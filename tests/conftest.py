@@ -1,0 +1,34 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; all sharding/pipeline tests run
+against 8 virtual CPU devices (the same validation path the driver uses via
+``__graft_entry__.dryrun_multichip``). Must run before jax is imported
+anywhere, hence the env mutation at module import time.
+"""
+
+import asyncio
+import inspect
+import os
+
+import pytest
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+  os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("XOT_TPU_UUID", "test-node-id")
+
+
+def pytest_configure(config):
+  config.addinivalue_line("markers", "asyncio: run test in an asyncio event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+  """Minimal pytest-asyncio replacement (the plugin isn't in the image)."""
+  fn = pyfuncitem.obj
+  if inspect.iscoroutinefunction(fn):
+    kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+    asyncio.run(fn(**kwargs))
+    return True
+  return None

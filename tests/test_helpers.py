@@ -1,0 +1,75 @@
+import asyncio
+
+import pytest
+
+from xotorch_support_jetson_tpu.utils.helpers import (
+  AsyncCallbackSystem,
+  PrefixDict,
+  find_available_port,
+  get_or_create_node_id,
+  pretty_print_bytes,
+)
+
+
+@pytest.mark.asyncio
+async def test_callback_wait_and_trigger():
+  system: AsyncCallbackSystem[str, int] = AsyncCallbackSystem()
+  cb = system.register("req1")
+  seen = []
+  cb.on_next(lambda v: seen.append(v))
+
+  async def fire():
+    await asyncio.sleep(0.01)
+    system.trigger("req1", 41)
+    await asyncio.sleep(0.01)
+    system.trigger("req1", 42)
+
+  task = asyncio.create_task(fire())
+  result = await cb.wait(lambda v: v == 42, timeout=2)
+  await task
+  assert result == (42,)
+  assert seen == [41, 42]
+
+
+@pytest.mark.asyncio
+async def test_callback_wait_timeout():
+  system: AsyncCallbackSystem[str, int] = AsyncCallbackSystem()
+  cb = system.register("req")
+  with pytest.raises(asyncio.TimeoutError):
+    await cb.wait(lambda v: True, timeout=0.05)
+
+
+@pytest.mark.asyncio
+async def test_trigger_all():
+  system: AsyncCallbackSystem[str, str] = AsyncCallbackSystem()
+  a, b = system.register("a"), system.register("b")
+  system.trigger_all("x")
+  assert a.result == ("x",) and b.result == ("x",)
+  system.deregister("a")
+  system.trigger("a", "y")  # no-op, no raise
+
+
+def test_prefix_dict():
+  d: PrefixDict[str, int] = PrefixDict()
+  d["chatcmpl-abc"] = 1
+  d["chatcmpl-abcdef"] = 2
+  assert d.find_longest_prefix("chatcmpl-abcdef-xyz") == ("chatcmpl-abcdef", 2)
+  assert len(d.items_with_prefix("chatcmpl-")) == 2
+
+
+def test_find_available_port_binds():
+  import socket
+
+  port = find_available_port("127.0.0.1")
+  with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+    s.bind(("127.0.0.1", port))
+
+
+def test_node_id_env_override():
+  assert get_or_create_node_id() == "test-node-id"
+
+
+def test_pretty_bytes():
+  assert pretty_print_bytes(512) == "512 B"
+  assert pretty_print_bytes(2048) == "2.00 KB"
+  assert pretty_print_bytes(3 * 1024**3) == "3.00 GB"

@@ -1,0 +1,103 @@
+"""Partition math tests — mirrors the reference's coverage-guarantee strategy
+(``topology/test_map_partitions.py``, ``test_ring_memory_weighted_...py``)."""
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.topology import (
+  DeviceCapabilities,
+  DeviceFlops,
+  Partition,
+  RingMemoryWeightedPartitioningStrategy,
+  Topology,
+  map_partitions_to_shards,
+)
+
+
+def caps(memory: int) -> DeviceCapabilities:
+  return DeviceCapabilities(model="m", chip="c", memory=memory, flops=DeviceFlops(0, 0, 0))
+
+
+def assert_full_coverage(shards: list[Shard], n_layers: int):
+  assert shards[0].start_layer == 0
+  assert shards[-1].end_layer == n_layers - 1
+  for a, b in zip(shards, shards[1:]):
+    assert b.start_layer == a.end_layer + 1
+
+
+def test_map_partitions_exact_thirds():
+  partitions = [Partition("a", 0.0, 1 / 3), Partition("b", 1 / 3, 2 / 3), Partition("c", 2 / 3, 1.0)]
+  shards = map_partitions_to_shards(partitions, 32, "m")
+  assert [(s.start_layer, s.end_layer) for s in shards] == [(0, 10), (11, 20), (21, 31)]
+  assert_full_coverage(shards, 32)
+
+
+def test_map_partitions_rounding_coverage():
+  # Fractions that don't sum exactly to 1.0 must still cover all layers.
+  partitions = [Partition("a", 0.0, 0.42857), Partition("b", 0.42857, 0.71428), Partition("c", 0.71428, 0.99999)]
+  for n_layers in (5, 7, 16, 27, 32, 80, 126):
+    shards = map_partitions_to_shards(partitions, n_layers, "m")
+    assert_full_coverage(shards, n_layers)
+
+
+def test_map_partitions_single_node():
+  shards = map_partitions_to_shards([Partition("a", 0.0, 1.0)], 16, "m")
+  assert shards == [Shard("m", 0, 15, 16)]
+
+
+def test_map_partitions_more_nodes_than_layers():
+  partitions = [Partition(str(i), i / 8, (i + 1) / 8) for i in range(8)]
+  shards = map_partitions_to_shards(partitions, 4, "m")
+  # Fewer shards than partitions is fine; coverage must hold.
+  assert_full_coverage(shards, 4)
+
+
+def test_ring_memory_weighted_proportional():
+  t = Topology()
+  t.update_node("node1", caps(16 * 1024))
+  t.update_node("node2", caps(48 * 1024))
+  partitions = RingMemoryWeightedPartitioningStrategy().partition(t)
+  # Sorted by memory desc: node2 gets 75%, node1 gets 25%.
+  assert partitions[0].node_id == "node2"
+  assert abs(partitions[0].end - 0.75) < 1e-4
+  assert abs(partitions[-1].end - 1.0) < 1e-4
+
+
+def test_ring_memory_weighted_deterministic_tiebreak():
+  t1, t2 = Topology(), Topology()
+  for t in (t1, t2):
+    for nid in ("b", "a", "c"):
+      t.update_node(nid, caps(1024))
+  p1 = RingMemoryWeightedPartitioningStrategy().partition(t1)
+  p2 = RingMemoryWeightedPartitioningStrategy().partition(t2)
+  assert [p.node_id for p in p1] == [p.node_id for p in p2] == ["c", "b", "a"]
+
+
+def test_ring_memory_weighted_zero_memory_equal_split():
+  t = Topology()
+  for nid in ("a", "b"):
+    t.update_node(nid, caps(0))
+  partitions = RingMemoryWeightedPartitioningStrategy().partition(t)
+  assert abs(partitions[0].end - 0.5) < 1e-9
+  assert abs(partitions[1].end - 1.0) < 1e-9
+
+
+def test_shard_properties():
+  s = Shard("m", 0, 15, 32)
+  assert s.is_first_layer and not s.is_last_layer
+  assert s.n_shard_layers == 16
+  assert s.overlaps(Shard("m", 15, 20, 32))
+  assert not s.overlaps(Shard("m", 16, 31, 32))
+  assert not s.overlaps(Shard("other", 0, 15, 32))
+  assert Shard.from_dict(s.to_dict()) == s
+
+
+def test_topology_merge():
+  t1, t2 = Topology(), Topology()
+  t1.update_node("a", caps(1))
+  t2.update_node("b", caps(2))
+  t2.add_edge("b", "c")
+  t1.merge("b", t2)
+  assert set(t1.nodes) == {"a", "b"}
+  assert t1.get_neighbors("b") == {"c"}
+  rt = Topology.from_json(t1.to_json())
+  assert set(rt.nodes) == {"a", "b"}
+  assert rt.get_neighbors("b") == {"c"}

@@ -1,0 +1,43 @@
+from xotorch_support_jetson_tpu import registry
+from xotorch_support_jetson_tpu.registry import (
+  DUMMY_ENGINE,
+  JAX_ENGINE,
+  build_base_shard,
+  build_full_shard,
+  get_repo,
+  get_supported_models,
+  model_cards,
+)
+
+
+def test_cards_have_layers_and_family():
+  for model_id, card in model_cards.items():
+    assert card.layers >= 1, model_id
+    assert card.family, model_id
+    assert card.pretty, model_id
+
+
+def test_get_repo():
+  assert get_repo("llama-3.2-1b", JAX_ENGINE) == "unsloth/Llama-3.2-1B-Instruct"
+  assert get_repo("llama-3.2-1b", "NoSuchEngine") is None
+  assert get_repo("nope", JAX_ENGINE) is None
+  assert get_repo("dummy", DUMMY_ENGINE) == "dummy"
+
+
+def test_build_shards():
+  base = build_base_shard("llama-3.1-8b", JAX_ENGINE)
+  assert base is not None and (base.start_layer, base.end_layer, base.n_layers) == (0, 0, 32)
+  full = build_full_shard("llama-3.1-8b", JAX_ENGINE)
+  assert full is not None and full.is_first_layer and full.is_last_layer
+  assert build_base_shard("dummy", JAX_ENGINE) is None
+  assert build_base_shard("dummy", DUMMY_ENGINE) is not None
+
+
+def test_get_supported_models_filtering():
+  assert set(get_supported_models()) == set(model_cards.keys())
+  jax_models = get_supported_models([[JAX_ENGINE]])
+  assert "llama-3.1-8b" in jax_models and "dummy" not in jax_models
+  dummy_models = get_supported_models([["dummy"]])  # short engine alias
+  assert dummy_models == ["dummy"]
+  both = get_supported_models([[JAX_ENGINE], [DUMMY_ENGINE]])
+  assert both == []

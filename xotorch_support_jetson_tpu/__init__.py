@@ -1,0 +1,18 @@
+"""xotorch_support_jetson_tpu — a TPU-native distributed LLM inference and
+fine-tuning framework.
+
+Re-imagines the capability set of the reference project
+``satoutahhaithem/xotorch_support_jetson`` (an exo-v1 fork: peer-to-peer
+pipeline-parallel LLM serving over gRPC, see reference ``xotorch/``) as an
+idiomatic JAX/XLA framework:
+
+- compute path: jitted functional decoder over pytree params, static-shape
+  incremental decode with donated KV buffers, Pallas attention kernels;
+- parallelism: ``jax.sharding.Mesh`` + GSPMD tensor/FSDP sharding in-slice,
+  explicit pipeline stages with ``shard_map`` + ``lax.ppermute`` over ICI,
+  ring attention for sequence/context parallelism;
+- cluster plane: gRPC/UDP discovery + topology exchange retained only as a
+  thin control plane for heterogeneous multi-host deployments.
+"""
+
+__version__ = "0.1.0"

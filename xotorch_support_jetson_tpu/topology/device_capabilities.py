@@ -1,0 +1,158 @@
+"""Device capability probing — TPU-native.
+
+Capability parity with reference ``xotorch/topology/device_capabilities.py``
+(pydantic ``DeviceCapabilities`` model :35-49, hardcoded ``CHIP_FLOPS`` table
+:54-163, per-OS async probes :166-384). The reference probes Apple silicon,
+CUDA GPUs and Jetson boards; here the first-class citizen is the TPU: chip
+kind, count, and per-chip HBM come from live JAX runtime metadata
+(``jax.devices()``, ``device.memory_stats()``), with a small public-spec
+TFLOPS table for capability *estimates* (used only for placement weighting
+and viz, never for correctness). CPU fallback uses ``os.sysconf``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass
+
+from ..utils.helpers import DEBUG
+
+TFLOPS = 1.0
+
+
+@dataclass(frozen=True)
+class DeviceFlops:
+  # units: TFLOPS
+  fp32: float
+  fp16: float
+  int8: float
+
+  def to_dict(self) -> dict:
+    return asdict(self)
+
+
+@dataclass
+class DeviceCapabilities:
+  model: str
+  chip: str
+  memory: int  # MB
+  flops: DeviceFlops
+
+  def __str__(self) -> str:
+    return f"Model: {self.model}. Chip: {self.chip}. Memory: {self.memory}MB. Flops: fp32 {self.flops.fp32:.2f} TFLOPS, fp16 {self.flops.fp16:.2f} TFLOPS, int8 {self.flops.int8:.2f} TFLOPS"
+
+  def model_dump(self) -> dict:
+    return {"model": self.model, "chip": self.chip, "memory": self.memory, "flops": self.flops.to_dict()}
+
+  def to_dict(self) -> dict:
+    return self.model_dump()
+
+  @classmethod
+  def from_dict(cls, data: dict) -> "DeviceCapabilities":
+    flops = data.get("flops", {})
+    if isinstance(flops, DeviceFlops):
+      pass
+    else:
+      flops = DeviceFlops(fp32=flops.get("fp32", 0), fp16=flops.get("fp16", 0), int8=flops.get("int8", 0))
+    return cls(model=data.get("model", "Unknown"), chip=data.get("chip", "Unknown"), memory=data.get("memory", 0), flops=flops)
+
+
+UNKNOWN_DEVICE_CAPABILITIES = DeviceCapabilities(model="Unknown Model", chip="Unknown Chip", memory=0, flops=DeviceFlops(fp32=0, fp16=0, int8=0))
+
+# Public-spec peak compute per TPU chip generation (bf16 dense, int8 where
+# published). Estimates for placement weighting only — analogous in role to
+# the reference's CHIP_FLOPS table (device_capabilities.py:54-163) but keyed
+# on jax device_kind strings instead of GPU marketing names.
+TPU_CHIP_FLOPS: dict[str, DeviceFlops] = {
+  "tpu v2": DeviceFlops(fp32=11.5, fp16=23.0, int8=46.0),
+  "tpu v3": DeviceFlops(fp32=61.5, fp16=123.0, int8=246.0),
+  "tpu v4": DeviceFlops(fp32=137.5, fp16=275.0, int8=275.0),
+  "tpu v5 lite": DeviceFlops(fp32=98.5, fp16=197.0, int8=394.0),
+  "tpu v5e": DeviceFlops(fp32=98.5, fp16=197.0, int8=394.0),
+  "tpu v5": DeviceFlops(fp32=229.5, fp16=459.0, int8=918.0),
+  "tpu v5p": DeviceFlops(fp32=229.5, fp16=459.0, int8=918.0),
+  "tpu v6 lite": DeviceFlops(fp32=459.0, fp16=918.0, int8=1836.0),
+  "tpu v6e": DeviceFlops(fp32=459.0, fp16=918.0, int8=1836.0),
+  "tpu7x": DeviceFlops(fp32=1153.0, fp16=2307.0, int8=4614.0),
+}
+
+# Default per-chip HBM when memory_stats() is unavailable on the platform (MB).
+TPU_CHIP_HBM_MB: dict[str, int] = {
+  "tpu v2": 8 * 1024,
+  "tpu v3": 16 * 1024,
+  "tpu v4": 32 * 1024,
+  "tpu v5 lite": 16 * 1024,
+  "tpu v5e": 16 * 1024,
+  "tpu v5": 96 * 1024,
+  "tpu v5p": 96 * 1024,
+  "tpu v6 lite": 32 * 1024,
+  "tpu v6e": 32 * 1024,
+  "tpu7x": 192 * 1024,
+}
+
+
+def _lookup_chip(device_kind: str) -> tuple[DeviceFlops, int]:
+  kind = device_kind.lower().strip()
+  for key in sorted(TPU_CHIP_FLOPS, key=len, reverse=True):
+    if kind.startswith(key) or key in kind:
+      return TPU_CHIP_FLOPS[key], TPU_CHIP_HBM_MB.get(key, 16 * 1024)
+  return DeviceFlops(fp32=0, fp16=0, int8=0), 16 * 1024
+
+
+def _host_memory_mb() -> int:
+  try:
+    pages = os.sysconf("SC_PHYS_PAGES")
+    page_size = os.sysconf("SC_PAGE_SIZE")
+    return int(pages * page_size / (1024 * 1024))
+  except (ValueError, OSError):
+    return 0
+
+
+def _tpu_device_capabilities() -> DeviceCapabilities | None:
+  try:
+    import jax
+
+    devices = [d for d in jax.local_devices() if d.platform != "cpu"]
+  except Exception:  # noqa: BLE001 — no JAX backend is a soft failure
+    return None
+  if not devices:
+    return None
+  kind = devices[0].device_kind
+  flops, default_hbm = _lookup_chip(kind)
+  per_chip_mb = default_hbm
+  try:
+    stats = devices[0].memory_stats()
+    if stats and stats.get("bytes_limit"):
+      per_chip_mb = int(stats["bytes_limit"] / (1024 * 1024))
+  except Exception:  # noqa: BLE001 — memory_stats unsupported on some platforms
+    pass
+  n = len(devices)
+  return DeviceCapabilities(
+    model=f"TPU host ({n}x {kind})",
+    chip=kind,
+    memory=per_chip_mb * n,
+    flops=DeviceFlops(fp32=flops.fp32 * n, fp16=flops.fp16 * n, int8=flops.int8 * n),
+  )
+
+
+async def device_capabilities() -> DeviceCapabilities:
+  """Probe this host's accelerator (TPU first, CPU fallback)."""
+  caps = _tpu_device_capabilities()
+  if caps is not None:
+    if DEBUG >= 2:
+      print(f"[device_capabilities] {caps}")
+    return caps
+  mem = _host_memory_mb()
+  return DeviceCapabilities(
+    model=f"CPU host ({os.uname().machine})" if hasattr(os, "uname") else "CPU host",
+    chip="cpu",
+    memory=mem,
+    flops=DeviceFlops(fp32=0.1, fp16=0.1, int8=0.2),
+  )
+
+
+def device_capabilities_sync() -> DeviceCapabilities:
+  caps = _tpu_device_capabilities()
+  if caps is not None:
+    return caps
+  return DeviceCapabilities(model="CPU host", chip="cpu", memory=_host_memory_mb(), flops=DeviceFlops(fp32=0.1, fp16=0.1, int8=0.2))

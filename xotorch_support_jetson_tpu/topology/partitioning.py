@@ -1,0 +1,86 @@
+"""Placement policy: layer-space partitions and memory-weighted assignment.
+
+Capability parity with reference ``xotorch/topology/partitioning_strategy.py``
+(Partition fractions :11-15, ``map_partitions_to_shards`` coverage guarantees
+:24-42) and ``ring_memory_weighted_partitioning_strategy.py:8-18``.
+
+Contract preserved from the reference: placement is a *deterministic function
+of the topology view* (sort by memory desc, then node-id), so every peer that
+has merged the same topology computes identical partitions without any
+consensus round. Layer ranges are contiguous, non-overlapping, and cover
+``[0, n_layers)`` exactly regardless of float rounding — achieved here by
+rounding *cumulative* boundaries instead of per-node widths.
+
+TPU extension: on a homogeneous slice the same strategy degenerates to equal
+splits; per-chip HBM comes from live device metadata (device_capabilities.py)
+instead of a hardcoded chip table.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+
+from ..inference.shard import Shard
+from .topology import Topology
+
+
+@dataclass(frozen=True)
+class Partition:
+  node_id: str
+  start: float  # fraction of layer space, [0, 1)
+  end: float
+
+  def to_dict(self) -> dict:
+    return {"node_id": self.node_id, "start": self.start, "end": self.end}
+
+
+class PartitioningStrategy(ABC):
+  @abstractmethod
+  def partition(self, topology: Topology) -> list[Partition]:
+    ...
+
+
+def map_partitions_to_shards(partitions: list[Partition], n_layers: int, model_id: str) -> list[Shard]:
+  """Convert fractional partitions to contiguous inclusive layer-range shards.
+
+  Boundaries are ``round(p.end * n_layers)`` clamped monotonic, with the final
+  boundary forced to ``n_layers`` — guaranteeing exact coverage even when the
+  fractions don't sum to 1.0 bit-exactly (the rounding-regression case the
+  reference tests in ``topology/test_map_partitions.py:54-77``).
+  """
+  shards: list[Shard] = []
+  prev_boundary = 0
+  for i, partition in enumerate(partitions):
+    boundary = round(partition.end * n_layers) if i < len(partitions) - 1 else n_layers
+    boundary = max(prev_boundary, min(boundary, n_layers))
+    if i == len(partitions) - 1:
+      boundary = n_layers
+    if boundary > prev_boundary:
+      shards.append(Shard(model_id, prev_boundary, boundary - 1, n_layers))
+    prev_boundary = boundary
+  return shards
+
+
+class RingMemoryWeightedPartitioningStrategy(PartitioningStrategy):
+  """Assign each node a contiguous fraction of layers proportional to its memory.
+
+  Ring order: memory descending, then node-id (deterministic tiebreak) —
+  the same ordering contract as the reference strategy so independently
+  computed views agree.
+  """
+
+  def partition(self, topology: Topology) -> list[Partition]:
+    nodes = sorted(topology.all_nodes(), key=lambda kv: (kv[1].memory, kv[0]), reverse=True)
+    total_memory = sum(caps.memory for _, caps in nodes)
+    if total_memory == 0:
+      # All-unknown-memory cluster: fall back to equal split.
+      n = len(nodes)
+      return [Partition(node_id, i / n, (i + 1) / n) for i, (node_id, _) in enumerate(nodes)]
+    partitions: list[Partition] = []
+    start = 0.0
+    for node_id, caps in nodes:
+      end = round(start + caps.memory / total_memory, 5)
+      partitions.append(Partition(node_id, start, end))
+      start = end
+    return partitions

@@ -1,0 +1,23 @@
+from .helpers import (
+  DEBUG,
+  DEBUG_DISCOVERY,
+  AsyncCallback,
+  AsyncCallbackSystem,
+  PrefixDict,
+  find_available_port,
+  get_or_create_node_id,
+  pretty_print_bytes,
+  pretty_print_bytes_per_second,
+)
+
+__all__ = [
+  "DEBUG",
+  "DEBUG_DISCOVERY",
+  "AsyncCallback",
+  "AsyncCallbackSystem",
+  "PrefixDict",
+  "find_available_port",
+  "get_or_create_node_id",
+  "pretty_print_bytes",
+  "pretty_print_bytes_per_second",
+]

@@ -1,0 +1,216 @@
+"""Cross-cutting helpers: debug flags, async callback fan-out, small net/id utils.
+
+Capability parity with reference ``xotorch/helpers.py`` (DEBUG env levels
+:19-21, AsyncCallbackSystem :104-149, port/node-id/interface utilities
+:234-315), re-implemented for this framework. The callback system is the one
+piece of the reference design that is transport- and engine-agnostic and was
+explicitly worth keeping (SURVEY.md §7 design translation table).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import socket
+import uuid
+from pathlib import Path
+from typing import Any, Callable, Generic, TypeVar, TypeVarTuple, Unpack
+
+DEBUG = int(os.getenv("DEBUG", "0"))
+DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
+
+XOT_HOME = Path(os.getenv("XOT_TPU_HOME", Path.home() / ".cache" / "xot_tpu"))
+
+T = TypeVar("T")
+Ts = TypeVarTuple("Ts")
+
+
+class AsyncCallback(Generic[Unpack[Ts]]):
+  """A single awaitable callback channel.
+
+  ``wait(check, timeout)`` blocks until a ``trigger`` whose args satisfy
+  ``check``; ``on_next`` registers a synchronous observer for every trigger.
+  """
+
+  def __init__(self) -> None:
+    self.condition: asyncio.Condition = asyncio.Condition()
+    self.result: tuple[Unpack[Ts]] | None = None
+    self.observers: list[Callable[[Unpack[Ts]], None]] = []
+
+  async def wait(self, check_condition: Callable[[Unpack[Ts]], bool], timeout: float | None = None) -> tuple[Unpack[Ts]]:
+    async with self.condition:
+      await asyncio.wait_for(
+        self.condition.wait_for(lambda: self.result is not None and check_condition(*self.result)),
+        timeout,
+      )
+      assert self.result is not None
+      return self.result
+
+  def on_next(self, callback: Callable[[Unpack[Ts]], None]) -> None:
+    self.observers.append(callback)
+
+  def set(self, *args: Unpack[Ts]) -> None:
+    self.result = args
+    for observer in self.observers:
+      observer(*args)
+    loop = asyncio.get_event_loop()
+    loop.create_task(self._notify())
+
+  async def _notify(self) -> None:
+    async with self.condition:
+      self.condition.notify_all()
+
+
+class AsyncCallbackSystem(Generic[T, Unpack[Ts]]):
+  """Keyed registry of AsyncCallbacks with broadcast trigger."""
+
+  def __init__(self) -> None:
+    self.callbacks: dict[T, AsyncCallback[Unpack[Ts]]] = {}
+
+  def register(self, name: T) -> AsyncCallback[Unpack[Ts]]:
+    if name not in self.callbacks:
+      self.callbacks[name] = AsyncCallback[Unpack[Ts]]()
+    return self.callbacks[name]
+
+  def deregister(self, name: T) -> None:
+    self.callbacks.pop(name, None)
+
+  def trigger(self, name: T, *args: Unpack[Ts]) -> None:
+    if name in self.callbacks:
+      self.callbacks[name].set(*args)
+
+  def trigger_all(self, *args: Unpack[Ts]) -> None:
+    for callback in list(self.callbacks.values()):
+      callback.set(*args)
+
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class PrefixDict(Generic[K, V]):
+  """Dict queried by key prefix (used for request-id lookups in the API)."""
+
+  def __init__(self) -> None:
+    self.items: dict[K, V] = {}
+
+  def __setitem__(self, key: K, value: V) -> None:
+    self.items[key] = value
+
+  def __getitem__(self, key: K) -> V:
+    return self.items[key]
+
+  def __contains__(self, key: K) -> bool:
+    return key in self.items
+
+  def items_with_prefix(self, prefix: str) -> list[tuple[K, V]]:
+    return [(k, v) for k, v in self.items.items() if str(k).startswith(prefix)]
+
+  def find_prefix(self, argument: str) -> list[tuple[K, V]]:
+    return [(k, v) for k, v in self.items.items() if argument.startswith(str(k))]
+
+  def find_longest_prefix(self, argument: str) -> tuple[K, V] | None:
+    matches = self.find_prefix(argument)
+    if not matches:
+      return None
+    return max(matches, key=lambda kv: len(str(kv[0])))
+
+
+def find_available_port(host: str = "", min_port: int = 49152, max_port: int = 65535) -> int:
+  """Pick a free TCP port by bind-probing random candidates."""
+  for _ in range(100):
+    port = random.randint(min_port, max_port)
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+      try:
+        s.bind((host, port))
+        return port
+      except OSError:
+        continue
+  raise RuntimeError("no available port found")
+
+
+def get_or_create_node_id() -> str:
+  """Stable node identity persisted under the framework cache dir.
+
+  Honors ``XOT_TPU_UUID`` for tests/deployments that pin identity (reference
+  honors ``XOT_UUID``, ``helpers.py:360``).
+  """
+  if env_id := os.getenv("XOT_TPU_UUID"):
+    return env_id
+  id_file = XOT_HOME / ".node_id"
+  try:
+    if id_file.is_file():
+      stored = id_file.read_text().strip()
+      if stored:
+        return stored
+    node_id = str(uuid.uuid4())
+    id_file.parent.mkdir(parents=True, exist_ok=True)
+    id_file.write_text(node_id)
+    return node_id
+  except OSError:
+    return str(uuid.uuid4())
+
+
+def pretty_print_bytes(size_in_bytes: float) -> str:
+  for unit, divisor in (("TB", 1024**4), ("GB", 1024**3), ("MB", 1024**2), ("KB", 1024)):
+    if size_in_bytes >= divisor:
+      return f"{size_in_bytes / divisor:.2f} {unit}"
+  return f"{size_in_bytes:.0f} B"
+
+
+def pretty_print_bytes_per_second(bytes_per_second: float) -> str:
+  return f"{pretty_print_bytes(bytes_per_second)}/s"
+
+
+# Interface-type priority for discovery: when the same peer is reachable over
+# multiple links prefer the fastest (reference scores Thunderbolt > Ethernet >
+# WiFi, ``helpers.py:284-315``). On TPU hosts the analogous ranking is
+# ICI-attached (same slice) > DCN/Ethernet > WiFi > other.
+INTERFACE_PRIORITY = {
+  "ici": 50,
+  "thunderbolt": 40,
+  "ethernet": 30,
+  "wifi": 20,
+  "other": 10,
+  "loopback": 5,
+}
+
+
+def get_interface_priority_and_type(interface_name: str) -> tuple[int, str]:
+  name = interface_name.lower()
+  if name.startswith("lo"):
+    return INTERFACE_PRIORITY["loopback"], "loopback"
+  if name.startswith(("eth", "en", "eno", "ens", "enp")):
+    return INTERFACE_PRIORITY["ethernet"], "ethernet"
+  if name.startswith(("wlan", "wl", "wifi")):
+    return INTERFACE_PRIORITY["wifi"], "wifi"
+  if "thunderbolt" in name or name.startswith("tb"):
+    return INTERFACE_PRIORITY["thunderbolt"], "thunderbolt"
+  return INTERFACE_PRIORITY["other"], "other"
+
+
+def get_all_ip_addresses_and_interfaces() -> list[tuple[str, str]]:
+  """Best-effort enumeration of (ip, interface) pairs without psutil."""
+  results: list[tuple[str, str]] = []
+  try:
+    import socket as _socket
+
+    hostname = _socket.gethostname()
+    for info in _socket.getaddrinfo(hostname, None, _socket.AF_INET):
+      ip = info[4][0]
+      if ip and not ip.startswith("127."):
+        results.append((ip, "ethernet"))
+  except OSError:
+    pass
+  # Fallback: UDP-connect trick for the primary outbound interface.
+  if not results:
+    try:
+      with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.connect(("8.8.8.8", 80))
+        results.append((s.getsockname()[0], "ethernet"))
+    except OSError:
+      pass
+  if not results:
+    results.append(("127.0.0.1", "loopback"))
+  return list(dict.fromkeys(results))
