@@ -23,6 +23,19 @@ def pytest_configure(config):
   config.addinivalue_line("markers", "asyncio: run test in an asyncio event loop")
 
 
+@pytest.fixture(autouse=True)
+def _fp32_matmuls():
+  """Numerical tests compare reduction orders; run matmuls in true fp32.
+
+  (This build's DEFAULT matmul precision computes fp32 matmuls with bf16
+  passes, which would swamp cache-vs-full equivalence at ~2^-8.)
+  """
+  import jax
+
+  with jax.default_matmul_precision("highest"):
+    yield
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
   """Minimal pytest-asyncio replacement (the plugin isn't in the image)."""

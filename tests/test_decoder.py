@@ -1,0 +1,115 @@
+"""Decoder numerical tests.
+
+The central assertion mirrors the reference's key correctness test
+(``inference/test_inference_engine.py:12-47``): a full-model forward must
+equal the composition of layer-range shards, for both prefill and incremental
+decode. Plus: KV-cache decode must reproduce cache-less full-context forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  init_kv_cache,
+  init_shard_params,
+  shard_forward,
+  slice_shard_params,
+)
+
+CFG = tiny_test_config()
+KEY = jax.random.PRNGKey(0)
+
+
+def _positions(B, S, start=0):
+  return jnp.broadcast_to(jnp.arange(start, start + S, dtype=jnp.int32), (B, S))
+
+
+def test_forward_shapes():
+  params, shard = full_model_params(KEY, CFG)
+  tokens = jnp.array([[1, 2, 3, 4, 5]], dtype=jnp.int32)
+  logits, cache = shard_forward(params, CFG, shard, tokens, _positions(1, 5), None)
+  assert logits.shape == (1, 5, CFG.vocab_size)
+  assert cache is None
+
+
+def test_shard_composition_matches_full():
+  params, full_shard = full_model_params(KEY, CFG)
+  tokens = jnp.array([[7, 3, 9, 1, 4, 2]], dtype=jnp.int32)
+  pos = _positions(1, 6)
+
+  full_logits, _ = shard_forward(params, CFG, full_shard, tokens, pos, None)
+
+  s1 = Shard("model", 0, 1, CFG.n_layers)
+  s2 = Shard("model", 2, 3, CFG.n_layers)
+  p1 = slice_shard_params(params, CFG, full_shard, s1)
+  p2 = slice_shard_params(params, CFG, full_shard, s2)
+  hidden, _ = shard_forward(p1, CFG, s1, tokens, pos, None)
+  composed_logits, _ = shard_forward(p2, CFG, s2, hidden, pos, None)
+
+  np.testing.assert_allclose(np.asarray(full_logits), np.asarray(composed_logits), rtol=1e-5, atol=1e-5)
+
+
+def test_cached_decode_matches_cacheless_forward():
+  """Prefill + N cached decode steps == cache-less forward over the full seq."""
+  params, shard = full_model_params(KEY, CFG)
+  prompt = jnp.array([[5, 11, 42]], dtype=jnp.int32)
+  prompt_len = 3
+  n_steps = 4
+  max_seq = 16
+
+  # Cached path, with right-padded prefill (pad slots get overwritten later).
+  cache = init_kv_cache(CFG, shard.n_shard_layers, 1, max_seq)
+  pad = jnp.zeros((1, 8), dtype=jnp.int32).at[:, :prompt_len].set(prompt)
+  logits, cache = shard_forward(params, CFG, shard, pad, _positions(1, 8), cache)
+  seq = prompt
+  cached_last = [np.asarray(logits[:, prompt_len - 1, :])]
+  for step in range(n_steps):
+    nxt = jnp.argmax(jnp.asarray(cached_last[-1]), axis=-1).astype(jnp.int32)[None, :]
+    pos = _positions(1, 1, start=prompt_len + step)
+    logits, cache = shard_forward(params, CFG, shard, nxt, pos, cache)
+    seq = jnp.concatenate([seq, nxt], axis=1)
+    cached_last.append(np.asarray(logits[:, 0, :]))
+
+  # Cache-less reference path over the growing sequence.
+  for i in range(n_steps + 1):
+    sub = seq[:, : prompt_len + i]
+    ref_logits, _ = shard_forward(params, CFG, shard, sub, _positions(1, sub.shape[1]), None)
+    np.testing.assert_allclose(cached_last[i], np.asarray(ref_logits[:, -1, :]), rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_and_bias_variants():
+  cfg = tiny_test_config(qkv_bias=True, n_kv_heads=4)  # MHA + bias (qwen-style)
+  params, shard = full_model_params(KEY, cfg)
+  assert "bq" in params["layers"]
+  tokens = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+  logits, _ = shard_forward(params, cfg, shard, tokens, _positions(1, 3), None)
+  assert logits.shape == (1, 3, cfg.vocab_size)
+  assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_tied_embedding_fallback():
+  cfg = tiny_test_config(tied_embedding=True)
+  params, shard = full_model_params(KEY, cfg)
+  assert "lm_head" not in params
+  tokens = jnp.array([[1, 2]], dtype=jnp.int32)
+  logits, _ = shard_forward(params, cfg, shard, tokens, _positions(1, 2), None)
+  assert logits.shape == (1, 2, cfg.vocab_size)
+
+
+def test_llama3_rope_scaling_changes_freqs():
+  from xotorch_support_jetson_tpu.models.config import RopeScaling
+  from xotorch_support_jetson_tpu.ops.rope import rope_inv_freq
+
+  base = tiny_test_config(max_seq_len=16384)
+  scaled = tiny_test_config(max_seq_len=16384, rope_scaling=RopeScaling(factor=8.0, original_max_position_embeddings=64))
+  f0 = rope_inv_freq(base)
+  f1 = rope_inv_freq(scaled)
+  assert f0.shape == f1.shape
+  assert not np.allclose(np.asarray(f0), np.asarray(f1))
+  # Low frequencies must be divided by the factor; highest kept.
+  np.testing.assert_allclose(np.asarray(f1[-1]), np.asarray(f0[-1] / 8.0), rtol=1e-5)
+  np.testing.assert_allclose(np.asarray(f1[0]), np.asarray(f0[0]), rtol=1e-5)

@@ -1,0 +1,98 @@
+"""Engine contract tests.
+
+``test_jax_engine_sharded_composition`` is this framework's version of the
+reference's core correctness test (``inference/test_inference_engine.py:12-47``):
+full-model engine output must equal two half-model engines passing hidden
+state in-memory — multi-node pipeline semantics without any network.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+from xotorch_support_jetson_tpu.inference.engine import get_inference_engine
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.inference.state import InferenceState
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params, slice_shard_params
+
+
+@pytest.mark.asyncio
+async def test_dummy_engine_contract():
+  engine = DummyInferenceEngine()
+  shard = Shard("dummy", 0, 7, 8)
+  out, state = await engine.infer_prompt("req", shard, "hello world test")
+  assert out.shape[0] == 1
+  np.testing.assert_array_equal(out, np.asarray([[6.0, 6.0, 5.0]]))  # len+1 per word
+  token = await engine.sample(out)
+  assert token.shape == (1,)
+  text = await engine.decode(shard, token)
+  assert isinstance(text, str)
+  assert state.curr_pos == 3
+
+
+@pytest.mark.asyncio
+async def test_dummy_engine_middle_shard_passthrough():
+  engine = DummyInferenceEngine()
+  middle = Shard("dummy", 2, 5, 8)
+  x = np.ones((1, 4), dtype=np.int32)
+  out, _ = await engine.infer_tensor("req", middle, x)
+  np.testing.assert_array_equal(out, x.astype(np.float32))
+
+
+def test_engine_factory():
+  assert isinstance(get_inference_engine("dummy"), DummyInferenceEngine)
+  assert isinstance(get_inference_engine("jax"), JaxShardedInferenceEngine)
+  with pytest.raises(ValueError):
+    get_inference_engine("mlx")
+
+
+@pytest.mark.asyncio
+async def test_jax_engine_sharded_composition():
+  cfg = tiny_test_config()
+  params, full_shard = full_model_params(jax.random.PRNGKey(1), cfg, "m")
+  pp = cfg.n_layers // 2 - 1
+  s1, s2 = Shard("m", 0, pp, cfg.n_layers), Shard("m", pp + 1, cfg.n_layers - 1, cfg.n_layers)
+
+  engine_full = JaxShardedInferenceEngine()
+  engine_full.load_test_model(full_shard, cfg, params)
+  engine_1 = JaxShardedInferenceEngine()
+  engine_1.load_test_model(s1, cfg, slice_shard_params(params, cfg, full_shard, s1))
+  engine_2 = JaxShardedInferenceEngine()
+  engine_2.load_test_model(s2, cfg, slice_shard_params(params, cfg, full_shard, s2))
+
+  tokens = np.array([[3, 17, 92, 5]], dtype=np.int32)
+
+  # Prefill: full vs composed.
+  logits_full, state_f = await engine_full.infer_tensor("r1", full_shard, tokens)
+  hidden, state_1 = await engine_1.infer_tensor("r2", s1, tokens)
+  logits_comp, state_2 = await engine_2.infer_tensor("r2", s2, hidden, state_1)
+  assert logits_full.shape == (1, cfg.vocab_size)
+  np.testing.assert_allclose(logits_full, logits_comp, rtol=1e-4, atol=1e-4)
+
+  # One decode step: feed the sampled token back through both paths.
+  next_tok = np.argmax(logits_full, axis=-1).astype(np.int32).reshape(1, 1)
+  l_full2, _ = await engine_full.infer_tensor("r1", full_shard, next_tok, state_f)
+  h2, state_1b = await engine_1.infer_tensor("r2", s1, next_tok, state_2)
+  l_comp2, _ = await engine_2.infer_tensor("r2", s2, h2, state_1b)
+  np.testing.assert_allclose(l_full2, l_comp2, rtol=1e-4, atol=1e-4)
+
+  # Decode advanced exactly one position past the prompt.
+  assert state_1b.curr_pos == tokens.shape[1] + 1
+
+
+@pytest.mark.asyncio
+async def test_jax_engine_greedy_sample_deterministic():
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(2), cfg, "m")
+  engine = JaxShardedInferenceEngine()
+  engine.load_test_model(shard, cfg, params)
+  tokens = np.array([[9, 8, 7]], dtype=np.int32)
+  logits, _ = await engine.infer_tensor("a", shard, tokens)
+  t1 = await engine.sample(logits, temp=0.0)
+  t2 = await engine.sample(logits, temp=0.0)
+  np.testing.assert_array_equal(t1, t2)
+  t3 = await engine.sample(logits, temp=0.8, top_k=10)
+  assert t3.shape == (1,)

@@ -1,0 +1,65 @@
+"""Tokenizer resolution: local download dir first, hub fallback.
+
+Capability parity with reference ``inference/tokenizers.py:26-63``
+(``resolve_tokenizer``/``_resolve_tokenizer``: AutoProcessor→AutoTokenizer
+fallback with eos/encode/decode patching). Kept async so API handlers can
+resolve without blocking the loop (transformers does file IO).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from pathlib import Path
+
+from ..utils.helpers import DEBUG
+
+
+class _TokenizerCache:
+  def __init__(self) -> None:
+    self._cache: dict[str, object] = {}
+
+  def get(self, key: str):
+    return self._cache.get(key)
+
+  def put(self, key: str, tok) -> None:
+    self._cache[key] = tok
+
+
+_cache = _TokenizerCache()
+
+
+def _load_tokenizer(source: str):
+  from transformers import AutoProcessor, AutoTokenizer
+
+  try:
+    tok = AutoTokenizer.from_pretrained(source, trust_remote_code=False)
+    return tok
+  except Exception as e:  # noqa: BLE001 — processor-only repos (e.g. llava)
+    if DEBUG >= 2:
+      print(f"[tokenizers] AutoTokenizer failed for {source}: {e}; trying AutoProcessor")
+    processor = AutoProcessor.from_pretrained(source, trust_remote_code=False)
+    inner = getattr(processor, "tokenizer", None)
+    if inner is not None:
+      # Patch the processor so callers can use the tokenizer surface uniformly
+      # (the reference patches eos/encode/decode the same way, tokenizers.py:41-63).
+      processor.eos_token_id = getattr(inner, "eos_token_id", None)
+      processor.encode = inner.encode
+      processor.decode = inner.decode
+      processor.all_special_tokens = getattr(inner, "all_special_tokens", [])
+    return processor
+
+
+async def resolve_tokenizer(repo_id: str, local_dir: str | Path | None = None):
+  """Resolve from ``local_dir`` if it holds tokenizer files, else from the hub."""
+  key = str(local_dir or repo_id)
+  if (tok := _cache.get(key)) is not None:
+    return tok
+  source = repo_id
+  if local_dir and Path(local_dir).exists():
+    has_tok = any((Path(local_dir) / f).exists() for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model"))
+    if has_tok:
+      source = str(local_dir)
+  tok = await asyncio.get_event_loop().run_in_executor(None, _load_tokenizer, source)
+  _cache.put(key, tok)
+  return tok

@@ -1,0 +1,145 @@
+"""Model configuration: the variation points of the llama/qwen/mistral/phi
+decoder family, plus the HF ``config.json`` → internal mapping.
+
+Capability parity with reference ``inference/torch/models/llm_utils.py:22-77``
+(``load_model_config``) and ``general_mha.py:33-63`` (per-family RoPE flavor,
+qkv bias, tied-embedding selection). Unlike the reference — which sniffs model
+*names* to decide tied embeddings (``general_mha.py:43-57``) — tying is taken
+from ``config.json``'s ``tie_word_embeddings`` with a family default.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+  """Llama-3 style frequency scaling (rope_type='llama3' in HF configs)."""
+
+  factor: float = 8.0
+  low_freq_factor: float = 1.0
+  high_freq_factor: float = 4.0
+  original_max_position_embeddings: int = 8192
+  rope_type: str = "llama3"
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+  vocab_size: int
+  dim: int  # embedding/residual width
+  n_layers: int
+  n_heads: int
+  n_kv_heads: int
+  hidden_dim: int  # MLP intermediate width
+  head_dim: int = 0  # 0 → dim // n_heads
+  norm_eps: float = 1e-5
+  rope_theta: float = 500000.0
+  rope_scaling: RopeScaling | None = None
+  max_seq_len: int = 8192
+  qkv_bias: bool = False  # qwen2 uses attention biases
+  attn_out_bias: bool = False
+  tied_embedding: bool = False
+  family: str = "llama"
+  dtype: Any = jnp.bfloat16
+  eos_token_ids: tuple[int, ...] = ()
+
+  def __post_init__(self):
+    if self.head_dim == 0:
+      object.__setattr__(self, "head_dim", self.dim // self.n_heads)
+
+  @property
+  def q_dim(self) -> int:
+    return self.n_heads * self.head_dim
+
+  @property
+  def kv_dim(self) -> int:
+    return self.n_kv_heads * self.head_dim
+
+  def with_layers(self, n_layers: int) -> "ModelConfig":
+    return replace(self, n_layers=n_layers)
+
+
+def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
+  """Map an HF ``config.json`` dict to ModelConfig.
+
+  Handles the same key space the reference maps
+  (``llm_utils.py:30-77``): llama/qwen2/mistral/phi3 config.json layouts,
+  including llama3 rope_scaling blocks and explicit ``head_dim`` overrides
+  (needed e.g. for Llama-3.2 where head_dim * n_heads != hidden_size is
+  false but qwen3-style configs carry it explicitly).
+  """
+  arch = (hf.get("architectures") or [""])[0].lower()
+  model_type = hf.get("model_type", "").lower()
+  family = "llama"
+  if "qwen2" in model_type or "qwen2" in arch:
+    family = "qwen2"
+  elif "mistral" in model_type or "mistral" in arch:
+    family = "mistral"
+  elif "phi3" in model_type or "phi3" in arch:
+    family = "phi3"
+
+  rope_scaling = None
+  rs = hf.get("rope_scaling")
+  if isinstance(rs, dict) and rs.get("rope_type", rs.get("type", "")) == "llama3":
+    rope_scaling = RopeScaling(
+      factor=float(rs.get("factor", 8.0)),
+      low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+      high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+      original_max_position_embeddings=int(rs.get("original_max_position_embeddings", 8192)),
+    )
+
+  eos = hf.get("eos_token_id", [])
+  if isinstance(eos, int):
+    eos = [eos]
+
+  torch_dtype = str(hf.get("torch_dtype", "bfloat16"))
+  dtype_map = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16, "float32": jnp.float32}
+
+  n_heads = int(hf["num_attention_heads"])
+  return ModelConfig(
+    vocab_size=int(hf["vocab_size"]),
+    dim=int(hf["hidden_size"]),
+    n_layers=int(hf["num_hidden_layers"]),
+    n_heads=n_heads,
+    n_kv_heads=int(hf.get("num_key_value_heads", n_heads)),
+    hidden_dim=int(hf["intermediate_size"]),
+    head_dim=int(hf.get("head_dim") or 0),
+    norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+    rope_theta=float(hf.get("rope_theta", 10000.0)),
+    rope_scaling=rope_scaling,
+    max_seq_len=int(hf.get("max_position_embeddings", 8192)),
+    qkv_bias=family == "qwen2" or bool(hf.get("attention_bias", False)),
+    tied_embedding=bool(hf.get("tie_word_embeddings", family == "qwen2" and int(hf["hidden_size"]) < 2048)),
+    family=family,
+    dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
+    eos_token_ids=tuple(int(e) for e in eos),
+  )
+
+
+def load_model_config(model_dir: str | Path, dtype=None) -> ModelConfig:
+  with open(Path(model_dir) / "config.json") as f:
+    return config_from_hf(json.load(f), dtype=dtype)
+
+
+def tiny_test_config(**overrides) -> ModelConfig:
+  """A small config for unit tests (CPU-fast, GQA + all variation points on)."""
+  defaults = dict(
+    vocab_size=256,
+    dim=64,
+    n_layers=4,
+    n_heads=4,
+    n_kv_heads=2,
+    hidden_dim=128,
+    norm_eps=1e-5,
+    rope_theta=10000.0,
+    max_seq_len=128,
+    dtype=jnp.float32,
+  )
+  defaults.update(overrides)
+  return ModelConfig(**defaults)

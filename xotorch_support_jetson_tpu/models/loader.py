@@ -1,0 +1,167 @@
+"""HF safetensors → decoder pytree weight loading, shard-aware.
+
+Capability parity with reference ``llm_utils.py:97-284``
+(``load_model_weights_torchtune``: per-layer regex renames :181-246, q/k
+permutation :126-134, embed/norm/lm_head mapping :249-269, ``check_weights``
+validator :80-95). Differences by design:
+
+- **No q/k permutation.** The reference permutes q/k because torchtune uses
+  interleaved RoPE pairing; our RoPE (ops/rope.py) uses the HF half-rotation
+  convention, so checkpoints load as stored.
+- **Stacked layers.** Per-layer tensors are stacked into ``[L, ...]`` leaves
+  to feed ``lax.scan`` (models/decoder.py) — the loader is where the AoS→SoA
+  transpose happens, once, at load time.
+- **Shard-aware file selection.** Only safetensors files containing the
+  shard's layer range are opened (same contract as the reference's
+  weight-map-based download filtering, ``new_shard_download.py:181-194``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.shard import Shard
+from ..utils.helpers import DEBUG
+from .config import ModelConfig
+from .decoder import Params
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+# HF per-layer suffix → (our key, transpose?)
+_LAYER_MAP: dict[str, tuple[str, bool]] = {
+  "input_layernorm.weight": ("attn_norm", False),
+  "self_attn.q_proj.weight": ("wq", True),
+  "self_attn.k_proj.weight": ("wk", True),
+  "self_attn.v_proj.weight": ("wv", True),
+  "self_attn.o_proj.weight": ("wo", True),
+  "self_attn.q_proj.bias": ("bq", False),
+  "self_attn.k_proj.bias": ("bk", False),
+  "self_attn.v_proj.bias": ("bv", False),
+  "post_attention_layernorm.weight": ("mlp_norm", False),
+  "mlp.gate_proj.weight": ("w_gate", True),
+  "mlp.up_proj.weight": ("w_up", True),
+  "mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def _to_numpy(tensor) -> np.ndarray:
+  """safetensors tensor (possibly torch bf16) → numpy (ml_dtypes bf16 ok)."""
+  if isinstance(tensor, np.ndarray):
+    return tensor
+  import ml_dtypes
+  import torch
+
+  if tensor.dtype == torch.bfloat16:
+    return tensor.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+  return tensor.numpy()
+
+
+def _weight_files_for_shard(model_dir: Path, shard: Shard) -> list[Path]:
+  """Resolve which .safetensors files hold this shard's tensors."""
+  index_path = model_dir / "model.safetensors.index.json"
+  if not index_path.exists():
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+      raise FileNotFoundError(f"no safetensors files under {model_dir}")
+    return files
+  with open(index_path) as f:
+    weight_map: dict[str, str] = json.load(f)["weight_map"]
+  needed: set[str] = set()
+  for name, fname in weight_map.items():
+    m = _LAYER_RE.match(name)
+    if m:
+      if shard.start_layer <= int(m.group(1)) <= shard.end_layer:
+        needed.add(fname)
+    elif name.startswith("model.embed_tokens") and (shard.is_first_layer or shard.is_last_layer):
+      needed.add(fname)
+    elif (name.startswith("model.norm") or name.startswith("lm_head")) and shard.is_last_layer:
+      needed.add(fname)
+  return [model_dir / f for f in sorted(needed)]
+
+
+def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) -> Params:
+  """Load a shard's params from HF safetensors into the decoder layout."""
+  from safetensors import safe_open
+
+  model_dir = Path(model_dir)
+  per_layer: dict[int, dict[str, np.ndarray]] = {i: {} for i in range(shard.start_layer, shard.end_layer + 1)}
+  top: dict[str, np.ndarray] = {}
+
+  for file in _weight_files_for_shard(model_dir, shard):
+    with safe_open(str(file), framework="pt") as f:
+      for name in f.keys():
+        m = _LAYER_RE.match(name)
+        if m:
+          layer_idx = int(m.group(1))
+          if not (shard.start_layer <= layer_idx <= shard.end_layer):
+            continue
+          mapped = _LAYER_MAP.get(m.group(2))
+          if mapped is None:
+            if DEBUG >= 3:
+              print(f"[loader] skipping unmapped tensor {name}")
+            continue
+          key, transpose = mapped
+          arr = _to_numpy(f.get_tensor(name))
+          per_layer[layer_idx][key] = arr.T if transpose else arr
+        elif name == "model.embed_tokens.weight":
+          if shard.is_first_layer or (shard.is_last_layer and cfg.tied_embedding):
+            top["embed_tokens"] = _to_numpy(f.get_tensor(name))
+        elif name == "model.norm.weight" and shard.is_last_layer:
+          top["final_norm"] = _to_numpy(f.get_tensor(name))
+        elif name == "lm_head.weight" and shard.is_last_layer:
+          top["lm_head"] = _to_numpy(f.get_tensor(name)).T
+
+  # Stack per-layer dicts (AoS) into [L, ...] leaves (SoA) for lax.scan.
+  layer_keys = sorted(per_layer[shard.start_layer].keys())
+  for idx, tensors in per_layer.items():
+    missing = set(layer_keys) - set(tensors)
+    if missing:
+      raise ValueError(f"layer {idx}: missing tensors {sorted(missing)}")
+  layers = {key: jnp.stack([jnp.asarray(per_layer[i][key], dtype=cfg.dtype) for i in range(shard.start_layer, shard.end_layer + 1)]) for key in layer_keys}
+
+  params: Params = {"layers": layers}
+  if shard.is_first_layer:
+    params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)
+  if shard.is_last_layer:
+    params["final_norm"] = jnp.asarray(top["final_norm"], dtype=cfg.dtype)
+    if "lm_head" in top:
+      params["lm_head"] = jnp.asarray(top["lm_head"], dtype=cfg.dtype)
+    elif cfg.tied_embedding:
+      if not shard.is_first_layer:
+        params["lm_head"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype).T
+      # first+last single shard: decoder falls back to embed.T
+    else:
+      raise ValueError("last shard: no lm_head weight and embeddings not tied")
+  check_shard_params(params, cfg, shard)
+  return params
+
+
+def check_shard_params(params: Params, cfg: ModelConfig, shard: Shard) -> None:
+  """Shape validator (role of reference ``check_weights``, llm_utils.py:80-95)."""
+  L = shard.n_shard_layers
+  expect = {
+    "attn_norm": (L, cfg.dim),
+    "wq": (L, cfg.dim, cfg.q_dim),
+    "wk": (L, cfg.dim, cfg.kv_dim),
+    "wv": (L, cfg.dim, cfg.kv_dim),
+    "wo": (L, cfg.q_dim, cfg.dim),
+    "mlp_norm": (L, cfg.dim),
+    "w_gate": (L, cfg.dim, cfg.hidden_dim),
+    "w_up": (L, cfg.dim, cfg.hidden_dim),
+    "w_down": (L, cfg.hidden_dim, cfg.dim),
+  }
+  if cfg.qkv_bias:
+    expect.update({"bq": (L, cfg.q_dim), "bk": (L, cfg.kv_dim), "bv": (L, cfg.kv_dim)})
+  for key, shape in expect.items():
+    actual = tuple(params["layers"][key].shape)
+    if actual != shape:
+      raise ValueError(f"layers/{key}: expected {shape}, got {actual}")
+  if shard.is_first_layer and tuple(params["embed"].shape) != (cfg.vocab_size, cfg.dim):
+    raise ValueError(f"embed: expected {(cfg.vocab_size, cfg.dim)}, got {params['embed'].shape}")
+  if shard.is_last_layer and "lm_head" in params and tuple(params["lm_head"].shape) != (cfg.dim, cfg.vocab_size):
+    raise ValueError(f"lm_head: expected {(cfg.dim, cfg.vocab_size)}, got {params['lm_head'].shape}")

@@ -1,0 +1,44 @@
+"""Grouped-query attention with position-index masking.
+
+The reference materializes boolean causal masks and ships them between peers
+(``llm_utils.py:497-503`` — O(seq²) per hop). Here masks are *computed* from
+absolute position indices inside the op: a query at absolute position p
+attends exactly the KV slots whose slot-index ≤ p. Because the KV cache is
+slot-indexed by absolute position, stale prefill padding (slots > p) is
+masked out for free and gets overwritten as decode advances.
+
+This is the XLA-fusable dense path; ``ops/pallas_attention.py`` provides the
+flash-attention Pallas kernel for long-sequence prefill with the same
+signature, and ``parallel/ring_attention.py`` builds the sequence-parallel
+ring on top of the same blockwise math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gqa_attention(
+  q: jnp.ndarray,  # [B, Sq, Hq, hd]
+  k: jnp.ndarray,  # [B, Skv, Hkv, hd]
+  v: jnp.ndarray,  # [B, Skv, Hkv, hd]
+  q_positions: jnp.ndarray,  # [B, Sq] absolute positions of queries
+  kv_positions: jnp.ndarray,  # [Skv] absolute positions (slot indices) of keys
+) -> jnp.ndarray:
+  """Returns [B, Sq, Hq, hd]; softmax in fp32; output in q.dtype."""
+  B, Sq, Hq, hd = q.shape
+  Hkv = k.shape[2]
+  group = Hq // Hkv
+  scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
+
+  qg = q.reshape(B, Sq, Hkv, group, hd)
+  # scores: [B, Hkv, group, Sq, Skv]
+  scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32)) * scale
+  mask = kv_positions[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,Sq,Skv]
+  scores = jnp.where(mask, scores, NEG_INF)
+  probs = jax.nn.softmax(scores, axis=-1)
+  out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+  return out.reshape(B, Sq, Hq, hd).astype(q.dtype)

@@ -1,0 +1,11 @@
+"""RMSNorm in fp32 accumulation (the llama-family norm)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+  x32 = x.astype(jnp.float32)
+  rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+  return ((x32 / rms) * weight.astype(jnp.float32)).astype(x.dtype)

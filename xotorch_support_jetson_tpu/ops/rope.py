@@ -1,0 +1,53 @@
+"""Rotary position embeddings with llama-3 frequency scaling.
+
+Covers the RoPE variation points the reference selects per family
+(``general_mha.py:33-63``: Llama3ScaledRoPE vs vanilla/qwen2 RoPE — both are
+the same math, llama3 additionally rescales inv_freq). Implemented as pure
+functions of positions so decode steps at arbitrary offsets need no
+precomputed tables — XLA fuses the sin/cos into the attention matmuls.
+
+Uses the HF "half-rotation" pairing (channel i pairs with i + head_dim/2),
+matching safetensors checkpoints as stored — so unlike the reference we need
+no q/k weight permutation at load time (cf. ``llm_utils.py:126-134``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig, RopeScaling
+
+
+def rope_inv_freq(cfg: ModelConfig) -> jnp.ndarray:
+  """[head_dim/2] inverse frequencies, with optional llama3 scaling."""
+  half = cfg.head_dim // 2
+  inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+  if cfg.rope_scaling is not None:
+    inv_freq = _llama3_scale(inv_freq, cfg.rope_scaling)
+  return inv_freq
+
+
+def _llama3_scale(inv_freq: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
+  wavelen = 2.0 * jnp.pi / inv_freq
+  low_wavelen = s.original_max_position_embeddings / s.low_freq_factor
+  high_wavelen = s.original_max_position_embeddings / s.high_freq_factor
+  # Long wavelengths (low freq): divide by factor. Short: keep. Middle: smooth.
+  smooth = (s.original_max_position_embeddings / wavelen - s.low_freq_factor) / (s.high_freq_factor - s.low_freq_factor)
+  scaled_mid = (1.0 - smooth) * inv_freq / s.factor + smooth * inv_freq
+  out = jnp.where(wavelen > low_wavelen, inv_freq / s.factor, inv_freq)
+  is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+  return jnp.where(is_mid, scaled_mid, out)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+  """Rotate ``x`` [..., S, H, head_dim] by angles from ``positions`` [..., S].
+
+  Half-rotation convention: (x1, x2) = split(x, 2, axis=-1);
+  out = (x1*cos - x2*sin, x2*cos + x1*sin).
+  """
+  angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]  # [..., S, half]
+  cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, half]
+  sin = jnp.sin(angles)[..., None, :]
+  x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+  out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+  return out.astype(x.dtype)
