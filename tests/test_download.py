@@ -1,0 +1,118 @@
+import asyncio
+from pathlib import Path
+
+import pytest
+
+from xotorch_support_jetson_tpu.download.downloader import (
+  CachedShardDownloader,
+  ShardDownloader,
+  SingletonShardDownloader,
+)
+from xotorch_support_jetson_tpu.download.hf_utils import (
+  extract_weight_map,
+  filter_repo_objects,
+  get_allow_patterns,
+)
+from xotorch_support_jetson_tpu.download.progress import RepoProgressEvent
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.utils.helpers import AsyncCallbackSystem
+
+WEIGHT_MAP = {
+  "model.embed_tokens.weight": "model-00001.safetensors",
+  "model.layers.0.self_attn.q_proj.weight": "model-00001.safetensors",
+  "model.layers.1.self_attn.q_proj.weight": "model-00002.safetensors",
+  "model.layers.2.self_attn.q_proj.weight": "model-00002.safetensors",
+  "model.layers.3.self_attn.q_proj.weight": "model-00003.safetensors",
+  "model.norm.weight": "model-00003.safetensors",
+  "lm_head.weight": "model-00003.safetensors",
+}
+
+
+def test_allow_patterns_middle_shard():
+  shard = Shard("m", 1, 2, 4)
+  patterns = get_allow_patterns(WEIGHT_MAP, shard)
+  assert "model-00002.safetensors" in patterns
+  assert "model-00001.safetensors" not in patterns
+  assert "model-00003.safetensors" not in patterns
+  assert "*.json" in patterns
+
+
+def test_allow_patterns_first_and_last():
+  first = get_allow_patterns(WEIGHT_MAP, Shard("m", 0, 0, 4))
+  assert "model-00001.safetensors" in first
+  last = get_allow_patterns(WEIGHT_MAP, Shard("m", 3, 3, 4))
+  assert "model-00003.safetensors" in last
+
+
+def test_allow_patterns_no_weight_map():
+  patterns = get_allow_patterns(None, Shard("m", 0, 3, 4))
+  assert "*.safetensors" in patterns
+
+
+def test_filter_repo_objects():
+  files = ["config.json", "model-00001.safetensors", "model-00002.safetensors", "README.md", "tokenizer.json"]
+  kept = filter_repo_objects(files, allow_patterns=["*.json", "model-00001.safetensors"])
+  assert kept == ["config.json", "model-00001.safetensors", "tokenizer.json"]
+  assert filter_repo_objects(files, allow_patterns=None, ignore_patterns=["*.md"]) == [f for f in files if f != "README.md"]
+
+
+def test_extract_weight_map():
+  assert extract_weight_map('{"weight_map": {"a": "f1"}}') == {"a": "f1"}
+  assert extract_weight_map("not json") is None
+
+
+class CountingDownloader(ShardDownloader):
+  def __init__(self, delay: float = 0.0):
+    self.calls = 0
+    self.delay = delay
+    self._on_progress = AsyncCallbackSystem()
+
+  async def ensure_shard(self, shard: Shard, engine: str) -> Path:
+    self.calls += 1
+    if self.delay:
+      await asyncio.sleep(self.delay)
+    return Path(f"/tmp/{shard.model_id}-{shard.start_layer}")
+
+  @property
+  def on_progress(self):
+    return self._on_progress
+
+
+@pytest.mark.asyncio
+async def test_cached_downloader_memoizes():
+  inner = CountingDownloader()
+  cached = CachedShardDownloader(inner)
+  shard = Shard("m", 0, 3, 4)
+  p1 = await cached.ensure_shard(shard, "E")
+  p2 = await cached.ensure_shard(shard, "E")
+  assert p1 == p2 and inner.calls == 1
+  await cached.ensure_shard(Shard("m", 0, 1, 4), "E")
+  assert inner.calls == 2
+
+
+@pytest.mark.asyncio
+async def test_singleton_downloader_dedups_concurrent():
+  inner = CountingDownloader(delay=0.05)
+  singleton = SingletonShardDownloader(inner)
+  shard = Shard("m", 0, 3, 4)
+  results = await asyncio.gather(*(singleton.ensure_shard(shard, "E") for _ in range(5)))
+  assert inner.calls == 1
+  assert all(r == results[0] for r in results)
+
+
+def test_progress_event_roundtrip():
+  ev = RepoProgressEvent(
+    shard=Shard("m", 0, 3, 4).to_dict(),
+    repo_id="org/repo",
+    repo_revision="main",
+    completed_files=1,
+    total_files=2,
+    downloaded_bytes=100,
+    downloaded_bytes_this_session=50,
+    total_bytes=200,
+    overall_speed=10.0,
+    overall_eta=10.0,
+    status="in_progress",
+  )
+  rt = RepoProgressEvent.from_dict(ev.to_dict())
+  assert rt.repo_id == "org/repo" and rt.downloaded_bytes == 100

@@ -1,0 +1,156 @@
+"""Golden-logit fidelity tests against HF transformers (torch CPU).
+
+The reference's hard part #1 (SURVEY.md §7): HF→JAX weight fidelity across
+model families. For each family we build a tiny random HF model, save it as
+safetensors, load it through our loader, and require logits to match the
+torch forward. This catches name-mapping, transpose, RoPE-convention, GQA
+and tied-embedding mistakes exactly where the reference needed its q/k
+permutation subtleties (``llm_utils.py:126-269``).
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import load_model_config
+from xotorch_support_jetson_tpu.models.decoder import shard_forward
+from xotorch_support_jetson_tpu.models.loader import load_shard_weights
+
+TOKENS = [[3, 25, 99, 7, 41, 0, 12]]
+
+
+def _save_tiny_hf(tmp_path, family: str):
+  import torch
+  from transformers import AutoConfig, AutoModelForCausalLM
+
+  torch.manual_seed(0)
+  if family == "llama":
+    cfg = AutoConfig.for_model(
+      "llama",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=3,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
+  elif family == "llama3-scaled":
+    cfg = AutoConfig.for_model(
+      "llama",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      rms_norm_eps=1e-5,
+      rope_theta=500000.0,
+      max_position_embeddings=1024,
+      rope_scaling={
+        "rope_type": "llama3",
+        "factor": 8.0,
+        "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 64,
+      },
+      tie_word_embeddings=True,
+      torch_dtype="float32",
+    )
+  elif family == "qwen2":
+    cfg = AutoConfig.for_model(
+      "qwen2",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=3,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=True,
+      torch_dtype="float32",
+    )
+  elif family == "mistral":
+    cfg = AutoConfig.for_model(
+      "mistral",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
+  else:
+    raise ValueError(family)
+  model = AutoModelForCausalLM.from_config(cfg)
+  model = model.to(torch.float32).eval()
+  model.save_pretrained(tmp_path, safe_serialization=True)
+  with torch.no_grad():
+    ref_logits = model(torch.tensor(TOKENS)).logits.numpy()
+  return ref_logits
+
+
+@pytest.mark.parametrize("family", ["llama", "llama3-scaled", "qwen2", "mistral"])
+def test_golden_logits_vs_hf(tmp_path, family):
+  ref_logits = _save_tiny_hf(tmp_path, family)
+
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  shard = Shard("tiny", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+
+  tokens = jnp.asarray(TOKENS, dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+  logits, _ = shard_forward(params, cfg, shard, tokens, positions, None)
+
+  np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_load_from_index(tmp_path):
+  """Shard-aware file selection: split-layer load == full load on a 2-file repo."""
+  import torch
+  from safetensors.torch import save_file
+
+  _ = _save_tiny_hf(tmp_path, "llama")
+  # Re-shard the single safetensors file into two + an index to exercise
+  # weight_map-based file filtering (reference new_shard_download.py:181-194).
+  from safetensors import safe_open
+
+  src = tmp_path / "model.safetensors"
+  tensors = {}
+  with safe_open(str(src), framework="pt") as f:
+    for k in f.keys():
+      tensors[k] = f.get_tensor(k)
+  group_a = {k: v for k, v in tensors.items() if ".layers.0." in k or "embed" in k}
+  group_b = {k: v for k, v in tensors.items() if k not in group_a}
+  save_file(group_a, str(tmp_path / "model-00001-of-00002.safetensors"))
+  save_file(group_b, str(tmp_path / "model-00002-of-00002.safetensors"))
+  weight_map = {k: "model-00001-of-00002.safetensors" for k in group_a}
+  weight_map |= {k: "model-00002-of-00002.safetensors" for k in group_b}
+  (tmp_path / "model.safetensors.index.json").write_text(json.dumps({"weight_map": weight_map}))
+  src.unlink()
+
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  first = Shard("tiny", 0, 0, cfg.n_layers)
+  from xotorch_support_jetson_tpu.models.loader import _weight_files_for_shard
+
+  files = [p.name for p in _weight_files_for_shard(tmp_path, first)]
+  assert files == ["model-00001-of-00002.safetensors"]
+
+  params = load_shard_weights(tmp_path, cfg, first)
+  assert params["layers"]["wq"].shape[0] == 1
+  assert "embed" in params and "final_norm" not in params
+
+  last = Shard("tiny", 1, cfg.n_layers - 1, cfg.n_layers)
+  params_last = load_shard_weights(tmp_path, cfg, last)
+  assert "embed" not in params_last and "final_norm" in params_last and "lm_head" in params_last

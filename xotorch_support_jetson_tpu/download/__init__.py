@@ -1,0 +1,26 @@
+from .downloader import (
+  CachedShardDownloader,
+  HFShardDownloader,
+  NoopShardDownloader,
+  ShardDownloader,
+  SingletonShardDownloader,
+  delete_model,
+  ensure_models_dir,
+  get_models_dir,
+  new_shard_downloader,
+)
+from .progress import RepoFileProgressEvent, RepoProgressEvent
+
+__all__ = [
+  "CachedShardDownloader",
+  "HFShardDownloader",
+  "NoopShardDownloader",
+  "ShardDownloader",
+  "SingletonShardDownloader",
+  "delete_model",
+  "ensure_models_dir",
+  "get_models_dir",
+  "new_shard_downloader",
+  "RepoFileProgressEvent",
+  "RepoProgressEvent",
+]

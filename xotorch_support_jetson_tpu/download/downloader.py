@@ -1,0 +1,296 @@
+"""Shard-aware model downloading.
+
+Parity with reference ``download/shard_download.py`` (ABC + Noop :9-49) and
+``download/new_shard_download.py`` (home mgmt :24-70, file-list fetch w/
+retry+cache :72-107, ranged-resume downloads :141-168, progress accounting
+:171-179, shard-aware filtering :181-194, 8-way parallelism :231-235,
+``Singleton(Cached(...))`` stack :243-285).
+
+Extra over the reference: ``XOT_TPU_MODEL_DIR`` short-circuits the network
+entirely and serves a local checkpoint directory — the offline/airgapped path
+(TPU pods frequently have no egress; the reference has no offline story).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from datetime import timedelta
+from pathlib import Path
+from typing import AsyncIterator, Callable
+
+from ..inference.shard import Shard
+from ..utils.helpers import DEBUG, XOT_HOME, AsyncCallbackSystem
+from .hf_utils import extract_weight_map, filter_repo_objects, get_allow_patterns, get_auth_headers, get_hf_endpoint
+from .progress import RepoFileProgressEvent, RepoProgressEvent
+
+
+class ShardDownloader(ABC):
+  @abstractmethod
+  async def ensure_shard(self, shard: Shard, inference_engine_classname: str) -> Path:
+    ...
+
+  @property
+  @abstractmethod
+  def on_progress(self) -> AsyncCallbackSystem[str, tuple]:
+    ...
+
+  async def get_shard_download_status(self, inference_engine_classname: str) -> AsyncIterator[tuple[Path, RepoProgressEvent]]:
+    if False:
+      yield  # pragma: no cover
+
+
+class NoopShardDownloader(ShardDownloader):
+  def __init__(self) -> None:
+    self._on_progress: AsyncCallbackSystem[str, tuple] = AsyncCallbackSystem()
+
+  async def ensure_shard(self, shard: Shard, inference_engine_classname: str) -> Path:
+    return Path(os.getenv("XOT_TPU_MODEL_DIR", "/tmp/noop_shard"))
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem[str, tuple]:
+    return self._on_progress
+
+
+def get_models_dir() -> Path:
+  return XOT_HOME / "downloads"
+
+
+def ensure_models_dir() -> Path:
+  d = get_models_dir()
+  d.mkdir(parents=True, exist_ok=True)
+  return d
+
+
+def repo_to_dirname(repo_id: str) -> str:
+  return repo_id.replace("/", "--")
+
+
+async def delete_model(model_id: str, engine_classname: str) -> bool:
+  """Remove a downloaded model dir (reference new_shard_download.py:54-70)."""
+  from .. import registry
+
+  repo = registry.get_repo(model_id, engine_classname)
+  if repo is None:
+    return False
+  model_dir = get_models_dir() / repo_to_dirname(repo)
+  if not model_dir.exists():
+    return False
+  await asyncio.get_event_loop().run_in_executor(None, shutil.rmtree, model_dir)
+  return True
+
+
+@dataclass
+class _FileInfo:
+  path: str
+  size: int
+
+
+class HFShardDownloader(ShardDownloader):
+  """Downloads only the files a shard needs, with ranged resume."""
+
+  def __init__(self, max_parallel_downloads: int = 8, revision: str = "main") -> None:
+    self.max_parallel_downloads = max_parallel_downloads
+    self.revision = revision
+    self._on_progress: AsyncCallbackSystem[str, tuple] = AsyncCallbackSystem()
+    self._file_list_cache: dict[str, list[_FileInfo]] = {}
+    self.session_timeout = float(os.getenv("XOT_TPU_DL_TIMEOUT", "30"))
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem[str, tuple]:
+    return self._on_progress
+
+  # -------------------------------------------------------------- http bits
+
+  async def _fetch_file_list(self, session, repo_id: str, path: str = "") -> list[_FileInfo]:
+    cache_key = f"{repo_id}/{path}"
+    if cache_key in self._file_list_cache:
+      return self._file_list_cache[cache_key]
+    url = f"{get_hf_endpoint()}/api/models/{repo_id}/tree/{self.revision}"
+    if path:
+      url += f"/{path}"
+    for attempt in range(5):
+      try:
+        async with session.get(url, headers=get_auth_headers()) as resp:
+          resp.raise_for_status()
+          entries = await resp.json()
+        files: list[_FileInfo] = []
+        for entry in entries:
+          if entry["type"] == "file":
+            files.append(_FileInfo(entry["path"], entry.get("size", 0)))
+          elif entry["type"] == "directory":
+            files.extend(await self._fetch_file_list(session, repo_id, entry["path"]))
+        self._file_list_cache[cache_key] = files
+        return files
+      except Exception:  # noqa: BLE001 — transient hub errors
+        if attempt == 4:
+          raise
+        await asyncio.sleep(1.5**attempt)
+    raise RuntimeError("unreachable")
+
+  async def _download_file(self, session, repo_id: str, file: _FileInfo, target_dir: Path, progress_cb: Callable[[str, int, int], None]) -> Path:
+    """Ranged-resume download via a .partial file."""
+    target = target_dir / file.path
+    target.parent.mkdir(parents=True, exist_ok=True)
+    if target.exists() and (file.size == 0 or target.stat().st_size == file.size):
+      progress_cb(file.path, target.stat().st_size, 0)
+      return target
+    partial = target.with_suffix(target.suffix + ".partial")
+    resume_from = partial.stat().st_size if partial.exists() else 0
+    headers = get_auth_headers()
+    if resume_from:
+      headers["Range"] = f"bytes={resume_from}-"
+    url = f"{get_hf_endpoint()}/{repo_id}/resolve/{self.revision}/{file.path}"
+    async with session.get(url, headers=headers) as resp:
+      if resp.status == 416:  # already fully downloaded
+        partial.rename(target)
+        progress_cb(file.path, resume_from, 0)
+        return target
+      resp.raise_for_status()
+      if resp.status != 206:
+        resume_from = 0  # server ignored the range; restart
+      mode = "ab" if resume_from else "wb"
+      downloaded = resume_from
+      with open(partial, mode) as f:
+        async for chunk in resp.content.iter_chunked(1 << 20):
+          f.write(chunk)
+          downloaded += len(chunk)
+          progress_cb(file.path, downloaded, len(chunk))
+    partial.rename(target)
+    return target
+
+  # -------------------------------------------------------------- main path
+
+  async def ensure_shard(self, shard: Shard, inference_engine_classname: str) -> Path:
+    from .. import registry
+
+    # Offline short-circuit: serve a local checkpoint dir directly.
+    if local := os.getenv("XOT_TPU_MODEL_DIR"):
+      return Path(local)
+
+    repo_id = registry.get_repo(shard.model_id, inference_engine_classname)
+    if repo_id is None:
+      raise ValueError(f"no repo for model {shard.model_id!r} on engine {inference_engine_classname}")
+    target_dir = ensure_models_dir() / repo_to_dirname(repo_id)
+    target_dir.mkdir(parents=True, exist_ok=True)
+
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=self.session_timeout, sock_read=self.session_timeout)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+      all_files = await self._fetch_file_list(session, repo_id)
+
+      # Weight map first (tiny file), to compute the shard's allow patterns.
+      weight_map = None
+      index_name = "model.safetensors.index.json"
+      if any(f.path == index_name for f in all_files):
+        index_file = next(f for f in all_files if f.path == index_name)
+        await self._download_file(session, repo_id, index_file, target_dir, lambda *_: None)
+        weight_map = extract_weight_map((target_dir / index_name).read_text())
+
+      patterns = get_allow_patterns(weight_map, shard)
+      wanted_paths = set(filter_repo_objects([f.path for f in all_files], allow_patterns=patterns))
+      wanted = [f for f in all_files if f.path in wanted_paths]
+      total_bytes = sum(f.size for f in wanted)
+      if DEBUG >= 1:
+        print(f"[download] {repo_id} shard {shard.start_layer}-{shard.end_layer}: {len(wanted)}/{len(all_files)} files, {total_bytes/1e9:.2f} GB")
+
+      start_time = time.monotonic()
+      downloaded_per_file: dict[str, int] = {}
+      session_bytes: dict[str, int] = {}
+      lock = asyncio.Lock()
+
+      def progress_cb(path: str, downloaded: int, delta: int) -> None:
+        downloaded_per_file[path] = downloaded
+        session_bytes[path] = session_bytes.get(path, 0) + delta
+        self._emit_progress(shard, repo_id, wanted, downloaded_per_file, session_bytes, total_bytes, start_time)
+
+      sem = asyncio.Semaphore(self.max_parallel_downloads)
+
+      async def fetch(file: _FileInfo):
+        async with sem:
+          await self._download_file(session, repo_id, file, target_dir, progress_cb)
+
+      await asyncio.gather(*(fetch(f) for f in wanted))
+      self._emit_progress(shard, repo_id, wanted, downloaded_per_file, session_bytes, total_bytes, start_time, final=True)
+    return target_dir
+
+  def _emit_progress(self, shard, repo_id, wanted, downloaded_per_file, session_bytes, total_bytes, start_time, final=False):
+    downloaded = sum(downloaded_per_file.values())
+    this_session = sum(session_bytes.values())
+    elapsed = max(time.monotonic() - start_time, 1e-6)
+    speed = this_session / elapsed
+    remaining = max(total_bytes - downloaded, 0)
+    eta = remaining / speed if speed > 0 else 0.0
+    completed = sum(1 for f in wanted if downloaded_per_file.get(f.path, 0) >= f.size > 0)
+    status = "complete" if final or (completed == len(wanted) and total_bytes > 0 and downloaded >= total_bytes) else "in_progress"
+    event = RepoProgressEvent(
+      shard=shard.to_dict(),
+      repo_id=repo_id,
+      repo_revision=self.revision,
+      completed_files=completed,
+      total_files=len(wanted),
+      downloaded_bytes=downloaded,
+      downloaded_bytes_this_session=this_session,
+      total_bytes=total_bytes,
+      overall_speed=speed,
+      overall_eta=eta,
+      status=status,
+    )
+    self.on_progress.trigger_all(shard, event)
+
+
+class SingletonShardDownloader(ShardDownloader):
+  """Dedup concurrent ensure_shard calls per shard (reference :246-263)."""
+
+  def __init__(self, inner: ShardDownloader) -> None:
+    self.inner = inner
+    self._tasks: dict[Shard, asyncio.Task] = {}
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem[str, tuple]:
+    return self.inner.on_progress
+
+  async def ensure_shard(self, shard: Shard, inference_engine_classname: str) -> Path:
+    task = self._tasks.get(shard)
+    if task is None or task.done() and task.exception() is not None:
+      task = asyncio.create_task(self.inner.ensure_shard(shard, inference_engine_classname))
+      self._tasks[shard] = task
+    return await asyncio.shield(task)
+
+  async def get_shard_download_status(self, inference_engine_classname: str):
+    async for item in self.inner.get_shard_download_status(inference_engine_classname):
+      yield item
+
+
+class CachedShardDownloader(ShardDownloader):
+  """Memoize resolved paths per (engine, shard) (reference :265-285)."""
+
+  def __init__(self, inner: ShardDownloader) -> None:
+    self.inner = inner
+    self._cache: dict[tuple[str, Shard], Path] = {}
+
+  @property
+  def on_progress(self) -> AsyncCallbackSystem[str, tuple]:
+    return self.inner.on_progress
+
+  async def ensure_shard(self, shard: Shard, inference_engine_classname: str) -> Path:
+    key = (inference_engine_classname, shard)
+    if key in self._cache:
+      return self._cache[key]
+    path = await self.inner.ensure_shard(shard, inference_engine_classname)
+    self._cache[key] = path
+    return path
+
+  async def get_shard_download_status(self, inference_engine_classname: str):
+    async for item in self.inner.get_shard_download_status(inference_engine_classname):
+      yield item
+
+
+def new_shard_downloader(max_parallel_downloads: int = 8) -> ShardDownloader:
+  return SingletonShardDownloader(CachedShardDownloader(HFShardDownloader(max_parallel_downloads)))
