@@ -1,0 +1,120 @@
+"""Headline benchmark: single-chip decode throughput on the flagship model.
+
+Runs on whatever accelerator JAX exposes (one TPU chip under the driver).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+supporting fields. The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is reported against the driver-recorded history when present
+(BENCH_r*.json) and null otherwise.
+
+Model: llama-3.2-1b geometry, random bf16 weights (no network egress in the
+bench environment). Decode uses the fused lax.scan loop (models/decoder.py
+``fused_decode``) — one compiled program for the whole token stream, KV cache
+donated in place.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+  from xotorch_support_jetson_tpu.models.config import ModelConfig
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache, shard_forward
+
+  platform = jax.devices()[0].platform
+  on_accel = platform != "cpu"
+
+  cfg = ModelConfig(
+    vocab_size=128256,
+    dim=2048,
+    n_layers=16,
+    n_heads=32,
+    n_kv_heads=8,
+    hidden_dim=8192,
+    head_dim=64,
+    rope_theta=500000.0,
+    max_seq_len=2048,
+    tied_embedding=True,
+    dtype=jnp.bfloat16,
+  )
+  if not on_accel:  # keep the CPU smoke run quick
+    cfg = ModelConfig(
+      vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=4, hidden_dim=1024,
+      rope_theta=10000.0, max_seq_len=512, tied_embedding=True, dtype=jnp.float32,
+    )
+
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "llama-3.2-1b")
+  B, prompt_len, max_seq = 1, 128, 1024 if on_accel else 256
+  n_decode = 128 if on_accel else 8
+
+  tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, prompt_len)), dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (B, prompt_len))
+
+  def prefill(params, tokens, cache):
+    logits, cache = shard_forward(params, cfg, shard, tokens, positions, cache)
+    return logits[:, -1, :], cache
+
+  prefill_jit = jax.jit(prefill, donate_argnums=(2,))
+
+  # Warmup / compile.
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
+  last, cache = prefill_jit(params, tokens, cache)
+  jax.block_until_ready(last)
+
+  # TTFT (prefill latency, compiled).
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
+  t0 = time.perf_counter()
+  last, cache = prefill_jit(params, tokens, cache)
+  jax.block_until_ready(last)
+  ttft_ms = (time.perf_counter() - t0) * 1e3
+
+  first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
+  start_pos = jnp.full((B,), prompt_len, dtype=jnp.int32)
+
+  # Warmup decode compile.
+  toks, cache = fused_decode(params, cfg, shard, first_tok, cache, start_pos, n_decode)
+  jax.block_until_ready(toks)
+
+  # Timed decode (fresh cache region; positions continue).
+  start_pos2 = start_pos + n_decode
+  t0 = time.perf_counter()
+  toks, cache = fused_decode(params, cfg, shard, first_tok, cache, start_pos2, n_decode)
+  jax.block_until_ready(toks)
+  dt = time.perf_counter() - t0
+  tok_per_s = n_decode * B / dt
+
+  vs_baseline = None
+  try:  # compare to the previous round's recorded value if the driver left one
+    import glob
+
+    hist = sorted(glob.glob("BENCH_r*.json"))
+    if hist:
+      prev = json.load(open(hist[-1]))
+      if prev.get("unit") == "tokens/s" and prev.get("value"):
+        vs_baseline = round(tok_per_s / float(prev["value"]), 4)
+  except Exception:  # noqa: BLE001
+    pass
+
+  print(
+    json.dumps(
+      {
+        "metric": "decode_tokens_per_sec_llama1b_bf16_1chip" if on_accel else "decode_tokens_per_sec_smoke_cpu",
+        "value": round(tok_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": vs_baseline,
+        "ttft_ms_prefill128": round(ttft_ms, 2),
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+        "n_decode": n_decode,
+      }
+    )
+  )
+
+
+if __name__ == "__main__":
+  main()

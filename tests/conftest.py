@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
   os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("XOT_TPU_UUID", "test-node-id")
 
+# The axon TPU plugin in this image overrides JAX_PLATFORMS at import time;
+# the config API still wins, so force the CPU backend explicitly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def pytest_configure(config):
   config.addinivalue_line("markers", "asyncio: run test in an asyncio event loop")

@@ -1,0 +1,108 @@
+"""Node orchestration tests with the dummy engine (no network, no model)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.dummy_engine import DUMMY_EOS, DummyInferenceEngine
+from xotorch_support_jetson_tpu.networking.discovery import Discovery
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.registry import build_base_shard
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+
+class NoDiscovery(Discovery):
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+  async def discover_peers(self, wait_for_peers: int = 0):
+    return []
+
+
+class StubServer:
+  async def start(self):
+    pass
+
+  async def stop(self):
+    pass
+
+
+def make_node(node_id="n1", max_tokens=200):
+  return Node(
+    node_id,
+    StubServer(),
+    DummyInferenceEngine(),
+    NoDiscovery(),
+    None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=max_tokens,
+  )
+
+
+@pytest.mark.asyncio
+async def test_single_node_generates_until_eos():
+  node = make_node()
+  await node.start()
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+  request_id = "req-1"
+  callback = node.on_token.register("test")
+  done = asyncio.Event()
+  collected = []
+
+  def on_tok(rid, tokens, finished):
+    if rid == request_id:
+      collected.extend(tokens)
+      if finished:
+        done.set()
+
+  callback.on_next(on_tok)
+  # Dummy engine: last-layer output = input + 1, sample takes the last value,
+  # so tokens count up deterministically until EOS (69).
+  await node.process_prompt(shard, "aaaa", request_id)  # one word, len 4 → token 5
+  await asyncio.wait_for(done.wait(), timeout=10)
+  assert collected[-1] == DUMMY_EOS
+  assert collected == list(range(5, DUMMY_EOS + 1))
+  tokens, finished = node.buffered_token_output[request_id]
+  assert finished and tokens[-1] == DUMMY_EOS
+  await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_single_node_max_tokens_cutoff():
+  node = make_node(max_tokens=5)
+  await node.start()
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+  done = asyncio.Event()
+  node.on_token.register("t").on_next(lambda rid, toks, fin: done.set() if fin else None)
+  await node.process_prompt(shard, "a", "req-2")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  tokens, finished = node.buffered_token_output["req-2"]
+  assert finished and len(tokens) == 5
+  await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_node_status_active_node_tracking():
+  node = make_node()
+  await node.start()
+  assert node.topology.active_node_id in (node.id, None)
+  node.on_node_status("r", '{"type": "node_status", "status": "start_process_prompt", "node_id": "other"}')
+  assert node.topology.active_node_id == "other"
+  node.on_node_status("r", '{"type": "node_status", "status": "end_process_prompt", "node_id": "other"}')
+  assert node.topology.active_node_id is None
+  await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_single_node_training_step():
+  node = make_node()
+  await node.start()
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+  # Dummy engine has no train(): NotImplementedError per the explicit contract.
+  with pytest.raises(NotImplementedError):
+    await node.process_example(shard, np.ones((1, 4), np.int32), np.ones((1, 4), np.int32), np.array([4]), True, "r")
+  await node.stop()

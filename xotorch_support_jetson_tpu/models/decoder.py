@@ -115,8 +115,13 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
 # ---------------------------------------------------------------- forward
 
 
-def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool):
-  """One decoder layer. h [B,S,D] → h, (new_k_cache, new_v_cache)."""
+def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
+  """One decoder layer. h [B,S,D] → h, (new_k_cache, new_v_cache).
+
+  ``attn_fn(q, k, v, q_pos, kv_pos)`` overrides the attention op on the
+  cache-less path — used to swap in ring attention under sequence
+  parallelism (parallel/ring_attention.py).
+  """
   B, S, D = h.shape
   p = layer_params
 
@@ -140,7 +145,7 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
     v_cache = _write_cache(v_cache, v, start)
     attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
   else:
-    attn = gqa_attention(q, k, v, positions, positions[0])
+    attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
 
   h = h + attn.reshape(B, S, -1) @ p["wo"]
 
@@ -207,6 +212,36 @@ def shard_forward(
 jit_shard_forward = partial(jax.jit, static_argnames=("cfg", "shard"))(
   lambda params, cfg, shard, x, positions, kv_cache: shard_forward(params, cfg, shard, x, positions, kv_cache)
 )
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "temp", "top_k"), donate_argnums=(4,))
+def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp: float = 0.0, top_k: int = 35, key=None):
+  """Generate ``n_steps`` tokens in ONE compiled program (lax.scan over steps).
+
+  The single-node serving fast path: no host round-trip per token, cache
+  donated and updated in place. token [B,1] int32; start_pos [B] int32.
+  Returns (tokens [B, n_steps], cache). Requires a full-model shard.
+  """
+  from ..ops.sampling import sample_logits
+
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("fused_decode requires a full-model shard")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+
+  def body(carry, _):
+    tok, pos, cache, key = carry
+    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    row = logits[:, 0, :]
+    if temp <= 0.0:
+      nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+    else:
+      key, sub = jax.random.split(key)
+      nxt = sample_logits(row, sub, temp=temp, top_k=top_k)
+    return (nxt[:, None], pos + 1, cache, key), nxt
+
+  (_, _, cache, _), toks = jax.lax.scan(body, (token, start_pos, cache, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), cache
 
 
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:

@@ -1,0 +1,171 @@
+"""gRPC client-side peer handle.
+
+Parity with reference ``networking/grpc/grpc_peer_handle.py`` (lazy connect
+w/ timeout :78-85, gzip compression :64, health check :87-100, tensor ser/de
+:117-136, example/loss :138-178). RPCs are built with ``channel.unary_unary``
+against the same method paths the server registers — no generated stubs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import grpc
+import numpy as np
+
+from ...inference.shard import Shard
+from ...inference.state import InferenceState
+from ...topology.device_capabilities import DeviceCapabilities
+from ...topology.topology import Topology
+from ...utils.helpers import DEBUG
+from ..peer_handle import PeerHandle
+from . import node_service_pb2 as pb
+from .grpc_server import CHANNEL_OPTIONS, SERVICE_NAME
+from .serialization import (
+  proto_to_tensor,
+  proto_to_topology,
+  shard_to_proto,
+  state_to_proto,
+  tensor_to_proto,
+)
+
+CONNECT_TIMEOUT = 10.0
+HEALTH_TIMEOUT = 5.0
+
+
+class GRPCPeerHandle(PeerHandle):
+  def __init__(self, _id: str, address: str, desc: str, device_capabilities: DeviceCapabilities) -> None:
+    self._id = _id
+    self.address = address
+    self.desc = desc
+    self._device_capabilities = device_capabilities
+    self.channel: grpc.aio.Channel | None = None
+    self._rpcs: dict = {}
+
+  def id(self) -> str:
+    return self._id
+
+  def addr(self) -> str:
+    return self.address
+
+  def description(self) -> str:
+    return self.desc
+
+  def device_capabilities(self) -> DeviceCapabilities:
+    return self._device_capabilities
+
+  # ------------------------------------------------------------- connection
+
+  async def connect(self) -> None:
+    if self.channel is None:
+      self.channel = grpc.aio.insecure_channel(
+        self.address,
+        options=CHANNEL_OPTIONS,
+        compression=grpc.Compression.Gzip,
+      )
+      self._rpcs = {
+        name: self.channel.unary_unary(
+          f"/{SERVICE_NAME}/{name}",
+          request_serializer=req.SerializeToString,
+          response_deserializer=resp.FromString,
+        )
+        for name, (req, resp) in {
+          "SendPrompt": (pb.PromptRequest, pb.Tensor),
+          "SendTensor": (pb.TensorRequest, pb.Tensor),
+          "SendExample": (pb.ExampleRequest, pb.Loss),
+          "SendLoss": (pb.Loss, pb.Empty),
+          "CollectTopology": (pb.CollectTopologyRequest, pb.Topology),
+          "SendResult": (pb.SendResultRequest, pb.Empty),
+          "SendOpaqueStatus": (pb.SendOpaqueStatusRequest, pb.Empty),
+          "HealthCheck": (pb.HealthCheckRequest, pb.HealthCheckResponse),
+        }.items()
+      }
+    await asyncio.wait_for(self.channel.channel_ready(), timeout=CONNECT_TIMEOUT)
+
+  async def is_connected(self) -> bool:
+    return self.channel is not None and self.channel.get_state() == grpc.ChannelConnectivity.READY
+
+  async def disconnect(self) -> None:
+    if self.channel is not None:
+      await self.channel.close()
+    self.channel = None
+    self._rpcs = {}
+
+  async def _ensure_connected(self) -> None:
+    if not await self.is_connected():
+      try:
+        await asyncio.wait_for(self.connect(), timeout=CONNECT_TIMEOUT)
+      except asyncio.TimeoutError:
+        raise TimeoutError(f"connect to {self.address} timed out") from None
+
+  async def health_check(self) -> bool:
+    try:
+      await self._ensure_connected()
+      response = await asyncio.wait_for(self._rpcs["HealthCheck"](pb.HealthCheckRequest()), timeout=HEALTH_TIMEOUT)
+      return response.is_healthy
+    except Exception:  # noqa: BLE001 — any failure means unhealthy
+      if DEBUG >= 4:
+        import traceback
+
+        traceback.print_exc()
+      return False
+
+  # -------------------------------------------------------------- data plane
+
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None = None) -> None:
+    await self._ensure_connected()
+    request = pb.PromptRequest(
+      shard=shard_to_proto(shard),
+      prompt=prompt,
+      request_id=request_id,
+      inference_state=state_to_proto(inference_state),
+    )
+    await self._rpcs["SendPrompt"](request)
+
+  async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None) -> None:
+    await self._ensure_connected()
+    request = pb.TensorRequest(
+      shard=shard_to_proto(shard),
+      tensor=tensor_to_proto(tensor),
+      request_id=request_id,
+      inference_state=state_to_proto(inference_state),
+    )
+    await self._rpcs["SendTensor"](request)
+
+  async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool, request_id: str) -> tuple[float, np.ndarray | None]:
+    await self._ensure_connected()
+    request = pb.ExampleRequest(
+      shard=shard_to_proto(shard),
+      example=tensor_to_proto(example),
+      target=tensor_to_proto(target),
+      length=tensor_to_proto(length),
+      train=train,
+      request_id=request_id,
+    )
+    response = await self._rpcs["SendExample"](request)
+    grads = proto_to_tensor(response.grads) if response.HasField("grads") else None
+    return response.loss, grads
+
+  async def send_loss(self, loss: float, grads: np.ndarray | None = None) -> None:
+    await self._ensure_connected()
+    await self._rpcs["SendLoss"](pb.Loss(loss=loss, grads=tensor_to_proto(grads)))
+
+  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+    await self._ensure_connected()
+    request = pb.SendResultRequest(request_id=request_id, is_finished=is_finished)
+    if isinstance(result, np.ndarray):
+      request.tensor.CopyFrom(tensor_to_proto(result))
+    else:
+      request.result.extend(int(r) for r in result)
+    await asyncio.wait_for(self._rpcs["SendResult"](request), timeout=15.0)
+
+  async def send_opaque_status(self, request_id: str, status: str) -> None:
+    await self._ensure_connected()
+    await asyncio.wait_for(self._rpcs["SendOpaqueStatus"](pb.SendOpaqueStatusRequest(request_id=request_id, status=status)), timeout=15.0)
+
+  async def collect_topology(self, visited: set[str], max_depth: int) -> Topology:
+    await self._ensure_connected()
+    request = pb.CollectTopologyRequest(visited=sorted(visited), max_depth=max_depth)
+    response = await asyncio.wait_for(self._rpcs["CollectTopology"](request), timeout=5.0)
+    return proto_to_topology(response)

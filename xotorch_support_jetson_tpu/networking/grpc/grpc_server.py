@@ -1,0 +1,124 @@
+"""grpc.aio server for the cluster control/data plane.
+
+Parity with reference ``networking/grpc/grpc_server.py`` (channel options
+:29-46, RPC handlers :62-156). Methods are registered through
+``grpc.method_handlers_generic_handler`` — functionally identical to
+protoc-generated servicers, without the grpcio-tools build dependency.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import grpc
+
+from ...utils.helpers import DEBUG
+from . import node_service_pb2 as pb
+from .serialization import (
+  proto_to_shard,
+  proto_to_state,
+  proto_to_tensor,
+  shard_to_proto,
+  state_to_proto,
+  tensor_to_proto,
+  topology_to_proto,
+)
+
+SERVICE_NAME = "xot_tpu.NodeService"
+
+MAX_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+CHANNEL_OPTIONS = [
+  ("grpc.max_metadata_size", 32 * 1024 * 1024),
+  ("grpc.max_send_message_length", MAX_MESSAGE_LENGTH),
+  ("grpc.max_receive_message_length", MAX_MESSAGE_LENGTH),
+  ("grpc.keepalive_time_ms", 10000),
+  ("grpc.keepalive_timeout_ms", 5000),
+  ("grpc.http2.max_pings_without_data", 0),
+  ("grpc.tcp_nodelay", 1),
+  ("grpc.optimization_target", "throughput"),
+]
+
+
+class GRPCServer:
+  def __init__(self, node, host: str, port: int) -> None:
+    self.node = node  # orchestration.Node
+    self.host = host
+    self.port = port
+    self.server: grpc.aio.Server | None = None
+
+  async def start(self) -> None:
+    self.server = grpc.aio.server(futures.ThreadPoolExecutor(max_workers=32), options=CHANNEL_OPTIONS)
+    self.server.add_generic_rpc_handlers([self._make_handler()])
+    listen_addr = f"{self.host}:{self.port}"
+    self.server.add_insecure_port(listen_addr)
+    await self.server.start()
+    if DEBUG >= 1:
+      print(f"[grpc] server started on {listen_addr}")
+
+  async def stop(self) -> None:
+    if self.server is not None:
+      await self.server.stop(grace=5)
+      await self.server.wait_for_termination()
+      self.server = None
+
+  def _make_handler(self):
+    def unary(fn, req_cls, resp_cls):
+      return grpc.unary_unary_rpc_method_handler(fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString)
+
+    handlers = {
+      "SendPrompt": unary(self.SendPrompt, pb.PromptRequest, pb.Tensor),
+      "SendTensor": unary(self.SendTensor, pb.TensorRequest, pb.Tensor),
+      "SendExample": unary(self.SendExample, pb.ExampleRequest, pb.Loss),
+      "SendLoss": unary(self.SendLoss, pb.Loss, pb.Empty),
+      "CollectTopology": unary(self.CollectTopology, pb.CollectTopologyRequest, pb.Topology),
+      "SendResult": unary(self.SendResult, pb.SendResultRequest, pb.Empty),
+      "SendOpaqueStatus": unary(self.SendOpaqueStatus, pb.SendOpaqueStatusRequest, pb.Empty),
+      "HealthCheck": unary(self.HealthCheck, pb.HealthCheckRequest, pb.HealthCheckResponse),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+  # ------------------------------------------------------------ RPC methods
+
+  async def SendPrompt(self, request: pb.PromptRequest, context) -> pb.Tensor:
+    shard = proto_to_shard(request.shard)
+    state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
+    result = await self.node.process_prompt(shard, request.prompt, request.request_id, state)
+    return tensor_to_proto(result)
+
+  async def SendTensor(self, request: pb.TensorRequest, context) -> pb.Tensor:
+    shard = proto_to_shard(request.shard)
+    tensor = proto_to_tensor(request.tensor)
+    state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
+    result = await self.node.process_tensor(shard, tensor, request.request_id, state)
+    return tensor_to_proto(result)
+
+  async def SendExample(self, request: pb.ExampleRequest, context) -> pb.Loss:
+    shard = proto_to_shard(request.shard)
+    example = proto_to_tensor(request.example)
+    target = proto_to_tensor(request.target)
+    length = proto_to_tensor(request.length)
+    loss, grads = await self.node.process_example(shard, example, target, length, request.train, request.request_id)
+    return pb.Loss(loss=float(loss), grads=tensor_to_proto(grads))
+
+  async def SendLoss(self, request: pb.Loss, context) -> pb.Empty:
+    await self.node.on_loss(request.loss)
+    return pb.Empty()
+
+  async def CollectTopology(self, request: pb.CollectTopologyRequest, context) -> pb.Topology:
+    topology = await self.node.collect_topology(set(request.visited), request.max_depth)
+    return topology_to_proto(topology)
+
+  async def SendResult(self, request: pb.SendResultRequest, context) -> pb.Empty:
+    tensor = proto_to_tensor(request.tensor) if request.HasField("tensor") else None
+    result = tensor if tensor is not None else list(request.result)
+    self.node.on_token.trigger_all(request.request_id, result, request.is_finished)
+    return pb.Empty()
+
+  async def SendOpaqueStatus(self, request: pb.SendOpaqueStatusRequest, context) -> pb.Empty:
+    self.node.on_opaque_status.trigger_all(request.request_id, request.status)
+    return pb.Empty()
+
+  async def HealthCheck(self, request: pb.HealthCheckRequest, context) -> pb.HealthCheckResponse:
+    return pb.HealthCheckResponse(is_healthy=True)

@@ -1,0 +1,103 @@
+"""numpy/state/topology ↔ protobuf conversion.
+
+Tensors travel as raw ``tobytes()`` + shape + dtype string, matching the
+reference wire format (``grpc_peer_handle.py:117-136``) but preserving dtype
+end-to-end (the reference upcast bf16→f32 on the hot path,
+``sharded_inference_engine.py:352,366`` — here bf16 stays 2 bytes/elem via
+ml_dtypes).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ...inference.shard import Shard
+from ...inference.state import InferenceState
+from ...topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from ...topology.topology import Topology
+from . import node_service_pb2 as pb
+
+
+def _np_dtype(name: str):
+  if name == "bfloat16":
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+  return np.dtype(name)
+
+
+def tensor_to_proto(arr: np.ndarray | None) -> pb.Tensor:
+  if arr is None:
+    return pb.Tensor()
+  arr = np.ascontiguousarray(arr)
+  return pb.Tensor(tensor_data=arr.tobytes(), shape=list(arr.shape), dtype=str(arr.dtype))
+
+
+def proto_to_tensor(t: pb.Tensor) -> np.ndarray | None:
+  if not t.dtype:
+    return None
+  return np.frombuffer(t.tensor_data, dtype=_np_dtype(t.dtype)).reshape(tuple(t.shape))
+
+
+def shard_to_proto(shard: Shard) -> pb.Shard:
+  return pb.Shard(model_id=shard.model_id, start_layer=shard.start_layer, end_layer=shard.end_layer, n_layers=shard.n_layers)
+
+
+def proto_to_shard(s: pb.Shard) -> Shard:
+  return Shard(s.model_id, s.start_layer, s.end_layer, s.n_layers)
+
+
+def state_to_proto(state: InferenceState | None) -> pb.InferenceState:
+  if state is None:
+    return pb.InferenceState()
+  return pb.InferenceState(
+    tokens=tensor_to_proto(state.tokens),
+    curr_pos=state.curr_pos,
+    prompt_len=state.prompt_len,
+    extras_json=json.dumps(state.extras) if state.extras else "",
+  )
+
+
+def proto_to_state(s: pb.InferenceState) -> InferenceState:
+  return InferenceState(
+    tokens=proto_to_tensor(s.tokens),
+    curr_pos=s.curr_pos,
+    prompt_len=s.prompt_len,
+    extras=json.loads(s.extras_json) if s.extras_json else {},
+  )
+
+
+def topology_to_proto(topology: Topology) -> pb.Topology:
+  nodes = []
+  for node_id, caps in topology.nodes.items():
+    nodes.append(
+      pb.TopologyNode(
+        node_id=node_id,
+        capabilities=pb.DeviceCapabilities(
+          model=caps.model,
+          chip=caps.chip,
+          memory=caps.memory,
+          flops=pb.DeviceFlops(fp32=caps.flops.fp32, fp16=caps.flops.fp16, int8=caps.flops.int8),
+        ),
+        connected_to=sorted(topology.get_neighbors(node_id)),
+      )
+    )
+  return pb.Topology(nodes=nodes, active_node_id=topology.active_node_id or "")
+
+
+def proto_to_topology(t: pb.Topology) -> Topology:
+  topology = Topology()
+  for node in t.nodes:
+    caps = DeviceCapabilities(
+      model=node.capabilities.model,
+      chip=node.capabilities.chip,
+      memory=node.capabilities.memory,
+      flops=DeviceFlops(fp32=node.capabilities.flops.fp32, fp16=node.capabilities.flops.fp16, int8=node.capabilities.flops.int8),
+    )
+    topology.update_node(node.node_id, caps)
+    for neighbor in node.connected_to:
+      topology.add_edge(node.node_id, neighbor)
+  topology.active_node_id = t.active_node_id or None
+  return topology

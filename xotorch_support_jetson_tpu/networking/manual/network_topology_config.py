@@ -1,0 +1,37 @@
+"""Static network topology config (pydantic-validated JSON).
+
+Parity with reference ``networking/manual/network_topology_config.py:7-31``.
+This is the natural mode for TPU pods: membership is known ahead of time.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, ValidationError
+
+from ...topology.device_capabilities import DeviceCapabilities, DeviceFlops
+
+
+class PeerConfig(BaseModel):
+  address: str
+  port: int
+  device_capabilities: dict
+
+
+class NetworkTopology(BaseModel):
+  peers: dict[str, PeerConfig]
+
+  @classmethod
+  def from_path(cls, path: str) -> "NetworkTopology":
+    try:
+      with open(path) as f:
+        config_data = f.read()
+    except FileNotFoundError as e:
+      raise FileNotFoundError(f"Config file not found at {path}") from e
+    try:
+      return cls.model_validate_json(config_data)
+    except ValidationError as e:
+      raise ValueError(f"Error validating network topology config from {path}: {e}") from e
+
+
+def peer_device_capabilities(peer: PeerConfig) -> DeviceCapabilities:
+  return DeviceCapabilities.from_dict(peer.device_capabilities)

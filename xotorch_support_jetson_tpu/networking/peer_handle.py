@@ -1,0 +1,75 @@
+"""Client-side peer contract.
+
+Parity with reference ``networking/peer_handle.py:9-56``, extended with the
+``send_loss`` the reference declared but never wired (its proto lacked the
+RPC — see networking/grpc/node_service.proto here).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+from ..inference.shard import Shard
+from ..inference.state import InferenceState
+from ..topology.device_capabilities import DeviceCapabilities
+from ..topology.topology import Topology
+
+
+class PeerHandle(ABC):
+  @abstractmethod
+  def id(self) -> str:
+    ...
+
+  @abstractmethod
+  def addr(self) -> str:
+    ...
+
+  @abstractmethod
+  def description(self) -> str:
+    ...
+
+  @abstractmethod
+  def device_capabilities(self) -> DeviceCapabilities:
+    ...
+
+  @abstractmethod
+  async def connect(self) -> None:
+    ...
+
+  @abstractmethod
+  async def is_connected(self) -> bool:
+    ...
+
+  @abstractmethod
+  async def disconnect(self) -> None:
+    ...
+
+  @abstractmethod
+  async def health_check(self) -> bool:
+    ...
+
+  @abstractmethod
+  async def send_prompt(self, shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None = None) -> None:
+    ...
+
+  @abstractmethod
+  async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None) -> None:
+    ...
+
+  @abstractmethod
+  async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool, request_id: str) -> tuple[float, np.ndarray | None]:
+    ...
+
+  @abstractmethod
+  async def send_result(self, request_id: str, result: list[int] | np.ndarray, is_finished: bool) -> None:
+    ...
+
+  @abstractmethod
+  async def send_opaque_status(self, request_id: str, status: str) -> None:
+    ...
+
+  @abstractmethod
+  async def collect_topology(self, visited: set[str], max_depth: int) -> Topology:
+    ...

@@ -1,0 +1,15 @@
+"""Server ABC (parity: reference ``networking/server.py:4-11``)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class Server(ABC):
+  @abstractmethod
+  async def start(self) -> None:
+    ...
+
+  @abstractmethod
+  async def stop(self) -> None:
+    ...

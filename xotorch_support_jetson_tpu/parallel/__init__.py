@@ -1,0 +1,25 @@
+from .mesh import AXES, MeshPlan, auto_plan, build_mesh, decoder_param_specs, kv_cache_specs, shard_params, specs_for_params
+from .pipeline import make_pipeline_layers_fn, stack_stage_params, unstack_stage_params
+from .ring_attention import make_sharded_ring_attention, ring_attention
+from .train_step import cross_entropy_loss, make_eval_step, make_forward_fn, make_train_step, shard_batch
+
+__all__ = [
+  "AXES",
+  "MeshPlan",
+  "auto_plan",
+  "build_mesh",
+  "decoder_param_specs",
+  "kv_cache_specs",
+  "shard_params",
+  "specs_for_params",
+  "make_pipeline_layers_fn",
+  "stack_stage_params",
+  "unstack_stage_params",
+  "make_sharded_ring_attention",
+  "ring_attention",
+  "cross_entropy_loss",
+  "make_eval_step",
+  "make_forward_fn",
+  "make_train_step",
+  "shard_batch",
+]

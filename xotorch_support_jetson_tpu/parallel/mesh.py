@@ -1,0 +1,132 @@
+"""Device mesh construction and parameter sharding.
+
+This is the TPU-native replacement for the reference's cluster-of-peers
+execution model (SURVEY.md §7 design-translation table): where the reference
+assigns a ``Shard`` per gRPC peer, this framework assigns shardings over a
+``jax.sharding.Mesh`` and lets XLA place collectives on ICI.
+
+Axes (any may be 1):
+  dp — data parallel (batch dim; gradients all-reduce here)
+  pp — pipeline stages (layer ranges; activations ppermute here)
+  sp — sequence/context parallel (ring attention shards the sequence here)
+  tp — tensor parallel (attention heads / MLP width; megatron-style)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+  dp: int = 1
+  pp: int = 1
+  sp: int = 1
+  tp: int = 1
+
+  @property
+  def n_devices(self) -> int:
+    return self.dp * self.pp * self.sp * self.tp
+
+  def describe(self) -> str:
+    return f"dp={self.dp} pp={self.pp} sp={self.sp} tp={self.tp}"
+
+
+def build_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
+  devices = devices if devices is not None else jax.devices()
+  if len(devices) < plan.n_devices:
+    raise ValueError(f"mesh plan {plan.describe()} needs {plan.n_devices} devices, have {len(devices)}")
+  devices = devices[: plan.n_devices]
+  shape = (plan.dp, plan.pp, plan.sp, plan.tp)
+  try:
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+  except Exception:  # noqa: BLE001 — heterogeneous/virtual devices: plain reshape
+    dev_array = np.asarray(devices).reshape(shape)
+  return Mesh(dev_array, AXES)
+
+
+def auto_plan(n_devices: int | None = None, n_kv_heads: int | None = None) -> MeshPlan:
+  """Default single-slice plan: TP up to the KV-head count, rest DP.
+
+  TP is the axis the hardware wants first (head-parallel matmuls stay on the
+  MXU and the all-reduce rides ICI); beyond n_kv_heads, extra TP only
+  replicates KV, so remaining chips go to DP.
+  """
+  n = n_devices if n_devices is not None else len(jax.devices())
+  tp = 1
+  limit = n_kv_heads or n
+  while tp * 2 <= min(n, limit):
+    tp *= 2
+  dp = n // tp
+  return MeshPlan(dp=dp, tp=tp)
+
+
+# ---------------------------------------------------------------- shardings
+
+
+def decoder_param_specs(fsdp: bool = False) -> dict:
+  """PartitionSpecs for the decoder pytree (models/decoder.py layout).
+
+  TP follows the megatron pattern: qkv/gate/up column-parallel, o/down
+  row-parallel — XLA then places exactly one psum per block on ICI. With
+  ``fsdp=True`` the weights are additionally sharded over dp on the
+  non-tp dim and all-gathered just-in-time (GSPMD handles the gathers).
+  """
+  d = "dp" if fsdp else None
+  layers = {
+    "attn_norm": P(None, None),
+    "wq": P(None, d, "tp"),
+    "wk": P(None, d, "tp"),
+    "wv": P(None, d, "tp"),
+    "wo": P(None, "tp", d),
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
+    "mlp_norm": P(None, None),
+    "w_gate": P(None, d, "tp"),
+    "w_up": P(None, d, "tp"),
+    "w_down": P(None, "tp", d),
+  }
+  return {
+    "embed": P("tp", d),  # vocab-sharded
+    "layers": layers,
+    "final_norm": P(None),
+    "lm_head": P(d, "tp"),
+  }
+
+
+def specs_for_params(params, fsdp: bool = False) -> dict:
+  """Match the spec tree to an actual params pytree (drop absent keys)."""
+  full = decoder_param_specs(fsdp)
+  out = {}
+  for key, value in params.items():
+    if key == "layers":
+      out["layers"] = {k: full["layers"][k] for k in value}
+    else:
+      out[key] = full[key]
+  return out
+
+
+def kv_cache_specs() -> dict:
+  # [L, B, S, Hkv, hd] — batch over dp, kv heads over tp, sequence over sp.
+  return {"k": P(None, "dp", "sp", "tp", None), "v": P(None, "dp", "sp", "tp", None)}
+
+
+def shard_params(params, mesh: Mesh, fsdp: bool = False):
+  """device_put the params pytree with NamedShardings over the mesh."""
+  specs = specs_for_params(params, fsdp)
+  return jax.tree.map(
+    lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+    params,
+    specs,
+    is_leaf=lambda x: isinstance(x, P),
+  )

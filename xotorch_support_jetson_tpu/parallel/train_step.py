@@ -1,0 +1,115 @@
+"""The full distributed training step: dp × pp × sp × tp in one jitted program.
+
+The reference *sketched* pipeline-parallel training (gradients ride the gRPC
+ring back via SendExample, ``node.py:299-330``) but its engines never
+implemented ``train`` (SURVEY.md §2.2) — the path raises AttributeError.
+Here the training step is a single compiled XLA program over the mesh:
+
+  dp — batch sharded; gradient all-reduce inserted by GSPMD
+  pp — GPipe microbatch pipeline (parallel/pipeline.py), grads flow back
+       through the reversed ppermutes
+  sp — ring attention shards the sequence (parallel/ring_attention.py)
+  tp — megatron param shardings (parallel/mesh.py), collectives by GSPMD
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..ops.norm import rms_norm
+from .mesh import MeshPlan, specs_for_params
+from .pipeline import make_pipeline_layers_fn, stack_stage_params
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+  """Masked mean next-token CE. logits [B,S,V] fp32, targets [B,S], mask [B,S]."""
+  logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+  nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+  mask = mask.astype(jnp.float32)
+  return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_forward_fn(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int = 1, ring_sp: bool | None = None, remat: bool = True):
+  """fn(params, tokens [B,S], positions [B,S]) -> logits [B,S,V] (fp32)."""
+  ring = plan.sp > 1 if ring_sp is None else ring_sp
+  layers_fn = make_pipeline_layers_fn(mesh, cfg, plan.pp, n_micro, ring_sp=ring, remat=remat)
+
+  def forward(params, tokens, positions):
+    tokens = jax.lax.with_sharding_constraint(tokens, NamedSharding(mesh, P("dp", "sp" if ring else None)))
+    h = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    stage_params = stack_stage_params(params["layers"], plan.pp)
+    h = layers_fn(stage_params, h, positions)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    w_out = params.get("lm_head")
+    if w_out is None:
+      w_out = params["embed"].T
+    return h.astype(jnp.float32) @ w_out.astype(jnp.float32)
+
+  return forward
+
+
+def make_train_step(
+  mesh: Mesh,
+  cfg: ModelConfig,
+  plan: MeshPlan,
+  optimizer: optax.GradientTransformation | None = None,
+  n_micro: int = 1,
+  remat: bool = True,
+  grad_postprocess: Callable[[Any, Any], Any] | None = None,
+):
+  """Returns (init_fn, step_fn).
+
+  init_fn(params) -> opt_state (sharded like params).
+  step_fn(params, opt_state, batch) -> (params, opt_state, loss); jitted with
+  params/opt_state donated. batch = {"inputs","targets","mask"} each [B,S].
+  ``grad_postprocess(grads, params)`` can zero/filter grads (LoRA freezing).
+  """
+  optimizer = optimizer or optax.adamw(1e-5)
+  forward = make_forward_fn(mesh, cfg, plan, n_micro=n_micro, remat=remat)
+
+  def loss_fn(params, batch):
+    tokens = batch["inputs"]
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    logits = forward(params, tokens, positions)
+    return cross_entropy_loss(logits, batch["targets"], batch["mask"])
+
+  @partial(jax.jit, donate_argnums=(0, 1))
+  def step_fn(params, opt_state, batch):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    if grad_postprocess is not None:
+      grads = grad_postprocess(grads, params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+  def init_fn(params):
+    return optimizer.init(params)
+
+  return init_fn, step_fn
+
+
+def make_eval_step(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int = 1):
+  forward = make_forward_fn(mesh, cfg, plan, n_micro=n_micro, remat=False)
+
+  @jax.jit
+  def eval_fn(params, batch):
+    tokens = batch["inputs"]
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    logits = forward(params, tokens, positions)
+    return cross_entropy_loss(logits, batch["targets"], batch["mask"])
+
+  return eval_fn
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+  spec = NamedSharding(mesh, P("dp", None))
+  return {k: jax.device_put(jnp.asarray(v), spec) for k, v in batch.items()}
