@@ -17,6 +17,7 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
   os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("XOT_TPU_UUID", "test-node-id")
+os.environ.setdefault("HF_HUB_OFFLINE", "1")  # no egress in CI; fail fast
 
 # The axon TPU plugin in this image overrides JAX_PLATFORMS at import time;
 # the config API still wins, so force the CPU backend explicitly.

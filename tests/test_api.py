@@ -1,0 +1,130 @@
+"""ChatGPT-compatible API tests against a single dummy-engine node."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI, build_prompt, parse_chat_request
+from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine, DummyTokenizer
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from tests_support_stubs import NoDiscovery, StubServer
+
+
+async def _make_api():
+  node = Node(
+    "api-node",
+    StubServer(),
+    DummyInferenceEngine(),
+    NoDiscovery(),
+    None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=50,
+  )
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+@pytest.mark.asyncio
+async def test_healthcheck_and_models():
+  node, api, client = await _make_api()
+  try:
+    resp = await client.get("/healthcheck")
+    assert resp.status == 200 and (await resp.json())["status"] == "ok"
+
+    resp = await client.get("/v1/models")
+    data = await resp.json()
+    ids = [m["id"] for m in data["data"]]
+    assert "dummy" in ids
+
+    resp = await client.get("/v1/topology")
+    topo = await resp.json()
+    assert "api-node" in topo["nodes"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_blocking_chat_completion():
+  node, api, client = await _make_api()
+  try:
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False},
+    )
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    assert data["object"] == "chat.completion"
+    choice = data["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] in ("stop", "length")
+    assert data["usage"]["completion_tokens"] > 0
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_chat_completion():
+  node, api, client = await _make_api()
+  try:
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": True},
+    )
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    chunks = []
+    done = False
+    async for line in resp.content:
+      line = line.decode().strip()
+      if not line.startswith("data: "):
+        continue
+      payload = line[len("data: "):]
+      if payload == "[DONE]":
+        done = True
+        break
+      chunks.append(json.loads(payload))
+    assert done
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    finish = [c for c in chunks if c["choices"][0]["finish_reason"]]
+    assert finish, "no finish_reason chunk"
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_unknown_model_falls_back_and_gpt_alias():
+  req = parse_chat_request({"model": "gpt-4o", "messages": [{"role": "user", "content": "x"}]}, "dummy")
+  assert req.model == "dummy"
+  req = parse_chat_request({"model": "definitely-not-a-model", "messages": [{"role": "user", "content": "x"}]}, "dummy")
+  assert req.model == "dummy"
+
+
+@pytest.mark.asyncio
+async def test_token_encode_endpoint():
+  node, api, client = await _make_api()
+  try:
+    resp = await client.post("/v1/chat/token/encode", json={"model": "dummy", "messages": [{"role": "user", "content": "hello world"}]})
+    assert resp.status == 200
+    data = await resp.json()
+    assert data["num_tokens"] > 0 and isinstance(data["encoded_tokens"], list)
+  finally:
+    await client.close()
+    await node.stop()
+
+
+def test_build_prompt_multimodal_flatten():
+  from xotorch_support_jetson_tpu.api.chatgpt_api import Message
+
+  tok = DummyTokenizer()
+  messages = [Message("user", [{"type": "text", "text": "hi"}, {"type": "image_url", "image_url": {"url": "x"}}])]
+  prompt = build_prompt(tok, messages)
+  assert "hi" in prompt

@@ -86,6 +86,22 @@ async def _make_cluster(n=2):
     nodes.append(node)
     servers.append(server)
   await asyncio.gather(*(node.start() for node in nodes))
+  # Placement is eventually consistent (views converge via the 2s collection
+  # loop; reference §5.3 has the same property). Wait until every node sees
+  # the full membership and computes an n-way partition before using the ring.
+  from xotorch_support_jetson_tpu.topology.partitioning import map_partitions_to_shards
+
+  for _ in range(100):
+    converged = True
+    for node in nodes:
+      parts = node.partitioning_strategy.partition(node.topology)
+      shards = map_partitions_to_shards(parts, 8, "dummy")
+      if len(node.topology.nodes) != n or len(shards) != n:
+        converged = False
+    if converged:
+      break
+    await asyncio.gather(*(node.collect_topology(set()) for node in nodes))
+    await asyncio.sleep(0.05)
   return nodes
 
 

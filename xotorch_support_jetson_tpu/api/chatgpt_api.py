@@ -1,0 +1,421 @@
+"""OpenAI-compatible HTTP API.
+
+Endpoint parity with reference ``api/chatgpt_api.py`` (routes :208-234,
+streaming/blocking completions :317-443, token queues :194-198,585, prompt
+build w/ chat template + tools :131-150, finish_reason logic :383,430-436,
+``gpt-*`` aliasing :322, timeout middleware :246-253, CORS, static web chat).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from pathlib import Path
+
+from aiohttp import web
+
+from .. import registry
+from ..inference.shard import Shard
+from ..inference.tokenizers import resolve_tokenizer
+from ..utils.helpers import DEBUG, PrefixDict, AsyncCallbackSystem
+
+
+class Message:
+  def __init__(self, role: str, content, tools=None):
+    self.role = role
+    self.content = content
+    self.tools = tools
+
+  def to_dict(self) -> dict:
+    data = {"role": self.role, "content": self.content}
+    if self.tools:
+      data["tools"] = self.tools
+    return data
+
+
+class ChatCompletionRequest:
+  def __init__(self, model: str, messages: list[Message], temperature: float, tools=None, max_tokens=None, stream=False):
+    self.model = model
+    self.messages = messages
+    self.temperature = temperature
+    self.tools = tools
+    self.max_tokens = max_tokens
+    self.stream = stream
+
+
+def remap_messages(messages: list[Message]) -> list[Message]:
+  """Flatten multimodal content blocks to text (image support: vision models
+  not yet wired into the jax engine — reference :97-128 remaps for llava)."""
+  remapped = []
+  for message in messages:
+    if isinstance(message.content, list):
+      text = " ".join(part.get("text", "") for part in message.content if isinstance(part, dict) and part.get("type") == "text")
+      remapped.append(Message(message.role, text, message.tools))
+    else:
+      remapped.append(message)
+  return remapped
+
+
+def build_prompt(tokenizer, _messages: list[Message], tools=None) -> str:
+  messages = remap_messages(_messages)
+  chat_template_args = {
+    "conversation": [m.to_dict() for m in messages],
+    "tokenize": False,
+    "add_generation_prompt": True,
+  }
+  if tools:
+    chat_template_args["tools"] = tools
+  try:
+    return tokenizer.apply_chat_template(**chat_template_args)
+  except TypeError:
+    # Tokenizers without `conversation=` kwarg naming.
+    args = dict(chat_template_args)
+    conv = args.pop("conversation")
+    return tokenizer.apply_chat_template(conv, **args)
+
+
+def parse_message(data: dict) -> Message:
+  if "role" not in data or "content" not in data:
+    raise ValueError(f"Invalid message: {data}. Must have 'role' and 'content'")
+  return Message(data["role"], data["content"], data.get("tools"))
+
+
+def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
+  model = data.get("model", default_model)
+  if model and model.startswith("gpt-"):  # alias ChatGPT client defaults
+    model = default_model
+  if model not in registry.model_cards:
+    if DEBUG >= 1:
+      print(f"[api] unknown model {model}; defaulting to {default_model}")
+    model = default_model
+  return ChatCompletionRequest(
+    model,
+    [parse_message(m) for m in data["messages"]],
+    data.get("temperature", 0.6),
+    data.get("tools"),
+    data.get("max_tokens"),
+    data.get("stream", False),
+  )
+
+
+def completion_chunk(request_id: str, model: str, created: int, content: str | None, finish_reason: str | None) -> dict:
+  delta = {} if content is None else {"role": "assistant", "content": content}
+  return {
+    "id": f"chatcmpl-{request_id}",
+    "object": "chat.completion.chunk",
+    "created": created,
+    "model": model,
+    "system_fingerprint": "xot_tpu_0.1.0",
+    "choices": [{"index": 0, "delta": delta, "logprobs": None, "finish_reason": finish_reason}],
+  }
+
+
+class ChatGPTAPI:
+  def __init__(self, node, inference_engine_classname: str, response_timeout: float = 900.0, on_chat_completion_request=None, default_model: str | None = None, system_prompt: str | None = None):
+    self.node = node
+    self.inference_engine_classname = inference_engine_classname
+    self.response_timeout = response_timeout
+    self.on_chat_completion_request = on_chat_completion_request
+    self.default_model = default_model or "llama-3.2-1b"
+    self.system_prompt = system_prompt
+
+    self.app = web.Application(client_max_size=1024**3)  # 100MB+ for image payloads
+    self.prev_token_lens: dict[str, int] = {}
+    self.stream_tasks: dict[str, asyncio.Task] = {}
+    self.token_queues: dict[str, asyncio.Queue] = {}
+
+    # Token events from the node (local or broadcast from the sampling peer).
+    self.node.on_token.register("chatgpt-api-token-handler").on_next(
+      lambda req_id, tokens, is_finished: asyncio.create_task(self.handle_tokens(req_id, tokens, is_finished))
+    )
+
+    cors_middleware = self._make_cors_middleware()
+    timeout_middleware = self._make_timeout_middleware()
+    self.app.middlewares.extend([cors_middleware, timeout_middleware])
+
+    r = self.app.router
+    r.add_post("/v1/chat/completions", self.handle_post_chat_completions)
+    r.add_post("/chat/completions", self.handle_post_chat_completions)
+    r.add_post("/v1/chat/token/encode", self.handle_post_chat_token_encode)
+    r.add_get("/v1/models", self.handle_get_models)
+    r.add_get("/models", self.handle_get_models)
+    r.add_get("/initial_models", self.handle_get_initial_models)
+    r.add_get("/modelpool", self.handle_model_support)
+    r.add_get("/healthcheck", self.handle_healthcheck)
+    r.add_get("/v1/topology", self.handle_get_topology)
+    r.add_get("/topology", self.handle_get_topology)
+    r.add_get("/v1/download/progress", self.handle_get_download_progress)
+    r.add_post("/download", self.handle_post_download)
+    r.add_delete("/models/{model_name}", self.handle_delete_model)
+    r.add_post("/quit", self.handle_quit)
+
+    static_dir = Path(__file__).parent.parent / "tinychat"
+    if static_dir.exists():
+      r.add_get("/", self.handle_root)
+      r.add_static("/", static_dir, name="static")
+
+  # ------------------------------------------------------------ middleware
+
+  def _make_cors_middleware(self):
+    @web.middleware
+    async def cors(request, handler):
+      if request.method == "OPTIONS":
+        response = web.Response()
+      else:
+        try:
+          response = await handler(request)
+        except web.HTTPException as e:
+          response = e
+      response.headers["Access-Control-Allow-Origin"] = "*"
+      response.headers["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+      response.headers["Access-Control-Allow-Headers"] = "Content-Type, Authorization"
+      return response
+
+    return cors
+
+  def _make_timeout_middleware(self):
+    @web.middleware
+    async def timeout(request, handler):
+      try:
+        return await asyncio.wait_for(handler(request), timeout=self.response_timeout)
+      except asyncio.TimeoutError:
+        return web.json_response({"detail": "Request timed out"}, status=408)
+
+    return timeout
+
+  # --------------------------------------------------------------- handlers
+
+  async def handle_root(self, request):
+    return web.FileResponse(Path(__file__).parent.parent / "tinychat" / "index.html")
+
+  async def handle_healthcheck(self, request):
+    return web.json_response({"status": "ok"})
+
+  async def handle_quit(self, request):
+    response = web.json_response({"detail": "Quit signal received"}, status=200)
+    await response.prepare(request)
+    await response.write_eof()
+    import os
+    import signal
+
+    os.kill(os.getpid(), signal.SIGINT)
+    return response
+
+  async def handle_get_models(self, request):
+    models = [
+      {"id": model_id, "object": "model", "owned_by": "xot_tpu", "ready": True, "name": card.pretty}
+      for model_id, card in registry.model_cards.items()
+      if card.repo_for(self.inference_engine_classname)
+    ]
+    return web.json_response({"object": "list", "data": models})
+
+  async def handle_get_initial_models(self, request):
+    model_data = {
+      model_id: {
+        "name": card.pretty,
+        "downloaded": None,
+        "download_percentage": None,
+        "total_size": None,
+        "total_downloaded": None,
+        "loading": False,
+      }
+      for model_id, card in registry.model_cards.items()
+      if card.repo_for(self.inference_engine_classname)
+    }
+    return web.json_response(model_data)
+
+  async def handle_model_support(self, request):
+    response = web.StreamResponse(status=200, headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache", "Connection": "keep-alive"})
+    await response.prepare(request)
+    for model_id, card in registry.model_cards.items():
+      if not card.repo_for(self.inference_engine_classname):
+        continue
+      payload = {"model": model_id, "name": card.pretty, "downloaded": None, "download_percentage": None}
+      await response.write(f"data: {json.dumps(payload)}\n\n".encode())
+    await response.write(b"data: [DONE]\n\n")
+    await response.write_eof()
+    return response
+
+  async def handle_get_topology(self, request):
+    topology = self.node.current_topology
+    return web.json_response(topology.to_json() if topology else {})
+
+  async def handle_get_download_progress(self, request):
+    progress_data = {}
+    for node_id, progress in self.node.node_download_progress.items():
+      progress_data[str(node_id)] = progress
+    return web.json_response(progress_data)
+
+  async def handle_post_download(self, request):
+    data = await request.json()
+    model_id = data.get("model")
+    shard = registry.build_full_shard(model_id, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"error": f"Invalid model: {model_id}"}, status=400)
+    if self.node.shard_downloader is None:
+      return web.json_response({"error": "no downloader configured"}, status=400)
+    asyncio.create_task(self.node.shard_downloader.ensure_shard(shard, self.inference_engine_classname))
+    return web.json_response({"status": f"Download started for {model_id}"})
+
+  async def handle_delete_model(self, request):
+    model_name = request.match_info.get("model_name")
+    from ..download.downloader import delete_model
+
+    if await delete_model(model_name, self.inference_engine_classname):
+      return web.json_response({"status": f"Model {model_name} deleted"})
+    return web.json_response({"detail": f"Model {model_name} not found"}, status=404)
+
+  async def handle_post_chat_token_encode(self, request):
+    data = await request.json()
+    model = data.get("model", self.default_model)
+    if model.startswith("gpt-"):
+      model = self.default_model
+    shard = registry.build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"error": f"Unsupported model: {model}"}, status=400)
+    messages = [parse_message(m) for m in data.get("messages", [])]
+    tokenizer = await self._tokenizer_for(shard)
+    prompt = build_prompt(tokenizer, messages, data.get("tools"))
+    tokens = tokenizer.encode(prompt)
+    return web.json_response({"length": len(prompt), "num_tokens": len(tokens), "encoded_tokens": [int(t) for t in tokens], "encoded_prompt": prompt})
+
+  async def _tokenizer_for(self, shard: Shard):
+    engine_tok = getattr(self.node.inference_engine, "tokenizer", None)
+    loaded_shard = getattr(self.node.inference_engine, "shard", None)
+    if engine_tok is not None and loaded_shard is not None and loaded_shard.model_id == shard.model_id:
+      return engine_tok
+    repo = registry.get_repo(shard.model_id, self.inference_engine_classname)
+    if repo == "dummy":  # the dummy engine's tokenizer never lives on the hub
+      return engine_tok
+    return await resolve_tokenizer(repo)
+
+  async def handle_tokens(self, request_id: str, tokens: list[int], is_finished: bool) -> None:
+    queue = self.token_queues.get(request_id)
+    if queue is not None:
+      await queue.put((tokens, is_finished))
+
+  async def handle_post_chat_completions(self, request):
+    data = await request.json()
+    if DEBUG >= 2:
+      print(f"[api] chat completions request: {data}")
+    try:
+      chat_request = parse_chat_request(data, self.default_model)
+    except ValueError as e:
+      return web.json_response({"error": str(e)}, status=400)
+
+    shard = registry.build_base_shard(chat_request.model, self.inference_engine_classname)
+    if shard is None:
+      supported = registry.get_supported_models([[self.inference_engine_classname]])
+      return web.json_response(
+        {"detail": f"Unsupported model: {chat_request.model} with engine {self.inference_engine_classname}. Supported: {supported}"},
+        status=400,
+      )
+
+    if self.system_prompt and not any(m.role == "system" for m in chat_request.messages):
+      chat_request.messages.insert(0, Message("system", self.system_prompt))
+
+    tokenizer = await self._tokenizer_for(shard)
+    prompt = build_prompt(tokenizer, chat_request.messages, chat_request.tools)
+    request_id = str(uuid.uuid4())
+    if self.on_chat_completion_request:
+      try:
+        self.on_chat_completion_request(request_id, chat_request, prompt)
+      except Exception:  # noqa: BLE001
+        pass
+
+    self.token_queues[request_id] = asyncio.Queue()
+    created = int(time.time())
+    try:
+      await asyncio.wait_for(asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))), timeout=self.response_timeout)
+
+      if chat_request.stream:
+        return await self._stream_response(request, chat_request, request_id, tokenizer, created)
+      return await self._blocking_response(chat_request, request_id, tokenizer, created)
+    except asyncio.TimeoutError:
+      return web.json_response({"detail": "Response generation timed out"}, status=408)
+    except Exception as e:  # noqa: BLE001
+      if DEBUG >= 1:
+        import traceback
+
+        traceback.print_exc()
+      return web.json_response({"detail": f"Error processing prompt: {e}"}, status=500)
+    finally:
+      self.token_queues.pop(request_id, None)
+
+  def _finish_reason(self, tokenizer, last_token: int, is_finished: bool, hit_max: bool) -> str | None:
+    if not is_finished:
+      return None
+    eos = getattr(tokenizer, "eos_token_id", None)
+    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    return "stop" if last_token in eos_set else "length"
+
+  async def _stream_response(self, request, chat_request, request_id, tokenizer, created):
+    response = web.StreamResponse(
+      status=200,
+      reason="OK",
+      headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
+    )
+    await response.prepare(request)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    n_emitted = 0
+    try:
+      while True:
+        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+        emit = [t for t in tokens if t not in eos_set]
+        n_emitted += len(tokens)
+        if emit:
+          content = tokenizer.decode(emit)
+          chunk = completion_chunk(request_id, chat_request.model, created, content, None)
+          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        if is_finished:
+          finish = self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)
+          chunk = completion_chunk(request_id, chat_request.model, created, None, finish)
+          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+          break
+      await response.write(b"data: [DONE]\n\n")
+      await response.write_eof()
+      return response
+    except asyncio.TimeoutError:
+      return web.json_response({"detail": "Response generation timed out"}, status=408)
+
+  async def _blocking_response(self, chat_request, request_id, tokenizer, created):
+    all_tokens: list[int] = []
+    while True:
+      tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+      all_tokens.extend(tokens)
+      if is_finished:
+        break
+    eos = getattr(tokenizer, "eos_token_id", None)
+    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
+    content_tokens = [t for t in all_tokens if t not in eos_set]
+    return web.json_response(
+      {
+        "id": f"chatcmpl-{request_id}",
+        "object": "chat.completion",
+        "created": created,
+        "model": chat_request.model,
+        "system_fingerprint": "xot_tpu_0.1.0",
+        "choices": [
+          {
+            "index": 0,
+            "message": {"role": "assistant", "content": tokenizer.decode(content_tokens)},
+            "logprobs": None,
+            "finish_reason": finish_reason,
+          }
+        ],
+        "usage": {"prompt_tokens": 0, "completion_tokens": len(all_tokens), "total_tokens": len(all_tokens)},
+      }
+    )
+
+  async def run(self, host: str = "0.0.0.0", port: int = 52415):
+    runner = web.AppRunner(self.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    if DEBUG >= 0:
+      print(f"[api] ChatGPT-compatible API on http://{host}:{port}")
+    return runner

@@ -1,0 +1,269 @@
+"""CLI entrypoint: ``xot-tpu`` — daemon (API server), one-shot run, train, eval.
+
+Parity with reference ``xotorch/main.py`` (flag surface :73-108, component
+wiring :120-182, preemptive-load + download-broadcast callbacks :184-227,
+``run`` one-shot :229-259, train/eval :287-318, daemon default :362-387,
+signal handling :345-358).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+import time
+import uuid
+
+from . import registry
+from .inference.engine import get_inference_engine, inference_engine_classes
+from .inference.shard import Shard
+from .topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from .utils.helpers import DEBUG, find_available_port, get_or_create_node_id
+
+
+def build_parser() -> argparse.ArgumentParser:
+  parser = argparse.ArgumentParser(prog="xot-tpu", description="TPU-native distributed LLM inference and fine-tuning")
+  parser.add_argument("command", nargs="?", choices=["run", "eval", "train"], help="Command to run (default: daemon with API server)")
+  parser.add_argument("model_name", nargs="?", help="Model id (see registry)")
+  parser.add_argument("--default-model", type=str, default="llama-3.2-1b")
+  parser.add_argument("--node-id", type=str, default=None)
+  parser.add_argument("--node-host", type=str, default="0.0.0.0")
+  parser.add_argument("--node-port", type=int, default=None)
+  parser.add_argument("--listen-port", type=int, default=5678)
+  parser.add_argument("--broadcast-port", type=int, default=5678)
+  parser.add_argument("--discovery-module", type=str, choices=["udp", "manual", "none"], default="udp")
+  parser.add_argument("--discovery-timeout", type=int, default=30)
+  parser.add_argument("--discovery-config-path", type=str, default=None)
+  parser.add_argument("--wait-for-peers", type=int, default=0)
+  parser.add_argument("--chatgpt-api-port", type=int, default=52415)
+  parser.add_argument("--chatgpt-api-response-timeout", type=int, default=900)
+  parser.add_argument("--max-generate-tokens", type=int, default=10000)
+  parser.add_argument("--inference-engine", type=str, default="jax", choices=list(inference_engine_classes))
+  parser.add_argument("--temp", type=float, default=0.6)
+  parser.add_argument("--top-k", type=int, default=35)
+  parser.add_argument("--prompt", type=str, default="Who are you?")
+  parser.add_argument("--system-prompt", type=str, default=None)
+  parser.add_argument("--disable-tui", action="store_true")
+  parser.add_argument("--max-parallel-downloads", type=int, default=8)
+  parser.add_argument("--data", type=str, default=None, help="dataset dir for train/eval")
+  parser.add_argument("--iters", type=int, default=100)
+  parser.add_argument("--batch-size", type=int, default=1)
+  parser.add_argument("--seq-len", type=int, default=512)
+  parser.add_argument("--lr", type=float, default=1e-5)
+  parser.add_argument("--lora-rank", type=int, default=0, help=">0 enables LoRA with this rank")
+  parser.add_argument("--save-every", type=int, default=0)
+  parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
+  parser.add_argument("--resume-checkpoint", type=str, default=None)
+  parser.add_argument("--allowed-node-ids", type=str, default=None, help="comma-separated")
+  return parser
+
+
+def build_components(args):
+  """Wire downloader → engine → discovery → Node → gRPC server → API."""
+  from .api.chatgpt_api import ChatGPTAPI
+  from .download.downloader import new_shard_downloader
+  from .networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from .networking.grpc.grpc_server import GRPCServer
+  from .orchestration.node import Node
+
+  node_id = args.node_id or get_or_create_node_id()
+  node_port = args.node_port or find_available_port(args.node_host)
+
+  downloader = new_shard_downloader(args.max_parallel_downloads)
+  engine = get_inference_engine(args.inference_engine, downloader)
+  engine_classname = type(engine).__name__
+
+  def create_peer_handle(peer_id, address, description, device_capabilities):
+    return GRPCPeerHandle(peer_id, address, description, device_capabilities)
+
+  if args.discovery_module == "udp":
+    from .networking.udp.udp_discovery import UDPDiscovery
+
+    discovery = UDPDiscovery(
+      node_id,
+      node_port,
+      args.listen_port,
+      args.broadcast_port,
+      create_peer_handle,
+      discovery_timeout=args.discovery_timeout,
+      allowed_node_ids=args.allowed_node_ids.split(",") if args.allowed_node_ids else None,
+    )
+  elif args.discovery_module == "manual":
+    from .networking.manual.manual_discovery import ManualDiscovery
+
+    if not args.discovery_config_path:
+      raise ValueError("--discovery-config-path required with manual discovery")
+    discovery = ManualDiscovery(args.discovery_config_path, node_id, create_peer_handle)
+  else:
+    from .networking.discovery import Discovery
+
+    class _NoDiscovery(Discovery):
+      async def start(self):
+        pass
+
+      async def stop(self):
+        pass
+
+      async def discover_peers(self, wait_for_peers: int = 0):
+        return []
+
+    discovery = _NoDiscovery()
+
+  topology_viz = None
+  if not args.disable_tui:
+    try:
+      from .viz.topology_viz import TopologyViz
+
+      topology_viz = TopologyViz()
+    except Exception:  # noqa: BLE001 — rich unavailable or no tty
+      topology_viz = None
+
+  node = Node(
+    node_id,
+    None,
+    engine,
+    discovery,
+    downloader,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=args.max_generate_tokens,
+    default_sample_temp=args.temp,
+    default_sample_top_k=args.top_k,
+    topology_viz=topology_viz,
+  )
+  server = GRPCServer(node, args.node_host, node_port)
+  node.server = server
+
+  api = ChatGPTAPI(
+    node,
+    engine_classname,
+    response_timeout=args.chatgpt_api_response_timeout,
+    default_model=args.default_model,
+    system_prompt=args.system_prompt,
+  )
+
+  # Preemptive shard load: when any node starts a prompt, every node warms its
+  # own shard of that model (reference main.py:204-215).
+  def on_opaque_status(request_id: str, status: str):
+    try:
+      data = json.loads(status)
+      if data.get("type") == "node_status" and data.get("status") == "start_process_prompt":
+        base_shard = Shard.from_dict(data.get("base_shard", {}))
+        current = node.get_current_shard(base_shard)
+        asyncio.create_task(engine.ensure_shard(current))
+    except Exception:  # noqa: BLE001
+      pass
+
+  node.on_opaque_status.register("preload").on_next(on_opaque_status)
+
+  # Download progress rebroadcast (throttled), reference main.py:217-227.
+  last_broadcast = {}
+
+  def on_progress(shard, event):
+    now = time.time()
+    if now - last_broadcast.get(shard, 0) < 0.2 and event.status != "complete":
+      return
+    last_broadcast[shard] = now
+    asyncio.create_task(
+      node.broadcast_opaque_status(
+        "",
+        json.dumps({"type": "download_progress", "node_id": node.id, "progress": event.to_dict()}),
+      )
+    )
+
+  if downloader is not None:
+    downloader.on_progress.register("broadcast").on_next(on_progress)
+
+  return node, server, api, engine, engine_classname
+
+
+async def run_model_cli(node, engine_classname: str, model_name: str, prompt: str) -> None:
+  shard = registry.build_base_shard(model_name, engine_classname)
+  if shard is None:
+    print(f"Error: unsupported model '{model_name}' for engine {engine_classname}")
+    return
+  from .inference.tokenizers import resolve_tokenizer
+
+  tokenizer = await resolve_tokenizer(registry.get_repo(model_name, engine_classname))
+  messages = [{"role": "user", "content": prompt}]
+  templated = tokenizer.apply_chat_template(messages, tokenize=False, add_generation_prompt=True)
+
+  request_id = str(uuid.uuid4())
+  done = asyncio.Event()
+  tokens_out: list[int] = []
+  t_start = time.perf_counter()
+
+  def on_token(rid, tokens, is_finished):
+    if rid != request_id:
+      return
+    tokens_out.extend(tokens)
+    text = tokenizer.decode(tokens)
+    print(text, end="", flush=True)
+    if is_finished:
+      done.set()
+
+  node.on_token.register("cli").on_next(on_token)
+  await node.process_prompt(shard, templated, request_id)
+  try:
+    await asyncio.wait_for(done.wait(), timeout=300)
+  except asyncio.TimeoutError:
+    print("\n[timeout]")
+  elapsed = time.perf_counter() - t_start
+  print(f"\n[{len(tokens_out)} tokens in {elapsed:.1f}s — {len(tokens_out)/max(elapsed,1e-9):.1f} tok/s]")
+
+
+async def train_model_cli(node, engine_classname: str, args) -> None:
+  from .train.driver import run_training
+
+  await run_training(node, engine_classname, args)
+
+
+async def eval_model_cli(node, engine_classname: str, args) -> None:
+  from .train.driver import run_eval
+
+  await run_eval(node, engine_classname, args)
+
+
+async def async_main(args) -> None:
+  node, server, api, engine, engine_classname = build_components(args)
+  await node.start(wait_for_peers=args.wait_for_peers)
+
+  loop = asyncio.get_event_loop()
+  stop_event = asyncio.Event()
+
+  def shutdown():
+    stop_event.set()
+
+  for sig in (signal.SIGINT, signal.SIGTERM):
+    try:
+      loop.add_signal_handler(sig, shutdown)
+    except NotImplementedError:
+      pass
+
+  try:
+    if args.command == "run":
+      model = args.model_name or args.default_model
+      await run_model_cli(node, engine_classname, model, args.prompt)
+    elif args.command == "train":
+      await train_model_cli(node, engine_classname, args)
+    elif args.command == "eval":
+      await eval_model_cli(node, engine_classname, args)
+    else:
+      runner = await api.run(port=args.chatgpt_api_port)
+      await stop_event.wait()
+      await runner.cleanup()
+  finally:
+    await node.stop()
+
+
+def run() -> None:
+  args = build_parser().parse_args()
+  try:
+    asyncio.run(async_main(args))
+  except KeyboardInterrupt:
+    print("\nshutting down")
+
+
+if __name__ == "__main__":
+  run()

@@ -129,6 +129,11 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   q = x @ p["wq"]
   k = x @ p["wk"]
   v = x @ p["wv"]
+  # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
+  if "wq_lora_a" in p:
+    q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
+  if "wv_lora_a" in p:
+    v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
   if "bq" in p:
     q = q + p["bq"]
     k = k + p["bk"]

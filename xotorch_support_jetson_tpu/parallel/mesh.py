@@ -95,6 +95,12 @@ def decoder_param_specs(fsdp: bool = False) -> dict:
     "w_gate": P(None, d, "tp"),
     "w_up": P(None, d, "tp"),
     "w_down": P(None, "tp", d),
+    # LoRA adapters: A column stays replicated (rank dim is tiny), B follows
+    # the target's column-parallel sharding.
+    "wq_lora_a": P(None, d, None),
+    "wq_lora_b": P(None, None, "tp"),
+    "wv_lora_a": P(None, d, None),
+    "wv_lora_b": P(None, None, "tp"),
   }
   return {
     "embed": P("tp", d),  # vocab-sharded
@@ -110,9 +116,9 @@ def specs_for_params(params, fsdp: bool = False) -> dict:
   out = {}
   for key, value in params.items():
     if key == "layers":
-      out["layers"] = {k: full["layers"][k] for k in value}
+      out["layers"] = {k: full["layers"].get(k, P()) for k in value}
     else:
-      out[key] = full[key]
+      out[key] = full.get(key, P())
   return out
 
 

@@ -1,0 +1,4 @@
+from .dataset import Dataset, iterate_batches, load_dataset
+from .lora import add_lora, lora_grad_mask, merge_lora
+
+__all__ = ["Dataset", "iterate_batches", "load_dataset", "add_lora", "lora_grad_mask", "merge_lora"]

@@ -1,0 +1,49 @@
+"""Checkpoint save/load (orbax-backed, npz fallback).
+
+The reference's checkpoint path is a no-op stub (``save_checkpoint`` default
+empty, ``load_checkpoint`` stub; ``--resume-checkpoint`` parsed and unused —
+SURVEY.md §5.4). Here save/restore round-trips the params pytree for real.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def save_params(params, path: str | Path) -> None:
+  path = Path(path)
+  path.parent.mkdir(parents=True, exist_ok=True)
+  try:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path.absolute().with_suffix(".orbax"), params, force=True)
+    ckptr.wait_until_finished()
+  except Exception:  # noqa: BLE001 — orbax API drift: flat-npz fallback
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
+    np.savez(str(path.with_suffix(".npz")), **arrays)
+
+
+def load_params(path: str | Path, like):
+  """Restore a params pytree with the structure/dtypes of ``like``."""
+  path = Path(path)
+  orbax_path = path.absolute().with_suffix(".orbax")
+  npz_path = path.with_suffix(".npz")
+  if orbax_path.exists():
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(orbax_path, like)
+  if npz_path.exists():
+    data = np.load(str(npz_path))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for key_path, leaf in flat:
+      arr = data[jax.tree_util.keystr(key_path)]
+      leaves.append(jax.numpy.asarray(arr, dtype=leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+  raise FileNotFoundError(f"no checkpoint at {orbax_path} or {npz_path}")

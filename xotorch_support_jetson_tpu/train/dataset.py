@@ -1,0 +1,82 @@
+"""jsonl dataset loading + batching for fine-tuning.
+
+Parity with reference ``train/dataset.py`` (``load_dataset`` :67,
+``iterate_batches`` :9-44 returning (input, target, lengths), >max-len
+warning :46-57). Examples are ``{"text": ...}`` or ``{"prompt","completion"}``
+jsonl lines.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+class Dataset:
+  def __init__(self, path: str | Path, max_seq_len: int = 2048) -> None:
+    self.path = Path(path)
+    self.max_seq_len = max_seq_len
+    self.examples: list[dict] = []
+    with open(self.path) as f:
+      for line in f:
+        line = line.strip()
+        if line:
+          self.examples.append(json.loads(line))
+
+  def __len__(self) -> int:
+    return len(self.examples)
+
+  def __getitem__(self, idx: int) -> str:
+    ex = self.examples[idx]
+    if "text" in ex:
+      return ex["text"]
+    if "prompt" in ex and "completion" in ex:
+      return ex["prompt"] + ex["completion"]
+    raise ValueError(f"example {idx}: need 'text' or 'prompt'+'completion', got keys {list(ex)}")
+
+
+def load_dataset(data_dir: str | Path, max_seq_len: int = 2048) -> tuple[Dataset, Dataset, Dataset]:
+  """Load train/valid/test jsonl from a directory."""
+  data_dir = Path(data_dir)
+
+  def load(name: str) -> Dataset:
+    path = data_dir / f"{name}.jsonl"
+    if not path.exists():
+      raise FileNotFoundError(f"missing {path}")
+    return Dataset(path, max_seq_len)
+
+  return load("train"), load("valid"), load("test")
+
+
+def iterate_batches(dataset: Dataset, tokenizer, batch_size: int, seq_len: int, train: bool = False, seed: int = 0):
+  """Yield (inputs [B,S], targets [B,S], lengths [B]) int32/int32/int32.
+
+  Next-token setup: inputs = tokens[:-1] padded, targets = tokens[1:] padded,
+  lengths = number of valid target positions.
+  """
+  rng = np.random.default_rng(seed)
+  order = np.arange(len(dataset))
+  while True:
+    if train:
+      rng.shuffle(order)
+    for start in range(0, len(order) - batch_size + 1, batch_size):
+      idxs = order[start : start + batch_size]
+      token_lists = []
+      for i in idxs:
+        toks = tokenizer.encode(dataset[int(i)])
+        if len(toks) > seq_len + 1:
+          toks = toks[: seq_len + 1]
+        token_lists.append(toks)
+      inputs = np.zeros((batch_size, seq_len), np.int32)
+      targets = np.zeros((batch_size, seq_len), np.int32)
+      lengths = np.zeros((batch_size,), np.int32)
+      for row, toks in enumerate(token_lists):
+        n = max(len(toks) - 1, 0)
+        inputs[row, :n] = toks[:-1][:n]
+        targets[row, :n] = toks[1:][:n]
+        lengths[row] = n
+      yield inputs, targets, lengths
+    if not train:
+      break

@@ -1,0 +1,67 @@
+"""Train/eval CLI drivers (role of reference ``main.py:261-318``)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+
+from .. import registry
+from .dataset import iterate_batches, load_dataset
+
+
+async def _prepare(node, engine_classname: str, args):
+  model = args.model_name or args.default_model
+  shard = registry.build_full_shard(model, engine_classname)
+  if shard is None:
+    raise ValueError(f"unsupported model {model!r} for engine {engine_classname}")
+  engine = node.inference_engine
+  await engine.ensure_shard(shard)
+  if args.lora_rank and args.lora_rank > 0:
+    import jax
+
+    from .lora import add_lora
+
+    engine.params = add_lora(engine.params, args.lora_rank, jax.random.PRNGKey(0))
+    if hasattr(engine, "_train_state"):
+      del engine._train_state
+  if args.resume_checkpoint:
+    await engine.load_checkpoint(shard, args.resume_checkpoint)
+  if not args.data:
+    raise ValueError("--data <dir with train/valid/test.jsonl> is required")
+  train_set, valid_set, test_set = load_dataset(args.data)
+  return shard, engine, train_set, valid_set, test_set
+
+
+async def run_training(node, engine_classname: str, args) -> None:
+  shard, engine, train_set, valid_set, _ = await _prepare(node, engine_classname, args)
+  batches = iterate_batches(train_set, engine.tokenizer, args.batch_size, args.seq_len, train=True)
+  losses = []
+  t0 = time.perf_counter()
+  for it in range(1, args.iters + 1):
+    inputs, targets, lengths = next(batches)
+    loss, _ = await node.enqueue_example(shard, inputs, targets, lengths, train=True, request_id=f"train-{it}")
+    losses.append(loss)
+    if it % 10 == 0 or it == 1:
+      rate = it / (time.perf_counter() - t0)
+      print(f"iter {it}/{args.iters}  loss {loss:.4f}  avg10 {np.mean(losses[-10:]):.4f}  {rate:.2f} it/s")
+    if args.save_every and it % args.save_every == 0:
+      await node.coordinate_save(shard, it, args.save_checkpoint_dir)
+      print(f"checkpoint saved at iter {it}")
+  # Final validation pass.
+  val_losses = []
+  for inputs, targets, lengths in iterate_batches(valid_set, engine.tokenizer, args.batch_size, args.seq_len):
+    loss, _ = await node.enqueue_example(shard, inputs, targets, lengths, train=False)
+    val_losses.append(loss)
+  if val_losses:
+    print(f"validation loss: {np.mean(val_losses):.4f}")
+
+
+async def run_eval(node, engine_classname: str, args) -> None:
+  shard, engine, _, _, test_set = await _prepare(node, engine_classname, args)
+  losses = []
+  for inputs, targets, lengths in iterate_batches(test_set, engine.tokenizer, args.batch_size, args.seq_len):
+    loss, _ = await node.enqueue_example(shard, inputs, targets, lengths, train=False)
+    losses.append(loss)
+  print(f"test loss: {np.mean(losses):.4f}  ppl: {np.exp(np.mean(losses)):.2f}" if losses else "no test data")

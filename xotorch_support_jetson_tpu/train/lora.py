@@ -1,0 +1,61 @@
+"""LoRA adapters on the decoder pytree.
+
+The reference ships sample LoRA jsonl data but no LoRA implementation
+(``train/data/lora/``, SURVEY.md §2.10); here adapters are extra stacked
+leaves on the layers dict (``wq_lora_a`` [L, D, r], ``wq_lora_b`` [L, r, Qd],
+same for wv), applied inside the decoder layer when present
+(models/decoder.py). Freezing the base model is a gradient mask — the
+functional-pytree equivalent of requires_grad=False.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LORA_TARGETS = ("wq", "wv")
+
+
+def lora_scale(rank: int, alpha: float | None = None) -> float:
+  return (alpha if alpha is not None else 2.0 * rank) / rank
+
+
+def add_lora(params: dict, rank: int, key: jax.Array, targets: tuple[str, ...] = LORA_TARGETS) -> dict:
+  """Return params with zero-initialized-B LoRA leaves added (A ~ N(0, 1/r))."""
+  layers = dict(params["layers"])
+  for i, target in enumerate(targets):
+    w = layers[target]  # [L, D_in, D_out]
+    L, d_in, d_out = w.shape
+    sub = jax.random.fold_in(key, i)
+    layers[f"{target}_lora_a"] = (jax.random.normal(sub, (L, d_in, rank), jnp.float32) / rank).astype(w.dtype)
+    layers[f"{target}_lora_b"] = jnp.zeros((L, rank, d_out), w.dtype)
+  return {**params, "layers": layers}
+
+
+def merge_lora(params: dict, rank: int, targets: tuple[str, ...] = LORA_TARGETS) -> dict:
+  """Fold adapters into the base weights and drop the LoRA leaves."""
+  layers = dict(params["layers"])
+  scale = lora_scale(rank)
+  for target in targets:
+    a = layers.pop(f"{target}_lora_a", None)
+    b = layers.pop(f"{target}_lora_b", None)
+    if a is None or b is None:
+      continue
+    delta = jnp.einsum("ldr,lro->ldo", a.astype(jnp.float32), b.astype(jnp.float32)) * scale
+    layers[target] = (layers[target].astype(jnp.float32) + delta).astype(layers[target].dtype)
+  return {**params, "layers": layers}
+
+
+def lora_grad_mask(grads: dict, params: dict) -> dict:
+  """Zero every gradient except the LoRA leaves (base model frozen)."""
+
+  def mask_tree(tree, path=""):
+    out = {}
+    for k, v in tree.items():
+      if isinstance(v, dict):
+        out[k] = mask_tree(v, k)
+      else:
+        out[k] = v if "_lora_" in k else jax.tree.map(jnp.zeros_like, v)
+    return out
+
+  return mask_tree(grads)

@@ -1,0 +1,75 @@
+"""Engine-side training/eval steps.
+
+This is the piece the reference *calls but never implemented*
+(``node.py:317,324,333`` → AttributeError; SURVEY.md §2.2). The engine's
+``train``/``evaluate`` delegate here; the step itself is the distributed
+train step from parallel/train_step.py, run on whatever mesh the engine's
+devices support (single chip → trivial mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import optax
+
+from ..parallel.mesh import MeshPlan, build_mesh
+from ..parallel.train_step import make_eval_step, make_train_step
+from .lora import lora_grad_mask
+
+
+class _TrainState:
+  def __init__(self, step_fn, eval_fn, opt_state):
+    self.step_fn = step_fn
+    self.eval_fn = eval_fn
+    self.opt_state = opt_state
+
+
+def _get_train_state(engine, lr: float, opt: str, lora: bool) -> _TrainState:
+  state = getattr(engine, "_train_state", None)
+  if state is not None:
+    return state
+  cfg = engine.cfg
+  mesh = build_mesh(MeshPlan())  # single-device; multi-chip via parallel API
+  if opt == "sgd":
+    optimizer = optax.sgd(lr)
+  elif lora:
+    # No decoupled weight decay with LoRA: adamw would decay the frozen base
+    # weights even with zero gradients.
+    optimizer = optax.adam(lr)
+  else:
+    optimizer = optax.adamw(lr)
+  grad_post = lora_grad_mask if lora else None
+  init_fn, step_fn = make_train_step(mesh, cfg, MeshPlan(), optimizer=optimizer, remat=True, grad_postprocess=grad_post)
+  eval_fn = make_eval_step(mesh, cfg, MeshPlan())
+  opt_state = init_fn(engine.params)
+  state = _TrainState(step_fn, eval_fn, opt_state)
+  engine._train_state = state
+  return state
+
+
+def _make_batch(inputs, targets, lengths):
+  inputs = np.asarray(inputs, np.int32)
+  targets = np.asarray(targets, np.int32)
+  lengths = np.asarray(lengths, np.int32).reshape(-1)
+  S = inputs.shape[1]
+  mask = (np.arange(S)[None, :] < lengths[:, None]).astype(np.float32)
+  return {"inputs": inputs, "targets": targets, "mask": mask}
+
+
+def engine_train_step(engine, shard, inputs, targets, lengths, loss: str = "ce", opt: str = "adamw", lr: float = 1e-5) -> float:
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise NotImplementedError("engine-side training requires a full-model shard (pipeline training rides the ring protocol)")
+  lora = any("_lora_" in k for k in engine.params["layers"])
+  state = _get_train_state(engine, lr, opt, lora)
+  batch = _make_batch(inputs, targets, lengths)
+  engine.params, state.opt_state, loss_val = state.step_fn(engine.params, state.opt_state, batch)
+  return float(jax.device_get(loss_val))
+
+
+def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") -> float:
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise NotImplementedError("engine-side eval requires a full-model shard")
+  state = _get_train_state(engine, 1e-5, "adamw", any("_lora_" in k for k in engine.params["layers"]))
+  batch = _make_batch(inputs, targets, lengths)
+  return float(jax.device_get(state.eval_fn(engine.params, batch)))

@@ -1,0 +1,64 @@
+"""Plain terminal REPL chat with tokens/sec measurement.
+
+Parity with reference ``viz/chat_tui.py:74-155``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+
+from .. import registry
+from ..inference.tokenizers import resolve_tokenizer
+
+
+async def run_chat_tui(node, engine_classname: str, model_name: str) -> None:
+  shard = registry.build_base_shard(model_name, engine_classname)
+  if shard is None:
+    print(f"unsupported model: {model_name}")
+    return
+  repo = registry.get_repo(model_name, engine_classname)
+  tokenizer = await resolve_tokenizer(repo)
+  messages: list[dict] = []
+  print(f"chat with {model_name} — empty line or /quit to exit")
+  loop = asyncio.get_event_loop()
+
+  while True:
+    try:
+      user_input = await loop.run_in_executor(None, input, "\n> ")
+    except (EOFError, KeyboardInterrupt):
+      break
+    if not user_input.strip() or user_input.strip() == "/quit":
+      break
+    messages.append({"role": "user", "content": user_input})
+    prompt = tokenizer.apply_chat_template(messages, tokenize=False, add_generation_prompt=True)
+
+    request_id = str(uuid.uuid4())
+    done = asyncio.Event()
+    collected: list[int] = []
+    t_start = time.perf_counter()
+    t_first: list[float] = []
+
+    def on_token(rid, tokens, is_finished):
+      if rid != request_id:
+        return
+      if not t_first:
+        t_first.append(time.perf_counter())
+      collected.extend(tokens)
+      print(tokenizer.decode(tokens), end="", flush=True)
+      if is_finished:
+        done.set()
+
+    node.on_token.register(f"tui-{request_id}").on_next(on_token)
+    await node.process_prompt(shard, prompt, request_id)
+    try:
+      await asyncio.wait_for(done.wait(), timeout=300)
+    except asyncio.TimeoutError:
+      print("\n[timeout]")
+    node.on_token.deregister(f"tui-{request_id}")
+
+    elapsed = time.perf_counter() - t_start
+    ttft = (t_first[0] - t_start) if t_first else 0.0
+    print(f"\n[{len(collected)} tokens, {len(collected)/max(elapsed, 1e-9):.1f} tok/s, ttft {ttft*1e3:.0f}ms]")
+    messages.append({"role": "assistant", "content": tokenizer.decode(collected)})

@@ -1,0 +1,128 @@
+"""Terminal topology visualization (rich Live TUI).
+
+Role parity with reference ``viz/topology_viz.py`` (ring layout of partitions
+w/ per-node chip/memory/TFLOPS + active-node highlight :182-332, GPU-poor/rich
+bar :219-249, prompt/response panel :84-180, download progress :334-378),
+rendered with rich tables/panels rather than a hand-drawn ellipse — same
+information, sturdier in narrow terminals.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+
+from rich.console import Console, Group
+from rich.live import Live
+from rich.panel import Panel
+from rich.table import Table
+from rich.text import Text
+
+from ..topology.partitioning import Partition
+from ..topology.topology import Topology
+
+
+class TopologyViz:
+  def __init__(self, chatgpt_api_port: int | None = None, max_history: int = 3) -> None:
+    self.chatgpt_api_port = chatgpt_api_port
+    self.topology = Topology()
+    self.partitions: list[Partition] = []
+    self.node_id: str | None = None
+    self.prompts: deque = deque(maxlen=max_history)
+    self.responses: dict[str, str] = {}
+    self.download_lines: dict[str, str] = {}
+    self.console = Console()
+    self.live: Live | None = None
+
+  def start(self) -> None:
+    if self.live is None:
+      self.live = Live(self._render(), console=self.console, refresh_per_second=4, transient=False)
+      self.live.start()
+
+  def stop(self) -> None:
+    if self.live is not None:
+      self.live.stop()
+      self.live = None
+
+  def update_visualization(self, topology: Topology, partitions: list[Partition], node_id: str | None = None) -> None:
+    self.topology = topology
+    self.partitions = partitions
+    self.node_id = node_id
+    self.refresh()
+
+  def add_prompt(self, request_id: str, prompt: str) -> None:
+    self.prompts.append((request_id, prompt))
+    self.refresh()
+
+  def update_response(self, request_id: str, response: str) -> None:
+    self.responses[request_id] = response
+    self.refresh()
+
+  def update_download(self, node_id: str, line: str) -> None:
+    self.download_lines[node_id] = line
+    self.refresh()
+
+  def refresh(self) -> None:
+    if self.live is not None:
+      self.live.update(self._render())
+
+  # ---------------------------------------------------------------- render
+
+  def _gpu_bar(self) -> Text:
+    total_fp16 = sum(caps.flops.fp16 for _, caps in self.topology.all_nodes())
+    # tanh scaling: consumer laptop ≈ left edge, pod slice ≈ right edge.
+    frac = math.tanh(total_fp16 / 1000.0)
+    width = 40
+    filled = int(frac * width)
+    bar = Text()
+    bar.append("GPU poor ", style="dim")
+    bar.append("█" * filled, style="green")
+    bar.append("░" * (width - filled), style="dim")
+    bar.append(" GPU rich", style="dim")
+    bar.append(f"  ({total_fp16:.0f} TFLOPS fp16 total)", style="cyan")
+    return bar
+
+  def _ring_table(self) -> Table:
+    table = Table(title="cluster ring", show_lines=False, expand=False)
+    table.add_column("#", justify="right")
+    table.add_column("node")
+    table.add_column("layers")
+    table.add_column("chip")
+    table.add_column("memory", justify="right")
+    table.add_column("fp16 TFLOPS", justify="right")
+    for i, partition in enumerate(self.partitions):
+      caps = self.topology.get_node(partition.node_id)
+      active = partition.node_id == self.topology.active_node_id
+      marker = "▶" if active else " "
+      style = "bold green" if partition.node_id == self.node_id else None
+      table.add_row(
+        f"{marker}{i}",
+        partition.node_id[:16],
+        f"[{partition.start:.2f}, {partition.end:.2f})",
+        caps.chip if caps else "?",
+        f"{caps.memory / 1024:.1f}GB" if caps else "?",
+        f"{caps.flops.fp16:.1f}" if caps else "?",
+        style=style,
+      )
+    return table
+
+  def _chat_panel(self) -> Panel:
+    lines = []
+    for request_id, prompt in self.prompts:
+      lines.append(Text(f"> {prompt[:120]}", style="bold"))
+      if request_id in self.responses:
+        lines.append(Text(self.responses[request_id][:400]))
+    return Panel(Group(*lines) if lines else Text("(no requests yet)", style="dim"), title="recent chat")
+
+  def _render(self):
+    parts = [self._gpu_bar(), self._ring_table(), self._chat_panel()]
+    if self.download_lines:
+      dl = Table(title="downloads", expand=False)
+      dl.add_column("node")
+      dl.add_column("progress")
+      for node_id, line in self.download_lines.items():
+        dl.add_row(node_id[:16], line)
+      parts.append(dl)
+    if self.chatgpt_api_port:
+      parts.append(Text(f"ChatGPT API: http://localhost:{self.chatgpt_api_port}/v1/chat/completions", style="cyan"))
+    return Group(*parts)
