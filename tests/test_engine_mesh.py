@@ -1,0 +1,35 @@
+"""Engine in-slice TP: sharded-over-mesh engine must match single-device."""
+
+import jax
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+
+@pytest.mark.asyncio
+async def test_engine_local_mesh_matches_single_device():
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(5), cfg, "m")
+  tokens = np.array([[3, 14, 15, 92]], dtype=np.int32)
+
+  with jax.default_matmul_precision("highest"):
+    plain = JaxShardedInferenceEngine(use_local_mesh=False)
+    plain.load_test_model(shard, cfg, params)
+    ref_logits, ref_state = await plain.infer_tensor("a", shard, tokens)
+
+    meshed = JaxShardedInferenceEngine(use_local_mesh=True)
+    meshed.load_test_model(shard, cfg, params)
+    meshed._maybe_shard_over_local_mesh()
+    assert meshed.mesh is not None and meshed.mesh.shape["tp"] == 4  # 4 q heads
+    mesh_logits, mesh_state = await meshed.infer_tensor("a", shard, tokens)
+
+    np.testing.assert_allclose(mesh_logits, ref_logits, rtol=2e-4, atol=2e-4)
+
+    # One decode step on both paths.
+    nxt = np.argmax(ref_logits, axis=-1).astype(np.int32).reshape(1, 1)
+    ref2, _ = await plain.infer_tensor("a", shard, nxt, ref_state)
+    mesh2, _ = await meshed.infer_tensor("a", shard, nxt, mesh_state)
+    np.testing.assert_allclose(mesh2, ref2, rtol=2e-4, atol=2e-4)

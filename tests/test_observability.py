@@ -1,0 +1,82 @@
+"""Tracing + metrics: live (unlike the reference's dead tracer, SURVEY §5.1)."""
+
+import asyncio
+
+import pytest
+
+from xotorch_support_jetson_tpu.orchestration.tracing import (
+  Tracer,
+  format_traceparent,
+  parse_traceparent,
+)
+from xotorch_support_jetson_tpu.utils.metrics import Metrics
+
+
+def test_traceparent_roundtrip():
+  tp = format_traceparent("a" * 32, "b" * 16)
+  assert parse_traceparent(tp) == ("a" * 32, "b" * 16)
+  assert parse_traceparent("garbage") is None
+  assert parse_traceparent(None) is None
+
+
+def test_span_lifecycle_and_token_groups():
+  tracer = Tracer()
+  ctx = tracer.request_context("req1")
+  with tracer.start_span("request.process_prompt", "req1", {"model": "m"}) as span:
+    assert span.trace_id == ctx.trace_id
+  for _ in range(25):
+    tracer.handle_token("req1")
+  spans = tracer.recent_spans()
+  names = [s["name"] for s in spans]
+  assert "request.process_prompt" in names
+  assert names.count("token_group") == 2  # groups of 10; 25 tokens → 2 full groups
+  group = [s for s in spans if s["name"] == "token_group"][0]
+  assert group["parent_id"] == ctx.request_span_id
+  tracer.end_request("req1")
+  assert "req1" not in tracer.contexts
+
+
+def test_remote_context_joins_trace():
+  tracer = Tracer()
+  remote_tp = format_traceparent("c" * 32, "d" * 16)
+  ctx = tracer.request_context("req2", remote_tp)
+  assert ctx.trace_id == "c" * 32
+  assert ctx.parent_id == "d" * 16
+
+
+def test_metrics_render():
+  m = Metrics()
+  m.inc("requests_total")
+  m.inc("requests_total", 2)
+  m.set_gauge("active_sessions", 3)
+  with m.timer("prefill"):
+    pass
+  text = m.render_prometheus()
+  assert "xot_tpu_requests_total 3.0" in text
+  assert "xot_tpu_active_sessions 3" in text
+  assert "xot_tpu_prefill_seconds_count 1" in text
+
+
+@pytest.mark.asyncio
+async def test_node_generates_spans_and_metrics():
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer as global_tracer
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as global_metrics
+  from tests_support_stubs import NoDiscovery, StubServer
+
+  node = Node("trace-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=30)
+  await node.start()
+  done = asyncio.Event()
+  node.on_token.register("t").on_next(lambda r, toks, fin: done.set() if fin else None)
+  before_tokens = global_metrics.counters["tokens_generated_total"]
+  await node.process_prompt(build_base_shard("dummy", "DummyInferenceEngine"), "aaaa", "trace-req")
+  await asyncio.wait_for(done.wait(), timeout=10)
+  await node.stop()
+
+  assert global_metrics.counters["tokens_generated_total"] > before_tokens
+  names = [s["name"] for s in global_tracer.recent_spans(500)]
+  assert "request.process_prompt" in names
+  assert "token_group" in names

@@ -1,0 +1,52 @@
+"""Flash-attention kernel correctness (interpret mode on CPU; the same kernel
+compiles for TPU via Mosaic — bench.py exercises that path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.ops.attention import gqa_attention
+from xotorch_support_jetson_tpu.ops.pallas_attention import BLOCK_K, BLOCK_Q, flash_attention_prefill, flash_supported
+
+
+def _make(B=2, Sq=256, Skv=256, Hq=4, Hkv=2, hd=64, seed=0):
+  ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+  q = jax.random.normal(ks[0], (B, Sq, Hq, hd), jnp.float32)
+  k = jax.random.normal(ks[1], (B, Skv, Hkv, hd), jnp.float32)
+  v = jax.random.normal(ks[2], (B, Skv, Hkv, hd), jnp.float32)
+  return q, k, v
+
+
+@pytest.mark.parametrize("Sq,Skv,offset", [(256, 256, 0), (128, 512, 0), (128, 384, 128)])
+def test_flash_matches_dense(Sq, Skv, offset):
+  q, k, v = _make(Sq=Sq, Skv=Skv)
+  q_pos = jnp.broadcast_to(offset + jnp.arange(Sq, dtype=jnp.int32), (q.shape[0], Sq))
+  kv_pos = jnp.arange(Skv, dtype=jnp.int32)
+  with jax.default_matmul_precision("highest"):
+    dense = gqa_attention(q, k, v, q_pos, kv_pos)
+    flash = flash_attention_prefill(q, k, v, q_offset=offset, interpret=True)
+  np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_masks_garbage_beyond_positions():
+  """Cache slots beyond the prompt hold junk; positional masking must hide it."""
+  q, k, v = _make(Sq=128, Skv=256)
+  # Poison slots >= 128 with huge values.
+  k = k.at[:, 128:].set(1e4)
+  v = v.at[:, 128:].set(1e4)
+  q_pos = jnp.broadcast_to(jnp.arange(128, dtype=jnp.int32), (2, 128))
+  with jax.default_matmul_precision("highest"):
+    dense = gqa_attention(q, k[:, :128], v[:, :128], q_pos, jnp.arange(128, dtype=jnp.int32))
+    flash = flash_attention_prefill(q, k, v, q_offset=0, interpret=True)
+  np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_supported_gating(monkeypatch):
+  assert not flash_supported((1, 100, 4, 64), 256, platform="tpu")  # Sq not blocked
+  assert not flash_supported((1, 128, 4, 63), 256, platform="tpu")  # odd head dim
+  assert not flash_supported((1, 128, 4, 64), 200, platform="tpu")  # kv not blocked
+  assert flash_supported((1, 128, 4, 64), 256, platform="tpu")
+  assert not flash_supported((1, 128, 4, 64), 256, platform="cpu")
+  monkeypatch.setenv("XOT_TPU_NO_FLASH", "1")
+  assert not flash_supported((1, 128, 4, 64), 256, platform="tpu")

@@ -144,6 +144,8 @@ class ChatGPTAPI:
     r.add_get("/initial_models", self.handle_get_initial_models)
     r.add_get("/modelpool", self.handle_model_support)
     r.add_get("/healthcheck", self.handle_healthcheck)
+    r.add_get("/metrics", self.handle_metrics)
+    r.add_get("/v1/traces", self.handle_traces)
     r.add_get("/v1/topology", self.handle_get_topology)
     r.add_get("/topology", self.handle_get_topology)
     r.add_get("/v1/download/progress", self.handle_get_download_progress)
@@ -192,6 +194,17 @@ class ChatGPTAPI:
 
   async def handle_healthcheck(self, request):
     return web.json_response({"status": "ok"})
+
+  async def handle_metrics(self, request):
+    from ..utils.metrics import metrics
+
+    return web.Response(text=metrics.render_prometheus(), content_type="text/plain")
+
+  async def handle_traces(self, request):
+    from ..orchestration.tracing import tracer
+
+    n = int(request.query.get("n", "100"))
+    return web.json_response({"spans": tracer.recent_spans(n)})
 
   async def handle_quit(self, request):
     response = web.json_response({"detail": "Quit signal received"}, status=200)

@@ -81,7 +81,14 @@ class _Session:
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
-  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0):
+  """In-slice parallel by default: when the host exposes multiple chips, the
+  engine shards its shard's params megatron-style over a local tp×dp mesh
+  (parallel/mesh.py) and jit/GSPMD inserts the ICI collectives. The cluster
+  ring (orchestration) and the in-slice mesh compose: each ring node runs its
+  layer range across all of its own chips.
+  """
+
+  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None):
     super().__init__()
     self.shard_downloader = shard_downloader
     self.shard: Shard | None = None
@@ -89,6 +96,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = None
     self.tokenizer = None
     self.max_seq_len = max_seq_len or DEFAULT_MAX_SEQ
+    self.use_local_mesh = use_local_mesh if use_local_mesh is not None else os.getenv("XOT_TPU_LOCAL_MESH", "1") == "1"
+    self.mesh = None
     self.sessions: dict[str, _Session] = {}
     # One worker thread serializes all device work off the asyncio loop —
     # same concurrency discipline as the reference engine (:46).
@@ -117,11 +126,30 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.params = load_shard_weights(model_dir, cfg, shard)
     self.cfg = cfg
     self.shard = shard
+    self._maybe_shard_over_local_mesh()
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
     self._model_dir = Path(model_dir)
     if DEBUG >= 1:
-      print(f"[jax_engine] loaded {shard} from {model_dir}")
+      print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
+
+  def _maybe_shard_over_local_mesh(self) -> None:
+    if not self.use_local_mesh or len(jax.devices()) <= 1:
+      return
+    from ..parallel.mesh import build_mesh, inference_plan, shard_params
+
+    plan = inference_plan(len(jax.devices()), n_heads=self.cfg.n_heads)
+    self.mesh = build_mesh(plan)
+    self.params = shard_params(self.params, self.mesh)
+
+  def _place_cache(self, cache):
+    if self.mesh is None:
+      return cache
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tp = "tp" if self.cfg.n_kv_heads % self.mesh.shape["tp"] == 0 else None
+    spec = NamedSharding(self.mesh, P(None, None, None, tp, None))
+    return jax.tree.map(lambda x: jax.device_put(x, spec), cache)
 
   async def _load_tokenizer(self, shard: Shard) -> None:
     from .. import registry
@@ -188,7 +216,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     session = self.sessions.get(request_id)
     if session is None:
       max_seq = min(self.max_seq_len, self.cfg.max_seq_len)
-      cache = init_kv_cache(self.cfg, shard.n_shard_layers, B, max_seq)
+      cache = self._place_cache(init_kv_cache(self.cfg, shard.n_shard_layers, B, max_seq))
       session = self.sessions[request_id] = _Session(cache, max_seq)
 
     prefilling = session.curr_pos == 0

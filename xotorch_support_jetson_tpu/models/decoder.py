@@ -148,7 +148,14 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
     start = positions[:, 0]
     k_cache = _write_cache(k_cache, k, start)
     v_cache = _write_cache(v_cache, v, start)
-    attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
+    from ..ops.pallas_attention import flash_attention_prefill, flash_supported
+
+    if S > 1 and flash_supported(q.shape, k_cache.shape[1]):
+      # Prefill on TPU: flash kernel against the full cache (stale slots
+      # beyond the prompt are positionally masked — slot index > position).
+      attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=0)
+    else:
+      attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
   else:
     attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
 

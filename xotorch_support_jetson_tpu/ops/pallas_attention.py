@@ -1,0 +1,110 @@
+"""Pallas flash-attention (prefill) kernel for TPU.
+
+Blockwise online-softmax attention: K/V stream through VMEM in BLOCK_K
+chunks while each grid step owns one (batch, q-head, q-block) tile — O(S)
+memory instead of materializing [Sq, Skv] scores in HBM, and the QK^T /
+PV matmuls stay on the MXU back-to-back.
+
+Causality is positional, consistent with ops/attention.py: query row i at
+absolute position ``q_offset + i`` attends KV slot j iff ``j <= pos``. GQA is
+handled in the index map (q head h reads kv head ``h // group``).
+
+Used by the decoder for prefill when shapes allow (models/decoder.py);
+``ops.attention.gqa_attention`` is the XLA fallback everywhere else
+(decode steps, CPU tests, odd shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, q_offset_static: int):
+  import jax.experimental.pallas as pl
+
+  qi = pl.program_id(2)
+  q = q_ref[0, 0].astype(jnp.float32)  # [BQ, hd]
+  bq = q.shape[0]
+  skv = k_ref.shape[2]
+  n_kv_blocks = pl.cdiv(skv, block_k)
+
+  q_pos = q_offset_static + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ,1]
+
+  def body(kb, carry):
+    m, l, acc = carry
+    k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)  # [BK, hd]
+    v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    scores = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+    kv_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)  # [1,BK]
+    mask = kv_pos <= q_pos
+    scores = jnp.where(mask, scores, NEG_INF)
+    blk_m = jnp.max(scores, axis=1, keepdims=True)  # [BQ,1]
+    new_m = jnp.maximum(m, blk_m)
+    p = jnp.exp(scores - new_m)
+    p = jnp.where(new_m <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m - new_m)
+    l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc = acc * alpha + jax.lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return new_m, l, acc
+
+  hd = q.shape[1]
+  m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+  l0 = jnp.zeros((bq, 1), jnp.float32)
+  acc0 = jnp.zeros((bq, hd), jnp.float32)
+  m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
+  l = jnp.where(l == 0.0, 1.0, l)
+  o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_offset", "interpret"))
+def flash_attention_prefill(q, k, v, q_offset: int = 0, interpret: bool = False):
+  """q [B,Sq,Hq,hd], k/v [B,Skv,Hkv,hd] → [B,Sq,Hq,hd].
+
+  Requires Sq % BLOCK_Q == 0 and Skv % BLOCK_K == 0 (callers pad; the
+  positional mask keeps padded KV slots (slot index > pos) inert as long as
+  they hold finite values).
+  """
+  import jax.experimental.pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  B, Sq, Hq, hd = q.shape
+  Skv, Hkv = k.shape[1], k.shape[2]
+  group = Hq // Hkv
+  scale = float(1.0 / (hd**0.5))
+
+  # Layout: [B, H, S, hd] so the S×hd tile is contiguous per (b, h).
+  qt = jnp.moveaxis(q, 2, 1)  # [B, Hq, Sq, hd]
+  kt = jnp.moveaxis(k, 2, 1)
+  vt = jnp.moveaxis(v, 2, 1)
+
+  grid = (B, Hq, Sq // BLOCK_Q)
+  kernel = functools.partial(_flash_kernel, block_k=BLOCK_K, scale=scale, q_offset_static=q_offset)
+  out = pl.pallas_call(
+    kernel,
+    out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
+    grid=grid,
+    in_specs=[
+      pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
+      pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
+      pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
+    ],
+    out_specs=pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
+    interpret=interpret,
+  )(qt, kt, vt)
+  return jnp.moveaxis(out, 1, 2)  # [B, Sq, Hq, hd]
+
+
+def flash_supported(q_shape, kv_len: int, platform: str | None = None) -> bool:
+  if os.getenv("XOT_TPU_NO_FLASH"):
+    return False
+  platform = platform or jax.default_backend()
+  B, Sq, Hq, hd = q_shape
+  return platform == "tpu" and Sq % BLOCK_Q == 0 and kv_len % BLOCK_K == 0 and hd in (64, 128, 256)

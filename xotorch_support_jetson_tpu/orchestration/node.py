@@ -34,7 +34,9 @@ from ..topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_c
 from ..topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from ..topology.topology import Topology
 from ..utils.helpers import DEBUG, AsyncCallbackSystem
+from ..utils.metrics import metrics
 from .. import registry
+from .tracing import tracer
 
 
 class Node:
@@ -106,6 +108,8 @@ class Node:
     if request_id is None:
       request_id = str(uuid.uuid4())
     start_time = time.perf_counter_ns()
+    ctx = tracer.request_context(request_id)
+    metrics.inc("requests_total")
     asyncio.create_task(
       self.broadcast_opaque_status(
         request_id,
@@ -118,11 +122,13 @@ class Node:
             "shard": shard.to_dict(),
             "prompt": prompt,
             "request_id": request_id,
+            "traceparent": ctx.traceparent(),
           }
         ),
       )
     )
-    result = await self._process_prompt(base_shard, prompt, request_id, inference_state)
+    with tracer.start_span("request.process_prompt", request_id, {"node_id": self.id, "model": base_shard.model_id}):
+      result = await self._process_prompt(base_shard, prompt, request_id, inference_state)
     elapsed_ns = time.perf_counter_ns() - start_time
     asyncio.create_task(
       self.broadcast_opaque_status(
@@ -175,6 +181,8 @@ class Node:
       token = await self.inference_engine.sample(result, temp=self.default_sample_temp, top_k=self.default_sample_top_k)
       token_int = int(np.asarray(token).reshape(-1)[0])
       tokens.append(token_int)
+      tracer.handle_token(request_id)
+      metrics.inc("tokens_generated_total")
 
       is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state)
       self.buffered_token_output[request_id] = (tokens, is_finished)
@@ -183,6 +191,7 @@ class Node:
 
       if is_finished:
         self.outstanding_requests.pop(request_id, None)
+        tracer.end_request(request_id)
         if hasattr(self.inference_engine, "end_request"):
           self.inference_engine.end_request(request_id)
         return
@@ -412,6 +421,9 @@ class Node:
       status_data = json.loads(opaque_status)
       status_type = status_data.get("type", "")
       if status_type == "node_status":
+        # Join the originating node's trace (W3C traceparent propagation).
+        if status_data.get("traceparent") and status_data.get("request_id"):
+          tracer.request_context(status_data["request_id"], status_data["traceparent"])
         if status_data.get("status", "").startswith("start_"):
           self.topology.active_node_id = status_data.get("node_id")
         elif status_data.get("status", "").startswith("end_"):

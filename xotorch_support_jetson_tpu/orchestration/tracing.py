@@ -1,0 +1,174 @@
+"""Request tracing with cross-node propagation — live, not vestigial.
+
+The reference ships a full OpenTelemetry tracer that nothing imports and no
+proto field carries (``orchestration/tracing.py`` — dead code, SURVEY.md §5.1).
+This one is wired in: ``Node.process_prompt`` opens a request span,
+per-token-group spans (every 10 tokens) record decode cadence, and the W3C
+``traceparent`` rides the opaque-status JSON so multi-node rings stitch into
+one trace. Self-contained (no otel dependency); export is an in-memory ring
+buffer + optional JSONL file (``XOT_TPU_TRACE_FILE``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+  trace_id: str
+  span_id: str
+  parent_id: str | None
+  name: str
+  start_ns: int
+  end_ns: int | None = None
+  attributes: dict = field(default_factory=dict)
+
+  @property
+  def duration_ms(self) -> float | None:
+    return None if self.end_ns is None else (self.end_ns - self.start_ns) / 1e6
+
+  def to_dict(self) -> dict:
+    return {
+      "trace_id": self.trace_id,
+      "span_id": self.span_id,
+      "parent_id": self.parent_id,
+      "name": self.name,
+      "start_ns": self.start_ns,
+      "end_ns": self.end_ns,
+      "duration_ms": self.duration_ms,
+      "attributes": self.attributes,
+    }
+
+
+def new_trace_id() -> str:
+  return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+  return secrets.token_hex(8)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+  return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+  if not header:
+    return None
+  parts = header.split("-")
+  if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+    return None
+  return parts[1], parts[2]
+
+
+class TraceContext:
+  """Per-request trace state: ids + token-group bookkeeping."""
+
+  def __init__(self, trace_id: str, parent_id: str | None = None, group_size: int = 10) -> None:
+    self.trace_id = trace_id
+    self.parent_id = parent_id
+    self.request_span_id: str | None = None
+    self.group_size = group_size
+    self.token_count = 0
+    self._group_start_ns: int | None = None
+
+  def traceparent(self) -> str:
+    return format_traceparent(self.trace_id, self.request_span_id or new_span_id())
+
+
+class Tracer:
+  def __init__(self, max_spans: int = 4096) -> None:
+    self.spans: deque[Span] = deque(maxlen=max_spans)
+    self.contexts: dict[str, TraceContext] = {}
+    self._lock = threading.Lock()
+    self._export_path = os.getenv("XOT_TPU_TRACE_FILE")
+
+  # -------------------------------------------------------------- contexts
+
+  def request_context(self, request_id: str, traceparent: str | None = None) -> TraceContext:
+    with self._lock:
+      ctx = self.contexts.get(request_id)
+      if ctx is None:
+        parsed = parse_traceparent(traceparent)
+        if parsed:
+          ctx = TraceContext(parsed[0], parent_id=parsed[1])
+        else:
+          ctx = TraceContext(new_trace_id())
+        self.contexts[request_id] = ctx
+      return ctx
+
+  def end_request(self, request_id: str) -> None:
+    with self._lock:
+      self.contexts.pop(request_id, None)
+
+  # ----------------------------------------------------------------- spans
+
+  @contextmanager
+  def start_span(self, name: str, request_id: str | None = None, attributes: dict | None = None):
+    ctx = self.request_context(request_id) if request_id else None
+    span = Span(
+      trace_id=ctx.trace_id if ctx else new_trace_id(),
+      span_id=new_span_id(),
+      parent_id=(ctx.request_span_id or ctx.parent_id) if ctx else None,
+      name=name,
+      start_ns=time.perf_counter_ns(),
+      attributes=dict(attributes or {}),
+    )
+    if ctx and ctx.request_span_id is None and name.startswith("request"):
+      ctx.request_span_id = span.span_id
+    try:
+      yield span
+    finally:
+      span.end_ns = time.perf_counter_ns()
+      self._record(span)
+
+  def handle_token(self, request_id: str) -> None:
+    """Count a token; emit a token-group span every ``group_size`` tokens."""
+    with self._lock:
+      ctx = self.contexts.get(request_id)
+      if ctx is None:
+        return
+      now = time.perf_counter_ns()
+      if ctx._group_start_ns is None:
+        ctx._group_start_ns = now
+      ctx.token_count += 1
+      if ctx.token_count % ctx.group_size == 0:
+        span = Span(
+          trace_id=ctx.trace_id,
+          span_id=new_span_id(),
+          parent_id=ctx.request_span_id,
+          name="token_group",
+          start_ns=ctx._group_start_ns,
+          end_ns=now,
+          attributes={"n_tokens": ctx.group_size, "total_tokens": ctx.token_count},
+        )
+        ctx._group_start_ns = now
+        self._record_locked(span)
+
+  def _record(self, span: Span) -> None:
+    with self._lock:
+      self._record_locked(span)
+
+  def _record_locked(self, span: Span) -> None:
+    self.spans.append(span)
+    if self._export_path:
+      try:
+        with open(self._export_path, "a") as f:
+          f.write(json.dumps(span.to_dict()) + "\n")
+      except OSError:
+        pass
+
+  def recent_spans(self, n: int = 100) -> list[dict]:
+    with self._lock:
+      return [s.to_dict() for s in list(self.spans)[-n:]]
+
+
+tracer = Tracer()

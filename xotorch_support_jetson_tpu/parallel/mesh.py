@@ -70,6 +70,18 @@ def auto_plan(n_devices: int | None = None, n_kv_heads: int | None = None) -> Me
   return MeshPlan(dp=dp, tp=tp)
 
 
+def inference_plan(n_devices: int | None = None, n_heads: int | None = None) -> MeshPlan:
+  """Serving plan for one request stream: pure TP (batch is tiny, so DP
+  would idle). TP caps at the q-head count; GSPMD replicates GQA KV heads
+  when tp exceeds them."""
+  n = n_devices if n_devices is not None else len(jax.devices())
+  tp = 1
+  limit = n_heads or n
+  while tp * 2 <= min(n, limit):
+    tp *= 2
+  return MeshPlan(tp=tp)
+
+
 # ---------------------------------------------------------------- shardings
 
 

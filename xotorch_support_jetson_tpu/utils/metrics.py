@@ -1,0 +1,66 @@
+"""Minimal metrics registry with Prometheus text exposition.
+
+The reference pins prometheus-client and never imports it (SURVEY.md §5.5);
+here a dependency-free registry backs the API's ``/metrics`` endpoint:
+request counts, token throughput, per-request latency summaries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Metrics:
+  def __init__(self) -> None:
+    self._lock = threading.Lock()
+    self.counters: dict[str, float] = defaultdict(float)
+    self.gauges: dict[str, float] = {}
+    self._latency_sum: dict[str, float] = defaultdict(float)
+    self._latency_count: dict[str, int] = defaultdict(int)
+
+  def inc(self, name: str, value: float = 1.0) -> None:
+    with self._lock:
+      self.counters[name] += value
+
+  def set_gauge(self, name: str, value: float) -> None:
+    with self._lock:
+      self.gauges[name] = value
+
+  def observe_latency(self, name: str, seconds: float) -> None:
+    with self._lock:
+      self._latency_sum[name] += seconds
+      self._latency_count[name] += 1
+
+  def timer(self, name: str):
+    metrics = self
+
+    class _Timer:
+      def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+      def __exit__(self, *exc):
+        metrics.observe_latency(name, time.perf_counter() - self.t0)
+        return False
+
+    return _Timer()
+
+  def render_prometheus(self) -> str:
+    lines: list[str] = []
+    with self._lock:
+      for name, value in sorted(self.counters.items()):
+        lines.append(f"# TYPE xot_tpu_{name} counter")
+        lines.append(f"xot_tpu_{name} {value}")
+      for name, value in sorted(self.gauges.items()):
+        lines.append(f"# TYPE xot_tpu_{name} gauge")
+        lines.append(f"xot_tpu_{name} {value}")
+      for name in sorted(self._latency_sum):
+        lines.append(f"# TYPE xot_tpu_{name}_seconds summary")
+        lines.append(f"xot_tpu_{name}_seconds_sum {self._latency_sum[name]}")
+        lines.append(f"xot_tpu_{name}_seconds_count {self._latency_count[name]}")
+    return "\n".join(lines) + "\n"
+
+
+metrics = Metrics()
