@@ -373,15 +373,19 @@ class ChatGPTAPI:
     await response.prepare(request)
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
-    n_emitted = 0
+    # Incremental detokenization: decode the full token list each time and
+    # emit the text suffix — per-token decode drops BPE leading spaces.
+    all_tokens: list[int] = []
+    emitted_text = ""
     try:
       while True:
         tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
-        emit = [t for t in tokens if t not in eos_set]
-        n_emitted += len(tokens)
-        if emit:
-          content = tokenizer.decode(emit)
-          chunk = completion_chunk(request_id, chat_request.model, created, content, None)
+        all_tokens.extend(t for t in tokens if t not in eos_set)
+        full_text = tokenizer.decode(all_tokens) if all_tokens else ""
+        delta = full_text[len(emitted_text):]
+        if delta:
+          emitted_text = full_text
+          chunk = completion_chunk(request_id, chat_request.model, created, delta, None)
           await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
         if is_finished:
           finish = self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)

@@ -123,9 +123,18 @@ class JaxShardedInferenceEngine(InferenceEngine):
     from ..models.loader import load_shard_weights
 
     cfg = load_model_config(model_dir)
-    self.params = load_shard_weights(model_dir, cfg, shard)
+    # Registry layer counts can disagree with an arbitrary local checkpoint
+    # (XOT_TPU_MODEL_DIR override): remap the shard's layer fractions onto the
+    # checkpoint's real depth.
+    eff = shard
+    if cfg.n_layers != shard.n_layers:
+      start = round(shard.start_layer * cfg.n_layers / shard.n_layers)
+      end = round((shard.end_layer + 1) * cfg.n_layers / shard.n_layers) - 1
+      eff = Shard(shard.model_id, start, max(start, end), cfg.n_layers)
+    self.params = load_shard_weights(model_dir, cfg, eff)
     self.cfg = cfg
     self.shard = shard
+    self._effective_shard = eff
     self._maybe_shard_over_local_mesh()
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
@@ -162,6 +171,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
   def load_test_model(self, shard: Shard, cfg, params, tokenizer=None) -> None:
     """Directly inject a model (unit tests / local pipeline composition)."""
     self.shard = shard
+    self._effective_shard = shard
     self.cfg = cfg
     self.params = params
     self.tokenizer = tokenizer
@@ -208,6 +218,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     )
 
   def _infer_tensor_sync(self, request_id, shard, input_data, state):
+    shard = getattr(self, "_effective_shard", shard)
     state = state or InferenceState()
     x = np.asarray(input_data)
     is_tokens = x.ndim == 2 and np.issubdtype(x.dtype, np.integer)

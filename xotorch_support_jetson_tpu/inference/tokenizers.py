@@ -51,7 +51,13 @@ def _load_tokenizer(source: str):
 
 
 async def resolve_tokenizer(repo_id: str, local_dir: str | Path | None = None):
-  """Resolve from ``local_dir`` if it holds tokenizer files, else from the hub."""
+  """Resolve from ``local_dir`` if it holds tokenizer files, else from the hub.
+
+  ``XOT_TPU_MODEL_DIR`` (the offline checkpoint override, download/downloader.py)
+  doubles as the default local dir.
+  """
+  if local_dir is None and (env_dir := os.getenv("XOT_TPU_MODEL_DIR")):
+    local_dir = env_dir
   key = str(local_dir or repo_id)
   if (tok := _cache.get(key)) is not None:
     return tok
