@@ -1,0 +1,122 @@
+"""UDP discovery over real loopback sockets (reference test strategy:
+``networking/udp/test_udp_discovery.py`` — crossed listen/broadcast ports,
+real gRPC servers, mocked compute)."""
+
+import asyncio
+
+import pytest
+
+from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+from xotorch_support_jetson_tpu.networking.udp.udp_discovery import UDPDiscovery
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+from tests_support_stubs import NoDiscovery, StubServer
+
+CAPS = DeviceCapabilities(model="test", chip="cpu", memory=2048, flops=DeviceFlops(1, 2, 4))
+
+
+async def _grpc_backed_node(port):
+  node = Node("udp-target", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  # Bind all interfaces: the UDP beacon's source address is the host's
+  # outbound interface, and the adopting side health-checks that address.
+  server = GRPCServer(node, "0.0.0.0", port)
+  node.server = server
+  await node.start()
+  return node
+
+
+@pytest.mark.asyncio
+async def test_udp_discovery_two_instances_discover_each_other():
+  # Crossed ports: A broadcasts on B's listen port and vice versa.
+  port_a, port_b = find_available_port(), find_available_port()
+  grpc_a, grpc_b = find_available_port("127.0.0.1"), find_available_port("127.0.0.1")
+  node_b = await _grpc_backed_node(grpc_b)
+
+  seen = {}
+
+  def make_handle(pid, addr, desc, caps):
+    handle = GRPCPeerHandle(pid, addr, desc, caps)
+    seen[pid] = addr
+    return handle
+
+  disc_a = UDPDiscovery("node-a", grpc_a, listen_port=port_a, broadcast_port=port_b, create_peer_handle=make_handle, broadcast_interval=0.2, device_capabilities=CAPS)
+  disc_b = UDPDiscovery("node-b", grpc_b, listen_port=port_b, broadcast_port=port_a, create_peer_handle=lambda *a: GRPCPeerHandle(*a), broadcast_interval=0.2, device_capabilities=CAPS)
+  # a listens where b broadcasts: a should adopt b (health-checked via b's real gRPC).
+  await disc_b.start()
+  await disc_a.start()
+  try:
+    peers = []
+    for _ in range(100):
+      peers = await disc_a.discover_peers()
+      if peers:
+        break
+      await asyncio.sleep(0.1)
+    assert peers and peers[0].id() == "node-b"
+    assert peers[0].device_capabilities().memory == 2048
+  finally:
+    await disc_a.stop()
+    await disc_b.stop()
+    await node_b.stop()
+
+
+@pytest.mark.asyncio
+async def test_udp_discovery_evicts_dead_peer(monkeypatch):
+  import xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle as gph
+
+  monkeypatch.setattr(gph, "CONNECT_TIMEOUT", 1.0)
+  monkeypatch.setattr(gph, "HEALTH_TIMEOUT", 1.0)
+  port_a, port_b = find_available_port(), find_available_port()
+  grpc_b = find_available_port("127.0.0.1")
+  node_b = await _grpc_backed_node(grpc_b)
+  disc_a = UDPDiscovery(
+    "node-a", 1, listen_port=port_a, broadcast_port=port_b,
+    create_peer_handle=lambda *a: GRPCPeerHandle(*a),
+    broadcast_interval=0.2, discovery_timeout=600, device_capabilities=CAPS,
+  )
+  disc_b = UDPDiscovery("node-b", grpc_b, listen_port=port_b, broadcast_port=port_a, create_peer_handle=lambda *a: GRPCPeerHandle(*a), broadcast_interval=0.2, device_capabilities=CAPS)
+  await disc_b.start()
+  await disc_a.start()
+  try:
+    for _ in range(100):
+      if await disc_a.discover_peers():
+        break
+      await asyncio.sleep(0.1)
+    assert await disc_a.discover_peers()
+
+    # Kill node-b's gRPC server AND its beacons: health checks fail → eviction.
+    await disc_b.stop()
+    await node_b.stop()
+    node_b = None
+    for _ in range(100):
+      if not await disc_a.discover_peers():
+        break
+      await asyncio.sleep(0.1)
+    assert await disc_a.discover_peers() == []
+  finally:
+    await disc_a.stop()
+    if node_b is not None:
+      await node_b.stop()
+
+
+@pytest.mark.asyncio
+async def test_udp_discovery_filters_disallowed_node_ids():
+  port_a, port_b = find_available_port(), find_available_port()
+  disc_a = UDPDiscovery(
+    "node-a", 1, listen_port=port_a, broadcast_port=port_b,
+    create_peer_handle=lambda *a: GRPCPeerHandle(*a),
+    broadcast_interval=0.2, device_capabilities=CAPS,
+    allowed_node_ids=["only-this-one"],
+  )
+  disc_b = UDPDiscovery("node-b", 2, listen_port=port_b, broadcast_port=port_a, create_peer_handle=lambda *a: GRPCPeerHandle(*a), broadcast_interval=0.2, device_capabilities=CAPS)
+  await disc_b.start()
+  await disc_a.start()
+  try:
+    await asyncio.sleep(1.0)
+    assert await disc_a.discover_peers() == []
+  finally:
+    await disc_a.stop()
+    await disc_b.stop()

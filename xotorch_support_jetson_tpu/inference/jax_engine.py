@@ -71,13 +71,14 @@ def _decode_step(params, cfg, shard, x, kv_cache, pos):
 
 
 class _Session:
-  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq")
+  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev")
 
   def __init__(self, kv_cache, max_seq: int) -> None:
     self.kv_cache = kv_cache
     self.curr_pos = 0
     self.prompt_len = 0
     self.max_seq = max_seq
+    self.next_token_dev = None  # [B,1] device array chaining fused chunks
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -260,6 +261,55 @@ class JaxShardedInferenceEngine(InferenceEngine):
     state.curr_pos = session.curr_pos
     out_np = np.asarray(out)
     return out_np, state
+
+  async def generate_chunk(self, request_id: str, shard: Shard, last_token: int, n_steps: int, temp: float = 0.6, top_k: int = 35) -> list[int]:
+    """Generate ``n_steps`` tokens in one compiled program (fused lax.scan)."""
+    handle = await self.dispatch_chunk(request_id, shard, n_steps, temp, top_k, first_token=last_token)
+    return await self.read_chunk(handle)
+
+  async def dispatch_chunk(self, request_id: str, shard: Shard, n_steps: int, temp: float = 0.6, top_k: int = 35, first_token: int | None = None):
+    """Enqueue one fused decode chunk; returns a device handle immediately.
+
+    The chunk's input token is either ``first_token`` (host int, first chunk
+    after prefill) or the previous chunk's last token, which stays ON DEVICE
+    (``session.next_token_dev``) — so the Node can dispatch chunk N+1 before
+    reading chunk N and hide the host/tunnel round-trip behind compute.
+    Returns None if the KV cache is exhausted.
+    """
+    await self.ensure_shard(shard)
+    return await asyncio.get_event_loop().run_in_executor(
+      self.executor, self._dispatch_chunk_sync, request_id, shard, n_steps, temp, top_k, first_token
+    )
+
+  def _dispatch_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
+    from ..models.decoder import fused_decode
+
+    shard = getattr(self, "_effective_shard", shard)
+    session = self.sessions[request_id]
+    n_steps = min(n_steps, session.max_seq - session.curr_pos)
+    if n_steps <= 0:
+      return None
+    B = session.kv_cache["k"].shape[1]
+    if first_token is not None:
+      token = jnp.full((B, 1), int(first_token), dtype=jnp.int32)
+    else:
+      token = session.next_token_dev
+      if token is None:
+        raise RuntimeError(f"no chained token for request {request_id}; pass first_token after prefill")
+    start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
+    self._key, sub = jax.random.split(self._key)
+    toks, session.kv_cache = fused_decode(
+      self.params, self.cfg, shard, token, session.kv_cache, start_pos, n_steps,
+      temp=float(temp), top_k=int(top_k), key=sub,
+    )
+    session.next_token_dev = toks[:, -1:]
+    session.curr_pos += n_steps
+    return toks
+
+  async def read_chunk(self, handle) -> list[int]:
+    if handle is None:
+      return []
+    return await asyncio.get_event_loop().run_in_executor(self.executor, lambda: [int(t) for t in np.asarray(handle)[0]])
 
   async def clear_session(self) -> None:
     self.sessions.clear()
