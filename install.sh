@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+set -euo pipefail
+cd "$(dirname "$0")"
+python -m venv .venv 2>/dev/null || true
+source .venv/bin/activate
+pip install -e .
+echo "installed. run: xot-tpu"

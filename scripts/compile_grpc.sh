@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Regenerate protobuf message bindings (service methods are registered at
+# runtime via grpc generic handlers — see networking/grpc/grpc_server.py).
+set -euo pipefail
+cd "$(dirname "$0")/../xotorch_support_jetson_tpu/networking/grpc"
+protoc --python_out=. -I. node_service.proto
+echo "regenerated node_service_pb2.py"

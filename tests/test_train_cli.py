@@ -1,0 +1,100 @@
+"""`xot-tpu train` / `eval` end-to-end through the Node + driver, offline,
+against a real tiny checkpoint — the flow the reference shipped broken
+(SURVEY.md §3.4)."""
+
+import argparse
+import json
+
+import pytest
+
+from tests_support_stubs import NoDiscovery, StubServer
+from test_e2e_serving import tiny_model_dir  # noqa: F401 — shared fixture
+
+
+def _args(model_dir, data_dir, **over):
+  ns = argparse.Namespace(
+    model_name="llama-3.2-1b",
+    default_model="llama-3.2-1b",
+    data=str(data_dir),
+    iters=3,
+    batch_size=2,
+    seq_len=32,
+    lr=1e-3,
+    lora_rank=0,
+    save_every=0,
+    save_checkpoint_dir=str(model_dir / "ckpts"),
+    resume_checkpoint=None,
+  )
+  for k, v in over.items():
+    setattr(ns, k, v)
+  return ns
+
+
+def _write_data(tmp_path):
+  data = tmp_path / "data"
+  data.mkdir(exist_ok=True)
+  rows = [{"text": "hello world how are you today"}, {"text": "the quick brown fox jumps"}, {"text": "tell me a story about tpus"}, {"text": "what is your name friend"}]
+  for name in ("train", "valid", "test"):
+    with open(data / f"{name}.jsonl", "w") as f:
+      for r in rows:
+        f.write(json.dumps(r) + "\n")
+  return data
+
+
+@pytest.fixture()
+def train_node(tiny_model_dir, monkeypatch):  # noqa: F811
+  monkeypatch.setenv("XOT_TPU_MODEL_DIR", str(tiny_model_dir))
+  from xotorch_support_jetson_tpu.download.downloader import HFShardDownloader
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  engine = JaxShardedInferenceEngine(HFShardDownloader(), use_local_mesh=False)
+  return Node("train-node", StubServer(), engine, NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+
+
+@pytest.mark.asyncio
+async def test_train_cli_end_to_end(train_node, tiny_model_dir, tmp_path, capsys):  # noqa: F811
+  await train_node.start()
+  try:
+    from xotorch_support_jetson_tpu.train.driver import run_training
+
+    data = _write_data(tmp_path)
+    args = _args(tiny_model_dir, data, save_every=2, save_checkpoint_dir=str(tmp_path / "ckpts"))
+    await run_training(train_node, "JaxShardedInferenceEngine", args)
+    out = capsys.readouterr().out
+    assert "iter 1/3" in out and "validation loss" in out
+    # coordinate_save wrote a checkpoint for the full shard at iter 2.
+    ckpts = list((tmp_path / "ckpts").rglob("*"))
+    assert any("0-15-2" in p.name for p in ckpts), ckpts  # {start}-{end}-{iteration}
+  finally:
+    await train_node.stop()
+
+
+@pytest.mark.asyncio
+async def test_eval_cli_end_to_end(train_node, tiny_model_dir, tmp_path, capsys):  # noqa: F811
+  await train_node.start()
+  try:
+    from xotorch_support_jetson_tpu.train.driver import run_eval
+
+    data = _write_data(tmp_path)
+    await run_eval(train_node, "JaxShardedInferenceEngine", _args(tiny_model_dir, data))
+    out = capsys.readouterr().out
+    assert "test loss" in out and "ppl" in out
+  finally:
+    await train_node.stop()
+
+
+@pytest.mark.asyncio
+async def test_train_cli_lora(train_node, tiny_model_dir, tmp_path, capsys):  # noqa: F811
+  await train_node.start()
+  try:
+    from xotorch_support_jetson_tpu.train.driver import run_training
+
+    data = _write_data(tmp_path)
+    await run_training(train_node, "JaxShardedInferenceEngine", _args(tiny_model_dir, data, lora_rank=4))
+    assert "wq_lora_a" in train_node.inference_engine.params["layers"]
+    out = capsys.readouterr().out
+    assert "validation loss" in out
+  finally:
+    await train_node.stop()

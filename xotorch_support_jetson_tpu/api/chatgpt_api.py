@@ -151,6 +151,7 @@ class ChatGPTAPI:
     r.add_get("/v1/download/progress", self.handle_get_download_progress)
     r.add_post("/download", self.handle_post_download)
     r.add_delete("/models/{model_name}", self.handle_delete_model)
+    r.add_post("/v1/image/generations", self.handle_image_generations)
     r.add_post("/quit", self.handle_quit)
 
     static_dir = Path(__file__).parent.parent / "tinychat"
@@ -279,6 +280,12 @@ class ChatGPTAPI:
     if await delete_model(model_name, self.inference_engine_classname):
       return web.json_response({"status": f"Model {model_name} deleted"})
     return web.json_response({"detail": f"Model {model_name} not found"}, status=404)
+
+  async def handle_image_generations(self, request):
+    # Endpoint surface parity with the reference's stable-diffusion path
+    # (chatgpt_api.py:445-535); diffusion models are not in the registry
+    # (the reference ships the entry commented out too, models.py:168-169).
+    return web.json_response({"detail": "image generation models are not supported by this engine"}, status=501)
 
   async def handle_post_chat_token_encode(self, request):
     data = await request.json()

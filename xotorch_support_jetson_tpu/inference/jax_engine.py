@@ -314,6 +314,22 @@ class JaxShardedInferenceEngine(InferenceEngine):
   async def clear_session(self) -> None:
     self.sessions.clear()
 
+  async def clear_model(self) -> None:
+    """Drop the loaded model and all sessions, freeing HBM.
+
+    Role of the reference's OOM-recovery ``clear_model``
+    (``sharded_inference_engine.py:85-106``) — but here it's an explicit
+    management operation (model-switch, DELETE /models), not a crash handler:
+    HBM is budgeted ahead of time by the static cache allocation.
+    """
+    self.params = None
+    self.shard = None
+    self._effective_shard = None
+    self.cfg = None
+    self.tokenizer = None
+    self.mesh = None
+    self.sessions.clear()
+
   def end_request(self, request_id: str) -> None:
     self.sessions.pop(request_id, None)
 

@@ -215,7 +215,9 @@ def shard_forward(
     w_out = params.get("lm_head")
     if w_out is None:
       w_out = params["embed"].T  # tied embeddings, single-params case
-    logits = (h.astype(jnp.float32) @ w_out.astype(jnp.float32))
+    # Keep operands in model dtype on the MXU; accumulate fp32. (Casting the
+    # [D,V] head to fp32 would double its HBM traffic on every decode step.)
+    logits = jax.lax.dot_general(h, w_out.astype(h.dtype), (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     return logits, new_cache
   return h, new_cache
 
