@@ -18,6 +18,11 @@ import json
 import time
 
 import jax
+
+from xotorch_support_jetson_tpu.utils.helpers import apply_platform_override
+
+apply_platform_override()
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -88,23 +93,21 @@ def main() -> None:
   dt = time.perf_counter() - t0
   tok_per_s = n_decode * B / dt
 
-  # Serving cadence: pipelined chunk-of-8 fused decode (the Node fast path —
-  # the next chunk's input token chains on-device, so the host readback of
-  # chunk N overlaps chunk N+1's compute).
-  chunk = 32
+  # Serving cadence: the Node's non-streaming fast path — fused_generate
+  # (while_loop w/ on-device EOS) generates the whole response in ONE
+  # dispatch + ONE host readback. On a tunneled chip a readback costs ~67 ms
+  # and cannot overlap compute, so per-chunk readbacks are what kill serving
+  # throughput; this measures the amortized-to-one path end-to-end.
+  from xotorch_support_jetson_tpu.models.decoder import fused_generate
+
   pos = int(np.asarray(start_pos2)[0]) + n_decode
-  prev, cache = fused_decode(params, cfg, shard, first_tok, cache, jnp.full((B,), pos, jnp.int32), chunk)
-  jax.block_until_ready(prev)  # warm the chunk-8 program
-  pos += chunk
-  n_chunks = max((n_decode // chunk) - 1, 1)
+  buf, n_run, cache = fused_generate(params, cfg, shard, first_tok, cache, jnp.full((B,), pos, jnp.int32), n_decode, eos_ids=(-1,))
+  _ = np.asarray(buf)  # warm compile + readback path
+  pos += n_decode  # eos id -1 never fires, so all n_decode steps ran
   t0 = time.perf_counter()
-  for _ in range(n_chunks):
-    nxt, cache = fused_decode(params, cfg, shard, prev[:, -1:], cache, jnp.full((B,), pos, jnp.int32), chunk)
-    _ = np.asarray(prev)  # read chunk N while N+1 computes
-    prev = nxt
-    pos += chunk
-  _ = np.asarray(prev)
-  serving_tok_s = n_chunks * chunk * B / (time.perf_counter() - t0)
+  buf, n_run, cache = fused_generate(params, cfg, shard, first_tok, cache, jnp.full((B,), pos, jnp.int32), n_decode, eos_ids=(-1,))
+  _ = np.asarray(buf)  # single readback; count inferred host-side in the engine
+  serving_tok_s = n_decode * B / (time.perf_counter() - t0)
 
   vs_baseline = None
   try:  # compare to the previous round's recorded value if the driver left one

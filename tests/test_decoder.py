@@ -113,3 +113,34 @@ def test_llama3_rope_scaling_changes_freqs():
   # Low frequencies must be divided by the factor; highest kept.
   np.testing.assert_allclose(np.asarray(f1[-1]), np.asarray(f0[-1] / 8.0), rtol=1e-5)
   np.testing.assert_allclose(np.asarray(f1[0]), np.asarray(f0[0]), rtol=1e-5)
+
+
+def test_fused_generate_matches_fused_decode_and_stops_at_eos():
+  """fused_generate (while_loop, on-device EOS) == fused_decode prefix; the
+  loop must exit at the first EOS instead of running all max_steps."""
+  from xotorch_support_jetson_tpu.models.decoder import fused_decode, fused_generate
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, "m")
+  B, n = 1, 12
+  token = jnp.array([[5]], dtype=jnp.int32)
+  start = jnp.zeros((B,), dtype=jnp.int32)
+
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, 64)
+  ref_toks, _ = fused_decode(params, cfg, shard, token, cache, start, n, temp=0.0)
+  ref = np.asarray(ref_toks)[0]
+
+  # No EOS hit: runs all steps and matches fused_decode exactly.
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, 64)
+  buf, count, _ = fused_generate(params, cfg, shard, token, cache, start, n, eos_ids=(), temp=0.0)
+  assert int(count) == n
+  np.testing.assert_array_equal(np.asarray(buf)[0], ref)
+
+  # EOS at a known step: declare the 4th greedy token to be EOS.
+  eos = int(ref[3])
+  first = int(np.argmax(np.asarray(ref) == eos)) + 1  # first occurrence, 1-based
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, 64)
+  buf, count, _ = fused_generate(params, cfg, shard, token, cache, start, n, eos_ids=(eos,), temp=0.0)
+  assert int(count) == first
+  np.testing.assert_array_equal(np.asarray(buf)[0, : int(count)], ref[:first])
+  assert int(np.asarray(buf)[0, int(count) - 1]) == eos

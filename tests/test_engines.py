@@ -96,3 +96,37 @@ async def test_jax_engine_greedy_sample_deterministic():
   np.testing.assert_array_equal(t1, t2)
   t3 = await engine.sample(logits, temp=0.8, top_k=10)
   assert t3.shape == (1,)
+
+
+@pytest.mark.asyncio
+async def test_jax_engine_generate_oneshot():
+  """One-dispatch whole-response generation: matches the chunked fast path
+  token-for-token (greedy) and advances the session by the steps actually run."""
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(5), cfg, "m")
+
+  engine_a = JaxShardedInferenceEngine()
+  engine_a.load_test_model(shard, cfg, params)
+  tokens = np.array([[4, 11, 3]], dtype=np.int32)
+  logits, _ = await engine_a.infer_tensor("r", shard, tokens)
+  seed = int(np.argmax(logits, axis=-1)[0])
+  chunked = await engine_a.generate_chunk("r", shard, seed, 10, temp=0.0)
+
+  engine_b = JaxShardedInferenceEngine()
+  engine_b.load_test_model(shard, cfg, params)
+  logits_b, _ = await engine_b.infer_tensor("r", shard, tokens)
+  oneshot = await engine_b.generate_oneshot("r", shard, seed, 10, eos_ids=(), temp=0.0)
+  assert oneshot == chunked
+  # The compiled program is bucketed to 16 steps but the traced limit stops
+  # the loop at exactly the 10 requested — no overrun into the cache.
+  assert engine_b.sessions["r"].curr_pos == tokens.shape[1] + 10
+
+  # EOS inside the window: generation stops there.
+  eos = chunked[4]
+  engine_c = JaxShardedInferenceEngine()
+  engine_c.load_test_model(shard, cfg, params)
+  await engine_c.infer_tensor("r", shard, tokens)
+  stopped = await engine_c.generate_oneshot("r", shard, seed, 10, eos_ids=(eos,), temp=0.0)
+  first = chunked.index(eos) + 1
+  assert stopped == chunked[:first]
+  assert engine_c.sessions["r"].curr_pos == tokens.shape[1] + first

@@ -106,3 +106,58 @@ async def test_single_node_training_step():
   with pytest.raises(NotImplementedError):
     await node.process_example(shard, np.ones((1, 4), np.int32), np.ones((1, 4), np.int32), np.array([4]), True, "r")
   await node.stop()
+
+
+class _StubTokenizer:
+  """Minimal tokenizer: maps chars to small ids; eos configurable."""
+
+  def __init__(self, eos_token_id: int):
+    self.eos_token_id = eos_token_id
+
+  def encode(self, text: str):
+    return [(ord(c) % 50) + 1 for c in text][:8]
+
+  def decode(self, ids):
+    return " ".join(str(i) for i in ids)
+
+
+@pytest.mark.asyncio
+async def test_node_oneshot_nonstreaming_matches_chunked():
+  """A non-streaming request (API hint stream=False) takes the one-dispatch
+  fused_generate path and must produce the same tokens as the default
+  chunked path."""
+  import jax
+
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.inference.shard import Shard
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(7), cfg, "m")
+
+  async def run(stream_hint):
+    engine = JaxShardedInferenceEngine()
+    engine.load_test_model(shard, cfg, params, tokenizer=_StubTokenizer(eos_token_id=-1))
+    node = Node("n1", StubServer(), engine, NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=200, default_sample_temp=0.0)
+    await node.start()
+    done = asyncio.Event()
+    collected = []
+
+    def on_tok(rid, toks, fin):
+      collected.extend(toks)
+      if fin:
+        done.set()
+
+    node.on_token.register("t").on_next(on_tok)
+    rid = "req-os"
+    node.set_request_options(rid, stream=stream_hint, max_tokens=9, temperature=0.0)
+    await node.process_prompt(Shard("m", 0, cfg.n_layers - 1, cfg.n_layers), "hello", rid)
+    await asyncio.wait_for(done.wait(), timeout=30)
+    await node.stop()
+    return collected
+
+  chunked = await run(True)
+  oneshot = await run(False)
+  assert len(chunked) == 9
+  assert oneshot == chunked

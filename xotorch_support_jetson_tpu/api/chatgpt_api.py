@@ -36,7 +36,7 @@ class Message:
 
 
 class ChatCompletionRequest:
-  def __init__(self, model: str, messages: list[Message], temperature: float, tools=None, max_tokens=None, stream=False):
+  def __init__(self, model: str, messages: list[Message], temperature: float | None = None, tools=None, max_tokens=None, stream=False):
     self.model = model
     self.messages = messages
     self.temperature = temperature
@@ -83,6 +83,8 @@ def parse_message(data: dict) -> Message:
 
 
 def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
+  if not data.get("messages"):
+    raise ValueError("'messages' must be a non-empty list")
   model = data.get("model", default_model)
   if model and model.startswith("gpt-"):  # alias ChatGPT client defaults
     model = default_model
@@ -93,7 +95,9 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
   return ChatCompletionRequest(
     model,
     [parse_message(m) for m in data["messages"]],
-    data.get("temperature", 0.6),
+    # None = "not specified" → the node's configured default applies; an
+    # unconditional 0.6 here would override the daemon's --temp flag.
+    data.get("temperature"),
     data.get("tools"),
     data.get("max_tokens"),
     data.get("stream", False),
@@ -317,7 +321,10 @@ class ChatGPTAPI:
       await queue.put((tokens, is_finished))
 
   async def handle_post_chat_completions(self, request):
-    data = await request.json()
+    try:
+      data = await request.json()
+    except Exception:  # noqa: BLE001 — malformed body is a client error
+      return web.json_response({"error": "invalid JSON body"}, status=400)
     if DEBUG >= 2:
       print(f"[api] chat completions request: {data}")
     try:
@@ -347,6 +354,15 @@ class ChatGPTAPI:
 
     self.token_queues[request_id] = asyncio.Queue()
     created = int(time.time())
+    if hasattr(self.node, "set_request_options"):
+      # Serving hints: a non-streaming request lets the node generate the
+      # whole response in one compiled program (single device round-trip).
+      self.node.set_request_options(
+        request_id,
+        stream=bool(chat_request.stream),
+        max_tokens=chat_request.max_tokens,
+        temperature=chat_request.temperature,
+      )
     try:
       await asyncio.wait_for(asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))), timeout=self.response_timeout)
 
@@ -363,6 +379,9 @@ class ChatGPTAPI:
       return web.json_response({"detail": f"Error processing prompt: {e}"}, status=500)
     finally:
       self.token_queues.pop(request_id, None)
+      # On multi-node rings the finishing node cleans its own copy; the
+      # API-attached node must drop its entry here or it leaks per request.
+      getattr(self.node, "request_options", {}).pop(request_id, None)
 
   def _finish_reason(self, tokenizer, last_token: int, is_finished: bool, hit_max: bool) -> str | None:
     if not is_finished:

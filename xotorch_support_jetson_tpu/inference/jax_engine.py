@@ -306,6 +306,63 @@ class JaxShardedInferenceEngine(InferenceEngine):
     session.curr_pos += n_steps
     return toks
 
+  async def generate_oneshot(
+    self,
+    request_id: str,
+    shard: Shard,
+    first_token: int,
+    max_steps: int,
+    eos_ids=(),
+    temp: float = 0.6,
+    top_k: int = 35,
+  ) -> list[int]:
+    """Generate a whole response (until EOS) in one compiled program.
+
+    One dispatch + one host readback total (vs one per chunk) — the blocking
+    completion fast path on tunneled/high-latency device links. Returns the
+    generated tokens trimmed at the first EOS.
+    """
+    await self.ensure_shard(shard)
+    return await asyncio.get_event_loop().run_in_executor(
+      self.executor, self._generate_oneshot_sync, request_id, shard, first_token, max_steps, eos_ids, temp, top_k
+    )
+
+  def _generate_oneshot_sync(self, request_id, shard, first_token, max_steps, eos_ids, temp, top_k):
+    from ..models.decoder import fused_generate
+
+    shard = getattr(self, "_effective_shard", shard)
+    session = self.sessions[request_id]
+    room = session.max_seq - session.curr_pos
+    if room <= 0:
+      return []
+    # Bucket the COMPILED step count (power-of-two, capped by cache room) so
+    # varying max_tokens requests reuse a handful of compiled programs; the
+    # actual step cap travels as a traced scalar, so no extra steps run.
+    limit = max(1, min(max_steps, room))
+    steps = min(1 << (limit - 1).bit_length(), room)
+    B = session.kv_cache["k"].shape[1]
+    token = jnp.full((B, 1), int(first_token), dtype=jnp.int32)
+    start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
+    self._key, sub = jax.random.split(self._key)
+    eos = tuple(sorted(int(e) for e in eos_ids))
+    buf, _n, session.kv_cache = fused_generate(
+      self.params, self.cfg, shard, token, session.kv_cache, start_pos, steps,
+      eos_ids=eos, temp=float(temp), top_k=int(top_k), key=sub, n_limit=limit,
+    )
+    # ONE host readback: the step count is recovered from the first EOS hit
+    # (the while_loop stops right after writing it), not fetched separately —
+    # each scalar fetch through a tunneled link costs a full ~67 ms RTT.
+    row = np.asarray(buf)[0]
+    n = limit
+    if eos:
+      hits = np.nonzero(np.isin(row[:limit], eos))[0]
+      if hits.size:
+        n = int(hits[0]) + 1
+    toks = [int(t) for t in row[:n]]
+    session.curr_pos += n
+    session.next_token_dev = None  # chain broken: next chunk must re-seed
+    return toks
+
   async def read_chunk(self, handle) -> list[int]:
     if handle is None:
       return []

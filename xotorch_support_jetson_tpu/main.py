@@ -16,6 +16,10 @@ import sys
 import time
 import uuid
 
+from .utils.helpers import apply_platform_override
+
+apply_platform_override()
+
 from . import registry
 from .inference.engine import get_inference_engine, inference_engine_classes
 from .inference.shard import Shard

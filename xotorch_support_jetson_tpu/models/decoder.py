@@ -258,6 +258,72 @@ def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos
   return jnp.moveaxis(toks, 0, 1), cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "shard", "max_steps", "temp", "top_k", "eos_ids"), donate_argnums=(4,))
+def fused_generate(
+  params,
+  cfg: ModelConfig,
+  shard: Shard,
+  token,  # [B,1] int32 — the token that seeds generation
+  cache,
+  start_pos,  # [B] int32
+  max_steps: int,
+  eos_ids: tuple = (),
+  temp: float = 0.0,
+  top_k: int = 35,
+  key=None,
+  n_limit=None,
+):
+  """Generate until EOS (or a step limit) in ONE compiled program.
+
+  ``max_steps`` (static) sizes the token buffer and the compiled program;
+  ``n_limit`` (traced scalar, default ``max_steps``) is the actual step cap —
+  callers bucket ``max_steps`` to reuse compiled programs across requests
+  without running bucket−request extra steps.
+
+  ``lax.while_loop`` exits as soon as every batch row has sampled an EOS id,
+  so the host pays exactly ONE dispatch + ONE result fetch for the whole
+  response. On a tunneled TPU a host round-trip costs ~67 ms — per-token (the
+  reference's loop, ``node.py:109-147``) or even per-chunk readbacks dominate
+  end-to-end latency; this path amortizes it to one.
+
+  Returns (tokens [B, max_steps] int32, n_steps [] int32, cache). Rows keep
+  their EOS token; positions past a row's EOS hold whatever was speculatively
+  sampled before every row finished (callers trim at the first EOS).
+  """
+  from ..ops.sampling import sample_logits
+
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("fused_generate requires a full-model shard")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+  B = token.shape[0]
+  eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
+  limit = jnp.int32(max_steps) if n_limit is None else jnp.minimum(jnp.int32(n_limit), max_steps)
+  buf0 = jnp.zeros((B, max_steps), dtype=jnp.int32)
+  done0 = jnp.zeros((B,), dtype=jnp.bool_)
+
+  def cond(carry):
+    _, _, _, _, _, i, done = carry
+    return (i < limit) & ~jnp.all(done)
+
+  def body(carry):
+    tok, pos, cache, key, buf, i, done = carry
+    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    row = logits[:, 0, :]
+    if temp <= 0.0:
+      nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+    else:
+      key, sub = jax.random.split(key)
+      nxt = sample_logits(row, sub, temp=temp, top_k=top_k)
+    buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+    if eos is not None:
+      done = done | jnp.any(nxt[:, None] == eos[None, :], axis=-1)
+    return (nxt[:, None], pos + 1, cache, key, buf, i + 1, done)
+
+  _, _, cache, _, buf, n, _ = jax.lax.while_loop(cond, body, (token, start_pos, cache, key, buf0, jnp.int32(0), done0))
+  return buf, n, cache
+
+
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:
   shard = Shard(model_id, 0, cfg.n_layers - 1, cfg.n_layers)
   return init_shard_params(key, cfg, shard, dtype=dtype), shard

@@ -68,6 +68,7 @@ class Node:
     self.topology: Topology = Topology()
     self.device_capabilities = UNKNOWN_DEVICE_CAPABILITIES
     self.buffered_token_output: dict[str, tuple[list[int], bool]] = {}
+    self.request_options: dict[str, dict] = {}
     self.buffered_inputs: dict[str, list] = {}
     self.checkpoints: dict[str, dict[str, int]] = {}
     self.outstanding_requests: dict[str, str] = {}
@@ -102,6 +103,40 @@ class Node:
     await self.server.stop()
 
   # --------------------------------------------------------------- serving
+
+  def set_request_options(self, request_id: str, *, stream: bool | None = None, max_tokens: int | None = None, temperature: float | None = None, top_k: int | None = None) -> None:
+    """Per-request serving hints set by the API before ``process_prompt``.
+
+    ``stream=False`` lets the fast decode path generate the entire response
+    in one compiled program (single host round-trip) instead of streaming
+    chunks; ``max_tokens``/``temperature``/``top_k`` override the node
+    defaults for this request only.
+    """
+    opts = self.request_options.setdefault(request_id, {})
+    for k, v in (("stream", stream), ("max_tokens", max_tokens), ("temperature", temperature), ("top_k", top_k)):
+      if v is not None:
+        opts[k] = v
+
+  def _request_limits(self, request_id: str) -> tuple[int, float, int]:
+    opts = self.request_options.get(request_id, {})
+    max_tokens = opts.get("max_tokens")
+    max_tokens = self.max_generate_tokens if max_tokens is None else min(int(max_tokens), self.max_generate_tokens)
+    temp = float(opts.get("temperature", self.default_sample_temp))
+    top_k = int(opts.get("top_k", self.default_sample_top_k))
+    return max_tokens, temp, top_k
+
+  def _stash_options(self, request_id: str, state: InferenceState | None) -> InferenceState | None:
+    """Attach this request's serving options to the wire state so every ring
+    peer (the last-shard node samples and enforces limits) sees them."""
+    opts = self.request_options.get(request_id)
+    if opts:
+      state = state or InferenceState()
+      state.extras["request_options"] = opts
+    return state
+
+  def _adopt_options(self, request_id: str, state: InferenceState | None) -> None:
+    if state is not None and "request_options" in state.extras and request_id not in self.request_options:
+      self.request_options[request_id] = dict(state.extras["request_options"])
 
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: str | None = None, inference_state: InferenceState | None = None):
     shard = self.get_current_shard(base_shard)
@@ -148,6 +183,7 @@ class Node:
 
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None):
     shard = self.get_current_shard(base_shard)
+    self._adopt_options(request_id, inference_state)
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0.
       head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
@@ -160,13 +196,14 @@ class Node:
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None):
     shard = self.get_current_shard(base_shard)
+    self._adopt_options(request_id, inference_state)
     try:
       self.outstanding_requests[request_id] = "processing"
       output, state = await self.inference_engine.infer_tensor(request_id, shard, tensor, inference_state)
       await self.process_inference_result(base_shard, output, request_id, state)
       return output
     except Exception:  # noqa: BLE001 — a failed hop must not kill the server
-      self.outstanding_requests.pop(request_id, None)
+      self._finish_request(request_id)
       print(f"[node {self.id}] error processing tensor for {request_id}")
       traceback.print_exc()
       return None
@@ -178,22 +215,20 @@ class Node:
       if request_id not in self.buffered_token_output:
         self.buffered_token_output[request_id] = ([], False)
       tokens, _ = self.buffered_token_output[request_id]
-      token = await self.inference_engine.sample(result, temp=self.default_sample_temp, top_k=self.default_sample_top_k)
+      _, req_temp, req_top_k = self._request_limits(request_id)
+      token = await self.inference_engine.sample(result, temp=req_temp, top_k=req_top_k)
       token_int = int(np.asarray(token).reshape(-1)[0])
       tokens.append(token_int)
       tracer.handle_token(request_id)
       metrics.inc("tokens_generated_total")
 
-      is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state)
+      is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state, request_id)
       self.buffered_token_output[request_id] = (tokens, is_finished)
       self.trigger_on_token_callbacks(request_id, [token_int], is_finished)
       asyncio.create_task(self.broadcast_result(request_id, [token_int], is_finished))
 
       if is_finished:
-        self.outstanding_requests.pop(request_id, None)
-        tracer.end_request(request_id)
-        if hasattr(self.inference_engine, "end_request"):
-          self.inference_engine.end_request(request_id)
+        self._finish_request(request_id)
         return
       # Single-node fast path: this node owns the whole model, so decode in
       # fused chunks (one compiled program per chunk, no per-token host trip).
@@ -214,7 +249,29 @@ class Node:
     speculative chunk."""
     engine = self.inference_engine
     eos_ids = self._eos_token_ids(base_shard)
-    temp, top_k = self.default_sample_temp, self.default_sample_top_k
+    max_tokens, temp, top_k = self._request_limits(request_id)
+
+    # Non-streaming request + oneshot-capable engine: generate the whole
+    # response in ONE compiled program (single host/tunnel round-trip).
+    if self.request_options.get(request_id, {}).get("stream") is False and hasattr(engine, "generate_oneshot"):
+      tokens, _ = self.buffered_token_output[request_id]
+      emit: list[int] = []
+      remaining = max_tokens - len(tokens)
+      if remaining > 0:
+        new_tokens = await engine.generate_oneshot(request_id, shard, last_token, remaining, eos_ids, temp, top_k)
+        for t in new_tokens:
+          emit.append(t)
+          tracer.handle_token(request_id)
+          metrics.inc("tokens_generated_total")
+          if t in eos_ids:
+            break
+        tokens.extend(emit)
+      self.buffered_token_output[request_id] = (tokens, True)
+      self.trigger_on_token_callbacks(request_id, emit, True)
+      asyncio.create_task(self.broadcast_result(request_id, emit, True))
+      self._finish_request(request_id)
+      return
+
     if chunk is None:
       # Streaming cadence vs per-dispatch overhead: ~200ms bursts at 32 on a
       # tunneled chip; on a local chip 8 is near-optimal. Env-tunable.
@@ -225,7 +282,7 @@ class Node:
     pending = await engine.dispatch_chunk(request_id, shard, chunk, temp, top_k, first_token=last_token)
     while pending is not None:
       tokens, _ = self.buffered_token_output[request_id]
-      remaining = self.max_generate_tokens - len(tokens)
+      remaining = max_tokens - len(tokens)
       # Speculatively enqueue the next chunk while we read this one.
       nxt = None
       if remaining > chunk:
@@ -242,7 +299,7 @@ class Node:
           hit_eos = True
           break
       tokens.extend(emit)
-      done = hit_eos or len(tokens) >= self.max_generate_tokens
+      done = hit_eos or len(tokens) >= max_tokens
       self.buffered_token_output[request_id] = (tokens, done)
       if emit or done:
         self.trigger_on_token_callbacks(request_id, emit, done)
@@ -251,10 +308,7 @@ class Node:
         break
       pending = nxt
 
-    self.outstanding_requests.pop(request_id, None)
-    tracer.end_request(request_id)
-    if hasattr(engine, "end_request"):
-      engine.end_request(request_id)
+    self._finish_request(request_id)
     # Ensure listeners see a finish even on cache exhaustion.
     tokens, finished = self.buffered_token_output[request_id]
     if not finished:
@@ -262,8 +316,16 @@ class Node:
       self.trigger_on_token_callbacks(request_id, [], True)
       asyncio.create_task(self.broadcast_result(request_id, [], True))
 
-  def _check_finished(self, base_shard: Shard, token: int, n_tokens: int, state: InferenceState | None) -> bool:
-    if n_tokens >= self.max_generate_tokens:
+  def _finish_request(self, request_id: str) -> None:
+    self.outstanding_requests.pop(request_id, None)
+    self.request_options.pop(request_id, None)
+    tracer.end_request(request_id)
+    if hasattr(self.inference_engine, "end_request"):
+      self.inference_engine.end_request(request_id)
+
+  def _check_finished(self, base_shard: Shard, token: int, n_tokens: int, state: InferenceState | None, request_id: str = "") -> bool:
+    max_tokens, _, _ = self._request_limits(request_id)
+    if n_tokens >= max_tokens:
       return True
     eos_ids = self._eos_token_ids(base_shard)
     return token in eos_ids
@@ -289,6 +351,7 @@ class Node:
       print(f"[node {self.id}] forwarding prompt {request_id} to partition {target_index}")
     target_id = self.partitioning_strategy.partition(self.topology)[target_index].node_id
     next_shard = self.get_current_shard(base_shard, target_index)
+    inference_state = self._stash_options(request_id, inference_state)
     if target_id == self.id:
       await self.process_prompt(next_shard, prompt, request_id, inference_state)
     else:
@@ -302,6 +365,7 @@ class Node:
       print(f"[node {self.id}] forwarding tensor {tensor.shape} for {request_id} to partition {target_index}")
     target_id = self.partitioning_strategy.partition(self.topology)[target_index].node_id
     next_shard = self.get_current_shard(base_shard, target_index)
+    inference_state = self._stash_options(request_id, inference_state)
     if target_id == self.id:
       await self.process_tensor(next_shard, tensor, request_id, inference_state)
     else:

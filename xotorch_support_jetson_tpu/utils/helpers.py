@@ -20,6 +20,22 @@ from typing import Any, Callable, Generic, TypeVar, TypeVarTuple, Unpack
 DEBUG = int(os.getenv("DEBUG", "0"))
 DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
 
+
+def apply_platform_override() -> None:
+  """Honor XOT_TPU_PLATFORM / JAX_PLATFORMS as the device override, parity
+  with the reference's TORCH_DEVICE knob (sharded_inference_engine.py:58-65).
+
+  Some TPU plugins clobber the JAX_PLATFORMS env var at import time; the
+  config API still wins, so entrypoints call this before touching devices
+  (e.g. ``JAX_PLATFORMS=cpu`` runs the daemon or bench without an
+  accelerator).
+  """
+  platform = os.getenv("XOT_TPU_PLATFORM") or os.getenv("JAX_PLATFORMS")
+  if platform:
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
 XOT_HOME = Path(os.getenv("XOT_TPU_HOME", Path.home() / ".cache" / "xot_tpu"))
 
 T = TypeVar("T")
