@@ -128,3 +128,25 @@ def test_build_prompt_multimodal_flatten():
   messages = [Message("user", [{"type": "text", "text": "hi"}, {"type": "image_url", "image_url": {"url": "x"}}])]
   prompt = build_prompt(tok, messages)
   assert "hi" in prompt
+
+
+@pytest.mark.asyncio
+async def test_request_validation_rejects_bad_fields():
+  node, api, client = await _make_api()
+  try:
+    base = {"model": "dummy", "messages": [{"role": "user", "content": "x"}]}
+    for bad in (
+      {"messages": []},
+      {**base, "max_tokens": "ten"},
+      {**base, "max_tokens": 0},
+      {**base, "max_tokens": -5},
+      {**base, "temperature": "hot"},
+      {**base, "temperature": 9.0},
+    ):
+      resp = await client.post("/v1/chat/completions", json=bad)
+      assert resp.status == 400, (bad, resp.status, await resp.text())
+    resp = await client.post("/v1/chat/completions", data=b"not json", headers={"Content-Type": "application/json"})
+    assert resp.status == 400
+  finally:
+    await client.close()
+    await node.stop()

@@ -144,3 +144,25 @@ def test_fused_generate_matches_fused_decode_and_stops_at_eos():
   assert int(count) == first
   np.testing.assert_array_equal(np.asarray(buf)[0, : int(count)], ref[:first])
   assert int(np.asarray(buf)[0, int(count) - 1]) == eos
+
+
+def test_client_temperature_does_not_recompile():
+  """temp is traced (greedy-vs-sampled is the only sampling variant): distinct
+  client temperatures must reuse one compiled program, or varied API requests
+  become a compile storm."""
+  from xotorch_support_jetson_tpu.models.decoder import _fused_decode_impl, fused_decode
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(9), cfg, "m")
+  tok = jnp.array([[3]], dtype=jnp.int32)
+  start = jnp.zeros((1,), dtype=jnp.int32)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
+  fused_decode(params, cfg, shard, tok, cache, start, 2, temp=0.6)  # compile the sampled variant
+  base = _fused_decode_impl._cache_size()
+  for temp in (0.61, 0.9, 1.3):
+    cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
+    fused_decode(params, cfg, shard, tok, cache, start, 2, temp=temp)
+  assert _fused_decode_impl._cache_size() == base  # no recompile per temperature
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
+  fused_decode(params, cfg, shard, tok, cache, start, 2, temp=0.0)
+  assert _fused_decode_impl._cache_size() == base + 1  # greedy is its own variant

@@ -1,0 +1,109 @@
+"""int8 weight quantization: fidelity, engine integration, mesh sharding."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache, jit_shard_forward
+from xotorch_support_jetson_tpu.models.quantize import qdot, quantize_params, quantize_weight
+
+
+def _logits(params, cfg, shard, tokens):
+  positions = jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, tokens.shape[0], 32)
+  out, _ = jit_shard_forward(params, cfg, shard, tokens, positions, cache)
+  return np.asarray(out[:, -1, :], dtype=np.float32)
+
+
+def test_quantize_weight_roundtrip_error():
+  w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+  q, s = quantize_weight(w)
+  assert q.dtype == jnp.int8 and s.shape == (128,)
+  deq = q.astype(jnp.float32) * s[None, :]
+  # Symmetric int8 per-channel: max error is half a quantization step.
+  step = np.asarray(s)[None, :]
+  assert np.max(np.abs(np.asarray(deq - w))) <= 0.5 * step.max() + 1e-6
+
+
+def test_qdot_modes_close():
+  x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+  w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+  q, s = quantize_weight(w)
+  ref = np.asarray(x @ w)
+  w8a16 = np.asarray(qdot(x, q, s, "w8a16"))
+  w8a8 = np.asarray(qdot(x, q, s, "w8a8"))
+  # ~1% relative error on random gaussians is the expected int8 regime.
+  assert np.abs(w8a16 - ref).max() / np.abs(ref).max() < 0.02
+  assert np.abs(w8a8 - ref).max() / np.abs(ref).max() < 0.03
+
+
+def test_quantized_model_logits_track_full_precision():
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, "m")
+  qparams = quantize_params(params)
+  # Layer weights went int8 with sibling scales; norms/embed untouched.
+  assert qparams["layers"]["wq"].dtype == jnp.int8
+  assert "wq_scale" in qparams["layers"]
+  assert qparams["layers"]["attn_norm"].dtype == params["layers"]["attn_norm"].dtype
+  assert qparams["lm_head"].dtype == jnp.int8
+  assert qparams["embed"].dtype == params["embed"].dtype
+
+  # Tied-embedding variant grows an int8 lm_head copy; the full-precision
+  # table is kept for the embedding gather.
+  tied_cfg = tiny_test_config(n_layers=2, tied_embedding=True)
+  tied_params, _ = full_model_params(jax.random.PRNGKey(8), tied_cfg, "m")
+  tied_q = quantize_params(tied_params)
+  assert "lm_head" not in tied_params and tied_q["lm_head"].dtype == jnp.int8
+  assert tied_q["embed"].dtype == tied_params["embed"].dtype
+
+  tokens = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+  ref = _logits(params, cfg, shard, tokens)
+  quant = _logits(qparams, cfg, shard, tokens)
+  # Quantized logits must rank the same argmax and correlate strongly.
+  assert np.argmax(ref) == np.argmax(quant)
+  cos = float(np.dot(ref.ravel(), quant.ravel()) / (np.linalg.norm(ref) * np.linalg.norm(quant)))
+  assert cos > 0.995, cos
+
+
+def test_quantized_fused_decode_runs_greedy():
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(4), cfg, "m")
+  qparams = quantize_params(params)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 32)
+  toks, _ = fused_decode(qparams, cfg, shard, jnp.asarray([[7]], jnp.int32), cache, jnp.zeros((1,), jnp.int32), 6, temp=0.0)
+  toks2_cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 32)
+  toks2, _ = fused_decode(qparams, cfg, shard, jnp.asarray([[7]], jnp.int32), toks2_cache, jnp.zeros((1,), jnp.int32), 6, temp=0.0)
+  np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+
+@pytest.mark.asyncio
+async def test_engine_quant_mode():
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(5), cfg, "m")
+  engine = JaxShardedInferenceEngine(quant="int8")
+  engine.load_test_model(shard, cfg, quantize_params(params))
+  tokens = np.array([[2, 9, 6]], dtype=np.int32)
+  logits, _ = await engine.infer_tensor("r", shard, tokens)
+  assert logits.shape == (1, cfg.vocab_size)
+  full = JaxShardedInferenceEngine()
+  full.load_test_model(shard, cfg, params)
+  ref, _ = await full.infer_tensor("r", shard, tokens)
+  assert np.argmax(ref) == np.argmax(logits)
+
+
+def test_quantized_params_shard_over_mesh():
+  from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan, build_mesh, shard_params
+
+  cfg = tiny_test_config(n_layers=2, n_heads=4, n_kv_heads=2)
+  params, shard = full_model_params(jax.random.PRNGKey(6), cfg, "m")
+  qparams = quantize_params(params)
+  mesh = build_mesh(MeshPlan(tp=2), jax.devices()[:2])
+  sharded = shard_params(qparams, mesh)
+  # Scales land sharded on the same axis as their weight's output dim.
+  assert sharded["layers"]["wq_scale"].sharding.spec == jax.sharding.PartitionSpec(None, "tp")

@@ -85,6 +85,12 @@ def parse_message(data: dict) -> Message:
 def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
   if not data.get("messages"):
     raise ValueError("'messages' must be a non-empty list")
+  max_tokens = data.get("max_tokens")
+  if max_tokens is not None and (not isinstance(max_tokens, int) or isinstance(max_tokens, bool) or max_tokens < 1):
+    raise ValueError("'max_tokens' must be a positive integer")
+  temperature = data.get("temperature")
+  if temperature is not None and (not isinstance(temperature, (int, float)) or isinstance(temperature, bool) or not 0 <= temperature <= 2):
+    raise ValueError("'temperature' must be a number in [0, 2]")
   model = data.get("model", default_model)
   if model and model.startswith("gpt-"):  # alias ChatGPT client defaults
     model = default_model
@@ -97,9 +103,9 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
     [parse_message(m) for m in data["messages"]],
     # None = "not specified" → the node's configured default applies; an
     # unconditional 0.6 here would override the daemon's --temp flag.
-    data.get("temperature"),
+    temperature,
     data.get("tools"),
-    data.get("max_tokens"),
+    max_tokens,
     data.get("stream", False),
   )
 

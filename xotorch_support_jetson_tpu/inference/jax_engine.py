@@ -89,7 +89,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
   layer range across all of its own chips.
   """
 
-  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None):
+  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None):
     super().__init__()
     self.shard_downloader = shard_downloader
     self.shard: Shard | None = None
@@ -97,6 +97,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = None
     self.tokenizer = None
     self.max_seq_len = max_seq_len or DEFAULT_MAX_SEQ
+    # XOT_TPU_QUANT=int8 loads ANY registry model weight-quantized (decode is
+    # HBM-bound: ~half the weight bytes ≈ ~half the per-token latency). The
+    # reference instead ships separate -8bit checkpoints (models.py:29).
+    self.quant = quant if quant is not None else (os.getenv("XOT_TPU_QUANT") or None)
     self.use_local_mesh = use_local_mesh if use_local_mesh is not None else os.getenv("XOT_TPU_LOCAL_MESH", "1") == "1"
     self.mesh = None
     self.sessions: dict[str, _Session] = {}
@@ -133,6 +137,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
       end = round((shard.end_layer + 1) * cfg.n_layers / shard.n_layers) - 1
       eff = Shard(shard.model_id, start, max(start, end), cfg.n_layers)
     self.params = load_shard_weights(model_dir, cfg, eff)
+    if self.quant:
+      from ..models.quantize import quantize_params
+
+      self.params = quantize_params(self.params, self.quant)
     self.cfg = cfg
     self.shard = shard
     self._effective_shard = eff

@@ -24,6 +24,7 @@ TPU-first design (deliberately different from the reference's per-layer
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -34,8 +35,21 @@ from ..ops.attention import gqa_attention
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope, rope_inv_freq
 from .config import ModelConfig
+from .quantize import qdot
 
 Params = dict
+
+# int8 matmul compute mode (models/quantize.py): "w8a16" upcasts weights next
+# to the dot; "w8a8" also dynamically quantizes activations onto the int8 MXU
+# path. Static at trace time.
+QUANT_COMPUTE = os.getenv("XOT_TPU_QUANT_COMPUTE", "w8a16")
+
+
+def _mm(x: jnp.ndarray, p: Params, name: str) -> jnp.ndarray:
+  """x @ p[name], transparently dequantizing int8 leaves (``<name>_scale``)."""
+  if f"{name}_scale" in p:
+    return qdot(x, p[name], p[f"{name}_scale"], QUANT_COMPUTE)
+  return x @ p[name]
 
 
 # ---------------------------------------------------------------- KV cache
@@ -126,9 +140,9 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   p = layer_params
 
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
-  q = x @ p["wq"]
-  k = x @ p["wk"]
-  v = x @ p["wv"]
+  q = _mm(x, p, "wq")
+  k = _mm(x, p, "wk")
+  v = _mm(x, p, "wv")
   # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
   if "wq_lora_a" in p:
     q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
@@ -159,11 +173,11 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   else:
     attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
 
-  h = h + attn.reshape(B, S, -1) @ p["wo"]
+  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
 
   x = rms_norm(h, p["mlp_norm"], cfg.norm_eps)
-  gated = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype) * (x @ p["w_up"])
-  h = h + gated @ p["w_down"]
+  gated = jax.nn.silu(_mm(x, p, "w_gate").astype(jnp.float32)).astype(h.dtype) * _mm(x, p, "w_up")
+  h = h + _mm(gated, p, "w_down")
   return h, k_cache, v_cache
 
 
@@ -212,6 +226,9 @@ def shard_forward(
 
   if shard.is_last_layer:
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    if "lm_head_scale" in params:
+      logits = qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
+      return logits, new_cache
     w_out = params.get("lm_head")
     if w_out is None:
       w_out = params["embed"].T  # tied embeddings, single-params case
@@ -228,7 +245,30 @@ jit_shard_forward = partial(jax.jit, static_argnames=("cfg", "shard"))(
 )
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "temp", "top_k"), donate_argnums=(4,))
+def _next_token(row, key, greedy: bool, temp, top_k: int):
+  """greedy is STATIC (two compiled variants); temp is TRACED — client
+  temperatures must not key the jit cache, or each distinct value would
+  recompile the full decode program (a remotely triggerable compile storm)."""
+  from ..ops.sampling import sample_logits
+
+  if greedy:
+    return jnp.argmax(row, axis=-1).astype(jnp.int32), key
+  key, sub = jax.random.split(key)
+  return sample_logits(row, sub, temp=temp, top_k=top_k), key
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "top_k", "greedy"), donate_argnums=(4,))
+def _fused_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp, top_k: int, greedy: bool, key):
+  def body(carry, _):
+    tok, pos, cache, key = carry
+    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    nxt, key = _next_token(logits[:, 0, :], key, greedy, temp, top_k)
+    return (nxt[:, None], pos + 1, cache, key), nxt
+
+  (_, _, cache, _), toks = jax.lax.scan(body, (token, start_pos, cache, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), cache
+
+
 def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp: float = 0.0, top_k: int = 35, key=None):
   """Generate ``n_steps`` tokens in ONE compiled program (lax.scan over steps).
 
@@ -236,29 +276,40 @@ def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos
   donated and updated in place. token [B,1] int32; start_pos [B] int32.
   Returns (tokens [B, n_steps], cache). Requires a full-model shard.
   """
-  from ..ops.sampling import sample_logits
-
   if not (shard.is_first_layer and shard.is_last_layer):
     raise ValueError("fused_decode requires a full-model shard")
   if key is None:
     key = jax.random.PRNGKey(0)
+  greedy = temp is None or float(temp) <= 0.0
+  temp_arr = jnp.float32(1.0 if greedy else float(temp))
+  return _fused_decode_impl(params, cfg, shard, token, cache, start_pos, int(n_steps), temp_arr, int(top_k), greedy, key)
 
-  def body(carry, _):
-    tok, pos, cache, key = carry
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "max_steps", "top_k", "eos_ids", "greedy"), donate_argnums=(4,))
+def _fused_generate_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, max_steps: int, eos_ids: tuple, temp, top_k: int, greedy: bool, key, n_limit):
+  B = token.shape[0]
+  eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
+  limit = jnp.minimum(n_limit.astype(jnp.int32), max_steps)
+  buf0 = jnp.zeros((B, max_steps), dtype=jnp.int32)
+  done0 = jnp.zeros((B,), dtype=jnp.bool_)
+
+  def cond(carry):
+    _, _, _, _, _, i, done = carry
+    return (i < limit) & ~jnp.all(done)
+
+  def body(carry):
+    tok, pos, cache, key, buf, i, done = carry
     logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
-    row = logits[:, 0, :]
-    if temp <= 0.0:
-      nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
-    else:
-      key, sub = jax.random.split(key)
-      nxt = sample_logits(row, sub, temp=temp, top_k=top_k)
-    return (nxt[:, None], pos + 1, cache, key), nxt
+    nxt, key = _next_token(logits[:, 0, :], key, greedy, temp, top_k)
+    buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+    if eos is not None:
+      done = done | jnp.any(nxt[:, None] == eos[None, :], axis=-1)
+    return (nxt[:, None], pos + 1, cache, key, buf, i + 1, done)
 
-  (_, _, cache, _), toks = jax.lax.scan(body, (token, start_pos, cache, key), None, length=n_steps)
-  return jnp.moveaxis(toks, 0, 1), cache
+  _, _, cache, _, buf, n, _ = jax.lax.while_loop(cond, body, (token, start_pos, cache, key, buf0, jnp.int32(0), done0))
+  return buf, n, cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "max_steps", "temp", "top_k", "eos_ids"), donate_argnums=(4,))
 def fused_generate(
   params,
   cfg: ModelConfig,
@@ -278,7 +329,9 @@ def fused_generate(
   ``max_steps`` (static) sizes the token buffer and the compiled program;
   ``n_limit`` (traced scalar, default ``max_steps``) is the actual step cap —
   callers bucket ``max_steps`` to reuse compiled programs across requests
-  without running bucket−request extra steps.
+  without running bucket−request extra steps. ``temp`` is traced too (client
+  temperatures must not key the jit cache); only greedy-vs-sampled compiles
+  two variants.
 
   ``lax.while_loop`` exits as soon as every batch row has sampled an EOS id,
   so the host pays exactly ONE dispatch + ONE result fetch for the whole
@@ -290,38 +343,16 @@ def fused_generate(
   their EOS token; positions past a row's EOS hold whatever was speculatively
   sampled before every row finished (callers trim at the first EOS).
   """
-  from ..ops.sampling import sample_logits
-
   if not (shard.is_first_layer and shard.is_last_layer):
     raise ValueError("fused_generate requires a full-model shard")
   if key is None:
     key = jax.random.PRNGKey(0)
-  B = token.shape[0]
-  eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
-  limit = jnp.int32(max_steps) if n_limit is None else jnp.minimum(jnp.int32(n_limit), max_steps)
-  buf0 = jnp.zeros((B, max_steps), dtype=jnp.int32)
-  done0 = jnp.zeros((B,), dtype=jnp.bool_)
-
-  def cond(carry):
-    _, _, _, _, _, i, done = carry
-    return (i < limit) & ~jnp.all(done)
-
-  def body(carry):
-    tok, pos, cache, key, buf, i, done = carry
-    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
-    row = logits[:, 0, :]
-    if temp <= 0.0:
-      nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
-    else:
-      key, sub = jax.random.split(key)
-      nxt = sample_logits(row, sub, temp=temp, top_k=top_k)
-    buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
-    if eos is not None:
-      done = done | jnp.any(nxt[:, None] == eos[None, :], axis=-1)
-    return (nxt[:, None], pos + 1, cache, key, buf, i + 1, done)
-
-  _, _, cache, _, buf, n, _ = jax.lax.while_loop(cond, body, (token, start_pos, cache, key, buf0, jnp.int32(0), done0))
-  return buf, n, cache
+  greedy = temp is None or float(temp) <= 0.0
+  temp_arr = jnp.float32(1.0 if greedy else float(temp))
+  limit = jnp.int32(max_steps if n_limit is None else n_limit)
+  return _fused_generate_impl(
+    params, cfg, shard, token, cache, start_pos, int(max_steps), tuple(eos_ids), temp_arr, int(top_k), greedy, key, limit
+  )
 
 
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:

@@ -1,0 +1,94 @@
+"""Weight quantization for the general decoder: int8 per-output-channel.
+
+The reference ships quantized checkpoints as separate registry entries
+(``models.py:29`` llama-3.1-405b-8bit) and otherwise runs whatever dtype the
+checkpoint has. Here quantization is a first-class engine mode instead:
+any registry model can be loaded with ``XOT_TPU_QUANT=int8``, halving the
+HBM bytes per decode step — single-token decode is bandwidth-bound on TPU,
+so weight bytes ≈ decode latency.
+
+Two compute modes for a quantized matmul (selected per-call):
+
+- ``w8a16`` (weight-only): int8 weights are upcast next to the dot;
+  activations stay bf16. Numerically safest.
+- ``w8a8`` (dynamic): activations are quantized per-row symmetric to int8 on
+  the fly and the dot runs int8×int8→int32 on the MXU's int8 path, then
+  rescales by (row_scale × channel_scale). Half the weight traffic AND the
+  int8 MXU rate; small extra quantization error on activations.
+
+Quantized params keep the same pytree names with an added ``<name>_scale``
+leaf, so sharding specs and checkpoint code treat them like any other leaf.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Layer-stacked weight leaves eligible for quantization ([L, in, out]) plus
+# the top-level lm_head ([in, out]). Norm gains, biases, LoRA adapters and
+# the embedding table stay in model dtype (embed rows are gathered, not
+# matmul'd; quantizing it would also quantize a tied LM head).
+QUANT_LAYER_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANT_TOP_LEAVES = ("lm_head",)
+
+
+def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Symmetric per-output-channel int8: w ≈ q * scale[..., None, :].
+
+  w [..., in, out] → (q int8 [..., in, out], scale f32 [..., out]).
+  """
+  absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+  scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+  q = jnp.round(w.astype(jnp.float32) / scale[..., None, :]).astype(jnp.int8)
+  return q, scale
+
+
+def quantize_params(params: dict, mode: str = "int8") -> dict:
+  """Quantize a shard's params in place-shape: returns a new pytree where
+  each eligible leaf ``w`` becomes int8 with a sibling ``w_scale``."""
+  if mode not in ("int8",):
+    raise ValueError(f"unsupported quantization mode {mode!r}")
+  out = dict(params)
+  layers = dict(params.get("layers", {}))
+  for name in QUANT_LAYER_LEAVES:
+    if name in layers and layers[name].dtype != jnp.int8:
+      q, s = quantize_weight(layers[name])
+      layers[name] = q
+      layers[f"{name}_scale"] = s
+  out["layers"] = layers
+  for name in QUANT_TOP_LEAVES:
+    if name in out and out[name].dtype != jnp.int8:
+      q, s = quantize_weight(out[name])
+      out[name] = q
+      out[f"{name}_scale"] = s
+  if "lm_head" not in out and "embed" in out and "final_norm" in out:
+    # Tied embeddings: materialize an int8 copy of the head so decode reads
+    # ~1 byte/param for the [D,V] projection (the single biggest weight read
+    # per token); the bf16 table stays for the embedding gather.
+    q, s = quantize_weight(out["embed"].T)
+    out["lm_head"] = q
+    out["lm_head_scale"] = s
+  return out
+
+
+def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a16") -> jnp.ndarray:
+  """x [..., in] @ quantized w [in, out] → [..., out] in x.dtype.
+
+  ``compute='w8a8'`` additionally quantizes x per-row to int8 and runs the
+  dot on the int8 MXU path with int32 accumulation.
+  """
+  if compute == "w8a8":
+    xf = x.astype(jnp.float32)
+    row = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    sx = jnp.where(row > 0, row / 127.0, 1.0)
+    xq = jnp.round(xf / sx).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, w, (((xq.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * scale.astype(jnp.float32)).astype(x.dtype)
+  up = w.astype(x.dtype)
+  acc = jax.lax.dot_general(x, up, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+  return (acc * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def is_quantized(p: dict, name: str) -> bool:
+  return f"{name}_scale" in p

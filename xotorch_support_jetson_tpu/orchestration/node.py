@@ -134,7 +134,12 @@ class Node:
       state.extras["request_options"] = opts
     return state
 
-  def _adopt_options(self, request_id: str, state: InferenceState | None) -> None:
+  def _adopt_options(self, request_id: str, state: InferenceState | None, shard: Shard) -> None:
+    # Only the last-shard node samples and enforces limits, and only it runs
+    # _finish_request — adopting on middle nodes would leak one dict entry
+    # per request with nothing to clean it up.
+    if not shard.is_last_layer:
+      return
     if state is not None and "request_options" in state.extras and request_id not in self.request_options:
       self.request_options[request_id] = dict(state.extras["request_options"])
 
@@ -183,7 +188,7 @@ class Node:
 
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None):
     shard = self.get_current_shard(base_shard)
-    self._adopt_options(request_id, inference_state)
+    self._adopt_options(request_id, inference_state, shard)
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0.
       head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
@@ -196,7 +201,7 @@ class Node:
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None):
     shard = self.get_current_shard(base_shard)
-    self._adopt_options(request_id, inference_state)
+    self._adopt_options(request_id, inference_state, shard)
     try:
       self.outstanding_requests[request_id] = "processing"
       output, state = await self.inference_engine.infer_tensor(request_id, shard, tensor, inference_state)
@@ -258,13 +263,11 @@ class Node:
       emit: list[int] = []
       remaining = max_tokens - len(tokens)
       if remaining > 0:
-        new_tokens = await engine.generate_oneshot(request_id, shard, last_token, remaining, eos_ids, temp, top_k)
-        for t in new_tokens:
-          emit.append(t)
+        # generate_oneshot already trims at the first EOS.
+        emit = await engine.generate_oneshot(request_id, shard, last_token, remaining, eos_ids, temp, top_k)
+        for _ in emit:
           tracer.handle_token(request_id)
-          metrics.inc("tokens_generated_total")
-          if t in eos_ids:
-            break
+        metrics.inc("tokens_generated_total", len(emit))
         tokens.extend(emit)
       self.buffered_token_output[request_id] = (tokens, True)
       self.trigger_on_token_callbacks(request_id, emit, True)

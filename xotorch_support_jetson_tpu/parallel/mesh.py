@@ -113,12 +113,22 @@ def decoder_param_specs(fsdp: bool = False) -> dict:
     "wq_lora_b": P(None, None, "tp"),
     "wv_lora_a": P(None, d, None),
     "wv_lora_b": P(None, None, "tp"),
+    # int8 per-output-channel scales (models/quantize.py) follow their
+    # weight's output-dim sharding.
+    "wq_scale": P(None, "tp"),
+    "wk_scale": P(None, "tp"),
+    "wv_scale": P(None, "tp"),
+    "wo_scale": P(None, d),
+    "w_gate_scale": P(None, "tp"),
+    "w_up_scale": P(None, "tp"),
+    "w_down_scale": P(None, d),
   }
   return {
     "embed": P("tp", d),  # vocab-sharded
     "layers": layers,
     "final_norm": P(None),
     "lm_head": P(d, "tp"),
+    "lm_head_scale": P("tp"),
   }
 
 
