@@ -91,6 +91,42 @@ def _save_tiny_hf(tmp_path, family: str):
       tie_word_embeddings=False,
       torch_dtype="float32",
     )
+  elif family == "mixtral":
+    cfg = AutoConfig.for_model(
+      "mixtral",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      num_local_experts=4,
+      num_experts_per_tok=2,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
+  elif family == "qwen2-moe":
+    cfg = AutoConfig.for_model(
+      "qwen2_moe",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      moe_intermediate_size=48,
+      shared_expert_intermediate_size=96,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      num_experts=4,
+      num_experts_per_tok=2,
+      decoder_sparse_step=1,
+      norm_topk_prob=False,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
   else:
     raise ValueError(family)
   model = AutoModelForCausalLM.from_config(cfg)
@@ -101,7 +137,7 @@ def _save_tiny_hf(tmp_path, family: str):
   return ref_logits
 
 
-@pytest.mark.parametrize("family", ["llama", "llama3-scaled", "qwen2", "mistral"])
+@pytest.mark.parametrize("family", ["llama", "llama3-scaled", "qwen2", "mistral", "mixtral", "qwen2-moe"])
 def test_golden_logits_vs_hf(tmp_path, family):
   ref_logits = _save_tiny_hf(tmp_path, family)
 

@@ -1,0 +1,209 @@
+"""MoE op + MoE-decoder tests.
+
+The reference cannot load any of its registered MoE models (SURVEY.md §2.11 —
+dense-only builder, ``general_mha.py:77-120``); these tests cover the real MoE
+support this framework adds: routing math, capacity-based dispatch, the
+deepseek-style dense-prefix decoder, and the sharding-equivalence contract
+(full model == composed layer-range shards) across the dense/MoE boundary.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  init_kv_cache,
+  shard_forward,
+  slice_shard_params,
+)
+from xotorch_support_jetson_tpu.ops.moe import (
+  dispatch_combine_masks,
+  expert_capacity,
+  moe_ffn,
+  router_topk,
+)
+
+
+def _moe_cfg(**over):
+  defaults = dict(
+    n_experts=4,
+    n_active_experts=2,
+    moe_hidden_dim=32,
+    first_k_dense=1,
+    n_layers=4,
+  )
+  defaults.update(over)
+  return tiny_test_config(**defaults)
+
+
+def test_router_topk_softmax_norm():
+  logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+  w, idx = router_topk(logits, k=2, scoring="softmax", norm_topk=True)
+  assert idx.tolist() == [[1, 2]]
+  np.testing.assert_allclose(np.sum(np.asarray(w), axis=-1), 1.0, rtol=1e-6)
+
+
+def test_router_sigmoid_selection_bias_reorders_but_does_not_weight():
+  logits = jnp.asarray([[0.0, 0.1, 0.2, 0.3]])
+  bias = jnp.asarray([10.0, 0.0, 0.0, 0.0])  # force expert 0 into the top-k
+  w, idx = router_topk(logits, k=2, scoring="sigmoid", selection_bias=bias)
+  assert 0 in idx.tolist()[0]
+  # combine weight for expert 0 is its *unbiased* sigmoid score
+  pos = idx.tolist()[0].index(0)
+  np.testing.assert_allclose(np.asarray(w)[0, pos], 1 / (1 + np.exp(0.0)), rtol=1e-6)
+
+
+def test_dispatch_exact_capacity_no_drops():
+  T, E, k = 6, 4, 2
+  key = jax.random.PRNGKey(0)
+  logits = jax.random.normal(key, (T, E))
+  w, idx = router_topk(logits, k)
+  C = expert_capacity(T, k, E, None)
+  assert C == T
+  dispatch, combine = dispatch_combine_masks(idx, w, E, C)
+  # every assignment lands: total dispatched slots == T*k
+  assert float(jnp.sum(dispatch)) == T * k
+  # combine weights sum per token to the router weights' sum
+  np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))), np.asarray(jnp.sum(w, axis=-1)), rtol=1e-5)
+
+
+def test_capacity_one_drops_overflow():
+  # All tokens pick expert 0 ⇒ capacity 1 keeps exactly one assignment.
+  idx = jnp.zeros((5, 1), dtype=jnp.int32)
+  w = jnp.ones((5, 1))
+  dispatch, _ = dispatch_combine_masks(idx, w, n_experts=2, capacity=1)
+  assert float(jnp.sum(dispatch)) == 1.0
+
+
+def test_moe_ffn_matches_per_token_loop():
+  """Capacity einsum == naive gather loop (the definition of routed FFN)."""
+  T, D, E, F, k = 5, 8, 4, 16, 2
+  key = jax.random.PRNGKey(1)
+  ks = jax.random.split(key, 5)
+  x = jax.random.normal(ks[0], (T, D), dtype=jnp.float32)
+  w_router = jax.random.normal(ks[1], (D, E)) * 0.1
+  w_gate = jax.random.normal(ks[2], (E, D, F)) * 0.1
+  w_up = jax.random.normal(ks[3], (E, D, F)) * 0.1
+  w_down = jax.random.normal(ks[4], (E, F, D)) * 0.1
+
+  out = moe_ffn(x, w_router, w_gate, w_up, w_down, k=k)
+
+  weights, idx = router_topk(x @ w_router, k)
+  expected = np.zeros((T, D), np.float32)
+  for t in range(T):
+    for j in range(k):
+      e = int(idx[t, j])
+      h = np.asarray(x[t]) @ np.asarray(w_gate[e])
+      act = h / (1 + np.exp(-h)) * (np.asarray(x[t]) @ np.asarray(w_up[e]))
+      expected[t] += float(weights[t, j]) * (act @ np.asarray(w_down[e]))
+  np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_decoder_forward_and_decode():
+  """Dense-prefix + MoE stacks: prefill-with-cache then one decode step."""
+  cfg = _moe_cfg(shared_expert_dim=32, shared_expert_gate=True)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "moe-test")
+  assert params["layers"]["wq"].shape[0] == 1  # dense prefix
+  assert params["moe_layers"]["w_experts_gate"].shape[:2] == (3, 4)
+
+  B, S = 2, 6
+  tokens = jnp.arange(B * S, dtype=jnp.int32).reshape(B, S) % cfg.vocab_size
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, 16)
+  logits, cache = shard_forward(params, cfg, shard, tokens, positions, cache)
+  assert logits.shape == (B, S, cfg.vocab_size)
+
+  nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+  logits2, _ = shard_forward(params, cfg, shard, nxt, jnp.full((B, 1), S, jnp.int32), cache)
+  assert logits2.shape == (B, 1, cfg.vocab_size)
+  assert np.all(np.isfinite(np.asarray(logits2, dtype=np.float32)))
+
+
+def test_moe_sharding_equivalence_across_boundary():
+  """Full MoE model == composed shards split *at* the dense/MoE boundary
+  and also mid-MoE (reference's core numerical contract,
+  inference/test_inference_engine.py:12-47)."""
+  cfg = _moe_cfg()
+  params, full = full_model_params(jax.random.PRNGKey(2), cfg, "moe-test")
+  B, S = 1, 5
+  tokens = jnp.arange(S, dtype=jnp.int32)[None, :]
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+  full_logits, _ = shard_forward(params, cfg, full, tokens, positions, None)
+
+  for split in (1, 2):  # layer boundary: at the dense/MoE edge and mid-MoE
+    a = Shard("moe-test", 0, split - 1, cfg.n_layers)
+    b = Shard("moe-test", split, cfg.n_layers - 1, cfg.n_layers)
+    pa = slice_shard_params(params, cfg, full, a)
+    pb = slice_shard_params(params, cfg, full, b)
+    hidden, _ = shard_forward(pa, cfg, a, tokens, positions, None)
+    logits, _ = shard_forward(pb, cfg, b, hidden, positions, None)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full_logits), rtol=2e-5, atol=2e-5)
+
+
+def test_moe_sigmoid_router_decoder():
+  """deepseek-v3 style: sigmoid scoring + selection bias + scaling factor."""
+  cfg = _moe_cfg(router_scoring="sigmoid", norm_topk_prob=True, routed_scaling_factor=2.5, first_k_dense=0)
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, "v3-test")
+  assert "layers" not in params and "router_bias" in params["moe_layers"]
+  tokens = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+  positions = jnp.asarray([[0, 1, 2]], dtype=jnp.int32)
+  logits, _ = shard_forward(params, cfg, shard, tokens, positions, None)
+  assert np.all(np.isfinite(np.asarray(logits, dtype=np.float32)))
+
+
+def test_moe_quantized_forward_close_to_fp():
+  """XOT_TPU_QUANT=int8 path: expert weights quantize and the forward stays close."""
+  from xotorch_support_jetson_tpu.models.quantize import quantize_params
+
+  cfg = _moe_cfg(shared_expert_dim=32)
+  params, shard = full_model_params(jax.random.PRNGKey(4), cfg, "moe-q")
+  qp = quantize_params(params)
+  assert qp["moe_layers"]["w_experts_gate"].dtype == jnp.int8
+  assert qp["layers"]["w_gate"].dtype == jnp.int8
+  assert "w_router" not in [k for k in qp["moe_layers"] if qp["moe_layers"][k].dtype == jnp.int8]
+
+  tokens = jnp.asarray([[1, 2, 3, 4]], dtype=jnp.int32)
+  positions = jnp.asarray([[0, 1, 2, 3]], dtype=jnp.int32)
+  ref, _ = shard_forward(params, cfg, shard, tokens, positions, None)
+  out, _ = shard_forward(qp, cfg, shard, tokens, positions, None)
+  # int8 weight error is small at tiny scale; just require close correlation
+  ref, out = np.asarray(ref, np.float32).ravel(), np.asarray(out, np.float32).ravel()
+  corr = np.corrcoef(ref, out)[0, 1]
+  assert corr > 0.99, f"quantized forward diverged (corr={corr})"
+
+
+def test_moe_aux_loss_surfaces_in_forward():
+  """make_forward_fn returns aux > 0 for MoE models and 0 for dense ones."""
+  from xotorch_support_jetson_tpu.parallel import MeshPlan, build_mesh, make_forward_fn
+
+  mesh = build_mesh(MeshPlan())
+  tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+
+  moe_cfg = _moe_cfg(first_k_dense=0)
+  params, _ = full_model_params(jax.random.PRNGKey(5), moe_cfg)
+  _, aux = make_forward_fn(mesh, moe_cfg, MeshPlan(), remat=False)(params, tokens, positions)
+  assert float(aux) > 0.0
+
+  dense_cfg = tiny_test_config(n_layers=2)
+  dparams, _ = full_model_params(jax.random.PRNGKey(6), dense_cfg)
+  _, daux = make_forward_fn(mesh, dense_cfg, MeshPlan(), remat=False)(dparams, tokens, positions)
+  assert float(daux) == 0.0
+
+
+def test_moe_chunked_dispatch_matches_single_block():
+  """Chunked exact dispatch (T > chunk) == one-shot dispatch."""
+  T, D, E, F, k = 40, 8, 4, 16, 2
+  ks = jax.random.split(jax.random.PRNGKey(11), 5)
+  x = jax.random.normal(ks[0], (T, D), dtype=jnp.float32)
+  w_router = jax.random.normal(ks[1], (D, E)) * 0.1
+  w_gate = jax.random.normal(ks[2], (E, D, F)) * 0.1
+  w_up = jax.random.normal(ks[3], (E, D, F)) * 0.1
+  w_down = jax.random.normal(ks[4], (E, F, D)) * 0.1
+  one = moe_ffn(x, w_router, w_gate, w_up, w_down, k=k, chunk=64)
+  chunked = moe_ffn(x, w_router, w_gate, w_up, w_down, k=k, chunk=16)
+  np.testing.assert_allclose(np.asarray(chunked), np.asarray(one), rtol=1e-5, atol=1e-6)

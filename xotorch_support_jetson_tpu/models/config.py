@@ -48,6 +48,19 @@ class ModelConfig:
   family: str = "llama"
   dtype: Any = jnp.bfloat16
   eos_token_ids: tuple[int, ...] = ()
+  # --- MoE (ops/moe.py). n_experts == 0 ⇒ dense model; first_k_dense layers
+  # stay dense even in an MoE model (deepseek puts layer 0 dense).
+  n_experts: int = 0
+  n_active_experts: int = 0  # top-k routed experts per token
+  moe_hidden_dim: int = 0  # per-routed-expert intermediate width
+  shared_expert_dim: int = 0  # total shared-expert intermediate width (0 ⇒ none)
+  shared_expert_gate: bool = False  # qwen2-moe: sigmoid gate on the shared expert
+  first_k_dense: int = 0
+  router_scoring: str = "softmax"  # "softmax" | "sigmoid" (deepseek-v3)
+  norm_topk_prob: bool = False
+  routed_scaling_factor: float = 1.0
+  moe_capacity_factor: float | None = None  # None ⇒ exact compute (no token drops)
+  moe_aux_loss_coef: float = 0.0  # load-balancing loss weight in training
 
   def __post_init__(self):
     if self.head_dim == 0:
@@ -74,15 +87,30 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
   (needed e.g. for Llama-3.2 where head_dim * n_heads != hidden_size is
   false but qwen3-style configs carry it explicitly).
   """
+  if "text_config" in hf and isinstance(hf["text_config"], dict):
+    # Vision-language checkpoints (llava) nest the decoder config; the text
+    # path runs on the nested config (role of the reference's llava registry
+    # entry + API image remapping, chatgpt_api.py:97-128).
+    merged = dict(hf["text_config"])
+    merged.setdefault("vocab_size", hf.get("vocab_size", merged.get("vocab_size")))
+    hf = merged
   arch = (hf.get("architectures") or [""])[0].lower()
   model_type = hf.get("model_type", "").lower()
   family = "llama"
-  if "qwen2" in model_type or "qwen2" in arch:
+  if "qwen2_moe" in model_type or "qwen2moe" in arch:
+    family = "qwen2-moe"
+  elif "qwen2" in model_type or "qwen2" in arch:
     family = "qwen2"
+  elif "mixtral" in model_type or "mixtral" in arch:
+    family = "mixtral"
   elif "mistral" in model_type or "mistral" in arch:
     family = "mistral"
   elif "phi3" in model_type or "phi3" in arch:
     family = "phi3"
+  elif "deepseek_v3" in model_type or "deepseekv3" in arch:
+    family = "deepseek-v3"
+  elif "deepseek_v2" in model_type or "deepseekv2" in arch:
+    family = "deepseek-v2"
 
   rope_scaling = None
   rs = hf.get("rope_scaling")
@@ -101,6 +129,31 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
   torch_dtype = str(hf.get("torch_dtype", "bfloat16"))
   dtype_map = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16, "float32": jnp.float32}
 
+  # MoE key space: mixtral (num_local_experts, expert width = intermediate_size),
+  # qwen2-moe (num_experts, moe_intermediate_size, gated shared expert),
+  # deepseek-v2/v3 (n_routed_experts, n_shared_experts, first_k_dense_replace,
+  # sigmoid scoring + routed_scaling_factor on v3).
+  moe: dict[str, Any] = {}
+  n_experts = int(hf.get("num_local_experts") or hf.get("num_experts") or hf.get("n_routed_experts") or 0)
+  if n_experts:
+    moe_hidden = int(hf.get("moe_intermediate_size") or hf["intermediate_size"])
+    n_shared = int(hf.get("n_shared_experts") or 0)
+    shared_dim = n_shared * moe_hidden
+    if family == "qwen2-moe":
+      shared_dim = int(hf.get("shared_expert_intermediate_size") or 0)
+    moe = dict(
+      n_experts=n_experts,
+      n_active_experts=int(hf.get("num_experts_per_tok", 2)),
+      moe_hidden_dim=moe_hidden,
+      shared_expert_dim=shared_dim,
+      shared_expert_gate=family == "qwen2-moe",
+      first_k_dense=int(hf.get("first_k_dense_replace", 0)),
+      router_scoring="sigmoid" if hf.get("scoring_func") == "sigmoid" else "softmax",
+      norm_topk_prob=bool(hf.get("norm_topk_prob", family == "mixtral")),
+      routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
+      moe_aux_loss_coef=float(hf.get("router_aux_loss_coef", 0.001)),
+    )
+
   n_heads = int(hf["num_attention_heads"])
   return ModelConfig(
     vocab_size=int(hf["vocab_size"]),
@@ -114,11 +167,12 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     rope_theta=float(hf.get("rope_theta", 10000.0)),
     rope_scaling=rope_scaling,
     max_seq_len=int(hf.get("max_position_embeddings", 8192)),
-    qkv_bias=family == "qwen2" or bool(hf.get("attention_bias", False)),
+    qkv_bias=family in ("qwen2", "qwen2-moe") or bool(hf.get("attention_bias", False)),
     tied_embedding=bool(hf.get("tie_word_embeddings", family == "qwen2" and int(hf["hidden_size"]) < 2048)),
     family=family,
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
     eos_token_ids=tuple(int(e) for e in eos),
+    **moe,
   )
 
 

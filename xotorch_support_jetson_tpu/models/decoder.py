@@ -94,29 +94,55 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
   L = shard.n_shard_layers
   D, F, V = cfg.dim, cfg.hidden_dim, cfg.vocab_size
   Qd, Kd = cfg.q_dim, cfg.kv_dim
-  keys = iter(jax.random.split(key, 16))
+  keys = iter(jax.random.split(key, 32))
 
   def w(k, *shape, scale=None):
     scale = scale if scale is not None else 1.0 / jnp.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
     return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
 
-  layers = {
-    "attn_norm": jnp.ones((L, D), dtype=dtype),
-    "wq": w(next(keys), L, D, Qd),
-    "wk": w(next(keys), L, D, Kd),
-    "wv": w(next(keys), L, D, Kd),
-    "wo": w(next(keys), L, Qd, D),
-    "mlp_norm": jnp.ones((L, D), dtype=dtype),
-    "w_gate": w(next(keys), L, D, F),
-    "w_up": w(next(keys), L, D, F),
-    "w_down": w(next(keys), L, F, D),
-  }
-  if cfg.qkv_bias:
-    layers["bq"] = jnp.zeros((L, Qd), dtype=dtype)
-    layers["bk"] = jnp.zeros((L, Kd), dtype=dtype)
-    layers["bv"] = jnp.zeros((L, Kd), dtype=dtype)
+  def attn_leaves(L):
+    leaves = {
+      "attn_norm": jnp.ones((L, D), dtype=dtype),
+      "wq": w(next(keys), L, D, Qd),
+      "wk": w(next(keys), L, D, Kd),
+      "wv": w(next(keys), L, D, Kd),
+      "wo": w(next(keys), L, Qd, D),
+      "mlp_norm": jnp.ones((L, D), dtype=dtype),
+    }
+    if cfg.qkv_bias:
+      leaves["bq"] = jnp.zeros((L, Qd), dtype=dtype)
+      leaves["bk"] = jnp.zeros((L, Kd), dtype=dtype)
+      leaves["bv"] = jnp.zeros((L, Kd), dtype=dtype)
+    return leaves
 
-  params: Params = {"layers": layers}
+  def dense_stack(L):
+    return {**attn_leaves(L), "w_gate": w(next(keys), L, D, F), "w_up": w(next(keys), L, D, F), "w_down": w(next(keys), L, F, D)}
+
+  params: Params = {}
+  if cfg.n_experts:
+    # MoE model: dense prefix (layers [0, first_k_dense) globally), MoE rest.
+    n_dense = min(max(cfg.first_k_dense - shard.start_layer, 0), L)
+    Lm, E, Fm, Fs = L - n_dense, cfg.n_experts, cfg.moe_hidden_dim, cfg.shared_expert_dim
+    if n_dense:
+      params["layers"] = dense_stack(n_dense)
+    moe = {
+      **attn_leaves(Lm),
+      "w_router": w(next(keys), Lm, D, E),
+      "w_experts_gate": w(next(keys), Lm, E, D, Fm),
+      "w_experts_up": w(next(keys), Lm, E, D, Fm),
+      "w_experts_down": w(next(keys), Lm, E, Fm, D),
+    }
+    if cfg.router_scoring == "sigmoid":
+      moe["router_bias"] = jnp.zeros((Lm, E), dtype=jnp.float32)
+    if Fs:
+      moe["w_shared_gate"] = w(next(keys), Lm, D, Fs)
+      moe["w_shared_up"] = w(next(keys), Lm, D, Fs)
+      moe["w_shared_down"] = w(next(keys), Lm, Fs, D)
+      if cfg.shared_expert_gate:
+        moe["w_shared_expert_gate"] = w(next(keys), Lm, D, 1)
+    params["moe_layers"] = moe
+  else:
+    params["layers"] = dense_stack(L)
   if shard.is_first_layer:
     params["embed"] = w(next(keys), V, D, scale=0.02)
   if shard.is_last_layer:
@@ -130,8 +156,10 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
 
 
 def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
-  """One decoder layer. h [B,S,D] → h, (new_k_cache, new_v_cache).
+  """One decoder layer. h [B,S,D] → (h, new_k_cache, new_v_cache, aux).
 
+  ``aux`` is the MoE load-balancing loss for this layer (0.0 for dense
+  layers); the training path accumulates it (parallel/train_step.py).
   ``attn_fn(q, k, v, q_pos, kv_pos)`` overrides the attention op on the
   cache-less path — used to swap in ring attention under sequence
   parallelism (parallel/ring_attention.py).
@@ -176,9 +204,44 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   h = h + _mm(attn.reshape(B, S, -1), p, "wo")
 
   x = rms_norm(h, p["mlp_norm"], cfg.norm_eps)
-  gated = jax.nn.silu(_mm(x, p, "w_gate").astype(jnp.float32)).astype(h.dtype) * _mm(x, p, "w_up")
-  h = h + _mm(gated, p, "w_down")
-  return h, k_cache, v_cache
+  aux = jnp.float32(0.0)
+  if "w_experts_gate" in p:  # routed MoE FFN (ops/moe.py) + optional shared expert
+    from ..ops.moe import moe_ffn
+
+    def expert_w(name):
+      # int8 expert weights: dequantize next to the einsum (XLA fuses the
+      # scale multiply into the operand read — w8a16-style).
+      w = p[name]
+      if f"{name}_scale" in p:
+        return w.astype(h.dtype) * p[f"{name}_scale"][..., None, :].astype(h.dtype)
+      return w
+
+    xt = x.reshape(B * S, D)
+    out, aux = moe_ffn(
+      xt,
+      p["w_router"],
+      expert_w("w_experts_gate"),
+      expert_w("w_experts_up"),
+      expert_w("w_experts_down"),
+      k=cfg.n_active_experts,
+      scoring=cfg.router_scoring,
+      norm_topk=cfg.norm_topk_prob,
+      selection_bias=p.get("router_bias"),
+      scale=cfg.routed_scaling_factor,
+      capacity_factor=cfg.moe_capacity_factor,
+      return_aux=True,
+    )
+    if "w_shared_gate" in p:
+      shared = jax.nn.silu(_mm(xt, p, "w_shared_gate").astype(jnp.float32)).astype(h.dtype) * _mm(xt, p, "w_shared_up")
+      shared = _mm(shared, p, "w_shared_down")
+      if "w_shared_expert_gate" in p:  # qwen2-moe sigmoid-gated shared expert
+        shared = shared * jax.nn.sigmoid((xt @ p["w_shared_expert_gate"]).astype(jnp.float32)).astype(h.dtype)
+      out = out + shared
+    h = h + out.reshape(B, S, D)
+  else:
+    gated = jax.nn.silu(_mm(x, p, "w_gate").astype(jnp.float32)).astype(h.dtype) * _mm(x, p, "w_up")
+    h = h + _mm(gated, p, "w_down")
+  return h, k_cache, v_cache, aux
 
 
 def shard_forward(
@@ -204,24 +267,39 @@ def shard_forward(
   use_cache = kv_cache is not None
   kv_positions = jnp.arange(kv_cache["k"].shape[2], dtype=jnp.int32) if use_cache else positions[0]
 
+  # Layer stacks run in order: dense prefix ("layers", e.g. deepseek's
+  # first_k_dense), then the MoE stack ("moe_layers"). Each stack is one
+  # lax.scan; MoE models with no dense prefix simply have no "layers" key.
+  stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
+
   if use_cache:
+    new_k_parts, new_v_parts = [], []
+    off = 0
+    for stack in stacks:
+      L = next(iter(stack.values())).shape[0]
 
-    def body(carry, per_layer):
-      h = carry
-      lp, kc, vc = per_layer
-      h, kc, vc = _layer_step(h, lp, kc, vc, positions, kv_positions, inv_freq, cfg, True)
-      return h, (kc, vc)
+      def body(carry, per_layer):
+        h = carry
+        lp, kc, vc = per_layer
+        h, kc, vc, _ = _layer_step(h, lp, kc, vc, positions, kv_positions, inv_freq, cfg, True)
+        return h, (kc, vc)
 
-    h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], kv_cache["k"], kv_cache["v"]))
+      h, (nk, nv) = jax.lax.scan(body, h, (stack, kv_cache["k"][off : off + L], kv_cache["v"][off : off + L]))
+      new_k_parts.append(nk)
+      new_v_parts.append(nv)
+      off += L
+    new_k = new_k_parts[0] if len(new_k_parts) == 1 else jnp.concatenate(new_k_parts, axis=0)
+    new_v = new_v_parts[0] if len(new_v_parts) == 1 else jnp.concatenate(new_v_parts, axis=0)
     new_cache: Params | None = {"k": new_k, "v": new_v}
   else:
 
     def body(carry, lp):
       h = carry
-      h, _, _ = _layer_step(h, lp, None, None, positions, kv_positions, inv_freq, cfg, False)
+      h, _, _, _ = _layer_step(h, lp, None, None, positions, kv_positions, inv_freq, cfg, False)
       return h, None
 
-    h, _ = jax.lax.scan(body, h, params["layers"])
+    for stack in stacks:
+      h, _ = jax.lax.scan(body, h, stack)
     new_cache = None
 
   if shard.is_last_layer:
@@ -362,9 +440,18 @@ def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model",
 
 def slice_shard_params(params: Params, cfg: ModelConfig, full_shard: Shard, sub: Shard) -> Params:
   """Carve a sub-shard's params out of full-model params (tests, local PP)."""
-  lo = sub.start_layer - full_shard.start_layer
-  hi = lo + sub.n_shard_layers
-  out: Params = {"layers": {k: v[lo:hi] for k, v in params["layers"].items()}}
+  out: Params = {}
+  stack_start = full_shard.start_layer  # global index of each stack's first layer
+  for name in ("layers", "moe_layers"):
+    if name not in params:
+      continue
+    stack = params[name]
+    L = next(iter(stack.values())).shape[0]
+    lo = max(sub.start_layer - stack_start, 0)
+    hi = min(sub.end_layer + 1 - stack_start, L)
+    if hi > lo:
+      out[name] = {k: v[lo:hi] for k, v in stack.items()}
+    stack_start += L
   if sub.is_first_layer:
     out["embed"] = params["embed"]
   if sub.is_last_layer:

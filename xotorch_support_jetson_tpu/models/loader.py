@@ -46,6 +46,30 @@ _LAYER_MAP: dict[str, tuple[str, bool]] = {
   "mlp.gate_proj.weight": ("w_gate", True),
   "mlp.up_proj.weight": ("w_up", True),
   "mlp.down_proj.weight": ("w_down", True),
+  # MoE routers / shared experts (mixtral, qwen2-moe, deepseek-v2/v3; the
+  # reference registers these models but cannot load them — SURVEY.md §2.11).
+  "block_sparse_moe.gate.weight": ("w_router", True),
+  "mlp.gate.weight": ("w_router", True),
+  "mlp.gate.e_score_correction_bias": ("router_bias", False),
+  "mlp.shared_expert.gate_proj.weight": ("w_shared_gate", True),
+  "mlp.shared_expert.up_proj.weight": ("w_shared_up", True),
+  "mlp.shared_expert.down_proj.weight": ("w_shared_down", True),
+  "mlp.shared_experts.gate_proj.weight": ("w_shared_gate", True),
+  "mlp.shared_experts.up_proj.weight": ("w_shared_up", True),
+  "mlp.shared_experts.down_proj.weight": ("w_shared_down", True),
+  "mlp.shared_expert_gate.weight": ("w_shared_expert_gate", True),
+}
+
+# Per-expert projections: `{block_sparse_moe|mlp}.experts.{e}.{proj}.weight`,
+# stacked into [E, D, F] / [E, F, D] leaves (mixtral names w1/w3/w2).
+_EXPERT_RE = re.compile(r"^(?:block_sparse_moe|mlp)\.experts\.(\d+)\.(w1|w2|w3|gate_proj|up_proj|down_proj)\.weight$")
+_EXPERT_KEY = {
+  "w1": "w_experts_gate",
+  "gate_proj": "w_experts_gate",
+  "w3": "w_experts_up",
+  "up_proj": "w_experts_up",
+  "w2": "w_experts_down",
+  "down_proj": "w_experts_down",
 }
 
 
@@ -100,14 +124,20 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
           layer_idx = int(m.group(1))
           if not (shard.start_layer <= layer_idx <= shard.end_layer):
             continue
-          mapped = _LAYER_MAP.get(m.group(2))
-          if mapped is None:
-            if DEBUG >= 3:
-              print(f"[loader] skipping unmapped tensor {name}")
+          suffix = m.group(2)
+          mapped = _LAYER_MAP.get(suffix)
+          if mapped is not None:
+            key, transpose = mapped
+            arr = _to_numpy(f.get_tensor(name))
+            per_layer[layer_idx][key] = arr.T if transpose else arr
             continue
-          key, transpose = mapped
-          arr = _to_numpy(f.get_tensor(name))
-          per_layer[layer_idx][key] = arr.T if transpose else arr
+          em = _EXPERT_RE.match(suffix)
+          if em is not None:
+            key = _EXPERT_KEY[em.group(2)]
+            per_layer[layer_idx].setdefault(key, {})[int(em.group(1))] = _to_numpy(f.get_tensor(name)).T
+            continue
+          if DEBUG >= 3:
+            print(f"[loader] skipping unmapped tensor {name}")
         elif name == "model.embed_tokens.weight":
           if shard.is_first_layer or (shard.is_last_layer and cfg.tied_embedding):
             top["embed_tokens"] = _to_numpy(f.get_tensor(name))
@@ -116,15 +146,31 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
         elif name == "lm_head.weight" and shard.is_last_layer:
           top["lm_head"] = _to_numpy(f.get_tensor(name)).T
 
-  # Stack per-layer dicts (AoS) into [L, ...] leaves (SoA) for lax.scan.
-  layer_keys = sorted(per_layer[shard.start_layer].keys())
-  for idx, tensors in per_layer.items():
-    missing = set(layer_keys) - set(tensors)
-    if missing:
-      raise ValueError(f"layer {idx}: missing tensors {sorted(missing)}")
-  layers = {key: jnp.stack([jnp.asarray(per_layer[i][key], dtype=cfg.dtype) for i in range(shard.start_layer, shard.end_layer + 1)]) for key in layer_keys}
+  # Stack per-layer dicts (AoS) into [L, ...] leaves (SoA) for lax.scan —
+  # a dense-prefix stack ("layers") and, for MoE models, an MoE stack
+  # ("moe_layers") with per-expert leaves stacked on an extra [E] axis.
+  first_k = cfg.first_k_dense if cfg.n_experts else shard.n_layers
+  all_idx = range(shard.start_layer, shard.end_layer + 1)
+  groups = [("layers", [i for i in all_idx if i < first_k]), ("moe_layers", [i for i in all_idx if i >= first_k])]
 
-  params: Params = {"layers": layers}
+  def as_leaf(t, key: str):
+    if isinstance(t, dict):  # experts: {e → [D,F]} → [E, D, F]
+      if sorted(t) != list(range(len(t))):
+        raise ValueError(f"{key}: missing expert tensors (have {sorted(t)})")
+      t = np.stack([t[e] for e in range(len(t))])
+    dtype = jnp.float32 if key == "router_bias" else cfg.dtype
+    return jnp.asarray(np.ascontiguousarray(t), dtype=dtype)
+
+  params: Params = {}
+  for stack_name, indices in groups:
+    if not indices:
+      continue
+    layer_keys = sorted(per_layer[indices[0]].keys())
+    for idx in indices:
+      missing = set(layer_keys) - set(per_layer[idx])
+      if missing:
+        raise ValueError(f"layer {idx}: missing tensors {sorted(missing)}")
+    params[stack_name] = {key: jnp.stack([as_leaf(per_layer[i][key], key) for i in indices]) for key in layer_keys}
   if shard.is_first_layer:
     params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)
   if shard.is_last_layer:
@@ -144,23 +190,58 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
 def check_shard_params(params: Params, cfg: ModelConfig, shard: Shard) -> None:
   """Shape validator (role of reference ``check_weights``, llm_utils.py:80-95)."""
   L = shard.n_shard_layers
-  expect = {
-    "attn_norm": (L, cfg.dim),
-    "wq": (L, cfg.dim, cfg.q_dim),
-    "wk": (L, cfg.dim, cfg.kv_dim),
-    "wv": (L, cfg.dim, cfg.kv_dim),
-    "wo": (L, cfg.q_dim, cfg.dim),
-    "mlp_norm": (L, cfg.dim),
-    "w_gate": (L, cfg.dim, cfg.hidden_dim),
-    "w_up": (L, cfg.dim, cfg.hidden_dim),
-    "w_down": (L, cfg.hidden_dim, cfg.dim),
-  }
-  if cfg.qkv_bias:
-    expect.update({"bq": (L, cfg.q_dim), "bk": (L, cfg.kv_dim), "bv": (L, cfg.kv_dim)})
-  for key, shape in expect.items():
-    actual = tuple(params["layers"][key].shape)
-    if actual != shape:
-      raise ValueError(f"layers/{key}: expected {shape}, got {actual}")
+  if cfg.n_experts:
+    n_dense = sum(1 for i in range(shard.start_layer, shard.end_layer + 1) if i < cfg.first_k_dense)
+  else:
+    n_dense = L
+
+  def attn_expect(L):
+    exp = {
+      "attn_norm": (L, cfg.dim),
+      "wq": (L, cfg.dim, cfg.q_dim),
+      "wk": (L, cfg.dim, cfg.kv_dim),
+      "wv": (L, cfg.dim, cfg.kv_dim),
+      "wo": (L, cfg.q_dim, cfg.dim),
+      "mlp_norm": (L, cfg.dim),
+    }
+    if cfg.qkv_bias:
+      exp.update({"bq": (L, cfg.q_dim), "bk": (L, cfg.kv_dim), "bv": (L, cfg.kv_dim)})
+    return exp
+
+  checks: dict[str, dict] = {}
+  if n_dense:
+    checks["layers"] = {
+      **attn_expect(n_dense),
+      "w_gate": (n_dense, cfg.dim, cfg.hidden_dim),
+      "w_up": (n_dense, cfg.dim, cfg.hidden_dim),
+      "w_down": (n_dense, cfg.hidden_dim, cfg.dim),
+    }
+  if L - n_dense:
+    Lm, E, Fm, Fs = L - n_dense, cfg.n_experts, cfg.moe_hidden_dim, cfg.shared_expert_dim
+    moe_exp = {
+      **attn_expect(Lm),
+      "w_router": (Lm, cfg.dim, E),
+      "w_experts_gate": (Lm, E, cfg.dim, Fm),
+      "w_experts_up": (Lm, E, cfg.dim, Fm),
+      "w_experts_down": (Lm, E, Fm, cfg.dim),
+    }
+    if Fs:
+      moe_exp.update({
+        "w_shared_gate": (Lm, cfg.dim, Fs),
+        "w_shared_up": (Lm, cfg.dim, Fs),
+        "w_shared_down": (Lm, Fs, cfg.dim),
+      })
+      if cfg.shared_expert_gate:
+        moe_exp["w_shared_expert_gate"] = (Lm, cfg.dim, 1)
+    checks["moe_layers"] = moe_exp
+  for stack_name, expect in checks.items():
+    stack = params.get(stack_name, {})
+    for key, shape in expect.items():
+      if key not in stack:
+        raise ValueError(f"{stack_name}/{key}: missing")
+      actual = tuple(stack[key].shape)
+      if actual != shape:
+        raise ValueError(f"{stack_name}/{key}: expected {shape}, got {actual}")
   if shard.is_first_layer and tuple(params["embed"].shape) != (cfg.vocab_size, cfg.dim):
     raise ValueError(f"embed: expected {(cfg.vocab_size, cfg.dim)}, got {params['embed'].shape}")
   if shard.is_last_layer and "lm_head" in params and tuple(params["lm_head"].shape) != (cfg.dim, cfg.vocab_size):

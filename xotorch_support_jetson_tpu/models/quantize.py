@@ -25,11 +25,26 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# Layer-stacked weight leaves eligible for quantization ([L, in, out]) plus
-# the top-level lm_head ([in, out]). Norm gains, biases, LoRA adapters and
-# the embedding table stay in model dtype (embed rows are gathered, not
-# matmul'd; quantizing it would also quantize a tied LM head).
-QUANT_LAYER_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# Stacked weight leaves eligible for quantization (last two dims [in, out];
+# expert leaves carry extra leading axes) plus the top-level lm_head.
+# Norm gains, biases, routers, LoRA adapters and the embedding table stay in
+# model dtype (embed rows are gathered, not matmul'd; quantizing it would
+# also quantize a tied LM head; routers are tiny and accuracy-critical).
+QUANT_STACK_LEAVES = {
+  "layers": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+  "moe_layers": (
+    "wq",
+    "wk",
+    "wv",
+    "wo",
+    "w_experts_gate",
+    "w_experts_up",
+    "w_experts_down",
+    "w_shared_gate",
+    "w_shared_up",
+    "w_shared_down",
+  ),
+}
 QUANT_TOP_LEAVES = ("lm_head",)
 
 
@@ -50,13 +65,16 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
   if mode not in ("int8",):
     raise ValueError(f"unsupported quantization mode {mode!r}")
   out = dict(params)
-  layers = dict(params.get("layers", {}))
-  for name in QUANT_LAYER_LEAVES:
-    if name in layers and layers[name].dtype != jnp.int8:
-      q, s = quantize_weight(layers[name])
-      layers[name] = q
-      layers[f"{name}_scale"] = s
-  out["layers"] = layers
+  for stack_name, eligible in QUANT_STACK_LEAVES.items():
+    if stack_name not in params:
+      continue
+    stack = dict(params[stack_name])
+    for name in eligible:
+      if name in stack and stack[name].dtype != jnp.int8:
+        q, s = quantize_weight(stack[name])
+        stack[name] = q
+        stack[f"{name}_scale"] = s
+    out[stack_name] = stack
   for name in QUANT_TOP_LEAVES:
     if name in out and out[name].dtype != jnp.int8:
       q, s = quantize_weight(out[name])

@@ -9,6 +9,7 @@ Axes (any may be 1):
   dp — data parallel (batch dim; gradients all-reduce here)
   pp — pipeline stages (layer ranges; activations ppermute here)
   sp — sequence/context parallel (ring attention shards the sequence here)
+  ep — expert parallel (MoE expert axis; dispatch/combine all-to-alls here)
   tp — tensor parallel (attention heads / MLP width; megatron-style)
 """
 
@@ -21,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "pp", "sp", "tp")
+AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
@@ -30,13 +31,14 @@ class MeshPlan:
   pp: int = 1
   sp: int = 1
   tp: int = 1
+  ep: int = 1
 
   @property
   def n_devices(self) -> int:
-    return self.dp * self.pp * self.sp * self.tp
+    return self.dp * self.pp * self.sp * self.ep * self.tp
 
   def describe(self) -> str:
-    return f"dp={self.dp} pp={self.pp} sp={self.sp} tp={self.tp}"
+    return f"dp={self.dp} pp={self.pp} sp={self.sp} ep={self.ep} tp={self.tp}"
 
 
 def build_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
@@ -44,7 +46,7 @@ def build_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
   if len(devices) < plan.n_devices:
     raise ValueError(f"mesh plan {plan.describe()} needs {plan.n_devices} devices, have {len(devices)}")
   devices = devices[: plan.n_devices]
-  shape = (plan.dp, plan.pp, plan.sp, plan.tp)
+  shape = (plan.dp, plan.pp, plan.sp, plan.ep, plan.tp)
   try:
     from jax.experimental import mesh_utils
 
@@ -123,9 +125,32 @@ def decoder_param_specs(fsdp: bool = False) -> dict:
     "w_up_scale": P(None, "tp"),
     "w_down_scale": P(None, d),
   }
+  # MoE leaves (models/decoder.py "moe_layers" stack): experts shard over ep,
+  # each expert's FFN width additionally over tp; the router and shared
+  # expert are small and follow the dense pattern. GSPMD turns the
+  # dispatch/combine einsums (ops/moe.py) into all-to-alls on the ep axis.
+  moe_layers = {
+    **layers,
+    "w_router": P(None, None, None),
+    "router_bias": P(None, None),
+    "w_experts_gate": P(None, "ep", d, "tp"),
+    "w_experts_up": P(None, "ep", d, "tp"),
+    "w_experts_down": P(None, "ep", "tp", d),
+    "w_shared_gate": P(None, d, "tp"),
+    "w_shared_up": P(None, d, "tp"),
+    "w_shared_down": P(None, "tp", d),
+    "w_shared_expert_gate": P(None, None, None),
+    "w_experts_gate_scale": P(None, "ep", "tp"),
+    "w_experts_up_scale": P(None, "ep", "tp"),
+    "w_experts_down_scale": P(None, "ep", d),
+    "w_shared_gate_scale": P(None, "tp"),
+    "w_shared_up_scale": P(None, "tp"),
+    "w_shared_down_scale": P(None, d),
+  }
   return {
     "embed": P("tp", d),  # vocab-sharded
     "layers": layers,
+    "moe_layers": moe_layers,
     "final_norm": P(None),
     "lm_head": P(d, "tp"),
     "lm_head_scale": P("tp"),
@@ -137,8 +162,8 @@ def specs_for_params(params, fsdp: bool = False) -> dict:
   full = decoder_param_specs(fsdp)
   out = {}
   for key, value in params.items():
-    if key == "layers":
-      out["layers"] = {k: full["layers"].get(k, P()) for k in value}
+    if key in ("layers", "moe_layers"):
+      out[key] = {k: full[key].get(k, P()) for k in value}
     else:
       out[key] = full.get(key, P())
   return out

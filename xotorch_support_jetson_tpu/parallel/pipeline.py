@@ -47,20 +47,22 @@ def unstack_stage_params(stage_params: dict) -> dict:
   return {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in stage_params.items()}
 
 
-def run_layer_stack(stage_layers: dict, h: jnp.ndarray, positions: jnp.ndarray, inv_freq, cfg: ModelConfig, attn_fn=None, remat: bool = False) -> jnp.ndarray:
+def run_layer_stack(stage_layers: dict, h: jnp.ndarray, positions: jnp.ndarray, inv_freq, cfg: ModelConfig, attn_fn=None, remat: bool = False, with_aux: bool = False):
   """Run a stack of layers (cache-less) via lax.scan; h [B,S,D].
 
   ``remat=True`` wraps each layer in ``jax.checkpoint`` (rematerialize
   activations in backward — HBM for FLOPs, the standard TPU training trade).
+  ``with_aux=True`` also returns the summed MoE load-balancing loss.
   """
 
   def one_layer(carry, lp):
-    out, _, _ = _layer_step(carry, lp, None, None, positions, positions[0], inv_freq, cfg, False, attn_fn)
-    return out, None
+    h, aux = carry
+    out, _, _, a = _layer_step(h, lp, None, None, positions, positions[0], inv_freq, cfg, False, attn_fn)
+    return (out, aux + a), None
 
   body = jax.checkpoint(one_layer) if remat else one_layer
-  h, _ = jax.lax.scan(body, h, stage_layers)
-  return h
+  (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0.0)), stage_layers)
+  return (h, aux) if with_aux else h
 
 
 def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro: int, ring_sp: bool = False, remat: bool = False):
@@ -83,7 +85,7 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro
     # rejects manual subgroups over size-1 axes in some programs).
     def apply_plain(stage_params, h, positions):
       layers = {k: v[0] for k, v in stage_params.items()}
-      return run_layer_stack(layers, h, positions, rope_inv_freq(cfg), cfg, remat=remat)
+      return run_layer_stack(layers, h, positions, rope_inv_freq(cfg), cfg, remat=remat, with_aux=True)
 
     return apply_plain
 
@@ -94,7 +96,7 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro
     jax.shard_map,
     mesh=mesh,
     in_specs=(P(pp_spec), P(None, seq, None), P(None, seq)),
-    out_specs=P(pp_spec, None, seq, None),
+    out_specs=(P(pp_spec, None, seq, None), P()),
     axis_names=manual,  # manual over pp (and sp if ring); dp/tp stay GSPMD-auto
     check_vma=False,
   )
@@ -109,6 +111,7 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro
 
     outputs = jnp.zeros((n_micro, mb, S, D), h.dtype)
     carry_out = jnp.zeros((mb, S, D), h.dtype)
+    aux_total = jnp.float32(0.0)
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     for t in range(n_micro + n_stages - 1):
@@ -117,19 +120,25 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro
       m_clamped = jnp.clip(m, 0, n_micro - 1)
       active = jnp.logical_and(m >= 0, m < n_micro)
       my_in = jnp.where(stage == 0, jax.lax.dynamic_index_in_dim(x_mb, m_clamped, axis=0, keepdims=False), recv)
-      out = run_layer_stack(stage_layers, my_in, pos_mb, inv_freq, cfg, attn_fn=attn_fn, remat=remat)
+      out, aux = run_layer_stack(stage_layers, my_in, pos_mb, inv_freq, cfg, attn_fn=attn_fn, remat=remat, with_aux=True)
+      aux_total = aux_total + jnp.where(active, aux, 0.0)
       out = jnp.where(active, out, carry_out)
       prev_slice = jax.lax.dynamic_index_in_dim(outputs, m_clamped, axis=0, keepdims=False)
       collect = jnp.logical_and(stage == n_stages - 1, active)
       outputs = jax.lax.dynamic_update_index_in_dim(outputs, jnp.where(collect, out, prev_slice), m_clamped, axis=0)
       carry_out = out
 
-    return outputs.reshape(B, S, D)[None]  # [1,B,S,D] per stage → [P,B,S,D] global
+    aux_total = aux_total / n_micro  # mean over microbatches
+    if n_stages > 1:
+      aux_total = jax.lax.psum(aux_total, "pp")  # sum each stage's layer contributions
+    if ring_sp:
+      aux_total = jax.lax.pmean(aux_total, "sp")  # mean over sequence shards
+    return outputs.reshape(B, S, D)[None], aux_total  # [1,B,S,D] per stage → [P,B,S,D] global
 
   def apply(stage_params, h, positions):
     if h.shape[0] % n_micro:
       raise ValueError(f"batch {h.shape[0]} not divisible by n_micro={n_micro}")
-    stacked = pp_fn(stage_params, h, positions)
-    return stacked[-1]  # only the last stage's slot holds real outputs
+    stacked, aux = pp_fn(stage_params, h, positions)
+    return stacked[-1], aux  # only the last stage's slot holds real outputs
 
   return apply

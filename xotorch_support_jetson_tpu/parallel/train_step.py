@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..ops.norm import rms_norm
 from .mesh import MeshPlan, specs_for_params
-from .pipeline import make_pipeline_layers_fn, stack_stage_params
+from .pipeline import make_pipeline_layers_fn, run_layer_stack, stack_stage_params
 
 
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -37,20 +37,34 @@ def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray, mask: jnp.ndar
 
 
 def make_forward_fn(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int = 1, ring_sp: bool | None = None, remat: bool = True):
-  """fn(params, tokens [B,S], positions [B,S]) -> logits [B,S,V] (fp32)."""
+  """fn(params, tokens [B,S], positions [B,S]) -> (logits [B,S,V] fp32, moe_aux []).
+
+  ``moe_aux`` is the accumulated MoE load-balancing loss (0.0 for dense
+  models); make_train_step folds it into the objective with
+  ``cfg.moe_aux_loss_coef``."""
   ring = plan.sp > 1 if ring_sp is None else ring_sp
   layers_fn = make_pipeline_layers_fn(mesh, cfg, plan.pp, n_micro, ring_sp=ring, remat=remat)
 
   def forward(params, tokens, positions):
     tokens = jax.lax.with_sharding_constraint(tokens, NamedSharding(mesh, P("dp", "sp" if ring else None)))
     h = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-    stage_params = stack_stage_params(params["layers"], plan.pp)
-    h = layers_fn(stage_params, h, positions)
+    if "moe_layers" in params:
+      # MoE model: a dense prefix (deepseek's first_k_dense — tiny, and not
+      # divisible into pp stages) runs under plain GSPMD; the MoE stack is
+      # what gets pipelined. ep/tp collectives are GSPMD-auto inside stages.
+      if "layers" in params:
+        from ..ops.rope import rope_inv_freq
+
+        h = run_layer_stack(params["layers"], h, positions, rope_inv_freq(cfg), cfg, remat=remat)
+      stage_params = stack_stage_params(params["moe_layers"], plan.pp)
+    else:
+      stage_params = stack_stage_params(params["layers"], plan.pp)
+    h, aux = layers_fn(stage_params, h, positions)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     w_out = params.get("lm_head")
     if w_out is None:
       w_out = params["embed"].T
-    return h.astype(jnp.float32) @ w_out.astype(jnp.float32)
+    return h.astype(jnp.float32) @ w_out.astype(jnp.float32), aux
 
   return forward
 
@@ -78,8 +92,8 @@ def make_train_step(
     tokens = batch["inputs"]
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    logits = forward(params, tokens, positions)
-    return cross_entropy_loss(logits, batch["targets"], batch["mask"])
+    logits, aux = forward(params, tokens, positions)
+    return cross_entropy_loss(logits, batch["targets"], batch["mask"]) + cfg.moe_aux_loss_coef * aux
 
   @partial(jax.jit, donate_argnums=(0, 1))
   def step_fn(params, opt_state, batch):
@@ -104,7 +118,7 @@ def make_eval_step(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int = 
     tokens = batch["inputs"]
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    logits = forward(params, tokens, positions)
+    logits, _ = forward(params, tokens, positions)  # eval loss is pure CE
     return cross_entropy_loss(logits, batch["targets"], batch["mask"])
 
   return eval_fn

@@ -20,30 +20,49 @@ def lora_scale(rank: int, alpha: float | None = None) -> float:
   return (alpha if alpha is not None else 2.0 * rank) / rank
 
 
+_STACKS = ("layers", "moe_layers")  # adapters attach to every stack present
+
+
 def add_lora(params: dict, rank: int, key: jax.Array, targets: tuple[str, ...] = LORA_TARGETS) -> dict:
-  """Return params with zero-initialized-B LoRA leaves added (A ~ N(0, 1/r))."""
-  layers = dict(params["layers"])
-  for i, target in enumerate(targets):
-    w = layers[target]  # [L, D_in, D_out]
-    L, d_in, d_out = w.shape
-    sub = jax.random.fold_in(key, i)
-    layers[f"{target}_lora_a"] = (jax.random.normal(sub, (L, d_in, rank), jnp.float32) / rank).astype(w.dtype)
-    layers[f"{target}_lora_b"] = jnp.zeros((L, rank, d_out), w.dtype)
-  return {**params, "layers": layers}
+  """Return params with zero-initialized-B LoRA leaves added (A ~ N(0, 1/r)).
+
+  For MoE models both the dense prefix ("layers") and the MoE stack
+  ("moe_layers") get adapters — the targets are attention projections, which
+  exist in every stack."""
+  out = dict(params)
+  salt = 0
+  for stack_name in _STACKS:
+    if stack_name not in params:
+      continue
+    layers = dict(params[stack_name])
+    for target in targets:
+      w = layers[target]  # [L, D_in, D_out]
+      L, d_in, d_out = w.shape
+      sub = jax.random.fold_in(key, salt)
+      salt += 1
+      layers[f"{target}_lora_a"] = (jax.random.normal(sub, (L, d_in, rank), jnp.float32) / rank).astype(w.dtype)
+      layers[f"{target}_lora_b"] = jnp.zeros((L, rank, d_out), w.dtype)
+    out[stack_name] = layers
+  return out
 
 
 def merge_lora(params: dict, rank: int, targets: tuple[str, ...] = LORA_TARGETS) -> dict:
   """Fold adapters into the base weights and drop the LoRA leaves."""
-  layers = dict(params["layers"])
+  out = dict(params)
   scale = lora_scale(rank)
-  for target in targets:
-    a = layers.pop(f"{target}_lora_a", None)
-    b = layers.pop(f"{target}_lora_b", None)
-    if a is None or b is None:
+  for stack_name in _STACKS:
+    if stack_name not in params:
       continue
-    delta = jnp.einsum("ldr,lro->ldo", a.astype(jnp.float32), b.astype(jnp.float32)) * scale
-    layers[target] = (layers[target].astype(jnp.float32) + delta).astype(layers[target].dtype)
-  return {**params, "layers": layers}
+    layers = dict(params[stack_name])
+    for target in targets:
+      a = layers.pop(f"{target}_lora_a", None)
+      b = layers.pop(f"{target}_lora_b", None)
+      if a is None or b is None:
+        continue
+      delta = jnp.einsum("ldr,lro->ldo", a.astype(jnp.float32), b.astype(jnp.float32)) * scale
+      layers[target] = (layers[target].astype(jnp.float32) + delta).astype(layers[target].dtype)
+    out[stack_name] = layers
+  return out
 
 
 def lora_grad_mask(grads: dict, params: dict) -> dict:

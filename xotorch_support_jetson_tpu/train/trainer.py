@@ -48,6 +48,10 @@ def _get_train_state(engine, lr: float, opt: str, lora: bool) -> _TrainState:
   return state
 
 
+def _has_lora(params) -> bool:
+  return any("_lora_" in k for stack in ("layers", "moe_layers") if stack in params for k in params[stack])
+
+
 def _make_batch(inputs, targets, lengths):
   inputs = np.asarray(inputs, np.int32)
   targets = np.asarray(targets, np.int32)
@@ -60,7 +64,7 @@ def _make_batch(inputs, targets, lengths):
 def engine_train_step(engine, shard, inputs, targets, lengths, loss: str = "ce", opt: str = "adamw", lr: float = 1e-5) -> float:
   if not (shard.is_first_layer and shard.is_last_layer):
     raise NotImplementedError("engine-side training requires a full-model shard (pipeline training rides the ring protocol)")
-  lora = any("_lora_" in k for k in engine.params["layers"])
+  lora = _has_lora(engine.params)
   state = _get_train_state(engine, lr, opt, lora)
   batch = _make_batch(inputs, targets, lengths)
   engine.params, state.opt_state, loss_val = state.step_fn(engine.params, state.opt_state, batch)
@@ -70,6 +74,6 @@ def engine_train_step(engine, shard, inputs, targets, lengths, loss: str = "ce",
 def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") -> float:
   if not (shard.is_first_layer and shard.is_last_layer):
     raise NotImplementedError("engine-side eval requires a full-model shard")
-  state = _get_train_state(engine, 1e-5, "adamw", any("_lora_" in k for k in engine.params["layers"]))
+  state = _get_train_state(engine, 1e-5, "adamw", _has_lora(engine.params))
   batch = _make_batch(inputs, targets, lengths)
   return float(jax.device_get(state.eval_fn(engine.params, batch)))
