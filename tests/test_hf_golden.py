@@ -127,6 +127,79 @@ def _save_tiny_hf(tmp_path, family: str):
       tie_word_embeddings=False,
       torch_dtype="float32",
     )
+  elif family in ("deepseek-v2-lite", "deepseek-v2", "deepseek-v2-yarn"):
+    cfg = AutoConfig.for_model(
+      "deepseek_v2",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      moe_intermediate_size=48,
+      num_hidden_layers=3,
+      num_attention_heads=4,
+      num_key_value_heads=4,
+      n_routed_experts=8,
+      n_shared_experts=1,
+      num_experts_per_tok=2,
+      first_k_dense_replace=1,
+      moe_layer_freq=1,
+      kv_lora_rank=16,
+      q_lora_rank=None if family == "deepseek-v2-lite" else 32,
+      qk_nope_head_dim=16,
+      qk_rope_head_dim=8,
+      v_head_dim=16,
+      head_dim=24 if family != "deepseek-v2-yarn" else 8,
+      rope_scaling=None
+      if family != "deepseek-v2-yarn"
+      else {
+        "type": "yarn",
+        "factor": 4.0,
+        "beta_fast": 32,
+        "beta_slow": 1,
+        "mscale": 0.707,
+        "mscale_all_dim": 1.0,
+        "original_max_position_embeddings": 64,
+      },
+      topk_method="group_limited_greedy" if family == "deepseek-v2" else "greedy",
+      n_group=4 if family == "deepseek-v2" else 1,
+      topk_group=2 if family == "deepseek-v2" else 1,
+      max_position_embeddings=256,
+      norm_topk_prob=False,
+      routed_scaling_factor=1.0,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
+  elif family == "deepseek-v3":
+    cfg = AutoConfig.for_model(
+      "deepseek_v3",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      moe_intermediate_size=48,
+      num_hidden_layers=3,
+      num_attention_heads=4,
+      num_key_value_heads=4,
+      n_routed_experts=8,
+      n_shared_experts=1,
+      num_experts_per_tok=2,
+      first_k_dense_replace=1,
+      moe_layer_freq=1,
+      kv_lora_rank=16,
+      q_lora_rank=32,
+      qk_nope_head_dim=16,
+      qk_rope_head_dim=8,
+      v_head_dim=16,
+      head_dim=8,
+      n_group=4,
+      topk_group=2,
+      norm_topk_prob=True,
+      routed_scaling_factor=2.5,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
   else:
     raise ValueError(family)
   model = AutoModelForCausalLM.from_config(cfg)
@@ -137,7 +210,21 @@ def _save_tiny_hf(tmp_path, family: str):
   return ref_logits
 
 
-@pytest.mark.parametrize("family", ["llama", "llama3-scaled", "qwen2", "mistral", "mixtral", "qwen2-moe"])
+@pytest.mark.parametrize(
+  "family",
+  [
+    "llama",
+    "llama3-scaled",
+    "qwen2",
+    "mistral",
+    "mixtral",
+    "qwen2-moe",
+    "deepseek-v2-lite",
+    "deepseek-v2",
+    "deepseek-v2-yarn",
+    "deepseek-v3",
+  ],
+)
 def test_golden_logits_vs_hf(tmp_path, family):
   ref_logits = _save_tiny_hf(tmp_path, family)
 

@@ -30,6 +30,23 @@ class RopeScaling:
 
 
 @dataclass(frozen=True)
+class YarnScaling:
+  """Yarn frequency scaling (rope_type='yarn'; deepseek-v2/v3 checkpoints).
+
+  ``attention_factor`` is resolved at parse time (HF `_compute_yarn_parameters`:
+  explicit value, else mscale/mscale_all_dim ratio, else 0.1·ln(factor)+1) and
+  multiplies cos/sin at application."""
+
+  factor: float = 1.0
+  beta_fast: float = 32.0
+  beta_slow: float = 1.0
+  original_max_position_embeddings: int = 4096
+  attention_factor: float = 1.0
+  truncate: bool = True
+  rope_type: str = "yarn"
+
+
+@dataclass(frozen=True)
 class ModelConfig:
   vocab_size: int
   dim: int  # embedding/residual width
@@ -40,7 +57,7 @@ class ModelConfig:
   head_dim: int = 0  # 0 → dim // n_heads
   norm_eps: float = 1e-5
   rope_theta: float = 500000.0
-  rope_scaling: RopeScaling | None = None
+  rope_scaling: RopeScaling | YarnScaling | None = None
   max_seq_len: int = 8192
   qkv_bias: bool = False  # qwen2 uses attention biases
   attn_out_bias: bool = False
@@ -61,6 +78,44 @@ class ModelConfig:
   routed_scaling_factor: float = 1.0
   moe_capacity_factor: float | None = None  # None ⇒ exact compute (no token drops)
   moe_aux_loss_coef: float = 0.0  # load-balancing loss weight in training
+  # Group-limited routing (deepseek): experts are grouped; only experts in the
+  # top ``topk_group`` groups are eligible. Group score = max expert score
+  # (v2 "group_limited_greedy") or sum of top-2 (v3 "noaux_tc").
+  n_group: int = 1
+  topk_group: int = 1
+  group_mode: str = "none"  # "none" | "max" | "top2sum"
+  # --- MLA (multi-head latent attention, deepseek-v2/v3). kv_lora_rank > 0
+  # switches the attention block to MLA: queries optionally LoRA-compressed
+  # (q_lora_rank, 0 ⇒ direct q_proj), KV always compressed to a shared latent
+  # + a small MQA rope channel. Rope applies only to the *_rope parts, with
+  # deepseek's interleaved pairing (ops/rope.py apply_rope_interleaved).
+  q_lora_rank: int = 0
+  kv_lora_rank: int = 0
+  qk_nope_head_dim: int = 0
+  qk_rope_head_dim: int = 0
+  v_head_dim: int = 0
+
+  @property
+  def is_mla(self) -> bool:
+    return self.kv_lora_rank > 0
+
+  @property
+  def qk_head_dim(self) -> int:
+    return self.qk_nope_head_dim + self.qk_rope_head_dim if self.is_mla else self.head_dim
+
+  # KV-cache geometry (models/decoder.py init_kv_cache): MLA caches full
+  # per-head K/V (k and v widths differ); dense caches GQA heads.
+  @property
+  def cache_kv_heads(self) -> int:
+    return self.n_heads if self.is_mla else self.n_kv_heads
+
+  @property
+  def cache_k_dim(self) -> int:
+    return self.qk_head_dim if self.is_mla else self.head_dim
+
+  @property
+  def cache_v_dim(self) -> int:
+    return self.v_head_dim if self.is_mla else self.head_dim
 
   def __post_init__(self):
     if self.head_dim == 0:
@@ -114,13 +169,38 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
 
   rope_scaling = None
   rs = hf.get("rope_scaling")
-  if isinstance(rs, dict) and rs.get("rope_type", rs.get("type", "")) == "llama3":
-    rope_scaling = RopeScaling(
-      factor=float(rs.get("factor", 8.0)),
-      low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
-      high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
-      original_max_position_embeddings=int(rs.get("original_max_position_embeddings", 8192)),
-    )
+  if isinstance(rs, dict):
+    rope_type = rs.get("rope_type", rs.get("type", ""))
+    if rope_type == "llama3":
+      rope_scaling = RopeScaling(
+        factor=float(rs.get("factor", 8.0)),
+        low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        original_max_position_embeddings=int(rs.get("original_max_position_embeddings", 8192)),
+      )
+    elif rope_type == "yarn":
+      import math
+
+      factor = float(rs.get("factor", 1.0))
+      attention_factor = rs.get("attention_factor")
+      if attention_factor is None:
+        mscale, mscale_all = rs.get("mscale"), rs.get("mscale_all_dim")
+
+        def get_mscale(scale, m=1.0):
+          return 0.1 * m * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+        if mscale and mscale_all:
+          attention_factor = get_mscale(factor, float(mscale)) / get_mscale(factor, float(mscale_all))
+        else:
+          attention_factor = get_mscale(factor)
+      rope_scaling = YarnScaling(
+        factor=factor,
+        beta_fast=float(rs.get("beta_fast") or 32),
+        beta_slow=float(rs.get("beta_slow") or 1),
+        original_max_position_embeddings=int(rs.get("original_max_position_embeddings") or hf.get("max_position_embeddings", 4096)),
+        attention_factor=float(attention_factor),
+        truncate=bool(rs.get("truncate", True)),
+      )
 
   eos = hf.get("eos_token_id", [])
   if isinstance(eos, int):
@@ -141,6 +221,15 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     shared_dim = n_shared * moe_hidden
     if family == "qwen2-moe":
       shared_dim = int(hf.get("shared_expert_intermediate_size") or 0)
+    # deepseek group-limited routing: v3 is always sigmoid + top-2-sum group
+    # scores (HF DeepseekV3TopkRouter); v2 keys it on topk_method.
+    scoring = "sigmoid" if (hf.get("scoring_func") == "sigmoid" or family == "deepseek-v3") else "softmax"
+    if family == "deepseek-v3":
+      group_mode = "top2sum"
+    elif hf.get("topk_method") == "group_limited_greedy":
+      group_mode = "max"
+    else:
+      group_mode = "none"
     moe = dict(
       n_experts=n_experts,
       n_active_experts=int(hf.get("num_experts_per_tok", 2)),
@@ -148,10 +237,23 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
       shared_expert_dim=shared_dim,
       shared_expert_gate=family == "qwen2-moe",
       first_k_dense=int(hf.get("first_k_dense_replace", 0)),
-      router_scoring="sigmoid" if hf.get("scoring_func") == "sigmoid" else "softmax",
+      router_scoring=scoring,
       norm_topk_prob=bool(hf.get("norm_topk_prob", family == "mixtral")),
       routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
-      moe_aux_loss_coef=float(hf.get("router_aux_loss_coef", 0.001)),
+      moe_aux_loss_coef=float(hf.get("router_aux_loss_coef", hf.get("aux_loss_alpha", 0.001))),
+      n_group=int(hf.get("n_group") or 1),
+      topk_group=int(hf.get("topk_group") or 1),
+      group_mode=group_mode,
+    )
+
+  mla: dict[str, Any] = {}
+  if hf.get("kv_lora_rank"):
+    mla = dict(
+      q_lora_rank=int(hf.get("q_lora_rank") or 0),
+      kv_lora_rank=int(hf["kv_lora_rank"]),
+      qk_nope_head_dim=int(hf["qk_nope_head_dim"]),
+      qk_rope_head_dim=int(hf["qk_rope_head_dim"]),
+      v_head_dim=int(hf["v_head_dim"]),
     )
 
   n_heads = int(hf["num_attention_heads"])
@@ -173,6 +275,7 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
     eos_token_ids=tuple(int(e) for e in eos),
     **moe,
+    **mla,
   )
 
 

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from ..inference.shard import Shard
 from ..ops.attention import gqa_attention
 from ..ops.norm import rms_norm
-from ..ops.rope import apply_rope, rope_inv_freq
+from ..ops.rope import apply_rope, apply_rope_interleaved, rope_attention_factor, rope_inv_freq
 from .config import ModelConfig
 from .quantize import qdot
 
@@ -56,10 +56,16 @@ def _mm(x: jnp.ndarray, p: Params, name: str) -> jnp.ndarray:
 
 
 def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: int, dtype=None) -> Params:
-  """Slot-indexed KV cache: slot j holds the KV of absolute position j."""
-  shape = (n_shard_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+  """Slot-indexed KV cache: slot j holds the KV of absolute position j.
+
+  Geometry comes from the config: GQA heads for dense models; for MLA
+  (deepseek) full per-head K/V with distinct k (qk_head_dim) and v
+  (v_head_dim) widths.
+  """
   dtype = dtype or cfg.dtype
-  return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+  k_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_k_dim)
+  v_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_v_dim)
+  return {"k": jnp.zeros(k_shape, dtype=dtype), "v": jnp.zeros(v_shape, dtype=dtype)}
 
 
 def _write_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
@@ -101,6 +107,23 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
     return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
 
   def attn_leaves(L):
+    if cfg.is_mla:
+      H, qk, vh = cfg.n_heads, cfg.qk_head_dim, cfg.v_head_dim
+      leaves = {
+        "attn_norm": jnp.ones((L, D), dtype=dtype),
+        "wkv_a": w(next(keys), L, D, cfg.kv_lora_rank + cfg.qk_rope_head_dim),
+        "kv_a_norm": jnp.ones((L, cfg.kv_lora_rank), dtype=dtype),
+        "wkv_b": w(next(keys), L, cfg.kv_lora_rank, H * (cfg.qk_nope_head_dim + vh)),
+        "wo": w(next(keys), L, H * vh, D),
+        "mlp_norm": jnp.ones((L, D), dtype=dtype),
+      }
+      if cfg.q_lora_rank:
+        leaves["wq_a"] = w(next(keys), L, D, cfg.q_lora_rank)
+        leaves["q_a_norm"] = jnp.ones((L, cfg.q_lora_rank), dtype=dtype)
+        leaves["wq_b"] = w(next(keys), L, cfg.q_lora_rank, H * qk)
+      else:
+        leaves["wq"] = w(next(keys), L, D, H * qk)
+      return leaves
     leaves = {
       "attn_norm": jnp.ones((L, D), dtype=dtype),
       "wq": w(next(keys), L, D, Qd),
@@ -154,6 +177,52 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
 
 # ---------------------------------------------------------------- forward
 
+# HF deepseek fixes the latent-norm eps at 1e-6 regardless of rms_norm_eps
+# (DeepseekV2RMSNorm default in q_a_layernorm/kv_a_layernorm).
+_MLA_NORM_EPS = 1e-6
+
+
+def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
+  """Multi-head latent attention projections (deepseek-v2/v3).
+
+  Parity with HF ``DeepseekV2Attention``/``DeepseekV3Attention``: queries
+  optionally LoRA-compressed (wq_a/q_a_norm/wq_b; direct wq when
+  cfg.q_lora_rank == 0), KV compressed to a shared ``kv_lora_rank`` latent
+  plus a single MQA rope channel; rope (interleaved pairing) applies only to
+  the rope parts. Returns (q [B,S,H,qk], k [B,S,H,qk], v [B,S,H,v]).
+  """
+  B, S, D = x.shape
+  H, nope, rope = cfg.n_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+  # LoRA adapters attach to the per-head q up-projection (wq or wq_b) and the
+  # kv up-projection wkv_b (train/lora.py maps wv→wkv_b for MLA).
+  if "wq_a" in p:
+    ql = rms_norm(_mm(x, p, "wq_a"), p["q_a_norm"], _MLA_NORM_EPS)
+    q = _mm(ql, p, "wq_b")
+    if "wq_b_lora_a" in p:
+      q = q + ((ql @ p["wq_b_lora_a"]) @ p["wq_b_lora_b"]) * 2.0
+  else:
+    q = _mm(x, p, "wq")
+    if "wq_lora_a" in p:
+      q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
+  q = q.reshape(B, S, H, nope + rope)
+  q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+  kv_a = _mm(x, p, "wkv_a")  # [B, S, kv_lora_rank + rope]
+  c_kv = rms_norm(kv_a[..., : cfg.kv_lora_rank], p["kv_a_norm"], _MLA_NORM_EPS)
+  k_pe = kv_a[..., cfg.kv_lora_rank :][:, :, None, :]  # [B, S, 1, rope] shared across heads
+  kv = _mm(c_kv, p, "wkv_b")
+  if "wkv_b_lora_a" in p:
+    kv = kv + ((c_kv @ p["wkv_b_lora_a"]) @ p["wkv_b_lora_b"]) * 2.0
+  kv = kv.reshape(B, S, H, nope + cfg.v_head_dim)
+  k_nope, v = kv[..., :nope], kv[..., nope:]
+
+  m = rope_attention_factor(cfg)
+  q_pe = apply_rope_interleaved(q_pe, positions, inv_freq, m)
+  k_pe = apply_rope_interleaved(k_pe, positions, inv_freq, m)
+  q = jnp.concatenate([q_nope, q_pe], axis=-1)
+  k = jnp.concatenate([k_nope, jnp.broadcast_to(k_pe, (B, S, H, rope))], axis=-1)
+  return q, k, v
+
 
 def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
   """One decoder layer. h [B,S,D] → (h, new_k_cache, new_v_cache, aux).
@@ -168,23 +237,27 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   p = layer_params
 
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
-  q = _mm(x, p, "wq")
-  k = _mm(x, p, "wk")
-  v = _mm(x, p, "wv")
-  # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
-  if "wq_lora_a" in p:
-    q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
-  if "wv_lora_a" in p:
-    v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
-  if "bq" in p:
-    q = q + p["bq"]
-    k = k + p["bk"]
-    v = v + p["bv"]
-  q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
-  k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-  v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-  q = apply_rope(q, positions, inv_freq)
-  k = apply_rope(k, positions, inv_freq)
+  if "wkv_a" in p:  # MLA (deepseek-v2/v3): latent-compressed KV + MQA rope channel
+    q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
+  else:
+    q = _mm(x, p, "wq")
+    k = _mm(x, p, "wk")
+    v = _mm(x, p, "wv")
+    # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
+    if "wq_lora_a" in p:
+      q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
+    if "wv_lora_a" in p:
+      v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
+    if "bq" in p:
+      q = q + p["bq"]
+      k = k + p["bk"]
+      v = v + p["bv"]
+    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    m = rope_attention_factor(cfg)
+    q = apply_rope(q, positions, inv_freq, m)
+    k = apply_rope(k, positions, inv_freq, m)
 
   if use_cache:
     start = positions[:, 0]
@@ -192,7 +265,7 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
     v_cache = _write_cache(v_cache, v, start)
     from ..ops.pallas_attention import flash_attention_prefill, flash_supported
 
-    if S > 1 and flash_supported(q.shape, k_cache.shape[1]):
+    if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
       # Prefill on TPU: flash kernel against the full cache (stale slots
       # beyond the prompt are positionally masked — slot index > position).
       attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=0)
@@ -230,6 +303,9 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
       scale=cfg.routed_scaling_factor,
       capacity_factor=cfg.moe_capacity_factor,
       return_aux=True,
+      n_group=cfg.n_group,
+      topk_group=cfg.topk_group,
+      group_mode=cfg.group_mode,
     )
     if "w_shared_gate" in p:
       shared = jax.nn.silu(_mm(xt, p, "w_shared_gate").astype(jnp.float32)).astype(h.dtype) * _mm(xt, p, "w_shared_up")

@@ -42,6 +42,14 @@ _LAYER_MAP: dict[str, tuple[str, bool]] = {
   "self_attn.q_proj.bias": ("bq", False),
   "self_attn.k_proj.bias": ("bk", False),
   "self_attn.v_proj.bias": ("bv", False),
+  # MLA projections (deepseek-v2/v3, HF DeepseekV2Attention): q optionally
+  # LoRA-compressed; KV compressed to a latent + MQA rope channel.
+  "self_attn.q_a_proj.weight": ("wq_a", True),
+  "self_attn.q_a_layernorm.weight": ("q_a_norm", False),
+  "self_attn.q_b_proj.weight": ("wq_b", True),
+  "self_attn.kv_a_proj_with_mqa.weight": ("wkv_a", True),
+  "self_attn.kv_a_layernorm.weight": ("kv_a_norm", False),
+  "self_attn.kv_b_proj.weight": ("wkv_b", True),
   "post_attention_layernorm.weight": ("mlp_norm", False),
   "mlp.gate_proj.weight": ("w_gate", True),
   "mlp.up_proj.weight": ("w_up", True),
@@ -196,6 +204,25 @@ def check_shard_params(params: Params, cfg: ModelConfig, shard: Shard) -> None:
     n_dense = L
 
   def attn_expect(L):
+    if cfg.is_mla:
+      H = cfg.n_heads
+      exp = {
+        "attn_norm": (L, cfg.dim),
+        "wkv_a": (L, cfg.dim, cfg.kv_lora_rank + cfg.qk_rope_head_dim),
+        "kv_a_norm": (L, cfg.kv_lora_rank),
+        "wkv_b": (L, cfg.kv_lora_rank, H * (cfg.qk_nope_head_dim + cfg.v_head_dim)),
+        "wo": (L, H * cfg.v_head_dim, cfg.dim),
+        "mlp_norm": (L, cfg.dim),
+      }
+      if cfg.q_lora_rank:
+        exp.update({
+          "wq_a": (L, cfg.dim, cfg.q_lora_rank),
+          "q_a_norm": (L, cfg.q_lora_rank),
+          "wq_b": (L, cfg.q_lora_rank, H * cfg.qk_head_dim),
+        })
+      else:
+        exp["wq"] = (L, cfg.dim, H * cfg.qk_head_dim)
+      return exp
     exp = {
       "attn_norm": (L, cfg.dim),
       "wq": (L, cfg.dim, cfg.q_dim),

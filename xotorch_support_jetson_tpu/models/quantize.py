@@ -30,13 +30,15 @@ import jax.numpy as jnp
 # Norm gains, biases, routers, LoRA adapters and the embedding table stay in
 # model dtype (embed rows are gathered, not matmul'd; quantizing it would
 # also quantize a tied LM head; routers are tiny and accuracy-critical).
+_MLA_LEAVES = ("wq_a", "wq_b", "wkv_a", "wkv_b")
 QUANT_STACK_LEAVES = {
-  "layers": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+  "layers": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", *_MLA_LEAVES),
   "moe_layers": (
     "wq",
     "wk",
     "wv",
     "wo",
+    *_MLA_LEAVES,
     "w_experts_gate",
     "w_experts_up",
     "w_experts_down",

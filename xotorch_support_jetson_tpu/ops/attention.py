@@ -28,9 +28,14 @@ def gqa_attention(
   q_positions: jnp.ndarray,  # [B, Sq] absolute positions of queries
   kv_positions: jnp.ndarray,  # [Skv] absolute positions (slot indices) of keys
 ) -> jnp.ndarray:
-  """Returns [B, Sq, Hq, hd]; softmax in fp32; output in q.dtype."""
+  """Returns [B, Sq, Hq, hd_v]; softmax in fp32; output in q.dtype.
+
+  ``v``'s head dim may differ from q/k's (MLA: qk 192, v 128); the scale is
+  always 1/sqrt(qk head dim).
+  """
   B, Sq, Hq, hd = q.shape
   Hkv = k.shape[2]
+  hd_v = v.shape[3]
   group = Hq // Hkv
   scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
 
@@ -41,4 +46,4 @@ def gqa_attention(
   scores = jnp.where(mask, scores, NEG_INF)
   probs = jax.nn.softmax(scores, axis=-1)
   out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
-  return out.reshape(B, Sq, Hq, hd).astype(q.dtype)
+  return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)

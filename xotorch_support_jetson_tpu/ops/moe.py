@@ -11,7 +11,9 @@ dispatch/combine all-to-alls on ICI.
 Design (idiomatic TPU, not a translation of any torch MoE):
 
 - **top-k routing** with either softmax scoring (mixtral/qwen2-moe/deepseek-v2)
-  or sigmoid scoring with a selection-only correction bias (deepseek-v3).
+  or sigmoid scoring with a selection-only correction bias (deepseek-v3),
+  optionally group-limited (deepseek's device-limited routing: v2
+  ``group_limited_greedy``, v3 ``noaux_tc``).
 - **Capacity-based dispatch**: tokens are assigned a position inside their
   expert's buffer via a cumulative-sum rank; position ≥ capacity ⇒ the token
   drops that expert (its combine weight is zero). ``capacity_factor=None``
@@ -37,12 +39,18 @@ def router_topk(
   norm_topk: bool = False,
   selection_bias: jnp.ndarray | None = None,  # [E] added for *selection only* (deepseek-v3)
   scale: float = 1.0,
+  n_group: int = 1,
+  topk_group: int = 1,
+  group_mode: str = "none",  # "none" | "max" (deepseek-v2) | "top2sum" (deepseek-v3)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
   """Select top-k experts per token. Returns (weights [T,k] fp32, idx [T,k] int32).
 
   Combine weights are always the *unbiased* scores gathered at the selected
   experts; ``selection_bias`` (deepseek-v3's e_score_correction_bias) only
-  reorders the top-k choice.
+  reorders the top-k choice. With ``group_mode`` ≠ "none" experts are split
+  into ``n_group`` groups and only the top ``topk_group`` groups (by max or
+  top-2-sum of member scores) are eligible — deepseek's device-limited
+  routing, which bounds how many EP shards a token can touch.
   """
   logits = logits.astype(jnp.float32)
   if scoring == "sigmoid":
@@ -50,10 +58,20 @@ def router_topk(
   else:
     scores = jax.nn.softmax(logits, axis=-1)
   sel = scores if selection_bias is None else scores + selection_bias.astype(jnp.float32)
+  if group_mode != "none" and n_group > 1:
+    T, E = sel.shape
+    grouped = sel.reshape(T, n_group, E // n_group)
+    if group_mode == "top2sum":
+      group_scores = jnp.sum(jax.lax.top_k(grouped, 2)[0], axis=-1)
+    else:
+      group_scores = jnp.max(grouped, axis=-1)
+    _, gidx = jax.lax.top_k(group_scores, topk_group)  # [T, topk_group]
+    gmask = jnp.sum(jax.nn.one_hot(gidx, n_group, dtype=jnp.float32), axis=1)  # [T, n_group]
+    sel = jnp.where(jnp.repeat(gmask > 0, E // n_group, axis=-1), sel, 0.0)
   _, idx = jax.lax.top_k(sel, k)
   weights = jnp.take_along_axis(scores, idx, axis=-1)
   if norm_topk:
-    weights = weights / jnp.maximum(jnp.sum(weights, axis=-1, keepdims=True), 1e-20)
+    weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
   return weights * scale, idx.astype(jnp.int32)
 
 
@@ -83,12 +101,12 @@ def dispatch_combine_masks(idx: jnp.ndarray, weights: jnp.ndarray, n_experts: in
   return dispatch, combine
 
 
-def _moe_ffn_block(x, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, capacity_factor):
+def _moe_ffn_block(x, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, capacity_factor, n_group, topk_group, group_mode):
   """One dispatch/compute/combine block over [T, D] tokens. Returns (out, aux)."""
   T, D = x.shape
   E = w_gate.shape[0]
   logits = x.astype(jnp.float32) @ w_router.astype(jnp.float32)
-  weights, idx = router_topk(logits, k, scoring, norm_topk, selection_bias, scale)
+  weights, idx = router_topk(logits, k, scoring, norm_topk, selection_bias, scale, n_group, topk_group, group_mode)
   C = expert_capacity(T, k, E, capacity_factor)
   dispatch, combine = dispatch_combine_masks(idx, weights, E, C)
 
@@ -114,6 +132,9 @@ def moe_ffn(
   capacity_factor: float | None = None,
   chunk: int = 256,
   return_aux: bool = False,
+  n_group: int = 1,
+  topk_group: int = 1,
+  group_mode: str = "none",
 ):
   """Routed SwiGLU FFN over ``E`` experts; returns [T, D] in x.dtype
   (or ``(out, aux_loss)`` with ``return_aux``).
@@ -126,7 +147,7 @@ def moe_ffn(
   T, D = x.shape
 
   def block(xs):
-    return _moe_ffn_block(xs, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, capacity_factor)
+    return _moe_ffn_block(xs, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, capacity_factor, n_group, topk_group, group_mode)
 
   if T <= chunk:
     out, aux = block(x)

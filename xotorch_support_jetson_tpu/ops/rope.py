@@ -13,18 +13,56 @@ no q/k weight permutation at load time (cf. ``llm_utils.py:126-134``).
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
-from ..models.config import ModelConfig, RopeScaling
+from ..models.config import ModelConfig, RopeScaling, YarnScaling
 
 
 def rope_inv_freq(cfg: ModelConfig) -> jnp.ndarray:
-  """[head_dim/2] inverse frequencies, with optional llama3 scaling."""
-  half = cfg.head_dim // 2
+  """[rot_dim/2] inverse frequencies, with optional llama3/yarn scaling.
+
+  For MLA models (deepseek) only the ``qk_rope_head_dim`` channel carries
+  position; dense models rotate the whole head_dim.
+  """
+  rot_dim = cfg.qk_rope_head_dim if cfg.is_mla else cfg.head_dim
+  half = rot_dim // 2
   inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-  if cfg.rope_scaling is not None:
+  if isinstance(cfg.rope_scaling, YarnScaling):
+    return _yarn_inv_freq(rot_dim, cfg.rope_theta, cfg.rope_scaling)
+  if isinstance(cfg.rope_scaling, RopeScaling):
     inv_freq = _llama3_scale(inv_freq, cfg.rope_scaling)
   return inv_freq
+
+
+def rope_attention_factor(cfg: ModelConfig) -> float:
+  """Yarn's post-scaling of cos/sin (HF multiplies freqs_cis by it); 1.0 otherwise."""
+  return cfg.rope_scaling.attention_factor if isinstance(cfg.rope_scaling, YarnScaling) else 1.0
+
+
+def _yarn_inv_freq(dim: int, base: float, s: YarnScaling) -> jnp.ndarray:
+  """Yarn NTK-by-parts inverse frequencies (HF ``_compute_yarn_parameters``):
+  interpolated (freq/factor) below the slow-rotation boundary, extrapolated
+  (unscaled) above the fast one, linear ramp between."""
+
+  def correction_dim(num_rotations: float) -> float:
+    return (dim * math.log(s.original_max_position_embeddings / (num_rotations * 2 * math.pi))) / (2 * math.log(base))
+
+  low = correction_dim(s.beta_fast)
+  high = correction_dim(s.beta_slow)
+  if s.truncate:
+    low, high = math.floor(low), math.ceil(high)
+  low, high = max(low, 0), min(high, dim - 1)
+  if low == high:
+    high += 0.001  # prevent singularity
+
+  pos_freqs = base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+  inv_extrapolation = 1.0 / pos_freqs
+  inv_interpolation = 1.0 / (s.factor * pos_freqs)
+  ramp = jnp.clip((jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low), 0.0, 1.0)
+  extrapolation_factor = 1.0 - ramp
+  return inv_interpolation * (1.0 - extrapolation_factor) + inv_extrapolation * extrapolation_factor
 
 
 def _llama3_scale(inv_freq: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
@@ -39,15 +77,35 @@ def _llama3_scale(inv_freq: jnp.ndarray, s: RopeScaling) -> jnp.ndarray:
   return jnp.where(is_mid, scaled_mid, out)
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray, attn_factor: float = 1.0) -> jnp.ndarray:
   """Rotate ``x`` [..., S, H, head_dim] by angles from ``positions`` [..., S].
 
   Half-rotation convention: (x1, x2) = split(x, 2, axis=-1);
-  out = (x1*cos - x2*sin, x2*cos + x1*sin).
+  out = (x1*cos - x2*sin, x2*cos + x1*sin). ``attn_factor`` (yarn) scales
+  cos/sin.
   """
   angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]  # [..., S, half]
-  cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, half]
-  sin = jnp.sin(angles)[..., None, :]
+  cos = jnp.cos(angles)[..., None, :] * attn_factor  # [..., S, 1, half]
+  sin = jnp.sin(angles)[..., None, :] * attn_factor
   x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
   out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+  return out.astype(x.dtype)
+
+
+def apply_rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray, attn_factor: float = 1.0) -> jnp.ndarray:
+  """Rotate with deepseek's interleaved pairing: channel 2i pairs with 2i+1.
+
+  Matches HF ``apply_rotary_emb`` for deepseek-v2/v3 (complex multiply over
+  adjacent pairs; yarn's ``attn_factor`` scales freqs_cis) — checkpoints
+  store q_pe/k_pe in this layout, so no load permutation is needed.
+  """
+  angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]  # [..., S, half]
+  cos = jnp.cos(angles)[..., None, :] * attn_factor
+  sin = jnp.sin(angles)[..., None, :] * attn_factor
+  xf = x.astype(jnp.float32)
+  even = xf[..., 0::2]
+  odd = xf[..., 1::2]
+  out_even = even * cos - odd * sin
+  out_odd = even * sin + odd * cos
+  out = jnp.stack([out_even, out_odd], axis=-1).reshape(x.shape)
   return out.astype(x.dtype)

@@ -105,6 +105,19 @@ def decoder_param_specs(fsdp: bool = False) -> dict:
     "bq": P(None, "tp"),
     "bk": P(None, "tp"),
     "bv": P(None, "tp"),
+    # MLA (deepseek): the latent projections are shared across heads
+    # (replicated); the per-head up-projections (wq_b, wkv_b) are
+    # column-parallel like wq, and wo stays row-parallel.
+    "wq_a": P(None, d, None),
+    "q_a_norm": P(None, None),
+    "wq_b": P(None, None, "tp"),
+    "wkv_a": P(None, d, None),
+    "kv_a_norm": P(None, None),
+    "wkv_b": P(None, None, "tp"),
+    "wq_a_scale": P(None, None),
+    "wq_b_scale": P(None, "tp"),
+    "wkv_a_scale": P(None, None),
+    "wkv_b_scale": P(None, "tp"),
     "mlp_norm": P(None, None),
     "w_gate": P(None, d, "tp"),
     "w_up": P(None, d, "tp"),
@@ -115,6 +128,10 @@ def decoder_param_specs(fsdp: bool = False) -> dict:
     "wq_lora_b": P(None, None, "tp"),
     "wv_lora_a": P(None, d, None),
     "wv_lora_b": P(None, None, "tp"),
+    "wq_b_lora_a": P(None, None, None),
+    "wq_b_lora_b": P(None, None, "tp"),
+    "wkv_b_lora_a": P(None, None, None),
+    "wkv_b_lora_b": P(None, None, "tp"),
     # int8 per-output-channel scales (models/quantize.py) follow their
     # weight's output-dim sharding.
     "wq_scale": P(None, "tp"),

@@ -52,7 +52,9 @@ _CARDS: list[ModelCard] = [
   _card("mistral-7b", 32, "Mistral 7B Instruct", "mistral", "mistralai/Mistral-7B-Instruct-v0.3"),
   _card("mistral-nemo", 40, "Mistral Nemo", "mistral", "unsloth/Mistral-Nemo-Instruct-2407-bnb-4bit"),
   _card("mistral-large", 88, "Mistral Large", "mistral", "unsloth/Mistral-Large-Instruct-2407-bnb-4bit"),
-  # deepseek (MoE entries kept for registry parity; dense distills are runnable)
+  # deepseek — fully runnable here (MLA attention + MoE + group-limited
+  # routing, models/decoder.py), unlike the reference where these entries
+  # cannot load (SURVEY.md §2.11)
   _card("deepseek-coder-v2-lite", 27, "Deepseek Coder V2 Lite", "deepseek-moe", "deepseek-ai/DeepSeek-Coder-V2-Lite-Instruct"),
   _card("deepseek-v3", 61, "Deepseek V3", "deepseek-moe", "unsloth/DeepSeek-V3-bf16"),
   _card("deepseek-r1", 61, "Deepseek R1", "deepseek-moe", "deepseek-ai/DeepSeek-R1"),

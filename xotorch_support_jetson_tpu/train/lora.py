@@ -21,6 +21,9 @@ def lora_scale(rank: int, alpha: float | None = None) -> float:
 
 
 _STACKS = ("layers", "moe_layers")  # adapters attach to every stack present
+# MLA attention (deepseek) has no wq/wv when q is LoRA-compressed; the
+# equivalent per-head projections are the q and kv up-projections.
+_MLA_TARGET_MAP = {"wq": "wq_b", "wv": "wkv_b"}
 
 
 def add_lora(params: dict, rank: int, key: jax.Array, targets: tuple[str, ...] = LORA_TARGETS) -> dict:
@@ -36,12 +39,15 @@ def add_lora(params: dict, rank: int, key: jax.Array, targets: tuple[str, ...] =
       continue
     layers = dict(params[stack_name])
     for target in targets:
-      w = layers[target]  # [L, D_in, D_out]
+      actual = target if target in layers else _MLA_TARGET_MAP.get(target)
+      if actual is None or actual not in layers:
+        continue
+      w = layers[actual]  # [L, D_in, D_out]
       L, d_in, d_out = w.shape
       sub = jax.random.fold_in(key, salt)
       salt += 1
-      layers[f"{target}_lora_a"] = (jax.random.normal(sub, (L, d_in, rank), jnp.float32) / rank).astype(w.dtype)
-      layers[f"{target}_lora_b"] = jnp.zeros((L, rank, d_out), w.dtype)
+      layers[f"{actual}_lora_a"] = (jax.random.normal(sub, (L, d_in, rank), jnp.float32) / rank).astype(w.dtype)
+      layers[f"{actual}_lora_b"] = jnp.zeros((L, rank, d_out), w.dtype)
     out[stack_name] = layers
   return out
 
@@ -55,12 +61,15 @@ def merge_lora(params: dict, rank: int, targets: tuple[str, ...] = LORA_TARGETS)
       continue
     layers = dict(params[stack_name])
     for target in targets:
-      a = layers.pop(f"{target}_lora_a", None)
-      b = layers.pop(f"{target}_lora_b", None)
+      actual = target if f"{target}_lora_a" in layers else _MLA_TARGET_MAP.get(target)
+      if actual is None:
+        continue
+      a = layers.pop(f"{actual}_lora_a", None)
+      b = layers.pop(f"{actual}_lora_b", None)
       if a is None or b is None:
         continue
       delta = jnp.einsum("ldr,lro->ldo", a.astype(jnp.float32), b.astype(jnp.float32)) * scale
-      layers[target] = (layers[target].astype(jnp.float32) + delta).astype(layers[target].dtype)
+      layers[actual] = (layers[actual].astype(jnp.float32) + delta).astype(layers[actual].dtype)
     out[stack_name] = layers
   return out
 
