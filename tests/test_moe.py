@@ -227,7 +227,9 @@ def test_mla_decode_cache_matches_full_forward():
     shared_expert_dim=32,
     first_k_dense=1,
   )
-  assert cfg.is_mla and cfg.cache_k_dim == 24 and cfg.cache_v_dim == 16
+  # Latent cache: "k" holds the kv latent (rank), "v" the rope channel.
+  assert cfg.is_mla and cfg.cache_kv_heads == 1
+  assert cfg.cache_k_dim == cfg.kv_lora_rank and cfg.cache_v_dim == cfg.qk_rope_head_dim
   params, shard = full_model_params(jax.random.PRNGKey(12), cfg, "mla-test")
 
   S = 6

@@ -103,19 +103,22 @@ class ModelConfig:
   def qk_head_dim(self) -> int:
     return self.qk_nope_head_dim + self.qk_rope_head_dim if self.is_mla else self.head_dim
 
-  # KV-cache geometry (models/decoder.py init_kv_cache): MLA caches full
-  # per-head K/V (k and v widths differ); dense caches GQA heads.
+  # KV-cache geometry (models/decoder.py init_kv_cache): MLA caches the
+  # *latent* (shared kv latent in the "k" buffer, rope channel in the "v"
+  # buffer — rank+rope floats per token instead of per-head K/V; the kv_b
+  # up-projection is absorbed into attention, ops/attention.py
+  # mla_absorbed_attention). Dense models cache GQA heads.
   @property
   def cache_kv_heads(self) -> int:
-    return self.n_heads if self.is_mla else self.n_kv_heads
+    return 1 if self.is_mla else self.n_kv_heads
 
   @property
   def cache_k_dim(self) -> int:
-    return self.qk_head_dim if self.is_mla else self.head_dim
+    return self.kv_lora_rank if self.is_mla else self.head_dim
 
   @property
   def cache_v_dim(self) -> int:
-    return self.v_head_dim if self.is_mla else self.head_dim
+    return self.qk_rope_head_dim if self.is_mla else self.head_dim
 
   def __post_init__(self):
     if self.head_dim == 0:

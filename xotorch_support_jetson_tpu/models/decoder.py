@@ -182,14 +182,15 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
 _MLA_NORM_EPS = 1e-6
 
 
-def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
+def _mla_latents(x, p, cfg: ModelConfig, positions, inv_freq):
   """Multi-head latent attention projections (deepseek-v2/v3).
 
   Parity with HF ``DeepseekV2Attention``/``DeepseekV3Attention``: queries
   optionally LoRA-compressed (wq_a/q_a_norm/wq_b; direct wq when
   cfg.q_lora_rank == 0), KV compressed to a shared ``kv_lora_rank`` latent
   plus a single MQA rope channel; rope (interleaved pairing) applies only to
-  the rope parts. Returns (q [B,S,H,qk], k [B,S,H,qk], v [B,S,H,v]).
+  the rope parts. Returns (q_nope [B,S,H,nope], q_pe [B,S,H,rope] roped,
+  c_kv [B,S,rank] normed, k_pe [B,S,rope] roped).
   """
   B, S, D = x.shape
   H, nope, rope = cfg.n_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
@@ -209,18 +210,32 @@ def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
 
   kv_a = _mm(x, p, "wkv_a")  # [B, S, kv_lora_rank + rope]
   c_kv = rms_norm(kv_a[..., : cfg.kv_lora_rank], p["kv_a_norm"], _MLA_NORM_EPS)
-  k_pe = kv_a[..., cfg.kv_lora_rank :][:, :, None, :]  # [B, S, 1, rope] shared across heads
-  kv = _mm(c_kv, p, "wkv_b")
-  if "wkv_b_lora_a" in p:
-    kv = kv + ((c_kv @ p["wkv_b_lora_a"]) @ p["wkv_b_lora_b"]) * 2.0
-  kv = kv.reshape(B, S, H, nope + cfg.v_head_dim)
-  k_nope, v = kv[..., :nope], kv[..., nope:]
 
   m = rope_attention_factor(cfg)
   q_pe = apply_rope_interleaved(q_pe, positions, inv_freq, m)
-  k_pe = apply_rope_interleaved(k_pe, positions, inv_freq, m)
+  k_pe = apply_rope_interleaved(kv_a[..., cfg.kv_lora_rank :][:, :, None, :], positions, inv_freq, m)[:, :, 0, :]
+  return q_nope, q_pe, c_kv, k_pe
+
+
+def _mla_w_kv_b(p, dtype):
+  """The kv_b up-projection with int8 scales / LoRA folded in ([rank, H*(nope+v)])."""
+  w = p["wkv_b"]
+  if "wkv_b_scale" in p:
+    w = w.astype(dtype) * p["wkv_b_scale"][None, :].astype(dtype)
+  if "wkv_b_lora_a" in p:
+    w = w.astype(dtype) + (p["wkv_b_lora_a"] @ p["wkv_b_lora_b"]).astype(dtype) * 2.0
+  return w
+
+
+def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
+  """Naive (non-absorbed) MLA q/k/v — the cache-less/training path."""
+  B, S, D = x.shape
+  H, nope, rope = cfg.n_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+  q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
+  kv = (c_kv @ _mla_w_kv_b(p, x.dtype)).reshape(B, S, H, nope + cfg.v_head_dim)
+  k_nope, v = kv[..., :nope], kv[..., nope:]
   q = jnp.concatenate([q_nope, q_pe], axis=-1)
-  k = jnp.concatenate([k_nope, jnp.broadcast_to(k_pe, (B, S, H, rope))], axis=-1)
+  k = jnp.concatenate([k_nope, jnp.broadcast_to(k_pe[:, :, None, :], (B, S, H, rope))], axis=-1)
   return q, k, v
 
 
@@ -237,42 +252,63 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   p = layer_params
 
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
-  if "wkv_a" in p:  # MLA (deepseek-v2/v3): latent-compressed KV + MQA rope channel
-    q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
-  else:
-    q = _mm(x, p, "wq")
-    k = _mm(x, p, "wk")
-    v = _mm(x, p, "wv")
-    # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
-    if "wq_lora_a" in p:
-      q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
-    if "wv_lora_a" in p:
-      v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
-    if "bq" in p:
-      q = q + p["bq"]
-      k = k + p["bk"]
-      v = v + p["bv"]
-    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    m = rope_attention_factor(cfg)
-    q = apply_rope(q, positions, inv_freq, m)
-    k = apply_rope(k, positions, inv_freq, m)
+  if "wkv_a" in p and use_cache:
+    # MLA with cache: write only the latent (+rope channel) and attend via
+    # weight absorption (ops/attention.py mla_absorbed_attention) — the cache
+    # holds rank+rope floats per token instead of H·(qk+v).
+    from ..ops.attention import mla_absorbed_attention
 
-  if use_cache:
+    q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
     start = positions[:, 0]
-    k_cache = _write_cache(k_cache, k, start)
-    v_cache = _write_cache(v_cache, v, start)
-    from ..ops.pallas_attention import flash_attention_prefill, flash_supported
-
-    if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
-      # Prefill on TPU: flash kernel against the full cache (stale slots
-      # beyond the prompt are positionally masked — slot index > position).
-      attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=0)
-    else:
-      attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
+    k_cache = _write_cache(k_cache, c_kv[:, :, None, :], start)
+    v_cache = _write_cache(v_cache, k_pe[:, :, None, :], start)
+    attn = mla_absorbed_attention(
+      q_nope,
+      q_pe,
+      k_cache[:, :, 0, :].astype(h.dtype),
+      v_cache[:, :, 0, :].astype(h.dtype),
+      _mla_w_kv_b(p, h.dtype),
+      positions,
+      kv_positions,
+      cfg.v_head_dim,
+    )
   else:
-    attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
+    if "wkv_a" in p:  # MLA, cache-less (training): naive per-head K/V
+      q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
+    else:
+      q = _mm(x, p, "wq")
+      k = _mm(x, p, "wk")
+      v = _mm(x, p, "wv")
+      # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
+      if "wq_lora_a" in p:
+        q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
+      if "wv_lora_a" in p:
+        v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
+      if "bq" in p:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+      q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+      k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+      v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+      m = rope_attention_factor(cfg)
+      q = apply_rope(q, positions, inv_freq, m)
+      k = apply_rope(k, positions, inv_freq, m)
+
+    if use_cache:
+      start = positions[:, 0]
+      k_cache = _write_cache(k_cache, k, start)
+      v_cache = _write_cache(v_cache, v, start)
+      from ..ops.pallas_attention import flash_attention_prefill, flash_supported
+
+      if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
+        # Prefill on TPU: flash kernel against the full cache (stale slots
+        # beyond the prompt are positionally masked — slot index > position).
+        attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=0)
+      else:
+        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
+    else:
+      attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
 
   h = h + _mm(attn.reshape(B, S, -1), p, "wo")
 

@@ -47,3 +47,47 @@ def gqa_attention(
   probs = jax.nn.softmax(scores, axis=-1)
   out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
   return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)
+
+
+def mla_absorbed_attention(
+  q_nope: jnp.ndarray,  # [B, Sq, H, nope]
+  q_pe: jnp.ndarray,  # [B, Sq, H, rope] (rope already applied)
+  ckv: jnp.ndarray,  # [B, Skv, rank] cached KV latent (post kv_a_norm)
+  kpe: jnp.ndarray,  # [B, Skv, rope] cached rope channel (rope already applied)
+  w_kv_b: jnp.ndarray,  # [rank, H*(nope+v)] up-projection
+  q_positions: jnp.ndarray,  # [B, Sq]
+  kv_positions: jnp.ndarray,  # [Skv]
+  v_dim: int,
+) -> jnp.ndarray:
+  """MLA attention against the *latent* cache (weight absorption).
+
+  Instead of materializing per-head K/V (H·(qk+v) floats per cached token),
+  the cache holds only the shared latent + rope channel (rank+rope floats —
+  ~9× smaller for deepseek-v2-lite, ~71× for v3 geometry), and the kv_b
+  up-projection is folded into the query/output sides:
+
+    score_h(t) = (q_nope_h · W_k_hᵀ) · ckv(t) + q_pe_h · kpe(t)
+    out_h      = (Σ_t p_t ckv(t)) · W_v_h
+
+  Decode is HBM-bound on the cache read, so shrinking cached bytes is the
+  long-context lever (SURVEY.md §5.7 is greenfield in the reference).
+  Returns [B, Sq, H, v_dim] in q_nope.dtype.
+  """
+  B, Sq, H, nope = q_nope.shape
+  rank = ckv.shape[-1]
+  rope = q_pe.shape[-1]
+  W = w_kv_b.reshape(rank, H, nope + v_dim)
+  w_k = W[..., :nope].astype(jnp.float32)  # [rank, H, nope]
+  w_v = W[..., nope:].astype(jnp.float32)  # [rank, H, v]
+  scale = 1.0 / jnp.sqrt(jnp.asarray(nope + rope, dtype=jnp.float32))
+
+  q_abs = jnp.einsum("bshn,rhn->bshr", q_nope.astype(jnp.float32), w_k)  # [B,Sq,H,rank]
+  scores = jnp.einsum("bshr,btr->bhst", q_abs, ckv.astype(jnp.float32))
+  scores = scores + jnp.einsum("bshp,btp->bhst", q_pe.astype(jnp.float32), kpe.astype(jnp.float32))
+  scores = scores * scale
+  mask = kv_positions[None, None, None, :] <= q_positions[:, None, :, None]  # [B,1,Sq,Skv]
+  scores = jnp.where(mask, scores, NEG_INF)
+  probs = jax.nn.softmax(scores, axis=-1)
+  ctx = jnp.einsum("bhst,btr->bshr", probs, ckv.astype(jnp.float32))  # [B,Sq,H,rank]
+  out = jnp.einsum("bshr,rhv->bshv", ctx, w_v)
+  return out.astype(q_nope.dtype)
