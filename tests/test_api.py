@@ -125,9 +125,24 @@ def test_build_prompt_multimodal_flatten():
   from xotorch_support_jetson_tpu.api.chatgpt_api import Message
 
   tok = DummyTokenizer()
-  messages = [Message("user", [{"type": "text", "text": "hi"}, {"type": "image_url", "image_url": {"url": "x"}}])]
-  prompt = build_prompt(tok, messages)
+  messages = [
+    Message(
+      "user",
+      [
+        {"type": "text", "text": "hi"},
+        {"type": "image_url", "image_url": {"url": "x"}},  # non-data URL: dropped (no egress)
+        {"type": "image_url", "image_url": {"url": "data:image/png;base64,aGk="}},
+      ],
+    )
+  ]
+  prompt, images = build_prompt(tok, messages, vision=True)
   assert "hi" in prompt
+  assert "<image>" in prompt  # placeholder for the processor to expand
+  assert images == ["aGk="]
+
+  # Text-only serving model: images dropped cleanly, no placeholder pollution.
+  prompt_txt, images_txt = build_prompt(tok, messages)
+  assert "<image>" not in prompt_txt and images_txt == []
 
 
 @pytest.mark.asyncio

@@ -1,0 +1,137 @@
+"""LLaVA vision-path golden tests vs HF transformers (torch CPU).
+
+The reference registers llava-1.5 and remaps image messages in the API but
+has no vision compute path (SURVEY.md §2.3/2.4); here the CLIP tower +
+projector + embedding merge (models/vision.py) must match HF
+``LlavaForConditionalGeneration`` logits exactly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import load_model_config
+from xotorch_support_jetson_tpu.models.decoder import shard_forward
+from xotorch_support_jetson_tpu.models.loader import load_shard_weights
+from xotorch_support_jetson_tpu.models.vision import encode_images, merge_image_embeddings
+
+IMAGE_TOKEN = 127
+
+
+def _save_tiny_llava(tmp_path):
+  import torch
+  from transformers import CLIPVisionConfig, LlamaConfig, LlavaConfig, LlavaForConditionalGeneration
+
+  torch.manual_seed(0)
+  vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=3, num_attention_heads=4, image_size=28, patch_size=14)
+  tc = LlamaConfig(
+    vocab_size=128,
+    hidden_size=48,
+    intermediate_size=96,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    tie_word_embeddings=False,
+  )
+  cfg = LlavaConfig(vision_config=vc, text_config=tc, image_token_index=IMAGE_TOKEN)
+  model = LlavaForConditionalGeneration(cfg).to(torch.float32).eval()
+  model.save_pretrained(tmp_path, safe_serialization=True)
+
+  # 4 patches (28/14)^2 ⇒ 4 image placeholder tokens.
+  input_ids = torch.tensor([[1, IMAGE_TOKEN, IMAGE_TOKEN, IMAGE_TOKEN, IMAGE_TOKEN, 5, 9, 2]])
+  pixel_values = torch.randn(1, 3, 28, 28)
+  with torch.no_grad():
+    ref = model(input_ids=input_ids, pixel_values=pixel_values).logits.numpy()
+  return np.asarray(input_ids.numpy()), pixel_values.numpy(), ref
+
+
+def test_llava_golden_logits_vs_hf(tmp_path):
+  tokens_np, pixels_np, ref_logits = _save_tiny_llava(tmp_path)
+
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  assert cfg.vision is not None and cfg.image_token_id == IMAGE_TOKEN
+  assert cfg.vision.n_patches == 4
+
+  shard = Shard("tiny-llava", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+  assert "vision" in params and "projector" in params
+
+  tokens = jnp.asarray(tokens_np, dtype=jnp.int32)
+  feats = encode_images(params["vision"], params["projector"], cfg.vision, jnp.asarray(pixels_np))
+  assert feats.shape == (1, 4, cfg.dim)
+
+  embeds = jnp.take(params["embed"], tokens, axis=0)
+  merged = merge_image_embeddings(embeds, tokens, feats, cfg.image_token_id)
+  positions = jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+  logits, _ = shard_forward(params, cfg, shard, merged, positions, None)
+
+  np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_llava_text_only_still_works(tmp_path):
+  """Without images the model is a plain text decoder (no vision compute)."""
+  _, _, _ = _save_tiny_llava(tmp_path)
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  shard = Shard("tiny-llava", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+  tokens = jnp.asarray([[1, 5, 9, 2]], dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (1, 4))
+  logits, _ = shard_forward(params, cfg, shard, tokens, positions, None)
+  assert logits.shape == (1, 4, cfg.vocab_size)
+  assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_engine_multimodal_prefill_and_decode(tmp_path):
+  """Engine plumbing: images in state.extras ride through infer_prompt into a
+  merged-embedding prefill, then normal decode continues (asyncio path)."""
+  import asyncio
+  import base64
+  import io
+
+  from PIL import Image
+
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.inference.state import InferenceState
+
+  tokens_np, pixels_np, _ = _save_tiny_llava(tmp_path)
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  shard = Shard("tiny-llava", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+
+  class FakeProcessor:
+    """Stands in for AutoProcessor: expands <image> and preprocesses pixels."""
+
+    eos_token_id = 2
+
+    def __call__(self, text, images, return_tensors):
+      assert "<image>" in text and len(images) == 1
+      return {"input_ids": tokens_np, "pixel_values": pixels_np}
+
+    def encode(self, text):
+      return [1, 5, 9]
+
+    def decode(self, toks):
+      return " ".join(str(t) for t in toks)
+
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, cfg, params, tokenizer=FakeProcessor())
+
+  png = io.BytesIO()
+  Image.new("RGB", (28, 28), (128, 64, 32)).save(png, format="PNG")
+  b64 = base64.b64encode(png.getvalue()).decode()
+
+  async def run():
+    state = InferenceState(extras={"images": [b64]})
+    out, state = await engine.infer_prompt("req-mm", shard, "describe <image>", state)
+    assert out.shape == (1, cfg.vocab_size)  # last-shard logits row
+    assert state.prompt_len == tokens_np.shape[1]
+    assert state.tokens is not None and state.tokens.shape == tokens_np.shape
+    # decode one step off the merged prefill
+    nxt = np.argmax(out, axis=-1).astype(np.int32).reshape(1, 1)
+    out2, state = await engine.infer_tensor("req-mm", shard, nxt, state)
+    assert out2.shape == (1, cfg.vocab_size)
+    assert np.all(np.isfinite(out2))
+
+  asyncio.run(run())

@@ -45,21 +45,36 @@ class ChatCompletionRequest:
     self.stream = stream
 
 
-def remap_messages(messages: list[Message]) -> list[Message]:
-  """Flatten multimodal content blocks to text (image support: vision models
-  not yet wired into the jax engine — reference :97-128 remaps for llava)."""
+def remap_messages(messages: list[Message], vision: bool = False) -> tuple[list[Message], list[str]]:
+  """Flatten multimodal content blocks. With ``vision`` (the serving model
+  has a tower, models/vision.py) each data-URL image becomes an ``<image>``
+  placeholder (the llava processor expands it to patch tokens) and its
+  base64 payload is collected for the engine; for text-only models images
+  are dropped cleanly, leaving no placeholder in the prompt. Role of
+  reference ``chatgpt_api.py:97-128`` — but backed by a real vision path."""
   remapped = []
+  images: list[str] = []
   for message in messages:
     if isinstance(message.content, list):
-      text = " ".join(part.get("text", "") for part in message.content if isinstance(part, dict) and part.get("type") == "text")
-      remapped.append(Message(message.role, text, message.tools))
+      parts = []
+      for part in message.content:
+        if not isinstance(part, dict):
+          continue
+        if part.get("type") == "text":
+          parts.append(part.get("text", ""))
+        elif part.get("type") == "image_url" and vision:
+          url = (part.get("image_url") or {}).get("url", "")
+          if url.startswith("data:") and "," in url:
+            images.append(url.split(",", 1)[1])
+            parts.append("<image>")
+      remapped.append(Message(message.role, " ".join(parts), message.tools))
     else:
       remapped.append(message)
-  return remapped
+  return remapped, images
 
 
-def build_prompt(tokenizer, _messages: list[Message], tools=None) -> str:
-  messages = remap_messages(_messages)
+def build_prompt(tokenizer, _messages: list[Message], tools=None, vision: bool = False) -> tuple[str, list[str]]:
+  messages, images = remap_messages(_messages, vision=vision)
   chat_template_args = {
     "conversation": [m.to_dict() for m in messages],
     "tokenize": False,
@@ -68,12 +83,12 @@ def build_prompt(tokenizer, _messages: list[Message], tools=None) -> str:
   if tools:
     chat_template_args["tools"] = tools
   try:
-    return tokenizer.apply_chat_template(**chat_template_args)
+    return tokenizer.apply_chat_template(**chat_template_args), images
   except TypeError:
     # Tokenizers without `conversation=` kwarg naming.
     args = dict(chat_template_args)
     conv = args.pop("conversation")
-    return tokenizer.apply_chat_template(conv, **args)
+    return tokenizer.apply_chat_template(conv, **args), images
 
 
 def parse_message(data: dict) -> Message:
@@ -307,7 +322,7 @@ class ChatGPTAPI:
       return web.json_response({"error": f"Unsupported model: {model}"}, status=400)
     messages = [parse_message(m) for m in data.get("messages", [])]
     tokenizer = await self._tokenizer_for(shard)
-    prompt = build_prompt(tokenizer, messages, data.get("tools"))
+    prompt, _images = build_prompt(tokenizer, messages, data.get("tools"))
     tokens = tokenizer.encode(prompt)
     return web.json_response({"length": len(prompt), "num_tokens": len(tokens), "encoded_tokens": [int(t) for t in tokens], "encoded_prompt": prompt})
 
@@ -350,7 +365,13 @@ class ChatGPTAPI:
       chat_request.messages.insert(0, Message("system", self.system_prompt))
 
     tokenizer = await self._tokenizer_for(shard)
-    prompt = build_prompt(tokenizer, chat_request.messages, chat_request.tools)
+    card = registry.model_cards.get(chat_request.model)
+    vision = card is not None and card.family == "llava"
+    # Local-checkpoint override (XOT_TPU_MODEL_DIR) can serve a vision model
+    # under any id — trust the loaded engine config when present.
+    engine_cfg = getattr(self.node.inference_engine, "cfg", None)
+    vision = vision or getattr(engine_cfg, "vision", None) is not None
+    prompt, images = build_prompt(tokenizer, chat_request.messages, chat_request.tools, vision=vision)
     request_id = str(uuid.uuid4())
     if self.on_chat_completion_request:
       try:
@@ -369,8 +390,16 @@ class ChatGPTAPI:
         max_tokens=chat_request.max_tokens,
         temperature=chat_request.temperature,
       )
+    initial_state = None
+    if images:
+      from ..inference.state import InferenceState
+
+      initial_state = InferenceState(extras={"images": images})
     try:
-      await asyncio.wait_for(asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))), timeout=self.response_timeout)
+      await asyncio.wait_for(
+        asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
+        timeout=self.response_timeout,
+      )
 
       if chat_request.stream:
         return await self._stream_response(request, chat_request, request_id, tokenizer, created)

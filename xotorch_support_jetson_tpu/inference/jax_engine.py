@@ -165,7 +165,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
       return cache
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    tp = "tp" if self.cfg.n_kv_heads % self.mesh.shape["tp"] == 0 else None
+    heads = self.cfg.cache_kv_heads  # MLA latent cache has a size-1 head axis
+    tp = "tp" if heads > 1 and heads % self.mesh.shape["tp"] == 0 else None
     spec = NamedSharding(self.mesh, P(None, None, None, tp, None))
     return jax.tree.map(lambda x: jax.device_put(x, spec), cache)
 
@@ -175,7 +176,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     repo = registry.get_repo(shard.model_id, type(self).__name__) or shard.model_id
     local = getattr(self, "_model_dir", None)
-    self.tokenizer = await resolve_tokenizer(repo, local)
+    prefer_processor = self.cfg is not None and self.cfg.vision is not None
+    self.tokenizer = await resolve_tokenizer(repo, local, prefer_processor=prefer_processor)
 
   def load_test_model(self, shard: Shard, cfg, params, tokenizer=None) -> None:
     """Directly inject a model (unit tests / local pipeline composition)."""
@@ -213,6 +215,57 @@ class JaxShardedInferenceEngine(InferenceEngine):
       self._key = jax.random.PRNGKey(self._seed)
     self._key, sub = jax.random.split(self._key)
     return np.asarray(sample_logits(logits, sub, temp=temp, top_k=top_k))
+
+  async def infer_prompt(
+    self,
+    request_id: str,
+    shard: Shard,
+    prompt: str,
+    inference_state: InferenceState | None = None,
+  ) -> tuple[np.ndarray, InferenceState]:
+    """Adds the llava vision path on top of the base encode→infer_tensor:
+    when the request carries images (state.extras["images"], base64 — set by
+    the API) and the loaded model has a vision tower, the prompt's <image>
+    placeholders are expanded by the HF processor, the CLIP tower + projector
+    run on-device, and the patch features are merged into the token
+    embeddings before prefill (models/vision.py)."""
+    images = (inference_state.extras.pop("images", None) if inference_state and inference_state.extras else None)
+    await self.ensure_shard(shard)
+    if images and self.cfg is not None and self.cfg.vision is not None and shard.is_first_layer:
+      return await asyncio.get_event_loop().run_in_executor(
+        self.executor, self._infer_prompt_multimodal_sync, request_id, shard, prompt, images, inference_state or InferenceState()
+      )
+    return await super().infer_prompt(request_id, shard, prompt, inference_state)
+
+  def _infer_prompt_multimodal_sync(self, request_id, shard, prompt, images_b64, state):
+    import base64
+    import io
+
+    from PIL import Image
+
+    from ..models.vision import encode_images, merge_image_embeddings
+
+    pil_images = [Image.open(io.BytesIO(base64.b64decode(b))).convert("RGB") for b in images_b64]
+    # The resolved "tokenizer" for llava repos is the AutoProcessor
+    # (inference/tokenizers.py) — it expands each <image> into n_patches
+    # placeholder ids and normalizes pixels to the CLIP layout.
+    proc = self.tokenizer
+    out = proc(text=prompt, images=pil_images, return_tensors="np")
+    tokens = np.asarray(out["input_ids"], dtype=np.int32)
+    pixel_values = np.asarray(out["pixel_values"], dtype=np.float32)
+    B, S = tokens.shape
+
+    feats = encode_images(self.params["vision"], self.params["projector"], self.cfg.vision, jnp.asarray(pixel_values))
+    pad_to = min(_round_up(S, PREFILL_BUCKET), min(self.max_seq_len, self.cfg.max_seq_len))
+    tok_pad = np.zeros((B, pad_to), dtype=np.int32)
+    tok_pad[:, :S] = tokens
+    embeds = jnp.take(self.params["embed"], jnp.asarray(tok_pad), axis=0).astype(self.cfg.dtype)
+    merged = merge_image_embeddings(embeds, jnp.asarray(tok_pad), feats, self.cfg.image_token_id)
+
+    state.prompt_len = S
+    out_np, state = self._infer_tensor_sync(request_id, shard, np.asarray(merged), state)
+    state.tokens = tokens  # the hidden-input path doesn't record token ids
+    return out_np, state
 
   async def infer_tensor(
     self,

@@ -29,36 +29,50 @@ class _TokenizerCache:
 _cache = _TokenizerCache()
 
 
-def _load_tokenizer(source: str):
+def _patch_processor(processor):
+  inner = getattr(processor, "tokenizer", None)
+  if inner is not None:
+    # Patch the processor so callers can use the tokenizer surface uniformly
+    # (the reference patches eos/encode/decode the same way, tokenizers.py:41-63).
+    processor.eos_token_id = getattr(inner, "eos_token_id", None)
+    processor.encode = inner.encode
+    processor.decode = inner.decode
+    processor.all_special_tokens = getattr(inner, "all_special_tokens", [])
+  return processor
+
+
+def _load_tokenizer(source: str, prefer_processor: bool = False):
   from transformers import AutoProcessor, AutoTokenizer
 
+  if prefer_processor:
+    # Vision models (llava) ship BOTH tokenizer and processor files — the
+    # multimodal path needs the processor (image preprocessing + <image>
+    # expansion), so AutoTokenizer-first would silently break it.
+    try:
+      return _patch_processor(AutoProcessor.from_pretrained(source, trust_remote_code=False))
+    except Exception as e:  # noqa: BLE001
+      if DEBUG >= 2:
+        print(f"[tokenizers] AutoProcessor failed for {source}: {e}; trying AutoTokenizer")
+      return AutoTokenizer.from_pretrained(source, trust_remote_code=False)
   try:
     tok = AutoTokenizer.from_pretrained(source, trust_remote_code=False)
     return tok
-  except Exception as e:  # noqa: BLE001 — processor-only repos (e.g. llava)
+  except Exception as e:  # noqa: BLE001 — processor-only repos
     if DEBUG >= 2:
       print(f"[tokenizers] AutoTokenizer failed for {source}: {e}; trying AutoProcessor")
-    processor = AutoProcessor.from_pretrained(source, trust_remote_code=False)
-    inner = getattr(processor, "tokenizer", None)
-    if inner is not None:
-      # Patch the processor so callers can use the tokenizer surface uniformly
-      # (the reference patches eos/encode/decode the same way, tokenizers.py:41-63).
-      processor.eos_token_id = getattr(inner, "eos_token_id", None)
-      processor.encode = inner.encode
-      processor.decode = inner.decode
-      processor.all_special_tokens = getattr(inner, "all_special_tokens", [])
-    return processor
+    return _patch_processor(AutoProcessor.from_pretrained(source, trust_remote_code=False))
 
 
-async def resolve_tokenizer(repo_id: str, local_dir: str | Path | None = None):
+async def resolve_tokenizer(repo_id: str, local_dir: str | Path | None = None, prefer_processor: bool = False):
   """Resolve from ``local_dir`` if it holds tokenizer files, else from the hub.
 
   ``XOT_TPU_MODEL_DIR`` (the offline checkpoint override, download/downloader.py)
-  doubles as the default local dir.
+  doubles as the default local dir. ``prefer_processor`` selects AutoProcessor
+  first — required for vision models, whose repos also ship tokenizer files.
   """
   if local_dir is None and (env_dir := os.getenv("XOT_TPU_MODEL_DIR")):
     local_dir = env_dir
-  key = str(local_dir or repo_id)
+  key = ("proc:" if prefer_processor else "") + str(local_dir or repo_id)
   if (tok := _cache.get(key)) is not None:
     return tok
   source = repo_id
@@ -66,6 +80,6 @@ async def resolve_tokenizer(repo_id: str, local_dir: str | Path | None = None):
     has_tok = any((Path(local_dir) / f).exists() for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model"))
     if has_tok:
       source = str(local_dir)
-  tok = await asyncio.get_event_loop().run_in_executor(None, _load_tokenizer, source)
+  tok = await asyncio.get_event_loop().run_in_executor(None, _load_tokenizer, source, prefer_processor)
   _cache.put(key, tok)
   return tok

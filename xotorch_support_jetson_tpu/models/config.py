@@ -94,6 +94,10 @@ class ModelConfig:
   qk_nope_head_dim: int = 0
   qk_rope_head_dim: int = 0
   v_head_dim: int = 0
+  # --- vision (llava): CLIP tower + projector config (models/vision.py) and
+  # the placeholder token id the HF processor expands per image patch.
+  vision: Any = None  # VisionConfig | None (Any keeps this module torch/vision-free)
+  image_token_id: int = -1
 
   @property
   def is_mla(self) -> bool:
@@ -145,13 +149,22 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
   (needed e.g. for Llama-3.2 where head_dim * n_heads != hidden_size is
   false but qwen3-style configs carry it explicitly).
   """
+  vision_cfg = None
+  image_token_id = -1
   if "text_config" in hf and isinstance(hf["text_config"], dict):
     # Vision-language checkpoints (llava) nest the decoder config; the text
-    # path runs on the nested config (role of the reference's llava registry
-    # entry + API image remapping, chatgpt_api.py:97-128).
+    # path runs on the nested config, and the vision tower/projector configs
+    # are carried alongside (models/vision.py — a real tower, beyond the
+    # reference's registry entry + API image remapping, chatgpt_api.py:97-128).
+    top = hf
     merged = dict(hf["text_config"])
-    merged.setdefault("vocab_size", hf.get("vocab_size", merged.get("vocab_size")))
+    merged.setdefault("vocab_size", top.get("vocab_size", merged.get("vocab_size")))
     hf = merged
+    image_token_id = int(top.get("image_token_index", -1))
+    if isinstance(top.get("vision_config"), dict):
+      from .vision import vision_config_from_hf
+
+      vision_cfg = vision_config_from_hf(top["vision_config"], int(hf["hidden_size"]), top)
   arch = (hf.get("architectures") or [""])[0].lower()
   model_type = hf.get("model_type", "").lower()
   family = "llama"
@@ -277,6 +290,8 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     family=family,
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
     eos_token_ids=tuple(int(e) for e in eos),
+    vision=vision_cfg,
+    image_token_id=image_token_id,
     **moe,
     **mla,
   )

@@ -59,8 +59,9 @@ def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: in
   """Slot-indexed KV cache: slot j holds the KV of absolute position j.
 
   Geometry comes from the config: GQA heads for dense models; for MLA
-  (deepseek) full per-head K/V with distinct k (qk_head_dim) and v
-  (v_head_dim) widths.
+  (deepseek) the cache is the *latent* — "k" holds the shared kv latent
+  (kv_lora_rank wide), "v" the MQA rope channel (qk_rope_head_dim), one
+  head axis entry (see ops/attention.py mla_absorbed_attention).
   """
   dtype = dtype or cfg.dtype
   k_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_k_dim)

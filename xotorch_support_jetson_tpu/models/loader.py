@@ -80,6 +80,49 @@ _EXPERT_KEY = {
   "down_proj": "w_experts_down",
 }
 
+# Vision tower (llava: CLIP ViT, HF `vision_tower.vision_model.*`) per-layer
+# suffix → (our key, transpose?). Non-layer tensors handled by name below.
+_VISION_LAYER_MAP = {
+  "layer_norm1.weight": ("ln1_scale", False),
+  "layer_norm1.bias": ("ln1_bias", False),
+  "self_attn.q_proj.weight": ("wq", True),
+  "self_attn.q_proj.bias": ("bq", False),
+  "self_attn.k_proj.weight": ("wk", True),
+  "self_attn.k_proj.bias": ("bk", False),
+  "self_attn.v_proj.weight": ("wv", True),
+  "self_attn.v_proj.bias": ("bv", False),
+  "self_attn.out_proj.weight": ("wo", True),
+  "self_attn.out_proj.bias": ("bo", False),
+  "layer_norm2.weight": ("ln2_scale", False),
+  "layer_norm2.bias": ("ln2_bias", False),
+  "mlp.fc1.weight": ("fc1", True),
+  "mlp.fc1.bias": ("bfc1", False),
+  "mlp.fc2.weight": ("fc2", True),
+  "mlp.fc2.bias": ("bfc2", False),
+}
+_VISION_TOP_MAP = {
+  "vision_tower.vision_model.embeddings.class_embedding": ("class_embed", False),
+  "vision_tower.vision_model.embeddings.patch_embedding.weight": ("patch_embed", False),
+  "vision_tower.vision_model.embeddings.position_embedding.weight": ("pos_embed", False),
+  "vision_tower.vision_model.pre_layrnorm.weight": ("pre_ln_scale", False),  # HF's typo, as stored
+  "vision_tower.vision_model.pre_layrnorm.bias": ("pre_ln_bias", False),
+}
+_PROJECTOR_MAP = {
+  "multi_modal_projector.linear_1.weight": ("w1", True),
+  "multi_modal_projector.linear_1.bias": ("b1", False),
+  "multi_modal_projector.linear_2.weight": ("w2", True),
+  "multi_modal_projector.linear_2.bias": ("b2", False),
+}
+_VISION_LAYER_RE = re.compile(r"^vision_tower\.vision_model\.encoder\.layers\.(\d+)\.(.+)$")
+
+
+def _normalize_name(name: str) -> str:
+  """llava checkpoints prefix the text decoder as ``language_model.`` —
+  strip it so the standard maps apply."""
+  if name.startswith("language_model."):
+    return name[len("language_model.") :]
+  return name
+
 
 def _to_numpy(tensor) -> np.ndarray:
   """safetensors tensor (possibly torch bf16) → numpy (ml_dtypes bf16 ok)."""
@@ -104,7 +147,8 @@ def _weight_files_for_shard(model_dir: Path, shard: Shard) -> list[Path]:
   with open(index_path) as f:
     weight_map: dict[str, str] = json.load(f)["weight_map"]
   needed: set[str] = set()
-  for name, fname in weight_map.items():
+  for raw_name, fname in weight_map.items():
+    name = _normalize_name(raw_name)
     m = _LAYER_RE.match(name)
     if m:
       if shard.start_layer <= int(m.group(1)) <= shard.end_layer:
@@ -112,6 +156,8 @@ def _weight_files_for_shard(model_dir: Path, shard: Shard) -> list[Path]:
     elif name.startswith("model.embed_tokens") and (shard.is_first_layer or shard.is_last_layer):
       needed.add(fname)
     elif (name.startswith("model.norm") or name.startswith("lm_head")) and shard.is_last_layer:
+      needed.add(fname)
+    elif raw_name.startswith(("vision_tower.", "multi_modal_projector.")) and shard.is_first_layer:
       needed.add(fname)
   return [model_dir / f for f in sorted(needed)]
 
@@ -123,10 +169,32 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
   model_dir = Path(model_dir)
   per_layer: dict[int, dict[str, np.ndarray]] = {i: {} for i in range(shard.start_layer, shard.end_layer + 1)}
   top: dict[str, np.ndarray] = {}
+  vision_layers: dict[str, dict[int, np.ndarray]] = {}
+  vision_top: dict[str, np.ndarray] = {}
+  projector: dict[str, np.ndarray] = {}
 
   for file in _weight_files_for_shard(model_dir, shard):
     with safe_open(str(file), framework="pt") as f:
-      for name in f.keys():
+      for raw_name in f.keys():
+        name = _normalize_name(raw_name)
+        if raw_name.startswith(("vision_tower.", "multi_modal_projector.")):
+          # llava vision tower + projector ride with the FIRST shard (the
+          # node that embeds the prompt also embeds the images).
+          if not (shard.is_first_layer and cfg.vision is not None):
+            continue
+          vm = _VISION_LAYER_RE.match(raw_name)
+          if vm and vm.group(2) in _VISION_LAYER_MAP:
+            key, tr = _VISION_LAYER_MAP[vm.group(2)]
+            arr = _to_numpy(f.get_tensor(raw_name))
+            vision_layers.setdefault(key, {})[int(vm.group(1))] = arr.T if tr else arr
+          elif raw_name in _VISION_TOP_MAP:
+            key, tr = _VISION_TOP_MAP[raw_name]
+            vision_top[key] = _to_numpy(f.get_tensor(raw_name))
+          elif raw_name in _PROJECTOR_MAP:
+            key, tr = _PROJECTOR_MAP[raw_name]
+            arr = _to_numpy(f.get_tensor(raw_name))
+            projector[key] = arr.T if tr else arr
+          continue
         m = _LAYER_RE.match(name)
         if m:
           layer_idx = int(m.group(1))
@@ -136,23 +204,23 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
           mapped = _LAYER_MAP.get(suffix)
           if mapped is not None:
             key, transpose = mapped
-            arr = _to_numpy(f.get_tensor(name))
+            arr = _to_numpy(f.get_tensor(raw_name))
             per_layer[layer_idx][key] = arr.T if transpose else arr
             continue
           em = _EXPERT_RE.match(suffix)
           if em is not None:
             key = _EXPERT_KEY[em.group(2)]
-            per_layer[layer_idx].setdefault(key, {})[int(em.group(1))] = _to_numpy(f.get_tensor(name)).T
+            per_layer[layer_idx].setdefault(key, {})[int(em.group(1))] = _to_numpy(f.get_tensor(raw_name)).T
             continue
           if DEBUG >= 3:
             print(f"[loader] skipping unmapped tensor {name}")
         elif name == "model.embed_tokens.weight":
           if shard.is_first_layer or (shard.is_last_layer and cfg.tied_embedding):
-            top["embed_tokens"] = _to_numpy(f.get_tensor(name))
+            top["embed_tokens"] = _to_numpy(f.get_tensor(raw_name))
         elif name == "model.norm.weight" and shard.is_last_layer:
-          top["final_norm"] = _to_numpy(f.get_tensor(name))
+          top["final_norm"] = _to_numpy(f.get_tensor(raw_name))
         elif name == "lm_head.weight" and shard.is_last_layer:
-          top["lm_head"] = _to_numpy(f.get_tensor(name)).T
+          top["lm_head"] = _to_numpy(f.get_tensor(raw_name)).T
 
   # Stack per-layer dicts (AoS) into [L, ...] leaves (SoA) for lax.scan —
   # a dense-prefix stack ("layers") and, for MoE models, an MoE stack
@@ -181,6 +249,16 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
     params[stack_name] = {key: jnp.stack([as_leaf(per_layer[i][key], key) for i in indices]) for key in layer_keys}
   if shard.is_first_layer:
     params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)
+    if vision_layers:  # llava: vision tower + projector ride with shard 0
+      L = cfg.vision.n_layers
+      for key, by_idx in vision_layers.items():
+        if sorted(by_idx) != list(range(L)):
+          raise ValueError(f"vision/{key}: missing layers (have {sorted(by_idx)})")
+      params["vision"] = {
+        **{k: jnp.asarray(v, dtype=cfg.dtype) for k, v in vision_top.items()},
+        "layers": {key: jnp.stack([jnp.asarray(by_idx[i], dtype=cfg.dtype) for i in range(L)]) for key, by_idx in vision_layers.items()},
+      }
+      params["projector"] = {k: jnp.asarray(v, dtype=cfg.dtype) for k, v in projector.items()}
   if shard.is_last_layer:
     params["final_norm"] = jnp.asarray(top["final_norm"], dtype=cfg.dtype)
     if "lm_head" in top:

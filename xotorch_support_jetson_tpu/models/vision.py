@@ -1,0 +1,190 @@
+"""LLaVA vision path: CLIP ViT tower + multi-modal projector.
+
+The reference registers llava-1.5 (``models.py:120-125``) and remaps image
+messages in the API (``chatgpt_api.py:97-128``), but its dense text-only
+layer builder cannot actually run the vision tower (SURVEY.md §2.3). Here
+the tower is a real functional JAX ViT:
+
+- patch embedding as one strided conv (XLA lowers it onto the MXU),
+- scan-stacked pre-norm transformer layers (same O(1)-compile-depth design
+  as the text decoder, models/decoder.py),
+- features taken from the hidden state *entering* the selected layer
+  (HF ``vision_feature_layer=-2`` ⇒ run all but the last layer), CLS dropped
+  under the "default" select strategy,
+- two-layer GELU projector into the text embedding space.
+
+Parity target: HF ``LlavaForConditionalGeneration`` (CLIPVisionModel +
+LlavaMultiModalProjector) — verified by golden test (tests/test_vision.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+  hidden_size: int
+  intermediate_size: int
+  n_layers: int
+  n_heads: int
+  image_size: int
+  patch_size: int
+  layer_norm_eps: float = 1e-5
+  feature_layer: int = -2  # HF vision_feature_layer
+  drop_cls: bool = True  # vision_feature_select_strategy == "default"
+  projector_dim: int = 0  # text embedding width
+
+  @property
+  def n_patches(self) -> int:
+    return (self.image_size // self.patch_size) ** 2
+
+
+def vision_config_from_hf(vision_hf: dict, text_dim: int, top: dict | None = None) -> VisionConfig:
+  top = top or {}
+  return VisionConfig(
+    hidden_size=int(vision_hf["hidden_size"]),
+    intermediate_size=int(vision_hf["intermediate_size"]),
+    n_layers=int(vision_hf["num_hidden_layers"]),
+    n_heads=int(vision_hf["num_attention_heads"]),
+    image_size=int(vision_hf.get("image_size", 336)),
+    patch_size=int(vision_hf.get("patch_size", 14)),
+    layer_norm_eps=float(vision_hf.get("layer_norm_eps", 1e-5)),
+    feature_layer=int(top.get("vision_feature_layer", -2)),
+    drop_cls=top.get("vision_feature_select_strategy", "default") == "default",
+    projector_dim=text_dim,
+  )
+
+
+def _layer_norm(x, scale, bias, eps):
+  xf = x.astype(jnp.float32)
+  mean = jnp.mean(xf, axis=-1, keepdims=True)
+  var = jnp.var(xf, axis=-1, keepdims=True)
+  return ((xf - mean) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _quick_gelu(x):
+  xf = x.astype(jnp.float32)
+  return (xf * jax.nn.sigmoid(1.702 * xf)).astype(x.dtype)
+
+
+def _vit_layer(h, p, vcfg: VisionConfig):
+  """One pre-norm CLIP encoder layer (bidirectional MHA + quick-GELU MLP)."""
+  B, S, D = h.shape
+  H = vcfg.n_heads
+  hd = D // H
+  x = _layer_norm(h, p["ln1_scale"], p["ln1_bias"], vcfg.layer_norm_eps)
+  q = (x @ p["wq"] + p["bq"]).reshape(B, S, H, hd)
+  k = (x @ p["wk"] + p["bk"]).reshape(B, S, H, hd)
+  v = (x @ p["wv"] + p["bv"]).reshape(B, S, H, hd)
+  scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+  scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+  probs = jax.nn.softmax(scores, axis=-1)
+  attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(h.dtype)
+  h = h + (attn.reshape(B, S, D) @ p["wo"] + p["bo"])
+
+  x = _layer_norm(h, p["ln2_scale"], p["ln2_bias"], vcfg.layer_norm_eps)
+  h = h + (_quick_gelu(x @ p["fc1"] + p["bfc1"]) @ p["fc2"] + p["bfc2"])
+  return h
+
+
+def encode_images(vision: Params, projector: Params, vcfg: VisionConfig, pixel_values: jnp.ndarray) -> jnp.ndarray:
+  """pixel_values [B, 3, H, W] (HF processor layout) → [B, n_patches, text_dim].
+
+  Runs the tower up to (excluding) the last ``-feature_layer - 1`` layers,
+  drops CLS, projects into text space.
+  """
+  B = pixel_values.shape[0]
+  dtype = vision["patch_embed"].dtype
+  # Strided conv patch embedding: kernel [D, 3, p, p], stride p, no bias.
+  patches = jax.lax.conv_general_dilated(
+    pixel_values.astype(dtype),
+    vision["patch_embed"],
+    window_strides=(vcfg.patch_size, vcfg.patch_size),
+    padding="VALID",
+    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+  )  # [B, D, h, w]
+  patches = patches.reshape(B, vcfg.hidden_size, -1).transpose(0, 2, 1)  # [B, n_patches, D]
+  cls = jnp.broadcast_to(vision["class_embed"].astype(dtype), (B, 1, vcfg.hidden_size))
+  h = jnp.concatenate([cls, patches], axis=1) + vision["pos_embed"].astype(dtype)[None]
+  h = _layer_norm(h, vision["pre_ln_scale"], vision["pre_ln_bias"], vcfg.layer_norm_eps)
+
+  # feature_layer=-2 ⇒ the hidden state entering the last layer ⇒ run L-1.
+  n_run = vcfg.n_layers + 1 + vcfg.feature_layer if vcfg.feature_layer < 0 else vcfg.feature_layer
+  layers = {k: v[:n_run] for k, v in vision["layers"].items()}
+
+  def body(carry, lp):
+    return _vit_layer(carry, lp, vcfg), None
+
+  h, _ = jax.lax.scan(body, h, layers)
+  if vcfg.drop_cls:
+    h = h[:, 1:, :]
+
+  # LlavaMultiModalProjector: linear → exact GELU → linear.
+  h = jax.nn.gelu((h @ projector["w1"] + projector["b1"]).astype(jnp.float32), approximate=False).astype(h.dtype)
+  return h @ projector["w2"] + projector["b2"]
+
+
+def merge_image_embeddings(embeds: jnp.ndarray, tokens: jnp.ndarray, image_features: jnp.ndarray, image_token_id: int) -> jnp.ndarray:
+  """Scatter image patch features into the token embedding sequence.
+
+  ``tokens`` [B,S] already contains ``image_token_id`` at every patch slot
+  (the HF processor expands one <image> into n_patches placeholders);
+  features fill those slots in order. Fixed-shape (no boolean indexing):
+  for each position, its *rank among image positions* indexes the features.
+  """
+  B, S, D = embeds.shape
+  is_img = tokens == image_token_id  # [B, S]
+  rank = jnp.cumsum(is_img.astype(jnp.int32), axis=1) - 1  # [B, S]
+  n_feat = image_features.shape[0] * image_features.shape[1]
+  flat_feats = image_features.reshape(n_feat, D)
+  idx = jnp.clip(rank, 0, n_feat - 1)
+  gathered = flat_feats[idx]  # [B, S, D]
+  return jnp.where(is_img[..., None], gathered.astype(embeds.dtype), embeds)
+
+
+def init_vision_params(key: jax.Array, vcfg: VisionConfig, dtype=jnp.float32) -> tuple[Params, Params]:
+  """Random-init tower + projector (tests)."""
+  D, F, L = vcfg.hidden_size, vcfg.intermediate_size, vcfg.n_layers
+  ks = iter(jax.random.split(key, 16))
+
+  def w(k, *shape):
+    return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+  vision = {
+    "patch_embed": w(next(ks), D, 3, vcfg.patch_size, vcfg.patch_size),
+    "class_embed": w(next(ks), D),
+    "pos_embed": w(next(ks), vcfg.n_patches + 1, D),
+    "pre_ln_scale": jnp.ones((D,), dtype),
+    "pre_ln_bias": jnp.zeros((D,), dtype),
+    "layers": {
+      "ln1_scale": jnp.ones((L, D), dtype),
+      "ln1_bias": jnp.zeros((L, D), dtype),
+      "wq": w(next(ks), L, D, D),
+      "bq": jnp.zeros((L, D), dtype),
+      "wk": w(next(ks), L, D, D),
+      "bk": jnp.zeros((L, D), dtype),
+      "wv": w(next(ks), L, D, D),
+      "bv": jnp.zeros((L, D), dtype),
+      "wo": w(next(ks), L, D, D),
+      "bo": jnp.zeros((L, D), dtype),
+      "ln2_scale": jnp.ones((L, D), dtype),
+      "ln2_bias": jnp.zeros((L, D), dtype),
+      "fc1": w(next(ks), L, D, F),
+      "bfc1": jnp.zeros((L, F), dtype),
+      "fc2": w(next(ks), L, F, D),
+      "bfc2": jnp.zeros((L, D), dtype),
+    },
+  }
+  projector = {
+    "w1": w(next(ks), D, vcfg.projector_dim),
+    "b1": jnp.zeros((vcfg.projector_dim,), dtype),
+    "w2": w(next(ks), vcfg.projector_dim, vcfg.projector_dim),
+    "b2": jnp.zeros((vcfg.projector_dim,), dtype),
+  }
+  return vision, projector

@@ -181,6 +181,8 @@ def specs_for_params(params, fsdp: bool = False) -> dict:
   for key, value in params.items():
     if key in ("layers", "moe_layers"):
       out[key] = {k: full[key].get(k, P()) for k in value}
+    elif isinstance(value, dict):  # e.g. vision tower / projector: replicate
+      out[key] = jax.tree.map(lambda _: P(), value)
     else:
       out[key] = full.get(key, P())
   return out
