@@ -1,0 +1,212 @@
+"""Continuous-batching tests (inference/batch_scheduler.py).
+
+The core correctness claim: a request decoded inside a shared slot pool
+produces exactly the tokens it would produce alone (greedy), regardless of
+what the other rows are doing — per-row positions/active masks isolate rows,
+and the pooled cache rows never cross-talk.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  fused_batch_decode,
+  fused_decode,
+  init_kv_cache,
+  prefill_into_slot,
+  shard_forward,
+)
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+
+
+def _single_row_reference(params, shard, prompt, n_steps):
+  """Independent greedy decode of one prompt (the no-batching ground truth)."""
+  S = len(prompt)
+  tokens = jnp.asarray([prompt], dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  cache = init_kv_cache(CFG, shard.n_shard_layers, 1, 64)
+  logits, cache = shard_forward(params, CFG, shard, tokens, positions, cache)
+  first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+  toks, _ = fused_decode(params, CFG, shard, first, cache, jnp.full((1,), S, jnp.int32), n_steps, temp=0.0)
+  return [int(first[0, 0])] + [int(t) for t in np.asarray(toks)[0]]
+
+
+def test_batched_rows_match_single_requests():
+  """3 rows with different prompts/positions in one pool == 3 solo runs."""
+  params, shard = full_model_params(KEY, CFG)
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5], [100]]
+  n_steps = 6
+  expected = [_single_row_reference(params, shard, p, n_steps) for p in prompts]
+
+  n_slots = 4  # one row stays empty the whole time
+  cache = init_kv_cache(CFG, shard.n_shard_layers, n_slots, 64)
+  firsts = []
+  for row, prompt in enumerate(prompts):
+    S = len(prompt)
+    pad = np.zeros((1, 16), np.int32)
+    pad[0, :S] = prompt
+    last, cache = prefill_into_slot(params, CFG, shard, jnp.asarray(pad), cache, jnp.int32(row), jnp.int32(S))
+    firsts.append(int(np.argmax(np.asarray(last)[0])))
+
+  tokens = np.array([[firsts[0]], [firsts[1]], [firsts[2]], [0]], np.int32)
+  positions = np.array([len(p) for p in prompts] + [0], np.int32)
+  active = np.array([True, True, True, False])
+  temps = np.zeros((n_slots,), np.float32)
+  toks, new_pos, cache = fused_batch_decode(
+    params, CFG, shard, jnp.asarray(tokens), cache, jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), n_steps
+  )
+  toks = np.asarray(toks)
+  for row in range(3):
+    got = [firsts[row]] + [int(t) for t in toks[row]]
+    assert got == expected[row], f"row {row}: {got} != {expected[row]}"
+  # Inactive row did not advance.
+  assert int(np.asarray(new_pos)[3]) == 0
+
+
+def test_batched_chunks_resume_correctly():
+  """Two chunks of 3 == one chunk of 6 (host-tracked positions resume)."""
+  params, shard = full_model_params(KEY, CFG)
+  prompt = [5, 17, 2, 99]
+  expected = _single_row_reference(params, shard, prompt, 6)
+
+  cache = init_kv_cache(CFG, shard.n_shard_layers, 2, 64)
+  pad = np.zeros((1, 16), np.int32)
+  pad[0, : len(prompt)] = prompt
+  last, cache = prefill_into_slot(params, CFG, shard, jnp.asarray(pad), cache, jnp.int32(1), jnp.int32(len(prompt)))
+  first = int(np.argmax(np.asarray(last)[0]))
+
+  got = [first]
+  pos = len(prompt)
+  tok = first
+  active = jnp.asarray([False, True])
+  temps = jnp.zeros((2,), jnp.float32)
+  for _ in range(2):
+    toks, _, cache = fused_batch_decode(
+      params, CFG, shard, jnp.asarray([[0], [tok]], jnp.int32), cache, jnp.asarray([0, pos], jnp.int32), active, temps, 3
+    )
+    row = [int(t) for t in np.asarray(toks)[1]]
+    got.extend(row)
+    tok = row[-1]
+    pos += 3
+  assert got == expected
+
+
+def test_batched_server_concurrent_requests():
+  """Scheduler end-to-end: concurrent submits each get their solo answer and
+  stream monotonically; slots admit/release across request lifetimes."""
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+  n_gen = 5  # first sampled token + 4 more
+  expected = [_single_row_reference(params, shard, p, n_gen - 1) for p in prompts]
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  server = BatchedServer(engine, n_slots=2, chunk=2)  # fewer slots than requests
+  streamed: dict[str, list] = {}
+
+  async def run():
+    def emit(rid, toks, finished):
+      streamed.setdefault(rid, []).extend(toks)
+
+    outs = await asyncio.gather(
+      *(
+        server.submit(f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+        for i, p in enumerate(prompts)
+      )
+    )
+    return outs
+
+  outs = asyncio.run(run())
+  for i, out in enumerate(outs):
+    assert out == expected[i], f"req {i}: {out} != {expected[i]}"
+    assert streamed[f"r{i}"] == out  # emitted stream matches the final result
+
+
+def test_batched_server_eos_and_limits():
+  """EOS inside a chunk trims the stream; max_tokens=1 finishes at prefill."""
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  solo = _single_row_reference(params, shard, [3, 25, 9], 6)
+  eos = solo[2]  # force an early stop on a token we know will be generated
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  server = BatchedServer(engine, n_slots=2, chunk=4)
+
+  async def run():
+    out_eos = await server.submit("e1", np.asarray([3, 25, 9], np.int32), max_tokens=20, temp=0.0, top_k=35, eos_ids=(eos,), emit=lambda *_: None)
+    out_one = await server.submit("e2", np.asarray([3, 25, 9], np.int32), max_tokens=1, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    return out_eos, out_one
+
+  out_eos, out_one = asyncio.run(run())
+  assert out_eos == solo[:3] and out_eos[-1] == eos
+  assert out_one == solo[:1]
+
+
+def test_node_batched_mode_concurrent_prompts(monkeypatch):
+  """XOT_TPU_BATCHED=1 routes single-node prompts through the slot pool;
+  concurrent API-style prompts stream and finish with the solo answers."""
+  import jax as _jax
+
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from tests.test_node import NoDiscovery, StubServer
+
+  monkeypatch.setenv("XOT_TPU_BATCHED", "1")
+
+  class StubTok:
+    eos_token_id = -1
+
+    def encode(self, prompt):
+      return [3, 25, 9] if "a" in prompt else [7, 1, 88, 42, 5]
+
+    def decode(self, toks):
+      return " ".join(map(str, toks))
+
+  params, shard = full_model_params(KEY, CFG)
+  expected = {
+    "ra": _single_row_reference(params, shard, [3, 25, 9], 4),
+    "rb": _single_row_reference(params, shard, [7, 1, 88, 42, 5], 4),
+  }
+
+  async def run():
+    engine = JaxShardedInferenceEngine(use_local_mesh=False)
+    engine.load_test_model(shard, CFG, params, tokenizer=StubTok())
+    node = Node(
+      "n1", StubServer(), engine, NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy(),
+      max_generate_tokens=5, default_sample_temp=0.0,
+    )
+    await node.start()
+    got: dict[str, list] = {}
+    done: dict[str, asyncio.Event] = {"ra": asyncio.Event(), "rb": asyncio.Event()}
+
+    def on_tok(rid, toks, fin):
+      got.setdefault(rid, []).extend(toks)
+      if fin and rid in done:
+        done[rid].set()
+
+    node.on_token.register("t").on_next(on_tok)
+    await asyncio.gather(
+      node.process_prompt(shard, "prompt a", "ra"),
+      node.process_prompt(shard, "prompt b", "rb"),
+    )
+    await asyncio.wait_for(asyncio.gather(done["ra"].wait(), done["rb"].wait()), timeout=30)
+    await node.stop()
+    return got
+
+  got = asyncio.run(run())
+  assert got["ra"] == expected["ra"]
+  assert got["rb"] == expected["rb"]

@@ -1,0 +1,263 @@
+"""Continuous batching for single-node serving: a fixed pool of batch rows
+("slots"), each holding one in-flight request.
+
+The reference serves strictly one token step at a time per request around the
+ring (``node.py:109-147``) — concurrent requests serialize. On TPU, decode is
+weight-bandwidth-bound: stepping B rows costs almost exactly the same HBM
+traffic as stepping one, so batching B concurrent requests multiplies
+aggregate tokens/s by ~B. This scheduler keeps XLA happy with fully static
+shapes:
+
+- ONE pooled KV cache ``[L, n_slots, max_seq, H, hd]`` allocated up front;
+- prefill scatters a single request into its row
+  (``models/decoder.py prefill_into_slot`` — row index and prompt length are
+  traced scalars, so one compiled program per pad bucket serves every slot);
+- decode runs ``fused_batch_decode`` chunks over ALL rows every tick with
+  per-row positions/temperature/active mask — one compiled program total;
+- admission happens between chunks: new requests claim free slots and
+  prefill while other rows keep their state (their next chunk resumes from
+  host-tracked positions).
+
+Enable with ``XOT_TPU_BATCHED=1`` (orchestration/node.py routes single-node
+full-shard prompts here). ``XOT_TPU_BATCH_SLOTS`` (default 4) and
+``XOT_TPU_BATCH_CHUNK`` (default 8) size the pool and the emission cadence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.helpers import DEBUG
+
+PREFILL_BUCKET = 128
+
+
+def _round_up(n: int, multiple: int) -> int:
+  return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclass
+class _Request:
+  request_id: str
+  tokens: np.ndarray  # [S] int32 prompt tokens
+  max_tokens: int
+  temp: float
+  top_k: int
+  eos_ids: tuple
+  emit: Callable[[str, list, bool], None]  # (request_id, new_tokens, finished)
+  future: asyncio.Future = None
+
+
+@dataclass
+class _Slot:
+  req: _Request
+  pos: int  # next cache slot to write (== tokens absorbed)
+  generated: int = 0
+  last_token: int = 0
+  finished: bool = False
+  out_tokens: list = field(default_factory=list)
+
+
+class BatchedServer:
+  """Owns the slot pool and the decode loop for one engine."""
+
+  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None):
+    self.engine = engine
+    self.n_slots = n_slots or int(os.getenv("XOT_TPU_BATCH_SLOTS", "4"))
+    self.chunk = chunk or int(os.getenv("XOT_TPU_BATCH_CHUNK", "8"))
+    # Pool-wide and FIXED: top_k is a static arg of the compiled batch-decode
+    # program, so honoring per-request values would both recompile per value
+    # and change sampling for rows already in flight. Per-request temperature
+    # IS honored (traced per row); temp<=0 rows are exact greedy.
+    self.top_k = top_k or int(os.getenv("XOT_TPU_BATCH_TOP_K", "35"))
+    self.cache = None
+    self.max_seq = 0
+    self.slots: list[_Slot | None] = [None] * self.n_slots
+    self.queue: asyncio.Queue[_Request] = asyncio.Queue()
+    self._loop_task: asyncio.Task | None = None
+
+  # ------------------------------------------------------------- public API
+
+  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit) -> list:
+    """Enqueue a request; resolves when it finishes. Tokens stream out via
+    ``emit(request_id, new_tokens, finished)`` as chunks complete.
+    ``top_k`` is accepted for interface parity but the pool-wide static
+    ``self.top_k`` is what applies (see __init__)."""
+    req = _Request(
+      request_id=request_id,
+      tokens=np.asarray(tokens, dtype=np.int32).reshape(-1),
+      max_tokens=int(max_tokens),
+      temp=float(temp),
+      top_k=int(top_k),
+      eos_ids=tuple(int(e) for e in eos_ids),
+      emit=emit,
+      future=asyncio.get_event_loop().create_future(),
+    )
+    await self.queue.put(req)
+    if self._loop_task is None or self._loop_task.done():
+      self._loop_task = asyncio.create_task(self._run())
+    return await req.future
+
+  def shutdown(self) -> None:
+    """Stop the decode loop and drop the pooled cache (model unload/reload).
+
+    Thread-safe: callable from the engine's executor thread — the task
+    cancel is marshalled onto the loop that owns it."""
+    task = self._loop_task
+    self._loop_task = None
+    self.cache = None
+    if task is not None and not task.done():
+      task.get_loop().call_soon_threadsafe(task.cancel)
+
+  # ---------------------------------------------------------------- loop
+
+  def _ensure_cache(self):
+    if self.cache is not None:
+      return
+    from ..models.decoder import init_kv_cache
+
+    eng = self.engine
+    self.max_seq = min(eng.max_seq_len, eng.cfg.max_seq_len)
+    self.cache = init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, self.n_slots, self.max_seq)
+
+  def _free_slot(self) -> int | None:
+    for i, s in enumerate(self.slots):
+      if s is None:
+        return i
+    return None
+
+  async def _admit(self, req: _Request, row: int) -> None:
+    """Prefill one request into a pool row and emit its first token.
+
+    A failed prefill fails THIS request's future (the pool keeps serving)."""
+    from ..models.decoder import prefill_into_slot
+
+    eng = self.engine
+    try:
+      S = int(req.tokens.shape[0])
+      if S + 1 >= self.max_seq:
+        req.emit(req.request_id, [], True)
+        if not req.future.done():
+          req.future.set_result([])
+        return
+      pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
+      tok_pad = np.zeros((1, pad_to), dtype=np.int32)
+      tok_pad[0, :S] = req.tokens
+
+      def run():
+        # Prefill AND first-token sample stay on the engine executor — the
+        # single thread that serializes all device work (and owns eng._key).
+        last, self.cache = prefill_into_slot(
+          eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache, jnp.int32(row), jnp.int32(S)
+        )
+        return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, self.top_k)).reshape(-1)[0])
+
+      first = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    except Exception as e:  # noqa: BLE001
+      if not req.future.done():
+        req.future.set_exception(e)
+      return
+    slot = _Slot(req=req, pos=S, generated=1, last_token=first)
+    slot.out_tokens.append(first)
+    finished = first in req.eos_ids or slot.generated >= req.max_tokens
+    slot.finished = finished
+    req.emit(req.request_id, [first], finished)
+    if finished:
+      if not req.future.done():
+        req.future.set_result(slot.out_tokens)
+      return
+    self.slots[row] = slot
+
+  async def _run(self) -> None:
+    from ..models.decoder import fused_batch_decode
+
+    eng = self.engine
+    self._ensure_cache()
+    try:
+      while True:
+        # Admission: fill free slots from the queue (no await while any row
+        # is active — keep the pool stepping).
+        while (row := self._free_slot()) is not None and not self.queue.empty():
+          await self._admit(self.queue.get_nowait(), row)
+        if all(s is None for s in self.slots):
+          # Idle: block on the queue (the task persists — no exit/restart race).
+          req = await self.queue.get()
+          await self._admit(req, self._free_slot())
+          continue
+
+        active = np.array([s is not None for s in self.slots])
+        tokens = np.array([[s.last_token if s else 0] for s in self.slots], dtype=np.int32)
+        positions = np.array([s.pos if s else 0 for s in self.slots], dtype=np.int32)
+        temps = np.array([s.req.temp if s else 0.0 for s in self.slots], dtype=np.float32)
+        # Rows without cache room finish before the chunk.
+        for i, s in enumerate(self.slots):
+          if s is not None and s.pos + self.chunk >= self.max_seq:
+            active[i] = False
+
+        def run_chunk():
+          eng._key, sub = jax.random.split(eng._key)
+          toks, _pos, self.cache = fused_batch_decode(
+            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
+            jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk, top_k=self.top_k, key=sub,
+          )
+          return np.asarray(toks)  # ONE readback for the whole pool chunk
+
+        rows = await asyncio.get_event_loop().run_in_executor(eng.executor, run_chunk)
+
+        for i, slot in enumerate(self.slots):
+          if slot is None:
+            continue
+          req = slot.req
+          if not active[i]:  # cache exhausted
+            slot.finished = True
+            req.emit(req.request_id, [], True)
+            if not req.future.done():
+              req.future.set_result(slot.out_tokens)
+            self.slots[i] = None
+            continue
+          emit: list[int] = []
+          done = False
+          for t in rows[i]:
+            t = int(t)
+            emit.append(t)
+            slot.generated += 1
+            if t in req.eos_ids or slot.generated >= req.max_tokens:
+              done = True
+              break
+          slot.out_tokens.extend(emit)
+          slot.pos += len(emit)
+          slot.last_token = emit[-1] if emit else slot.last_token
+          req.emit(req.request_id, emit, done)
+          if done:
+            if not req.future.done():
+              req.future.set_result(slot.out_tokens)
+            self.slots[i] = None
+    except asyncio.CancelledError:
+      self._fail_all(RuntimeError("batched server shut down"))
+      raise
+    except Exception as e:  # noqa: BLE001 — fail every in-flight request loudly
+      if DEBUG >= 1:
+        import traceback
+
+        traceback.print_exc()
+      # The fused calls donate the cache: after a mid-call failure the
+      # buffers may be consumed — drop it so the next submit reallocates.
+      self.cache = None
+      self._fail_all(e)
+
+  def _fail_all(self, exc: Exception) -> None:
+    for i, slot in enumerate(self.slots):
+      if slot is not None and not slot.req.future.done():
+        slot.req.future.set_exception(exc)
+      self.slots[i] = None
+    while not self.queue.empty():
+      req = self.queue.get_nowait()
+      if not req.future.done():
+        req.future.set_exception(exc)

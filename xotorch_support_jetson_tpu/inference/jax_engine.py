@@ -146,6 +146,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._effective_shard = eff
     self._maybe_shard_over_local_mesh()
     self.sessions.clear()
+    self._drop_batched_server()  # pooled cache is model-specific
     self._key = jax.random.PRNGKey(self._seed)
     self._model_dir = Path(model_dir)
     if DEBUG >= 1:
@@ -429,6 +430,22 @@ class JaxShardedInferenceEngine(InferenceEngine):
       return []
     return await asyncio.get_event_loop().run_in_executor(self.executor, lambda: [int(t) for t in np.asarray(handle)[0]])
 
+  def get_batched_server(self):
+    """Lazy continuous-batching scheduler (inference/batch_scheduler.py);
+    one per loaded model — the pooled KV cache is model-specific."""
+    if getattr(self, "_batched_server", None) is None:
+      from .batch_scheduler import BatchedServer
+
+      self._batched_server = BatchedServer(self)
+    return self._batched_server
+
+  def _drop_batched_server(self) -> None:
+    """Stop the old pool loop so its HBM cache actually frees (model swap)."""
+    server = getattr(self, "_batched_server", None)
+    if server is not None:
+      server.shutdown()
+    self._batched_server = None
+
   async def clear_session(self) -> None:
     self.sessions.clear()
 
@@ -447,6 +464,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.tokenizer = None
     self.mesh = None
     self.sessions.clear()
+    self._drop_batched_server()
 
   def end_request(self, request_id: str) -> None:
     self.sessions.pop(request_id, None)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from pathlib import Path
 
 from ..utils.helpers import DEBUG
@@ -41,7 +42,18 @@ def _patch_processor(processor):
   return processor
 
 
+_load_lock = threading.Lock()
+
+
 def _load_tokenizer(source: str, prefer_processor: bool = False):
+  # Serialized: transformers' lazy module-attribute import is not thread-safe
+  # — concurrent first-time imports from several executor threads raise
+  # spurious "cannot import name 'AutoProcessor'" ImportErrors.
+  with _load_lock:
+    return _load_tokenizer_locked(source, prefer_processor)
+
+
+def _load_tokenizer_locked(source: str, prefer_processor: bool = False):
   from transformers import AutoProcessor, AutoTokenizer
 
   if prefer_processor:

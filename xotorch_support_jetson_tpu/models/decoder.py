@@ -546,6 +546,73 @@ def fused_generate(
   )
 
 
+# ------------------------------------------------------- batched serving
+# (inference/batch_scheduler.py): a fixed pool of batch rows ("slots"), each
+# holding one request. Shapes stay static — prefill scatters one row into the
+# pooled cache; decode steps ALL rows every tick (decode is weight-bandwidth
+# bound, so B rows cost ≈ 1 row) with per-row positions/temperature.
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard"), donate_argnums=(4,))
+def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row, prompt_len):
+  """Prefill one request into batch row ``row`` of the pooled cache.
+
+  tokens [1, S_pad] int32; returns (last-token logits [1, V], cache).
+  ``row`` and ``prompt_len`` are traced scalars — one compiled program
+  serves every slot and prompt length within a pad bucket.
+  """
+  S = tokens.shape[1]
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in cache.items()}
+  logits, sub = shard_forward(params, cfg, shard, tokens, positions, sub)
+  cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in cache}
+  idx = (prompt_len - 1).reshape(1, 1, 1)
+  last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (1, 1, logits.shape[-1])), axis=1)[:, 0, :]
+  return last, cache
+
+
+def _next_token_batched(rows, key, temps, top_k: int):
+  """Per-row sampling: temp ≤ 0 rows greedy, others top-k at their temp."""
+  from ..ops.sampling import sample_logits
+
+  greedy_rows = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+  key, sub = jax.random.split(key)
+  safe_temp = jnp.where(temps > 0, temps, 1.0)[:, None]
+  sampled = sample_logits(rows / jnp.maximum(safe_temp, 1e-6), sub, temp=1.0, top_k=top_k)
+  return jnp.where(temps > 0, sampled, greedy_rows), key
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "top_k"), donate_argnums=(4,))
+def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k: int, key):
+  def body(carry, _):
+    tok, pos, cache, key = carry
+    logits, new_cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_k)
+    nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold their token
+    pos = jnp.where(active, pos + 1, pos)  # ...and their position
+    return (nxt[:, None], pos, new_cache, key), nxt
+
+  (_, pos, cache, _), toks = jax.lax.scan(body, (token, positions, cache, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), pos, cache
+
+
+def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k: int = 35, key=None):
+  """One compiled decode chunk over the whole slot pool.
+
+  token [B,1] int32 (each row's last token; inactive rows ignored),
+  positions [B] int32, active [B] bool, temps [B] f32 (≤0 ⇒ greedy).
+  Returns (tokens [B, n_steps], new positions [B], cache). Inactive rows do
+  not advance and their cache rows stay untouched at their position.
+  """
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("fused_batch_decode requires a full-model shard")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+  return _fused_batch_decode_impl(
+    params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), int(n_steps), int(top_k), key
+  )
+
+
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:
   shard = Shard(model_id, 0, cfg.n_layers - 1, cfg.n_layers)
   return init_shard_params(key, cfg, shard, dtype=dtype), shard

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import traceback
 import uuid
@@ -194,10 +195,45 @@ class Node:
       head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
       await self.forward_prompt(base_shard, prompt, request_id, head_idx, inference_state)
       return None
+    if (
+      os.getenv("XOT_TPU_BATCHED", "0") == "1"
+      and shard.is_last_layer
+      and hasattr(self.inference_engine, "get_batched_server")
+      and not (inference_state and inference_state.extras.get("images"))
+    ):
+      # Continuous batching (inference/batch_scheduler.py): this node owns the
+      # whole model, so concurrent requests share fused decode chunks — decode
+      # is weight-bandwidth-bound, so B in-flight requests cost ≈ 1.
+      return await self._batched_serve(base_shard, shard, prompt, request_id)
     self.outstanding_requests[request_id] = "processing"
     output, state = await self.inference_engine.infer_prompt(request_id, shard, prompt, inference_state)
     await self.process_inference_result(base_shard, output, request_id, state)
     return output
+
+  async def _batched_serve(self, base_shard: Shard, shard: Shard, prompt: str, request_id: str) -> None:
+    engine = self.inference_engine
+    self.outstanding_requests[request_id] = "processing"
+    tokens = await engine.encode(shard, prompt)
+    max_tokens, temp, top_k = self._request_limits(request_id)
+    eos_ids = self._eos_token_ids(base_shard)
+    self.buffered_token_output[request_id] = ([], False)
+
+    def emit(rid: str, new_tokens: list, finished: bool) -> None:
+      buffered, _ = self.buffered_token_output.get(rid, ([], False))
+      buffered.extend(new_tokens)
+      self.buffered_token_output[rid] = (buffered, finished)
+      for _ in new_tokens:
+        tracer.handle_token(rid)
+      metrics.inc("tokens_generated_total", len(new_tokens))
+      self.trigger_on_token_callbacks(rid, list(new_tokens), finished)
+      asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished))
+
+    try:
+      await engine.get_batched_server().submit(
+        request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit
+      )
+    finally:
+      self._finish_request(request_id)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None):
     shard = self.get_current_shard(base_shard)
