@@ -165,3 +165,19 @@ async def test_request_validation_rejects_bad_fields():
   finally:
     await client.close()
     await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_web_ui_served_with_management_controls():
+  """The tinychat page serves at / with the management surface the API backs:
+  model picker, download/delete buttons, image attach, stop, topology."""
+  node, api, client = await _make_api()
+  try:
+    resp = await client.get("/")
+    assert resp.status == 200
+    html = await resp.text()
+    for needle in ('id="model"', 'id="dl-btn"', 'id="del-btn"', 'id="attach"', 'id="stop"', 'id="topology"', "/v1/download/progress"):
+      assert needle in html, f"missing {needle}"
+  finally:
+    await client.close()
+    await node.stop()

@@ -210,3 +210,41 @@ def test_node_batched_mode_concurrent_prompts(monkeypatch):
   got = asyncio.run(run())
   assert got["ra"] == expected["ra"]
   assert got["rb"] == expected["rb"]
+
+
+def test_batched_server_cancel_frees_slot():
+  """cancel() mid-generation resolves the request early at a chunk boundary
+  and frees the slot for the next request."""
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  server = BatchedServer(engine, n_slots=1, chunk=2)
+  solo = _single_row_reference(params, shard, [3, 25, 9], 4)
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "long" and toks:
+        started.set()
+
+    long_task = asyncio.create_task(
+      server.submit("long", np.asarray([3, 25, 9], np.int32), max_tokens=500, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+    )
+    await asyncio.wait_for(started.wait(), timeout=30)
+    server.cancel("long")
+    out_long = await asyncio.wait_for(long_task, timeout=30)
+    assert len(out_long) < 500  # stopped well before max_tokens
+
+    # The freed slot serves the next request normally.
+    out_next = await asyncio.wait_for(
+      server.submit("next", np.asarray([3, 25, 9], np.int32), max_tokens=5, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None),
+      timeout=30,
+    )
+    assert out_next == solo
+    return out_long
+
+  asyncio.run(run())

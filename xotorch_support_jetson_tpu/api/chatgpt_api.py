@@ -243,8 +243,24 @@ class ChatGPTAPI:
     return response
 
   async def handle_get_models(self, request):
+    from ..download.downloader import get_models_dir, repo_to_dirname
+
+    models_dir = get_models_dir()
+
+    def has_local_weights(card) -> bool:
+      repo = card.repo_for(self.inference_engine_classname)
+      d = models_dir / repo_to_dirname(repo)
+      return d.is_dir() and any(d.glob("*.safetensors"))
+
     models = [
-      {"id": model_id, "object": "model", "owned_by": "xot_tpu", "ready": True, "name": card.pretty}
+      {
+        "id": model_id,
+        "object": "model",
+        "owned_by": "xot_tpu",
+        "ready": True,
+        "name": card.pretty,
+        "downloaded": has_local_weights(card),
+      }
       for model_id, card in registry.model_cards.items()
       if card.repo_for(self.inference_engine_classname)
     ]
@@ -396,13 +412,27 @@ class ChatGPTAPI:
 
       initial_state = InferenceState(extras={"images": images})
     try:
+      if chat_request.stream:
+        # Generation runs CONCURRENTLY with the SSE stream: tokens flow to
+        # the client as they arrive (TTFT = prefill, not full generation),
+        # and a client disconnect cancels the in-flight generation (frees
+        # its batch slot / decode loop) instead of running to max_tokens.
+        gen_task = asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))
+        try:
+          return await self._stream_response(request, chat_request, request_id, tokenizer, created, gen_task)
+        finally:
+          if not gen_task.done():
+            cancel = getattr(self.node, "cancel_request", None)
+            if cancel is not None:
+              cancel(request_id)
+          try:
+            await asyncio.wait_for(asyncio.shield(gen_task), timeout=30)
+          except Exception:  # noqa: BLE001 — surfaced via the stream already
+            pass
       await asyncio.wait_for(
         asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
         timeout=self.response_timeout,
       )
-
-      if chat_request.stream:
-        return await self._stream_response(request, chat_request, request_id, tokenizer, created)
       return await self._blocking_response(chat_request, request_id, tokenizer, created)
     except asyncio.TimeoutError:
       return web.json_response({"detail": "Response generation timed out"}, status=408)
@@ -425,7 +455,22 @@ class ChatGPTAPI:
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     return "stop" if last_token in eos_set else "length"
 
-  async def _stream_response(self, request, chat_request, request_id, tokenizer, created):
+  async def _next_tokens(self, request_id, gen_task):
+    """Next (tokens, finished) from the queue; surfaces a generation failure
+    promptly instead of waiting out the full response timeout."""
+    queue = self.token_queues[request_id]
+    deadline = asyncio.get_event_loop().time() + self.response_timeout
+    while True:
+      remaining = deadline - asyncio.get_event_loop().time()
+      if remaining <= 0:
+        raise asyncio.TimeoutError
+      try:
+        return await asyncio.wait_for(queue.get(), timeout=min(1.0, remaining))
+      except asyncio.TimeoutError:
+        if gen_task is not None and gen_task.done() and gen_task.exception() is not None:
+          raise gen_task.exception()
+
+  async def _stream_response(self, request, chat_request, request_id, tokenizer, created, gen_task=None):
     response = web.StreamResponse(
       status=200,
       reason="OK",
@@ -440,7 +485,7 @@ class ChatGPTAPI:
     emitted_text = ""
     try:
       while True:
-        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+        tokens, is_finished = await self._next_tokens(request_id, gen_task)
         all_tokens.extend(t for t in tokens if t not in eos_set)
         full_text = tokenizer.decode(all_tokens) if all_tokens else ""
         delta = full_text[len(emitted_text):]

@@ -62,6 +62,7 @@ class _Slot:
   generated: int = 0
   last_token: int = 0
   finished: bool = False
+  cancelled: bool = False
   out_tokens: list = field(default_factory=list)
 
 
@@ -105,6 +106,19 @@ class BatchedServer:
       self._loop_task = asyncio.create_task(self._run())
     return await req.future
 
+  def cancel(self, request_id: str) -> None:
+    """Stop a request (client gone): its slot frees at the next chunk
+    boundary; a still-queued request resolves immediately."""
+    for slot in self.slots:
+      if slot is not None and slot.req.request_id == request_id:
+        slot.cancelled = True
+        return
+    # Not in a slot: mark any queued copy so _admit skips it.
+    for req in list(self.queue._queue):  # peek; asyncio.Queue has no scan API
+      if req.request_id == request_id and not req.future.done():
+        req.max_tokens = 0  # admitted-then-finished immediately
+        return
+
   def shutdown(self) -> None:
     """Stop the decode loop and drop the pooled cache (model unload/reload).
 
@@ -141,6 +155,11 @@ class BatchedServer:
 
     eng = self.engine
     try:
+      if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
+        req.emit(req.request_id, [], True)
+        if not req.future.done():
+          req.future.set_result([])
+        return
       S = int(req.tokens.shape[0])
       if S + 1 >= self.max_seq:
         req.emit(req.request_id, [], True)
@@ -196,9 +215,10 @@ class BatchedServer:
         tokens = np.array([[s.last_token if s else 0] for s in self.slots], dtype=np.int32)
         positions = np.array([s.pos if s else 0 for s in self.slots], dtype=np.int32)
         temps = np.array([s.req.temp if s else 0.0 for s in self.slots], dtype=np.float32)
-        # Rows without cache room finish before the chunk.
+        # Rows without cache room (or cancelled by their client) finish
+        # before the chunk; the results loop below frees them.
         for i, s in enumerate(self.slots):
-          if s is not None and s.pos + self.chunk >= self.max_seq:
+          if s is not None and (s.cancelled or s.pos + self.chunk >= self.max_seq):
             active[i] = False
 
         def run_chunk():
@@ -215,7 +235,7 @@ class BatchedServer:
           if slot is None:
             continue
           req = slot.req
-          if not active[i]:  # cache exhausted
+          if not active[i]:  # cache exhausted or cancelled
             slot.finished = True
             req.emit(req.request_id, [], True)
             if not req.future.done():

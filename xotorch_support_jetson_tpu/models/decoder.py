@@ -553,13 +553,18 @@ def fused_generate(
 # bound, so B rows cost ≈ 1 row) with per-row positions/temperature.
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard"), donate_argnums=(4,))
+@partial(jax.jit, static_argnames=("cfg", "shard"))
 def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row, prompt_len):
   """Prefill one request into batch row ``row`` of the pooled cache.
 
   tokens [1, S_pad] int32; returns (last-token logits [1, V], cache).
   ``row`` and ``prompt_len`` are traced scalars — one compiled program
   serves every slot and prompt length within a pad bucket.
+
+  Deliberately NOT donated: a prefill that fails on-device (e.g. activation
+  OOM on a huge prompt) must leave the POOLED cache intact so the other
+  rows' requests keep serving — the scheduler fails only the one request
+  (batch_scheduler.py _admit). The copy costs one cache write pass.
   """
   S = tokens.shape[1]
   positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))

@@ -70,6 +70,7 @@ class Node:
     self.device_capabilities = UNKNOWN_DEVICE_CAPABILITIES
     self.buffered_token_output: dict[str, tuple[list[int], bool]] = {}
     self.request_options: dict[str, dict] = {}
+    self.cancelled_requests: set[str] = set()
     self.buffered_inputs: dict[str, list] = {}
     self.checkpoints: dict[str, dict[str, int]] = {}
     self.outstanding_requests: dict[str, str] = {}
@@ -320,6 +321,8 @@ class Node:
 
     pending = await engine.dispatch_chunk(request_id, shard, chunk, temp, top_k, first_token=last_token)
     while pending is not None:
+      if request_id in self.cancelled_requests:
+        break
       tokens, _ = self.buffered_token_output[request_id]
       remaining = max_tokens - len(tokens)
       # Speculatively enqueue the next chunk while we read this one.
@@ -355,9 +358,23 @@ class Node:
       self.trigger_on_token_callbacks(request_id, [], True)
       asyncio.create_task(self.broadcast_result(request_id, [], True))
 
+  def cancel_request(self, request_id: str) -> None:
+    """Stop generating for a request (client disconnected / stream aborted).
+
+    Takes effect at the next chunk boundary: the fast decode loop checks the
+    flag between chunks, and the batched scheduler frees the request's slot
+    (inference/batch_scheduler.py ``cancel``). Without this, an abandoned
+    request keeps decoding to max_tokens — harmless when requests serialize,
+    a slot-starvation bug under continuous batching."""
+    self.cancelled_requests.add(request_id)
+    server = getattr(self.inference_engine, "_batched_server", None)
+    if server is not None:
+      server.cancel(request_id)
+
   def _finish_request(self, request_id: str) -> None:
     self.outstanding_requests.pop(request_id, None)
     self.request_options.pop(request_id, None)
+    self.cancelled_requests.discard(request_id)
     tracer.end_request(request_id)
     if hasattr(self.inference_engine, "end_request"):
       self.inference_engine.end_request(request_id)
