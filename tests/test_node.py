@@ -161,3 +161,76 @@ async def test_node_oneshot_nonstreaming_matches_chunked():
   oneshot = await run(False)
   assert len(chunked) == 9
   assert oneshot == chunked
+
+
+@pytest.mark.asyncio
+async def test_retry_request_replays_token_history(monkeypatch):
+  """Elastic in-flight recovery (reference fails these — SURVEY §5.3):
+  a dead next-hop triggers a replay of the full token history as a fresh
+  prefill with the restart flag; attempts are bounded."""
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.inference.state import InferenceState
+
+  monkeypatch.setenv("XOT_TPU_RETRY_DELAY_S", "0")
+  node = make_node()
+  await node.start()
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+
+  forwarded = []
+
+  async def fake_forward_tensor(base_shard, tensor, request_id, target_index, inference_state=None):
+    forwarded.append((np.asarray(tensor).copy(), inference_state))
+
+  node.forward_tensor = fake_forward_tensor
+  state = InferenceState(tokens=np.asarray([[5, 6, 7, 8]], np.int32), prompt_len=2)
+  await node._retry_request(shard, "rid-replay", state)
+
+  assert len(forwarded) == 1
+  tensor, replay_state = forwarded[0]
+  assert tensor.tolist() == [[5, 6, 7, 8]]  # prompt + generated so far
+  assert replay_state.extras.get("replay_epoch") == 1
+  assert replay_state.prompt_len == 4
+  assert node._replay_attempts["rid-replay"] == 1
+
+  # Exhaustion: after the retry budget the request finishes (with an event).
+  node._replay_attempts["rid-replay"] = 99
+  finished = []
+  node.on_token.register("t").on_next(lambda rid, toks, fin: finished.append((rid, fin)))
+  await node._retry_request(shard, "rid-replay", state)
+  assert ("rid-replay", True) in finished
+  await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_engine_restart_flag_resets_session():
+  """The replay's restart flag makes the engine prefill from scratch even
+  though a session exists for the request id."""
+  import jax
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.inference.state import InferenceState
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, cfg, params)
+
+  rid = "replay-me"
+  prompt = np.asarray([[4, 9, 2]], np.int32)
+  out1, st = await engine.infer_tensor(rid, shard, prompt, None)
+  nxt = np.argmax(out1, axis=-1).astype(np.int32).reshape(1, 1)
+  out2, st = await engine.infer_tensor(rid, shard, nxt, st)
+  assert engine.sessions[rid].curr_pos == 4
+
+  # Replay: full history with a bumped epoch ⇒ session resets, fresh prefill.
+  history = np.concatenate([prompt, nxt], axis=1)
+  replay = InferenceState(tokens=history.copy(), prompt_len=4, extras={"replay_epoch": 1})
+  out3, _ = await engine.infer_tensor(rid, shard, history, replay)
+  assert engine.sessions[rid].prompt_len == 4 and engine.sessions[rid].epoch == 1
+  # The epoch is read, NOT consumed — it must keep traveling down the ring.
+  assert replay.extras.get("replay_epoch") == 1
+  np.testing.assert_allclose(out3, out2, rtol=2e-4, atol=2e-4)  # same logits as pre-failure

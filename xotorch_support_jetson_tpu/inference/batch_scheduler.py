@@ -82,6 +82,7 @@ class BatchedServer:
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self.queue: asyncio.Queue[_Request] = asyncio.Queue()
+    self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
     self._loop_task: asyncio.Task | None = None
 
   # ------------------------------------------------------------- public API
@@ -108,12 +109,14 @@ class BatchedServer:
 
   def cancel(self, request_id: str) -> None:
     """Stop a request (client gone): its slot frees at the next chunk
-    boundary; a still-queued request resolves immediately."""
+    boundary; a queued or mid-admission request finishes as soon as it
+    surfaces (the id is remembered — a cancel can land while the request is
+    between the queue and its slot, inside _admit's prefill)."""
+    self._cancelled_ids.add(request_id)
     for slot in self.slots:
       if slot is not None and slot.req.request_id == request_id:
         slot.cancelled = True
         return
-    # Not in a slot: mark any queued copy so _admit skips it.
     for req in list(self.queue._queue):  # peek; asyncio.Queue has no scan API
       if req.request_id == request_id and not req.future.done():
         req.max_tokens = 0  # admitted-then-finished immediately
@@ -185,10 +188,12 @@ class BatchedServer:
       return
     slot = _Slot(req=req, pos=S, generated=1, last_token=first)
     slot.out_tokens.append(first)
-    finished = first in req.eos_ids or slot.generated >= req.max_tokens
+    cancelled = req.request_id in self._cancelled_ids  # raced during prefill
+    finished = cancelled or first in req.eos_ids or slot.generated >= req.max_tokens
     slot.finished = finished
-    req.emit(req.request_id, [first], finished)
+    req.emit(req.request_id, [] if cancelled else [first], finished)
     if finished:
+      self._cancelled_ids.discard(req.request_id)
       if not req.future.done():
         req.future.set_result(slot.out_tokens)
       return
@@ -237,6 +242,7 @@ class BatchedServer:
           req = slot.req
           if not active[i]:  # cache exhausted or cancelled
             slot.finished = True
+            self._cancelled_ids.discard(req.request_id)
             req.emit(req.request_id, [], True)
             if not req.future.done():
               req.future.set_result(slot.out_tokens)
@@ -256,6 +262,7 @@ class BatchedServer:
           slot.last_token = emit[-1] if emit else slot.last_token
           req.emit(req.request_id, emit, done)
           if done:
+            self._cancelled_ids.discard(req.request_id)
             if not req.future.done():
               req.future.set_result(slot.out_tokens)
             self.slots[i] = None

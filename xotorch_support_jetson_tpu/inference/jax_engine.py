@@ -71,14 +71,15 @@ def _decode_step(params, cfg, shard, x, kv_cache, pos):
 
 
 class _Session:
-  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev")
+  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch")
 
-  def __init__(self, kv_cache, max_seq: int) -> None:
+  def __init__(self, kv_cache, max_seq: int, epoch: int = 0) -> None:
     self.kv_cache = kv_cache
     self.curr_pos = 0
     self.prompt_len = 0
     self.max_seq = max_seq
     self.next_token_dev = None  # [B,1] device array chaining fused chunks
+    self.epoch = epoch  # replay epoch (elastic recovery, node._retry_request)
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -283,15 +284,24 @@ class JaxShardedInferenceEngine(InferenceEngine):
   def _infer_tensor_sync(self, request_id, shard, input_data, state):
     shard = getattr(self, "_effective_shard", shard)
     state = state or InferenceState()
+    # In-flight replay after a peer loss (orchestration/node.py
+    # _retry_request): a bumped replay_epoch invalidates any stale session so
+    # the replayed token history prefills from scratch. The epoch is READ,
+    # not consumed — it must keep traveling with the state to every
+    # surviving downstream node on the ring.
+    epoch = int(state.extras.get("replay_epoch", 0))
     x = np.asarray(input_data)
     is_tokens = x.ndim == 2 and np.issubdtype(x.dtype, np.integer)
     B = x.shape[0]
 
     session = self.sessions.get(request_id)
+    if session is not None and session.epoch != epoch:
+      session = None
+      self.sessions.pop(request_id, None)
     if session is None:
       max_seq = min(self.max_seq_len, self.cfg.max_seq_len)
       cache = self._place_cache(init_kv_cache(self.cfg, shard.n_shard_layers, B, max_seq))
-      session = self.sessions[request_id] = _Session(cache, max_seq)
+      session = self.sessions[request_id] = _Session(cache, max_seq, epoch)
 
     prefilling = session.curr_pos == 0
     if prefilling:

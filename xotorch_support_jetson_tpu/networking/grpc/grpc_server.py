@@ -107,8 +107,12 @@ class GRPCServer:
     return pb.Empty()
 
   async def CollectTopology(self, request: pb.CollectTopologyRequest, context) -> pb.Topology:
-    topology = await self.node.collect_topology(set(request.visited), request.max_depth)
-    return topology_to_proto(topology)
+    # Answer from the current merged view WITHOUT re-collecting: running a
+    # collection here would rebuild local state seeded from static config
+    # capabilities and clobber the node's own converged view on every
+    # incoming RPC (every peer polls every cycle). Gossip still converges:
+    # each node's own periodic collection merges its neighbors' currents.
+    return topology_to_proto(self.node.current_topology)
 
   async def SendResult(self, request: pb.SendResultRequest, context) -> pb.Empty:
     tensor = proto_to_tensor(request.tensor) if request.HasField("tensor") else None

@@ -71,6 +71,7 @@ class Node:
     self.buffered_token_output: dict[str, tuple[list[int], bool]] = {}
     self.request_options: dict[str, dict] = {}
     self.cancelled_requests: set[str] = set()
+    self._replay_attempts: dict[str, int] = {}
     self.buffered_inputs: dict[str, list] = {}
     self.checkpoints: dict[str, dict[str, int]] = {}
     self.outstanding_requests: dict[str, str] = {}
@@ -189,12 +190,29 @@ class Node:
     return result
 
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None):
-    shard = self.get_current_shard(base_shard)
+    # Same sender-authoritative rule as process_tensor: a concrete wire shard
+    # (from a peer's forward_prompt) is obeyed; the API's (0,0,n) base marker
+    # resolves against the local view.
+    is_base_marker = base_shard.start_layer == 0 and base_shard.end_layer == 0 and base_shard.n_layers > 1
+    shard = self.get_current_shard(base_shard) if is_base_marker else base_shard
     self._adopt_options(request_id, inference_state, shard)
     if not shard.is_first_layer:
-      # Not the ring head: route the prompt to whichever node owns layer 0.
-      head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
-      await self.forward_prompt(base_shard, prompt, request_id, head_idx, inference_state)
+      # Not the ring head: route the prompt to whichever node owns layer 0,
+      # retrying once over a refreshed topology if the head just left.
+      for attempt in (0, 1):
+        try:
+          head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
+          await self.forward_prompt(base_shard, prompt, request_id, head_idx, inference_state)
+          return None
+        except Exception:  # noqa: BLE001
+          if attempt:
+            raise
+          await asyncio.sleep(float(os.getenv("XOT_TPU_RETRY_DELAY_S", "3")))
+          try:
+            await self.update_peers()
+            await self.collect_topology(set())
+          except Exception:  # noqa: BLE001
+            pass
       return None
     if (
       os.getenv("XOT_TPU_BATCHED", "0") == "1"
@@ -208,7 +226,7 @@ class Node:
       return await self._batched_serve(base_shard, shard, prompt, request_id)
     self.outstanding_requests[request_id] = "processing"
     output, state = await self.inference_engine.infer_prompt(request_id, shard, prompt, inference_state)
-    await self.process_inference_result(base_shard, output, request_id, state)
+    await self.process_inference_result(base_shard, output, request_id, state, shard=shard)
     return output
 
   async def _batched_serve(self, base_shard: Shard, shard: Shard, prompt: str, request_id: str) -> None:
@@ -237,12 +255,20 @@ class Node:
       self._finish_request(request_id)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None):
-    shard = self.get_current_shard(base_shard)
+    # Sender-authoritative routing: forward_tensor ships the CONCRETE layer
+    # range it computed for us. Obey it rather than re-deriving from our own
+    # topology view — during a divergence window (a node booting, a peer
+    # just evicted) local re-derivation can disagree with the sender and
+    # misinterpret the payload (e.g. a hidden state fed to an embedding
+    # lookup). A (0,0,n>1) shard is the API's abstract "base" marker and
+    # still resolves locally.
+    is_base_marker = base_shard.start_layer == 0 and base_shard.end_layer == 0 and base_shard.n_layers > 1
+    shard = self.get_current_shard(base_shard) if is_base_marker else base_shard
     self._adopt_options(request_id, inference_state, shard)
     try:
       self.outstanding_requests[request_id] = "processing"
       output, state = await self.inference_engine.infer_tensor(request_id, shard, tensor, inference_state)
-      await self.process_inference_result(base_shard, output, request_id, state)
+      await self.process_inference_result(base_shard, output, request_id, state, shard=shard)
       return output
     except Exception:  # noqa: BLE001 — a failed hop must not kill the server
       self._finish_request(request_id)
@@ -250,8 +276,19 @@ class Node:
       traceback.print_exc()
       return None
 
-  async def process_inference_result(self, base_shard: Shard, result, request_id: str, inference_state: InferenceState | None = None):
-    shard = self.get_current_shard(base_shard)
+  async def process_inference_result(self, base_shard: Shard, result, request_id: str, inference_state: InferenceState | None = None, shard: Shard | None = None):
+    # ``shard`` is the range the result was actually computed for (callers
+    # that obeyed a sender-authoritative wire shard pass it); routing of the
+    # NEXT hop still derives from this node's current topology view.
+    shard = shard or self.get_current_shard(base_shard)
+    if request_id in self.cancelled_requests:
+      # Client gone: stop the ring here instead of circulating to max_tokens.
+      self.buffered_token_output.setdefault(request_id, ([], False))
+      tokens, _ = self.buffered_token_output[request_id]
+      self.buffered_token_output[request_id] = (tokens, True)
+      self.trigger_on_token_callbacks(request_id, [], True)
+      self._finish_request(request_id)
+      return
     if shard.is_last_layer:
       # result is [B, vocab] logits: sample here, buffer, and broadcast.
       if request_id not in self.buffered_token_output:
@@ -279,10 +316,69 @@ class Node:
         return
       # Ring wraps: sampled token goes back to the first-layer owner.
       next_token = np.asarray([[token_int]], dtype=np.int32)
-      await self.forward_tensor(base_shard, next_token, request_id, self.get_partition_index(offset=1), inference_state)
+      try:
+        await self.forward_tensor(base_shard, next_token, request_id, self.get_partition_index(offset=1), inference_state)
+      except Exception:  # noqa: BLE001 — next hop gone: replay over new topology
+        # The just-sampled (and already streamed) token is only appended to
+        # the wire history when it reaches the head — include it here or the
+        # replay would regenerate/re-emit that position.
+        if inference_state is not None and inference_state.tokens is not None:
+          inference_state.tokens = np.concatenate([inference_state.tokens, next_token], axis=1)
+        await self._retry_request(base_shard, request_id, inference_state)
     else:
       # Middle shard: pass hidden state to the next partition.
-      await self.forward_tensor(base_shard, result, request_id, self.get_partition_index(offset=1), inference_state)
+      try:
+        await self.forward_tensor(base_shard, result, request_id, self.get_partition_index(offset=1), inference_state)
+      except Exception:  # noqa: BLE001
+        await self._retry_request(base_shard, request_id, inference_state)
+
+  async def _retry_request(self, base_shard: Shard, request_id: str, state: InferenceState | None) -> None:
+    """Elastic in-flight recovery: replay a request whose next hop died.
+
+    The reference simply fails in-flight requests when a peer leaves
+    (SURVEY.md §5.3: forward raises "peer not found"; no retry). Here the
+    wire state carries the full token history (prompt + generated so far —
+    inference/state.py), so after the membership loop re-derives the
+    partition map the request REPLAYS as a fresh prefill of those tokens to
+    the new layer-0 owner; surviving engines drop their stale per-request
+    sessions via the bumped ``replay_epoch``. Tokens already streamed are
+    not re-emitted — generation continues where it left off. (The separate
+    prompt-level retry in _process_prompt — used when the failure surfaces
+    inside the initial SendPrompt RPC — regenerates from the original
+    prompt, which can re-emit the earliest tokens; greedy decoding makes
+    the duplicates exact.)
+    """
+    retries = int(os.getenv("XOT_TPU_INFLIGHT_RETRIES", "2"))
+    attempt = self._replay_attempts.get(request_id, 0)
+    if state is None or state.tokens is None or attempt >= retries:
+      self._finish_request(request_id)
+      print(f"[node {self.id}] request {request_id} failed after {attempt} replay attempts")
+      self.buffered_token_output.setdefault(request_id, ([], False))
+      tokens, _ = self.buffered_token_output[request_id]
+      self.buffered_token_output[request_id] = (tokens, True)
+      self.trigger_on_token_callbacks(request_id, [], True)
+      return
+    self._replay_attempts[request_id] = attempt + 1
+    if DEBUG >= 1:
+      print(f"[node {self.id}] replaying {request_id} (attempt {attempt + 1}) after peer loss")
+    metrics.inc("requests_replayed_total")
+    # Let discovery evict the dead peer and the topology re-derive.
+    await asyncio.sleep(float(os.getenv("XOT_TPU_RETRY_DELAY_S", "3")))
+    try:
+      await self.update_peers()
+      await self.collect_topology(set())
+    except Exception:  # noqa: BLE001 — collection is best-effort here
+      pass
+    tokens = np.asarray(state.tokens, dtype=np.int32).reshape(1, -1)
+    # The epoch invalidates surviving engines' stale sessions and keeps
+    # traveling with the state across the ring; request options (limits,
+    # temperature) re-stash via the normal forward path.
+    replay_state = InferenceState(tokens=tokens.copy(), prompt_len=tokens.shape[1], extras={"replay_epoch": attempt + 1})
+    try:
+      head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
+      await self.forward_tensor(base_shard, tokens, request_id, head_idx, replay_state)
+    except Exception:  # noqa: BLE001 — recurse into the next attempt
+      await self._retry_request(base_shard, request_id, replay_state)
 
   async def _fast_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str, last_token: int, chunk: int | None = None) -> None:
     """Pipelined fused-chunk decode: chunk N+1 is dispatched (input token
@@ -361,20 +457,30 @@ class Node:
   def cancel_request(self, request_id: str) -> None:
     """Stop generating for a request (client disconnected / stream aborted).
 
-    Takes effect at the next chunk boundary: the fast decode loop checks the
-    flag between chunks, and the batched scheduler frees the request's slot
-    (inference/batch_scheduler.py ``cancel``). Without this, an abandoned
-    request keeps decoding to max_tokens — harmless when requests serialize,
-    a slot-starvation bug under continuous batching."""
+    Takes effect at the next step/chunk boundary: the fast decode loop and
+    the per-token ring check the flag, and the batched scheduler frees the
+    request's slot (inference/batch_scheduler.py ``cancel``). The cancel is
+    broadcast to peers so remote ring members stop too. Without this, an
+    abandoned request keeps decoding to max_tokens — harmless when requests
+    serialize, a slot-starvation bug under continuous batching."""
+    self._cancel_locally(request_id)
+    asyncio.create_task(self.broadcast_opaque_status(request_id, json.dumps({"type": "cancel_request", "request_id": request_id})))
+
+  def _cancel_locally(self, request_id: str) -> None:
     self.cancelled_requests.add(request_id)
     server = getattr(self.inference_engine, "_batched_server", None)
     if server is not None:
       server.cancel(request_id)
+    # Bound the set: a forwarding-only node never reaches _finish_request
+    # for this id, so expire the entry after the response timeout horizon.
+    loop = asyncio.get_event_loop()
+    loop.call_later(900, self.cancelled_requests.discard, request_id)
 
   def _finish_request(self, request_id: str) -> None:
     self.outstanding_requests.pop(request_id, None)
     self.request_options.pop(request_id, None)
     self.cancelled_requests.discard(request_id)
+    self._replay_attempts.pop(request_id, None)
     tracer.end_request(request_id)
     if hasattr(self.inference_engine, "end_request"):
       self.inference_engine.end_request(request_id)
@@ -544,7 +650,12 @@ class Node:
     next_topology = Topology()
     next_topology.update_node(self.id, self.device_capabilities)
     for peer in self.peers:
-      next_topology.update_node(peer.id(), peer.device_capabilities())
+      # Seed each peer from the best knowledge we have: a previously merged
+      # SELF-report beats the static capabilities on the discovery handle
+      # (manual-config caps are placeholders; probed values must win or
+      # nodes derive divergent partition maps — the ring corrupts).
+      known = self.topology.nodes.get(peer.id())
+      next_topology.update_node(peer.id(), known or peer.device_capabilities())
       next_topology.add_edge(self.id, peer.id(), peer.description())
     if max_depth > 0:
       prev_visited = set(visited)
@@ -559,6 +670,12 @@ class Node:
         except Exception as e:  # noqa: BLE001
           if DEBUG >= 1:
             print(f"[node {self.id}] error collecting topology from {peer.id()}: {e}")
+          # Unreachable peer: evict it from the partition map NOW instead of
+          # keeping the stale entry (manual discovery re-lists config peers
+          # forever, so a crashed node would otherwise keep owning layers and
+          # every replay would re-target it). It re-enters on the next
+          # successful collect once it's back.
+          next_topology.nodes.pop(peer.id(), None)
       # A peer's merged view may carry stale hearsay about *us* (e.g. the
       # static capabilities its handle was created with); self-knowledge wins,
       # and every node applying this rule keeps partition tables convergent.
@@ -576,8 +693,13 @@ class Node:
         did_change = await self.update_peers()
         if DEBUG >= 3:
           print(f"[node {self.id}] peers changed: {did_change}")
+        # Collect EVERY cycle (reference node.py:520-531 does too), not only
+        # on membership change: a view captured while a peer was still
+        # booting (its collect RPC failing) would otherwise stay stale
+        # forever, and two nodes with divergent views derive different
+        # partition maps — the ring corrupts.
+        await self.collect_topology(set())
         if did_change:
-          await self.collect_topology(set())
           self.select_best_inference_engine()
       except Exception:  # noqa: BLE001
         if DEBUG >= 1:
@@ -615,6 +737,12 @@ class Node:
         self.topology_inference_engines_pool.append(engines)
       elif status_type == "download_progress":
         self.node_download_progress[status_data.get("node_id")] = status_data.get("progress")
+      elif status_type == "cancel_request":
+        # A peer's client disconnected: stop our share of the generation at
+        # the next step/chunk boundary and drop the engine session.
+        rid = status_data.get("request_id", "")
+        if rid:
+          self._cancel_locally(rid)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001
