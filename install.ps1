@@ -1,0 +1,22 @@
+# Windows installer (role of the reference's install.ps1): create a venv and
+# install the package editable. TPU execution requires a TPU-attached Linux
+# host; on Windows this installs the CPU-backed development environment
+# (tests, dummy engine, CLI, API) only.
+$ErrorActionPreference = "Stop"
+
+$python = Get-Command python -ErrorAction SilentlyContinue
+if (-not $python) {
+  Write-Error "python not found on PATH (3.10+ required)"
+}
+
+Write-Host "Creating virtual environment .venv ..."
+python -m venv .venv
+& .\.venv\Scripts\Activate.ps1
+
+Write-Host "Installing xotorch_support_jetson_tpu (editable) ..."
+python -m pip install --upgrade pip
+python -m pip install -e .
+
+Write-Host ""
+Write-Host "Done. Activate with:  .\.venv\Scripts\Activate.ps1"
+Write-Host "Then run:             xot-tpu --help"

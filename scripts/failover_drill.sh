@@ -1,0 +1,64 @@
+#!/bin/bash
+# Two-daemon elastic-recovery drill (richer sibling of the reference's
+# test/reconnect.sh): start a 2-node manual-discovery ring on localhost,
+# stream a completion, SIGKILL the peer mid-generation, and assert the
+# request still completes on the survivor (prompt/tensor replay —
+# orchestration/node.py _retry_request).
+#
+# Usage: scripts/failover_drill.sh /path/to/tiny_checkpoint
+# (build one with the recipe in .claude/skills/verify/SKILL.md §1)
+set -euo pipefail
+CKPT=${1:?usage: failover_drill.sh <checkpoint_dir>}
+WORK=$(mktemp -d)
+trap 'kill $(cat "$WORK"/*.pid 2>/dev/null) 2>/dev/null || true' EXIT
+
+python - "$WORK" <<'EOF'
+import json, sys
+caps = {"model": "test", "chip": "cpu", "memory": 8192, "flops": {"fp32": 1.0, "fp16": 2.0, "int8": 4.0}}
+w = sys.argv[1]
+json.dump({"peers": {"nodeB": {"address": "127.0.0.1", "port": 53152, "device_capabilities": caps}}}, open(f"{w}/a.json", "w"))
+json.dump({"peers": {"nodeA": {"address": "127.0.0.1", "port": 53151, "device_capabilities": caps}}}, open(f"{w}/b.json", "w"))
+EOF
+
+export JAX_PLATFORMS=cpu XOT_TPU_MODEL_DIR="$CKPT" HF_HUB_OFFLINE=1 DEBUG=1
+COMMON=(--disable-tui --temp 0.0 --max-generate-tokens 40 --default-model llama-3.2-1b --discovery-module manual)
+XOT_TPU_UUID=nodeA python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
+  --discovery-config-path "$WORK/a.json" --node-port 53151 --chatgpt-api-port 52515 > "$WORK/a.log" 2>&1 &
+echo $! > "$WORK/a.pid"
+XOT_TPU_UUID=nodeB python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
+  --discovery-config-path "$WORK/b.json" --node-port 53152 --chatgpt-api-port 52516 > "$WORK/b.log" 2>&1 &
+echo $! > "$WORK/b.pid"
+
+sleep 24
+echo "== topology views (must agree on both probed memories):"
+for p in 52515 52516; do curl -sf --max-time 5 "http://127.0.0.1:$p/v1/topology" | python -c "
+import json, sys; t = json.load(sys.stdin)
+print('  ', {k: v['memory'] for k, v in t['nodes'].items()})"; done
+
+python - "$(cat "$WORK/b.pid")" <<'EOF'
+import json, os, signal, sys, time, urllib.request
+b_pid = int(sys.argv[1])
+req = urllib.request.Request("http://127.0.0.1:52515/v1/chat/completions",
+  data=json.dumps({"model": "llama-3.2-1b", "messages": [{"role": "user", "content": "the quick brown fox"}],
+                   "stream": True, "max_tokens": 40}).encode(),
+  headers={"Content-Type": "application/json"})
+resp = urllib.request.urlopen(req, timeout=240)
+nchunks, killed, done = 0, False, False
+t0 = time.time()
+while True:
+    line = resp.readline()
+    if not line:
+        break
+    if line.startswith(b"data: ") and b'"content"' in line:
+        nchunks += 1
+    if not killed and time.time() - t0 > 12:
+        os.kill(b_pid, signal.SIGKILL)
+        killed = True
+        print(f"== killed nodeB at t={time.time()-t0:.1f}s (after {nchunks} content chunks)")
+    if b"[DONE]" in line:
+        done = True
+        break
+assert killed, "peer was never killed (generation finished too fast — raise max_tokens)"
+assert done, "stream never finished after the kill"
+print(f"== PASS: request completed after peer loss (t={time.time()-t0:.1f}s)")
+EOF

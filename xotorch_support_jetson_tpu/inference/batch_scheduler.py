@@ -83,6 +83,7 @@ class BatchedServer:
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self.queue: asyncio.Queue[_Request] = asyncio.Queue()
     self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
+    self._admitting: set[str] = set()  # ids currently inside _admit
     self._loop_task: asyncio.Task | None = None
 
   # ------------------------------------------------------------- public API
@@ -109,10 +110,12 @@ class BatchedServer:
 
   def cancel(self, request_id: str) -> None:
     """Stop a request (client gone): its slot frees at the next chunk
-    boundary; a queued or mid-admission request finishes as soon as it
-    surfaces (the id is remembered — a cancel can land while the request is
-    between the queue and its slot, inside _admit's prefill)."""
-    self._cancelled_ids.add(request_id)
+    boundary; a queued request finishes at admission; a cancel racing a
+    request that is mid-admission (between the queue and its slot, inside
+    _admit's prefill) is remembered via ``_cancelled_ids``. Cancels for ids
+    the scheduler has never seen are ignored — an unconditional record would
+    grow without bound (every disconnect reaches here, including requests
+    that never entered the pool)."""
     for slot in self.slots:
       if slot is not None and slot.req.request_id == request_id:
         slot.cancelled = True
@@ -121,6 +124,8 @@ class BatchedServer:
       if req.request_id == request_id and not req.future.done():
         req.max_tokens = 0  # admitted-then-finished immediately
         return
+    if request_id in self._admitting:
+      self._cancelled_ids.add(request_id)
 
   def shutdown(self) -> None:
     """Stop the decode loop and drop the pooled cache (model unload/reload).
@@ -157,6 +162,7 @@ class BatchedServer:
     from ..models.decoder import prefill_into_slot
 
     eng = self.engine
+    self._admitting.add(req.request_id)
     try:
       if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
         req.emit(req.request_id, [], True)
@@ -186,6 +192,8 @@ class BatchedServer:
       if not req.future.done():
         req.future.set_exception(e)
       return
+    finally:
+      self._admitting.discard(req.request_id)
     slot = _Slot(req=req, pos=S, generated=1, last_token=first)
     slot.out_tokens.append(first)
     cancelled = req.request_id in self._cancelled_ids  # raced during prefill

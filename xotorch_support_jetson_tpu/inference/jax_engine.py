@@ -306,6 +306,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     prefilling = session.curr_pos == 0
     if prefilling:
       prompt_len = state.prompt_len or x.shape[1]
+      # Remember the FIRST prefill's prompt length for the request lifetime:
+      # a replay prefills the whole token history, and the max_tokens budget
+      # must still count from the original prompt (node._check_finished).
+      state.extras.setdefault("orig_prompt_len", int(prompt_len))
       if is_tokens:
         state.tokens = x.astype(np.int32)
         state.prompt_len = prompt_len

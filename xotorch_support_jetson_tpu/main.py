@@ -61,7 +61,31 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
   parser.add_argument("--resume-checkpoint", type=str, default=None)
   parser.add_argument("--allowed-node-ids", type=str, default=None, help="comma-separated")
+  # Multi-host SPMD (one mesh spanning hosts over ICI/DCN): initializes
+  # jax.distributed so every process sees the global device set; the in-slice
+  # engine mesh and parallel/ training meshes then span all hosts. This is
+  # the TPU-pod alternative to the gRPC ring (which remains the path for
+  # heterogeneous/loose clusters).
+  parser.add_argument("--jax-coordinator", type=str, default=None, help="host:port of process 0 (enables jax.distributed)")
+  parser.add_argument("--jax-num-processes", type=int, default=None)
+  parser.add_argument("--jax-process-id", type=int, default=None)
   return parser
+
+
+def maybe_init_jax_distributed(args) -> None:
+  if not args.jax_coordinator:
+    return
+  import jax
+
+  jax.distributed.initialize(
+    coordinator_address=args.jax_coordinator,
+    num_processes=args.jax_num_processes,
+    process_id=args.jax_process_id,
+  )
+  if DEBUG >= 1:
+    import jax as _jax
+
+    print(f"[main] jax.distributed up: process {args.jax_process_id}/{args.jax_num_processes}, {_jax.device_count()} global devices")
 
 
 def build_components(args):
@@ -263,6 +287,7 @@ async def async_main(args) -> None:
 
 def run() -> None:
   args = build_parser().parse_args()
+  maybe_init_jax_distributed(args)
   try:
     asyncio.run(async_main(args))
   except KeyboardInterrupt:

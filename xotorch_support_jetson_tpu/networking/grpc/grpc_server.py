@@ -84,14 +84,14 @@ class GRPCServer:
   async def SendPrompt(self, request: pb.PromptRequest, context) -> pb.Tensor:
     shard = proto_to_shard(request.shard)
     state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
-    result = await self.node.process_prompt(shard, request.prompt, request.request_id, state)
+    result = await self.node.process_prompt(shard, request.prompt, request.request_id, state, wire_concrete=True)
     return tensor_to_proto(result)
 
   async def SendTensor(self, request: pb.TensorRequest, context) -> pb.Tensor:
     shard = proto_to_shard(request.shard)
     tensor = proto_to_tensor(request.tensor)
     state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
-    result = await self.node.process_tensor(shard, tensor, request.request_id, state)
+    result = await self.node.process_tensor(shard, tensor, request.request_id, state, wire_concrete=True)
     return tensor_to_proto(result)
 
   async def SendExample(self, request: pb.ExampleRequest, context) -> pb.Loss:

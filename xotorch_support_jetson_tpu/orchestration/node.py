@@ -146,7 +146,7 @@ class Node:
     if state is not None and "request_options" in state.extras and request_id not in self.request_options:
       self.request_options[request_id] = dict(state.extras["request_options"])
 
-  async def process_prompt(self, base_shard: Shard, prompt: str, request_id: str | None = None, inference_state: InferenceState | None = None):
+  async def process_prompt(self, base_shard: Shard, prompt: str, request_id: str | None = None, inference_state: InferenceState | None = None, wire_concrete: bool = False):
     shard = self.get_current_shard(base_shard)
     if request_id is None:
       request_id = str(uuid.uuid4())
@@ -171,7 +171,7 @@ class Node:
       )
     )
     with tracer.start_span("request.process_prompt", request_id, {"node_id": self.id, "model": base_shard.model_id}):
-      result = await self._process_prompt(base_shard, prompt, request_id, inference_state)
+      result = await self._process_prompt(base_shard, prompt, request_id, inference_state, wire_concrete)
     elapsed_ns = time.perf_counter_ns() - start_time
     asyncio.create_task(
       self.broadcast_opaque_status(
@@ -189,12 +189,13 @@ class Node:
     )
     return result
 
-  async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None):
-    # Same sender-authoritative rule as process_tensor: a concrete wire shard
-    # (from a peer's forward_prompt) is obeyed; the API's (0,0,n) base marker
-    # resolves against the local view.
-    is_base_marker = base_shard.start_layer == 0 and base_shard.end_layer == 0 and base_shard.n_layers > 1
-    shard = self.get_current_shard(base_shard) if is_base_marker else base_shard
+  async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None, wire_concrete: bool = False):
+    # Sender-authoritative rule (see process_tensor): a shard that arrived
+    # over the wire is the sender's concrete routing decision — obey it.
+    # Local callers (API/CLI) pass abstract base shards that resolve against
+    # this node's topology view. The flag is explicit because a head owning
+    # only layer 0 is structurally identical to the API's (0,0,n) marker.
+    shard = base_shard if wire_concrete else self.get_current_shard(base_shard)
     self._adopt_options(request_id, inference_state, shard)
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0,
@@ -254,16 +255,15 @@ class Node:
     finally:
       self._finish_request(request_id)
 
-  async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None):
+  async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None, wire_concrete: bool = False):
     # Sender-authoritative routing: forward_tensor ships the CONCRETE layer
     # range it computed for us. Obey it rather than re-deriving from our own
     # topology view — during a divergence window (a node booting, a peer
     # just evicted) local re-derivation can disagree with the sender and
     # misinterpret the payload (e.g. a hidden state fed to an embedding
-    # lookup). A (0,0,n>1) shard is the API's abstract "base" marker and
-    # still resolves locally.
-    is_base_marker = base_shard.start_layer == 0 and base_shard.end_layer == 0 and base_shard.n_layers > 1
-    shard = self.get_current_shard(base_shard) if is_base_marker else base_shard
+    # lookup). ``wire_concrete`` is set by the gRPC server and by local
+    # self-forwards; plain callers resolve against the local view.
+    shard = base_shard if wire_concrete else self.get_current_shard(base_shard)
     self._adopt_options(request_id, inference_state, shard)
     try:
       self.outstanding_requests[request_id] = "processing"
@@ -371,9 +371,16 @@ class Node:
       pass
     tokens = np.asarray(state.tokens, dtype=np.int32).reshape(1, -1)
     # The epoch invalidates surviving engines' stale sessions and keeps
-    # traveling with the state across the ring; request options (limits,
-    # temperature) re-stash via the normal forward path.
-    replay_state = InferenceState(tokens=tokens.copy(), prompt_len=tokens.shape[1], extras={"replay_epoch": attempt + 1})
+    # traveling with the state across the ring. It derives from the WIRE
+    # state's epoch (not the local attempt counter): a second failure
+    # detected on a *different* node must still produce a new, higher epoch
+    # or survivors would keep their stale sessions. The original prompt
+    # length rides along so the new last-layer owner keeps the client's
+    # max_tokens budget (its local token buffer starts empty after a move).
+    extras = {"replay_epoch": int(state.extras.get("replay_epoch", 0)) + 1}
+    if "orig_prompt_len" in state.extras:
+      extras["orig_prompt_len"] = state.extras["orig_prompt_len"]
+    replay_state = InferenceState(tokens=tokens.copy(), prompt_len=tokens.shape[1], extras=extras)
     try:
       head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
       await self.forward_tensor(base_shard, tokens, request_id, head_idx, replay_state)
@@ -487,6 +494,12 @@ class Node:
 
   def _check_finished(self, base_shard: Shard, token: int, n_tokens: int, state: InferenceState | None, request_id: str = "") -> bool:
     max_tokens, _, _ = self._request_limits(request_id)
+    # After an elastic replay the last layer may land on a node whose local
+    # token buffer is empty — the wire state's history keeps the client's
+    # budget honest (generated = history beyond the ORIGINAL prompt, +1 for
+    # the token just sampled).
+    if state is not None and state.tokens is not None and "orig_prompt_len" in state.extras:
+      n_tokens = max(n_tokens, int(np.asarray(state.tokens).shape[-1]) - int(state.extras["orig_prompt_len"]) + 1)
     if n_tokens >= max_tokens:
       return True
     eos_ids = self._eos_token_ids(base_shard)
@@ -515,7 +528,7 @@ class Node:
     next_shard = self.get_current_shard(base_shard, target_index)
     inference_state = self._stash_options(request_id, inference_state)
     if target_id == self.id:
-      await self.process_prompt(next_shard, prompt, request_id, inference_state)
+      await self.process_prompt(next_shard, prompt, request_id, inference_state, wire_concrete=True)
     else:
       peer = next((p for p in self.peers if p.id() == target_id), None)
       if peer is None:
@@ -529,7 +542,7 @@ class Node:
     next_shard = self.get_current_shard(base_shard, target_index)
     inference_state = self._stash_options(request_id, inference_state)
     if target_id == self.id:
-      await self.process_tensor(next_shard, tensor, request_id, inference_state)
+      await self.process_tensor(next_shard, tensor, request_id, inference_state, wire_concrete=True)
     else:
       peer = next((p for p in self.peers if p.id() == target_id), None)
       if peer is None:
@@ -657,6 +670,7 @@ class Node:
       known = self.topology.nodes.get(peer.id())
       next_topology.update_node(peer.id(), known or peer.device_capabilities())
       next_topology.add_edge(self.id, peer.id(), peer.description())
+    unreachable: set[str] = set()
     if max_depth > 0:
       prev_visited = set(visited)
       visited.add(self.id)
@@ -670,16 +684,18 @@ class Node:
         except Exception as e:  # noqa: BLE001
           if DEBUG >= 1:
             print(f"[node {self.id}] error collecting topology from {peer.id()}: {e}")
-          # Unreachable peer: evict it from the partition map NOW instead of
-          # keeping the stale entry (manual discovery re-lists config peers
-          # forever, so a crashed node would otherwise keep owning layers and
-          # every replay would re-target it). It re-enters on the next
-          # successful collect once it's back.
-          next_topology.nodes.pop(peer.id(), None)
+          unreachable.add(peer.id())
       # A peer's merged view may carry stale hearsay about *us* (e.g. the
       # static capabilities its handle was created with); self-knowledge wins,
       # and every node applying this rule keeps partition tables convergent.
       next_topology.update_node(self.id, self.device_capabilities)
+    # Evict unreachable peers AFTER all merges (another peer's hearsay would
+    # otherwise resurrect a crashed node in the partition map): manual
+    # discovery re-lists config peers forever, so a dead node would keep
+    # owning layers and every replay would re-target it. It re-enters on the
+    # next successful collect once it's actually back.
+    for dead in unreachable:
+      next_topology.nodes.pop(dead, None)
     next_topology.active_node_id = self.topology.active_node_id or self.id
     self.topology = next_topology
     if self.topology_viz:
