@@ -127,6 +127,33 @@ def _save_tiny_hf(tmp_path, family: str):
       tie_word_embeddings=False,
       torch_dtype="float32",
     )
+  elif family in ("phi3", "phi3-longrope"):
+    cfg = AutoConfig.for_model(
+      "phi3",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      partial_rotary_factor=0.75,  # phi-4-mini ships this
+      max_position_embeddings=256,
+      original_max_position_embeddings=64 if family == "phi3-longrope" else None,
+      rope_scaling={
+        "type": "longrope",  # Phi3Config validates exactly {type, short_factor, long_factor}
+        "short_factor": [1.1, 1.2, 1.3, 1.4, 1.5, 1.6],
+        "long_factor": [2.0, 2.5, 3.0, 3.5, 4.0, 4.5],
+      }
+      if family == "phi3-longrope"
+      else None,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+      pad_token_id=0,
+      eos_token_id=2,
+      bos_token_id=1,
+    )
   elif family in ("deepseek-v2-lite", "deepseek-v2", "deepseek-v2-yarn"):
     cfg = AutoConfig.for_model(
       "deepseek_v2",
@@ -219,6 +246,8 @@ def _save_tiny_hf(tmp_path, family: str):
     "mistral",
     "mixtral",
     "qwen2-moe",
+    "phi3",
+    "phi3-longrope",
     "deepseek-v2-lite",
     "deepseek-v2",
     "deepseek-v2-yarn",
@@ -229,6 +258,13 @@ def test_golden_logits_vs_hf(tmp_path, family):
   ref_logits = _save_tiny_hf(tmp_path, family)
 
   cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  if family == "phi3-longrope":
+    # HF selects short_factor for sequences within the original context; our
+    # static selection keys off max_seq_len, which the serving engine clamps
+    # the same way (jax_engine._load_shard_sync).
+    from dataclasses import replace
+
+    cfg = replace(cfg, max_seq_len=64)
   shard = Shard("tiny", 0, cfg.n_layers - 1, cfg.n_layers)
   params = load_shard_weights(tmp_path, cfg, shard)
 

@@ -129,6 +129,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
     from ..models.loader import load_shard_weights
 
     cfg = load_model_config(model_dir)
+    # Clamp the config's max_seq_len to the engine's serving cap: cache
+    # allocation uses it, and longrope (phi-3/4) selects its short vs long
+    # frequency factors from it (ops/rope.py) — a cap within the original
+    # context keeps exact HF short-context rope parity.
+    from dataclasses import replace as _dc_replace
+
+    cfg = _dc_replace(cfg, max_seq_len=min(self.max_seq_len, cfg.max_seq_len))
     # Registry layer counts can disagree with an arbitrary local checkpoint
     # (XOT_TPU_MODEL_DIR override): remap the shard's layer fractions onto the
     # checkpoint's real depth.

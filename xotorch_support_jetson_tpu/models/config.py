@@ -47,6 +47,22 @@ class YarnScaling:
 
 
 @dataclass(frozen=True)
+class LongRopeScaling:
+  """Phi-3/phi-4 'longrope': per-frequency factors with a sqrt attention
+  scale. HF switches short→long factors dynamically when the sequence
+  exceeds the original context; with static shapes the choice here keys off
+  the model's effective max_seq_len (the engine clamps it to the serving
+  cap, inference/jax_engine.py) — exact HF parity whenever the cap fits the
+  original context, consistently long-factor beyond it."""
+
+  short_factor: tuple[float, ...]
+  long_factor: tuple[float, ...]
+  original_max_position_embeddings: int
+  attention_factor: float = 1.0
+  rope_type: str = "longrope"
+
+
+@dataclass(frozen=True)
 class ModelConfig:
   vocab_size: int
   dim: int  # embedding/residual width
@@ -57,10 +73,11 @@ class ModelConfig:
   head_dim: int = 0  # 0 → dim // n_heads
   norm_eps: float = 1e-5
   rope_theta: float = 500000.0
-  rope_scaling: RopeScaling | YarnScaling | None = None
+  rope_scaling: RopeScaling | YarnScaling | LongRopeScaling | None = None
   max_seq_len: int = 8192
   qkv_bias: bool = False  # qwen2 uses attention biases
   attn_out_bias: bool = False
+  partial_rotary_factor: float = 1.0  # phi3/phi-4: rope only the leading channels
   tied_embedding: bool = False
   family: str = "llama"
   dtype: Any = jnp.bfloat16
@@ -217,6 +234,22 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
         attention_factor=float(attention_factor),
         truncate=bool(rs.get("truncate", True)),
       )
+    elif rope_type == "longrope":
+      import math
+
+      orig = int(hf.get("original_max_position_embeddings") or hf.get("max_position_embeddings", 4096))
+      attention_factor = rs.get("attention_factor")
+      if attention_factor is None:
+        factor = rs.get("factor")
+        if hf.get("original_max_position_embeddings"):
+          factor = hf.get("max_position_embeddings", orig) / orig
+        attention_factor = 1.0 if not factor or factor <= 1.0 else math.sqrt(1 + math.log(factor) / math.log(orig))
+      rope_scaling = LongRopeScaling(
+        short_factor=tuple(float(x) for x in rs["short_factor"]),
+        long_factor=tuple(float(x) for x in rs["long_factor"]),
+        original_max_position_embeddings=orig,
+        attention_factor=float(attention_factor),
+      )
 
   eos = hf.get("eos_token_id", [])
   if isinstance(eos, int):
@@ -286,6 +319,7 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     rope_scaling=rope_scaling,
     max_seq_len=int(hf.get("max_position_embeddings", 8192)),
     qkv_bias=family in ("qwen2", "qwen2-moe") or bool(hf.get("attention_bias", False)),
+    partial_rotary_factor=float(hf.get("partial_rotary_factor", 1.0)),
     tied_embedding=bool(hf.get("tie_word_embeddings", family == "qwen2" and int(hf["hidden_size"]) < 2048)),
     family=family,
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),

@@ -207,6 +207,18 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
             arr = _to_numpy(f.get_tensor(raw_name))
             per_layer[layer_idx][key] = arr.T if transpose else arr
             continue
+          if suffix == "self_attn.qkv_proj.weight":  # phi3: fused [q+k+v, D]
+            arr = _to_numpy(f.get_tensor(raw_name))
+            qd, kd = cfg.q_dim, cfg.kv_dim
+            per_layer[layer_idx]["wq"] = arr[:qd].T
+            per_layer[layer_idx]["wk"] = arr[qd : qd + kd].T
+            per_layer[layer_idx]["wv"] = arr[qd + kd :].T
+            continue
+          if suffix == "mlp.gate_up_proj.weight":  # phi3: fused [2F, D]
+            arr = _to_numpy(f.get_tensor(raw_name))
+            per_layer[layer_idx]["w_gate"] = arr[: cfg.hidden_dim].T
+            per_layer[layer_idx]["w_up"] = arr[cfg.hidden_dim :].T
+            continue
           em = _EXPERT_RE.match(suffix)
           if em is not None:
             key = _EXPERT_KEY[em.group(2)]

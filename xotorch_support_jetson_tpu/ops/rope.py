@@ -17,7 +17,7 @@ import math
 
 import jax.numpy as jnp
 
-from ..models.config import ModelConfig, RopeScaling, YarnScaling
+from ..models.config import LongRopeScaling, ModelConfig, RopeScaling, YarnScaling
 
 
 def rope_inv_freq(cfg: ModelConfig) -> jnp.ndarray:
@@ -26,19 +26,25 @@ def rope_inv_freq(cfg: ModelConfig) -> jnp.ndarray:
   For MLA models (deepseek) only the ``qk_rope_head_dim`` channel carries
   position; dense models rotate the whole head_dim.
   """
-  rot_dim = cfg.qk_rope_head_dim if cfg.is_mla else cfg.head_dim
+  rot_dim = cfg.qk_rope_head_dim if cfg.is_mla else int(cfg.head_dim * cfg.partial_rotary_factor)
   half = rot_dim // 2
   inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
   if isinstance(cfg.rope_scaling, YarnScaling):
     return _yarn_inv_freq(rot_dim, cfg.rope_theta, cfg.rope_scaling)
+  if isinstance(cfg.rope_scaling, LongRopeScaling):
+    s = cfg.rope_scaling
+    # Static short/long selection keyed to the effective max sequence (the
+    # engine clamps cfg.max_seq_len to its serving cap) — see LongRopeScaling.
+    ext = s.short_factor if cfg.max_seq_len <= s.original_max_position_embeddings else s.long_factor
+    return inv_freq / jnp.asarray(ext, dtype=jnp.float32)
   if isinstance(cfg.rope_scaling, RopeScaling):
     inv_freq = _llama3_scale(inv_freq, cfg.rope_scaling)
   return inv_freq
 
 
 def rope_attention_factor(cfg: ModelConfig) -> float:
-  """Yarn's post-scaling of cos/sin (HF multiplies freqs_cis by it); 1.0 otherwise."""
-  return cfg.rope_scaling.attention_factor if isinstance(cfg.rope_scaling, YarnScaling) else 1.0
+  """Yarn/longrope post-scaling of cos/sin (HF multiplies them by it); 1.0 otherwise."""
+  return cfg.rope_scaling.attention_factor if isinstance(cfg.rope_scaling, (YarnScaling, LongRopeScaling)) else 1.0
 
 
 def _yarn_inv_freq(dim: int, base: float, s: YarnScaling) -> jnp.ndarray:
@@ -82,14 +88,20 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray, at
 
   Half-rotation convention: (x1, x2) = split(x, 2, axis=-1);
   out = (x1*cos - x2*sin, x2*cos + x1*sin). ``attn_factor`` (yarn) scales
-  cos/sin.
+  cos/sin. When ``inv_freq`` covers fewer than head_dim/2 frequencies
+  (phi3's partial_rotary_factor) only the leading 2·|inv_freq| channels
+  rotate; the tail passes through unchanged.
   """
+  rot = 2 * inv_freq.shape[-1]
+  tail = None
+  if rot < x.shape[-1]:
+    x, tail = x[..., :rot], x[..., rot:]
   angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]  # [..., S, half]
   cos = jnp.cos(angles)[..., None, :] * attn_factor  # [..., S, 1, half]
   sin = jnp.sin(angles)[..., None, :] * attn_factor
   x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-  out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-  return out.astype(x.dtype)
+  out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+  return out if tail is None else jnp.concatenate([out, tail], axis=-1)
 
 
 def apply_rope_interleaved(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray, attn_factor: float = 1.0) -> jnp.ndarray:
