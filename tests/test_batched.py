@@ -28,15 +28,16 @@ CFG = tiny_test_config(n_layers=2, max_seq_len=128)
 KEY = jax.random.PRNGKey(0)
 
 
-def _single_row_reference(params, shard, prompt, n_steps):
+def _single_row_reference(params, shard, prompt, n_steps, cfg=None):
   """Independent greedy decode of one prompt (the no-batching ground truth)."""
+  cfg = cfg or CFG
   S = len(prompt)
   tokens = jnp.asarray([prompt], dtype=jnp.int32)
   positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
-  cache = init_kv_cache(CFG, shard.n_shard_layers, 1, 64)
-  logits, cache = shard_forward(params, CFG, shard, tokens, positions, cache)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 64)
+  logits, cache = shard_forward(params, cfg, shard, tokens, positions, cache)
   first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
-  toks, _ = fused_decode(params, CFG, shard, first, cache, jnp.full((1,), S, jnp.int32), n_steps, temp=0.0)
+  toks, _ = fused_decode(params, cfg, shard, first, cache, jnp.full((1,), S, jnp.int32), n_steps, temp=0.0)
   return [int(first[0, 0])] + [int(t) for t in np.asarray(toks)[0]]
 
 
@@ -248,3 +249,53 @@ def test_batched_server_cancel_frees_slot():
     return out_long
 
   asyncio.run(run())
+
+
+def test_batched_decode_with_int8_params():
+  """Quantized (XOT_TPU_QUANT=int8) params work in the pooled batch path and
+  match the quantized solo decode exactly (same compiled math per row)."""
+  from xotorch_support_jetson_tpu.models.quantize import quantize_params
+
+  params, shard = full_model_params(KEY, CFG)
+  qp = quantize_params(params)
+  prompt = [3, 25, 9]
+  S = len(prompt)
+  solo = _single_row_reference(qp, shard, prompt, 5)
+
+  # Same request through a 2-slot pool.
+  pool = init_kv_cache(CFG, shard.n_shard_layers, 2, 64)
+  pad = np.zeros((1, 16), np.int32)
+  pad[0, :S] = prompt
+  last, pool = prefill_into_slot(qp, CFG, shard, jnp.asarray(pad), pool, jnp.int32(0), jnp.int32(S))
+  got = [int(np.argmax(np.asarray(last)[0]))]
+  toks, _, pool = fused_batch_decode(
+    qp, CFG, shard, jnp.asarray([[got[0]], [0]], jnp.int32), pool,
+    jnp.asarray([S, 0], jnp.int32), jnp.asarray([True, False]), jnp.zeros((2,), jnp.float32), 5,
+  )
+  got += [int(t) for t in np.asarray(toks)[0]]
+  assert got == solo
+
+
+def test_batched_decode_with_moe_model():
+  """The pooled batch path runs MoE models (routing is per-token, so pool
+  rows route independently) and matches the solo MoE decode."""
+  moe_cfg = tiny_test_config(
+    n_layers=2, max_seq_len=128, n_experts=4, n_active_experts=2,
+    moe_hidden_dim=32, shared_expert_dim=32, first_k_dense=1,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(21), moe_cfg)
+  prompt = [7, 3, 40]
+  S = len(prompt)
+  solo = _single_row_reference(params, shard, prompt, 4, cfg=moe_cfg)
+
+  pool = init_kv_cache(moe_cfg, shard.n_shard_layers, 3, 64)
+  pad = np.zeros((1, 16), np.int32)
+  pad[0, :S] = prompt
+  last, pool = prefill_into_slot(params, moe_cfg, shard, jnp.asarray(pad), pool, jnp.int32(1), jnp.int32(S))
+  got = [int(np.argmax(np.asarray(last)[0]))]
+  toks, _, pool = fused_batch_decode(
+    params, moe_cfg, shard, jnp.asarray([[0], [got[0]], [0]], jnp.int32), pool,
+    jnp.asarray([0, S, 0], jnp.int32), jnp.asarray([False, True, False]), jnp.zeros((3,), jnp.float32), 4,
+  )
+  got += [int(t) for t in np.asarray(toks)[1]]
+  assert got == solo
