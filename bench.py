@@ -150,6 +150,26 @@ def main() -> None:
     _ = np.asarray(btoks)
     batch8_tok_s = round(Bb * n_decode / (time.perf_counter() - t0), 2)
 
+  # Pipeline-parallel serving decode (parallel/pp_serving.py): only runs when
+  # the host exposes >=2 accelerator chips (the driver's bench env tunnels one
+  # chip, so this is the ready-for-multichip hook, exercised in tests and
+  # dryrun_multichip on the virtual mesh).
+  pp_decode_tok_s = None
+  if on_accel and len(jax.devices()) >= 2:
+    from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan, build_mesh
+    from xotorch_support_jetson_tpu.parallel.pp_serving import PPServing
+
+    n_dev = len(jax.devices())
+    pp_deg = n_dev if cfg.n_layers % n_dev == 0 else 2
+    pp = PPServing(build_mesh(MeshPlan(pp=pp_deg)), cfg, params, pp_deg, True, True)
+    pcache = pp.place_cache(init_kv_cache(cfg, shard.n_shard_layers, B, max_seq))
+    ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.zeros((B,), jnp.int32), n_decode)
+    _ = np.asarray(ptoks)
+    t0 = time.perf_counter()
+    ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.full((B,), n_decode, jnp.int32), n_decode)
+    _ = np.asarray(ptoks)
+    pp_decode_tok_s = round(n_decode * B / (time.perf_counter() - t0), 2)
+
   vs_baseline = None
   try:  # compare to the previous round's recorded value if the driver left one
     import glob
@@ -172,6 +192,7 @@ def main() -> None:
         "serving_chunked_tok_s": round(serving_tok_s, 2),
         "int8_decode_tok_s": int8_tok_s,
         "batch8_aggregate_tok_s": batch8_tok_s,
+        "pp_decode_tok_s": pp_decode_tok_s,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "platform": platform,
         "device": str(jax.devices()[0]),

@@ -90,7 +90,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
   layer range across all of its own chips.
   """
 
-  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None):
+  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None, pp: int | None = None):
     super().__init__()
     self.shard_downloader = shard_downloader
     self.shard: Shard | None = None
@@ -103,6 +103,11 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # reference instead ships separate -8bit checkpoints (models.py:29).
     self.quant = quant if quant is not None else (os.getenv("XOT_TPU_QUANT") or None)
     self.use_local_mesh = use_local_mesh if use_local_mesh is not None else os.getenv("XOT_TPU_LOCAL_MESH", "1") == "1"
+    # XOT_TPU_PP=N serves the loaded layer range as N pipeline stages over the
+    # local chips (parallel/pp_serving.py) — the in-slice rendering of the
+    # reference's layer-split serving; remaining chips go to tp.
+    self.pp = pp if pp is not None else int(os.getenv("XOT_TPU_PP", "0") or 0)
+    self._pp = None
     self.mesh = None
     self.sessions: dict[str, _Session] = {}
     # One worker thread serializes all device work off the asyncio loop —
@@ -161,6 +166,29 @@ class JaxShardedInferenceEngine(InferenceEngine):
       print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
 
   def _maybe_shard_over_local_mesh(self) -> None:
+    if self.pp > 1:
+      from ..parallel.mesh import MeshPlan, build_mesh
+      from ..parallel.pp_serving import PPServing
+
+      n = len(jax.devices())
+      if n < self.pp:
+        raise ValueError(f"XOT_TPU_PP={self.pp} but only {n} local devices")
+      if self.cfg.vision is not None:
+        # Reject at load: the pp split keeps only the decoder stack + head, so
+        # an image request would otherwise crash mid-request on the missing
+        # vision tower params.
+        raise ValueError("XOT_TPU_PP pipeline serving does not support vision models yet")
+      tp = 1
+      limit = min(n // self.pp, self.cfg.n_heads)
+      while tp * 2 <= limit:
+        tp *= 2
+      self.mesh = build_mesh(MeshPlan(pp=self.pp, tp=tp))
+      eff = getattr(self, "_effective_shard", self.shard)
+      self._pp = PPServing(self.mesh, self.cfg, self.params, self.pp, eff.is_first_layer, eff.is_last_layer)
+      # The pp-placed stage/head copies are the serving params; drop the
+      # original so a >1-chip model doesn't also hold a full-size copy.
+      self.params = None
+      return
     if not self.use_local_mesh or len(jax.devices()) <= 1:
       return
     from ..parallel.mesh import build_mesh, inference_plan, shard_params
@@ -170,6 +198,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.params = shard_params(self.params, self.mesh)
 
   def _place_cache(self, cache):
+    if self._pp is not None:
+      return self._pp.place_cache(cache)
     if self.mesh is None:
       return cache
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -326,7 +356,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
       else:
         x_in = x  # hidden states arrive already padded by the first shard
       lens = jnp.full((B,), prompt_len, dtype=jnp.int32)
-      out, session.kv_cache = _prefill(self.params, self.cfg, shard, jnp.asarray(x_in), session.kv_cache, lens)
+      if self._pp is not None:
+        out, session.kv_cache = self._pp.prefill(jnp.asarray(x_in), session.kv_cache, lens)
+      else:
+        out, session.kv_cache = _prefill(self.params, self.cfg, shard, jnp.asarray(x_in), session.kv_cache, lens)
       session.curr_pos = session.prompt_len = prompt_len
     else:
       if session.curr_pos >= session.max_seq:
@@ -338,7 +371,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
       else:
         x_step = x
       pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
-      out, session.kv_cache = _decode_step(self.params, self.cfg, shard, jnp.asarray(x_step), session.kv_cache, pos)
+      if self._pp is not None:
+        out, session.kv_cache = self._pp.decode_step(jnp.asarray(x_step), session.kv_cache, pos)
+      else:
+        out, session.kv_cache = _decode_step(self.params, self.cfg, shard, jnp.asarray(x_step), session.kv_cache, pos)
       session.curr_pos += 1
 
     state.curr_pos = session.curr_pos
@@ -381,10 +417,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
         raise RuntimeError(f"no chained token for request {request_id}; pass first_token after prefill")
     start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
     self._key, sub = jax.random.split(self._key)
-    toks, session.kv_cache = fused_decode(
-      self.params, self.cfg, shard, token, session.kv_cache, start_pos, n_steps,
-      temp=float(temp), top_k=int(top_k), key=sub,
-    )
+    if self._pp is not None:
+      toks, session.kv_cache = self._pp.fused_decode(token, session.kv_cache, start_pos, n_steps, temp=float(temp), top_k=int(top_k), key=sub)
+    else:
+      toks, session.kv_cache = fused_decode(
+        self.params, self.cfg, shard, token, session.kv_cache, start_pos, n_steps,
+        temp=float(temp), top_k=int(top_k), key=sub,
+      )
     session.next_token_dev = toks[:, -1:]
     session.curr_pos += n_steps
     return toks
@@ -428,10 +467,15 @@ class JaxShardedInferenceEngine(InferenceEngine):
     start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
     self._key, sub = jax.random.split(self._key)
     eos = tuple(sorted(int(e) for e in eos_ids))
-    buf, _n, session.kv_cache = fused_generate(
-      self.params, self.cfg, shard, token, session.kv_cache, start_pos, steps,
-      eos_ids=eos, temp=float(temp), top_k=int(top_k), key=sub, n_limit=limit,
-    )
+    if self._pp is not None:
+      buf, _n, session.kv_cache = self._pp.fused_generate(
+        token, session.kv_cache, start_pos, steps, eos_ids=eos, temp=float(temp), top_k=int(top_k), key=sub, n_limit=limit
+      )
+    else:
+      buf, _n, session.kv_cache = fused_generate(
+        self.params, self.cfg, shard, token, session.kv_cache, start_pos, steps,
+        eos_ids=eos, temp=float(temp), top_k=int(top_k), key=sub, n_limit=limit,
+      )
     # ONE host readback: the step count is recovered from the first EOS hit
     # (the while_loop stops right after writing it), not fetched separately —
     # each scalar fetch through a tunneled link costs a full ~67 ms RTT.
@@ -454,6 +498,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
   def get_batched_server(self):
     """Lazy continuous-batching scheduler (inference/batch_scheduler.py);
     one per loaded model — the pooled KV cache is model-specific."""
+    if self._pp is not None:
+      raise RuntimeError("batched serving (XOT_TPU_BATCHED) is not yet composed with XOT_TPU_PP pipeline serving")
     if getattr(self, "_batched_server", None) is None:
       from .batch_scheduler import BatchedServer
 
@@ -484,6 +530,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = None
     self.tokenizer = None
     self.mesh = None
+    self._pp = None
     self.sessions.clear()
     self._drop_batched_server()
 
@@ -495,6 +542,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
   #  see engine.py module docstring re the reference's missing train/evaluate)
 
   async def train(self, request_id, shard, inputs, targets, lengths, loss="ce", opt="adamw", lr=1e-5):
+    if self._pp is not None:
+      raise RuntimeError("training is not supported in XOT_TPU_PP serving mode (use parallel/train_step.py pipeline training)")
     from ..train.trainer import engine_train_step
 
     return await asyncio.get_event_loop().run_in_executor(
@@ -502,16 +551,22 @@ class JaxShardedInferenceEngine(InferenceEngine):
     )
 
   async def evaluate(self, request_id, shard, inputs, targets, lengths, loss="ce"):
+    if self._pp is not None:
+      raise RuntimeError("evaluate is not supported in XOT_TPU_PP serving mode")
     from ..train.trainer import engine_eval_step
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, engine_eval_step, self, shard, inputs, targets, lengths, loss)
 
   async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
+    if self._pp is not None:
+      raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")
     from ..train.checkpoint import save_params
 
     await asyncio.get_event_loop().run_in_executor(self.executor, save_params, self.params, path)
 
   async def load_checkpoint(self, shard: Shard, path: str | Path) -> None:
+    if self._pp is not None:
+      raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")
     from ..train.checkpoint import load_params
 
     loaded = await asyncio.get_event_loop().run_in_executor(self.executor, load_params, path, self.params)

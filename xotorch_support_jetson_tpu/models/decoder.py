@@ -357,6 +357,28 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   return h, k_cache, v_cache, aux
 
 
+def embed_tokens(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+  """Token ids [B,S] → embeddings [B,S,D] in model dtype."""
+  return jnp.take(params["embed"], x, axis=0).astype(cfg.dtype)
+
+
+def head_logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+  """Final norm + LM head: hidden [B,S,D] → fp32 logits [B,S,V].
+
+  Shared by the last-shard path below and the pipeline-parallel serving
+  programs (parallel/pp_serving.py), which run it replicated on every stage.
+  """
+  h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+  if "lm_head_scale" in params:
+    return qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
+  w_out = params.get("lm_head")
+  if w_out is None:
+    w_out = params["embed"].T  # tied embeddings, single-params case
+  # Keep operands in model dtype on the MXU; accumulate fp32. (Casting the
+  # [D,V] head to fp32 would double its HBM traffic on every decode step.)
+  return jax.lax.dot_general(h, w_out.astype(h.dtype), (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
 def shard_forward(
   params: Params,
   cfg: ModelConfig,
@@ -372,7 +394,7 @@ def shard_forward(
   Without a cache: plain causal attention within the call (training path).
   """
   if x.ndim == 2:  # token ids — valid only on the first shard
-    h = jnp.take(params["embed"], x, axis=0).astype(cfg.dtype)
+    h = embed_tokens(params, cfg, x)
   else:
     h = x.astype(cfg.dtype)
 
@@ -416,17 +438,7 @@ def shard_forward(
     new_cache = None
 
   if shard.is_last_layer:
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    if "lm_head_scale" in params:
-      logits = qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
-      return logits, new_cache
-    w_out = params.get("lm_head")
-    if w_out is None:
-      w_out = params["embed"].T  # tied embeddings, single-params case
-    # Keep operands in model dtype on the MXU; accumulate fp32. (Casting the
-    # [D,V] head to fp32 would double its HBM traffic on every decode step.)
-    logits = jax.lax.dot_general(h, w_out.astype(h.dtype), (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    return logits, new_cache
+    return head_logits(params, cfg, h), new_cache
   return h, new_cache
 
 
