@@ -161,14 +161,15 @@ def main() -> None:
 
     n_dev = len(jax.devices())
     pp_deg = n_dev if cfg.n_layers % n_dev == 0 else 2
-    pp = PPServing(build_mesh(MeshPlan(pp=pp_deg)), cfg, params, pp_deg, True, True)
-    pcache = pp.place_cache(init_kv_cache(cfg, shard.n_shard_layers, B, max_seq))
-    ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.zeros((B,), jnp.int32), n_decode)
-    _ = np.asarray(ptoks)
-    t0 = time.perf_counter()
-    ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.full((B,), n_decode, jnp.int32), n_decode)
-    _ = np.asarray(ptoks)
-    pp_decode_tok_s = round(n_decode * B / (time.perf_counter() - t0), 2)
+    if cfg.n_layers % pp_deg == 0:  # skip (like other optional sections) rather than abort the run
+      pp = PPServing(build_mesh(MeshPlan(pp=pp_deg)), cfg, params, pp_deg, True, True)
+      pcache = pp.place_cache(init_kv_cache(cfg, shard.n_shard_layers, B, max_seq))
+      ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.zeros((B,), jnp.int32), n_decode)
+      _ = np.asarray(ptoks)
+      t0 = time.perf_counter()
+      ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.full((B,), n_decode, jnp.int32), n_decode)
+      _ = np.asarray(ptoks)
+      pp_decode_tok_s = round(n_decode * B / (time.perf_counter() - t0), 2)
 
   vs_baseline = None
   try:  # compare to the previous round's recorded value if the driver left one

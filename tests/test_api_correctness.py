@@ -1,0 +1,302 @@
+"""API correctness: truthful usage, stop strings, error mapping, per-request
+top_k, queue limits, longrope default cap (round-2 VERDICT/ADVICE items)."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI, find_stop
+from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+from xotorch_support_jetson_tpu.inference.engine import PromptTooLongError, ServerOverloadedError
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from tests_support_stubs import NoDiscovery, StubServer
+
+
+async def _make_api(**api_kwargs):
+  node = Node(
+    "api-node",
+    StubServer(),
+    DummyInferenceEngine(),
+    NoDiscovery(),
+    None,
+    RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=50,
+  )
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy", **api_kwargs)
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+def test_find_stop_helper():
+  assert find_stop("hello world", ("wor",)) == (6, 6)
+  # no match, but a suffix could start a stop string -> held back
+  cut, safe = find_stop("hello wo", ("world",))
+  assert cut is None and safe == 6
+  cut, safe = find_stop("hello", ("xyz",))
+  assert cut is None and safe == 5
+  assert find_stop("abab", ("ab",)) == (0, 0)
+
+
+@pytest.mark.asyncio
+async def test_blocking_usage_and_stop_string():
+  node, api, client = await _make_api()
+  try:
+    # Dummy engine: prompt "aaaa" -> token [4], then 5, 6, 7, ... greedy.
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False, "stop": "8"},
+    )
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert "8" not in choice["message"]["content"]
+    assert "7" in choice["message"]["content"]
+    usage = data["usage"]
+    assert usage["prompt_tokens"] == 1  # "aaaa" -> one 4-char word
+    assert usage["completion_tokens"] >= 1
+    assert usage["total_tokens"] == usage["prompt_tokens"] + usage["completion_tokens"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_stop_string_and_include_usage():
+  node, api, client = await _make_api()
+  try:
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={
+        "model": "dummy",
+        "messages": [{"role": "user", "content": "aaaa"}],
+        "stream": True,
+        "stop": ["8"],
+        "stream_options": {"include_usage": True},
+      },
+    )
+    assert resp.status == 200
+    body = (await resp.read()).decode()
+    events = [json.loads(line[6:]) for line in body.splitlines() if line.startswith("data: ") and line != "data: [DONE]"]
+    text = "".join(e["choices"][0]["delta"].get("content", "") for e in events if e.get("choices"))
+    assert "8" not in text and "7" in text
+    finishes = [e["choices"][0].get("finish_reason") for e in events if e.get("choices")]
+    assert "stop" in finishes
+    usage_events = [e for e in events if "usage" in e]
+    assert usage_events and usage_events[-1]["usage"]["prompt_tokens"] == 1
+    assert body.rstrip().endswith("data: [DONE]")
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_prompt_too_long_maps_to_400_and_overload_to_429():
+  node, api, client = await _make_api()
+  try:
+    orig = node.process_prompt
+
+    async def raise_too_long(*a, **k):
+      raise PromptTooLongError("prompt of 9999 tokens exceeds the 128-token context window")
+
+    node.process_prompt = raise_too_long
+    resp = await client.post(
+      "/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}], "stream": False}
+    )
+    assert resp.status == 400
+    err = (await resp.json())["error"]
+    assert err["code"] == "context_length_exceeded"
+
+    async def raise_overload(*a, **k):
+      raise ServerOverloadedError("request queue full (64 waiting)")
+
+    node.process_prompt = raise_overload
+    resp = await client.post(
+      "/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}], "stream": False}
+    )
+    assert resp.status == 429
+    node.process_prompt = orig
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_error_before_first_token_gets_real_status():
+  """Failures knowable before the first token must surface as proper HTTP
+  statuses, not a 200 SSE stream (the stream is committed only after the
+  first token batch arrives)."""
+  node, api, client = await _make_api()
+  try:
+
+    async def boom(*a, **k):
+      raise PromptTooLongError("prompt of 9999 tokens exceeds the 128-token context window")
+
+    node.process_prompt = boom
+    resp = await client.post(
+      "/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}], "stream": True}
+    )
+    assert resp.status == 400
+    assert (await resp.json())["error"]["code"] == "context_length_exceeded"
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_error_after_first_token_reported_in_band():
+  """After prepare(), failures must arrive as SSE events, not a second
+  response object (ADVICE round-1 item 1)."""
+  node, api, client = await _make_api()
+  try:
+
+    async def boom_after_token(shard, prompt, request_id, inference_state=None, **k):
+      node.trigger_on_token_callbacks(request_id, [5], False)
+      await asyncio.sleep(0.05)
+      raise RuntimeError("engine exploded")
+
+    node.process_prompt = boom_after_token
+    resp = await client.post(
+      "/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}], "stream": True}
+    )
+    assert resp.status == 200  # stream already committed by the first token
+    body = (await resp.read()).decode()
+    assert "engine exploded" in body
+    assert body.rstrip().endswith("data: [DONE]")
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_flushes_heldback_stop_prefix_on_finish():
+  """Text held back as a potential stop-string prefix must flush when
+  generation finishes without the stop string completing."""
+  node, api, client = await _make_api()
+  try:
+    # Dummy tokens run 5..54 (max 50): text ends "... 53 54"; "4X" holds back
+    # the trailing "4" until EOS-less finish, which must flush it.
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": True, "stop": ["4X"]},
+    )
+    body = (await resp.read()).decode()
+    events = [json.loads(line[6:]) for line in body.splitlines() if line.startswith("data: ") and line != "data: [DONE]"]
+    text = "".join(e["choices"][0]["delta"].get("content", "") for e in events if e.get("choices"))
+
+    resp2 = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False},
+    )
+    blocking_text = (await resp2.json())["choices"][0]["message"]["content"]
+    assert text == blocking_text  # no silent truncation of the held suffix
+  finally:
+    await client.close()
+    await node.stop()
+
+
+def test_solo_engine_rejects_too_long_prompt():
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=32)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "m")
+  eng = JaxShardedInferenceEngine(use_local_mesh=False)
+  eng.load_test_model(shard, cfg, params)
+  with pytest.raises(PromptTooLongError):
+    eng._infer_tensor_sync("r", shard, np.ones((1, 40), np.int32), None)
+  assert "r" not in eng.sessions
+
+
+def test_batched_scheduler_prompt_too_long_and_queue_limit():
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=64)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "m")
+  eng = JaxShardedInferenceEngine(use_local_mesh=False, max_seq_len=64)
+  eng.load_test_model(shard, cfg, params)
+
+  async def run():
+    server = BatchedServer(eng, n_slots=2, chunk=4, max_queue=1)
+
+    def emit(rid, toks, fin):
+      pass
+
+    with pytest.raises(PromptTooLongError):
+      await server.submit("too-long", np.ones(70, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+
+    # Saturate the queue while the loop is blocked admitting; the next submit
+    # must fail fast with ServerOverloadedError.
+    server2 = BatchedServer(eng, n_slots=2, chunk=4, max_queue=0)
+    with pytest.raises(ServerOverloadedError):
+      await server2.submit("r1", np.ones(4, np.int32), max_tokens=2, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+    server.shutdown()
+    server2.shutdown()
+
+  asyncio.run(run())
+
+
+def test_per_request_top_k_is_honored_per_row():
+  """top_k=1 with temp>0 must equal greedy for that row while other rows
+  sample from their own k (was: pool-wide static top_k, NOTES round-1)."""
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_batch_decode, init_kv_cache
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=64)
+  params, shard = full_model_params(jax.random.PRNGKey(1), cfg, "m")
+  B, n_steps = 3, 6
+  prompt_len = 4
+
+  def run(top_ks, temps):
+    cache = init_kv_cache(cfg, shard.n_shard_layers, B, 64)
+    from xotorch_support_jetson_tpu.models.decoder import prefill_into_slot
+    import jax.numpy as jnp
+
+    for row in range(B):
+      _, cache = prefill_into_slot(params, cfg, shard, jnp.ones((1, prompt_len), jnp.int32), cache, jnp.int32(row), jnp.int32(prompt_len))
+    toks, _, _ = fused_batch_decode(
+      params, cfg, shard,
+      jnp.full((B, 1), 7, jnp.int32), cache, jnp.full((B,), prompt_len, jnp.int32),
+      jnp.ones((B,), bool), jnp.asarray(temps, jnp.float32), n_steps,
+      top_k=jnp.asarray(top_ks, jnp.int32), key=jax.random.PRNGKey(9),
+    )
+    return np.asarray(toks)
+
+  greedy_rows = run([1, 1, 1], [0.0, 0.0, 0.0])
+  mixed = run([1, 1, 50], [0.9, 0.0, 0.9])  # row0 temp>0 but k=1 => greedy; row1 greedy; row2 samples
+  np.testing.assert_array_equal(mixed[0], greedy_rows[0])
+  np.testing.assert_array_equal(mixed[1], greedy_rows[1])
+
+
+def test_longrope_default_cap_and_explicit_override():
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import LongRopeScaling, tiny_test_config
+
+  scaling = LongRopeScaling(
+    short_factor=(1.0,) * 8,
+    long_factor=(4.0,) * 8,
+    original_max_position_embeddings=2048,
+    attention_factor=1.0,
+  )
+  cfg = tiny_test_config(head_dim=16, max_seq_len=16384, rope_scaling=scaling)
+
+  eng_default = JaxShardedInferenceEngine(use_local_mesh=False)  # cap defaulted
+  assert eng_default._serving_cap(cfg) == 2048
+
+  eng_explicit = JaxShardedInferenceEngine(use_local_mesh=False, max_seq_len=8192)
+  assert eng_explicit._serving_cap(cfg) == 8192
+
+  plain = tiny_test_config(max_seq_len=16384)
+  assert eng_default._serving_cap(plain) == min(eng_default.max_seq_len, 16384)

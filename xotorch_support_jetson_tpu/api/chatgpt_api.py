@@ -36,13 +36,39 @@ class Message:
 
 
 class ChatCompletionRequest:
-  def __init__(self, model: str, messages: list[Message], temperature: float | None = None, tools=None, max_tokens=None, stream=False):
+  def __init__(self, model: str, messages: list[Message], temperature: float | None = None, tools=None, max_tokens=None, stream=False, stop=()):
     self.model = model
     self.messages = messages
     self.temperature = temperature
     self.tools = tools
     self.max_tokens = max_tokens
     self.stream = stream
+    self.stop = tuple(stop)
+
+
+def find_stop(text: str, stops: tuple) -> tuple[int | None, int]:
+  """Stop-string scan over accumulated response text.
+
+  Returns (cut, safe_len): ``cut`` is the index of the earliest stop-string
+  occurrence (None if absent); ``safe_len`` is how much of ``text`` can be
+  emitted now without risking that a later chunk completes a stop string
+  across the boundary (the longest text suffix that is a proper prefix of
+  any stop string is held back).
+  """
+  cut = None
+  for s in stops:
+    i = text.find(s)
+    if i != -1:
+      cut = i if cut is None else min(cut, i)
+  if cut is not None:
+    return cut, cut
+  hold = 0
+  for s in stops:
+    for l in range(min(len(s) - 1, len(text)), 0, -1):
+      if text.endswith(s[:l]):
+        hold = max(hold, l)
+        break
+  return None, len(text) - hold
 
 
 def remap_messages(messages: list[Message], vision: bool = False) -> tuple[list[Message], list[str]]:
@@ -106,6 +132,15 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
   temperature = data.get("temperature")
   if temperature is not None and (not isinstance(temperature, (int, float)) or isinstance(temperature, bool) or not 0 <= temperature <= 2):
     raise ValueError("'temperature' must be a number in [0, 2]")
+  stop = data.get("stop")
+  if stop is None:
+    stop = ()
+  elif isinstance(stop, str):
+    stop = (stop,)
+  elif isinstance(stop, list) and all(isinstance(s, str) and s for s in stop) and len(stop) <= 4:
+    stop = tuple(stop)
+  else:
+    raise ValueError("'stop' must be a non-empty string or a list of up to 4 non-empty strings")
   model = data.get("model", default_model)
   if model and model.startswith("gpt-"):  # alias ChatGPT client defaults
     model = default_model
@@ -122,6 +157,7 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
     data.get("tools"),
     max_tokens,
     data.get("stream", False),
+    stop,
   )
 
 
@@ -411,6 +447,14 @@ class ChatGPTAPI:
       from ..inference.state import InferenceState
 
       initial_state = InferenceState(extras={"images": images})
+    # Truthful usage accounting (the reference reports none at all). Encoding
+    # the prompt again costs one BPE pass — only pay it when usage will
+    # actually be reported (blocking always; streaming only on request).
+    include_usage = bool((data.get("stream_options") or {}).get("include_usage"))
+    need_usage = not chat_request.stream or include_usage
+    prompt_tokens = len(tokenizer.encode(prompt)) if need_usage and hasattr(tokenizer, "encode") else 0
+    from ..inference.engine import PromptTooLongError, ServerOverloadedError
+
     try:
       if chat_request.stream:
         # Generation runs CONCURRENTLY with the SSE stream: tokens flow to
@@ -419,7 +463,7 @@ class ChatGPTAPI:
         # its batch slot / decode loop) instead of running to max_tokens.
         gen_task = asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))
         try:
-          return await self._stream_response(request, chat_request, request_id, tokenizer, created, gen_task)
+          return await self._stream_response(request, chat_request, request_id, tokenizer, created, gen_task, prompt_tokens, include_usage)
         finally:
           if not gen_task.done():
             cancel = getattr(self.node, "cancel_request", None)
@@ -429,13 +473,25 @@ class ChatGPTAPI:
             await asyncio.wait_for(asyncio.shield(gen_task), timeout=30)
           except Exception:  # noqa: BLE001 — surfaced via the stream already
             pass
-      await asyncio.wait_for(
-        asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
-        timeout=self.response_timeout,
-      )
-      return await self._blocking_response(chat_request, request_id, tokenizer, created)
+      try:
+        await asyncio.wait_for(
+          asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
+          timeout=self.response_timeout,
+        )
+      except asyncio.TimeoutError:
+        # The shielded generation would otherwise keep decoding (and keep its
+        # batch slot) until max_tokens after the client got its 408.
+        cancel = getattr(self.node, "cancel_request", None)
+        if cancel is not None:
+          cancel(request_id)
+        raise
+      return await self._blocking_response(chat_request, request_id, tokenizer, created, prompt_tokens)
     except asyncio.TimeoutError:
       return web.json_response({"detail": "Response generation timed out"}, status=408)
+    except PromptTooLongError as e:
+      return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
+    except ServerOverloadedError as e:
+      return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
     except Exception as e:  # noqa: BLE001
       if DEBUG >= 1:
         import traceback
@@ -470,7 +526,12 @@ class ChatGPTAPI:
         if gen_task is not None and gen_task.done() and gen_task.exception() is not None:
           raise gen_task.exception()
 
-  async def _stream_response(self, request, chat_request, request_id, tokenizer, created, gen_task=None):
+  async def _stream_response(self, request, chat_request, request_id, tokenizer, created, gen_task=None, prompt_tokens: int = 0, include_usage: bool = False):
+    # Fetch the FIRST token batch before committing the SSE response: errors
+    # knowable at admission (PromptTooLongError, ServerOverloadedError, a
+    # pre-first-token timeout) propagate to the handler and get their proper
+    # 400/429/408 status instead of a 200 stream with an in-band error.
+    tokens, is_finished = await self._next_tokens(request_id, gen_task)
     response = web.StreamResponse(
       status=200,
       reason="OK",
@@ -482,39 +543,82 @@ class ChatGPTAPI:
     # Incremental detokenization: decode the full token list each time and
     # emit the text suffix — per-token decode drops BPE leading spaces.
     all_tokens: list[int] = []
+    n_completion = 0
     emitted_text = ""
+    stops = chat_request.stop
     try:
       while True:
-        tokens, is_finished = await self._next_tokens(request_id, gen_task)
+        n_completion += len(tokens)
         all_tokens.extend(t for t in tokens if t not in eos_set)
         full_text = tokenizer.decode(all_tokens) if all_tokens else ""
-        delta = full_text[len(emitted_text):]
+        cut = None
+        safe_len = len(full_text)
+        if stops:
+          cut, safe_len = find_stop(full_text, stops)
+          if cut is not None:
+            full_text = full_text[:cut]
+            safe_len = cut
+          elif is_finished:
+            safe_len = len(full_text)  # flush any held-back stop-prefix suffix
+        delta = full_text[len(emitted_text):safe_len]
         if delta:
-          emitted_text = full_text
+          emitted_text = full_text[:safe_len]
           chunk = completion_chunk(request_id, chat_request.model, created, delta, None)
           await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        if cut is not None:
+          # Stop string hit: end the stream (the handler's finally cancels
+          # the still-running generation) — finish_reason "stop" per OpenAI.
+          chunk = completion_chunk(request_id, chat_request.model, created, None, "stop")
+          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+          break
         if is_finished:
           finish = self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)
           chunk = completion_chunk(request_id, chat_request.model, created, None, finish)
           await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
           break
-      await response.write(b"data: [DONE]\n\n")
-      await response.write_eof()
-      return response
-    except asyncio.TimeoutError:
-      return web.json_response({"detail": "Response generation timed out"}, status=408)
+        tokens, is_finished = await self._next_tokens(request_id, gen_task)
+      if include_usage:  # OpenAI stream_options.include_usage: final usage-only chunk
+        usage_chunk = completion_chunk(request_id, chat_request.model, created, None, None)
+        usage_chunk["choices"] = []
+        usage_chunk["usage"] = {"prompt_tokens": prompt_tokens, "completion_tokens": n_completion, "total_tokens": prompt_tokens + n_completion}
+        await response.write(f"data: {json.dumps(usage_chunk)}\n\n".encode())
+    except Exception as e:  # noqa: BLE001
+      # The SSE response is already committed (prepare() ran; bytes may be
+      # out) — aiohttp cannot send a second response on this connection, so
+      # report the failure IN-BAND as an SSE error event and end the stream
+      # cleanly instead of returning a fresh json_response the client would
+      # never parse.
+      detail = "Response generation timed out" if isinstance(e, asyncio.TimeoutError) else f"Error processing prompt: {e}"
+      if DEBUG >= 1 and not isinstance(e, asyncio.TimeoutError):
+        import traceback
 
-  async def _blocking_response(self, chat_request, request_id, tokenizer, created):
+        traceback.print_exc()
+      try:
+        await response.write(f"data: {json.dumps({'error': {'message': detail}})}\n\n".encode())
+      except ConnectionResetError:
+        return response  # client already gone
+    await response.write(b"data: [DONE]\n\n")
+    await response.write_eof()
+    return response
+
+  async def _blocking_response(self, chat_request, request_id, tokenizer, created, prompt_tokens: int = 0):
+    eos = getattr(tokenizer, "eos_token_id", None)
+    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     all_tokens: list[int] = []
     while True:
       tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
       all_tokens.extend(tokens)
       if is_finished:
         break
-    eos = getattr(tokenizer, "eos_token_id", None)
-    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    # Generation already completed (the handler awaits process_prompt before
+    # calling here), so stop strings are a single post-hoc scan + truncation.
+    content = tokenizer.decode([t for t in all_tokens if t not in eos_set])
     finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
-    content_tokens = [t for t in all_tokens if t not in eos_set]
+    if chat_request.stop:
+      cut, _safe = find_stop(content, chat_request.stop)
+      if cut is not None:
+        content = content[:cut]
+        finish_reason = "stop"
     return web.json_response(
       {
         "id": f"chatcmpl-{request_id}",
@@ -525,12 +629,12 @@ class ChatGPTAPI:
         "choices": [
           {
             "index": 0,
-            "message": {"role": "assistant", "content": tokenizer.decode(content_tokens)},
+            "message": {"role": "assistant", "content": content},
             "logprobs": None,
             "finish_reason": finish_reason,
           }
         ],
-        "usage": {"prompt_tokens": 0, "completion_tokens": len(all_tokens), "total_tokens": len(all_tokens)},
+        "usage": {"prompt_tokens": prompt_tokens, "completion_tokens": len(all_tokens), "total_tokens": prompt_tokens + len(all_tokens)},
       }
     )
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.helpers import DEBUG
+from .engine import PromptTooLongError, ServerOverloadedError
 
 PREFILL_BUCKET = 128
 
@@ -69,19 +70,23 @@ class _Slot:
 class BatchedServer:
   """Owns the slot pool and the decode loop for one engine."""
 
-  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None):
+  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None):
     self.engine = engine
     self.n_slots = n_slots or int(os.getenv("XOT_TPU_BATCH_SLOTS", "4"))
     self.chunk = chunk or int(os.getenv("XOT_TPU_BATCH_CHUNK", "8"))
-    # Pool-wide and FIXED: top_k is a static arg of the compiled batch-decode
-    # program, so honoring per-request values would both recompile per value
-    # and change sampling for rows already in flight. Per-request temperature
-    # IS honored (traced per row); temp<=0 rows are exact greedy.
-    self.top_k = top_k or int(os.getenv("XOT_TPU_BATCH_TOP_K", "35"))
+    # Per-request top_k IS honored (traced per row, like temperature —
+    # ops/sampling.py sample_logits_per_row); only the candidate-set cap
+    # ``k_max`` is static in the compiled program. Requests asking for more
+    # than k_max candidates are clipped.
+    self.k_max = top_k or int(os.getenv("XOT_TPU_BATCH_TOP_K_MAX", "64"))
+    # Admission backpressure: beyond this many queued requests, submit fails
+    # fast (the API maps it to 429) instead of growing the queue unboundedly.
+    self.max_queue = max_queue if max_queue is not None else int(os.getenv("XOT_TPU_BATCH_MAX_QUEUE", "64"))
     self.cache = None
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self.queue: asyncio.Queue[_Request] = asyncio.Queue()
+    self._queued: dict[str, _Request] = {}  # request_id → queued request (cancel lookup)
     self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
     self._admitting: set[str] = set()  # ids currently inside _admit
     self._loop_task: asyncio.Task | None = None
@@ -90,9 +95,9 @@ class BatchedServer:
 
   async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit) -> list:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
-    ``emit(request_id, new_tokens, finished)`` as chunks complete.
-    ``top_k`` is accepted for interface parity but the pool-wide static
-    ``self.top_k`` is what applies (see __init__)."""
+    ``emit(request_id, new_tokens, finished)`` as chunks complete."""
+    if self.queue.qsize() >= self.max_queue:
+      raise ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
     req = _Request(
       request_id=request_id,
       tokens=np.asarray(tokens, dtype=np.int32).reshape(-1),
@@ -103,6 +108,7 @@ class BatchedServer:
       emit=emit,
       future=asyncio.get_event_loop().create_future(),
     )
+    self._queued[request_id] = req
     await self.queue.put(req)
     if self._loop_task is None or self._loop_task.done():
       self._loop_task = asyncio.create_task(self._run())
@@ -110,20 +116,22 @@ class BatchedServer:
 
   def cancel(self, request_id: str) -> None:
     """Stop a request (client gone): its slot frees at the next chunk
-    boundary; a queued request finishes at admission; a cancel racing a
-    request that is mid-admission (between the queue and its slot, inside
-    _admit's prefill) is remembered via ``_cancelled_ids``. Cancels for ids
-    the scheduler has never seen are ignored — an unconditional record would
+    boundary; a queued request finishes at admission (looked up via the
+    ``_queued`` side table — asyncio.Queue has no public scan API and its
+    ``_queue`` deque is an implementation detail); a cancel racing a request
+    that is mid-admission (between the queue and its slot, inside _admit's
+    prefill) is remembered via ``_cancelled_ids``. Cancels for ids the
+    scheduler has never seen are ignored — an unconditional record would
     grow without bound (every disconnect reaches here, including requests
     that never entered the pool)."""
     for slot in self.slots:
       if slot is not None and slot.req.request_id == request_id:
         slot.cancelled = True
         return
-    for req in list(self.queue._queue):  # peek; asyncio.Queue has no scan API
-      if req.request_id == request_id and not req.future.done():
-        req.max_tokens = 0  # admitted-then-finished immediately
-        return
+    queued = self._queued.get(request_id)
+    if queued is not None and not queued.future.done():
+      queued.max_tokens = 0  # admitted-then-finished immediately
+      return
     if request_id in self._admitting:
       self._cancelled_ids.add(request_id)
 
@@ -162,6 +170,7 @@ class BatchedServer:
     from ..models.decoder import prefill_into_slot
 
     eng = self.engine
+    self._queued.pop(req.request_id, None)
     self._admitting.add(req.request_id)
     try:
       if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
@@ -171,10 +180,8 @@ class BatchedServer:
         return
       S = int(req.tokens.shape[0])
       if S + 1 >= self.max_seq:
-        req.emit(req.request_id, [], True)
-        if not req.future.done():
-          req.future.set_result([])
-        return
+        # A too-long prompt is a client error, not an empty completion.
+        raise PromptTooLongError(f"prompt of {S} tokens exceeds the {self.max_seq}-token context window")
       pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
       tok_pad = np.zeros((1, pad_to), dtype=np.int32)
       tok_pad[0, :S] = req.tokens
@@ -185,7 +192,7 @@ class BatchedServer:
         last, self.cache = prefill_into_slot(
           eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache, jnp.int32(row), jnp.int32(S)
         )
-        return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, self.top_k)).reshape(-1)[0])
+        return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
 
       first = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     except Exception as e:  # noqa: BLE001
@@ -228,6 +235,7 @@ class BatchedServer:
         tokens = np.array([[s.last_token if s else 0] for s in self.slots], dtype=np.int32)
         positions = np.array([s.pos if s else 0 for s in self.slots], dtype=np.int32)
         temps = np.array([s.req.temp if s else 0.0 for s in self.slots], dtype=np.float32)
+        top_ks = np.array([s.req.top_k if s else 1 for s in self.slots], dtype=np.int32)
         # Rows without cache room (or cancelled by their client) finish
         # before the chunk; the results loop below frees them.
         for i, s in enumerate(self.slots):
@@ -238,7 +246,8 @@ class BatchedServer:
           eng._key, sub = jax.random.split(eng._key)
           toks, _pos, self.cache = fused_batch_decode(
             eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
-            jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk, top_k=self.top_k, key=sub,
+            jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk,
+            top_k=jnp.asarray(top_ks), k_max=self.k_max, key=sub,
           )
           return np.asarray(toks)  # ONE readback for the whole pool chunk
 
@@ -292,6 +301,7 @@ class BatchedServer:
       if slot is not None and not slot.req.future.done():
         slot.req.future.set_exception(exc)
       self.slots[i] = None
+    self._queued.clear()
     while not self.queue.empty():
       req = self.queue.get_nowait()
       if not req.future.done():

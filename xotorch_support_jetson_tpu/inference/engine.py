@@ -24,6 +24,19 @@ from .shard import Shard
 from .state import InferenceState
 
 
+class PromptTooLongError(ValueError):
+  """Prompt exceeds the serving context window.
+
+  Raised at admission/prefill so the API can answer with an OpenAI-style
+  context-length 400 instead of a silent empty completion (the engine's
+  mid-decode cache exhaustion is a different, truncating condition).
+  """
+
+
+class ServerOverloadedError(RuntimeError):
+  """Request admission queue is full; the API answers 429."""
+
+
 class InferenceEngine(ABC):
   """A model-executing backend bound to one shard at a time.
 

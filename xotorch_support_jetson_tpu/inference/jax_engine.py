@@ -98,6 +98,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = None
     self.tokenizer = None
     self.max_seq_len = max_seq_len or DEFAULT_MAX_SEQ
+    # Whether the serving cap was chosen by the operator (constructor arg or
+    # XOT_TPU_MAX_SEQ) vs defaulted — longrope models default their cap to the
+    # pre-scaling original context for exact HF short-context parity.
+    self._max_seq_explicit = max_seq_len is not None or os.getenv("XOT_TPU_MAX_SEQ") is not None
     # XOT_TPU_QUANT=int8 loads ANY registry model weight-quantized (decode is
     # HBM-bound: ~half the weight bytes ≈ ~half the per-token latency). The
     # reference instead ships separate -8bit checkpoints (models.py:29).
@@ -140,7 +144,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # context keeps exact HF short-context rope parity.
     from dataclasses import replace as _dc_replace
 
-    cfg = _dc_replace(cfg, max_seq_len=min(self.max_seq_len, cfg.max_seq_len))
+    cfg = _dc_replace(cfg, max_seq_len=self._serving_cap(cfg))
     # Registry layer counts can disagree with an arbitrary local checkpoint
     # (XOT_TPU_MODEL_DIR override): remap the shard's layer fractions onto the
     # checkpoint's real depth.
@@ -164,6 +168,23 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._model_dir = Path(model_dir)
     if DEBUG >= 1:
       print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
+
+  def _serving_cap(self, cfg) -> int:
+    """The effective serving max_seq_len for a loaded config.
+
+    Longrope (phi-3/4) selects short vs long frequency factors from this cap
+    (ops/rope.py, static per loaded model): unless the operator chose a cap
+    explicitly, default it to the pre-scaling original context so the common
+    short-context case keeps exact HF parity; raising XOT_TPU_MAX_SEQ above
+    original_max_position_embeddings opts into the long factors.
+    """
+    cap = min(self.max_seq_len, cfg.max_seq_len)
+    if not self._max_seq_explicit:
+      from ..models.config import LongRopeScaling
+
+      if isinstance(cfg.rope_scaling, LongRopeScaling):
+        cap = min(cap, cfg.rope_scaling.original_max_position_embeddings)
+    return cap
 
   def _maybe_shard_over_local_mesh(self) -> None:
     if self.pp > 1:
@@ -343,6 +364,11 @@ class JaxShardedInferenceEngine(InferenceEngine):
     prefilling = session.curr_pos == 0
     if prefilling:
       prompt_len = state.prompt_len or x.shape[1]
+      if prompt_len + 1 > session.max_seq:
+        from .engine import PromptTooLongError
+
+        self.sessions.pop(request_id, None)
+        raise PromptTooLongError(f"prompt of {prompt_len} tokens exceeds the {session.max_seq}-token context window")
       # Remember the FIRST prefill's prompt length for the request lifetime:
       # a replay prefills the whole token history, and the max_tokens budget
       # must still count from the original prompt (node._check_finished).

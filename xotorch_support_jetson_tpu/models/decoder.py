@@ -588,23 +588,24 @@ def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row
   return last, cache
 
 
-def _next_token_batched(rows, key, temps, top_k: int):
-  """Per-row sampling: temp ≤ 0 rows greedy, others top-k at their temp."""
-  from ..ops.sampling import sample_logits
+def _next_token_batched(rows, key, temps, top_ks, k_max: int):
+  """Per-row sampling: temp ≤ 0 rows greedy, others top-k at their own
+  (traced) temperature and top_k (ops/sampling.py sample_logits_per_row)."""
+  from ..ops.sampling import sample_logits_per_row
 
   greedy_rows = jnp.argmax(rows, axis=-1).astype(jnp.int32)
   key, sub = jax.random.split(key)
-  safe_temp = jnp.where(temps > 0, temps, 1.0)[:, None]
-  sampled = sample_logits(rows / jnp.maximum(safe_temp, 1e-6), sub, temp=1.0, top_k=top_k)
+  safe_temp = jnp.where(temps > 0, temps, 1.0)
+  sampled = sample_logits_per_row(rows, sub, safe_temp, top_ks, k_max=k_max)
   return jnp.where(temps > 0, sampled, greedy_rows), key
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "top_k"), donate_argnums=(4,))
-def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k: int, key):
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max"), donate_argnums=(4,))
+def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
   def body(carry, _):
     tok, pos, cache, key = carry
     logits, new_cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
-    nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_k)
+    nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_ks, k_max)
     nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold their token
     pos = jnp.where(active, pos + 1, pos)  # ...and their position
     return (nxt[:, None], pos, new_cache, key), nxt
@@ -613,11 +614,12 @@ def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cach
   return jnp.moveaxis(toks, 0, 1), pos, cache
 
 
-def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k: int = 35, key=None):
+def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, key=None):
   """One compiled decode chunk over the whole slot pool.
 
   token [B,1] int32 (each row's last token; inactive rows ignored),
-  positions [B] int32, active [B] bool, temps [B] f32 (≤0 ⇒ greedy).
+  positions [B] int32, active [B] bool, temps [B] f32 (≤0 ⇒ greedy),
+  top_k int or [B] int32 per-row (traced; clipped to the static ``k_max``).
   Returns (tokens [B, n_steps], new positions [B], cache). Inactive rows do
   not advance and their cache rows stay untouched at their position.
   """
@@ -625,8 +627,10 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
     raise ValueError("fused_batch_decode requires a full-model shard")
   if key is None:
     key = jax.random.PRNGKey(0)
+  B = token.shape[0]
+  top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
   return _fused_batch_decode_impl(
-    params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), int(n_steps), int(top_k), key
+    params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), key
   )
 
 

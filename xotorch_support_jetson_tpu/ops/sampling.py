@@ -54,6 +54,29 @@ def _apply_top_p_full(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
   return jnp.take_along_axis(masked, inv, axis=-1)
 
 
+@partial(jax.jit, static_argnames=("k_max",))
+def sample_logits_per_row(
+  logits: jnp.ndarray,  # [B, V]
+  key: jax.Array,
+  temps: jnp.ndarray,  # [B] f32, caller guarantees > 0
+  top_ks: jnp.ndarray,  # [B] int32, clipped to [1, k_max]
+  k_max: int = 64,
+) -> jnp.ndarray:
+  """Per-row temperature AND top-k: one compiled program for a whole slot
+  pool of heterogeneous requests (inference/batch_scheduler.py). The static
+  ``k_max`` caps the candidate set; each row's traced ``top_ks`` masks ranks
+  beyond its own k, so per-request values neither recompile nor leak into
+  other rows."""
+  x = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+  k_cap = min(k_max, x.shape[-1])
+  vals, idxs = jax.lax.top_k(x, k_cap)  # [B, k_cap] descending
+  rank = jnp.arange(k_cap, dtype=jnp.int32)[None, :]
+  keep = rank < jnp.clip(top_ks.astype(jnp.int32), 1, k_cap)[:, None]
+  vals = jnp.where(keep, vals, NEG_INF)
+  choice = jax.random.categorical(key, vals, axis=-1)
+  return jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
 @jax.jit
 def greedy(logits: jnp.ndarray) -> jnp.ndarray:
   return jnp.argmax(logits, axis=-1).astype(jnp.int32)
