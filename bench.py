@@ -150,6 +150,36 @@ def main() -> None:
     _ = np.asarray(btoks)
     batch8_tok_s = round(Bb * n_decode / (time.perf_counter() - t0), 2)
 
+  # Speculative decoding (XOT_TPU_SPEC_DECODE=int8, models/decoder.py
+  # fused_speculative_generate): greedy int8 self-draft + bf16 target in one
+  # while_loop. On these RANDOM weights logits are near-uniform, so the
+  # measured acceptance (and hence speed) is a floor, not the real-model
+  # number — reported alongside so the trade is visible.
+  spec_tok_s = None
+  spec_acceptance = None
+  if on_accel:
+    from xotorch_support_jetson_tpu.models.decoder import fused_speculative_generate
+
+    gamma = 4
+    spec_prefill = jax.jit(shard_forward, static_argnames=("cfg", "shard"))
+
+    def spec_caches():
+      ct = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
+      cd = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
+      _, ct = spec_prefill(params, cfg, shard, tokens, positions, ct)
+      _, cd = spec_prefill(qp, cfg, shard, tokens, positions, cd)
+      return ct, cd
+    ct, cd = spec_caches()
+    sbuf, sn, srounds, ct, cd = fused_speculative_generate(params, cfg, shard, qp, cfg, shard, first_tok, ct, cd, prompt_len, n_decode, gamma=gamma, eos_ids=(-1,))
+    _ = np.asarray(sbuf)
+    ct, cd = spec_caches()
+    t0 = time.perf_counter()
+    sbuf, sn, srounds, ct, cd = fused_speculative_generate(params, cfg, shard, qp, cfg, shard, first_tok, ct, cd, prompt_len, n_decode, gamma=gamma, eos_ids=(-1,))
+    _ = np.asarray(sbuf)
+    sn, srounds = int(sn), max(int(srounds), 1)
+    spec_tok_s = round(min(sn, n_decode) / (time.perf_counter() - t0), 2)
+    spec_acceptance = round((sn / srounds - 1) / gamma, 3)
+
   # Pipeline-parallel serving decode (parallel/pp_serving.py): only runs when
   # the host exposes >=2 accelerator chips (the driver's bench env tunnels one
   # chip, so this is the ready-for-multichip hook, exercised in tests and
@@ -193,6 +223,8 @@ def main() -> None:
         "serving_chunked_tok_s": round(serving_tok_s, 2),
         "int8_decode_tok_s": int8_tok_s,
         "batch8_aggregate_tok_s": batch8_tok_s,
+        "spec_decode_tok_s": spec_tok_s,
+        "spec_acceptance": spec_acceptance,
         "pp_decode_tok_s": pp_decode_tok_s,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "platform": platform,

@@ -450,7 +450,10 @@ class ChatGPTAPI:
     # Truthful usage accounting (the reference reports none at all). Encoding
     # the prompt again costs one BPE pass — only pay it when usage will
     # actually be reported (blocking always; streaming only on request).
-    include_usage = bool((data.get("stream_options") or {}).get("include_usage"))
+    stream_options = data.get("stream_options")
+    if stream_options is not None and not isinstance(stream_options, dict):
+      return web.json_response({"error": "'stream_options' must be an object"}, status=400)
+    include_usage = bool((stream_options or {}).get("include_usage"))
     need_usage = not chat_request.stream or include_usage
     prompt_tokens = len(tokenizer.encode(prompt)) if need_usage and hasattr(tokenizer, "encode") else 0
     from ..inference.engine import PromptTooLongError, ServerOverloadedError

@@ -71,7 +71,7 @@ def _decode_step(params, cfg, shard, x, kv_cache, pos):
 
 
 class _Session:
-  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch")
+  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch", "prompt_np", "draft_cache")
 
   def __init__(self, kv_cache, max_seq: int, epoch: int = 0) -> None:
     self.kv_cache = kv_cache
@@ -80,6 +80,8 @@ class _Session:
     self.max_seq = max_seq
     self.next_token_dev = None  # [B,1] device array chaining fused chunks
     self.epoch = epoch  # replay epoch (elastic recovery, node._retry_request)
+    self.prompt_np = None  # prompt token ids (speculative draft prefill)
+    self.draft_cache = None  # lazily-built draft KV cache (speculative mode)
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -90,7 +92,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
   layer range across all of its own chips.
   """
 
-  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None, pp: int | None = None):
+  def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None, pp: int | None = None, spec_decode: str | None = None):
     super().__init__()
     self.shard_downloader = shard_downloader
     self.shard: Shard | None = None
@@ -106,6 +108,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # HBM-bound: ~half the weight bytes ≈ ~half the per-token latency). The
     # reference instead ships separate -8bit checkpoints (models.py:29).
     self.quant = quant if quant is not None else (os.getenv("XOT_TPU_QUANT") or None)
+    # XOT_TPU_SPEC_DECODE=int8: greedy speculative decoding with a
+    # self-speculative int8 draft (models/decoder.py
+    # fused_speculative_generate) on the non-streaming fast path. Exact:
+    # output is token-identical to plain greedy.
+    self.spec_decode = spec_decode if spec_decode is not None else (os.getenv("XOT_TPU_SPEC_DECODE") or None)
+    self.spec_gamma = int(os.getenv("XOT_TPU_SPEC_GAMMA", "4"))
+    self._draft_params = None
     self.use_local_mesh = use_local_mesh if use_local_mesh is not None else os.getenv("XOT_TPU_LOCAL_MESH", "1") == "1"
     # XOT_TPU_PP=N serves the loaded layer range as N pipeline stages over the
     # local chips (parallel/pp_serving.py) — the in-slice rendering of the
@@ -162,12 +171,28 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.shard = shard
     self._effective_shard = eff
     self._maybe_shard_over_local_mesh()
+    # Build the draft AFTER mesh placement so the int8 copy derives from the
+    # already-sharded params (its leaves inherit their shardings).
+    self._maybe_build_draft()
     self.sessions.clear()
     self._drop_batched_server()  # pooled cache is model-specific
     self._key = jax.random.PRNGKey(self._seed)
     self._model_dir = Path(model_dir)
     if DEBUG >= 1:
       print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
+
+  def _maybe_build_draft(self) -> None:
+    """Self-speculative int8 draft: same weights, half the HBM bytes per
+    step. Requires a full-model shard (sampling feeds the next embed)."""
+    self._draft_params = None
+    eff = getattr(self, "_effective_shard", None)
+    if self.spec_decode != "int8" or eff is None or not (eff.is_first_layer and eff.is_last_layer) or self.params is None:
+      return
+    if self.quant:  # draft would equal the target — no speedup, skip
+      return
+    from ..models.quantize import quantize_params
+
+    self._draft_params = quantize_params(self.params)
 
   def _serving_cap(self, cfg) -> int:
     """The effective serving max_seq_len for a loaded config.
@@ -209,6 +234,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       # The pp-placed stage/head copies are the serving params; drop the
       # original so a >1-chip model doesn't also hold a full-size copy.
       self.params = None
+      self._draft_params = None  # speculative decode is not composed with pp
       return
     if not self.use_local_mesh or len(jax.devices()) <= 1:
       return
@@ -246,6 +272,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = cfg
     self.params = params
     self.tokenizer = tokenizer
+    self._maybe_build_draft()
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
 
@@ -376,6 +403,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       if is_tokens:
         state.tokens = x.astype(np.int32)
         state.prompt_len = prompt_len
+        session.prompt_np = x.astype(np.int32)  # draft prefill (speculative mode)
         pad_to = min(_round_up(x.shape[1], PREFILL_BUCKET), session.max_seq)
         x_in = np.zeros((B, pad_to), dtype=np.int32)
         x_in[:, : x.shape[1]] = x
@@ -483,6 +511,18 @@ class JaxShardedInferenceEngine(InferenceEngine):
     room = session.max_seq - session.curr_pos
     if room <= 0:
       return []
+    if (
+      self._draft_params is not None
+      and (temp is None or float(temp) <= 0.0)
+      and session.prompt_np is not None
+      and session.curr_pos == session.prompt_len  # fresh after prefill (no chunk history to replay into the draft)
+      and session.prompt_np.shape[0] == 1
+      # Spec rounds need gamma+1 slots of headroom; near the cache end the
+      # plain path can still emit the final tokens — use it so a
+      # context-limited response is never cut gamma+1 tokens short.
+      and max_steps <= room - self.spec_gamma - 1
+    ):
+      return self._generate_speculative_sync(request_id, shard, first_token, max_steps, eos_ids)
     # Bucket the COMPILED step count (power-of-two, capped by cache room) so
     # varying max_tokens requests reuse a handful of compiled programs; the
     # actual step cap travels as a traced scalar, so no extra steps run.
@@ -514,6 +554,44 @@ class JaxShardedInferenceEngine(InferenceEngine):
     toks = [int(t) for t in row[:n]]
     session.curr_pos += n
     session.next_token_dev = None  # chain broken: next chunk must re-seed
+    return toks
+
+  def _generate_speculative_sync(self, request_id, shard, first_token, max_steps, eos_ids):
+    """Greedy speculative oneshot: int8 self-draft + bf16 target fused in one
+    while_loop program (models/decoder.py fused_speculative_generate).
+    Output is exactly the plain-greedy tokens; only the speed differs."""
+    from ..models.decoder import fused_speculative_generate, init_kv_cache
+
+    session = self.sessions[request_id]
+    room = session.max_seq - session.curr_pos
+    limit = min(max_steps, room - self.spec_gamma - 1)  # caller guarantees > 0
+    steps = min(1 << (limit - 1).bit_length(), room - self.spec_gamma - 1)
+    if session.draft_cache is None:
+      # Draft prefill over the prompt (the draft never saw it): pad like the
+      # target prefill so the compiled program is shared across prompts.
+      B, S = session.prompt_np.shape
+      cache = init_kv_cache(self.cfg, shard.n_shard_layers, B, session.max_seq)
+      pad_to = min(_round_up(S, PREFILL_BUCKET), session.max_seq)
+      x_in = np.zeros((B, pad_to), dtype=np.int32)
+      x_in[:, :S] = session.prompt_np
+      lens = jnp.full((B,), S, dtype=jnp.int32)
+      _, session.draft_cache = _prefill(self._draft_params, self.cfg, shard, jnp.asarray(x_in), self._place_cache(cache), lens)
+    token = jnp.full((1, 1), int(first_token), dtype=jnp.int32)
+    eos = tuple(sorted(int(e) for e in eos_ids))
+    buf, n, _rounds, session.kv_cache, session.draft_cache = fused_speculative_generate(
+      self.params, self.cfg, shard, self._draft_params, self.cfg, shard,
+      token, session.kv_cache, session.draft_cache, session.curr_pos,
+      steps, gamma=self.spec_gamma, eos_ids=eos, n_limit=limit,
+    )
+    row = np.asarray(buf)
+    n = min(int(n), limit)
+    if eos:
+      hits = np.nonzero(np.isin(row[:n], eos))[0]
+      if hits.size:
+        n = int(hits[0]) + 1
+    toks = [int(t) for t in row[:n]]
+    session.curr_pos += n
+    session.next_token_dev = None
     return toks
 
   async def read_chunk(self, handle) -> list[int]:

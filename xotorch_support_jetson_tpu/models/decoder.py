@@ -558,6 +558,128 @@ def fused_generate(
   )
 
 
+# ------------------------------------------------ speculative decoding
+
+
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "shard_t", "shard_d", "max_steps", "gamma", "eos_ids"), donate_argnums=(6, 7))
+def _fused_spec_generate_impl(
+  params_t, params_d, cfg_t: ModelConfig, cfg_d: ModelConfig, shard_t: Shard, shard_d: Shard,
+  cache_t, cache_d, token, start_pos, max_steps: int, gamma: int, eos_ids: tuple, n_limit,
+):
+  G = gamma
+  eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
+  limit = jnp.minimum(n_limit.astype(jnp.int32), max_steps)
+  max_seq = cache_t["k"].shape[2]
+  buf0 = jnp.zeros((max_steps + G + 1,), dtype=jnp.int32)
+  idx = jnp.arange(G + 1, dtype=jnp.int32)
+
+  def cond(carry):
+    _, pos, _, _, _, n, _, done = carry
+    # Room guard: one round writes target slots [pos, pos+G]; stop a round
+    # early rather than run off the cache.
+    return (~done) & (n < limit) & (pos + G + 1 <= max_seq)
+
+  def body(carry):
+    cur, pos, cache_t_, cache_d_, buf, n, rounds, done = carry
+
+    # 1) Draft proposes G tokens greedily (sequential small-model steps).
+    def dstep(c, _):
+      tok, p, cache = c
+      logits, cache = shard_forward(params_d, cfg_d, shard_d, tok, p.reshape(1, 1), cache)
+      nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+      return (nxt[:, None], p + 1, cache), nxt[0]
+
+    (_, _, cache_d_), d = jax.lax.scan(dstep, (cur, pos, cache_d_), None, length=G)  # d: [G]
+
+    # 2) Target verifies the whole window in ONE parallel forward:
+    #    tokens [cur, d_1..d_G] at positions pos..pos+G.
+    window = jnp.concatenate([cur[0], d], axis=0)[None, :]  # [1, G+1]
+    positions = (pos + idx)[None, :]
+    logits_t, cache_t_ = shard_forward(params_t, cfg_t, shard_t, window, positions, cache_t_)
+    t = jnp.argmax(logits_t[0], axis=-1).astype(jnp.int32)  # [G+1]; t[i] = target's token for position pos+i+1
+
+    # 3) Greedy acceptance: longest prefix with d_i == t_{i-1}; then the
+    #    target's own next token. Every emitted token equals what plain
+    #    target-greedy would produce, so the scheme is EXACT for any draft.
+    matches = (d == t[:G]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(matches))
+    k = n_acc + 1  # tokens emitted this round
+    emitted = jnp.where(idx < n_acc, jnp.concatenate([d, jnp.zeros((1,), jnp.int32)])[idx], t[n_acc])
+    # (slots past index n_acc hold t[n_acc] too — harmless: only buf[n:n+k]
+    #  counts and the next round's write at n+k overwrites the rest.)
+    buf = jax.lax.dynamic_update_slice(buf, emitted, (n,))
+
+    # 4) Draft catch-up: same window through the draft so its cache covers
+    #    slot pos+G (the last proposed token's KV never lands during the
+    #    sequential proposal — on full acceptance the next round would
+    #    otherwise read a hole).
+    _, cache_d_ = shard_forward(params_d, cfg_d, shard_d, window, positions, cache_d_)
+
+    if eos is not None:
+      hit = jnp.any((emitted[:, None] == eos[None, :]) & (idx[:, None] < k), axis=(0, 1))
+      done = done | hit
+    cur = t[n_acc].reshape(1, 1)
+    return (cur, pos + k, cache_t_, cache_d_, buf, n + k, rounds + 1, done)
+
+  init = (token, start_pos, cache_t, cache_d, buf0, jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+  _, _, cache_t, cache_d, buf, n, rounds, _ = jax.lax.while_loop(cond, body, init)
+  return buf, n, rounds, cache_t, cache_d
+
+
+def fused_speculative_generate(
+  params_t, cfg_t: ModelConfig, shard_t: Shard,
+  params_d, cfg_d: ModelConfig, shard_d: Shard,
+  token,  # [1,1] int32 seed token
+  cache_t, cache_d,
+  start_pos,  # [] int32 scalar
+  max_steps: int,
+  gamma: int = 4,
+  eos_ids: tuple = (),
+  n_limit=None,
+):
+  """Greedy speculative decoding: draft + target fused in ONE while_loop.
+
+  Each round: the draft proposes ``gamma`` tokens sequentially; the target
+  scores the whole window in one parallel forward (reading its weights ONCE
+  for up to gamma+1 output tokens — decode is weight-bandwidth-bound, so
+  acceptance rate ≈ speedup); the longest matching prefix is accepted plus
+  the target's correction token. Host pays one dispatch + one readback for
+  the entire response (NOTES round-1: host-looped speculation regresses on
+  tunneled links).
+
+  EXACT by construction: every emitted token is the target's own greedy
+  choice (computed by the verification forward), so for ANY draft the output
+  is identical to ``fused_generate`` at temp=0 under deterministic
+  arithmetic — the draft only changes speed; the exactness tests run at f32
+  matmul precision and assert token-for-token equality. One honest numerics
+  caveat shared by all production speculative decoders: on bf16 hardware a
+  batched (gamma+1)-token forward and a 1-token forward can reduce in
+  different orders, so argmax near-ties may resolve differently than the
+  sequential path — the output is still a greedy trajectory of the target
+  under the verification forward's numerics. Rollback is free: rejected
+  slots are position-masked until the next round's writes cover them
+  (slot-indexed cache, see init_kv_cache).
+
+  Acceptance rate ≈ speedup. With a real checkpoint and an int8
+  self-draft, argmax agreement is high (peaked distributions); the
+  random-weight bench has near-uniform logits, so its acceptance — reported
+  as ``spec_acceptance`` in bench.py — understates real-model behavior.
+
+  Returns (buf [max_steps+gamma+1], n_generated, n_rounds, cache_t,
+  cache_d); trim to the first EOS within buf[:n] host-side. Acceptance rate
+  = (n/n_rounds − 1)/gamma.
+  """
+  if not (shard_t.is_first_layer and shard_t.is_last_layer and shard_d.is_first_layer and shard_d.is_last_layer):
+    raise ValueError("speculative decoding requires full-model shards")
+  if token.shape[0] != 1:
+    raise ValueError("speculative decoding is single-stream (B=1)")
+  limit = jnp.int32(max_steps if n_limit is None else n_limit)
+  return _fused_spec_generate_impl(
+    params_t, params_d, cfg_t, cfg_d, shard_t, shard_d, cache_t, cache_d,
+    token, jnp.int32(start_pos), int(max_steps), int(gamma), tuple(eos_ids), limit,
+  )
+
+
 # ------------------------------------------------------- batched serving
 # (inference/batch_scheduler.py): a fixed pool of batch rows ("slots"), each
 # holding one request. Shapes stay static — prefill scatters one row into the
