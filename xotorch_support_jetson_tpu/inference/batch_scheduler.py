@@ -65,6 +65,12 @@ class _Slot:
   finished: bool = False
   cancelled: bool = False
   out_tokens: list = field(default_factory=list)
+  # Paged mode (inference/paging.py): reused read-only prefix pages, then the
+  # request's private pages, in logical order; chain keys for every FULL
+  # prompt page (private ones are donated to the prefix cache on finish).
+  shared_pages: list = field(default_factory=list)
+  pages: list = field(default_factory=list)
+  chain_keys: list = field(default_factory=list)
 
 
 class BatchedServer:
@@ -82,6 +88,15 @@ class BatchedServer:
     # Admission backpressure: beyond this many queued requests, submit fails
     # fast (the API maps it to 429) instead of growing the queue unboundedly.
     self.max_queue = max_queue if max_queue is not None else int(os.getenv("XOT_TPU_BATCH_MAX_QUEUE", "64"))
+    # Paged KV cache (default): positions map onto fixed-size pages through
+    # per-row block tables (ops/paged.py), so HBM is bounded by aggregate
+    # context — XOT_TPU_BATCH_PAGES sizes the pool (default: full dense
+    # capacity) — and page-aligned prompt prefixes dedup across requests.
+    # XOT_TPU_PAGED=0 restores the dense slot-per-max_seq cache.
+    self.paged = os.getenv("XOT_TPU_PAGED", "1") not in ("0", "false")
+    self.page_size = int(os.getenv("XOT_TPU_PAGE_SIZE", "64"))
+    self.allocator = None
+    self.block_tables = None
     self.cache = None
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
@@ -155,7 +170,18 @@ class BatchedServer:
 
     eng = self.engine
     self.max_seq = min(eng.max_seq_len, eng.cfg.max_seq_len)
-    self.cache = init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, self.n_slots, self.max_seq)
+    if self.paged:
+      from ..ops.paged import init_paged_pool
+      from .paging import PageAllocator
+
+      ps = self.page_size
+      self.pages_per_row = (self.max_seq + ps - 1) // ps
+      n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or self.n_slots * self.pages_per_row + 1
+      self.allocator = PageAllocator(n_pages, ps)
+      self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
+      self.cache = init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, ps)
+    else:
+      self.cache = init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, self.n_slots, self.max_seq)
 
   def _free_slot(self) -> int | None:
     for i, s in enumerate(self.slots):
@@ -163,45 +189,94 @@ class BatchedServer:
         return i
     return None
 
-  async def _admit(self, req: _Request, row: int) -> None:
+  async def _admit(self, req: _Request, row: int) -> bool:
     """Prefill one request into a pool row and emit its first token.
 
-    A failed prefill fails THIS request's future (the pool keeps serving)."""
-    from ..models.decoder import prefill_into_slot
+    A failed prefill fails THIS request's future (the pool keeps serving).
+    Returns False when pages are scarce and the request was requeued to wait
+    (only possible while other rows are active — the caller stops admitting
+    for this tick)."""
+    from ..models.decoder import prefill_into_pages, prefill_into_slot
 
     eng = self.engine
     self._queued.pop(req.request_id, None)
     self._admitting.add(req.request_id)
+    shared_pages: list = []
+    new_pages: list = []
+    chain_keys: list = []
+    prefix_len = 0
     try:
       if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
         req.emit(req.request_id, [], True)
         if not req.future.done():
           req.future.set_result([])
-        return
+        return True
       S = int(req.tokens.shape[0])
       if S + 1 >= self.max_seq:
         # A too-long prompt is a client error, not an empty completion.
         raise PromptTooLongError(f"prompt of {S} tokens exceeds the {self.max_seq}-token context window")
-      pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
-      tok_pad = np.zeros((1, pad_to), dtype=np.int32)
-      tok_pad[0, :S] = req.tokens
 
-      def run():
-        # Prefill AND first-token sample stay on the engine executor — the
-        # single thread that serializes all device work (and owns eng._key).
-        last, self.cache = prefill_into_slot(
-          eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache, jnp.int32(row), jnp.int32(S)
-        )
-        return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
+      if self.paged:
+        ps = self.page_size
+        chain_keys = self.allocator.chain_keys(req.tokens, ps)
+        # Reuse at most (S-1)//ps pages: at least one suffix token must run
+        # through prefill to produce the last-position logits.
+        shared_pages = self.allocator.lookup_prefix(chain_keys[: (S - 1) // ps])
+        prefix_len = len(shared_pages) * ps
+        total = (S + 1 + ps - 1) // ps  # cover positions [0, S] (first generated token)
+        new_pages = self.allocator.alloc(total - len(shared_pages))
+        if new_pages is None:
+          for p in shared_pages:
+            self.allocator.release(p)
+          shared_pages = []  # already released — the except handler must not release again
+          if any(s is not None for s in self.slots):
+            # Other requests are draining pages — wait for a chunk boundary.
+            self._queued[req.request_id] = req
+            self.queue.put_nowait(req)
+            return False
+          raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
+        # The padded suffix writes at offset prefix_len and must stay inside
+        # the row's logical window — dynamic_update_slice CLAMPS out-of-range
+        # starts, which would silently corrupt slot 0.
+        pad_to = min(_round_up(S - prefix_len, PREFILL_BUCKET), self.max_seq - prefix_len)
+        tok_pad = np.zeros((1, pad_to), dtype=np.int32)
+        tok_pad[0, : S - prefix_len] = req.tokens[prefix_len:]
+        bt_row = np.zeros((self.pages_per_row,), dtype=np.int32)
+        bt_row[: len(shared_pages)] = shared_pages
+        bt_row[len(shared_pages) : total] = new_pages
+
+        def run():
+          last, self.cache = prefill_into_pages(
+            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache,
+            jnp.asarray(bt_row), jnp.int32(prefix_len), jnp.int32(S), self.page_size,
+          )
+          return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
+
+      else:
+        pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
+        tok_pad = np.zeros((1, pad_to), dtype=np.int32)
+        tok_pad[0, :S] = req.tokens
+
+        def run():
+          # Prefill AND first-token sample stay on the engine executor — the
+          # single thread that serializes all device work (and owns eng._key).
+          last, self.cache = prefill_into_slot(
+            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache, jnp.int32(row), jnp.int32(S)
+          )
+          return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
 
       first = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     except Exception as e:  # noqa: BLE001
+      for p in shared_pages:
+        self.allocator.release(p)
+      if new_pages:
+        self.allocator.free(new_pages)
       if not req.future.done():
         req.future.set_exception(e)
-      return
+      return True
     finally:
       self._admitting.discard(req.request_id)
-    slot = _Slot(req=req, pos=S, generated=1, last_token=first)
+    slot = _Slot(req=req, pos=S, generated=1, last_token=first, shared_pages=shared_pages, pages=list(new_pages or []), chain_keys=chain_keys)
     slot.out_tokens.append(first)
     cancelled = req.request_id in self._cancelled_ids  # raced during prefill
     finished = cancelled or first in req.eos_ids or slot.generated >= req.max_tokens
@@ -209,22 +284,67 @@ class BatchedServer:
     req.emit(req.request_id, [] if cancelled else [first], finished)
     if finished:
       self._cancelled_ids.discard(req.request_id)
+      self._release_pages(slot)
       if not req.future.done():
         req.future.set_result(slot.out_tokens)
-      return
+      return True
     self.slots[row] = slot
+    if self.paged:
+      self.block_tables[row, :] = 0
+      n = len(slot.shared_pages) + len(slot.pages)
+      self.block_tables[row, : n] = slot.shared_pages + slot.pages
+    return True
+
+  def _release_pages(self, slot: _Slot) -> None:
+    """Return a finished slot's pages: shared prefix refs drop; private FULL
+    prompt pages are donated to the prefix cache; the rest (partial prompt
+    tail + generated positions) free immediately."""
+    if not self.paged:
+      return
+    for p in slot.shared_pages:
+      self.allocator.release(p)
+    n_shared = len(slot.shared_pages)
+    n_full_prompt = len(slot.chain_keys)  # == S // page_size
+    to_free = []
+    for i, p in enumerate(slot.pages):
+      logical = n_shared + i
+      if logical < n_full_prompt and self.allocator.insert_cached(slot.chain_keys[logical], p):
+        continue
+      to_free.append(p)
+    self.allocator.free(to_free)
+    slot.shared_pages, slot.pages = [], []
+
+  def _clear_row(self, row: int) -> None:
+    if self.paged:
+      self.block_tables[row, :] = 0
+
+  def _grow_pages(self, row: int, slot: _Slot) -> bool:
+    """Ensure ``slot`` has pages covering its next decode chunk."""
+    ps = self.page_size
+    needed = (slot.pos + self.chunk - 1) // ps + 1
+    have = len(slot.shared_pages) + len(slot.pages)
+    if needed <= have:
+      return True
+    got = self.allocator.alloc(needed - have)
+    if got is None:
+      return False
+    self.block_tables[row, have : have + len(got)] = got
+    slot.pages.extend(got)
+    return True
 
   async def _run(self) -> None:
-    from ..models.decoder import fused_batch_decode
+    from ..models.decoder import fused_batch_decode, fused_paged_batch_decode
 
     eng = self.engine
     self._ensure_cache()
     try:
       while True:
         # Admission: fill free slots from the queue (no await while any row
-        # is active — keep the pool stepping).
+        # is active — keep the pool stepping). An admission that parks on
+        # page scarcity stops the fill for this tick.
         while (row := self._free_slot()) is not None and not self.queue.empty():
-          await self._admit(self.queue.get_nowait(), row)
+          if not await self._admit(self.queue.get_nowait(), row):
+            break
         if all(s is None for s in self.slots):
           # Idle: block on the queue (the task persists — no exit/restart race).
           req = await self.queue.get()
@@ -237,18 +357,46 @@ class BatchedServer:
         temps = np.array([s.req.temp if s else 0.0 for s in self.slots], dtype=np.float32)
         top_ks = np.array([s.req.top_k if s else 1 for s in self.slots], dtype=np.int32)
         # Rows without cache room (or cancelled by their client) finish
-        # before the chunk; the results loop below frees them.
+        # before the chunk; the results loop below frees them. In paged mode
+        # a row can also be page-STARVED: it skips this chunk but stays
+        # resident (other rows' finishes will free pages).
+        starved: set[int] = set()
         for i, s in enumerate(self.slots):
-          if s is not None and (s.cancelled or s.pos + self.chunk >= self.max_seq):
+          if s is None:
+            continue
+          if s.cancelled or s.pos + self.chunk >= self.max_seq:
             active[i] = False
+          elif self.paged and not self._grow_pages(i, s):
+            active[i] = False
+            starved.add(i)
+        finishing = [i for i, s in enumerate(self.slots) if s is not None and not active[i] and i not in starved]
+        if starved and not active.any() and not finishing:
+          # Every resident row is starved (none can run, and no finishing
+          # row is about to free pages in the results loop below): fail the
+          # youngest so the others make progress.
+          victim = min(starved, key=lambda i: self.slots[i].generated)
+          s = self.slots[victim]
+          self._release_pages(s)
+          self.slots[victim] = None
+          self.block_tables[victim, :] = 0
+          if not s.req.future.done():
+            s.req.future.set_exception(ServerOverloadedError("page pool exhausted with no runnable rows"))
+          continue
 
         def run_chunk():
           eng._key, sub = jax.random.split(eng._key)
-          toks, _pos, self.cache = fused_batch_decode(
-            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
-            jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk,
-            top_k=jnp.asarray(top_ks), k_max=self.k_max, key=sub,
-          )
+          if self.paged:
+            toks, _pos, self.cache = fused_paged_batch_decode(
+              eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
+              jnp.asarray(self.block_tables), jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps),
+              self.chunk, top_k=jnp.asarray(top_ks), k_max=self.k_max, page_size=self.page_size, key=sub,
+            )
+          else:
+            toks, _pos, self.cache = fused_batch_decode(
+              eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
+              jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk,
+              top_k=jnp.asarray(top_ks), k_max=self.k_max, key=sub,
+            )
           return np.asarray(toks)  # ONE readback for the whole pool chunk
 
         rows = await asyncio.get_event_loop().run_in_executor(eng.executor, run_chunk)
@@ -257,13 +405,17 @@ class BatchedServer:
           if slot is None:
             continue
           req = slot.req
+          if i in starved:  # skipped this chunk; retry next tick
+            continue
           if not active[i]:  # cache exhausted or cancelled
             slot.finished = True
             self._cancelled_ids.discard(req.request_id)
+            self._release_pages(slot)
             req.emit(req.request_id, [], True)
             if not req.future.done():
               req.future.set_result(slot.out_tokens)
             self.slots[i] = None
+            self._clear_row(i)
             continue
           emit: list[int] = []
           done = False
@@ -280,9 +432,11 @@ class BatchedServer:
           req.emit(req.request_id, emit, done)
           if done:
             self._cancelled_ids.discard(req.request_id)
+            self._release_pages(slot)
             if not req.future.done():
               req.future.set_result(slot.out_tokens)
             self.slots[i] = None
+            self._clear_row(i)
     except asyncio.CancelledError:
       self._fail_all(RuntimeError("batched server shut down"))
       raise

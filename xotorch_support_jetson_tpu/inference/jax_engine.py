@@ -661,6 +661,31 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, engine_eval_step, self, shard, inputs, targets, lengths, loss)
 
+  # Ring pipeline training (train/trainer.py ring section): partial-shard
+  # spans — forward ships activations, backward applies this span's update.
+
+  async def forward_span(self, request_id, shard, x, train: bool):
+    from ..train.trainer import engine_forward_span
+
+    return await asyncio.get_event_loop().run_in_executor(self.executor, engine_forward_span, self, shard, x, request_id, train)
+
+  async def backward_span(self, request_id, shard, d_out, opt="adamw", lr=1e-5):
+    from ..train.trainer import engine_backward_span
+
+    return await asyncio.get_event_loop().run_in_executor(self.executor, engine_backward_span, self, shard, d_out, request_id, opt, lr)
+
+  async def last_span_step(self, request_id, shard, h, targets, lengths, train: bool, opt="adamw", lr=1e-5):
+    from ..train.trainer import engine_last_span_step
+
+    return await asyncio.get_event_loop().run_in_executor(
+      self.executor, engine_last_span_step, self, shard, h, targets, lengths, train, opt, lr
+    )
+
+  def discard_span(self, request_id) -> None:
+    from ..train.trainer import engine_discard_span
+
+    engine_discard_span(self, request_id)
+
   async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
     if self._pp is not None:
       raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")

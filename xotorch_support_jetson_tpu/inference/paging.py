@@ -1,0 +1,116 @@
+"""Host-side page accounting for the paged KV cache (ops/paged.py).
+
+The device never sees this: pages are allocated/freed/shared here and the
+resulting block tables ride into the compiled decode program as traced
+operands. Two pieces:
+
+- ``PageAllocator`` — a free list over pages ``1..n_pages-1`` (page 0 is the
+  device-side trash page and is never handed out).
+- an integrated prefix cache: finished requests donate their prompt's FULL
+  pages keyed by the exact token chain that produced them; a new request
+  reuses the longest page-aligned prefix already resident, skipping both the
+  HBM and the prefill FLOPs for those tokens. Reused pages are read-only by
+  construction (decode writes only at positions ≥ its own prompt length,
+  which land in the request's private tail pages). Cached pages with no
+  active readers sit in an LRU and are evicted when the free list runs dry.
+
+Chain keys are exact (nested tuples of token ids), not hashes — no collision
+risk, and equality IS content equality.
+
+No reference counterpart (the reference's cache is dense per-request,
+``SURVEY.md §5.7``); the design is the vLLM paged-KV idea rebuilt for static
+XLA shapes.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class PageAllocator:
+  """Free-list + refcounted prefix cache over a fixed page pool."""
+
+  def __init__(self, n_pages: int, page_size: int):
+    self.n_pages = n_pages
+    self.page_size = page_size
+    self._free: list[int] = list(range(n_pages - 1, 0, -1))  # pop() -> low ids first
+    self._refs: dict[int, int] = {}  # page -> active readers (cached pages only)
+    self._by_key: dict[tuple, int] = {}  # chain key -> cached page
+    self._key_of: dict[int, tuple] = {}  # cached page -> chain key
+    self._lru: OrderedDict[int, None] = OrderedDict()  # refcount-0 cached pages
+
+  # ------------------------------------------------------------- allocation
+
+  @property
+  def n_free(self) -> int:
+    """Pages available without evicting (the LRU adds to this on demand)."""
+    return len(self._free)
+
+  @property
+  def n_available(self) -> int:
+    return len(self._free) + len(self._lru)
+
+  def alloc(self, n: int) -> list[int] | None:
+    """n fresh private pages, evicting idle cached pages if needed; None if
+    even eviction can't cover it (caller backpressures)."""
+    if n > self.n_available:
+      return None
+    while len(self._free) < n:
+      self._evict_one()
+    return [self._free.pop() for _ in range(n)]
+
+  def free(self, pages: list[int]) -> None:
+    """Return PRIVATE (never-cached) pages to the free list."""
+    for p in pages:
+      assert p not in self._key_of, f"page {p} is cached; use release()"
+      self._free.append(p)
+
+  def _evict_one(self) -> None:
+    page, _ = self._lru.popitem(last=False)
+    key = self._key_of.pop(page)
+    del self._by_key[key]
+    self._refs.pop(page, None)
+    self._free.append(page)
+
+  # ----------------------------------------------------------- prefix cache
+
+  @staticmethod
+  def chain_keys(tokens, page_size: int) -> list[tuple]:
+    """Cumulative content keys for each FULL page of ``tokens``."""
+    keys: list[tuple] = []
+    prev: tuple = ()
+    for i in range(len(tokens) // page_size):
+      prev = (prev, tuple(int(t) for t in tokens[i * page_size : (i + 1) * page_size]))
+      keys.append(prev)
+    return keys
+
+  def lookup_prefix(self, keys: list[tuple]) -> list[int]:
+    """Longest cached prefix; bumps each hit's refcount (caller must
+    ``release`` every returned page exactly once)."""
+    pages: list[int] = []
+    for key in keys:
+      page = self._by_key.get(key)
+      if page is None:
+        break
+      self._refs[page] = self._refs.get(page, 0) + 1
+      self._lru.pop(page, None)
+      pages.append(page)
+    return pages
+
+  def release(self, page: int) -> None:
+    """Drop one reader of a cached page; idle pages become evictable."""
+    self._refs[page] -= 1
+    if self._refs[page] <= 0:
+      self._refs.pop(page)
+      self._lru[page] = None
+
+  def insert_cached(self, key: tuple, page: int) -> bool:
+    """Donate a private page to the cache (refcount 0, evictable). Returns
+    False (page NOT adopted — caller should ``free`` it) when the chain is
+    already cached."""
+    if key in self._by_key:
+      return False
+    self._by_key[key] = page
+    self._key_of[page] = key
+    self._lru[page] = None
+    return True

@@ -240,79 +240,37 @@ def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
   return q, k, v
 
 
-def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
-  """One decoder layer. h [B,S,D] → (h, new_k_cache, new_v_cache, aux).
+def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
+  """Dense-attention q/k/v projections (+LoRA, qkv bias, rope applied).
 
-  ``aux`` is the MoE load-balancing loss for this layer (0.0 for dense
-  layers); the training path accumulates it (parallel/train_step.py).
-  ``attn_fn(q, k, v, q_pos, kv_pos)`` overrides the attention op on the
-  cache-less path — used to swap in ring attention under sequence
-  parallelism (parallel/ring_attention.py).
+  x [B,S,D] → q [B,S,Hq,hd], k/v [B,S,Hkv,hd]. Shared by the contiguous-cache
+  layer step below and the paged decode step (``_paged_layer_step``).
   """
+  B, S, _ = x.shape
+  q = _mm(x, p, "wq")
+  k = _mm(x, p, "wk")
+  v = _mm(x, p, "wv")
+  # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
+  if "wq_lora_a" in p:
+    q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
+  if "wv_lora_a" in p:
+    v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
+  if "bq" in p:
+    q = q + p["bq"]
+    k = k + p["bk"]
+    v = v + p["bv"]
+  q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+  k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+  v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+  m = rope_attention_factor(cfg)
+  q = apply_rope(q, positions, inv_freq, m)
+  k = apply_rope(k, positions, inv_freq, m)
+  return q, k, v
+
+
+def _mlp_block(h, p, cfg: ModelConfig):
+  """Post-attention norm + FFN (dense or MoE+shared-expert). Returns (h, aux)."""
   B, S, D = h.shape
-  p = layer_params
-
-  x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
-  if "wkv_a" in p and use_cache:
-    # MLA with cache: write only the latent (+rope channel) and attend via
-    # weight absorption (ops/attention.py mla_absorbed_attention) — the cache
-    # holds rank+rope floats per token instead of H·(qk+v).
-    from ..ops.attention import mla_absorbed_attention
-
-    q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
-    start = positions[:, 0]
-    k_cache = _write_cache(k_cache, c_kv[:, :, None, :], start)
-    v_cache = _write_cache(v_cache, k_pe[:, :, None, :], start)
-    attn = mla_absorbed_attention(
-      q_nope,
-      q_pe,
-      k_cache[:, :, 0, :].astype(h.dtype),
-      v_cache[:, :, 0, :].astype(h.dtype),
-      _mla_w_kv_b(p, h.dtype),
-      positions,
-      kv_positions,
-      cfg.v_head_dim,
-    )
-  else:
-    if "wkv_a" in p:  # MLA, cache-less (training): naive per-head K/V
-      q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
-    else:
-      q = _mm(x, p, "wq")
-      k = _mm(x, p, "wk")
-      v = _mm(x, p, "wv")
-      # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
-      if "wq_lora_a" in p:
-        q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
-      if "wv_lora_a" in p:
-        v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
-      if "bq" in p:
-        q = q + p["bq"]
-        k = k + p["bk"]
-        v = v + p["bv"]
-      q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
-      k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-      v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-      m = rope_attention_factor(cfg)
-      q = apply_rope(q, positions, inv_freq, m)
-      k = apply_rope(k, positions, inv_freq, m)
-
-    if use_cache:
-      start = positions[:, 0]
-      k_cache = _write_cache(k_cache, k, start)
-      v_cache = _write_cache(v_cache, v, start)
-      from ..ops.pallas_attention import flash_attention_prefill, flash_supported
-
-      if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
-        # Prefill on TPU: flash kernel against the full cache (stale slots
-        # beyond the prompt are positionally masked — slot index > position).
-        attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=0)
-      else:
-        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
-    else:
-      attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
-
-  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
-
   x = rms_norm(h, p["mlp_norm"], cfg.norm_eps)
   aux = jnp.float32(0.0)
   if "w_experts_gate" in p:  # routed MoE FFN (ops/moe.py) + optional shared expert
@@ -354,6 +312,65 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
   else:
     gated = jax.nn.silu(_mm(x, p, "w_gate").astype(jnp.float32)).astype(h.dtype) * _mm(x, p, "w_up")
     h = h + _mm(gated, p, "w_down")
+  return h, aux
+
+
+def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
+  """One decoder layer. h [B,S,D] → (h, new_k_cache, new_v_cache, aux).
+
+  ``aux`` is the MoE load-balancing loss for this layer (0.0 for dense
+  layers); the training path accumulates it (parallel/train_step.py).
+  ``attn_fn(q, k, v, q_pos, kv_pos)`` overrides the attention op on the
+  cache-less path — used to swap in ring attention under sequence
+  parallelism (parallel/ring_attention.py).
+  """
+  B, S, D = h.shape
+  p = layer_params
+
+  x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
+  if "wkv_a" in p and use_cache:
+    # MLA with cache: write only the latent (+rope channel) and attend via
+    # weight absorption (ops/attention.py mla_absorbed_attention) — the cache
+    # holds rank+rope floats per token instead of H·(qk+v).
+    from ..ops.attention import mla_absorbed_attention
+
+    q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
+    start = positions[:, 0]
+    k_cache = _write_cache(k_cache, c_kv[:, :, None, :], start)
+    v_cache = _write_cache(v_cache, k_pe[:, :, None, :], start)
+    attn = mla_absorbed_attention(
+      q_nope,
+      q_pe,
+      k_cache[:, :, 0, :].astype(h.dtype),
+      v_cache[:, :, 0, :].astype(h.dtype),
+      _mla_w_kv_b(p, h.dtype),
+      positions,
+      kv_positions,
+      cfg.v_head_dim,
+    )
+  else:
+    if "wkv_a" in p:  # MLA, cache-less (training): naive per-head K/V
+      q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
+    else:
+      q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+
+    if use_cache:
+      start = positions[:, 0]
+      k_cache = _write_cache(k_cache, k, start)
+      v_cache = _write_cache(v_cache, v, start)
+      from ..ops.pallas_attention import flash_attention_prefill, flash_supported
+
+      if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
+        # Prefill on TPU: flash kernel against the full cache (stale slots
+        # beyond the prompt are positionally masked — slot index > position).
+        attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=positions[:, 0])
+      else:
+        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
+    else:
+      attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
+
+  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
+  h, aux = _mlp_block(h, p, cfg)
   return h, k_cache, v_cache, aux
 
 
@@ -754,6 +771,160 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
   return _fused_batch_decode_impl(
     params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), key
   )
+
+
+# ------------------------------------------------------- paged serving
+# (ops/paged.py + inference/batch_scheduler.py): the pooled cache above gives
+# every slot max_seq tokens; the paged variants below map each row's logical
+# positions onto fixed-size pages through a block table, so HBM is bounded by
+# aggregate context and page-aligned prompt prefixes can be shared. Block
+# tables are TRACED [B, mp] operands — one compiled program covers every
+# allocation state. Rows without a request must keep their table zeroed (all
+# writes land in the reserved trash page 0).
+
+
+def _paged_layer_step(h, p, k_pool, v_pool, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool):
+  """One decoder layer against the page pool — decode only (S == 1).
+
+  k_pool/v_pool [P, Hkv, ps, hd] (this layer's pages); positions [B, 1].
+  """
+  B, S, D = h.shape
+  x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
+  pos = positions[:, 0]
+  lengths = pos + 1  # valid KV slots incl. the token written below
+  from ..ops.paged import paged_decode_attention, paged_gqa_attention_ref, paged_mla_attention_ref, write_token_kv
+
+  if "wkv_a" in p:
+    # MLA: pages hold the latent ("k") and rope channel ("v"), one head entry.
+    q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
+    k_pool = write_token_kv(k_pool, c_kv[:, 0][:, None, :], block_tables, pos, page_size)
+    v_pool = write_token_kv(v_pool, k_pe[:, 0][:, None, :], block_tables, pos, page_size)
+    attn = paged_mla_attention_ref(q_nope, q_pe, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, _mla_w_kv_b(p, h.dtype), cfg.v_head_dim, page_size)
+  else:
+    q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+    k_pool = write_token_kv(k_pool, k[:, 0], block_tables, pos, page_size)
+    v_pool = write_token_kv(v_pool, v[:, 0], block_tables, pos, page_size)
+    if use_kernel:
+      attn = paged_decode_attention(q[:, 0], k_pool, v_pool, block_tables, lengths, page_size)[:, None]
+    else:
+      attn = paged_gqa_attention_ref(q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size)
+  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
+  h, _ = _mlp_block(h, p, cfg)
+  return h, k_pool, v_pool
+
+
+def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool):
+  """One decode step for all rows against the page pool.
+
+  tokens [B, 1] int32 → (logits [B, 1, V], updated pool). Full shard only
+  (the batched server is single-node)."""
+  h = embed_tokens(params, cfg, tokens)
+  inv_freq = rope_inv_freq(cfg)
+  stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
+  new_k_parts, new_v_parts = [], []
+  off = 0
+  for stack in stacks:
+    L = next(iter(stack.values())).shape[0]
+
+    def body(carry, per_layer):
+      h = carry
+      lp, kp, vp = per_layer
+      h, kp, vp = _paged_layer_step(h, lp, kp, vp, block_tables, positions, inv_freq, cfg, page_size, use_kernel)
+      return h, (kp, vp)
+
+    h, (nk, nv) = jax.lax.scan(body, h, (stack, pool["k"][off : off + L], pool["v"][off : off + L]))
+    new_k_parts.append(nk)
+    new_v_parts.append(nv)
+    off += L
+  new_k = new_k_parts[0] if len(new_k_parts) == 1 else jnp.concatenate(new_k_parts, axis=0)
+  new_v = new_v_parts[0] if len(new_v_parts) == 1 else jnp.concatenate(new_v_parts, axis=0)
+  return head_logits(params, cfg, h), {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
+def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+  def body(carry, _):
+    tok, pos, pool, key = carry
+    # Inactive rows would write into whatever page their table names; pin
+    # their table to the trash page so held-token rewrites can't land on a
+    # page another row now owns.
+    bt = jnp.where(active[:, None], block_tables, 0)
+    logits, pool = paged_decode_forward(params, cfg, shard, tok, pos[:, None], pool, bt, page_size, use_kernel)
+    nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_ks, k_max)
+    nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold their token
+    pos = jnp.where(active, pos + 1, pos)  # ...and their position
+    return (nxt[:, None], pos, pool, key), nxt
+
+  (_, pos, pool, _), toks = jax.lax.scan(body, (token, positions, pool, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), pos, pool
+
+
+def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
+  """``fused_batch_decode`` against the page pool.
+
+  Same contract plus ``block_tables`` [B, mp] int32 — the host must have
+  allocated pages covering [pos, pos + n_steps) for every active row before
+  dispatch (inference/batch_scheduler.py does). Returns
+  (tokens [B, n_steps], positions [B], pool).
+  """
+  from ..ops.paged import paged_kernel_supported
+
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("fused_paged_batch_decode requires a full-model shard")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+  if use_kernel is None:
+    use_kernel = paged_kernel_supported(cfg)
+  B = token.shape[0]
+  top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+  return _fused_paged_batch_decode_impl(
+    params, cfg, shard, token, pool, jnp.asarray(block_tables, jnp.int32), positions, active.astype(jnp.bool_),
+    jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
+  )
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "page_size"))
+def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
+  """Prefill one request's prompt SUFFIX into its pages.
+
+  tokens [1, S_pad] int32 — the prompt tokens from ``prefix_len`` on (the
+  page-aligned reused prefix is skipped: its KV is already in the shared
+  pages named by ``bt_row``). bt_row [mp] int32; prefix_len/prompt_len are
+  traced scalars (prompt_len is the FULL prompt length). Returns
+  (last-token logits [1, V], pool).
+
+  Strategy: gather the row's pages into a contiguous [L, 1, mp·ps, H, hd]
+  cache, run the ordinary ``shard_forward`` prefill at positions
+  [prefix_len, prefix_len + S_pad), then scatter the touched pages back.
+  Untouched/unallocated table entries scatter into the trash page 0. Not
+  donated for the same reason as ``prefill_into_slot``: a failed prefill
+  must leave the shared pool intact.
+  """
+  S = tokens.shape[1]
+  mp = bt_row.shape[0]
+
+  def row_gather(pool_part):  # [L, P, Hkv, ps, hd] → [L, 1, mp·ps, Hkv, hd]
+    g = jnp.take(pool_part, bt_row, axis=1)  # [L, mp, Hkv, ps, hd]
+    L, _, Hkv, ps, hd = g.shape
+    return jnp.swapaxes(g, 2, 3).reshape(L, 1, mp * ps, Hkv, hd)
+
+  temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+  positions = (prefix_len + jnp.arange(S, dtype=jnp.int32))[None, :]
+  logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp)
+
+  page_ids = jnp.arange(mp, dtype=jnp.int32)
+  touched = (page_ids >= prefix_len // page_size) & (page_ids * page_size < prompt_len)
+  target = jnp.where(touched, bt_row, 0)  # trash page for the rest
+
+  def row_scatter(pool_part, t):  # write touched pages back
+    L, _, Stot, Hkv, hd = t.shape
+    pages = jnp.swapaxes(t.reshape(L, mp, page_size, Hkv, hd), 2, 3)  # [L, mp, Hkv, ps, hd]
+    return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
+
+  pool = {"k": row_scatter(pool["k"], temp["k"]), "v": row_scatter(pool["v"], temp["v"])}
+  idx = (prompt_len - prefix_len - 1).reshape(1, 1, 1)
+  last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (1, 1, logits.shape[-1])), axis=1)[:, 0, :]
+  return last, pool
 
 
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:

@@ -1,0 +1,205 @@
+"""Paged KV cache: block-table indirection over a shared page pool.
+
+The batched server's round-1 cache gave every slot ``max_seq`` tokens of HBM
+up front — the concurrency ceiling was ``n_slots × max_seq`` bytes whether or
+not requests used their window. Here the cache is a pool of fixed-size pages;
+each request maps logical positions onto pages through a block table, so HBM
+holds only the tokens that exist, concurrent capacity is bounded by *aggregate*
+context instead of per-slot worst case, and page-aligned prompt prefixes can be
+shared between requests (inference/batch_scheduler.py owns allocation and
+prefix dedup; this module owns the device-side ops).
+
+No reference counterpart: the reference's torch engine has a dense per-request
+cache (``SURVEY.md §5.7`` marks long-context serving greenfield). The design
+target is TPU: static shapes everywhere (the block table is a traced [B, mp]
+int32 operand — one compiled program for every allocation state), and decode
+attention reads pages through a Pallas kernel whose block-table indirection
+rides scalar prefetch, clamped so out-of-range grid steps re-fetch the same
+page (no DMA) instead of touching unallocated memory.
+
+Pool layout: ``[L, P, Hkv, ps, hd]`` — one logical page id addresses the same
+page index in every layer, and the per-(page, head) ``[ps, hd]`` tile is
+contiguous for the kernel's DMA.
+
+Page 0 is reserved as a trash page: gathers of unallocated block-table entries
+read it (positionally masked anyway) and masked scatters dump there, which
+keeps every shape static without conditional writes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, gqa_attention, mla_absorbed_attention
+
+DEFAULT_PAGE_SIZE = 64
+
+
+def init_paged_pool(cfg, n_shard_layers: int, n_pages: int, page_size: int, dtype=None) -> dict:
+  """Page pool for a shard. ``n_pages`` INCLUDES the reserved trash page 0.
+
+  Geometry follows ``models/decoder.py init_kv_cache``: GQA heads for dense
+  models; for MLA "k" holds the kv latent and "v" the rope channel.
+  """
+  dtype = dtype or cfg.dtype
+  k_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_k_dim)
+  v_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_v_dim)
+  return {"k": jnp.zeros(k_shape, dtype=dtype), "v": jnp.zeros(v_shape, dtype=dtype)}
+
+
+def write_token_kv(pool_l: jnp.ndarray, new: jnp.ndarray, block_tables: jnp.ndarray, pos: jnp.ndarray, page_size: int) -> jnp.ndarray:
+  """Scatter one decode step's KV into the pool (one layer).
+
+  pool_l [P, Hkv, ps, hd]; new [B, Hkv, hd]; block_tables [B, mp] int32;
+  pos [B] int32 (the logical position being written). Rows own disjoint
+  pages, so the scatter indices never collide.
+  """
+  page = jnp.take_along_axis(block_tables, (pos // page_size)[:, None], axis=1)[:, 0]  # [B]
+  off = pos % page_size
+  return pool_l.at[page, :, off].set(new.astype(pool_l.dtype))
+
+
+def gather_pages(pool_l: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
+  """[P, Hkv, ps, hd] × [B, mp] → position-ordered KV [B, mp·ps, Hkv, hd].
+
+  The XLA fallback path (CPU tests, MLA models): materializes the gathered
+  cache per layer. The Pallas kernel below avoids this copy on TPU.
+  """
+  g = jnp.take(pool_l, block_tables, axis=0)  # [B, mp, Hkv, ps, hd]
+  B, mp, Hkv, ps, hd = g.shape
+  return jnp.swapaxes(g, 2, 3).reshape(B, mp * ps, Hkv, hd)
+
+
+def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int) -> jnp.ndarray:
+  """Reference paged decode attention via gather (q [B, 1, Hq, hd])."""
+  k = gather_pages(k_pool_l, block_tables)
+  v = gather_pages(v_pool_l, block_tables)
+  kv_positions = jnp.arange(k.shape[1], dtype=jnp.int32)
+  q_positions = (lengths - 1)[:, None]  # current token's position
+  return gqa_attention(q, k, v, q_positions, kv_positions)
+
+
+def paged_mla_attention_ref(q_nope, q_pe, k_pool_l, v_pool_l, block_tables, lengths, w_kv_b, v_dim: int, page_size: int) -> jnp.ndarray:
+  """Paged MLA decode attention: gather the latent pages, then the absorbed op."""
+  ckv = gather_pages(k_pool_l, block_tables)[:, :, 0, :]  # [B, mp·ps, rank]
+  kpe = gather_pages(v_pool_l, block_tables)[:, :, 0, :]
+  kv_positions = jnp.arange(ckv.shape[1], dtype=jnp.int32)
+  q_positions = (lengths - 1)[:, None]
+  return mla_absorbed_attention(q_nope, q_pe, ckv, kpe, w_kv_b, q_positions, kv_positions, v_dim)
+
+
+# ------------------------------------------------- Pallas paged decode kernel
+#
+# One-token-per-row decode attention straight off the page pool. Split-K
+# flash-decode over pages: grid (B, Hkv, mp) — the innermost page axis runs
+# sequentially per (row, kv-head) carrying online-softmax state in VMEM
+# scratch, so long contexts stream page tiles through VMEM without ever
+# materializing the gathered cache. The block table and per-row lengths are
+# scalar-prefetched: the index map picks each step's page BEFORE the body
+# runs, and clamps past-the-end steps to the last valid page so their DMA is
+# a no-op re-fetch (Pallas skips the copy when the block index repeats).
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size: int, scale: float):
+  import jax.experimental.pallas as pl
+
+  b, i = pl.program_id(0), pl.program_id(2)
+
+  @pl.when(i == 0)
+  def _init():
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+  length = len_ref[b]
+  start = i * page_size
+
+  @pl.when(start < length)
+  def _block():
+    q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+    k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [group, ps]
+    kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    s = jnp.where(kv_pos < length, s, NEG_INF)
+    m_prev = m_ref[...]
+    blk_m = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_m)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+  @pl.when(i == pl.num_programs(2) - 1)
+  def _finish():
+    l = l_ref[...]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_decode_attention(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, interpret: bool = False):
+  """Decode attention off the page pool (dense GQA models).
+
+  q [B, Hq, hd] (the single new token per row); k/v pool [P, Hkv, ps, hd];
+  block_tables [B, mp] int32 (unallocated entries may hold anything — steps
+  past ``lengths`` are clamped to the last valid page and masked);
+  lengths [B] int32 = number of valid KV slots INCLUDING the token just
+  written. Returns [B, Hq, hd].
+  """
+  import jax.experimental.pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  B, Hq, hd = q.shape
+  Hkv = k_pool_l.shape[1]
+  group = Hq // Hkv
+  mp = block_tables.shape[1]
+  scale = float(1.0 / (hd**0.5))
+  qg = q.reshape(B, Hkv, group, hd)
+
+  def page_index(b, h, i, bt_ref, len_ref):
+    # Clamp past-the-end steps to the row's last valid page: the repeated
+    # block index makes the DMA a no-op instead of fetching garbage.
+    last = jnp.maximum(len_ref[b] - 1, 0) // page_size
+    return (bt_ref[b, jnp.minimum(i, last)], h, 0, 0)
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=2,
+    grid=(B, Hkv, mp),
+    in_specs=[
+      pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0)),
+      pl.BlockSpec((1, 1, page_size, hd), page_index),
+      pl.BlockSpec((1, 1, page_size, hd), page_index),
+    ],
+    out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0)),
+    scratch_shapes=[
+      pltpu.VMEM((group, 1), jnp.float32),
+      pltpu.VMEM((group, 1), jnp.float32),
+      pltpu.VMEM((group, hd), jnp.float32),
+    ],
+  )
+  out = pl.pallas_call(
+    functools.partial(_paged_decode_kernel, page_size=page_size, scale=scale),
+    out_shape=jax.ShapeDtypeStruct((B, Hkv, group, hd), q.dtype),
+    grid_spec=grid_spec,
+    interpret=interpret,
+  )(block_tables, lengths, qg, k_pool_l, v_pool_l)
+  return out.reshape(B, Hq, hd)
+
+
+def paged_kernel_supported(cfg, platform: str | None = None) -> bool:
+  """Whether the Pallas paged kernel should run. OPT-IN (XOT_TPU_PAGED_KERNEL=1):
+  at serving-scale contexts (≤4K) XLA's fused gather+attention beats the
+  kernel on v5e (measured: 1000 vs 854 aggregate tok/s at 16×1K rows) —
+  the kernel's page-clamped DMA pays off only on long, ragged caches."""
+  import os
+
+  if os.getenv("XOT_TPU_NO_FLASH") or os.getenv("XOT_TPU_PAGED_KERNEL", "0") in ("0", "false"):
+    return False
+  platform = platform or jax.default_backend()
+  return platform == "tpu" and not cfg.is_mla and cfg.head_dim in (64, 128, 256)

@@ -27,16 +27,19 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, q_offset_static: int):
+def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
   import jax.experimental.pallas as pl
 
-  qi = pl.program_id(2)
+  b, qi = pl.program_id(0), pl.program_id(2)
   q = q_ref[0, 0].astype(jnp.float32)  # [BQ, hd]
   bq = q.shape[0]
   skv = k_ref.shape[2]
   n_kv_blocks = pl.cdiv(skv, block_k)
 
-  q_pos = q_offset_static + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ,1]
+  # Per-row dynamic offset (SMEM): query row i is at absolute position
+  # off[b] + i. Prefix-cached prefills start mid-sequence (models/decoder.py
+  # prefill_into_pages), so the offset cannot be a static 0.
+  q_pos = off_ref[b] + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ,1]
 
   def body(kb, carry):
     m, l, acc = carry
@@ -64,13 +67,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, q_o
   o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("q_offset", "interpret"))
-def flash_attention_prefill(q, k, v, q_offset: int = 0, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_attention_prefill(q, k, v, q_offset=0, interpret: bool = False):
   """q [B,Sq,Hq,hd], k/v [B,Skv,Hkv,hd] → [B,Sq,Hq,hd].
 
-  Requires Sq % BLOCK_Q == 0 and Skv % BLOCK_K == 0 (callers pad; the
-  positional mask keeps padded KV slots (slot index > pos) inert as long as
-  they hold finite values).
+  ``q_offset`` — int or [B] int32 (TRACED): absolute position of each row's
+  first query. Requires Sq % BLOCK_Q == 0 and Skv % BLOCK_K == 0 (callers
+  pad; the positional mask keeps padded KV slots (slot index > pos) inert as
+  long as they hold finite values).
   """
   import jax.experimental.pallas as pl
   from jax.experimental.pallas import tpu as pltpu
@@ -79,6 +83,7 @@ def flash_attention_prefill(q, k, v, q_offset: int = 0, interpret: bool = False)
   Skv, Hkv = k.shape[1], k.shape[2]
   group = Hq // Hkv
   scale = float(1.0 / (hd**0.5))
+  offsets = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32), (B,))
 
   # Layout: [B, H, S, hd] so the S×hd tile is contiguous per (b, h).
   qt = jnp.moveaxis(q, 2, 1)  # [B, Hq, Sq, hd]
@@ -86,19 +91,20 @@ def flash_attention_prefill(q, k, v, q_offset: int = 0, interpret: bool = False)
   vt = jnp.moveaxis(v, 2, 1)
 
   grid = (B, Hq, Sq // BLOCK_Q)
-  kernel = functools.partial(_flash_kernel, block_k=BLOCK_K, scale=scale, q_offset_static=q_offset)
+  kernel = functools.partial(_flash_kernel, block_k=BLOCK_K, scale=scale)
   out = pl.pallas_call(
     kernel,
     out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
     grid=grid,
     in_specs=[
+      pl.BlockSpec(memory_space=pltpu.SMEM),
       pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
       pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
       pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
     ],
     out_specs=pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
     interpret=interpret,
-  )(qt, kt, vt)
+  )(offsets, qt, kt, vt)
   return jnp.moveaxis(out, 1, 2)  # [B, Sq, Hq, hd]
 
 

@@ -566,21 +566,49 @@ class Node:
     return await peer.send_example(self.get_current_shard(base_shard, head_idx), example, target, length, train, request_id)
 
   async def process_example(self, base_shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool, request_id: str) -> tuple[float, np.ndarray | None]:
-    """Run this node's span of the training ring (single-node: full step)."""
+    """Run this node's span of the training ring.
+
+    Full-model shard: one engine step. Partial shards run the ring protocol
+    the reference designed but never implemented engine-side
+    (``reference/orchestration/node.py:299-330``, proto ``Loss{loss,grads}``):
+    activations hop forward via SendExample; each RPC *reply* carries the
+    loss and d_activations back, and every span applies its own optimizer
+    update — elementwise optimizers make the composite step identical to a
+    single-node full-model step (tests/test_ring_training.py)."""
     shard = self.get_current_shard(base_shard)
     self.outstanding_requests[request_id] = "training" if train else "evaluating"
     try:
-      if shard.is_last_layer:
+      if shard.is_last_layer and shard.is_first_layer:
         if train:
           loss = await self.inference_engine.train(request_id, shard, example, target, length)
         else:
           loss = await self.inference_engine.evaluate(request_id, shard, example, target, length)
         return float(loss), None
-      # Multi-node training ring is not yet implemented engine-side: the
-      # activations-forward/grads-backward protocol exists (SendExample), but
-      # the engine runs full-model steps only. Mirrors the reference's state
-      # (its engines had no train at all) while single-node training works.
-      raise NotImplementedError("multi-node pipeline training requires the full model on the ring head for now")
+      if shard.is_last_layer:
+        # Ring tail: example carries the upstream span's activations.
+        loss, d_h = await self.inference_engine.last_span_step(request_id, shard, example, target, length, train)
+        return float(loss), d_h
+      # Head or middle span: forward own layers, hop downstream, and (when
+      # training) backpropagate through the stashed VJP on the reply.
+      h = await self.inference_engine.forward_span(request_id, shard, example, train)
+      next_idx = self.get_partition_index(offset=1)
+      next_shard = self.get_current_shard(base_shard, next_idx)
+      target_id = self.partitioning_strategy.partition(self.topology)[next_idx].node_id
+      peer = next((p for p in self.peers if p.id() == target_id), None)
+      if peer is None:
+        if train:
+          self.inference_engine.discard_span(request_id)
+        raise ValueError(f"downstream training peer {target_id} not found")
+      try:
+        loss, d_out = await peer.send_example(next_shard, h, target, length, train, request_id)
+      except Exception:
+        if train:
+          self.inference_engine.discard_span(request_id)
+        raise
+      if not train:
+        return float(loss), None
+      d_in = await self.inference_engine.backward_span(request_id, shard, d_out)
+      return float(loss), d_in
     finally:
       self.outstanding_requests.pop(request_id, None)
 

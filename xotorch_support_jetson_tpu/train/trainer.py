@@ -77,3 +77,121 @@ def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") 
   state = _get_train_state(engine, 1e-5, "adamw", _has_lora(engine.params))
   batch = _make_batch(inputs, targets, lengths)
   return float(jax.device_get(state.eval_fn(engine.params, batch)))
+
+
+# ----------------------------- ring pipeline training (partial shards)
+#
+# The reference DESIGNED this protocol — activations forward via SendExample,
+# per-span gradients back in the reply (``reference/orchestration/node.py:299-330``,
+# ``node_service.proto:36-48`` Loss{loss, grads}) — but its engines never
+# implemented train, so the path could never run. Here each node runs its
+# layer span under ``jax.vjp``: the forward hop ships activations downstream,
+# the RPC *reply* carries (loss, d_activations) back up, and every node
+# applies its own optimizer update to its own span — elementwise optimizers
+# (adamw/sgd) make this exactly equivalent to a single-node full-model step.
+# MoE load-balancing aux loss is omitted on this path (the cache-less
+# shard_forward discards per-layer aux); dense and LoRA models are exact.
+
+
+class _RingState:
+  def __init__(self):
+    self.vjps: dict = {}  # request_id -> (vjp_fn, is_first_layer)
+    self.opt = None
+    self.opt_state = None
+
+
+def _ring_state(engine) -> _RingState:
+  state = getattr(engine, "_ring_train_state", None)
+  if state is None:
+    state = _RingState()
+    engine._ring_train_state = state
+  return state
+
+
+def _ring_update(engine, grads, lr: float, opt: str) -> None:
+  st = _ring_state(engine)
+  lora = _has_lora(engine.params)
+  if st.opt is None:
+    st.opt = optax.sgd(lr) if opt == "sgd" else (optax.adam(lr) if lora else optax.adamw(lr))
+    st.opt_state = st.opt.init(engine.params)
+  if lora:
+    grads = lora_grad_mask(grads, engine.params)
+  updates, st.opt_state = st.opt.update(grads, st.opt_state, engine.params)
+  engine.params = optax.apply_updates(engine.params, updates)
+
+
+def _span_positions(x) -> "jax.Array":
+  import jax.numpy as jnp
+
+  B, S = x.shape[:2]
+  return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+
+def engine_forward_span(engine, shard, x, request_id: str, train: bool) -> np.ndarray:
+  """Forward a non-last span: tokens (first shard) or activations → hidden.
+
+  With ``train`` the VJP closure is stashed under ``request_id`` for the
+  backward hop (``engine_backward_span``)."""
+  import jax.numpy as jnp
+
+  from ..models.decoder import shard_forward
+
+  cfg = engine.cfg
+  x = jnp.asarray(np.asarray(x))
+  if shard.is_first_layer:
+    x = x.astype(jnp.int32)
+  positions = _span_positions(x)
+
+  def fwd(params, x):
+    return shard_forward(params, cfg, shard, x, positions, None)[0]
+
+  if train:
+    h, vjp_fn = jax.vjp(fwd, engine.params, x)
+    _ring_state(engine).vjps[request_id] = (vjp_fn, shard.is_first_layer)
+  else:
+    h = fwd(engine.params, x)
+  return jax.device_get(h)
+
+
+def engine_backward_span(engine, shard, d_out, request_id: str, opt: str = "adamw", lr: float = 1e-5) -> np.ndarray | None:
+  """Backward through a stashed span: applies this span's optimizer update,
+  returns d_input activations (None on the first shard — nothing upstream)."""
+  import jax.numpy as jnp
+
+  vjp_fn, is_first = _ring_state(engine).vjps.pop(request_id)
+  grads, d_x = vjp_fn(jnp.asarray(np.asarray(d_out)).astype(engine.cfg.dtype))
+  _ring_update(engine, grads, lr, opt)
+  return None if is_first else jax.device_get(d_x)
+
+
+def engine_discard_span(engine, request_id: str) -> None:
+  """Drop a stashed VJP (downstream hop failed)."""
+  _ring_state(engine).vjps.pop(request_id, None)
+
+
+def engine_last_span_step(engine, shard, h, targets, lengths, train: bool, opt: str = "adamw", lr: float = 1e-5) -> tuple[float, np.ndarray | None]:
+  """The ring tail: activations → masked CE loss; with ``train``, update this
+  span and return d_activations for the upstream reply."""
+  import jax.numpy as jnp
+
+  from ..models.decoder import shard_forward
+  from ..parallel.train_step import cross_entropy_loss
+
+  cfg = engine.cfg
+  h = jnp.asarray(np.asarray(h)).astype(cfg.dtype)
+  targets = jnp.asarray(np.asarray(targets, np.int32))
+  lengths = np.asarray(lengths, np.int32).reshape(-1)
+  S = h.shape[1]
+  mask = jnp.asarray((np.arange(S)[None, :] < lengths[:, None]).astype(np.float32))
+  positions = _span_positions(h)
+
+  def loss_fn(params, h):
+    logits, _ = shard_forward(params, cfg, shard, h, positions, None)
+    return cross_entropy_loss(logits, targets, mask)
+
+  if not train:
+    return float(jax.device_get(loss_fn(engine.params, h))), None
+  loss_val, vjp_fn = jax.vjp(loss_fn, engine.params, h)
+  grads, d_h = vjp_fn(jnp.ones((), jnp.float32))
+  _ring_update(engine, grads, lr, opt)
+  return float(jax.device_get(loss_val)), jax.device_get(d_h)
