@@ -13,7 +13,7 @@ from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedP
 from tests_support_stubs import NoDiscovery, StubServer
 
 
-async def _make_api():
+async def _make_api(max_generate_tokens: int = 50):
   node = Node(
     "api-node",
     StubServer(),
@@ -21,7 +21,7 @@ async def _make_api():
     NoDiscovery(),
     None,
     RingMemoryWeightedPartitioningStrategy(),
-    max_generate_tokens=50,
+    max_generate_tokens=max_generate_tokens,
   )
   await node.start()
   api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
@@ -178,6 +178,83 @@ async def test_web_ui_served_with_management_controls():
     html = await resp.text()
     for needle in ('id="model"', 'id="dl-btn"', 'id="del-btn"', 'id="attach"', 'id="stop"', 'id="topology"', "/v1/download/progress"):
       assert needle in html, f"missing {needle}"
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_legacy_completions_endpoint():
+  """/v1/completions: raw prompt (no chat template), blocking + streaming +
+  echo + validation errors."""
+  node, api, client = await _make_api(max_generate_tokens=200)
+  try:
+    body = {"model": "dummy", "prompt": "aaaa", "stream": False, "max_tokens": 10}
+    resp = await client.post("/v1/completions", json=body)
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    assert data["object"] == "text_completion"
+    text1 = data["choices"][0]["text"]
+    assert isinstance(text1, str) and text1
+    assert data["usage"]["prompt_tokens"] > 0 and data["usage"]["completion_tokens"] > 0
+    assert data["choices"][0]["finish_reason"] in ("stop", "length")
+    assert data["choices"][0]["logprobs"] is None
+
+    # echo prepends the prompt text.
+    resp = await client.post("/v1/completions", json={**body, "echo": True})
+    assert (await resp.json())["choices"][0]["text"].startswith("aaaa")
+
+    # single-element list prompt is accepted; multi-element is not.
+    resp = await client.post("/v1/completions", json={**body, "prompt": ["aaaa"]})
+    assert resp.status == 200
+    resp = await client.post("/v1/completions", json={**body, "prompt": ["a", "b"]})
+    assert resp.status == 400
+    resp = await client.post("/v1/completions", json={**body, "prompt": ""})
+    assert resp.status == 400
+    resp = await client.post("/v1/completions", json={**body, "logprobs": 50})
+    assert resp.status == 400
+    resp = await client.post("/v1/completions", json={**body, "logprobs": 2, "stream": True})
+    assert resp.status == 400
+
+    # streaming reproduces the blocking text; the dummy engine ends on EOS,
+    # so the final chunk's reason must be "stop" (computed from the RAW final
+    # token batch, not the EOS-filtered accumulator).
+    resp = await client.post("/v1/completions", json={**body, "stream": True, "max_tokens": 100})
+    assert resp.status == 200
+    acc, reasons = "", []
+    async for line in resp.content:
+      line = line.decode().strip()
+      if not line.startswith("data: ") or line == "data: [DONE]":
+        continue
+      chunk = json.loads(line[len("data: "):])
+      if "error" in chunk:
+        raise AssertionError(chunk)
+      acc += chunk["choices"][0]["text"]
+      if chunk["choices"][0]["finish_reason"]:
+        reasons.append(chunk["choices"][0]["finish_reason"])
+    assert acc and reasons == ["stop"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_chat_logprobs_validation():
+  node, api, client = await _make_api()
+  try:
+    base = {"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}]}
+    resp = await client.post("/v1/chat/completions", json={**base, "logprobs": "yes"})
+    assert resp.status == 400
+    resp = await client.post("/v1/chat/completions", json={**base, "top_logprobs": 3})
+    assert resp.status == 400  # requires logprobs: true
+    resp = await client.post("/v1/chat/completions", json={**base, "logprobs": True, "top_logprobs": 21})
+    assert resp.status == 400
+    resp = await client.post("/v1/chat/completions", json={**base, "logprobs": True, "stream": True})
+    assert resp.status == 400
+    # Dummy engine can't score: logprobs come back null, request still 200.
+    resp = await client.post("/v1/chat/completions", json={**base, "logprobs": True, "top_logprobs": 2})
+    assert resp.status == 200
+    assert (await resp.json())["choices"][0]["logprobs"] is None
   finally:
     await client.close()
     await node.stop()

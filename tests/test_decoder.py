@@ -166,3 +166,28 @@ def test_client_temperature_does_not_recompile():
   cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
   fused_decode(params, cfg, shard, tok, cache, start, 2, temp=0.0)
   assert _fused_decode_impl._cache_size() == base + 1  # greedy is its own variant
+
+
+def test_score_last_tokens_matches_full_logits():
+  """Post-hoc scoring (models/decoder.py score_last_tokens) == log_softmax of
+  the full cache-less forward at the scored positions, with padding inert."""
+  from xotorch_support_jetson_tpu.models.decoder import score_last_tokens
+
+  cfg = tiny_test_config(n_layers=2)
+  params, shard = full_model_params(jax.random.PRNGKey(4), cfg)
+  rng = np.random.default_rng(5)
+  seq = rng.integers(1, cfg.vocab_size, size=(11,)).astype(np.int32)
+  S, n_scored, top_n = len(seq), 4, 3
+
+  pad = np.zeros((1, 16), np.int32)
+  pad[0, :S] = seq
+  chosen_lp, top_ids, top_lp = score_last_tokens(params, cfg, shard, jnp.asarray(pad), jnp.int32(S), n_scored, top_n)
+
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  logits, _ = shard_forward(params, cfg, shard, jnp.asarray(seq[None, :]), positions, None)
+  logp = jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1)[0]
+  for i in range(n_scored):
+    pos = S - n_scored - 1 + i  # hidden at pos predicts token pos+1
+    np.testing.assert_allclose(float(chosen_lp[i]), float(logp[pos, seq[pos + 1]]), rtol=1e-5, atol=1e-5)
+    ref_top = np.argsort(-logp[pos])[:top_n]
+    assert list(np.asarray(top_ids[i])) == list(ref_top)

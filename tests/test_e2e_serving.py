@@ -136,3 +136,52 @@ async def test_cli_run_path(serving_stack, capsys):
     assert "tok/s" in out
   finally:
     await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_logprobs_real_engine(serving_stack):
+  """logprobs on the real engine: post-hoc scoring entries line up with the
+  generated tokens, and greedy decoding means every chosen token is also the
+  top-1 alternative with the same logprob."""
+  node, api, engine = serving_stack
+  await node.start()
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    body = {
+      "model": "llama-3.2-1b",
+      "messages": [{"role": "user", "content": "hello world"}],
+      "stream": False,
+      "logprobs": True,
+      "top_logprobs": 2,
+      "max_tokens": 6,
+    }
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    lp = data["choices"][0]["logprobs"]
+    assert lp is not None
+    entries = lp["content"]
+    assert len(entries) == data["usage"]["completion_tokens"]
+    for e in entries:
+      assert e["logprob"] <= 0.0
+      assert len(e["top_logprobs"]) == 2
+      # Greedy: the chosen token IS the argmax → matches top-1 exactly.
+      assert e["top_logprobs"][0]["token"] == e["token"]
+      assert abs(e["top_logprobs"][0]["logprob"] - e["logprob"]) < 1e-5
+      assert e["top_logprobs"][0]["logprob"] >= e["top_logprobs"][1]["logprob"]
+
+    # Legacy endpoint with integer logprobs.
+    resp = await client.post("/v1/completions", json={"model": "llama-3.2-1b", "prompt": "hello world", "logprobs": 3, "max_tokens": 5})
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    lp = data["choices"][0]["logprobs"]
+    assert lp is not None
+    n = data["usage"]["completion_tokens"]
+    assert len(lp["tokens"]) == n == len(lp["token_logprobs"]) == len(lp["top_logprobs"]) == len(lp["text_offset"])
+    assert all(v <= 0.0 for v in lp["token_logprobs"])
+    assert all(len(t) <= 3 for t in lp["top_logprobs"])
+    assert lp["text_offset"][0] == len("hello world")
+  finally:
+    await client.close()
+    await node.stop()

@@ -10,6 +10,7 @@ deepseek-style dense-prefix decoder, and the sharding-equivalence contract
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from xotorch_support_jetson_tpu.inference.shard import Shard
 from xotorch_support_jetson_tpu.models.config import tiny_test_config
@@ -272,3 +273,35 @@ def test_mla_lora_adapters_are_live():
   assert "wq_b_lora_a" not in merged["layers"]
   folded, _ = shard_forward(merged, cfg, shard, tokens, positions, None)
   np.testing.assert_allclose(np.asarray(folded), np.asarray(bumped), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+  "kwargs",
+  [
+    dict(scoring="softmax", norm_topk=False),  # mixtral
+    dict(scoring="softmax", norm_topk=True),  # qwen2-moe
+    dict(scoring="softmax", norm_topk=True, n_group=4, topk_group=2, group_mode="max", scale=2.0),  # deepseek-v2
+    dict(scoring="sigmoid", norm_topk=True, n_group=4, topk_group=2, group_mode="top2sum", scale=2.5),  # deepseek-v3
+  ],
+)
+def test_moe_gather_path_matches_einsum_path(kwargs):
+  """The decode-time weight-gather path (T <= MOE_GATHER_MAX) computes the
+  same outputs as the batched dispatch/combine einsums, for every routing
+  variant."""
+  from xotorch_support_jetson_tpu.ops.moe import _moe_ffn_block, _moe_ffn_gather
+
+  rng = np.random.default_rng(17)
+  E, D, F, k = 8, 16, 24, 3
+  w_router = jnp.asarray(rng.normal(size=(D, E)), jnp.float32)
+  w_gate = jnp.asarray(rng.normal(size=(E, D, F)) * 0.1, jnp.float32)
+  w_up = jnp.asarray(rng.normal(size=(E, D, F)) * 0.1, jnp.float32)
+  w_down = jnp.asarray(rng.normal(size=(E, F, D)) * 0.1, jnp.float32)
+  bias = jnp.asarray(rng.normal(size=(E,)) * 0.1, jnp.float32) if kwargs["scoring"] == "sigmoid" else None
+  full = dict(scoring="softmax", norm_topk=False, selection_bias=bias, scale=1.0, n_group=1, topk_group=1, group_mode="none")
+  full.update(kwargs)
+  for T in (1, 2, 4):
+    x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    ref, aux_ref = _moe_ffn_block(x, w_router, w_gate, w_up, w_down, k, capacity_factor=None, **full)
+    got, aux_got = _moe_ffn_gather(x, w_router, w_gate, w_up, w_down, k, **full)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_got), float(aux_ref), rtol=1e-5)

@@ -36,7 +36,7 @@ class Message:
 
 
 class ChatCompletionRequest:
-  def __init__(self, model: str, messages: list[Message], temperature: float | None = None, tools=None, max_tokens=None, stream=False, stop=()):
+  def __init__(self, model: str, messages: list[Message], temperature: float | None = None, tools=None, max_tokens=None, stream=False, stop=(), logprobs=False, top_logprobs=0):
     self.model = model
     self.messages = messages
     self.temperature = temperature
@@ -44,6 +44,8 @@ class ChatCompletionRequest:
     self.max_tokens = max_tokens
     self.stream = stream
     self.stop = tuple(stop)
+    self.logprobs = bool(logprobs)
+    self.top_logprobs = int(top_logprobs)
 
 
 def find_stop(text: str, stops: tuple) -> tuple[int | None, int]:
@@ -148,6 +150,19 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
     if DEBUG >= 1:
       print(f"[api] unknown model {model}; defaulting to {default_model}")
     model = default_model
+  logprobs = data.get("logprobs", False)
+  if not isinstance(logprobs, bool):
+    raise ValueError("'logprobs' must be a boolean")
+  top_logprobs = data.get("top_logprobs", 0) or 0
+  if not isinstance(top_logprobs, int) or isinstance(top_logprobs, bool) or not 0 <= top_logprobs <= 20:
+    raise ValueError("'top_logprobs' must be an integer in [0, 20]")
+  if top_logprobs and not logprobs:
+    raise ValueError("'top_logprobs' requires 'logprobs': true")
+  if logprobs and data.get("stream"):
+    # Logprobs are recomputed post-hoc in one parallel forward (the fused
+    # decode loops return token ids only); a stream has no final message to
+    # attach them to.
+    raise ValueError("'logprobs' is not supported with 'stream': true")
   return ChatCompletionRequest(
     model,
     [parse_message(m) for m in data["messages"]],
@@ -158,6 +173,8 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
     max_tokens,
     data.get("stream", False),
     stop,
+    logprobs,
+    top_logprobs,
   )
 
 
@@ -199,6 +216,8 @@ class ChatGPTAPI:
     r = self.app.router
     r.add_post("/v1/chat/completions", self.handle_post_chat_completions)
     r.add_post("/chat/completions", self.handle_post_chat_completions)
+    r.add_post("/v1/completions", self.handle_post_completions)
+    r.add_post("/completions", self.handle_post_completions)
     r.add_post("/v1/chat/token/encode", self.handle_post_chat_token_encode)
     r.add_get("/v1/models", self.handle_get_models)
     r.add_get("/models", self.handle_get_models)
@@ -358,6 +377,151 @@ class ChatGPTAPI:
       return web.json_response({"status": f"Model {model_name} deleted"})
     return web.json_response({"detail": f"Model {model_name} not found"}, status=404)
 
+  async def handle_post_completions(self, request):
+    """Legacy text completions (`/v1/completions`): the prompt runs RAW — no
+    chat template — through the same generation machinery. Supports
+    max_tokens/temperature/stop/stream/echo and OpenAI's integer ``logprobs``
+    (top-N per generated token, recomputed post-hoc; single-node serving)."""
+    try:
+      data = await request.json()
+    except Exception:  # noqa: BLE001
+      return web.json_response({"error": "invalid JSON body"}, status=400)
+    prompt = data.get("prompt")
+    if isinstance(prompt, list):
+      if len(prompt) != 1 or not isinstance(prompt[0], str):
+        return web.json_response({"error": "'prompt' must be a string (or a single-element list of one)"}, status=400)
+      prompt = prompt[0]
+    if not isinstance(prompt, str) or not prompt:
+      return web.json_response({"error": "'prompt' must be a non-empty string"}, status=400)
+    logprobs_n = data.get("logprobs")
+    if logprobs_n is not None and (not isinstance(logprobs_n, int) or isinstance(logprobs_n, bool) or not 0 <= logprobs_n <= 20):
+      return web.json_response({"error": "'logprobs' must be an integer in [0, 20]"}, status=400)
+    if logprobs_n and data.get("stream"):
+      return web.json_response({"error": "'logprobs' is not supported with 'stream': true"}, status=400)
+    try:
+      # Reuse the chat validation for the shared fields.
+      base = parse_chat_request({**data, "messages": [{"role": "user", "content": prompt}], "logprobs": False, "top_logprobs": 0}, self.default_model)
+    except ValueError as e:
+      return web.json_response({"error": str(e)}, status=400)
+    shard = registry.build_base_shard(base.model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"detail": f"Unsupported model: {base.model}"}, status=400)
+    tokenizer = await self._tokenizer_for(shard)
+    request_id = str(uuid.uuid4())
+    created = int(time.time())
+    self.token_queues[request_id] = asyncio.Queue()
+    if hasattr(self.node, "set_request_options"):
+      self.node.set_request_options(request_id, stream=bool(base.stream), max_tokens=base.max_tokens, temperature=base.temperature)
+    prompt_ids = list(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else []
+    eos = getattr(tokenizer, "eos_token_id", None)
+    eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    from ..inference.engine import PromptTooLongError, ServerOverloadedError
+
+    def completion_body(text: str, finish_reason, logprobs_obj=None, n_gen: int = 0) -> dict:
+      return {
+        "id": f"cmpl-{request_id}",
+        "object": "text_completion",
+        "created": created,
+        "model": base.model,
+        "system_fingerprint": "xot_tpu_0.1.0",
+        "choices": [{"index": 0, "text": text, "logprobs": logprobs_obj, "finish_reason": finish_reason}],
+        "usage": {"prompt_tokens": len(prompt_ids), "completion_tokens": n_gen, "total_tokens": len(prompt_ids) + n_gen},
+      }
+
+    try:
+      if base.stream:
+        gen_task = asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))
+        try:
+          return await self._stream_completions_response(request, base, request_id, tokenizer, created, gen_task)
+        finally:
+          if not gen_task.done():
+            cancel = getattr(self.node, "cancel_request", None)
+            if cancel is not None:
+              cancel(request_id)
+          try:
+            await asyncio.wait_for(asyncio.shield(gen_task), timeout=30)
+          except Exception:  # noqa: BLE001
+            pass
+      try:
+        await asyncio.wait_for(
+          asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))),
+          timeout=self.response_timeout,
+        )
+      except asyncio.TimeoutError:
+        cancel = getattr(self.node, "cancel_request", None)
+        if cancel is not None:
+          cancel(request_id)
+        raise
+      all_tokens: list[int] = []
+      while True:
+        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+        all_tokens.extend(tokens)
+        if is_finished:
+          break
+      text = tokenizer.decode([t for t in all_tokens if t not in eos_set])
+      finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
+      if base.stop:
+        cut, _ = find_stop(text, base.stop)
+        if cut is not None:
+          text = text[:cut]
+          finish_reason = "stop"
+      logprobs_obj = None
+      if logprobs_n:
+        scored = await self._score_logprobs(shard, prompt_ids, all_tokens, logprobs_n)
+        if scored is not None:
+          chosen_lp, top_ids, top_lp = scored
+          toks = [tokenizer.decode([int(t)]) for t in all_tokens]
+          offsets, off = [], len(prompt)
+          for s in toks:
+            offsets.append(off)
+            off += len(s)
+          logprobs_obj = {
+            "tokens": toks,
+            "token_logprobs": [float(x) for x in chosen_lp],
+            "top_logprobs": [
+              {tokenizer.decode([int(tid)]): float(tlp) for tid, tlp in zip(top_ids[i][:logprobs_n], top_lp[i][:logprobs_n])}
+              for i in range(len(all_tokens))
+            ],
+            "text_offset": offsets,
+          }
+      if data.get("echo"):
+        text = prompt + text
+      return web.json_response(completion_body(text, finish_reason, logprobs_obj, len(all_tokens)))
+    except asyncio.TimeoutError:
+      return web.json_response({"detail": "Response generation timed out"}, status=408)
+    except PromptTooLongError as e:
+      return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
+    except ServerOverloadedError as e:
+      return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
+    except Exception as e:  # noqa: BLE001
+      if DEBUG >= 1:
+        import traceback
+
+        traceback.print_exc()
+      return web.json_response({"detail": f"Error processing prompt: {e}"}, status=500)
+    finally:
+      self.token_queues.pop(request_id, None)
+      getattr(self.node, "request_options", {}).pop(request_id, None)
+
+  async def _stream_completions_response(self, request, base, request_id, tokenizer, created, gen_task):
+    """SSE for /v1/completions: the shared token loop with text_completion
+    chunk shapes."""
+
+    def chunk(text: str, reason) -> dict:
+      return {
+        "id": f"cmpl-{request_id}",
+        "object": "text_completion",
+        "created": created,
+        "model": base.model,
+        "choices": [{"index": 0, "text": text, "logprobs": None, "finish_reason": reason}],
+      }
+
+    return await self._run_sse_stream(
+      request, request_id, tokenizer, base.stop, gen_task,
+      lambda delta: chunk(delta, None),
+      lambda reason: chunk("", reason),
+    )
+
   async def handle_image_generations(self, request):
     # Endpoint surface parity with the reference's stable-diffusion path
     # (chatgpt_api.py:445-535); diffusion models are not in the registry
@@ -488,7 +652,8 @@ class ChatGPTAPI:
         if cancel is not None:
           cancel(request_id)
         raise
-      return await self._blocking_response(chat_request, request_id, tokenizer, created, prompt_tokens)
+      prompt_ids = list(tokenizer.encode(prompt)) if chat_request.logprobs and hasattr(tokenizer, "encode") else None
+      return await self._blocking_response(chat_request, request_id, tokenizer, created, prompt_tokens, shard=shard, prompt_ids=prompt_ids)
     except asyncio.TimeoutError:
       return web.json_response({"detail": "Response generation timed out"}, status=408)
     except PromptTooLongError as e:
@@ -529,7 +694,15 @@ class ChatGPTAPI:
         if gen_task is not None and gen_task.done() and gen_task.exception() is not None:
           raise gen_task.exception()
 
-  async def _stream_response(self, request, chat_request, request_id, tokenizer, created, gen_task=None, prompt_tokens: int = 0, include_usage: bool = False):
+  async def _run_sse_stream(self, request, request_id, tokenizer, stops, gen_task, make_delta_chunk, make_finish_chunk, make_trailer_chunk=None):
+    """The one SSE token loop both endpoints share: incremental
+    detokenization (decode the full token list each time and emit the text
+    suffix — per-token decode drops BPE leading spaces), stop-string
+    hold-back, finish_reason from the RAW final token batch, and in-band
+    error reporting once the response is committed. The chunk shapes
+    (chat.completion.chunk vs text_completion) come from the callbacks;
+    ``make_trailer_chunk(n_completion)`` may add one final chunk (usage).
+    """
     # Fetch the FIRST token batch before committing the SSE response: errors
     # knowable at admission (PromptTooLongError, ServerOverloadedError, a
     # pre-first-token timeout) propagate to the handler and get their proper
@@ -543,12 +716,13 @@ class ChatGPTAPI:
     await response.prepare(request)
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
-    # Incremental detokenization: decode the full token list each time and
-    # emit the text suffix — per-token decode drops BPE leading spaces.
     all_tokens: list[int] = []
     n_completion = 0
     emitted_text = ""
-    stops = chat_request.stop
+
+    async def emit(chunk: dict) -> None:
+      await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+
     try:
       while True:
         n_completion += len(tokens)
@@ -566,25 +740,22 @@ class ChatGPTAPI:
         delta = full_text[len(emitted_text):safe_len]
         if delta:
           emitted_text = full_text[:safe_len]
-          chunk = completion_chunk(request_id, chat_request.model, created, delta, None)
-          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+          await emit(make_delta_chunk(delta))
         if cut is not None:
           # Stop string hit: end the stream (the handler's finally cancels
           # the still-running generation) — finish_reason "stop" per OpenAI.
-          chunk = completion_chunk(request_id, chat_request.model, created, None, "stop")
-          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+          await emit(make_finish_chunk("stop"))
           break
         if is_finished:
-          finish = self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)
-          chunk = completion_chunk(request_id, chat_request.model, created, None, finish)
-          await response.write(f"data: {json.dumps(chunk)}\n\n".encode())
+          # Reason from the RAW final batch: an EOS-terminated stream is
+          # "stop" even though EOS tokens never enter all_tokens.
+          await emit(make_finish_chunk(self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)))
           break
         tokens, is_finished = await self._next_tokens(request_id, gen_task)
-      if include_usage:  # OpenAI stream_options.include_usage: final usage-only chunk
-        usage_chunk = completion_chunk(request_id, chat_request.model, created, None, None)
-        usage_chunk["choices"] = []
-        usage_chunk["usage"] = {"prompt_tokens": prompt_tokens, "completion_tokens": n_completion, "total_tokens": prompt_tokens + n_completion}
-        await response.write(f"data: {json.dumps(usage_chunk)}\n\n".encode())
+      if make_trailer_chunk is not None:
+        trailer = make_trailer_chunk(n_completion)
+        if trailer is not None:
+          await emit(trailer)
     except Exception as e:  # noqa: BLE001
       # The SSE response is already committed (prepare() ran; bytes may be
       # out) — aiohttp cannot send a second response on this connection, so
@@ -604,7 +775,56 @@ class ChatGPTAPI:
     await response.write_eof()
     return response
 
-  async def _blocking_response(self, chat_request, request_id, tokenizer, created, prompt_tokens: int = 0):
+  async def _stream_response(self, request, chat_request, request_id, tokenizer, created, gen_task=None, prompt_tokens: int = 0, include_usage: bool = False):
+    def make_trailer(n_completion: int) -> dict | None:
+      if not include_usage:  # OpenAI stream_options.include_usage: final usage-only chunk
+        return None
+      usage_chunk = completion_chunk(request_id, chat_request.model, created, None, None)
+      usage_chunk["choices"] = []
+      usage_chunk["usage"] = {"prompt_tokens": prompt_tokens, "completion_tokens": n_completion, "total_tokens": prompt_tokens + n_completion}
+      return usage_chunk
+
+    return await self._run_sse_stream(
+      request, request_id, tokenizer, chat_request.stop, gen_task,
+      lambda delta: completion_chunk(request_id, chat_request.model, created, delta, None),
+      lambda reason: completion_chunk(request_id, chat_request.model, created, None, reason),
+      make_trailer,
+    )
+
+  async def _score_logprobs(self, shard, prompt_ids, gen_tokens, top_n: int):
+    """(chosen_lp, top_ids, top_lp) for the generated tokens, or None where
+    scoring is unavailable (ring/mesh serving)."""
+    if not prompt_ids or not gen_tokens:
+      return None
+    scorer = getattr(self.node, "score_tokens", None)
+    if scorer is None:
+      return None
+    try:
+      return await scorer(shard, list(prompt_ids) + list(gen_tokens), len(gen_tokens), max(top_n, 1))
+    except Exception:  # noqa: BLE001 — logprobs are best-effort decoration
+      if DEBUG >= 1:
+        import traceback
+
+        traceback.print_exc()
+      return None
+
+  def _chat_logprobs(self, tokenizer, token_ids, scored, top_n: int) -> dict | None:
+    if scored is None:
+      return None
+    chosen_lp, top_ids, top_lp = scored
+
+    def tok_entry(tid: int, lp: float) -> dict:
+      s = tokenizer.decode([int(tid)])
+      return {"token": s, "logprob": float(lp), "bytes": list(s.encode())}
+
+    content = []
+    for i, t in enumerate(token_ids):
+      entry = tok_entry(t, chosen_lp[i])
+      entry["top_logprobs"] = [tok_entry(int(tid), float(tlp)) for tid, tlp in zip(top_ids[i][:top_n], top_lp[i][:top_n])]
+      content.append(entry)
+    return {"content": content, "refusal": None}
+
+  async def _blocking_response(self, chat_request, request_id, tokenizer, created, prompt_tokens: int = 0, shard=None, prompt_ids=None):
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     all_tokens: list[int] = []
@@ -622,6 +842,13 @@ class ChatGPTAPI:
       if cut is not None:
         content = content[:cut]
         finish_reason = "stop"
+    logprobs_obj = None
+    if chat_request.logprobs:
+      # Post-hoc scoring covers every generated token (including a trailing
+      # EOS and any tokens past a stop-string cut — token/text boundaries
+      # don't align under truncation).
+      scored = await self._score_logprobs(shard, prompt_ids, all_tokens, chat_request.top_logprobs)
+      logprobs_obj = self._chat_logprobs(tokenizer, all_tokens, scored, chat_request.top_logprobs)
     return web.json_response(
       {
         "id": f"chatcmpl-{request_id}",
@@ -633,7 +860,7 @@ class ChatGPTAPI:
           {
             "index": 0,
             "message": {"role": "assistant", "content": content},
-            "logprobs": None,
+            "logprobs": logprobs_obj,
             "finish_reason": finish_reason,
           }
         ],

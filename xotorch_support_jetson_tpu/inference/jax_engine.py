@@ -682,6 +682,41 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, engine_eval_step, self, shard, inputs, targets, lengths, loss)
 
+  async def score_tokens(self, shard: Shard, tokens, n_scored: int, top_n: int):
+    """Post-hoc logprobs for the last ``n_scored`` tokens (OpenAI logprobs).
+
+    One cache-less parallel forward over prompt+completion
+    (models/decoder.py score_last_tokens). Returns (chosen_logprobs [n],
+    top_ids [n, top_n], top_logprobs [n, top_n]) as numpy, or None when this
+    engine can't score (mesh serving modes hold no flat params; partial ring
+    shards lack the head)."""
+    if self._pp is not None or self.params is None or self.cfg is None:
+      return None
+    eff = self._effective_shard
+    if not (eff.is_first_layer and eff.is_last_layer):
+      return None
+    from ..models.decoder import score_last_tokens
+
+    toks = np.asarray(tokens, dtype=np.int32).reshape(-1)
+    S = int(toks.shape[0])
+    if n_scored <= 0 or n_scored >= S:
+      return None
+    pad_to = _round_up(S, PREFILL_BUCKET)
+    buf = np.zeros((1, pad_to), dtype=np.int32)
+    buf[0, :S] = toks
+    # n_scored and top_n are STATIC to the compiled program — bucket both so
+    # per-request completion lengths / top-N choices don't each trigger a
+    # full-forward recompile; the excess rows/columns slice off below.
+    n_bucket = min(_round_up(int(n_scored), 32), pad_to - 1)
+
+    def run():
+      out = score_last_tokens(self.params, self.cfg, eff, jnp.asarray(buf), jnp.int32(S), n_bucket, 20)
+      chosen_lp, top_ids, top_lp = (np.asarray(x) for x in out)
+      n, t = int(n_scored), max(int(top_n), 1)
+      return chosen_lp[-n:], top_ids[-n:, :t], top_lp[-n:, :t]
+
+    return await asyncio.get_event_loop().run_in_executor(self.executor, run)
+
   # Ring pipeline training (train/trainer.py ring section): partial-shard
   # spans — forward ships activations, backward applies this span's update.
 

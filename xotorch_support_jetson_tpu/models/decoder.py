@@ -931,6 +931,52 @@ def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_
   return last, pool
 
 
+# ------------------------------------------------------------- scoring
+# (OpenAI ``logprobs``): the serving fast paths return token ids only — one
+# readback per response is the whole point — so logprobs are recomputed
+# post-hoc in ONE parallel forward over prompt+completion, only when a client
+# asks. The head runs on just the scored positions' hidden states (full-
+# sequence logits would be [S, V] fp32 — ~2 GB at a 4K/128K-vocab request).
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_scored", "top_n"))
+def score_last_tokens(params, cfg: ModelConfig, shard: Shard, tokens, seq_len, n_scored: int, top_n: int):
+  """Logprobs of the last ``n_scored`` tokens of a [1, S_pad] sequence.
+
+  ``seq_len`` (traced) is the real length; padding beyond it is inert under
+  causal attention. Returns (chosen_logprob [n], top_ids [n, top_n],
+  top_logprobs [n, top_n]) — top-k always computed (static shape); callers
+  slice host-side. Full-model shards only.
+  """
+  h = embed_tokens(params, cfg, tokens)
+  inv_freq = rope_inv_freq(cfg)
+  B, S = tokens.shape
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+  def body(carry, lp):
+    h, _aux = carry
+    h, _, _, aux = _layer_step(h, lp, None, None, positions, positions[0], inv_freq, cfg, False)
+    return (h, _aux + aux), None
+
+  stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
+  for stack in stacks:
+    (h, _), _ = jax.lax.scan(body, (h, jnp.float32(0.0)), stack)
+
+  # Hidden states at positions [L-n-1, L-2] predict tokens [L-n, L-1].
+  # ``n_scored`` is BUCKETED by the caller (jax_engine.score_tokens) so one
+  # compiled program serves every completion length in a bucket; the clip
+  # keeps over-bucketed leading indices in range (their rows are garbage and
+  # the caller slices them off host-side).
+  idx = jnp.clip(seq_len - n_scored - 1 + jnp.arange(n_scored, dtype=jnp.int32), 0, tokens.shape[1] - 2)  # [n]
+  hs = jnp.take_along_axis(h, jnp.broadcast_to(idx[None, :, None], (1, n_scored, h.shape[-1])), axis=1)
+  logits = head_logits(params, cfg, hs)[0]  # [n, V]
+  logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+  chosen = jnp.take_along_axis(tokens[0], idx + 1, axis=0)  # [n]
+  chosen_lp = jnp.take_along_axis(logp, chosen[:, None], axis=1)[:, 0]
+  top_lp, top_ids = jax.lax.top_k(logp, top_n)
+  return chosen_lp, top_ids, top_lp
+
+
 def full_model_params(key: jax.Array, cfg: ModelConfig, model_id: str = "model", dtype=None) -> tuple[Params, Shard]:
   shard = Shard(model_id, 0, cfg.n_layers - 1, cfg.n_layers)
   return init_shard_params(key, cfg, shard, dtype=dtype), shard

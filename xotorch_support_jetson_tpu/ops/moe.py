@@ -118,6 +118,44 @@ def _moe_ffn_block(x, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, sel
   return out, load_balancing_loss(logits, idx, E)
 
 
+# Below this many tokens the gather path CAN replace the batched-einsum path:
+# decode steps route to k experts per token, and gathering just those experts'
+# weight slabs reads k·T/E of the expert bytes the einsum path streams (it
+# computes every expert's capacity block — ~32x extra HBM for deepseek-v3's
+# E=256, k=8 at batch 1). Exact only when nothing can drop, so it is gated on
+# capacity_factor=None (the inference default). OPT-IN (XOT_TPU_MOE_GATHER=1):
+# on the current v5e tunnel XLA lowers the expert gather to the same slow
+# irregular-read path as cache gathers (~35 GB/s vs ~450-550 GB/s for matmul
+# operand streams), so the einsum path WINS despite reading 10x the bytes —
+# measured 234 vs 117 tok/s on an E=64/k=6 decode. Revisit on hardware where
+# dynamic-gather streams at spec.
+from ..utils.helpers import env_flag as _env_flag
+
+MOE_GATHER_MAX = 32 if _env_flag("XOT_TPU_MOE_GATHER") else 0
+
+
+def _moe_ffn_gather(x, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, n_group, topk_group, group_mode):
+  """Decode-path MoE: gather the k active experts' weights per token.
+
+  [T, D] tokens with T small; reads only the routed experts' slabs (XLA
+  lowers ``jnp.take`` over the expert axis to a dynamic-gather — no full
+  [E, D, F] stream). Same routing as the einsum path, no capacity concept.
+  """
+  T, D = x.shape
+  E = w_gate.shape[0]
+  logits = x.astype(jnp.float32) @ w_router.astype(jnp.float32)
+  weights, idx = router_topk(logits, k, scoring, norm_topk, selection_bias, scale, n_group, topk_group, group_mode)
+  flat = idx.reshape(-1)  # [T·k]
+  g = jnp.take(w_gate, flat, axis=0).reshape(T, k, D, -1)
+  u = jnp.take(w_up, flat, axis=0).reshape(T, k, D, -1)
+  d = jnp.take(w_down, flat, axis=0).reshape(T, k, -1, D)
+  gated = jax.nn.silu(jnp.einsum("td,tjdf->tjf", x, g).astype(jnp.float32)).astype(x.dtype)
+  up = jnp.einsum("td,tjdf->tjf", x, u)
+  out_e = jnp.einsum("tjf,tjfd->tjd", gated * up, d)
+  out = jnp.einsum("tjd,tj->td", out_e.astype(jnp.float32), weights).astype(x.dtype)
+  return out, load_balancing_loss(logits, idx, E)
+
+
 def moe_ffn(
   x: jnp.ndarray,  # [T, D] tokens (flattened batch*seq)
   w_router: jnp.ndarray,  # [D, E]
@@ -139,17 +177,22 @@ def moe_ffn(
   """Routed SwiGLU FFN over ``E`` experts; returns [T, D] in x.dtype
   (or ``(out, aux_loss)`` with ``return_aux``).
 
-  Long token runs are processed in sequential chunks of ``chunk`` tokens so
-  the dispatch/combine one-hots stay O(chunk²·E) instead of O(T²·E) —
-  routing is per-token, so chunking is exact (with the default
-  ``capacity_factor=None``, capacity per chunk = chunk, nothing ever drops).
+  Small token runs (decode steps; T ≤ MOE_GATHER_MAX with the exact
+  ``capacity_factor=None``) take the weight-gather path — HBM reads scale
+  with the ACTIVE experts, not E. Long token runs are processed in
+  sequential chunks of ``chunk`` tokens so the dispatch/combine one-hots
+  stay O(chunk²·E) instead of O(T²·E) — routing is per-token, so chunking
+  is exact (with the default ``capacity_factor=None``, capacity per chunk =
+  chunk, nothing ever drops).
   """
   T, D = x.shape
 
   def block(xs):
     return _moe_ffn_block(xs, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, capacity_factor, n_group, topk_group, group_mode)
 
-  if T <= chunk:
+  if T <= MOE_GATHER_MAX and capacity_factor is None:
+    out, aux = _moe_ffn_gather(x, w_router, w_gate, w_up, w_down, k, scoring, norm_topk, selection_bias, scale, n_group, topk_group, group_mode)
+  elif T <= chunk:
     out, aux = block(x)
   else:
     pad = (-T) % chunk

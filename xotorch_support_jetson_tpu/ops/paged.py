@@ -199,7 +199,9 @@ def paged_kernel_supported(cfg, platform: str | None = None) -> bool:
   the kernel's page-clamped DMA pays off only on long, ragged caches."""
   import os
 
-  if os.getenv("XOT_TPU_NO_FLASH") or os.getenv("XOT_TPU_PAGED_KERNEL") != "1":
+  from ..utils.helpers import env_flag
+
+  if os.getenv("XOT_TPU_NO_FLASH") or not env_flag("XOT_TPU_PAGED_KERNEL"):
     return False
   platform = platform or jax.default_backend()
   return platform == "tpu" and not cfg.is_mla and cfg.head_dim in (64, 128, 256)

@@ -255,7 +255,9 @@ def flash_decode_supported(q_shape, kv_len: int, platform: str | None = None) ->
   The structural long-context lever is XOT_TPU_SP (parallel/sp_serving.py),
   which splits the wall across chips. Kernel kept for retuning on hardware
   where pallas DMA streams at spec."""
-  if os.getenv("XOT_TPU_NO_FLASH") or os.getenv("XOT_TPU_FLASH_DECODE") != "1":
+  from ..utils.helpers import env_flag
+
+  if os.getenv("XOT_TPU_NO_FLASH") or not env_flag("XOT_TPU_FLASH_DECODE"):
     return False
   platform = platform or jax.default_backend()
   B, Sq, Hq, hd = q_shape

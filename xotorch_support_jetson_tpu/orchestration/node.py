@@ -612,6 +612,17 @@ class Node:
     finally:
       self.outstanding_requests.pop(request_id, None)
 
+  async def score_tokens(self, base_shard: Shard, tokens, n_scored: int, top_n: int):
+    """Post-hoc logprobs for the API (`logprobs` request field): one parallel
+    forward over prompt+completion on THIS node. Only meaningful where the
+    full model lives (single-node serving); ring deployments return None and
+    the API omits logprobs (documented limitation)."""
+    shard = self.get_current_shard(base_shard)
+    scorer = getattr(self.inference_engine, "score_tokens", None)
+    if scorer is None or not (shard.is_first_layer and shard.is_last_layer):
+      return None
+    return await scorer(shard, tokens, n_scored, top_n)
+
   async def coordinate_save(self, base_shard: Shard, iteration: int, destination: str) -> None:
     """Save this node's shard checkpoint (reference node.py:230-252)."""
     shard = self.get_current_shard(base_shard)

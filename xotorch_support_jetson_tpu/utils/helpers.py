@@ -21,6 +21,15 @@ DEBUG = int(os.getenv("DEBUG", "0"))
 DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
 
 
+def env_flag(name: str, default: bool = False) -> bool:
+  """Boolean env var: unset → default; '', '0', 'false', 'no', 'off' (any
+  case) → False; anything else ('1', 'true', 'yes', ...) → True."""
+  val = os.getenv(name)
+  if val is None:
+    return default
+  return val.strip().lower() not in ("", "0", "false", "no", "off")
+
+
 def apply_platform_override() -> None:
   """Honor XOT_TPU_PLATFORM / JAX_PLATFORMS as the device override, parity
   with the reference's TORCH_DEVICE knob (sharded_inference_engine.py:58-65).
