@@ -33,3 +33,49 @@ async def test_engine_local_mesh_matches_single_device():
     ref2, _ = await plain.infer_tensor("a", shard, nxt, ref_state)
     mesh2, _ = await meshed.infer_tensor("a", shard, nxt, mesh_state)
     np.testing.assert_allclose(mesh2, ref2, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.asyncio
+async def test_engine_local_mesh_moe_ep_sharding_matches():
+  """MoE model through the serving mesh: the plan splits chips ep x tp,
+  expert weights shard over ep (GSPMD all-to-alls), and logits match the
+  single-device engine."""
+  cfg = tiny_test_config(
+    n_layers=2, n_experts=4, n_active_experts=2, moe_hidden_dim=32,
+    shared_expert_dim=32, first_k_dense=1,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(9), cfg, "moe-mesh")
+  tokens = np.array([[3, 14, 15, 92]], dtype=np.int32)
+
+  with jax.default_matmul_precision("highest"):
+    plain = JaxShardedInferenceEngine(use_local_mesh=False)
+    plain.load_test_model(shard, cfg, params)
+    ref_logits, ref_state = await plain.infer_tensor("a", shard, tokens)
+
+    meshed = JaxShardedInferenceEngine(use_local_mesh=True)
+    meshed.load_test_model(shard, cfg, params)
+    meshed._maybe_shard_over_local_mesh()
+    assert meshed.mesh is not None
+    assert meshed.mesh.shape["ep"] == 4  # 4 experts -> ep=4 on 8 devices
+    assert meshed.mesh.shape["tp"] == 2
+    mesh_logits, mesh_state = await meshed.infer_tensor("a", shard, tokens)
+    np.testing.assert_allclose(mesh_logits, ref_logits, rtol=2e-4, atol=2e-4)
+
+    nxt = np.argmax(ref_logits, axis=-1).astype(np.int32).reshape(1, 1)
+    ref2, _ = await plain.infer_tensor("a", shard, nxt, ref_state)
+    mesh2, _ = await meshed.infer_tensor("a", shard, nxt, mesh_state)
+    np.testing.assert_allclose(mesh2, ref2, rtol=2e-4, atol=2e-4)
+
+
+def test_inference_plan_ep_requires_expert_divisibility():
+  """A 60-expert model must not get ep=8 (60 % 8 != 0 would crash
+  device_put); the plan backs off to the largest dividing power of 2."""
+  from xotorch_support_jetson_tpu.parallel.mesh import inference_plan, pow2_degree
+
+  plan = inference_plan(8, n_heads=16, n_experts=60)
+  assert plan.ep == 4 and 60 % plan.ep == 0
+  assert plan.tp == 2 and plan.n_devices <= 8
+  assert inference_plan(8, n_heads=16, n_experts=64).ep == 8
+  assert inference_plan(8, n_heads=16, n_experts=0).ep == 1
+  assert pow2_degree(8, 3) == 2  # limit caps below device count
+  assert pow2_degree(6, 16) == 2  # degree must divide the device count

@@ -245,10 +245,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
         # an image request would otherwise crash mid-request on the missing
         # vision tower params.
         raise ValueError("XOT_TPU_PP pipeline serving does not support vision models yet")
-      tp = 1
-      limit = min(n // self.pp, self.cfg.n_heads)
-      while tp * 2 <= limit:
-        tp *= 2
+      from ..parallel.mesh import pow2_degree
+
+      tp = pow2_degree(n // self.pp, self.cfg.n_heads)
       self.mesh = build_mesh(MeshPlan(pp=self.pp, tp=tp))
       eff = getattr(self, "_effective_shard", self.shard)
       self._pp = PPServing(self.mesh, self.cfg, self.params, self.pp, eff.is_first_layer, eff.is_last_layer)
@@ -261,7 +260,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       return
     from ..parallel.mesh import build_mesh, inference_plan, shard_params
 
-    plan = inference_plan(len(jax.devices()), n_heads=self.cfg.n_heads)
+    plan = inference_plan(len(jax.devices()), n_heads=self.cfg.n_heads, n_experts=self.cfg.n_experts or 0)
     self.mesh = build_mesh(plan)
     self.params = shard_params(self.params, self.mesh)
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import signal
 import sys
 import time
@@ -67,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
   # the TPU-pod alternative to the gRPC ring (which remains the path for
   # heterogeneous/loose clusters).
   parser.add_argument("--jax-coordinator", type=str, default=None, help="host:port of process 0 (enables jax.distributed)")
+  # Mesh serving modes (flag form of the XOT_TPU_PP / XOT_TPU_SP env vars —
+  # the engine reads the env, so the flags just set them before it loads).
+  parser.add_argument("--pp", type=int, default=None, help="serve the loaded layer range as N pipeline stages over local chips")
+  parser.add_argument("--sp", type=int, default=None, help="shard the KV cache over N local chips (long-context serving)")
   parser.add_argument("--jax-num-processes", type=int, default=None)
   parser.add_argument("--jax-process-id", type=int, default=None)
   return parser
@@ -287,6 +292,15 @@ async def async_main(args) -> None:
 
 def run() -> None:
   args = build_parser().parse_args()
+  if args.pp and args.sp:
+    # The engine serves in exactly one mesh mode; a silent pick would leave
+    # the operator believing both splits are active.
+    print("error: --pp and --sp are mutually exclusive serving modes", file=sys.stderr)
+    sys.exit(2)
+  if args.pp:
+    os.environ["XOT_TPU_PP"] = str(args.pp)
+  if args.sp:
+    os.environ["XOT_TPU_SP"] = str(args.sp)
   maybe_init_jax_distributed(args)
   try:
     asyncio.run(async_main(args))

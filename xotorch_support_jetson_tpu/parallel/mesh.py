@@ -64,24 +64,33 @@ def auto_plan(n_devices: int | None = None, n_kv_heads: int | None = None) -> Me
   replicates KV, so remaining chips go to DP.
   """
   n = n_devices if n_devices is not None else len(jax.devices())
-  tp = 1
-  limit = n_kv_heads or n
-  while tp * 2 <= min(n, limit):
-    tp *= 2
+  tp = pow2_degree(n, n_kv_heads or n)
   dp = n // tp
   return MeshPlan(dp=dp, tp=tp)
 
 
-def inference_plan(n_devices: int | None = None, n_heads: int | None = None) -> MeshPlan:
-  """Serving plan for one request stream: pure TP (batch is tiny, so DP
-  would idle). TP caps at the q-head count; GSPMD replicates GQA KV heads
-  when tp exceeds them."""
+def pow2_degree(n_devices: int, *limits: int, divides: int | None = None) -> int:
+  """Largest power of 2 ≤ n_devices and every limit, that divides n_devices
+  (and ``divides`` when given — e.g. an expert count the axis must split)."""
+  d = 1
+  while d * 2 <= min(n_devices, *limits) and n_devices % (d * 2) == 0 and (divides is None or divides % (d * 2) == 0):
+    d *= 2
+  return d
+
+
+def inference_plan(n_devices: int | None = None, n_heads: int | None = None, n_experts: int = 0) -> MeshPlan:
+  """Serving plan for one request stream: pure TP for dense models (batch is
+  tiny, so DP would idle; TP caps at the q-head count and GSPMD replicates
+  GQA KV heads when tp exceeds them). MoE models split the chips ep × tp —
+  expert weights are the bulk of a big-E model's bytes, and sharding them
+  over ep divides per-chip HBM where extra TP would only shrink the already
+  small per-chip matmuls (the dispatch/combine einsums become GSPMD
+  all-to-alls on the ep axis)."""
   n = n_devices if n_devices is not None else len(jax.devices())
-  tp = 1
-  limit = n_heads or n
-  while tp * 2 <= min(n, limit):
-    tp *= 2
-  return MeshPlan(tp=tp)
+  # ep must divide the expert count (the [E, ...] leaves shard over it).
+  ep = pow2_degree(n, n_experts, divides=n_experts) if n_experts else 1
+  tp = pow2_degree(n // ep, n_heads or n)
+  return MeshPlan(ep=ep, tp=tp)
 
 
 # ---------------------------------------------------------------- shardings
