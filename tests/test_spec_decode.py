@@ -120,3 +120,98 @@ def test_spec_decode_eos_trim_matches_reference():
   params_d, shard_d = full_model_params(jax.random.PRNGKey(42), cfg_d, "d")
   got = _spec_tokens(cfg, params, shard, cfg_d, params_d, shard_d, prompt, 16, (eos,), 3)
   assert got == ref
+
+
+def test_spec_chunk_chain_is_exact():
+  """Streaming speculative chunks (models/decoder.py fused_speculative_chunk)
+  chained through the DEVICE-side seed/pos must reproduce plain greedy
+  token-for-token across chunk boundaries, for any draft."""
+  from xotorch_support_jetson_tpu.models.decoder import fused_speculative_chunk
+
+  cfg = tiny_test_config(n_layers=4, max_seq_len=128)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  params_d = jax.tree.map(lambda x: x, full_model_params(jax.random.PRNGKey(77), cfg, "m")[0])  # unrelated draft
+  prompt = np.array([[5, 9, 2, 71, 33]], dtype=np.int32)
+  ref = _greedy_reference(cfg, params, shard, prompt, 24, eos_ids=(-1,))
+
+  B, S = prompt.shape
+  cache_t = init_kv_cache(cfg, shard.n_shard_layers, B, cfg.max_seq_len)
+  cache_d = init_kv_cache(cfg, shard.n_shard_layers, B, cfg.max_seq_len)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+  logits, cache_t = shard_forward(params, cfg, shard, jnp.asarray(prompt), positions, cache_t)
+  _, cache_d = shard_forward(params_d, cfg, shard, jnp.asarray(prompt), positions, cache_d)
+  token = jnp.argmax(logits[:, S - 1, :], axis=-1).astype(jnp.int32)[:, None]
+  got = [int(token[0, 0])]
+  pos = jnp.int32(S)
+  for _ in range(4):  # 4 chunks of 6 = ref's 24 steps
+    packed, token, pos, cache_t, cache_d = fused_speculative_chunk(
+      params, cfg, shard, params_d, token, cache_t, cache_d, pos, steps=8, gamma=3, n_limit=6
+    )
+    row = np.asarray(packed)
+    m = int(row[0])
+    assert 1 <= m <= 6
+    got.extend(int(t) for t in row[1 : 1 + m])
+  assert got == ref[: len(got)]
+  assert len(got) >= 1 + 4 * 1
+
+
+@pytest.mark.asyncio
+async def test_engine_streaming_spec_chunks_match_plain():
+  """The engine's pipelined chunk path under XOT_TPU_SPEC_DECODE=int8:
+  dispatch N+1 before reading N (exactly like the node's loop), tokens must
+  equal the plain engine's chunked stream."""
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  cfg = tiny_test_config(n_layers=4, max_seq_len=128)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  prompt = np.array([[5, 9, 2, 71, 33]], dtype=np.int32)
+
+  async def drive_to_exhaustion(engine, rid, chunk):
+    """The node's pipelined loop (dispatch N+1 before reading N) until the
+    engine refuses for lack of cache room."""
+    logits, _ = await engine.infer_tensor(rid, shard, prompt)
+    first = int(np.argmax(logits, -1)[0])
+    out = [first]
+    pending = await engine.dispatch_chunk(rid, shard, chunk, 0.0, 35, first_token=first)
+    while pending is not None:
+      nxt = await engine.dispatch_chunk(rid, shard, chunk, 0.0, 35)
+      out.extend(await engine.read_chunk(pending))
+      pending = nxt
+    return out
+
+  plain = JaxShardedInferenceEngine(use_local_mesh=False)
+  plain.load_test_model(shard, cfg, params)
+  ref = await drive_to_exhaustion(plain, "a", 8)
+
+  spec = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="int8")
+  spec.load_test_model(shard, cfg, params)
+  # First dispatch must actually take the spec path.
+  logits2, _ = await spec.infer_tensor("probe", shard, prompt)
+  h = spec._dispatch_chunk_sync("probe", shard, 8, 0.0, 35, int(np.argmax(logits2, -1)[0]))
+  assert isinstance(h, tuple) and h[0] == "spec"
+
+  # FULL stream to cache exhaustion, including the near-cache-end handoff to
+  # the plain path with an unread (possibly truncated) spec chunk in flight:
+  # the whole stream must be token-identical to the plain engine's, and both
+  # must fill the cache to the same final position.
+  got = await drive_to_exhaustion(spec, "b", 8)
+  assert got == ref
+  assert spec.sessions["b"].curr_pos == plain.sessions["a"].curr_pos <= cfg.max_seq_len
+
+  # Mixed chunk sizes (the node shrinks n_steps near the token budget):
+  # larger unread buckets must be accounted at THEIR size, not the current one.
+  spec2 = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="int8")
+  spec2.load_test_model(shard, cfg, params)
+  logits3, _ = await spec2.infer_tensor("c", shard, prompt)
+  first3 = int(np.argmax(logits3, -1)[0])
+  got2 = [first3]
+  sizes = [16, 16, 4, 4, 2, 8, 16, 2]
+  pending = await spec2.dispatch_chunk("c", shard, sizes[0], 0.0, 35, first_token=first3)
+  i = 1
+  while pending is not None:
+    nxt = await spec2.dispatch_chunk("c", shard, sizes[i % len(sizes)], 0.0, 35)
+    i += 1
+    got2.extend(await spec2.read_chunk(pending))
+    pending = nxt
+  assert got2 == ref[: len(got2)]
+  assert spec2.sessions["c"].curr_pos <= cfg.max_seq_len

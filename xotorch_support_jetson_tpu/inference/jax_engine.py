@@ -71,7 +71,10 @@ def _decode_step(params, cfg, shard, x, kv_cache, pos):
 
 
 class _Session:
-  __slots__ = ("kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch", "prompt_np", "draft_cache")
+  __slots__ = (
+    "kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch", "prompt_np", "draft_cache",
+    "spec_seed_dev", "spec_pos_dev", "spec_known_pos", "spec_inflight_slots",
+  )
 
   def __init__(self, kv_cache, max_seq: int, epoch: int = 0) -> None:
     self.kv_cache = kv_cache
@@ -82,6 +85,17 @@ class _Session:
     self.epoch = epoch  # replay epoch (elastic recovery, node._retry_request)
     self.prompt_np = None  # prompt token ids (speculative draft prefill)
     self.draft_cache = None  # lazily-built draft KV cache (speculative mode)
+    # Streaming speculative chain (models/decoder.py fused_speculative_chunk):
+    # seed token and position stay ON DEVICE so chunk N+1 dispatches from
+    # chunk N's lazy outputs with no host round-trip. The host tracks a
+    # CONFIRMED position (updated as chunks are read) plus the summed
+    # worst-case slot consumption of dispatched-but-unread chunks (each
+    # chunk's own steps+gamma+1 — buckets can differ per chunk) for
+    # conservative cache-room checks.
+    self.spec_seed_dev = None
+    self.spec_pos_dev = None
+    self.spec_known_pos = 0
+    self.spec_inflight_slots = 0
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -474,10 +488,77 @@ class JaxShardedInferenceEngine(InferenceEngine):
       self.executor, self._dispatch_chunk_sync, request_id, shard, n_steps, temp, top_k, first_token
     )
 
+  def _spec_chunk_eligible(self, session, temp, first_token) -> bool:
+    """Streaming speculative chain: greedy single-stream requests with the
+    int8 self-draft, entered right after prefill and continued on-device."""
+    if self._draft_params is None or (temp is not None and float(temp) > 0.0):
+      return False
+    if session.spec_seed_dev is not None:
+      return True  # chain already active
+    return (
+      first_token is not None
+      and session.prompt_np is not None
+      and session.prompt_np.shape[0] == 1
+      and session.curr_pos == session.prompt_len  # fresh after prefill
+    )
+
+  def _dispatch_spec_chunk_sync(self, request_id, shard, n_steps, first_token, steps: int):
+    """One streaming speculative chunk (models/decoder.py
+    fused_speculative_chunk). The seed token and position ride the DEVICE
+    chain, so the node's pipelined dispatch (enqueue N+1 before reading N)
+    works without a host round-trip. EOS handling stays host-side exactly
+    like plain chunks (the node trims and stops)."""
+    from ..models.decoder import fused_speculative_chunk
+
+    session = self.sessions[request_id]
+    if session.spec_seed_dev is None:
+      self._ensure_draft_cache(session, shard)
+      session.spec_known_pos = session.curr_pos
+      token = jnp.full((1, 1), int(first_token), dtype=jnp.int32)
+      pos = jnp.int32(session.curr_pos)
+    else:
+      token = session.spec_seed_dev
+      pos = session.spec_pos_dev
+    worst = steps + self.spec_gamma + 1
+    packed, seed, new_pos, session.kv_cache, session.draft_cache = fused_speculative_chunk(
+      self.params, self.cfg, shard, self._draft_params, token, session.kv_cache, session.draft_cache,
+      pos, steps, gamma=self.spec_gamma, n_limit=min(n_steps, steps),
+    )
+    session.spec_seed_dev = seed
+    session.spec_pos_dev = new_pos
+    session.spec_inflight_slots += worst
+    session.next_token_dev = None  # plain chain broken while spec is active
+    return ("spec", request_id, worst, packed)
+
   def _dispatch_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
+    shard = getattr(self, "_effective_shard", shard)
+    session = self.sessions[request_id]
+    if self._pp is None and self._spec_chunk_eligible(session, temp, first_token):
+      G = self.spec_gamma
+      steps = min(1 << (max(n_steps, 1) - 1).bit_length(), 256)  # bucketed compile size
+      # Conservative room bound: confirmed position + every unread chunk's
+      # own worst case + this chunk's worst case. Before the chain starts
+      # the confirmed position is simply curr_pos.
+      base = session.spec_known_pos if session.spec_seed_dev is not None else session.curr_pos
+      if base + session.spec_inflight_slots + (steps + G + 1) + 1 <= session.max_seq:
+        return self._dispatch_spec_chunk_sync(request_id, shard, n_steps, first_token, steps)
+      if session.spec_seed_dev is not None:
+        # Near the cache end: sync the exact chain position once and hand the
+        # stream to the plain path, which trims precisely at max_seq. Stale
+        # spec handles read after this point must not touch the bookkeeping
+        # (read_chunk checks spec_seed_dev) — the synced position already
+        # includes every dispatched chunk.
+        session.curr_pos = int(np.asarray(session.spec_pos_dev))
+        session.spec_known_pos = session.curr_pos
+        session.next_token_dev = session.spec_seed_dev
+        session.spec_seed_dev = None
+        session.spec_pos_dev = None
+        session.spec_inflight_slots = 0
+    return self._dispatch_plain_chunk_sync(request_id, shard, n_steps, temp, top_k, first_token)
+
+  def _dispatch_plain_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
     from ..models.decoder import fused_decode
 
-    shard = getattr(self, "_effective_shard", shard)
     session = self.sessions[request_id]
     n_steps = min(n_steps, session.max_seq - session.curr_pos)
     if n_steps <= 0:
@@ -576,26 +657,32 @@ class JaxShardedInferenceEngine(InferenceEngine):
     session.next_token_dev = None  # chain broken: next chunk must re-seed
     return toks
 
+  def _ensure_draft_cache(self, session, shard) -> None:
+    """Draft prefill over the prompt (the draft never saw it): pad like the
+    target prefill so the compiled program is shared across prompts."""
+    from ..models.decoder import init_kv_cache
+
+    if session.draft_cache is not None:
+      return
+    B, S = session.prompt_np.shape
+    cache = init_kv_cache(self.cfg, shard.n_shard_layers, B, session.max_seq)
+    pad_to = min(_round_up(S, PREFILL_BUCKET), session.max_seq)
+    x_in = np.zeros((B, pad_to), dtype=np.int32)
+    x_in[:, :S] = session.prompt_np
+    lens = jnp.full((B,), S, dtype=jnp.int32)
+    _, session.draft_cache = _prefill(self._draft_params, self.cfg, shard, jnp.asarray(x_in), self._place_cache(cache), lens)
+
   def _generate_speculative_sync(self, request_id, shard, first_token, max_steps, eos_ids):
     """Greedy speculative oneshot: int8 self-draft + bf16 target fused in one
     while_loop program (models/decoder.py fused_speculative_generate).
     Output is exactly the plain-greedy tokens; only the speed differs."""
-    from ..models.decoder import fused_speculative_generate, init_kv_cache
+    from ..models.decoder import fused_speculative_generate
 
     session = self.sessions[request_id]
     room = session.max_seq - session.curr_pos
     limit = min(max_steps, room - self.spec_gamma - 1)  # caller guarantees > 0
     steps = min(1 << (limit - 1).bit_length(), room - self.spec_gamma - 1)
-    if session.draft_cache is None:
-      # Draft prefill over the prompt (the draft never saw it): pad like the
-      # target prefill so the compiled program is shared across prompts.
-      B, S = session.prompt_np.shape
-      cache = init_kv_cache(self.cfg, shard.n_shard_layers, B, session.max_seq)
-      pad_to = min(_round_up(S, PREFILL_BUCKET), session.max_seq)
-      x_in = np.zeros((B, pad_to), dtype=np.int32)
-      x_in[:, :S] = session.prompt_np
-      lens = jnp.full((B,), S, dtype=jnp.int32)
-      _, session.draft_cache = _prefill(self._draft_params, self.cfg, shard, jnp.asarray(x_in), self._place_cache(cache), lens)
+    self._ensure_draft_cache(session, shard)
     token = jnp.full((1, 1), int(first_token), dtype=jnp.int32)
     eos = tuple(sorted(int(e) for e in eos_ids))
     buf, n, _rounds, session.kv_cache, session.draft_cache = fused_speculative_generate(
@@ -617,7 +704,26 @@ class JaxShardedInferenceEngine(InferenceEngine):
   async def read_chunk(self, handle) -> list[int]:
     if handle is None:
       return []
-    return await asyncio.get_event_loop().run_in_executor(self.executor, lambda: [int(t) for t in np.asarray(handle)[0]])
+
+    def read():
+      if isinstance(handle, tuple) and handle[0] == "spec":
+        # Packed speculative chunk: [m, tokens...] in one fetch. Confirm the
+        # chain position host-side (the room bound tightens back up) — but
+        # ONLY while the chain is still active: after the near-cache-end
+        # handoff curr_pos is already exact (it includes this chunk), and a
+        # stale update would desync it from the device.
+        _, request_id, worst, packed = handle
+        row = np.asarray(packed)
+        m = int(row[0])
+        session = self.sessions.get(request_id)
+        if session is not None and session.spec_seed_dev is not None:
+          session.spec_known_pos += m
+          session.spec_inflight_slots = max(session.spec_inflight_slots - worst, 0)
+          session.curr_pos = session.spec_known_pos
+        return [int(t) for t in row[1 : 1 + m]]
+      return [int(t) for t in np.asarray(handle)[0]]
+
+    return await asyncio.get_event_loop().run_in_executor(self.executor, read)
 
   def get_batched_server(self):
     """Lazy continuous-batching scheduler (inference/batch_scheduler.py);

@@ -292,15 +292,16 @@ async def async_main(args) -> None:
 
 def run() -> None:
   args = build_parser().parse_args()
-  if args.pp and args.sp:
-    # The engine serves in exactly one mesh mode; a silent pick would leave
-    # the operator believing both splits are active.
-    print("error: --pp and --sp are mutually exclusive serving modes", file=sys.stderr)
-    sys.exit(2)
   if args.pp:
     os.environ["XOT_TPU_PP"] = str(args.pp)
   if args.sp:
     os.environ["XOT_TPU_SP"] = str(args.sp)
+  # The engine serves in exactly one mesh mode; a silent pick would leave the
+  # operator believing both splits are active. Check the EFFECTIVE settings —
+  # the flags are just aliases for the env vars, which may also be exported.
+  if int(os.environ.get("XOT_TPU_PP", "0") or 0) > 1 and int(os.environ.get("XOT_TPU_SP", "0") or 0) > 1:
+    print("error: --pp/XOT_TPU_PP and --sp/XOT_TPU_SP are mutually exclusive serving modes", file=sys.stderr)
+    sys.exit(2)
   maybe_init_jax_distributed(args)
   try:
     asyncio.run(async_main(args))

@@ -701,6 +701,42 @@ def fused_speculative_generate(
   )
 
 
+@partial(jax.jit, static_argnames=("cfg", "shard", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
+def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_limit, steps: int, gamma: int, eos_ids: tuple, cfg: ModelConfig, shard: Shard):
+  buf, n, _rounds, cache_t, cache_d = _fused_spec_generate_impl(
+    params_t, params_d, cfg, cfg, shard, shard, cache_t, cache_d, token, pos, steps, gamma, eos_ids, n_limit
+  )
+  m = jnp.minimum(n, n_limit)
+  # [m, tokens...] in ONE array: the host learns the count and the tokens in
+  # a single fetch (a separate scalar fetch costs a full tunnel RTT).
+  packed = jnp.concatenate([m[None], buf])
+  # The chain stays ON DEVICE: seed = last emitted token, pos advances by m —
+  # the next chunk can dispatch before this one is ever read back.
+  seed = jnp.where(m > 0, buf[jnp.maximum(m - 1, 0)], token[0, 0]).reshape(1, 1)
+  return packed, seed, pos + m, cache_t, cache_d
+
+
+def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, token, cache_t, cache_d, pos, steps: int, gamma: int = 4, eos_ids: tuple = (), n_limit=None):
+  """One STREAMING speculative chunk with a device-resident chain.
+
+  Same math as ``fused_speculative_generate`` (greedy, exact vs plain greedy
+  for any draft) bounded to ``steps`` emitted tokens. Returns
+  (packed [1+steps+gamma+1] int32 = [m, tokens...], seed [1,1], new_pos [],
+  cache_t, cache_d) — seed/new_pos are lazy device values, so the engine can
+  dispatch chunk N+1 from chunk N's outputs with no host round-trip, and the
+  node's pipelined chunk loop works unchanged (jax_engine
+  ``_dispatch_chunk_sync``). EOS inside the chunk shortens ``m`` via the
+  while_loop's done flag; positions past ``m`` in the packed buffer are
+  speculative garbage the host discards.
+  """
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("speculative decoding requires full-model shards")
+  limit = jnp.int32(steps if n_limit is None else n_limit)
+  return _fused_spec_chunk_impl(
+    params_t, params_d, token, cache_t, cache_d, jnp.int32(pos) if not hasattr(pos, "dtype") else pos, limit, int(steps), int(gamma), tuple(eos_ids), cfg, shard
+  )
+
+
 # ------------------------------------------------------- batched serving
 # (inference/batch_scheduler.py): a fixed pool of batch rows ("slots"), each
 # holding one request. Shapes stay static — prefill scatters one row into the
