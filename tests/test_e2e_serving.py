@@ -185,3 +185,56 @@ async def test_logprobs_real_engine(serving_stack):
   finally:
     await client.close()
     await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_spec_decode_through_api(tiny_model_dir, monkeypatch):
+  """XOT_TPU_SPEC_DECODE=int8 end-to-end through the node's pipelined chunk
+  loop and the SSE API: the stream must match the plain daemon's output AND
+  deliver the full token budget (speculative chunks return m <= n_steps, so
+  the node must re-dispatch when speculation under-delivers)."""
+  monkeypatch.setenv("XOT_TPU_MODEL_DIR", str(tiny_model_dir))
+  monkeypatch.setenv("XOT_TPU_DECODE_CHUNK", "8")
+
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.download.downloader import HFShardDownloader
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  async def run_once(spec):
+    downloader = HFShardDownloader()
+    engine = JaxShardedInferenceEngine(downloader, use_local_mesh=False, spec_decode=spec)
+    node = Node(
+      "spec-node" if spec else "plain-node", StubServer(), engine, NoDiscovery(), downloader,
+      RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=64, default_sample_temp=0.0,
+    )
+    api = ChatGPTAPI(node, "JaxShardedInferenceEngine", response_timeout=120, default_model="llama-3.2-1b")
+    await node.start()
+    client = TestClient(TestServer(api.app))
+    await client.start_server()
+    try:
+      body = {"model": "llama-3.2-1b", "messages": [{"role": "user", "content": "hello world"}], "stream": True, "max_tokens": 24}
+      resp = await client.post("/v1/chat/completions", json=body)
+      assert resp.status == 200, await resp.text()
+      acc = ""
+      async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: ") or line == "data: [DONE]":
+          continue
+        chunk = json.loads(line[len("data: "):])
+        if "error" in chunk:
+          raise AssertionError(chunk)
+        acc += chunk["choices"][0].get("delta", {}).get("content", "")
+      # Token count via the blocking path (truthful usage).
+      resp = await client.post("/v1/chat/completions", json={**body, "stream": False})
+      usage = (await resp.json())["usage"]
+      return acc, usage
+    finally:
+      await client.close()
+      await node.stop()
+
+  plain_text, plain_usage = await run_once(None)
+  spec_text, spec_usage = await run_once("int8")
+  assert spec_text == plain_text
+  assert spec_usage["completion_tokens"] == plain_usage["completion_tokens"] == 24

@@ -227,9 +227,29 @@ def _save_tiny_hf(tmp_path, family: str):
       tie_word_embeddings=False,
       torch_dtype="float32",
     )
+  elif family == "gemma2":
+    cfg = AutoConfig.for_model(
+      "gemma2",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=3,  # layers 0/2 sliding, layer 1 global (HF: even layers slide)
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      head_dim=16,
+      query_pre_attn_scalar=24.0,  # != head_dim: exercises the scale override
+      attn_logit_softcapping=50.0,
+      final_logit_softcapping=30.0,
+      sliding_window=4,  # < len(TOKENS[0]): the window actually masks
+      rms_norm_eps=1e-5,
+      rope_theta=10000.0,
+      tie_word_embeddings=True,
+      torch_dtype="float32",
+      attn_implementation="eager",  # sdpa paths skip softcapping
+    )
   else:
     raise ValueError(family)
-  model = AutoModelForCausalLM.from_config(cfg)
+  model = AutoModelForCausalLM.from_config(cfg, attn_implementation="eager") if family == "gemma2" else AutoModelForCausalLM.from_config(cfg)
   model = model.to(torch.float32).eval()
   model.save_pretrained(tmp_path, safe_serialization=True)
   with torch.no_grad():
@@ -252,6 +272,7 @@ def _save_tiny_hf(tmp_path, family: str):
     "deepseek-v2",
     "deepseek-v2-yarn",
     "deepseek-v3",
+    "gemma2",
   ],
 )
 def test_golden_logits_vs_hf(tmp_path, family):
@@ -313,3 +334,43 @@ def test_sharded_load_from_index(tmp_path):
   last = Shard("tiny", 1, cfg.n_layers - 1, cfg.n_layers)
   params_last = load_shard_weights(tmp_path, cfg, last)
   assert "embed" not in params_last and "final_norm" in params_last and "lm_head" in params_last
+
+
+def test_gemma2_cached_decode_matches_cacheless():
+  """Gemma2 through the CACHED serving path (slot cache + fused greedy
+  decode) == a cache-less argmax rollout — the sliding window and softcaps
+  behave identically against cache slots and fresh keys."""
+  import jax
+
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache
+
+  cfg = tiny_test_config(
+    n_layers=3, post_norms=True, mlp_act="gelu_tanh", attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=24.0, sliding_window=4,
+    embed_scale=8.0, tied_embedding=True, max_seq_len=64,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(6), cfg, "tiny-gemma")
+  assert "post_attn_norm" in params["layers"] and "is_sliding" in params["layers"]
+  assert list(np.asarray(params["layers"]["is_sliding"])) == [1.0, 0.0, 1.0]
+
+  prompt = [3, 25, 99, 7, 41]
+  S = len(prompt)
+  # Cache-less greedy rollout.
+  seq = list(prompt)
+  for _ in range(8):
+    toks = jnp.asarray([seq], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(len(seq), dtype=jnp.int32), (1, len(seq)))
+    logits, _ = shard_forward(params, cfg, shard, toks, pos, None)
+    seq.append(int(np.argmax(np.asarray(logits)[0, -1])))
+  ref = seq[S:]
+
+  # Cached path: prefill + fused greedy decode.
+  cache = init_kv_cache(cfg, cfg.n_layers, 1, 64)
+  toks = jnp.asarray([prompt], jnp.int32)
+  pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  logits, cache = shard_forward(params, cfg, shard, toks, pos, cache)
+  first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+  out, _ = fused_decode(params, cfg, shard, first, cache, jnp.full((1,), S, jnp.int32), 7)
+  got = [int(first[0, 0])] + [int(t) for t in np.asarray(out)[0]]
+  assert got == ref

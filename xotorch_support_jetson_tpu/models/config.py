@@ -111,10 +111,31 @@ class ModelConfig:
   qk_nope_head_dim: int = 0
   qk_rope_head_dim: int = 0
   v_head_dim: int = 0
+  # --- gemma2: pre+post norms around each block, GeGLU (tanh-gelu) MLP,
+  # tanh softcapping on attention scores and final logits, sqrt(dim) embed
+  # scaling, attention scale from query_pre_attn_scalar, and alternating
+  # sliding-window attention (even layers sliding in HF's Gemma2).
+  post_norms: bool = False
+  mlp_act: str = "silu"  # "silu" | "gelu_tanh"
+  attn_logit_softcap: float = 0.0  # 0 ⇒ off
+  final_logit_softcap: float = 0.0
+  query_pre_attn_scalar: float = 0.0  # 0 ⇒ scale by 1/sqrt(qk head dim)
+  sliding_window: int = 0  # 0 ⇒ global attention everywhere
+  embed_scale: float = 1.0  # gemma multiplies embeddings by sqrt(dim)
   # --- vision (llava): CLIP tower + projector config (models/vision.py) and
   # the placeholder token id the HF processor expands per image patch.
   vision: Any = None  # VisionConfig | None (Any keeps this module torch/vision-free)
   image_token_id: int = -1
+
+  def layer_is_sliding(self, layer_idx: int) -> bool:
+    """HF Gemma2: even-indexed layers use the sliding window."""
+    return self.sliding_window > 0 and layer_idx % 2 == 0
+
+  @property
+  def plain_attention(self) -> bool:
+    """No per-config attention variations (softcap/window/scale override) —
+    the single gate for Pallas kernels, which implement none of them."""
+    return not self.attn_logit_softcap and not self.sliding_window and not self.query_pre_attn_scalar
 
   @property
   def is_mla(self) -> bool:
@@ -199,6 +220,8 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     family = "deepseek-v3"
   elif "deepseek_v2" in model_type or "deepseekv2" in arch:
     family = "deepseek-v2"
+  elif "gemma2" in model_type or "gemma2" in arch:
+    family = "gemma2"
 
   rope_scaling = None
   rs = hf.get("rope_scaling")
@@ -305,6 +328,20 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
       v_head_dim=int(hf["v_head_dim"]),
     )
 
+  gemma: dict[str, Any] = {}
+  if family == "gemma2":
+    import math
+
+    gemma = dict(
+      post_norms=True,
+      mlp_act="gelu_tanh",
+      attn_logit_softcap=float(hf.get("attn_logit_softcapping") or 0.0),
+      final_logit_softcap=float(hf.get("final_logit_softcapping") or 0.0),
+      query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar") or 0.0),
+      sliding_window=int(hf.get("sliding_window") or 0),
+      embed_scale=math.sqrt(float(hf["hidden_size"])),
+    )
+
   n_heads = int(hf["num_attention_heads"])
   return ModelConfig(
     vocab_size=int(hf["vocab_size"]),
@@ -320,7 +357,7 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     max_seq_len=int(hf.get("max_position_embeddings", 8192)),
     qkv_bias=family in ("qwen2", "qwen2-moe") or bool(hf.get("attention_bias", False)),
     partial_rotary_factor=float(hf.get("partial_rotary_factor", 1.0)),
-    tied_embedding=bool(hf.get("tie_word_embeddings", family == "qwen2" and int(hf["hidden_size"]) < 2048)),
+    tied_embedding=bool(hf.get("tie_word_embeddings", family in ("gemma2",) or (family == "qwen2" and int(hf["hidden_size"]) < 2048))),
     family=family,
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
     eos_token_ids=tuple(int(e) for e in eos),
@@ -328,6 +365,7 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     image_token_id=image_token_id,
     **moe,
     **mla,
+    **gemma,
   )
 
 

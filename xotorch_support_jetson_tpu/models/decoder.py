@@ -140,7 +140,13 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
     return leaves
 
   def dense_stack(L):
-    return {**attn_leaves(L), "w_gate": w(next(keys), L, D, F), "w_up": w(next(keys), L, D, F), "w_down": w(next(keys), L, F, D)}
+    stack = {**attn_leaves(L), "w_gate": w(next(keys), L, D, F), "w_up": w(next(keys), L, D, F), "w_down": w(next(keys), L, F, D)}
+    if cfg.post_norms:  # gemma2's post-attention / post-feedforward norms
+      stack["post_attn_norm"] = jnp.ones((L, D), dtype=dtype)
+      stack["post_mlp_norm"] = jnp.ones((L, D), dtype=dtype)
+    if cfg.sliding_window:
+      stack["is_sliding"] = jnp.asarray([1.0 if cfg.layer_is_sliding(shard.start_layer + i) else 0.0 for i in range(L)], jnp.float32)
+    return stack
 
   params: Params = {}
   if cfg.n_experts:
@@ -268,6 +274,26 @@ def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
   return q, k, v
 
 
+def _mlp_act(x, cfg: ModelConfig):
+  if cfg.mlp_act == "gelu_tanh":  # gemma2's gelu_pytorch_tanh
+    return jax.nn.gelu(x.astype(jnp.float32), approximate=True)
+  return jax.nn.silu(x.astype(jnp.float32))
+
+
+def _attn_opts(cfg: ModelConfig, layer_sliding=None) -> dict:
+  """Attention kwargs a config implies (gemma2's scale override, logit
+  softcap, sliding window — the window rides a per-layer traced flag)."""
+  opts: dict = {}
+  if cfg.query_pre_attn_scalar:
+    opts["scale"] = 1.0 / cfg.query_pre_attn_scalar**0.5
+  if cfg.attn_logit_softcap:
+    opts["logit_softcap"] = cfg.attn_logit_softcap
+  if cfg.sliding_window and layer_sliding is not None:
+    # Traced per-layer window: huge (== no-op) on global-attention layers.
+    opts["sliding_window"] = jnp.where(layer_sliding > 0, cfg.sliding_window, jnp.int32(2**30))
+  return opts
+
+
 def _mlp_block(h, p, cfg: ModelConfig):
   """Post-attention norm + FFN (dense or MoE+shared-expert). Returns (h, aux)."""
   B, S, D = h.shape
@@ -310,8 +336,11 @@ def _mlp_block(h, p, cfg: ModelConfig):
       out = out + shared
     h = h + out.reshape(B, S, D)
   else:
-    gated = jax.nn.silu(_mm(x, p, "w_gate").astype(jnp.float32)).astype(h.dtype) * _mm(x, p, "w_up")
-    h = h + _mm(gated, p, "w_down")
+    gated = _mlp_act(_mm(x, p, "w_gate"), cfg).astype(h.dtype) * _mm(x, p, "w_up")
+    out = _mm(gated, p, "w_down")
+    if "post_mlp_norm" in p:  # gemma2 post-feedforward layernorm
+      out = rms_norm(out, p["post_mlp_norm"], cfg.norm_eps)
+    h = h + out
   return h, aux
 
 
@@ -360,27 +389,41 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
       v_cache = _write_cache(v_cache, v, start)
       from ..ops.pallas_attention import flash_attention_prefill, flash_decode_attention, flash_decode_supported, flash_supported
 
-      if S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
+      # The Pallas kernels don't implement gemma2's softcap/sliding window.
+      if cfg.plain_attention and S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
         # Prefill on TPU: flash kernel against the full cache (stale slots
         # beyond the prompt are positionally masked — slot index > position).
         attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=positions[:, 0])
-      elif S == 1 and not cfg.is_mla and flash_decode_supported(q.shape, k_cache.shape[1]):
+      elif cfg.plain_attention and S == 1 and not cfg.is_mla and flash_decode_supported(q.shape, k_cache.shape[1]):
         # Long-cache decode step via the split-K flash-decode kernel —
         # opt-in; see flash_decode_supported for the measured rationale.
         attn = flash_decode_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions)
       else:
-        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions)
+        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions, **_attn_opts(cfg, p.get("is_sliding")))
     else:
-      attn = (attn_fn or (lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp)))(q, k, v, positions, positions[0])
+      if attn_fn is not None and not cfg.plain_attention:
+        # Fail loudly: the ring-attention override computes plain attention
+        # and would silently drop softcap/sliding-window/scale.
+        raise NotImplementedError("the attention override (ring sp) does not support gemma2 attention options")
+      default_attn = lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp, **_attn_opts(cfg, p.get("is_sliding")))  # noqa: E731
+      attn = (attn_fn or default_attn)(q, k, v, positions, positions[0])
 
-  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+  if "post_attn_norm" in p:  # gemma2 post-attention layernorm
+    attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
+  h = h + attn_out
   h, aux = _mlp_block(h, p, cfg)
   return h, k_cache, v_cache, aux
 
 
 def embed_tokens(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
   """Token ids [B,S] → embeddings [B,S,D] in model dtype."""
-  return jnp.take(params["embed"], x, axis=0).astype(cfg.dtype)
+  h = jnp.take(params["embed"], x, axis=0).astype(cfg.dtype)
+  if cfg.embed_scale != 1.0:
+    # gemma scales embeddings by sqrt(dim), with HF casting the scalar to the
+    # model dtype first (bf16 rounding is part of the checkpoint contract).
+    h = h * jnp.asarray(cfg.embed_scale, dtype=cfg.dtype)
+  return h
 
 
 def head_logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
@@ -391,13 +434,17 @@ def head_logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray
   """
   h = rms_norm(h, params["final_norm"], cfg.norm_eps)
   if "lm_head_scale" in params:
-    return qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
-  w_out = params.get("lm_head")
-  if w_out is None:
-    w_out = params["embed"].T  # tied embeddings, single-params case
-  # Keep operands in model dtype on the MXU; accumulate fp32. (Casting the
-  # [D,V] head to fp32 would double its HBM traffic on every decode step.)
-  return jax.lax.dot_general(h, w_out.astype(h.dtype), (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    logits = qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
+  else:
+    w_out = params.get("lm_head")
+    if w_out is None:
+      w_out = params["embed"].T  # tied embeddings, single-params case
+    # Keep operands in model dtype on the MXU; accumulate fp32. (Casting the
+    # [D,V] head to fp32 would double its HBM traffic on every decode step.)
+    logits = jax.lax.dot_general(h, w_out.astype(h.dtype), (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+  if cfg.final_logit_softcap:
+    logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
+  return logits
 
 
 def shard_forward(
@@ -844,11 +891,14 @@ def _paged_layer_step(h, p, k_pool, v_pool, block_tables, positions, inv_freq, c
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
     k_pool = write_token_kv(k_pool, k[:, 0], block_tables, pos, page_size)
     v_pool = write_token_kv(v_pool, v[:, 0], block_tables, pos, page_size)
-    if use_kernel:
+    if use_kernel and cfg.plain_attention:  # the Pallas kernel has no softcap/window
       attn = paged_decode_attention(q[:, 0], k_pool, v_pool, block_tables, lengths, page_size)[:, None]
     else:
-      attn = paged_gqa_attention_ref(q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size)
-  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
+      attn = paged_gqa_attention_ref(q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size, **_attn_opts(cfg, p.get("is_sliding")))
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+  if "post_attn_norm" in p:  # gemma2
+    attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
+  h = h + attn_out
   h, _ = _mlp_block(h, p, cfg)
   return h, k_pool, v_pool
 

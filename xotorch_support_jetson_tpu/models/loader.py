@@ -51,6 +51,11 @@ _LAYER_MAP: dict[str, tuple[str, bool]] = {
   "self_attn.kv_a_layernorm.weight": ("kv_a_norm", False),
   "self_attn.kv_b_proj.weight": ("wkv_b", True),
   "post_attention_layernorm.weight": ("mlp_norm", False),
+  # gemma2's four-norm layout: input_layernorm/post_attention_layernorm wrap
+  # attention (the latter remapped to post_attn_norm below when
+  # cfg.post_norms), pre/post_feedforward_layernorm wrap the MLP.
+  "pre_feedforward_layernorm.weight": ("mlp_norm", False),
+  "post_feedforward_layernorm.weight": ("post_mlp_norm", False),
   "mlp.gate_proj.weight": ("w_gate", True),
   "mlp.up_proj.weight": ("w_up", True),
   "mlp.down_proj.weight": ("w_down", True),
@@ -204,6 +209,8 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
           mapped = _LAYER_MAP.get(suffix)
           if mapped is not None:
             key, transpose = mapped
+            if cfg.post_norms and suffix == "post_attention_layernorm.weight":
+              key = "post_attn_norm"  # gemma2: this norm follows attention
             arr = _to_numpy(f.get_tensor(raw_name))
             per_layer[layer_idx][key] = arr.T if transpose else arr
             continue
@@ -241,12 +248,20 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
   all_idx = range(shard.start_layer, shard.end_layer + 1)
   groups = [("layers", [i for i in all_idx if i < first_k]), ("moe_layers", [i for i in all_idx if i >= first_k])]
 
+  _norm_keys = ("attn_norm", "post_attn_norm", "mlp_norm", "post_mlp_norm")
+
   def as_leaf(t, key: str):
     if isinstance(t, dict):  # experts: {e → [D,F]} → [E, D, F]
       if sorted(t) != list(range(len(t))):
         raise ValueError(f"{key}: missing expert tensors (have {sorted(t)})")
       t = np.stack([t[e] for e in range(len(t))])
     dtype = jnp.float32 if key == "router_bias" else cfg.dtype
+    if cfg.post_norms and key in _norm_keys:
+      # gemma stores zero-centered norm weights; HF computes x*(1+w.float())
+      # in fp32, so the gain must stay fp32 — a bf16(1+w) round-trip loses
+      # any |w| < 2^-8 entirely (rms_norm upcasts, so fp32 gains are exact).
+      t = np.asarray(t, dtype=np.float32) + 1.0
+      dtype = jnp.float32
     return jnp.asarray(np.ascontiguousarray(t), dtype=dtype)
 
   params: Params = {}
@@ -259,6 +274,10 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
       if missing:
         raise ValueError(f"layer {idx}: missing tensors {sorted(missing)}")
     params[stack_name] = {key: jnp.stack([as_leaf(per_layer[i][key], key) for i in indices]) for key in layer_keys}
+    if cfg.sliding_window and stack_name == "layers":
+      # Per-layer sliding flag from the GLOBAL layer index, riding the stack
+      # so the lax.scan sees it as a traced per-layer scalar.
+      params[stack_name]["is_sliding"] = jnp.asarray([1.0 if cfg.layer_is_sliding(i) else 0.0 for i in indices], jnp.float32)
   if shard.is_first_layer:
     params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)
     if vision_layers:  # llava: vision tower + projector ride with shard 0
@@ -272,7 +291,11 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
       }
       params["projector"] = {k: jnp.asarray(v, dtype=cfg.dtype) for k, v in projector.items()}
   if shard.is_last_layer:
-    params["final_norm"] = jnp.asarray(top["final_norm"], dtype=cfg.dtype)
+    fn = top["final_norm"]
+    if cfg.post_norms:  # gemma zero-centered gain; fp32 like the layer norms
+      params["final_norm"] = jnp.asarray(np.asarray(fn, dtype=np.float32) + 1.0, dtype=jnp.float32)
+    else:
+      params["final_norm"] = jnp.asarray(fn, dtype=cfg.dtype)
     if "lm_head" in top:
       params["lm_head"] = jnp.asarray(top["lm_head"], dtype=cfg.dtype)
     elif cfg.tied_embedding:

@@ -27,22 +27,32 @@ def gqa_attention(
   v: jnp.ndarray,  # [B, Skv, Hkv, hd]
   q_positions: jnp.ndarray,  # [B, Sq] absolute positions of queries
   kv_positions: jnp.ndarray,  # [Skv] absolute positions (slot indices) of keys
+  scale: float | None = None,
+  logit_softcap: float = 0.0,
+  sliding_window=None,  # int or traced scalar; None ⇒ global attention
 ) -> jnp.ndarray:
   """Returns [B, Sq, Hq, hd_v]; softmax in fp32; output in q.dtype.
 
-  ``v``'s head dim may differ from q/k's (MLA: qk 192, v 128); the scale is
-  always 1/sqrt(qk head dim).
+  ``v``'s head dim may differ from q/k's (MLA: qk 192, v 128); the default
+  scale is 1/sqrt(qk head dim) (gemma2 overrides via query_pre_attn_scalar).
+  ``logit_softcap`` applies gemma2's ``cap·tanh(s/cap)`` before masking;
+  ``sliding_window`` restricts each query to the last W kv positions.
   """
   B, Sq, Hq, hd = q.shape
   Hkv = k.shape[2]
   hd_v = v.shape[3]
   group = Hq // Hkv
-  scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
+  if scale is None:
+    scale = 1.0 / float(hd) ** 0.5
 
   qg = q.reshape(B, Sq, Hkv, group, hd)
   # scores: [B, Hkv, group, Sq, Skv]
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32)) * scale
+  if logit_softcap:
+    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
   mask = kv_positions[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,Sq,Skv]
+  if sliding_window is not None:
+    mask = mask & (kv_positions[None, None, None, None, :] > q_positions[:, None, None, :, None] - sliding_window)
   scores = jnp.where(mask, scores, NEG_INF)
   probs = jax.nn.softmax(scores, axis=-1)
   out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))

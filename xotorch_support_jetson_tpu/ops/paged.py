@@ -73,13 +73,15 @@ def gather_pages(pool_l: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
   return jnp.swapaxes(g, 2, 3).reshape(B, mp * ps, Hkv, hd)
 
 
-def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int) -> jnp.ndarray:
-  """Reference paged decode attention via gather (q [B, 1, Hq, hd])."""
+def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, **attn_opts) -> jnp.ndarray:
+  """Reference paged decode attention via gather (q [B, 1, Hq, hd]).
+  ``attn_opts`` forward gemma2's scale/softcap/sliding-window
+  (models/decoder.py _attn_opts)."""
   k = gather_pages(k_pool_l, block_tables)
   v = gather_pages(v_pool_l, block_tables)
   kv_positions = jnp.arange(k.shape[1], dtype=jnp.int32)
   q_positions = (lengths - 1)[:, None]  # current token's position
-  return gqa_attention(q, k, v, q_positions, kv_positions)
+  return gqa_attention(q, k, v, q_positions, kv_positions, **attn_opts)
 
 
 def paged_mla_attention_ref(q_nope, q_pe, k_pool_l, v_pool_l, block_tables, lengths, w_kv_b, v_dim: int, page_size: int) -> jnp.ndarray:

@@ -452,6 +452,15 @@ class Node:
       if done:
         break
       pending = nxt
+      if pending is None:
+        # Variable-size chunks (speculative decoding returns m <= n_steps
+        # tokens) can under-deliver the speculatively-sized schedule: if
+        # budget remains but nothing is in flight, dispatch a continuation
+        # now (one non-overlapped dispatch only when speculation fell short).
+        tokens, _ = self.buffered_token_output[request_id]
+        remaining = max_tokens - len(tokens)
+        if remaining > 0:
+          pending = await engine.dispatch_chunk(request_id, shard, min(chunk, remaining), temp, top_k)
 
     self._finish_request(request_id)
     # Ensure listeners see a finish even on cache exhaustion.

@@ -58,16 +58,24 @@ def _partial_stats(scores):
   return m, jnp.sum(p, axis=-1, keepdims=True), p
 
 
-def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local):
-  """q [B,Sq,Hq,hd]; k/v local chunk [B,Skv_loc,Hkv,hd] → merged [B,Sq,Hq,hd]."""
+def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=None, logit_softcap: float = 0.0, sliding_window=None):
+  """q [B,Sq,Hq,hd]; k/v local chunk [B,Skv_loc,Hkv,hd] → merged [B,Sq,Hq,hd].
+  The gemma2 options (softcap before masking, window into the mask) commute
+  with the cross-rank merge — each rank's partials see the same scores a
+  single device would."""
   B, Sq, Hq, hd = q.shape
   Hkv = k_loc.shape[2]
   hd_v = v_loc.shape[3]
   group = Hq // Hkv
-  scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=jnp.float32))
+  if scale is None:
+    scale = 1.0 / float(hd) ** 0.5
   qg = q.reshape(B, Sq, Hkv, group, hd)
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k_loc.astype(jnp.float32)) * scale
+  if logit_softcap:
+    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
   mask = kv_positions_local[None, None, None, None, :] <= q_positions[:, None, None, :, None]
+  if sliding_window is not None:
+    mask = mask & (kv_positions_local[None, None, None, None, :] > q_positions[:, None, None, :, None] - sliding_window)
   scores = jnp.where(mask, scores, NEG_INF)
   m, l, p = _partial_stats(scores)  # [B,Hkv,g,Sq,1], p [B,Hkv,g,Sq,Skv]
   acc = jnp.einsum("bhgqk,bkhd->bhgqd", p, v_loc.astype(jnp.float32))
@@ -145,13 +153,18 @@ def _sp_layer_step(h, p, k_cache, v_cache, positions, rank_offset, inv_freq, cfg
       _mla_w_kv_b(p, h.dtype), positions, kv_positions_local, cfg.v_head_dim,
     )
   else:
+    from ..models.decoder import _attn_opts
+
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
     k_cache = _write_chunk(k_cache, k, start, rank_offset)
     v_cache = _write_chunk(v_cache, v, start, rank_offset)
-    attn = _sp_gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions_local)
+    attn = _sp_gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions_local, **_attn_opts(cfg, p.get("is_sliding")))
   from ..models.decoder import _mm
 
-  h = h + _mm(attn.reshape(B, S, -1), p, "wo")
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+  if "post_attn_norm" in p:  # gemma2
+    attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
+  h = h + attn_out
   h, _ = _mlp_block(h, p, cfg)
   return h, k_cache, v_cache
 

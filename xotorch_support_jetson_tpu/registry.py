@@ -84,6 +84,11 @@ _CARDS: list[ModelCard] = [
   _card("nemotron-70b", 80, "Nemotron 70B", "llama", "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF"),
   # phi
   _card("phi-4-mini-instruct", 32, "Phi-4 Mini Instruct", "phi3", "microsoft/Phi-4-mini-instruct"),
+  # gemma2 — the reference lists these display names but its dense-only llama
+  # builder could never load them (four-norm layers, GeGLU, softcapping,
+  # sliding window); here the general decoder runs them (models/decoder.py).
+  _card("gemma2-9b", 42, "Gemma2 9B", "gemma2", "google/gemma-2-9b-it"),
+  _card("gemma2-27b", 46, "Gemma2 27B", "gemma2", "google/gemma-2-27b-it"),
 ]
 
 model_cards: dict[str, ModelCard] = {c.model_id: c for c in _CARDS}
