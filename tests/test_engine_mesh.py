@@ -1,6 +1,7 @@
 """Engine in-slice TP: sharded-over-mesh engine must match single-device."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -79,3 +80,35 @@ def test_inference_plan_ep_requires_expert_divisibility():
   assert inference_plan(8, n_heads=16, n_experts=0).ep == 1
   assert pow2_degree(8, 3) == 2  # limit caps below device count
   assert pow2_degree(6, 16) == 2  # degree must divide the device count
+
+
+def test_batched_decode_over_local_mesh_matches():
+  """The pooled batch-decode path with GSPMD-sharded params (use_local_mesh
+  TP) == the unsharded pool: the batched server composes with in-slice TP."""
+  from xotorch_support_jetson_tpu.models.decoder import fused_batch_decode, init_kv_cache, prefill_into_slot
+  from xotorch_support_jetson_tpu.parallel.mesh import build_mesh, inference_plan, shard_params
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=128)
+  params, shard = full_model_params(jax.random.PRNGKey(12), cfg, "m")
+  mesh = build_mesh(inference_plan(8, n_heads=cfg.n_heads))
+  sharded = shard_params(jax.tree.map(jnp.copy, params), mesh)
+
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5]]
+  outs = []
+  with jax.default_matmul_precision("highest"):
+    for p in (params, sharded):
+      cache = init_kv_cache(cfg, cfg.n_layers, 2, 64)
+      firsts = []
+      for r, prompt in enumerate(prompts):
+        pad = np.zeros((1, 16), np.int32)
+        pad[0, : len(prompt)] = prompt
+        last, cache = prefill_into_slot(p, cfg, shard, jnp.asarray(pad), cache, jnp.int32(r), jnp.int32(len(prompt)))
+        firsts.append(int(np.argmax(np.asarray(last)[0])))
+      tok = jnp.asarray([[f] for f in firsts], jnp.int32)
+      pos = jnp.asarray([len(x) for x in prompts], jnp.int32)
+      act = jnp.ones((2,), bool)
+      temps = jnp.zeros((2,), jnp.float32)
+      toks, _, _ = fused_batch_decode(p, cfg, shard, tok, cache, pos, act, temps, 10)
+      outs.append((firsts, np.asarray(toks)))
+  assert outs[0][0] == outs[1][0]
+  assert np.array_equal(outs[0][1], outs[1][1])

@@ -161,3 +161,23 @@ async def test_engine_pp_mode_matches_plain_engine():
   ref_toks = await plain.generate_oneshot("a", shard, int(cur[0, 0]), 5, eos_ids=(-1,), temp=0.0)
   pp_toks = await pped.generate_oneshot("a", shard, int(cur[0, 0]), 5, eos_ids=(-1,), temp=0.0)
   assert ref_toks == pp_toks
+
+
+@pytest.mark.parametrize("plan", [MeshPlan(pp=2), MeshPlan(pp=2, tp=2)])
+def test_pp_serving_dense_prefix_moe_matches(plan):
+  """Deepseek-style dense-prefix MoE (+MLA) through PP serving: the prefix
+  runs replicated on every stage, the MoE stack pipelines — token-identical
+  to the single-device engine."""
+  cfg = tiny_test_config(
+    n_layers=5, max_seq_len=64, n_heads=4, n_kv_heads=4,
+    n_experts=4, n_active_experts=2, moe_hidden_dim=32, shared_expert_dim=32,
+    first_k_dense=1,  # 1 dense prefix layer + 4 pipelined MoE layers
+    kv_lora_rank=16, q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(15), cfg, "ds-pp")
+  prompt = np.array([[3, 25, 9, 77]], dtype=np.int32)
+  with jax.default_matmul_precision("highest"):
+    ref_first, ref_toks = _reference_tokens(cfg, params, shard, prompt, 10)
+    pp_first, pp_toks = _pp_tokens(cfg, params, shard, prompt, 10, plan)
+  assert np.array_equal(ref_first, pp_first)
+  assert np.array_equal(ref_toks, pp_toks)

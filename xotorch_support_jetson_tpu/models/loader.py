@@ -346,6 +346,12 @@ def check_shard_params(params: Params, cfg: ModelConfig, shard: Shard) -> None:
     }
     if cfg.qkv_bias:
       exp.update({"bq": (L, cfg.q_dim), "bk": (L, cfg.kv_dim), "bv": (L, cfg.kv_dim)})
+    if cfg.post_norms:  # gemma2: the decoder gates on key presence, so a
+      # missing post-norm must fail HERE, not silently skip the norm.
+      exp["post_attn_norm"] = (L, cfg.dim)
+      exp["post_mlp_norm"] = (L, cfg.dim)
+    if cfg.sliding_window:
+      exp["is_sliding"] = (L,)
     return exp
 
   checks: dict[str, dict] = {}

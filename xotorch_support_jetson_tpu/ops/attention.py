@@ -48,15 +48,25 @@ def gqa_attention(
   qg = q.reshape(B, Sq, Hkv, group, hd)
   # scores: [B, Hkv, group, Sq, Skv]
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32)) * scale
-  if logit_softcap:
-    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
-  mask = kv_positions[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,Sq,Skv]
-  if sliding_window is not None:
-    mask = mask & (kv_positions[None, None, None, None, :] > q_positions[:, None, None, :, None] - sliding_window)
-  scores = jnp.where(mask, scores, NEG_INF)
+  scores = cap_and_mask_scores(scores, q_positions, kv_positions, logit_softcap, sliding_window)
   probs = jax.nn.softmax(scores, axis=-1)
   out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
   return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)
+
+
+def cap_and_mask_scores(scores, q_positions, kv_positions, logit_softcap: float = 0.0, sliding_window=None):
+  """Shared softcap + causal/window masking for [B,Hkv,g,Sq,Skv] scores —
+  ONE implementation so the sp-serving partial-stat path (which merges
+  online-softmax stats across ranks) stays bit-consistent with this one.
+  Softcap applies BEFORE masking (HF gemma2 order)."""
+  if logit_softcap:
+    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+  kv = kv_positions[None, None, None, None, :]  # [1,1,1,1,Skv]
+  qp = q_positions[:, None, None, :, None]  # [B,1,1,Sq,1]
+  mask = kv <= qp
+  if sliding_window is not None:
+    mask = mask & (kv > qp - sliding_window)
+  return jnp.where(mask, scores, NEG_INF)
 
 
 def mla_absorbed_attention(

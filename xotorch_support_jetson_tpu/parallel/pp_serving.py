@@ -24,9 +24,10 @@ latency win — same as the reference's ring, minus the per-hop serialization.)
 The cache is layer-sharded over pp (axis 0), so each stage holds only its
 layer range's KV — cache capacity also scales with P.
 
-Supports single-stack models (dense families, or MoE with no dense prefix).
-Dense-prefix MoE (deepseek first_k_dense) would need per-stage heterogeneous
-pytrees; use the cluster ring or TP for those.
+Dense-prefix MoE models (deepseek first_k_dense) pipeline their MoE stack;
+the 1-3 dense prefix layers run REPLICATED on every stage before the tick
+loop (negligible compute, and it keeps the pipeline single-stack) with a
+pp-replicated prefix cache.
 """
 
 from __future__ import annotations
@@ -47,24 +48,34 @@ _HEAD_KEYS = ("embed", "final_norm", "lm_head", "lm_head_scale")
 def split_pp_params(params: dict, n_stages: int) -> tuple[str, dict, dict]:
   """Carve shard params into (stack_name, stage stack [P, L/P, ...], head).
 
-  The head dict carries only the embed/final-norm/lm-head leaves the pp
-  program needs (replicated over pp; tp-sharded under GSPMD as usual).
+  The head dict carries the embed/final-norm/lm-head leaves the pp program
+  needs (replicated over pp; tp-sharded under GSPMD as usual) — plus, for
+  dense-prefix MoE models (deepseek's first_k_dense), the whole PREFIX stack
+  under ``"prefix_layers"``: those 1-3 layers run replicated on every stage
+  before the pipeline (their compute is negligible next to the MoE stack,
+  and replicating them keeps the tick loop single-stack).
   """
   stacks = [n for n in ("layers", "moe_layers") if n in params]
-  if len(stacks) != 1:
-    raise ValueError(f"pp serving needs a single layer stack (dense, or MoE without a dense prefix); params have {stacks}")
-  stack = params[stacks[0]]
+  head = {k: params[k] for k in _HEAD_KEYS if k in params}
+  if len(stacks) == 2:
+    head["prefix_layers"] = params["layers"]
+    stack_name = "moe_layers"
+  elif len(stacks) == 1:
+    stack_name = stacks[0]
+  else:
+    raise ValueError(f"pp serving: params have no layer stacks ({stacks})")
+  stack = params[stack_name]
   L = next(iter(stack.values())).shape[0]
   if L % n_stages:
-    raise ValueError(f"shard has {L} layers, not divisible by pp={n_stages}")
+    raise ValueError(f"shard has {L} pipelined layers, not divisible by pp={n_stages}")
   stage_params = {k: v.reshape(n_stages, L // n_stages, *v.shape[1:]) for k, v in stack.items()}
-  head = {k: params[k] for k in _HEAD_KEYS if k in params}
-  return stacks[0], stage_params, head
+  return stack_name, stage_params, head
 
 
 def place_pp_params(stage_params: dict, head: dict, mesh: Mesh, stack_name: str) -> tuple[dict, dict]:
   """device_put: stage leaves [P, L/P, ...] over pp (+tp per the megatron
-  specs with the stage axis prepended); head leaves per the top-level specs."""
+  specs with the stage axis prepended); head leaves per the top-level specs
+  (a dense-prefix stack rides the head, replicated over pp, tp per specs)."""
   from .mesh import decoder_param_specs
 
   full = decoder_param_specs()
@@ -73,7 +84,13 @@ def place_pp_params(stage_params: dict, head: dict, mesh: Mesh, stack_name: str)
     k: jax.device_put(v, NamedSharding(mesh, P("pp", *layer_specs.get(k, P()))))
     for k, v in stage_params.items()
   }
-  head_placed = {k: jax.device_put(v, NamedSharding(mesh, full.get(k, P()))) for k, v in head.items()}
+  head_placed = {}
+  for k, v in head.items():
+    if k == "prefix_layers":
+      pre_specs = full["layers"]
+      head_placed[k] = {pk: jax.device_put(pv, NamedSharding(mesh, pre_specs.get(pk, P()))) for pk, pv in v.items()}
+    else:
+      head_placed[k] = jax.device_put(v, NamedSharding(mesh, full.get(k, P())))
   return stage_placed, head_placed
 
 
@@ -144,6 +161,23 @@ def _pp_tick_loop(stage_layers: dict, h0: jnp.ndarray, positions: jnp.ndarray, c
   return h_final, cache
 
 
+def _run_prefix(head: dict, h: jnp.ndarray, positions: jnp.ndarray, cache: dict, cfg: ModelConfig):
+  """Dense-prefix layers (deepseek first_k_dense), REPLICATED on every stage:
+  params and the ``*_pre`` cache are pp-replicated, so all ranks compute the
+  same result before the masked-stage pipeline starts."""
+  if "prefix_layers" not in head:
+    return h, cache
+  h, pre = _stage_forward(head["prefix_layers"], h, positions, {"k": cache["k_pre"], "v": cache["v_pre"]}, rope_inv_freq(cfg), cfg)
+  return h, {**cache, "k_pre": pre["k"], "v_pre": pre["v"]}
+
+
+def _full_forward(stage_layers: dict, head: dict, h0: jnp.ndarray, positions: jnp.ndarray, cache: dict, cfg: ModelConfig, n_stages: int, gather_pos=None):
+  """Replicated dense prefix (if any) + the masked-stage pipeline."""
+  h0, cache = _run_prefix(head, h0, positions, cache, cfg)
+  h, moe_cache = _pp_tick_loop(stage_layers, h0, positions, {"k": cache["k"], "v": cache["v"]}, cfg, n_stages, gather_pos=gather_pos)
+  return h, {**cache, **moe_cache}
+
+
 class PPServing:
   """Compiled pipeline-parallel serving programs for one loaded shard.
 
@@ -172,6 +206,8 @@ class PPServing:
     self.n_stages = n_stages
     self.is_first = is_first
     self.is_last = is_last
+    # Dense-prefix MoE (deepseek): the prefix rides the head, replicated.
+    self.n_prefix = next(iter(params["layers"].values())).shape[0] if ("layers" in params and "moe_layers" in params) else 0
     stack_name, stage_params, head = split_pp_params(params, n_stages)
     self.stage_params, self.head = place_pp_params(stage_params, head, mesh, stack_name)
     self._cache_spec = pp_cache_spec(cfg, mesh)
@@ -179,22 +215,38 @@ class PPServing:
     self._build()
 
   def place_cache(self, cache: dict) -> dict:
+    """Engine cache [L_total, ...] → pp placement. With a dense prefix the
+    first n_prefix layers split off as replicated ``*_pre`` buffers; the
+    pipelined layers shard over pp."""
     sharding = NamedSharding(self.mesh, self._cache_spec)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
+    if not self.n_prefix:
+      return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
+    repl = NamedSharding(self.mesh, P(*[None] * cache["k"].ndim))
+    n = self.n_prefix
+    return {
+      "k_pre": jax.device_put(cache["k"][:n], repl),
+      "v_pre": jax.device_put(cache["v"][:n], repl),
+      "k": jax.device_put(cache["k"][n:], sharding),
+      "v": jax.device_put(cache["v"][n:], sharding),
+    }
 
   # ------------------------------------------------------------- programs
 
   def _build(self) -> None:
     cfg, n_stages = self.cfg, self.n_stages
     is_first, is_last = self.is_first, self.is_last
-    cache_spec = P("pp")
+    # Per-key cache specs: pipelined layers shard over pp; a dense prefix's
+    # buffers are replicated (every stage computes the prefix identically).
+    cache_spec = {"k": P("pp"), "v": P("pp")}
+    if self.n_prefix:
+      cache_spec = {**cache_spec, "k_pre": P(), "v_pre": P()}
     stage_spec = P("pp")
 
     def make_forward_sm(gather_last: bool):
       def forward_sm(stage_params, head, x, positions, cache, prompt_len):
         stage_layers = {k: v[0] for k, v in stage_params.items()}  # [1, L/P, ...] -> [L/P, ...]
         h0 = embed_tokens(head, cfg, x) if (is_first and x.ndim == 2) else x.astype(cfg.dtype)
-        h, cache = _pp_tick_loop(stage_layers, h0, positions, cache, cfg, n_stages, gather_pos=prompt_len if gather_last else None)
+        h, cache = _full_forward(stage_layers, head, h0, positions, cache, cfg, n_stages, gather_pos=prompt_len if gather_last else None)
         return h, cache
 
       return forward_sm
@@ -224,7 +276,7 @@ class PPServing:
         def body(carry, _):
           tok, pos, cache, key = carry
           h0 = embed_tokens(head, cfg, tok)
-          h, cache = _pp_tick_loop(stage_layers, h0, pos[:, None], cache, cfg, n_stages)
+          h, cache = _full_forward(stage_layers, head, h0, pos[:, None], cache, cfg, n_stages)
           logits = head_logits(head, cfg, h)[:, 0, :]
           nxt, key = _next_token(logits, key, greedy, temp, top_k)
           return (nxt[:, None], pos + 1, cache, key), nxt
@@ -254,7 +306,7 @@ class PPServing:
         def body(carry):
           tok, pos, cache, key, buf, i, done = carry
           h0 = embed_tokens(head, cfg, tok)
-          h, cache = _pp_tick_loop(stage_layers, h0, pos[:, None], cache, cfg, n_stages)
+          h, cache = _full_forward(stage_layers, head, h0, pos[:, None], cache, cfg, n_stages)
           logits = head_logits(head, cfg, h)[:, 0, :]
           nxt, key = _next_token(logits, key, greedy, temp, top_k)
           buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))

@@ -71,12 +71,9 @@ def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=No
     scale = 1.0 / float(hd) ** 0.5
   qg = q.reshape(B, Sq, Hkv, group, hd)
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k_loc.astype(jnp.float32)) * scale
-  if logit_softcap:
-    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
-  mask = kv_positions_local[None, None, None, None, :] <= q_positions[:, None, None, :, None]
-  if sliding_window is not None:
-    mask = mask & (kv_positions_local[None, None, None, None, :] > q_positions[:, None, None, :, None] - sliding_window)
-  scores = jnp.where(mask, scores, NEG_INF)
+  from ..ops.attention import cap_and_mask_scores
+
+  scores = cap_and_mask_scores(scores, q_positions, kv_positions_local, logit_softcap, sliding_window)
   m, l, p = _partial_stats(scores)  # [B,Hkv,g,Sq,1], p [B,Hkv,g,Sq,Skv]
   acc = jnp.einsum("bhgqk,bkhd->bhgqd", p, v_loc.astype(jnp.float32))
   l_g, acc_g = _merge_stats(m, l, acc)
