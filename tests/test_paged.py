@@ -241,7 +241,7 @@ def test_scheduler_parks_starved_admission_until_pages_free(monkeypatch):
     assert out == expected[i], f"req {i}: {out} != {expected[i]}"
 
 
-@pytest.mark.parametrize("flavor", ["int8", "moe", "mla"])
+@pytest.mark.parametrize("flavor", ["int8", "moe", "mla", "gemma2"])
 def test_paged_decode_covers_engine_modes(flavor):
   """int8-quantized, MoE, and MLA (latent-cache) models through the paged
   decode == their dense batch decode."""
@@ -254,10 +254,17 @@ def test_paged_decode_covers_engine_modes(flavor):
   elif flavor == "moe":
     cfg = tiny_test_config(n_layers=2, max_seq_len=128, n_experts=4, n_active_experts=2, moe_hidden_dim=32, first_k_dense=1)
     params, shard = full_model_params(KEY, cfg)
-  else:
+  elif flavor == "mla":
     cfg = tiny_test_config(
       n_layers=2, max_seq_len=128, n_heads=4, n_kv_heads=4, kv_lora_rank=16,
       q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+    )
+    params, shard = full_model_params(KEY, cfg)
+  else:  # gemma2: softcaps + alternating sliding window through the page pool
+    cfg = tiny_test_config(
+      n_layers=2, max_seq_len=128, post_norms=True, mlp_act="gelu_tanh",
+      attn_logit_softcap=50.0, final_logit_softcap=30.0, query_pre_attn_scalar=24.0,
+      sliding_window=4, embed_scale=8.0, tied_embedding=True,
     )
     params, shard = full_model_params(KEY, cfg)
 

@@ -23,6 +23,11 @@ MLA = tiny_test_config(
   n_layers=2, max_seq_len=128, n_heads=4, n_kv_heads=4, kv_lora_rank=16,
   q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
 )
+GEMMA = tiny_test_config(
+  n_layers=2, max_seq_len=128, post_norms=True, mlp_act="gelu_tanh",
+  attn_logit_softcap=50.0, final_logit_softcap=30.0, query_pre_attn_scalar=24.0,
+  sliding_window=4, embed_scale=8.0, tied_embedding=True,
+)
 
 
 def _reference(params, cfg, shard, prompt, n_steps):
@@ -36,7 +41,7 @@ def _reference(params, cfg, shard, prompt, n_steps):
   return int(first[0, 0]), np.asarray(toks)[0]
 
 
-@pytest.mark.parametrize("cfg,sp_n", [(DENSE, 2), (DENSE, 4), (MLA, 2), (MLA, 4)])
+@pytest.mark.parametrize("cfg,sp_n", [(DENSE, 2), (DENSE, 4), (MLA, 2), (MLA, 4), (GEMMA, 2)])
 def test_sp_serving_matches_single_device(cfg, sp_n):
   params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "tiny")
   prompt = [3, 25, 9, 77, 2]

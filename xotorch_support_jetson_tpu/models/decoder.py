@@ -155,7 +155,9 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
     Lm, E, Fm, Fs = L - n_dense, cfg.n_experts, cfg.moe_hidden_dim, cfg.shared_expert_dim
     if n_dense:
       params["layers"] = dense_stack(n_dense)
+    moe_start = shard.start_layer + n_dense
     moe = {
+      **({"is_sliding": jnp.asarray([1.0 if cfg.layer_is_sliding(moe_start + i) else 0.0 for i in range(Lm)], jnp.float32)} if cfg.sliding_window else {}),
       **attn_leaves(Lm),
       "w_router": w(next(keys), Lm, D, E),
       "w_experts_gate": w(next(keys), Lm, E, D, Fm),

@@ -274,9 +274,9 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
       if missing:
         raise ValueError(f"layer {idx}: missing tensors {sorted(missing)}")
     params[stack_name] = {key: jnp.stack([as_leaf(per_layer[i][key], key) for i in indices]) for key in layer_keys}
-    if cfg.sliding_window and stack_name == "layers":
-      # Per-layer sliding flag from the GLOBAL layer index, riding the stack
-      # so the lax.scan sees it as a traced per-layer scalar.
+    if cfg.sliding_window:
+      # Per-layer sliding flag from the GLOBAL layer index, riding EVERY
+      # stack so the lax.scan sees it as a traced per-layer scalar.
       params[stack_name]["is_sliding"] = jnp.asarray([1.0 if cfg.layer_is_sliding(i) else 0.0 for i in indices], jnp.float32)
   if shard.is_first_layer:
     params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)

@@ -45,7 +45,7 @@ from ..ops.rope import rope_inv_freq
 _HEAD_KEYS = ("embed", "final_norm", "lm_head", "lm_head_scale")
 
 
-def split_pp_params(params: dict, n_stages: int) -> tuple[str, dict, dict]:
+def split_pp_params(params: dict, n_stages: int) -> tuple[str, dict, dict, int]:
   """Carve shard params into (stack_name, stage stack [P, L/P, ...], head).
 
   The head dict carries the embed/final-norm/lm-head leaves the pp program
@@ -57,8 +57,10 @@ def split_pp_params(params: dict, n_stages: int) -> tuple[str, dict, dict]:
   """
   stacks = [n for n in ("layers", "moe_layers") if n in params]
   head = {k: params[k] for k in _HEAD_KEYS if k in params}
+  n_prefix = 0
   if len(stacks) == 2:
     head["prefix_layers"] = params["layers"]
+    n_prefix = next(iter(params["layers"].values())).shape[0]
     stack_name = "moe_layers"
   elif len(stacks) == 1:
     stack_name = stacks[0]
@@ -69,7 +71,7 @@ def split_pp_params(params: dict, n_stages: int) -> tuple[str, dict, dict]:
   if L % n_stages:
     raise ValueError(f"shard has {L} pipelined layers, not divisible by pp={n_stages}")
   stage_params = {k: v.reshape(n_stages, L // n_stages, *v.shape[1:]) for k, v in stack.items()}
-  return stack_name, stage_params, head
+  return stack_name, stage_params, head, n_prefix
 
 
 def place_pp_params(stage_params: dict, head: dict, mesh: Mesh, stack_name: str) -> tuple[dict, dict]:
@@ -206,9 +208,7 @@ class PPServing:
     self.n_stages = n_stages
     self.is_first = is_first
     self.is_last = is_last
-    # Dense-prefix MoE (deepseek): the prefix rides the head, replicated.
-    self.n_prefix = next(iter(params["layers"].values())).shape[0] if ("layers" in params and "moe_layers" in params) else 0
-    stack_name, stage_params, head = split_pp_params(params, n_stages)
+    stack_name, stage_params, head, self.n_prefix = split_pp_params(params, n_stages)
     self.stage_params, self.head = place_pp_params(stage_params, head, mesh, stack_name)
     self._cache_spec = pp_cache_spec(cfg, mesh)
     self._sm = partial(jax.shard_map, mesh=mesh, axis_names={"pp"}, check_vma=False)

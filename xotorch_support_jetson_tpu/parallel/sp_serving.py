@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.decoder import _dense_qkv, _mla_latents, _mla_w_kv_b, _mlp_block, _next_token, embed_tokens, head_logits
-from ..ops.attention import NEG_INF
+from ..ops.attention import NEG_INF, cap_and_mask_scores
 from ..ops.norm import rms_norm
 from ..ops.rope import rope_inv_freq
 
@@ -71,8 +71,6 @@ def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=No
     scale = 1.0 / float(hd) ** 0.5
   qg = q.reshape(B, Sq, Hkv, group, hd)
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k_loc.astype(jnp.float32)) * scale
-  from ..ops.attention import cap_and_mask_scores
-
   scores = cap_and_mask_scores(scores, q_positions, kv_positions_local, logit_softcap, sliding_window)
   m, l, p = _partial_stats(scores)  # [B,Hkv,g,Sq,1], p [B,Hkv,g,Sq,Skv]
   acc = jnp.einsum("bhgqk,bkhd->bhgqd", p, v_loc.astype(jnp.float32))
