@@ -116,3 +116,33 @@ def test_progress_event_roundtrip():
   )
   rt = RepoProgressEvent.from_dict(ev.to_dict())
   assert rt.repo_id == "org/repo" and rt.downloaded_bytes == 100
+
+
+def test_seed_models_moves_dirs(tmp_path, monkeypatch):
+  """--models-seed-dir parity (reference new_shard_download.py:58-70): model
+  dirs move into the downloads home; hub-style 'models--' prefixes are
+  normalized; existing destinations are left alone."""
+  import asyncio
+
+  from xotorch_support_jetson_tpu.download import downloader as dl
+
+  home = tmp_path / "home"
+  monkeypatch.setattr(dl, "XOT_HOME", home)
+  seed = tmp_path / "seed"
+  (seed / "models--unsloth--tiny").mkdir(parents=True)
+  (seed / "models--unsloth--tiny" / "config.json").write_text("{}")
+  (seed / "owner--plain").mkdir()
+  (seed / "owner--plain" / "w.safetensors").write_text("x")
+  (seed / "loose_file.txt").write_text("ignored")
+
+  asyncio.run(dl.seed_models(seed))
+  dest = home / "downloads"
+  assert (dest / "unsloth--tiny" / "config.json").exists()
+  assert (dest / "owner--plain" / "w.safetensors").exists()
+  assert not (seed / "owner--plain").exists()  # moved, not copied
+
+  # Existing destination: seeding again with new content must not clobber.
+  (seed / "owner--plain").mkdir()
+  (seed / "owner--plain" / "other.bin").write_text("y")
+  asyncio.run(dl.seed_models(seed))
+  assert not (dest / "owner--plain" / "other.bin").exists()

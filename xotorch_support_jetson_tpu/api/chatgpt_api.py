@@ -219,6 +219,7 @@ class ChatGPTAPI:
     r.add_post("/v1/completions", self.handle_post_completions)
     r.add_post("/completions", self.handle_post_completions)
     r.add_post("/v1/chat/token/encode", self.handle_post_chat_token_encode)
+    r.add_post("/chat/token/encode", self.handle_post_chat_token_encode)
     r.add_get("/v1/models", self.handle_get_models)
     r.add_get("/models", self.handle_get_models)
     r.add_get("/initial_models", self.handle_get_initial_models)

@@ -71,6 +71,28 @@ def repo_to_dirname(repo_id: str) -> str:
   return repo_id.replace("/", "--")
 
 
+async def seed_models(seed_dir: str | Path) -> None:
+  """Move pre-fetched model dirs from ``seed_dir`` into the downloads home
+  (reference ``new_shard_download.py:58-70`` — it seeds ``models--*`` dirs;
+  ours are named ``owner--repo`` via repo_to_dirname, both accepted here).
+  Existing destinations are left untouched."""
+  source = Path(seed_dir)
+  dest_root = ensure_models_dir()
+  for path in source.iterdir():
+    if not path.is_dir():
+      continue
+    name = path.name[len("models--"):] if path.name.startswith("models--") else path.name
+    dest = dest_root / name
+    if dest.exists():
+      if DEBUG >= 1:
+        print(f"[seed] {dest} exists; skipping")
+      continue
+    try:
+      await asyncio.to_thread(shutil.move, str(path), str(dest))
+    except OSError as e:
+      print(f"[seed] failed to seed {path} -> {dest}: {e}")
+
+
 async def delete_model(model_id: str, engine_classname: str) -> bool:
   """Remove a downloaded model dir (reference new_shard_download.py:54-70)."""
   from .. import registry

@@ -46,11 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--chatgpt-api-response-timeout", type=int, default=900)
   parser.add_argument("--max-generate-tokens", type=int, default=10000)
   parser.add_argument("--inference-engine", type=str, default="jax", choices=list(inference_engine_classes))
-  parser.add_argument("--temp", type=float, default=0.6)
+  parser.add_argument("--temp", "--default-temp", dest="temp", type=float, default=0.6)
   parser.add_argument("--top-k", type=int, default=35)
   parser.add_argument("--prompt", type=str, default="Who are you?")
   parser.add_argument("--system-prompt", type=str, default=None)
   parser.add_argument("--disable-tui", action="store_true")
+  parser.add_argument("--chat-tui", action="store_true", help="daemon with an interactive terminal chat instead of the topology TUI")
+  parser.add_argument("--run-model", type=str, default=None, help="alias for the `run MODEL` command (reference parity)")
+  parser.add_argument("--models-seed-dir", type=str, default=None, help="move pre-fetched model dirs from here into the downloads home at startup")
+  parser.add_argument("--interface-type-filter", type=str, default=None, help="comma-separated interface types UDP discovery may adopt peers from (e.g. Ethernet,WiFi)")
   parser.add_argument("--max-parallel-downloads", type=int, default=8)
   parser.add_argument("--data", type=str, default=None, help="dataset dir for train/eval")
   parser.add_argument("--iters", type=int, default=100)
@@ -122,6 +126,7 @@ def build_components(args):
       create_peer_handle,
       discovery_timeout=args.discovery_timeout,
       allowed_node_ids=args.allowed_node_ids.split(",") if args.allowed_node_ids else None,
+      allowed_interface_types=args.interface_type_filter.split(",") if args.interface_type_filter else None,
     )
   elif args.discovery_module == "manual":
     from .networking.manual.manual_discovery import ManualDiscovery
@@ -259,6 +264,13 @@ async def eval_model_cli(node, engine_classname: str, args) -> None:
 
 
 async def async_main(args) -> None:
+  if args.models_seed_dir:
+    from .download.downloader import seed_models
+
+    try:
+      await seed_models(args.models_seed_dir)
+    except Exception as e:  # noqa: BLE001 — seeding is best-effort, like the reference
+      print(f"error seeding models from {args.models_seed_dir}: {e}")
   node, server, api, engine, engine_classname = build_components(args)
   await node.start(wait_for_peers=args.wait_for_peers)
 
@@ -275,13 +287,30 @@ async def async_main(args) -> None:
       pass
 
   try:
-    if args.command == "run":
-      model = args.model_name or args.default_model
+    if args.command == "run" or (args.command is None and args.run_model):
+      model = args.model_name or args.run_model or args.default_model
       await run_model_cli(node, engine_classname, model, args.prompt)
     elif args.command == "train":
       await train_model_cli(node, engine_classname, args)
     elif args.command == "eval":
       await eval_model_cli(node, engine_classname, args)
+    elif args.chat_tui:
+      # Interactive terminal chat against this daemon (reference --chat-tui):
+      # the API still serves alongside the REPL. SIGINT/SIGTERM must still
+      # stop the process (the loop-level handler swallows KeyboardInterrupt,
+      # so the REPL task races stop_event instead of relying on it).
+      from .viz.chat_tui import run_chat_tui
+
+      runner = await api.run(port=args.chatgpt_api_port)
+      tui = asyncio.ensure_future(run_chat_tui(node, engine_classname, args.default_model))
+      stopper = asyncio.ensure_future(stop_event.wait())
+      try:
+        await asyncio.wait({tui, stopper}, return_when=asyncio.FIRST_COMPLETED)
+      finally:
+        for t in (tui, stopper):
+          if not t.done():
+            t.cancel()
+        await runner.cleanup()
     else:
       runner = await api.run(port=args.chatgpt_api_port)
       await stop_event.wait()

@@ -81,6 +81,12 @@ def _write_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jn
 # ---------------------------------------------------------------- init
 
 
+def sliding_flags(cfg: ModelConfig, global_indices) -> jnp.ndarray:
+  """Per-layer sliding-window flags [L] f32 from GLOBAL layer indices — the
+  one encoding shared by init (below) and the checkpoint loader."""
+  return jnp.asarray([1.0 if cfg.layer_is_sliding(i) else 0.0 for i in global_indices], jnp.float32)
+
+
 def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None) -> Params:
   """Random-init params for a shard (tests, dryruns, training-from-scratch).
 
@@ -145,7 +151,7 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
       stack["post_attn_norm"] = jnp.ones((L, D), dtype=dtype)
       stack["post_mlp_norm"] = jnp.ones((L, D), dtype=dtype)
     if cfg.sliding_window:
-      stack["is_sliding"] = jnp.asarray([1.0 if cfg.layer_is_sliding(shard.start_layer + i) else 0.0 for i in range(L)], jnp.float32)
+      stack["is_sliding"] = sliding_flags(cfg, range(shard.start_layer, shard.start_layer + L))
     return stack
 
   params: Params = {}
@@ -157,7 +163,7 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
       params["layers"] = dense_stack(n_dense)
     moe_start = shard.start_layer + n_dense
     moe = {
-      **({"is_sliding": jnp.asarray([1.0 if cfg.layer_is_sliding(moe_start + i) else 0.0 for i in range(Lm)], jnp.float32)} if cfg.sliding_window else {}),
+      **({"is_sliding": sliding_flags(cfg, range(moe_start, moe_start + Lm))} if cfg.sliding_window else {}),
       **attn_leaves(Lm),
       "w_router": w(next(keys), Lm, D, E),
       "w_experts_gate": w(next(keys), Lm, E, D, Fm),

@@ -277,7 +277,9 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
     if cfg.sliding_window:
       # Per-layer sliding flag from the GLOBAL layer index, riding EVERY
       # stack so the lax.scan sees it as a traced per-layer scalar.
-      params[stack_name]["is_sliding"] = jnp.asarray([1.0 if cfg.layer_is_sliding(i) else 0.0 for i in indices], jnp.float32)
+      from .decoder import sliding_flags
+
+      params[stack_name]["is_sliding"] = sliding_flags(cfg, indices)
   if shard.is_first_layer:
     params["embed"] = jnp.asarray(top["embed_tokens"], dtype=cfg.dtype)
     if vision_layers:  # llava: vision tower + projector ride with shard 0
