@@ -294,3 +294,42 @@ def test_paged_decode_covers_engine_modes(flavor):
   td, _, _ = fused_batch_decode(params, cfg, shard, tok, dense, positions, active, temps, 8)
   tp, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, jnp.asarray(bt), positions, active, temps, 8, page_size=PS, use_kernel=False)
   assert np.array_equal(np.asarray(td), np.asarray(tp))
+
+
+def test_scheduler_chaos_pages_fully_recover(monkeypatch):
+  """Chaos invariant: after a burst of concurrent requests with random
+  cancels on a small pool, every future resolves and EVERY page returns to
+  the allocator (free list + idle prefix cache == full capacity) — no leaks
+  through the admit/park/starve/cancel/finish paths."""
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  params, shard = full_model_params(KEY, CFG)
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  mp = 128 // PS
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", str(3 * mp + 1))
+  server = BatchedServer(_engine(params, shard), n_slots=3, chunk=2)
+  rng = np.random.default_rng(23)
+
+  async def run():
+    async def one(i):
+      prompt = list(rng.integers(1, CFG.vocab_size, size=int(rng.integers(2, 2 * PS + 5))))
+      task = asyncio.ensure_future(
+        server.submit(f"c{i}", np.asarray(prompt, np.int32), max_tokens=int(rng.integers(1, 12)), temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+      )
+      if rng.random() < 0.4:
+        await asyncio.sleep(float(rng.random()) * 0.05)
+        server.cancel(f"c{i}")
+      try:
+        return await task
+      except Exception:  # noqa: BLE001 — overload errors are acceptable outcomes
+        return None
+
+    return await asyncio.gather(*(one(i) for i in range(16)))
+
+  outs = asyncio.run(run())
+  assert len(outs) == 16
+  alloc = server.allocator
+  assert alloc.n_available == alloc.n_pages - 1  # all pages back (page 0 reserved)
+  assert all(s is None for s in server.slots)
+  assert not alloc._refs, f"leaked refcounts: {alloc._refs}"

@@ -27,7 +27,8 @@ NEG_INF = -1e30
 def _block_attn(q, k, v, q_pos, kv_pos, scale):
   """One blockwise attention contribution, returning (numerator, row-max, row-sum).
 
-  q [B,Sq,Hkv,G,hd]; k,v [B,Skv,Hkv,hd]. All math fp32.
+  q [B,Sq,Hkv,G,hd]; k [B,Skv,Hkv,hd]; v [B,Skv,Hkv,hd_v] (MLA's naive
+  training K/V has v narrower than q/k). All math fp32.
   """
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
   mask = kv_pos[None, None, None, None, :] <= q_pos[:, None, None, :, None]
@@ -45,19 +46,21 @@ def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp"):
   """Blockwise ring attention; call inside shard_map with sequence sharded
   over ``axis_name``.
 
-  q [B,Sq_local,Hq,hd]; k,v [B,Skv_local,Hkv,hd]; q_positions [B,Sq_local];
-  kv_positions [Skv_local] (absolute positions of the local KV block — 1-D,
-  shared across batch; it rotates around the ring with K/V).
-  Returns [B,Sq_local,Hq,hd].
+  q [B,Sq_local,Hq,hd]; k [B,Skv_local,Hkv,hd]; v [B,Skv_local,Hkv,hd_v]
+  (hd_v may differ — MLA); q_positions [B,Sq_local]; kv_positions
+  [Skv_local] (absolute positions of the local KV block — 1-D, shared
+  across batch; it rotates around the ring with K/V). The scale is
+  1/sqrt(hd), matching gqa_attention. Returns [B,Sq_local,Hq,hd_v].
   """
   axis_size = jax.lax.psum(1, axis_name)
   B, Sq, Hq, hd = q.shape
   Hkv = k.shape[2]
+  hd_v = v.shape[3]  # MLA: v head dim differs from q/k's (192 vs 128 on deepseek)
   G = Hq // Hkv
   scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
   qg = q.reshape(B, Sq, Hkv, G, hd).astype(jnp.float32)
 
-  num0 = jnp.zeros((B, Sq, Hkv, G, hd), jnp.float32)
+  num0 = jnp.zeros((B, Sq, Hkv, G, hd_v), jnp.float32)
   m0 = jnp.full((B, Hkv, G, Sq), NEG_INF, jnp.float32)
   l0 = jnp.zeros((B, Hkv, G, Sq), jnp.float32)
 
@@ -81,7 +84,7 @@ def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp"):
   (k_f, v_f, kvp_f, num, m, l), _ = jax.lax.scan(body, (k, v, kv_positions, num0, m0, l0), None, length=axis_size)
   l_safe = jnp.where(l == 0.0, 1.0, l)
   out = num / jnp.moveaxis(l_safe, 3, 1)[..., None]
-  return out.reshape(B, Sq, Hq, hd).astype(q.dtype)
+  return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)
 
 
 def make_sharded_ring_attention(mesh: Mesh):

@@ -13,11 +13,10 @@ the *context* axis, SURVEY.md §5.7's greenfield mandate).
 Same entry points as ``pp_serving.PPServing``; the engine stores either under
 its mesh-serving slot (``XOT_TPU_SP=N``). Training-side sequence parallelism
 (ring attention, ``parallel/ring_attention.py``) shards the *queries* too;
-serving decode has one query per step, so stat-merge is the right shape —
-and unlike the training ring it composes with MLA: the absorbed-attention
-scores/latent-context pairs merge exactly the same way (the per-head
-up-projection is applied after the merge). Cache layout [L, B, S, H, hd]
-sharded over S (axis 2).
+serving decode has one query per step, so stat-merge is the right shape.
+MLA composes: the absorbed-attention scores/latent-context pairs merge
+exactly the same way (the per-head up-projection is applied after the
+merge). Cache layout [L, B, S, H, hd] sharded over S (axis 2).
 """
 
 from __future__ import annotations
