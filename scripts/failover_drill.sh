@@ -21,7 +21,7 @@ json.dump({"peers": {"nodeA": {"address": "127.0.0.1", "port": 53151, "device_ca
 EOF
 
 export JAX_PLATFORMS=cpu XOT_TPU_MODEL_DIR="$CKPT" HF_HUB_OFFLINE=1 DEBUG=1
-COMMON=(--disable-tui --temp 0.0 --max-generate-tokens 40 --default-model llama-3.2-1b --discovery-module manual)
+COMMON=(--disable-tui --temp 0.0 --max-generate-tokens 400 --default-model llama-3.2-1b --discovery-module manual)
 XOT_TPU_UUID=nodeA python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
   --discovery-config-path "$WORK/a.json" --node-port 53151 --chatgpt-api-port 52515 > "$WORK/a.log" 2>&1 &
 echo $! > "$WORK/a.pid"
@@ -40,7 +40,7 @@ import json, os, signal, sys, time, urllib.request
 b_pid = int(sys.argv[1])
 req = urllib.request.Request("http://127.0.0.1:52515/v1/chat/completions",
   data=json.dumps({"model": "llama-3.2-1b", "messages": [{"role": "user", "content": "the quick brown fox"}],
-                   "stream": True, "max_tokens": 40}).encode(),
+                   "stream": True, "max_tokens": 400}).encode(),
   headers={"Content-Type": "application/json"})
 resp = urllib.request.urlopen(req, timeout=240)
 nchunks, killed, done = 0, False, False
@@ -51,7 +51,7 @@ while True:
         break
     if line.startswith(b"data: ") and b'"content"' in line:
         nchunks += 1
-    if not killed and time.time() - t0 > 12:
+    if not killed and (nchunks >= 1 or time.time() - t0 > 12):
         os.kill(b_pid, signal.SIGKILL)
         killed = True
         print(f"== killed nodeB at t={time.time()-t0:.1f}s (after {nchunks} content chunks)")

@@ -33,8 +33,6 @@ KEY = jax.random.PRNGKey(0)
 
 
 def _ref_logits(params, tokens):
-  from xotorch_support_jetson_tpu.inference.shard import Shard
-
   shard = Shard("m", 0, CFG.n_layers - 1, CFG.n_layers)
   positions = jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
   logits, _ = shard_forward(params, CFG, shard, tokens, positions, None)
@@ -162,7 +160,6 @@ def test_moe_ep_forward_matches_single_device():
   tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 8), 0, moe_cfg.vocab_size, dtype=jnp.int32)
   positions = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
 
-  from xotorch_support_jetson_tpu.inference.shard import Shard
 
   shard = Shard("moe", 0, moe_cfg.n_layers - 1, moe_cfg.n_layers)
   with jax.default_matmul_precision("highest"):
