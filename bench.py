@@ -28,6 +28,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def gate_headline(tok_per_s: float, serving_tok_s: float | None) -> tuple[float, bool]:
+  """Sanity-gate the headline decode number against the serving-path number.
+
+  On the tunneled chip ``jax.block_until_ready`` can return before the work is
+  actually done, producing physically impossible throughputs (the round-2
+  record claimed 79,922 tok/s for a 2.45 GB-weight model whose HBM roofline is
+  ~220 tok/s). Both paths run the same weights-bound decode, so a headline more
+  than 2x the serving number cannot be real — treat it as a timing artifact
+  and report the serving number instead, flagging the trip.
+  """
+  if serving_tok_s and tok_per_s > 2.0 * serving_tok_s:
+    return float(serving_tok_s), True
+  return float(tok_per_s), False
+
+
+def plausible_value(rec: dict) -> float | None:
+  """Extract the trustworthy headline tok/s from a recorded BENCH_r*.json line.
+
+  A recorded ``value`` more than 2x its own ``serving_chunked_tok_s`` is a
+  ``block_until_ready`` tunnel artifact (the poisoned round-2 record); fall
+  back to that record's serving-path number so ``vs_baseline`` chains stay
+  sane across rounds.
+  """
+  v = rec.get("value")
+  s = rec.get("serving_chunked_tok_s")
+  if not v:
+    return None
+  return gate_headline(float(v), float(s) if s else None)[0]
+
+
 def main() -> None:
   from xotorch_support_jetson_tpu.models.config import ModelConfig
   from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache, shard_forward
@@ -67,16 +97,19 @@ def main() -> None:
 
   prefill_jit = jax.jit(prefill, donate_argnums=(2,))
 
-  # Warmup / compile.
+  # Warmup / compile. All timed sections below fetch results to the host with
+  # np.asarray — jax.block_until_ready can return early through the tunnel
+  # (NOTES.md gotchas; the round-2 headline was invalidated by exactly this).
   cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
   last, cache = prefill_jit(params, tokens, cache)
-  jax.block_until_ready(last)
+  _ = np.asarray(jnp.argmax(last, axis=-1))
 
-  # TTFT (prefill latency, compiled).
+  # TTFT: prefill + on-device sample + first token on the host (what a client
+  # actually waits for), compiled.
   cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
   t0 = time.perf_counter()
   last, cache = prefill_jit(params, tokens, cache)
-  jax.block_until_ready(last)
+  _ = np.asarray(jnp.argmax(last, axis=-1))
   ttft_ms = (time.perf_counter() - t0) * 1e3
 
   first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
@@ -84,13 +117,13 @@ def main() -> None:
 
   # Warmup decode compile.
   toks, cache = fused_decode(params, cfg, shard, first_tok, cache, start_pos, n_decode)
-  jax.block_until_ready(toks)
+  _ = np.asarray(toks)
 
-  # Timed decode (fresh cache region; positions continue).
+  # Timed decode (fresh cache region; positions continue). Full host fetch.
   start_pos2 = start_pos + n_decode
   t0 = time.perf_counter()
   toks, cache = fused_decode(params, cfg, shard, first_tok, cache, start_pos2, n_decode)
-  jax.block_until_ready(toks)
+  _ = np.asarray(toks)
   dt = time.perf_counter() - t0
   tok_per_s = n_decode * B / dt
 
@@ -313,6 +346,8 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — smaller-HBM devices: skip, don't abort the bench
       int8_8b_tok_s = None
 
+  headline, gate_tripped = gate_headline(tok_per_s, serving_tok_s)
+
   vs_baseline = None
   int8_vs_prev = None
   try:  # compare to the previous round's recorded value if the driver left one
@@ -323,12 +358,20 @@ def main() -> None:
       prev = json.load(open(hist[-1]))
       if "parsed" in prev:  # driver wraps the JSON line under "parsed"
         prev = prev["parsed"]
-      if prev.get("unit") == "tokens/s" and prev.get("value"):
-        vs_baseline = round(tok_per_s / float(prev["value"]), 4)
-      if int8_tok_s and prev.get("int8_decode_tok_s"):
+      denom = plausible_value(prev) if prev.get("unit") == "tokens/s" else None
+      if denom:
+        vs_baseline = round(headline / denom, 4)
+      prev_int8 = prev.get("int8_decode_tok_s")
+      prev_serving = prev.get("serving_chunked_tok_s")
+      # Same artifact filter as the headline: int8 halves the weight bytes, so
+      # a recorded int8 number beyond 4x the record's own serving number is a
+      # timing artifact, not a denominator.
+      if prev_int8 and prev_serving and float(prev_int8) > 4.0 * float(prev_serving):
+        prev_int8 = None
+      if int8_tok_s and prev_int8:
         # Regression gate (VERDICT r1 weak #1): flag int8 decode drift
         # round-over-round right in the bench line.
-        int8_vs_prev = round(int8_tok_s / float(prev["int8_decode_tok_s"]), 4)
+        int8_vs_prev = round(int8_tok_s / float(prev_int8), 4)
   except Exception:  # noqa: BLE001
     pass
 
@@ -336,9 +379,10 @@ def main() -> None:
     json.dumps(
       {
         "metric": "decode_tokens_per_sec_llama1b_bf16_1chip" if on_accel else "decode_tokens_per_sec_smoke_cpu",
-        "value": round(tok_per_s, 2),
+        "value": round(headline, 2),
         "unit": "tokens/s",
         "vs_baseline": vs_baseline,
+        "headline_gate_tripped": gate_tripped,
         "serving_chunked_tok_s": round(serving_tok_s, 2),
         "decode_tok_s_ctx32k": ctx32k_tok_s,
         "int8_decode_tok_s": int8_tok_s,
