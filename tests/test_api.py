@@ -258,3 +258,45 @@ async def test_chat_logprobs_validation():
   finally:
     await client.close()
     await node.stop()
+
+
+def test_align_logprobs_contract():
+  """_align_logprobs: entries align with the returned text — EOS dropped,
+  stop-cut truncation, and exact offsets even when per-token decodes diverge
+  from the joint decode (byte-level BPE multi-byte split)."""
+  from xotorch_support_jetson_tpu.api.chatgpt_api import _align_logprobs
+
+  class SimpleTok:
+    words = {1: "he", 2: "llo", 3: " wor", 4: "ld", 9: ""}
+
+    def decode(self, ids):
+      return "".join(self.words[i] for i in ids)
+
+  tok = SimpleTok()
+  # Plain: every non-EOS token kept, cumulative offsets past the prompt.
+  toks, offs, keep = _align_logprobs(tok, [1, 2, 3, 4, 99], {99}, "hello world", 5, False)
+  assert toks == ["he", "llo", " wor", "ld"]
+  assert offs == [5, 7, 10, 14]
+  assert keep == [0, 1, 2, 3]
+  # Stop cut at "hello": entries starting past the cut are dropped.
+  toks, offs, keep = _align_logprobs(tok, [1, 2, 3, 4, 99], {99}, "hello", 5, True)
+  assert toks == ["he", "llo"] and offs == [5, 7] and keep == [0, 1]
+  # Straddling token (starts inside the text, extends past) is kept, clamped.
+  toks, offs, keep = _align_logprobs(tok, [1, 2, 3, 4], set(), "hello w", 0, True)
+  assert toks == ["he", "llo", " wor"] and offs == [0, 2, 5]
+
+  class ByteTok:
+    # Tokens 1+2 are two halves of one multi-byte char: alone they decode to
+    # U+FFFD (1 char each), jointly to one char.
+    def decode(self, ids):
+      if list(ids) == [1, 2] or list(ids) == [1, 2, 3]:
+        return "é" + ("x" if 3 in ids else "")
+      return "".join({1: "�", 2: "�", 3: "x"}[i] for i in ids)
+
+  toks, offs, keep = _align_logprobs(ByteTok(), [1, 2, 3], set(), "éx", 0, False)
+  # Joint-prefix fallback: offsets follow the JOINT text ("é" is ONE char, so
+  # token 3 starts at 1, not at 2 as per-token U+FFFD decodes would claim),
+  # stay monotone, and stay within the text.
+  assert offs == [0, 1, 1]
+  assert keep == [0, 1, 2]
+  assert all(0 <= o <= len("éx") for o in offs)

@@ -171,17 +171,50 @@ async def test_logprobs_real_engine(serving_stack):
       assert abs(e["top_logprobs"][0]["logprob"] - e["logprob"]) < 1e-5
       assert e["top_logprobs"][0]["logprob"] >= e["top_logprobs"][1]["logprob"]
 
-    # Legacy endpoint with integer logprobs.
-    resp = await client.post("/v1/completions", json={"model": "llama-3.2-1b", "prompt": "hello world", "logprobs": 3, "max_tokens": 5})
-    assert resp.status == 200, await resp.text()
-    data = await resp.json()
-    lp = data["choices"][0]["logprobs"]
-    assert lp is not None
-    n = data["usage"]["completion_tokens"]
-    assert len(lp["tokens"]) == n == len(lp["token_logprobs"]) == len(lp["top_logprobs"]) == len(lp["text_offset"])
-    assert all(v <= 0.0 for v in lp["token_logprobs"])
-    assert all(len(t) <= 3 for t in lp["top_logprobs"])
-    assert lp["text_offset"][0] == len("hello world")
+    # Legacy endpoint with integer logprobs. The entries must align with the
+    # RETURNED text (ADVICE r2): no entries for trailing EOS/special tokens
+    # the text omits, none past a stop-string cut. Probe a few prompts — with
+    # this tiny random checkpoint some greedy continuations decode to ''.
+    text_out, best = "", None
+    for prompt_try in ("hello world", "the quick brown", "tell me a story about", "what is"):
+      resp = await client.post("/v1/completions", json={"model": "llama-3.2-1b", "prompt": prompt_try, "logprobs": 3, "max_tokens": 12})
+      assert resp.status == 200, await resp.text()
+      data = await resp.json()
+      lp = data["choices"][0]["logprobs"]
+      assert lp is not None
+      text_out = data["choices"][0]["text"]
+      n = data["usage"]["completion_tokens"]
+      assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["top_logprobs"]) == len(lp["text_offset"])
+      assert len(lp["tokens"]) <= n  # usage counts EOS; the arrays don't
+      assert all(v <= 0.0 for v in lp["token_logprobs"])
+      assert all(len(t) <= 3 for t in lp["top_logprobs"])
+      # Every offset lies within the returned text (OpenAI contract); with an
+      # empty text all entries clamp to the prompt end.
+      assert all(len(prompt_try) <= off <= len(prompt_try) + len(text_out) for off in lp["text_offset"])
+      assert lp["text_offset"] == sorted(lp["text_offset"])
+      if text_out == "":
+        continue
+      assert lp["text_offset"][0] == len(prompt_try)
+      best = (prompt_try, text_out)
+      break
+
+    # Stop-string cut: entries must not extend past the truncated text
+    # (previously they covered tokens past the cut and the EOS).
+    if best is not None and len(best[1]) >= 4:
+      prompt_try, text_out = best
+      stop = text_out[2:4]
+      resp = await client.post(
+        "/v1/completions",
+        json={"model": "llama-3.2-1b", "prompt": prompt_try, "logprobs": 3, "max_tokens": 12, "stop": [stop]},
+      )
+      assert resp.status == 200, await resp.text()
+      data2 = await resp.json()
+      text2 = data2["choices"][0]["text"]
+      lp2 = data2["choices"][0]["logprobs"]
+      assert stop not in text2 and len(text2) < len(text_out)
+      assert len(lp2["tokens"]) == len(lp2["token_logprobs"]) == len(lp2["top_logprobs"]) == len(lp2["text_offset"])
+      for off in lp2["text_offset"]:
+        assert off - len(prompt_try) < max(len(text2), 1), (lp2["text_offset"], text2)
   finally:
     await client.close()
     await node.stop()

@@ -241,6 +241,57 @@ def test_scheduler_parks_starved_admission_until_pages_free(monkeypatch):
     assert out == expected[i], f"req {i}: {out} != {expected[i]}"
 
 
+def test_parked_big_request_keeps_priority_over_later_small_ones(monkeypatch):
+  """A page-starved big prompt retains its queue position: a small request
+  arriving AFTER it must not leapfrog it by consuming the freed pages
+  (ADVICE r2 fairness/liveness finding — previously the starved request was
+  requeued at the tail and could wait unboundedly under sustained load)."""
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  params, shard = full_model_params(KEY, CFG)
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "5")  # 4 usable pages (page 0 is trash)
+  server = BatchedServer(_engine(params, shard), n_slots=3, chunk=2)
+
+  rng = np.random.default_rng(7)
+  small_a = [3, 25, 9]  # grows to 3 pages over its 40-token run
+  big = list(rng.integers(0, CFG.vocab_size, size=(3 * PS + 3,)))  # needs all 4 pages
+  small_c = [7, 1, 88]
+  n_gen = 6
+  expected_big = _solo(params, shard, big, n_gen)
+  expected_c = _solo(params, shard, small_c, n_gen)
+
+  first_emits: list[str] = []
+
+  def emit(rid, toks, fin):
+    if toks and rid not in first_emits:
+      first_emits.append(rid)
+
+  async def run():
+    # "a" runs long enough (20 chunk ticks) that "big" parks while it holds
+    # pages — and its growth to 3 pages means "big" can only admit after it.
+    fa = asyncio.ensure_future(server.submit("a", np.asarray(small_a, np.int32), max_tokens=40, temp=0.0, top_k=35, eos_ids=(), emit=emit))
+    for _ in range(200):  # wait until "a" is resident
+      await asyncio.sleep(0.02)
+      if any(s is not None for s in server.slots):
+        break
+    fb = asyncio.ensure_future(server.submit("big", np.asarray(big, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=emit))
+    for _ in range(500):  # wait until "big" has actually parked
+      await asyncio.sleep(0.02)
+      if server._parked:
+        break
+    assert server._parked, "big request never parked — pool sizing assumption broke"
+    fc = asyncio.ensure_future(server.submit("c", np.asarray(small_c, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=emit))
+    return await asyncio.gather(fa, fb, fc)
+
+  out_a, out_big, out_c = asyncio.run(run())
+  assert out_big == expected_big and out_c == expected_c
+  # "c" arrived while "big" was parked; page priority means "big" streams
+  # its first token before "c" does.
+  assert first_emits.index("big") < first_emits.index("c"), first_emits
+
+
 @pytest.mark.parametrize("flavor", ["int8", "moe", "mla", "gemma2"])
 def test_paged_decode_covers_engine_modes(flavor):
   """int8-quantized, MoE, and MLA (latent-cache) models through the paged

@@ -55,6 +55,25 @@ async def test_engine_train_loss_decreases():
 
 
 @pytest.mark.asyncio
+async def test_structural_is_sliding_flag_survives_adamw():
+  """The per-layer sliding-window flag rides in params (the scan body reads
+  it) but is NOT a weight: adamw's decoupled weight decay must not drift it
+  (ADVICE r2 — decay perturbs every leaf each step even at zero gradient)."""
+  cfg = tiny_test_config(n_layers=2, vocab_size=128, sliding_window=8)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "m")
+  engine = JaxShardedInferenceEngine()
+  engine.load_test_model(shard, cfg, params, WordTokenizer())
+  flags_before = np.asarray(engine.params["layers"]["is_sliding"]).copy()
+  assert flags_before.tolist() == [1.0, 0.0]  # even layers slide (gemma2 rule)
+  wq_before = np.asarray(engine.params["layers"]["wq"]).copy()
+  inputs, targets, lengths = _batch(cfg)
+  for _ in range(4):
+    await engine.train("r", shard, inputs, targets, lengths, lr=1e-2, opt="adamw")
+  np.testing.assert_array_equal(np.asarray(engine.params["layers"]["is_sliding"]), flags_before)
+  assert not np.allclose(np.asarray(engine.params["layers"]["wq"]), wq_before)  # real weights did move
+
+
+@pytest.mark.asyncio
 async def test_engine_evaluate():
   engine, shard, cfg = _engine()
   inputs, targets, lengths = _batch(cfg)

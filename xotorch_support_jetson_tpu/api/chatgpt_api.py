@@ -178,6 +178,41 @@ def parse_chat_request(data: dict, default_model: str) -> ChatCompletionRequest:
   )
 
 
+def _align_logprobs(tokenizer, all_tokens: list, eos_set, text: str, prompt_len: int, stop_cut: bool) -> tuple[list, list, list]:
+  """Token strings / text offsets / kept indices for /v1/completions logprobs.
+
+  OpenAI contract: the arrays align with the RETURNED text — no entries for
+  EOS tokens (the text omits them) or tokens starting past a stop-string
+  cut; ``keep`` indexes the surviving positions in ``all_tokens`` so the
+  caller can subset the scores. Fast path: when per-token decodes concatenate
+  to the joint decode, offsets are cumulative per-token lengths (O(tokens)).
+  Fallback (byte-level BPE splitting a multi-byte char across tokens decodes
+  to U+FFFD per token but one char jointly): joint prefix decodes, O(tokens²)
+  — callers run this off the event loop.
+  """
+  ids = [int(t) for t in all_tokens if t not in eos_set]
+  positions = [i for i, t in enumerate(all_tokens) if t not in eos_set]
+  pieces = [tokenizer.decode([t]) for t in ids]
+  joint = tokenizer.decode(ids)
+  if "".join(pieces) == joint:
+    prefix_lens = []
+    acc = 0
+    for p in pieces:
+      prefix_lens.append(acc)
+      acc += len(p)
+  else:
+    prefix_lens = [len(tokenizer.decode(ids[:j])) for j in range(len(ids))]
+  toks, offsets, keep = [], [], []
+  for j, (i, piece) in enumerate(zip(positions, pieces)):
+    start = prefix_lens[j]
+    if stop_cut and start >= len(text):  # starts past the cut
+      break
+    toks.append(piece)
+    offsets.append(prompt_len + min(start, len(text)))
+    keep.append(i)
+  return toks, offsets, keep
+
+
 def completion_chunk(request_id: str, model: str, created: int, content: str | None, finish_reason: str | None) -> dict:
   delta = {} if content is None else {"role": "assistant", "content": content}
   return {
@@ -461,27 +496,29 @@ class ChatGPTAPI:
           break
       text = tokenizer.decode([t for t in all_tokens if t not in eos_set])
       finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
+      stop_cut = False
       if base.stop:
         cut, _ = find_stop(text, base.stop)
         if cut is not None:
           text = text[:cut]
           finish_reason = "stop"
+          stop_cut = True
       logprobs_obj = None
       if logprobs_n:
         scored = await self._score_logprobs(shard, prompt_ids, all_tokens, logprobs_n)
         if scored is not None:
           chosen_lp, top_ids, top_lp = scored
-          toks = [tokenizer.decode([int(t)]) for t in all_tokens]
-          offsets, off = [], len(prompt)
-          for s in toks:
-            offsets.append(off)
-            off += len(s)
+          # Alignment runs in an executor: the exact fallback is O(tokens²)
+          # decode work that must not stall the event loop.
+          toks, offsets, keep = await asyncio.get_event_loop().run_in_executor(
+            None, _align_logprobs, tokenizer, all_tokens, eos_set, text, len(prompt), stop_cut
+          )
           logprobs_obj = {
             "tokens": toks,
-            "token_logprobs": [float(x) for x in chosen_lp],
+            "token_logprobs": [float(chosen_lp[i]) for i in keep],
             "top_logprobs": [
               {tokenizer.decode([int(tid)]): float(tlp) for tid, tlp in zip(top_ids[i][:logprobs_n], top_lp[i][:logprobs_n])}
-              for i in range(len(all_tokens))
+              for i in keep
             ],
             "text_offset": offsets,
           }

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -54,6 +55,7 @@ class _Request:
   eos_ids: tuple
   emit: Callable[[str, list, bool], None]  # (request_id, new_tokens, finished)
   future: asyncio.Future = None
+  page_demand: int = 0  # pages still needed at the last failed paged admission
 
 
 @dataclass
@@ -101,6 +103,12 @@ class BatchedServer:
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self.queue: asyncio.Queue[_Request] = asyncio.Queue()
+    # Page-starved requests park HERE, ahead of the queue, and retry first
+    # each tick — a large prompt must not lose its position to later-arriving
+    # small requests that would otherwise consume every freed page (ADVICE
+    # r2 fairness/liveness finding). While the head parked request's page
+    # demand is unmet, newer admissions may only use the surplus beyond it.
+    self._parked: deque[_Request] = deque()
     self._queued: dict[str, _Request] = {}  # request_id → queued request (cancel lookup)
     self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
     self._admitting: set[str] = set()  # ids currently inside _admit
@@ -111,7 +119,7 @@ class BatchedServer:
   async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit) -> list:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
     ``emit(request_id, new_tokens, finished)`` as chunks complete."""
-    if self.queue.qsize() >= self.max_queue:
+    if self.queue.qsize() + len(self._parked) >= self.max_queue:
       raise ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
     req = _Request(
       request_id=request_id,
@@ -189,13 +197,15 @@ class BatchedServer:
         return i
     return None
 
-  async def _admit(self, req: _Request, row: int) -> bool:
+
+  async def _admit(self, req: _Request, row: int, *, reserve: int = 0) -> bool:
     """Prefill one request into a pool row and emit its first token.
 
     A failed prefill fails THIS request's future (the pool keeps serving).
-    Returns False when pages are scarce and the request was requeued to wait
-    (only possible while other rows are active — the caller stops admitting
-    for this tick)."""
+    Returns False when pages are scarce (only possible while other rows are
+    active — the caller parks the request via ``_park`` so it retries ahead
+    of younger arrivals; ``req.page_demand`` is set for reserve accounting).
+    ``reserve`` pages are kept back for earlier parked requests."""
     from ..models.decoder import prefill_into_pages, prefill_into_slot
 
     eng = self.engine
@@ -224,15 +234,20 @@ class BatchedServer:
         shared_pages = self.allocator.lookup_prefix(chain_keys[: (S - 1) // ps])
         prefix_len = len(shared_pages) * ps
         total = (S + 1 + ps - 1) // ps  # cover positions [0, S] (first generated token)
-        new_pages = self.allocator.alloc(total - len(shared_pages))
+        need = total - len(shared_pages)
+        new_pages = None if self.allocator.n_available - need < reserve else self.allocator.alloc(need)
         if new_pages is None:
           for p in shared_pages:
             self.allocator.release(p)
           shared_pages = []  # already released — the except handler must not release again
           if any(s is not None for s in self.slots):
-            # Other requests are draining pages — wait for a chunk boundary.
+            # Other requests are draining pages — the caller parks us to
+            # retry at the next chunk boundary, keeping arrival order.
+            # Re-register for cancel lookup NOW (not at _park time): the
+            # caller may await other admissions before re-parking, and a
+            # cancel landing in that window must still find the request.
+            req.page_demand = need
             self._queued[req.request_id] = req
-            self.queue.put_nowait(req)
             return False
           raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
         # The padded suffix writes at offset prefix_len and must stay inside
@@ -273,6 +288,7 @@ class BatchedServer:
         self.allocator.free(new_pages)
       if not req.future.done():
         req.future.set_exception(e)
+      self._cancelled_ids.discard(req.request_id)  # a raced cancel is moot now
       return True
     finally:
       self._admitting.discard(req.request_id)
@@ -339,13 +355,35 @@ class BatchedServer:
     self._ensure_cache()
     try:
       while True:
-        # Admission: fill free slots from the queue (no await while any row
-        # is active — keep the pool stepping). An admission that parks on
-        # page scarcity stops the fill for this tick.
+        # Admission: parked (page-starved) requests retry FIRST, in arrival
+        # order; then fill remaining free slots from the queue (no await while
+        # any row is active — keep the pool stepping). Every still-unmet
+        # parked request's page demand accumulates into ``reserve``: younger
+        # requests may only admit out of the surplus beyond it, so freed
+        # pages accumulate toward the parked requests instead of being
+        # consumed by later small prompts.
+        reserve = 0
+        scan = 0  # parked entries stay IN the deque while being retried, so a
+        # teardown (_fail_all) or a concurrent submit's backpressure check
+        # during an admission await still sees them; drop only on admission.
+        while scan < len(self._parked) and (row := self._free_slot()) is not None:
+          req = self._parked[scan]
+          if await self._admit(req, row, reserve=reserve):
+            del self._parked[scan]
+          else:
+            reserve += req.page_demand
+            scan += 1
         while (row := self._free_slot()) is not None and not self.queue.empty():
-          if not await self._admit(self.queue.get_nowait(), row):
+          req = self.queue.get_nowait()
+          if not await self._admit(req, row, reserve=reserve):
+            self._parked.append(req)  # _admit re-registered it in _queued
             break
         if all(s is None for s in self.slots):
+          # _parked is necessarily empty here: with every slot free the retry
+          # loop above ran each parked entry through _admit, which can only
+          # ask to park again while some row is active (otherwise it admits
+          # or fails the request as overloaded).
+          assert not self._parked
           # Idle: block on the queue (the task persists — no exit/restart race).
           req = await self.queue.get()
           await self._admit(req, self._free_slot())
@@ -456,6 +494,10 @@ class BatchedServer:
         slot.req.future.set_exception(exc)
       self.slots[i] = None
     self._queued.clear()
+    while self._parked:
+      req = self._parked.popleft()
+      if not req.future.done():
+        req.future.set_exception(exc)
     while not self.queue.empty():
       req = self.queue.get_nowait()
       if not req.future.done():

@@ -14,8 +14,12 @@ operands. Two pieces:
   which land in the request's private tail pages). Cached pages with no
   active readers sit in an LRU and are evicted when the free list runs dry.
 
-Chain keys are exact (nested tuples of token ids), not hashes — no collision
-risk, and equality IS content equality.
+Chain keys are content-addressed: key i is a 128-bit blake2b digest of
+(key i-1, page i's token ids), so building all keys is O(prompt) and every
+dict op is O(1). (The first design used nested tuples of token ids for
+literal exactness, but hashing key i walks i pages — O(pages² · page_size)
+per admission at 32K contexts. At 128 bits a spurious collision needs ~2⁶⁴
+distinct pages; git-style content addressing, accepted as exact.)
 
 No reference counterpart (the reference's cache is dense per-request,
 ``SURVEY.md §5.7``); the design is the vLLM paged-KV idea rebuilt for static
@@ -24,7 +28,10 @@ XLA shapes.
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
+
+import numpy as np
 
 
 class PageAllocator:
@@ -35,8 +42,8 @@ class PageAllocator:
     self.page_size = page_size
     self._free: list[int] = list(range(n_pages - 1, 0, -1))  # pop() -> low ids first
     self._refs: dict[int, int] = {}  # page -> active readers (cached pages only)
-    self._by_key: dict[tuple, int] = {}  # chain key -> cached page
-    self._key_of: dict[int, tuple] = {}  # cached page -> chain key
+    self._by_key: dict[bytes, int] = {}  # chain key -> cached page
+    self._key_of: dict[int, bytes] = {}  # cached page -> chain key
     self._lru: OrderedDict[int, None] = OrderedDict()  # refcount-0 cached pages
 
   # ------------------------------------------------------------- allocation
@@ -75,16 +82,17 @@ class PageAllocator:
   # ----------------------------------------------------------- prefix cache
 
   @staticmethod
-  def chain_keys(tokens, page_size: int) -> list[tuple]:
+  def chain_keys(tokens, page_size: int) -> list[bytes]:
     """Cumulative content keys for each FULL page of ``tokens``."""
-    keys: list[tuple] = []
-    prev: tuple = ()
-    for i in range(len(tokens) // page_size):
-      prev = (prev, tuple(int(t) for t in tokens[i * page_size : (i + 1) * page_size]))
+    arr = np.asarray(tokens, dtype=np.int64)  # normalize dtype: same ids -> same bytes
+    keys: list[bytes] = []
+    prev = b""
+    for i in range(len(arr) // page_size):
+      prev = hashlib.blake2b(prev + arr[i * page_size : (i + 1) * page_size].tobytes(), digest_size=16).digest()
       keys.append(prev)
     return keys
 
-  def lookup_prefix(self, keys: list[tuple]) -> list[int]:
+  def lookup_prefix(self, keys: list[bytes]) -> list[int]:
     """Longest cached prefix; bumps each hit's refcount (caller must
     ``release`` every returned page exactly once)."""
     pages: list[int] = []
@@ -104,7 +112,7 @@ class PageAllocator:
       self._refs.pop(page)
       self._lru[page] = None
 
-  def insert_cached(self, key: tuple, page: int) -> bool:
+  def insert_cached(self, key: bytes, page: int) -> bool:
     """Donate a private page to the cache (refcount 0, evictable). Returns
     False (page NOT adopted — caller should ``free`` it) when the chain is
     already cached."""

@@ -28,6 +28,25 @@ from .mesh import MeshPlan, specs_for_params
 from .pipeline import make_pipeline_layers_fn, run_layer_stack, stack_stage_params
 
 
+# Params-resident structural flags read by the layer scan body (not weights):
+# the optimizer must never touch them. In particular adamw's decoupled weight
+# decay perturbs every leaf each step even at zero gradient.
+STRUCTURAL_LEAVES = ("is_sliding",)
+
+
+def freeze_structural(optimizer: optax.GradientTransformation) -> optax.GradientTransformation:
+  """Route structural params leaves (``STRUCTURAL_LEAVES``) to a zero update
+  so neither momentum nor decoupled weight decay drifts them."""
+
+  def labels(params):
+    return jax.tree_util.tree_map_with_path(
+      lambda path, _: "frozen" if any(getattr(k, "key", None) in STRUCTURAL_LEAVES for k in path) else "train",
+      params,
+    )
+
+  return optax.multi_transform({"train": optimizer, "frozen": optax.set_to_zero()}, labels)
+
+
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
   """Masked mean next-token CE. logits [B,S,V] fp32, targets [B,S], mask [B,S]."""
   logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -85,7 +104,7 @@ def make_train_step(
   params/opt_state donated. batch = {"inputs","targets","mask"} each [B,S].
   ``grad_postprocess(grads, params)`` can zero/filter grads (LoRA freezing).
   """
-  optimizer = optimizer or optax.adamw(1e-5)
+  optimizer = freeze_structural(optimizer or optax.adamw(1e-5))
   forward = make_forward_fn(mesh, cfg, plan, n_micro=n_micro, remat=remat)
 
   def loss_fn(params, batch):

@@ -14,7 +14,7 @@ import numpy as np
 import optax
 
 from ..parallel.mesh import MeshPlan, build_mesh
-from ..parallel.train_step import make_eval_step, make_train_step
+from ..parallel.train_step import freeze_structural, make_eval_step, make_train_step
 from .lora import lora_grad_mask
 
 
@@ -112,7 +112,7 @@ def _ring_update(engine, grads, lr: float, opt: str) -> None:
   st = _ring_state(engine)
   lora = _has_lora(engine.params)
   if st.opt is None:
-    st.opt = optax.sgd(lr) if opt == "sgd" else (optax.adam(lr) if lora else optax.adamw(lr))
+    st.opt = freeze_structural(optax.sgd(lr) if opt == "sgd" else (optax.adam(lr) if lora else optax.adamw(lr)))
     st.opt_state = st.opt.init(engine.params)
   if lora:
     grads = lora_grad_mask(grads, engine.params)
