@@ -263,6 +263,7 @@ def main() -> None:
   # chip, so this is the ready-for-multichip hook, exercised in tests and
   # dryrun_multichip on the virtual mesh).
   pp_decode_tok_s = None
+  pp_batched_tok_s = None
   if on_accel and len(jax.devices()) >= 2:
     from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan, build_mesh
     from xotorch_support_jetson_tpu.parallel.pp_serving import PPServing
@@ -278,6 +279,28 @@ def main() -> None:
       ptoks, pcache = pp.fused_decode(first_tok, pcache, jnp.full((B,), n_decode, jnp.int32), n_decode)
       _ = np.asarray(ptoks)
       pp_decode_tok_s = round(n_decode * B / (time.perf_counter() - t0), 2)
+      del pcache
+
+      # Multi-stream pipeline serving (parallel/pp_batch.py): 2·pp streams
+      # overlapping across stages — the aggregate-throughput story for deep
+      # pipelines (VERDICT r2 #2); target ≥ ~P× the B=1 pp number above.
+      from xotorch_support_jetson_tpu.parallel.pp_batch import PPBatchedServing
+
+      ppb = PPBatchedServing.from_pp_serving(pp)
+      Bpp = 2 * pp_deg
+      bcache2 = ppb.place_cache(init_kv_cache(cfg, shard.n_shard_layers, Bpp, 1024))
+      btok2 = jnp.ones((Bpp, 1), jnp.int32)
+      bpos2 = jnp.full((Bpp,), prompt_len, jnp.int32)
+      bact2 = jnp.ones((Bpp,), bool)
+      btmp2 = jnp.zeros((Bpp,), jnp.float32)
+      btk2 = jnp.full((Bpp,), 35, jnp.int32)
+      btoks2, bpos2, bcache2 = ppb.batch_decode(btok2, bcache2, bpos2, bact2, btmp2, btk2, n_decode)
+      _ = np.asarray(btoks2)
+      t0 = time.perf_counter()
+      btoks2, bpos2, bcache2 = ppb.batch_decode(btok2, bcache2, bpos2, bact2, btmp2, btk2, n_decode)
+      _ = np.asarray(btoks2)
+      pp_batched_tok_s = round(Bpp * n_decode / (time.perf_counter() - t0), 2)
+      del bcache2
 
   # 8B-geometry int8 decode: the measurable v5e-1 stand-in for BASELINE
   # configs 2/3 (8B-class serving). bf16 8B (~16 GB) exceeds one v5e chip's
@@ -393,6 +416,7 @@ def main() -> None:
         "int8_8b_decode_tok_s": int8_8b_tok_s,
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,
+        "pp_batched_aggregate_tok_s": pp_batched_tok_s,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "platform": platform,
         "device": str(jax.devices()[0]),

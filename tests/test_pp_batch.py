@@ -1,0 +1,209 @@
+"""Pipeline-parallel BATCHED serving (parallel/pp_batch.py): the pipelined
+group schedule must be token-identical to the single-device fused batch
+programs — dense slots and paged pool, prefill included — and the batch
+scheduler must serve concurrent requests through it end-to-end (VERDICT r2
+next-step #2: multi-stream pipeline serving)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  fused_batch_decode,
+  fused_paged_batch_decode,
+  init_kv_cache,
+  prefill_into_pages,
+  prefill_into_slot,
+)
+from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan, build_mesh
+from xotorch_support_jetson_tpu.parallel.pp_batch import PPBatchedServing
+
+KEY = jax.random.PRNGKey(0)
+PS = 16
+MAX_SEQ = 64
+PROMPTS = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+
+
+def _cfg(flavor="llama"):
+  if flavor == "gemma2":
+    return tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, sliding_window=8, attn_logit_softcap=50.0, final_logit_softcap=30.0)
+  if flavor == "moe":
+    return tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2, moe_hidden_dim=32)
+  return tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ)
+
+
+def _pad(p):
+  pad = np.zeros((1, 16 * ((len(p) + 15) // 16)), np.int32)
+  pad[0, : len(p)] = p
+  return jnp.asarray(pad)
+
+
+def _prefill_dense(params, cfg, shard, prompts, ppb=None):
+  """Prefill every prompt into a fresh slot pool (single-device or pp)."""
+  B = len(prompts)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, B, MAX_SEQ)
+  if ppb is not None:
+    cache = ppb.place_cache(cache)
+  firsts = []
+  for r, p in enumerate(prompts):
+    if ppb is not None:
+      last, cache = ppb.prefill_into_slot(_pad(p), cache, r, len(p))
+    else:
+      last, cache = prefill_into_slot(params, cfg, shard, _pad(p), cache, jnp.int32(r), jnp.int32(len(p)))
+    firsts.append(int(np.argmax(np.asarray(last)[0])))
+  return cache, firsts
+
+
+def _prefill_paged(params, cfg, shard, prompts, ppb=None):
+  B = len(prompts)
+  mp = MAX_SEQ // PS
+  pool = init_paged_pool(cfg, shard.n_shard_layers, 1 + B * mp, PS)
+  if ppb is not None:
+    pool = ppb.place_pool(pool)
+  bt = np.zeros((B, mp), np.int32)
+  firsts = []
+  for r, p in enumerate(prompts):
+    bt[r] = range(1 + r * mp, 1 + (r + 1) * mp)
+    if ppb is not None:
+      last, pool = ppb.prefill_into_pages(_pad(p), pool, bt[r], 0, len(p), PS)
+    else:
+      last, pool = prefill_into_pages(params, cfg, shard, _pad(p), pool, jnp.asarray(bt[r]), jnp.int32(0), jnp.int32(len(p)), PS)
+    firsts.append(int(np.argmax(np.asarray(last)[0])))
+  return pool, jnp.asarray(bt), firsts
+
+
+@pytest.mark.parametrize("flavor", ["llama", "gemma2", "moe"])
+@pytest.mark.parametrize("plan", [MeshPlan(pp=2), MeshPlan(pp=2, tp=2)], ids=["pp2", "pp2xtp2"])
+def test_pp_batch_decode_matches_single_device(flavor, plan):
+  cfg = _cfg(flavor)
+  params, shard = full_model_params(jax.random.PRNGKey(7), cfg, "m")
+  ppb = PPBatchedServing(build_mesh(plan), cfg, params, plan.pp)
+  n_steps = 6
+
+  cache_ref, firsts_ref = _prefill_dense(params, cfg, shard, PROMPTS)
+  cache_pp, firsts_pp = _prefill_dense(params, cfg, shard, PROMPTS, ppb)
+  assert firsts_pp == firsts_ref  # prefill logits agree
+
+  tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
+  pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  active = jnp.asarray([True, True, True, False])
+  temps = jnp.zeros((4,), jnp.float32)
+  ref_toks, ref_pos, _ = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+  pp_toks, pp_pos, _ = ppb.batch_decode(tok, cache_pp, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps)
+  np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
+  np.testing.assert_array_equal(np.asarray(pp_pos), np.asarray(ref_pos))
+
+
+def test_pp_batch_decode_consecutive_chunks_stay_exact():
+  """Two chained chunks (the scheduler's steady state): cache writes from the
+  pipelined schedule must land exactly where the next chunk reads them."""
+  cfg = _cfg()
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, "m")
+  ppb = PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+
+  cache_ref, firsts = _prefill_dense(params, cfg, shard, PROMPTS)
+  cache_pp, _ = _prefill_dense(params, cfg, shard, PROMPTS, ppb)
+  tok = jnp.asarray([[f] for f in firsts], jnp.int32)
+  pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  active = jnp.ones((4,), bool)
+  temps = jnp.zeros((4,), jnp.float32)
+  top_ks = jnp.full((4,), 35, jnp.int32)
+  for _ in range(3):
+    ref_toks, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, 4)
+    pp_toks, pos_pp, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, top_ks, 4)
+    np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
+    tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])
+    pos = pos_ref
+  assert int(pos[0]) == len(PROMPTS[0]) + 12
+
+
+@pytest.mark.parametrize("flavor", ["llama", "mla"])
+def test_pp_paged_batch_decode_matches_single_device(flavor):
+  if flavor == "mla":
+    cfg = tiny_test_config(
+      n_layers=4, max_seq_len=MAX_SEQ, n_heads=4, n_kv_heads=4, kv_lora_rank=16,
+      q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+    )
+  else:
+    cfg = _cfg()
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  ppb = PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+  n_steps = 6
+
+  pool_ref, bt, firsts_ref = _prefill_paged(params, cfg, shard, PROMPTS)
+  pool_pp, _, firsts_pp = _prefill_paged(params, cfg, shard, PROMPTS, ppb)
+  assert firsts_pp == firsts_ref
+
+  tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
+  pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  active = jnp.asarray([True, True, False, True])
+  temps = jnp.zeros((4,), jnp.float32)
+  ref_toks, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
+  pp_toks, _, _ = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps, page_size=PS)
+  np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
+
+
+def test_pp_batch_rejects_dense_prefix_moe():
+  cfg = tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2, moe_hidden_dim=32, first_k_dense=2)
+  params, _ = full_model_params(jax.random.PRNGKey(1), cfg, "m")
+  with pytest.raises(ValueError, match="dense-prefix"):
+    PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+
+
+def test_supports_batched_gates_dense_prefix_moe_under_pp():
+  """The Node's batched eligibility check consults engine.supports_batched:
+  dense-prefix MoE under PP falls back to the plain serving path instead of
+  erroring per request."""
+  cfg = tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2, moe_hidden_dim=32, first_k_dense=2)
+  params, shard = full_model_params(jax.random.PRNGKey(1), cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True, pp=2)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert engine._pp is not None and engine._pp.n_prefix == 2
+  assert not engine.supports_batched()
+
+  plain = JaxShardedInferenceEngine(use_local_mesh=False)
+  plain.load_test_model(*((shard, cfg, params)))
+  assert plain.supports_batched()
+
+
+def test_batch_scheduler_serves_concurrently_over_pp(monkeypatch):
+  """End-to-end: a pp=2 engine's batch scheduler (paged, the default) serves
+  4 concurrent requests token-identically to solo single-device runs — the
+  composition the round-2 engine refused (jax_engine get_batched_server)."""
+  from tests.test_batched import _single_row_reference
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  cfg = _cfg()
+  params, shard = full_model_params(jax.random.PRNGKey(5), cfg, "m")
+
+  engine = JaxShardedInferenceEngine(use_local_mesh=True, pp=2)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert engine._pp is not None and engine.mesh.shape["pp"] == 2
+  server = BatchedServer(engine, n_slots=3, chunk=2)  # rounds up to 4 (pp=2… still 4? 3→4)
+  assert server.n_slots % 2 == 0
+
+  n_gen = 5
+  expected = [_single_row_reference(params, shard, p, n_gen - 1, cfg=cfg) for p in PROMPTS]
+
+  async def run():
+    return await asyncio.gather(
+      *(
+        server.submit(f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+        for i, p in enumerate(PROMPTS)
+      )
+    )
+
+  outs = asyncio.run(run())
+  for i, out in enumerate(outs):
+    assert out == expected[i], f"req {i}: {out} != {expected[i]}"

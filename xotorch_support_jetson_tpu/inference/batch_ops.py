@@ -1,0 +1,112 @@
+"""Backend indirection for the continuous-batching scheduler.
+
+``BatchedServer`` (batch_scheduler.py) drives five device operations: cache
+and page-pool creation, slot/page prefill, and the fused chunk decode. This
+module provides them behind one small interface so the SAME scheduler loop
+serves both layouts:
+
+- ``DecoderBatchOps`` — the single-device path (models/decoder.py fused
+  programs), used whenever the engine runs without a serving mesh.
+- ``PPBatchOps`` — the pp-pipelined path (parallel/pp_batch.py): cache
+  sharded over pipeline stages, B streams overlapping across stages. Slots
+  are rounded UP to a multiple of pp so the rows split into equal groups.
+
+The engine picks one in ``JaxShardedInferenceEngine.batch_ops``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class DecoderBatchOps:
+  """Single-device batched serving ops (the default)."""
+
+  def __init__(self, engine):
+    self.engine = engine
+
+  def round_slots(self, n: int) -> int:
+    return n
+
+  def init_cache(self, n_slots: int, max_seq: int):
+    from ..models.decoder import init_kv_cache
+
+    eng = self.engine
+    return init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, n_slots, max_seq)
+
+  def init_pool(self, n_pages: int, page_size: int):
+    from ..ops.paged import init_paged_pool
+
+    eng = self.engine
+    return init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size)
+
+  def prefill_into_slot(self, tokens, cache, row, prompt_len):
+    from ..models.decoder import prefill_into_slot
+
+    eng = self.engine
+    return prefill_into_slot(eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.int32(row), jnp.int32(prompt_len))
+
+  def prefill_into_pages(self, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
+    from ..models.decoder import prefill_into_pages
+
+    eng = self.engine
+    return prefill_into_pages(
+      eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_row, jnp.int32),
+      jnp.int32(prefix_len), jnp.int32(prompt_len), int(page_size),
+    )
+
+  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
+    from ..models.decoder import fused_batch_decode
+
+    eng = self.engine
+    return fused_batch_decode(
+      eng.params, eng.cfg, eng._effective_shard, token, cache, positions, active, temps, n_steps,
+      top_k=top_ks, k_max=k_max, key=key,
+    )
+
+  def paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key):
+    from ..models.decoder import fused_paged_batch_decode
+
+    eng = self.engine
+    return fused_paged_batch_decode(
+      eng.params, eng.cfg, eng._effective_shard, token, pool, block_tables, positions, active, temps, n_steps,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+    )
+
+
+class PPBatchOps:
+  """Batched serving over the pp pipeline (parallel/pp_batch.py)."""
+
+  def __init__(self, engine, pp_batched):
+    self.engine = engine
+    self.pp = pp_batched
+
+  def round_slots(self, n: int) -> int:
+    p = self.pp.n_stages
+    return ((max(n, p) + p - 1) // p) * p
+
+  def init_cache(self, n_slots: int, max_seq: int):
+    from ..models.decoder import init_kv_cache
+
+    eng = self.engine
+    return self.pp.place_cache(init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, n_slots, max_seq))
+
+  def init_pool(self, n_pages: int, page_size: int):
+    from ..ops.paged import init_paged_pool
+
+    eng = self.engine
+    return self.pp.place_pool(init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size))
+
+  def prefill_into_slot(self, tokens, cache, row, prompt_len):
+    return self.pp.prefill_into_slot(tokens, cache, row, prompt_len)
+
+  def prefill_into_pages(self, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
+    return self.pp.prefill_into_pages(tokens, pool, bt_row, prefix_len, prompt_len, page_size)
+
+  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
+    return self.pp.batch_decode(token, cache, positions, active, temps, top_ks, n_steps, k_max=k_max, key=key)
+
+  def paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key):
+    return self.pp.paged_batch_decode(
+      token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max=k_max, page_size=page_size, key=key
+    )

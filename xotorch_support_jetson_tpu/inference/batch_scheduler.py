@@ -80,7 +80,11 @@ class BatchedServer:
 
   def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None):
     self.engine = engine
-    self.n_slots = n_slots or int(os.getenv("XOT_TPU_BATCH_SLOTS", "4"))
+    # Device ops go through the engine's backend (inference/batch_ops.py):
+    # single-device fused programs, or the pp-pipelined variants when the
+    # engine serves over a pipeline mesh (slots round up to a multiple of pp).
+    self.ops = engine.batch_ops
+    self.n_slots = self.ops.round_slots(n_slots or int(os.getenv("XOT_TPU_BATCH_SLOTS", "4")))
     self.chunk = chunk or int(os.getenv("XOT_TPU_BATCH_CHUNK", "8"))
     # Per-request top_k IS honored (traced per row, like temperature —
     # ops/sampling.py sample_logits_per_row); only the candidate-set cap
@@ -174,12 +178,9 @@ class BatchedServer:
   def _ensure_cache(self):
     if self.cache is not None:
       return
-    from ..models.decoder import init_kv_cache
-
     eng = self.engine
     self.max_seq = min(eng.max_seq_len, eng.cfg.max_seq_len)
     if self.paged:
-      from ..ops.paged import init_paged_pool
       from .paging import PageAllocator
 
       ps = self.page_size
@@ -187,9 +188,9 @@ class BatchedServer:
       n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or self.n_slots * self.pages_per_row + 1
       self.allocator = PageAllocator(n_pages, ps)
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
-      self.cache = init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, ps)
+      self.cache = self.ops.init_pool(n_pages, ps)
     else:
-      self.cache = init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, self.n_slots, self.max_seq)
+      self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
 
   def _free_slot(self) -> int | None:
     for i, s in enumerate(self.slots):
@@ -206,8 +207,6 @@ class BatchedServer:
     active — the caller parks the request via ``_park`` so it retries ahead
     of younger arrivals; ``req.page_demand`` is set for reserve accounting).
     ``reserve`` pages are kept back for earlier parked requests."""
-    from ..models.decoder import prefill_into_pages, prefill_into_slot
-
     eng = self.engine
     self._queued.pop(req.request_id, None)
     self._admitting.add(req.request_id)
@@ -261,10 +260,7 @@ class BatchedServer:
         bt_row[len(shared_pages) : total] = new_pages
 
         def run():
-          last, self.cache = prefill_into_pages(
-            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache,
-            jnp.asarray(bt_row), jnp.int32(prefix_len), jnp.int32(S), self.page_size,
-          )
+          last, self.cache = self.ops.prefill_into_pages(jnp.asarray(tok_pad), self.cache, bt_row, prefix_len, S, self.page_size)
           return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
 
       else:
@@ -275,9 +271,7 @@ class BatchedServer:
         def run():
           # Prefill AND first-token sample stay on the engine executor — the
           # single thread that serializes all device work (and owns eng._key).
-          last, self.cache = prefill_into_slot(
-            eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tok_pad), self.cache, jnp.int32(row), jnp.int32(S)
-          )
+          last, self.cache = self.ops.prefill_into_slot(jnp.asarray(tok_pad), self.cache, row, S)
           return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
 
       first = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
@@ -349,8 +343,6 @@ class BatchedServer:
     return True
 
   async def _run(self) -> None:
-    from ..models.decoder import fused_batch_decode, fused_paged_batch_decode
-
     eng = self.engine
     self._ensure_cache()
     try:
@@ -424,16 +416,15 @@ class BatchedServer:
         def run_chunk():
           eng._key, sub = jax.random.split(eng._key)
           if self.paged:
-            toks, _pos, self.cache = fused_paged_batch_decode(
-              eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
-              jnp.asarray(self.block_tables), jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps),
-              self.chunk, top_k=jnp.asarray(top_ks), k_max=self.k_max, page_size=self.page_size, key=sub,
+            toks, _pos, self.cache = self.ops.paged_batch_decode(
+              jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
+              jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
+              k_max=self.k_max, page_size=self.page_size, key=sub,
             )
           else:
-            toks, _pos, self.cache = fused_batch_decode(
-              eng.params, eng.cfg, eng._effective_shard, jnp.asarray(tokens), self.cache,
-              jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), self.chunk,
-              top_k=jnp.asarray(top_ks), k_max=self.k_max, key=sub,
+            toks, _pos, self.cache = self.ops.batch_decode(
+              jnp.asarray(tokens), self.cache, jnp.asarray(positions), jnp.asarray(active),
+              jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub,
             )
           return np.asarray(toks)  # ONE readback for the whole pool chunk
 

@@ -135,6 +135,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # reference's layer-split serving; remaining chips go to tp.
     self.pp = pp if pp is not None else int(os.getenv("XOT_TPU_PP", "0") or 0)
     self._pp = None
+    self._batch_ops = None
     self.mesh = None
     self.sessions: dict[str, _Session] = {}
     # One worker thread serializes all device work off the asyncio loop —
@@ -725,11 +726,43 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, read)
 
+  def supports_batched(self) -> bool:
+    """Whether batched serving can run for the loaded model + serving mesh.
+
+    The Node falls back to the plain serving path when False: SP mode has no
+    batched composition yet, and dense-prefix MoE models (deepseek
+    first_k_dense) are excluded from the pp-batched pipeline (their
+    replicated prefix cache would diverge across stages)."""
+    if self._pp is None:
+      return True
+    from ..parallel.pp_serving import PPServing
+
+    return isinstance(self._pp, PPServing) and not self._pp.n_prefix
+
+  @property
+  def batch_ops(self):
+    """Device-op backend for the batch scheduler (inference/batch_ops.py):
+    single-device fused programs, or pp-pipelined variants in XOT_TPU_PP mode
+    (B streams overlap across stages — parallel/pp_batch.py)."""
+    ops = getattr(self, "_batch_ops", None)
+    if ops is None:
+      from ..parallel.pp_serving import PPServing
+      from .batch_ops import DecoderBatchOps, PPBatchOps
+
+      if isinstance(self._pp, PPServing):
+        from ..parallel.pp_batch import PPBatchedServing
+
+        ops = PPBatchOps(self, PPBatchedServing.from_pp_serving(self._pp))
+      elif self._pp is not None:
+        raise RuntimeError("batched serving (XOT_TPU_BATCHED) is not yet composed with XOT_TPU_SP sequence-parallel serving")
+      else:
+        ops = DecoderBatchOps(self)
+      self._batch_ops = ops
+    return ops
+
   def get_batched_server(self):
     """Lazy continuous-batching scheduler (inference/batch_scheduler.py);
     one per loaded model — the pooled KV cache is model-specific."""
-    if self._pp is not None:
-      raise RuntimeError("batched serving (XOT_TPU_BATCHED) is not yet composed with XOT_TPU_PP pipeline serving")
     if getattr(self, "_batched_server", None) is None:
       from .batch_scheduler import BatchedServer
 
@@ -742,6 +775,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     if server is not None:
       server.shutdown()
     self._batched_server = None
+    self._batch_ops = None  # backend is model/mesh-specific
 
   async def clear_session(self) -> None:
     self.sessions.clear()
@@ -761,6 +795,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.tokenizer = None
     self.mesh = None
     self._pp = None
+    self._batch_ops = None
     self.sessions.clear()
     self._drop_batched_server()
 

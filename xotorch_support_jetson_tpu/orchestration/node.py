@@ -219,6 +219,7 @@ class Node:
       os.getenv("XOT_TPU_BATCHED", "0") == "1"
       and shard.is_last_layer
       and hasattr(self.inference_engine, "get_batched_server")
+      and getattr(self.inference_engine, "supports_batched", lambda: True)()
       and not (inference_state and inference_state.extras.get("images"))
     ):
       # Continuous batching (inference/batch_scheduler.py): this node owns the

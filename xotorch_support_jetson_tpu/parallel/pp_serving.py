@@ -105,14 +105,16 @@ def pp_cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
 
 def _merge_written(old: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray, width: int, active: jnp.ndarray) -> jnp.ndarray:
   """Keep ``new``'s cache writes only when ``active`` — O(B·width) work, not a
-  full-cache copy. old/new [L,B,Smax,H,hd]; start [B] per-row slot offsets."""
+  full-cache copy. old/new [L,B,Smax,H,hd]; start [B] per-row slot offsets;
+  active is a scalar (whole-batch stage mask) or [B] (per-row, pp_batch)."""
+  active = jnp.broadcast_to(active, start.shape)
 
-  def row(o, n, s):  # [L, Smax, H, hd]
+  def row(o, n, s, a):  # [L, Smax, H, hd]
     wn = jax.lax.dynamic_slice_in_dim(n, s, width, axis=1)
     wo = jax.lax.dynamic_slice_in_dim(o, s, width, axis=1)
-    return jax.lax.dynamic_update_slice_in_dim(o, jnp.where(active, wn, wo), s, axis=1)
+    return jax.lax.dynamic_update_slice_in_dim(o, jnp.where(a, wn, wo), s, axis=1)
 
-  return jax.vmap(row, in_axes=(1, 1, 0), out_axes=1)(old, new, start)
+  return jax.vmap(row, in_axes=(1, 1, 0, 0), out_axes=1)(old, new, start, active)
 
 
 def _stage_forward(stage_layers: dict, h: jnp.ndarray, positions: jnp.ndarray, cache: dict, inv_freq, cfg: ModelConfig):
