@@ -20,7 +20,7 @@ json.dump({"peers": {"nodeB": {"address": "127.0.0.1", "port": 53152, "device_ca
 json.dump({"peers": {"nodeA": {"address": "127.0.0.1", "port": 53151, "device_capabilities": caps}}}, open(f"{w}/b.json", "w"))
 EOF
 
-export JAX_PLATFORMS=cpu XOT_TPU_MODEL_DIR="$CKPT" HF_HUB_OFFLINE=1 DEBUG=1
+export JAX_PLATFORMS=cpu XOT_TPU_MODEL_DIR="$CKPT" HF_HUB_OFFLINE=1 DEBUG=1 PYTHONUNBUFFERED=1
 COMMON=(--disable-tui --temp 0.0 --max-generate-tokens 400 --default-model llama-3.2-1b --discovery-module manual)
 XOT_TPU_UUID=nodeA python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
   --discovery-config-path "$WORK/a.json" --node-port 53151 --chatgpt-api-port 52515 > "$WORK/a.log" 2>&1 &
@@ -44,6 +44,7 @@ req = urllib.request.Request("http://127.0.0.1:52515/v1/chat/completions",
   headers={"Content-Type": "application/json"})
 resp = urllib.request.urlopen(req, timeout=240)
 nchunks, killed, done = 0, False, False
+acc = ""
 t0 = time.time()
 while True:
     line = resp.readline()
@@ -51,6 +52,11 @@ while True:
         break
     if line.startswith(b"data: ") and b'"content"' in line:
         nchunks += 1
+        try:
+            delta = json.loads(line[6:])["choices"][0]["delta"].get("content") or ""
+        except Exception:
+            delta = ""
+        acc += delta
     if not killed and (nchunks >= 1 or time.time() - t0 > 12):
         os.kill(b_pid, signal.SIGKILL)
         killed = True
@@ -60,5 +66,15 @@ while True:
         break
 assert killed, "peer was never killed (generation finished too fast — raise max_tokens)"
 assert done, "stream never finished after the kill"
-print(f"== PASS: request completed after peer loss (t={time.time()-t0:.1f}s)")
+
+# No duplicated (or missing) span: the drilled transcript must equal the
+# survivor's canonical greedy completion of the same prompt exactly —
+# prompt-level replays dedup the re-emitted prefix at the node boundary.
+canon_req = urllib.request.Request("http://127.0.0.1:52515/v1/chat/completions",
+  data=json.dumps({"model": "llama-3.2-1b", "messages": [{"role": "user", "content": "the quick brown fox"}],
+                   "stream": False, "max_tokens": 400}).encode(),
+  headers={"Content-Type": "application/json"})
+canon = json.load(urllib.request.urlopen(canon_req, timeout=240))["choices"][0]["message"]["content"]
+assert acc.strip() == canon.strip(), f"transcript diverged from canonical greedy completion:\n drilled={acc!r}\n canon={canon!r}"
+print(f"== PASS: request completed after peer loss with an exact transcript (t={time.time()-t0:.1f}s)")
 EOF

@@ -151,9 +151,11 @@ class GRPCPeerHandle(PeerHandle):
     await self._ensure_connected()
     await self._rpcs["SendLoss"](pb.Loss(loss=loss, grads=tensor_to_proto(grads)))
 
-  async def send_result(self, request_id: str, result, is_finished: bool) -> None:
+  async def send_result(self, request_id: str, result, is_finished: bool, start_pos: int | None = None) -> None:
     await self._ensure_connected()
     request = pb.SendResultRequest(request_id=request_id, is_finished=is_finished)
+    if start_pos is not None:
+      request.start_pos = int(start_pos)
     if isinstance(result, np.ndarray):
       request.tensor.CopyFrom(tensor_to_proto(result))
     else:

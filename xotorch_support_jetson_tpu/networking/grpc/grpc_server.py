@@ -117,7 +117,10 @@ class GRPCServer:
   async def SendResult(self, request: pb.SendResultRequest, context) -> pb.Empty:
     tensor = proto_to_tensor(request.tensor) if request.HasField("tensor") else None
     result = tensor if tensor is not None else list(request.result)
-    self.node.on_token.trigger_all(request.request_id, result, request.is_finished)
+    # Through the node's dedup choke point: deliveries below the request's
+    # high-water mark (a replayed span after failover) are dropped.
+    start_pos = request.start_pos if request.HasField("start_pos") else None
+    self.node.handle_remote_result(request.request_id, result, request.is_finished, start_pos=start_pos)
     return pb.Empty()
 
   async def SendOpaqueStatus(self, request: pb.SendOpaqueStatusRequest, context) -> pb.Empty:

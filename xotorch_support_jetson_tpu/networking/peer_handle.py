@@ -63,7 +63,7 @@ class PeerHandle(ABC):
     ...
 
   @abstractmethod
-  async def send_result(self, request_id: str, result: list[int] | np.ndarray, is_finished: bool) -> None:
+  async def send_result(self, request_id: str, result: list[int] | np.ndarray, is_finished: bool, start_pos: int | None = None) -> None:
     ...
 
   @abstractmethod

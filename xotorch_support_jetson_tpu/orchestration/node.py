@@ -40,6 +40,17 @@ from .. import registry
 from .tracing import tracer
 
 
+# How long per-request bookkeeping (cancel flags, dedup tombstones) outlives
+# its request: must cover the API's response timeout (chatgpt_api.py, 900 s)
+# so zombie broadcasts arriving within any live client's window stay deduped.
+RESPONSE_TIMEOUT_HORIZON_S = 900.0
+
+# A held ahead-of-mark chunk waits this long for the gap to fill before the
+# stream force-flushes in position order: one LOST broadcast RPC then costs a
+# visible gap after a short stall instead of hanging the client forever.
+GAP_FLUSH_S = 5.0
+
+
 class Node:
   def __init__(
     self,
@@ -72,6 +83,22 @@ class Node:
     self.request_options: dict[str, dict] = {}
     self.cancelled_requests: set[str] = set()
     self._replay_attempts: dict[str, int] = {}
+    self._replay_pending: set[str] = set()  # requests with a replay in flight (coalesce concurrent failure reports)
+    # Client-stream replay dedup (VERDICT r2 #5): every token delivery
+    # carries the absolute completion index of its first token; a receiver
+    # delivers only tokens at/above its high-water mark, so a failover that
+    # regenerates an already-streamed span (prompt-level replay, or a
+    # zombie broadcast racing the retry) can never duplicate the client
+    # transcript. ``_emitted_counts`` is the per-request high-water mark;
+    # ``_completion_offset`` maps a generation node's LOCAL buffer index to
+    # the absolute index (non-zero only after adopting a token-level replay
+    # whose history predates this node's buffer); ``_seen_epochs`` detects a
+    # bumped replay_epoch so a surviving node resets its stale local buffer.
+    self._emitted_counts: dict[str, int] = {}
+    self._pending_chunks: dict[str, dict[int, tuple[list[int], bool]]] = {}  # ahead-of-mark deliveries held for in-order release
+    self._gap_flush_armed: set[str] = set()  # requests with a pending gap-flush timer
+    self._completion_offset: dict[str, int] = {}
+    self._seen_epochs: dict[str, int] = {}
     self.buffered_inputs: dict[str, list] = {}
     self.checkpoints: dict[str, dict[str, int]] = {}
     self.outstanding_requests: dict[str, str] = {}
@@ -145,6 +172,19 @@ class Node:
       return
     if state is not None and "request_options" in state.extras and request_id not in self.request_options:
       self.request_options[request_id] = dict(state.extras["request_options"])
+    if state is not None:
+      # A bumped replay_epoch means the stream was re-driven after a failure:
+      # a SURVIVING last-layer owner must drop its stale local buffer or the
+      # regenerated tokens would double-count against max_tokens (truncating
+      # the transcript) and desync the absolute positions. The wire history
+      # (orig_prompt_len floor in _check_finished / _completion_offset) keeps
+      # budget and positions exact for token-level replays.
+      epoch = int(state.extras.get("replay_epoch", 0))
+      if epoch > self._seen_epochs.get(request_id, 0):
+        self._seen_epochs[request_id] = epoch
+        if request_id in self.buffered_token_output:
+          self.buffered_token_output[request_id] = ([], False)
+        self._completion_offset.pop(request_id, None)
 
   async def process_prompt(self, base_shard: Shard, prompt: str, request_id: str | None = None, inference_state: InferenceState | None = None, wire_concrete: bool = False):
     shard = self.get_current_shard(base_shard)
@@ -202,6 +242,14 @@ class Node:
       # retrying once over a refreshed topology if the head just left.
       for attempt in (0, 1):
         try:
+          if attempt:
+            # The retry regenerates from position 0. Bump the replay epoch so
+            # every surviving node resets its stale buffer for this request
+            # (_adopt_options); the regenerated stream's absolute positions
+            # then restart at 0 and the receivers' high-water dedup drops the
+            # re-streamed prefix — no duplicated span reaches the client.
+            inference_state = inference_state or InferenceState()
+            inference_state.extras["replay_epoch"] = int(inference_state.extras.get("replay_epoch", 0)) + 1
           head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
           await self.forward_prompt(base_shard, prompt, request_id, head_idx, inference_state)
           return None
@@ -241,13 +289,14 @@ class Node:
 
     def emit(rid: str, new_tokens: list, finished: bool) -> None:
       buffered, _ = self.buffered_token_output.get(rid, ([], False))
+      start = len(buffered)
       buffered.extend(new_tokens)
       self.buffered_token_output[rid] = (buffered, finished)
       for _ in new_tokens:
         tracer.handle_token(rid)
       metrics.inc("tokens_generated_total", len(new_tokens))
-      self.trigger_on_token_callbacks(rid, list(new_tokens), finished)
-      asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished))
+      self.trigger_on_token_callbacks(rid, list(new_tokens), finished, start_pos=start)
+      asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished, start_pos=start))
 
     try:
       await engine.get_batched_server().submit(
@@ -304,8 +353,20 @@ class Node:
 
       is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state, request_id)
       self.buffered_token_output[request_id] = (tokens, is_finished)
-      self.trigger_on_token_callbacks(request_id, [token_int], is_finished)
-      asyncio.create_task(self.broadcast_result(request_id, [token_int], is_finished))
+      # Absolute completion index of this token: the wire history floors it
+      # when a token-level replay landed on a node whose buffer restarted
+      # (the offset then maps local buffer indices to absolute positions for
+      # the fast-decode loop too).
+      off = self._completion_offset.get(request_id, 0)
+      state = inference_state
+      if state is not None and state.tokens is not None and "orig_prompt_len" in state.extras:
+        hist_pos = int(np.asarray(state.tokens).shape[-1]) - int(state.extras["orig_prompt_len"])
+        if hist_pos - (len(tokens) - 1) > off:
+          off = hist_pos - (len(tokens) - 1)
+          self._completion_offset[request_id] = off
+      abs_pos = off + len(tokens) - 1
+      self.trigger_on_token_callbacks(request_id, [token_int], is_finished, start_pos=abs_pos)
+      asyncio.create_task(self.broadcast_result(request_id, [token_int], is_finished, start_pos=abs_pos))
 
       if is_finished:
         self._finish_request(request_id)
@@ -319,7 +380,9 @@ class Node:
       next_token = np.asarray([[token_int]], dtype=np.int32)
       try:
         await self.forward_tensor(base_shard, next_token, request_id, self.get_partition_index(offset=1), inference_state)
-      except Exception:  # noqa: BLE001 — next hop gone: replay over new topology
+      except Exception as e:  # noqa: BLE001 — next hop gone: replay over new topology
+        if DEBUG >= 1:
+          print(f"[node {self.id}] ring wrap hop for {request_id} failed: {e!r}")
         # The just-sampled (and already streamed) token is only appended to
         # the wire history when it reaches the head — include it here or the
         # replay would regenerate/re-emit that position.
@@ -330,7 +393,9 @@ class Node:
       # Middle shard: pass hidden state to the next partition.
       try:
         await self.forward_tensor(base_shard, result, request_id, self.get_partition_index(offset=1), inference_state)
-      except Exception:  # noqa: BLE001
+      except Exception as e:  # noqa: BLE001
+        if DEBUG >= 1:
+          print(f"[node {self.id}] mid-ring hop for {request_id} failed: {e!r}")
         await self._retry_request(base_shard, request_id, inference_state)
 
   async def _retry_request(self, base_shard: Shard, request_id: str, state: InferenceState | None) -> None:
@@ -343,13 +408,26 @@ class Node:
     partition map the request REPLAYS as a fresh prefill of those tokens to
     the new layer-0 owner; surviving engines drop their stale per-request
     sessions via the bumped ``replay_epoch``. Tokens already streamed are
-    not re-emitted — generation continues where it left off. (The separate
+    not re-emitted — generation continues where it left off. The separate
     prompt-level retry in _process_prompt — used when the failure surfaces
     inside the initial SendPrompt RPC — regenerates from the original
-    prompt, which can re-emit the earliest tokens; greedy decoding makes
-    the duplicates exact.)
+    prompt; receivers drop the re-streamed prefix by absolute-position
+    high-water mark (trigger_on_token_callbacks), so neither path can
+    duplicate the client transcript.
     """
-    retries = int(os.getenv("XOT_TPU_INFLIGHT_RETRIES", "2"))
+    # Coalesce: a mid-failover ring can report SEVERAL failures for one
+    # request near-simultaneously (the wrap hop, a stale broadcast, the next
+    # hop's error all landing in the same event-loop drain). Without this
+    # gate each report consumed an attempt instantly — the budget burned to
+    # exhaustion at t+0 and the request was declared failed while the replay
+    # that would have succeeded was still sleeping (observed live in
+    # scripts/failover_drill.sh).
+    if request_id in self._replay_pending:
+      return
+    # 4 x RETRY_DELAY must outlast discovery's eviction of the dead peer (a
+    # collect that still lists it re-targets the replay at the corpse; the
+    # drill showed 2 attempts losing that race on slow health timeouts).
+    retries = int(os.getenv("XOT_TPU_INFLIGHT_RETRIES", "4"))
     attempt = self._replay_attempts.get(request_id, 0)
     if state is None or state.tokens is None or attempt >= retries:
       self._finish_request(request_id)
@@ -358,35 +436,54 @@ class Node:
       tokens, _ = self.buffered_token_output[request_id]
       self.buffered_token_output[request_id] = (tokens, True)
       self.trigger_on_token_callbacks(request_id, [], True)
+      # Tell peers too: the origin (and any other counter) must see the
+      # finish or its per-request dedup state would linger forever.
+      asyncio.create_task(self.broadcast_result(request_id, [], True))
       return
     self._replay_attempts[request_id] = attempt + 1
+    # Held through sleep + forward so concurrent reports no-op; try/finally
+    # because a CancelledError (our caller is often a gRPC handler whose peer
+    # can drop mid-replay) must not leave the id stuck in the gate.
+    self._replay_pending.add(request_id)
     if DEBUG >= 1:
       print(f"[node {self.id}] replaying {request_id} (attempt {attempt + 1}) after peer loss")
     metrics.inc("requests_replayed_total")
-    # Let discovery evict the dead peer and the topology re-derive.
-    await asyncio.sleep(float(os.getenv("XOT_TPU_RETRY_DELAY_S", "3")))
+    retry_state: InferenceState | None = None
     try:
-      await self.update_peers()
-      await self.collect_topology(set())
-    except Exception:  # noqa: BLE001 — collection is best-effort here
-      pass
-    tokens = np.asarray(state.tokens, dtype=np.int32).reshape(1, -1)
-    # The epoch invalidates surviving engines' stale sessions and keeps
-    # traveling with the state across the ring. It derives from the WIRE
-    # state's epoch (not the local attempt counter): a second failure
-    # detected on a *different* node must still produce a new, higher epoch
-    # or survivors would keep their stale sessions. The original prompt
-    # length rides along so the new last-layer owner keeps the client's
-    # max_tokens budget (its local token buffer starts empty after a move).
-    extras = {"replay_epoch": int(state.extras.get("replay_epoch", 0)) + 1}
-    if "orig_prompt_len" in state.extras:
-      extras["orig_prompt_len"] = state.extras["orig_prompt_len"]
-    replay_state = InferenceState(tokens=tokens.copy(), prompt_len=tokens.shape[1], extras=extras)
-    try:
-      head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
-      await self.forward_tensor(base_shard, tokens, request_id, head_idx, replay_state)
-    except Exception:  # noqa: BLE001 — recurse into the next attempt
-      await self._retry_request(base_shard, request_id, replay_state)
+      # Let discovery evict the dead peer and the topology re-derive.
+      await asyncio.sleep(float(os.getenv("XOT_TPU_RETRY_DELAY_S", "3")))
+      try:
+        await self.update_peers()
+        await self.collect_topology(set())
+      except Exception:  # noqa: BLE001 — collection is best-effort here
+        pass
+      tokens = np.asarray(state.tokens, dtype=np.int32).reshape(1, -1)
+      # The epoch invalidates surviving engines' stale sessions and keeps
+      # traveling with the state across the ring. It derives from the WIRE
+      # state's epoch (not the local attempt counter): a second failure
+      # detected on a *different* node must still produce a new, higher epoch
+      # or survivors would keep their stale sessions. The original prompt
+      # length rides along so the new last-layer owner keeps the client's
+      # max_tokens budget (its local token buffer starts empty after a move).
+      extras = {"replay_epoch": int(state.extras.get("replay_epoch", 0)) + 1}
+      if "orig_prompt_len" in state.extras:
+        extras["orig_prompt_len"] = state.extras["orig_prompt_len"]
+      replay_state = InferenceState(tokens=tokens.copy(), prompt_len=tokens.shape[1], extras=extras)
+      try:
+        head_idx = self.get_partition_index(offset=0, owner_of_first_layer=True)
+        await self.forward_tensor(base_shard, tokens, request_id, head_idx, replay_state)
+      except Exception as e:  # noqa: BLE001 — recurse into the next attempt
+        if DEBUG >= 1:
+          print(f"[node {self.id}] replay forward for {request_id} failed: {e!r}")
+        retry_state = replay_state
+    finally:
+      self._replay_pending.discard(request_id)
+    if retry_state is not None:
+      await self._retry_request(base_shard, request_id, retry_state)
+    else:
+      # Replay forwarded successfully: reset the budget so a LATER, separate
+      # failure incident gets the full attempt count (not a lifetime cap).
+      self._replay_attempts.pop(request_id, None)
 
   async def _fast_decode_loop(self, base_shard: Shard, shard: Shard, request_id: str, last_token: int, chunk: int | None = None) -> None:
     """Pipelined fused-chunk decode: chunk N+1 is dispatched (input token
@@ -401,8 +498,10 @@ class Node:
     # response in ONE compiled program (single host/tunnel round-trip).
     if self.request_options.get(request_id, {}).get("stream") is False and hasattr(engine, "generate_oneshot"):
       tokens, _ = self.buffered_token_output[request_id]
+      off = self._completion_offset.get(request_id, 0)
       emit: list[int] = []
-      remaining = max_tokens - len(tokens)
+      start = off + len(tokens)
+      remaining = max_tokens - start
       if remaining > 0:
         # generate_oneshot already trims at the first EOS.
         emit = await engine.generate_oneshot(request_id, shard, last_token, remaining, eos_ids, temp, top_k)
@@ -411,8 +510,8 @@ class Node:
         metrics.inc("tokens_generated_total", len(emit))
         tokens.extend(emit)
       self.buffered_token_output[request_id] = (tokens, True)
-      self.trigger_on_token_callbacks(request_id, emit, True)
-      asyncio.create_task(self.broadcast_result(request_id, emit, True))
+      self.trigger_on_token_callbacks(request_id, emit, True, start_pos=start)
+      asyncio.create_task(self.broadcast_result(request_id, emit, True, start_pos=start))
       self._finish_request(request_id)
       return
 
@@ -423,12 +522,13 @@ class Node:
 
       chunk = int(_os.getenv("XOT_TPU_DECODE_CHUNK", "32"))
 
+    off = self._completion_offset.get(request_id, 0)
     pending = await engine.dispatch_chunk(request_id, shard, chunk, temp, top_k, first_token=last_token)
     while pending is not None:
       if request_id in self.cancelled_requests:
         break
       tokens, _ = self.buffered_token_output[request_id]
-      remaining = max_tokens - len(tokens)
+      remaining = max_tokens - off - len(tokens)
       # Speculatively enqueue the next chunk while we read this one.
       nxt = None
       if remaining > chunk:
@@ -444,12 +544,13 @@ class Node:
         if t in eos_ids:
           hit_eos = True
           break
+      start = off + len(tokens)
       tokens.extend(emit)
-      done = hit_eos or len(tokens) >= max_tokens
+      done = hit_eos or off + len(tokens) >= max_tokens
       self.buffered_token_output[request_id] = (tokens, done)
       if emit or done:
-        self.trigger_on_token_callbacks(request_id, emit, done)
-        asyncio.create_task(self.broadcast_result(request_id, emit, done))
+        self.trigger_on_token_callbacks(request_id, emit, done, start_pos=start)
+        asyncio.create_task(self.broadcast_result(request_id, emit, done, start_pos=start))
       if done:
         break
       pending = nxt
@@ -459,7 +560,7 @@ class Node:
         # budget remains but nothing is in flight, dispatch a continuation
         # now (one non-overlapped dispatch only when speculation fell short).
         tokens, _ = self.buffered_token_output[request_id]
-        remaining = max_tokens - len(tokens)
+        remaining = max_tokens - off - len(tokens)
         if remaining > 0:
           pending = await engine.dispatch_chunk(request_id, shard, min(chunk, remaining), temp, top_k)
 
@@ -488,16 +589,23 @@ class Node:
     server = getattr(self.inference_engine, "_batched_server", None)
     if server is not None:
       server.cancel(request_id)
-    # Bound the set: a forwarding-only node never reaches _finish_request
-    # for this id, so expire the entry after the response timeout horizon.
+    # Bound the sets: a forwarding-only node never reaches _finish_request
+    # for this id, so expire the entries after the response timeout horizon.
     loop = asyncio.get_event_loop()
-    loop.call_later(900, self.cancelled_requests.discard, request_id)
+    loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self.cancelled_requests.discard, request_id)
+    loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self._completion_offset.pop, request_id, None)
+    loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self._seen_epochs.pop, request_id, None)
+    self._expire_dedup_state(request_id)
 
   def _finish_request(self, request_id: str) -> None:
     self.outstanding_requests.pop(request_id, None)
     self.request_options.pop(request_id, None)
     self.cancelled_requests.discard(request_id)
     self._replay_attempts.pop(request_id, None)
+    self._replay_pending.discard(request_id)
+    self._expire_dedup_state(request_id)  # tombstoned against zombie broadcasts, not popped
+    self._completion_offset.pop(request_id, None)
+    self._seen_epochs.pop(request_id, None)
     tracer.end_request(request_id)
     if hasattr(self.inference_engine, "end_request"):
       self.inference_engine.end_request(request_id)
@@ -814,13 +922,102 @@ class Node:
       if DEBUG >= 1:
         traceback.print_exc()
 
-  def trigger_on_token_callbacks(self, request_id: str, tokens: list[int], is_finished: bool) -> None:
-    self._on_token.trigger_all(request_id, tokens, is_finished)
+  def trigger_on_token_callbacks(self, request_id: str, tokens: list[int], is_finished: bool, start_pos: int | None = None) -> None:
+    """Single choke point for client-facing token delivery.
 
-  async def broadcast_result(self, request_id: str, result: list[int], is_finished: bool) -> None:
+    With ``start_pos`` (the absolute completion index of ``tokens[0]``),
+    tokens below the request's high-water mark are dropped as replayed
+    duplicates, and tokens AHEAD of it (deliveries reordered across
+    channels during a failover) are held until the gap fills — the client
+    transcript is always the exact in-order stream. Without a position
+    (status-only events, legacy senders) tokens pass through and advance
+    the mark."""
+    if start_pos is not None and (tokens or is_finished):
+      emitted = self._emitted_counts.get(request_id, 0)
+      if start_pos > emitted:
+        held = self._pending_chunks.setdefault(request_id, {})
+        cur = held.get(start_pos)
+        if cur is None or len(tokens) > len(cur[0]):
+          # Same-start duplicates (zombie vs regenerated stream): keep the
+          # longer span; OR the finish flags so neither signal is lost.
+          held[start_pos] = (list(tokens), is_finished or (cur[1] if cur else False))
+        elif is_finished and not cur[1]:
+          held[start_pos] = (cur[0], True)
+        self._arm_gap_flush(request_id)
+        return
+      skip = emitted - start_pos
+      if skip > 0:
+        tokens = tokens[skip:]
+        if not tokens and not is_finished:
+          return
+        start_pos = emitted
+      self._emitted_counts[request_id] = max(emitted, start_pos + len(tokens))
+    elif tokens:
+      self._emitted_counts[request_id] = self._emitted_counts.get(request_id, 0) + len(tokens)
+    self._on_token.trigger_all(request_id, tokens, is_finished)
+    if is_finished:
+      # Keep the high-water mark as a tombstone so a straggling zombie
+      # broadcast can't reset it and re-deliver the stream; it expires on
+      # the response-timeout horizon (origin nodes never run
+      # _finish_request for remote flows).
+      self._pending_chunks.pop(request_id, None)
+      self._expire_dedup_state(request_id)
+      return
+    # Deliver any held chunk that now abuts or overlaps the advanced mark
+    # (recursion re-applies the duplicate trim and continues the chain).
+    pend = self._pending_chunks.get(request_id)
+    if pend:
+      emitted = self._emitted_counts.get(request_id, 0)
+      for sp in sorted(pend):
+        if sp <= emitted:
+          held_tokens, held_fin = pend.pop(sp)
+          if not pend:
+            self._pending_chunks.pop(request_id, None)
+          self.trigger_on_token_callbacks(request_id, held_tokens, held_fin, start_pos=sp)
+          break
+
+  def _expire_dedup_state(self, request_id: str) -> None:
+    def clear() -> None:
+      self._emitted_counts.pop(request_id, None)
+      self._pending_chunks.pop(request_id, None)
+    try:
+      asyncio.get_running_loop().call_later(RESPONSE_TIMEOUT_HORIZON_S, clear)
+    except RuntimeError:  # no loop (sync callers in tests): clear later is moot
+      pass
+
+  def _arm_gap_flush(self, request_id: str) -> None:
+    """Bound how long held chunks wait for a gap to fill (a lost broadcast
+    would otherwise stall the stream forever): after GAP_FLUSH_S, release
+    everything held in position order, accepting the hole."""
+    if request_id in self._gap_flush_armed:
+      return
+    def flush() -> None:
+      self._gap_flush_armed.discard(request_id)
+      pend = self._pending_chunks.pop(request_id, None)
+      if not pend:
+        return
+      for sp in sorted(pend):
+        held_tokens, held_fin = pend[sp]
+        self._emitted_counts[request_id] = max(self._emitted_counts.get(request_id, 0), sp)  # jump the mark over the hole
+        self.trigger_on_token_callbacks(request_id, held_tokens, held_fin, start_pos=sp)
+    try:
+      asyncio.get_running_loop().call_later(GAP_FLUSH_S, flush)
+      self._gap_flush_armed.add(request_id)
+    except RuntimeError:
+      pass
+
+  def handle_remote_result(self, request_id: str, result, is_finished: bool, start_pos: int | None = None) -> None:
+    """Results arriving over the wire (gRPC SendResult) — token lists route
+    through the dedup choke point; tensor payloads pass straight through."""
+    if isinstance(result, list):
+      self.trigger_on_token_callbacks(request_id, result, is_finished, start_pos=start_pos)
+    else:
+      self._on_token.trigger_all(request_id, result, is_finished)
+
+  async def broadcast_result(self, request_id: str, result: list[int], is_finished: bool, start_pos: int | None = None) -> None:
     async def send_result_to_peer(peer):
       try:
-        await asyncio.wait_for(peer.send_result(request_id, result, is_finished), timeout=15.0)
+        await asyncio.wait_for(peer.send_result(request_id, result, is_finished, start_pos=start_pos), timeout=15.0)
       except Exception:  # noqa: BLE001
         if DEBUG >= 1:
           print(f"[node {self.id}] result broadcast to {peer.id()} failed")
