@@ -173,3 +173,59 @@ async def test_two_node_grpc_ring_training():
   finally:
     for node in nodes:
       await node.stop()
+
+
+def test_moe_span_chain_matches_full_model_step_with_aux():
+  """Ring MoE training carries the load-balancing aux loss exactly: the
+  chained spans' loss and updated params equal the single-node step that
+  optimizes CE + moe_aux_loss_coef * sum(aux) (VERDICT r2 #6 — previously
+  the aux was silently dropped on the cache-less span path)."""
+  from xotorch_support_jetson_tpu.models.decoder import shard_forward_aux
+  from xotorch_support_jetson_tpu.train.trainer import engine_pop_span_aux
+
+  cfg = tiny_test_config(
+    n_layers=4, max_seq_len=64, n_experts=4, n_active_experts=2,
+    moe_hidden_dim=32, moe_aux_loss_coef=0.01,
+  )
+  params, _ = full_model_params(jax.random.PRNGKey(6), cfg)
+  rng = np.random.default_rng(1)
+  B, S = 2, 8
+  inputs = rng.integers(1, cfg.vocab_size, size=(B, S)).astype(np.int32)
+  targets = rng.integers(1, cfg.vocab_size, size=(B, S)).astype(np.int32)
+  lengths = np.asarray([S, S - 2], np.int32)
+
+  # Reference: one full-model adamw step on CE + coef * aux.
+  full = Shard("m", 0, cfg.n_layers - 1, cfg.n_layers)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+  mask = jnp.asarray((np.arange(S)[None, :] < lengths[:, None]).astype(np.float32))
+
+  def loss_fn(p):
+    logits, aux = shard_forward_aux(p, cfg, full, jnp.asarray(inputs), positions)
+    return cross_entropy_loss(logits, jnp.asarray(targets), mask) + cfg.moe_aux_loss_coef * aux
+
+  ref_loss, grads = jax.value_and_grad(loss_fn)(params)
+  opt = optax.adamw(1e-2)
+  updates, _ = opt.update(grads, opt.init(params), params)
+  ref_params = optax.apply_updates(params, updates)
+
+  # Ring chain over two spans.
+  split = 2
+  s0 = Shard("m", 0, split - 1, cfg.n_layers)
+  s1 = Shard("m", split, cfg.n_layers - 1, cfg.n_layers)
+  e0 = SimpleNamespace(params=slice_shard_params(params, cfg, full, s0), cfg=cfg)
+  e1 = SimpleNamespace(params=slice_shard_params(params, cfg, full, s1), cfg=cfg)
+
+  # The head span's own aux must be nonzero or this test proves nothing.
+  _, aux0 = shard_forward_aux(e0.params, cfg, s0, jnp.asarray(inputs), positions)
+  assert float(aux0) > 0.0
+
+  h = engine_forward_span(e0, s0, inputs, "r-moe", train=True)
+  tail_loss, d_h = engine_last_span_step(e1, s1, h, targets, lengths, train=True, lr=1e-2)
+  ring_loss = tail_loss + engine_pop_span_aux(e0, "r-moe")
+  engine_backward_span(e0, s0, d_h, "r-moe", lr=1e-2)
+
+  np.testing.assert_allclose(ring_loss, float(ref_loss), rtol=1e-5)
+  ref0 = slice_shard_params(ref_params, cfg, full, s0)
+  ref1 = slice_shard_params(ref_params, cfg, full, s1)
+  for got, want in ((e0.params, ref0), (e1.params, ref1)):
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5), got, want)

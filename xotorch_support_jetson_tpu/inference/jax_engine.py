@@ -888,6 +888,14 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     engine_discard_span(self, request_id)
 
+  def pop_span_aux(self, request_id) -> float:
+    """This span's coef-scaled MoE aux loss (0.0 for dense models): the Node
+    adds it to the loss riding the ring reply so the reported training loss
+    equals the single-node CE + moe_aux_loss_coef * sum(aux) objective."""
+    from ..train.trainer import engine_pop_span_aux
+
+    return engine_pop_span_aux(self, request_id)
+
   async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
     if self._pp is not None:
       raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")

@@ -524,6 +524,39 @@ jit_shard_forward = partial(jax.jit, static_argnames=("cfg", "shard"))(
 )
 
 
+def shard_forward_aux(
+  params: Params,
+  cfg: ModelConfig,
+  shard: Shard,
+  x: jnp.ndarray,
+  positions: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Cache-less ``shard_forward`` that also returns the span's accumulated
+  MoE load-balancing aux loss (0.0 for dense layers).
+
+  The ring-training spans (train/trainer.py) use this so each span folds its
+  OWN layers' aux gradient into its local update and adds ``coef·aux`` to
+  the loss riding the ring reply — making ring training of MoE models
+  exactly equivalent to the single-node step, which optimizes
+  ``CE + moe_aux_loss_coef · Σ aux`` (parallel/train_step.py).
+  """
+  h = embed_tokens(params, cfg, x) if x.ndim == 2 else x.astype(cfg.dtype)
+  inv_freq = rope_inv_freq(cfg)
+  kv_positions = positions[0]
+
+  def body(carry, lp):
+    h, a = carry
+    h, _, _, aux = _layer_step(h, lp, None, None, positions, kv_positions, inv_freq, cfg, False)
+    return (h, a + aux), None
+
+  a = jnp.float32(0.0)
+  for stack in (params[name] for name in ("layers", "moe_layers") if name in params):
+    (h, a), _ = jax.lax.scan(body, (h, a), stack)
+  if shard.is_last_layer:
+    return head_logits(params, cfg, h), a
+  return h, a
+
+
 def _next_token(row, key, greedy: bool, temp, top_k: int):
   """greedy is STATIC (two compiled variants); temp is TRACED — client
   temperatures must not key the jit cache, or each distinct value would

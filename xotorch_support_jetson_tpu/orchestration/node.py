@@ -84,6 +84,7 @@ class Node:
     self.cancelled_requests: set[str] = set()
     self._replay_attempts: dict[str, int] = {}
     self._replay_pending: set[str] = set()  # requests with a replay in flight (coalesce concurrent failure reports)
+    self._replay_lifetime: dict[str, int] = {}  # total replays per request (never resets; termination backstop)
     # Client-stream replay dedup (VERDICT r2 #5): every token delivery
     # carries the absolute completion index of its first token; a receiver
     # delivers only tokens at/above its high-water mark, so a failover that
@@ -96,7 +97,7 @@ class Node:
     # bumped replay_epoch so a surviving node resets its stale local buffer.
     self._emitted_counts: dict[str, int] = {}
     self._pending_chunks: dict[str, dict[int, tuple[list[int], bool]]] = {}  # ahead-of-mark deliveries held for in-order release
-    self._gap_flush_armed: set[str] = set()  # requests with a pending gap-flush timer
+    self._gap_flush_timers: dict[str, asyncio.TimerHandle] = {}  # armed gap-flush timers per request
     self._completion_offset: dict[str, int] = {}
     self._seen_epochs: dict[str, int] = {}
     self.buffered_inputs: dict[str, list] = {}
@@ -429,7 +430,11 @@ class Node:
     # drill showed 2 attempts losing that race on slow health timeouts).
     retries = int(os.getenv("XOT_TPU_INFLIGHT_RETRIES", "4"))
     attempt = self._replay_attempts.get(request_id, 0)
-    if state is None or state.tokens is None or attempt >= retries:
+    # The per-incident budget resets after a successful replay; the LIFETIME
+    # cap does not — a flapping peer that accepts every replay forward but
+    # fails every hop must still terminate with a finish event.
+    lifetime = self._replay_lifetime.get(request_id, 0)
+    if state is None or state.tokens is None or attempt >= retries or lifetime >= 4 * retries:
       self._finish_request(request_id)
       print(f"[node {self.id}] request {request_id} failed after {attempt} replay attempts")
       self.buffered_token_output.setdefault(request_id, ([], False))
@@ -441,6 +446,7 @@ class Node:
       asyncio.create_task(self.broadcast_result(request_id, [], True))
       return
     self._replay_attempts[request_id] = attempt + 1
+    self._replay_lifetime[request_id] = lifetime + 1
     # Held through sleep + forward so concurrent reports no-op; try/finally
     # because a CancelledError (our caller is often a gRPC handler whose peer
     # can drop mid-replay) must not leave the id stuck in the gate.
@@ -602,6 +608,7 @@ class Node:
     self.request_options.pop(request_id, None)
     self.cancelled_requests.discard(request_id)
     self._replay_attempts.pop(request_id, None)
+    self._replay_lifetime.pop(request_id, None)
     self._replay_pending.discard(request_id)
     self._expire_dedup_state(request_id)  # tombstoned against zombie broadcasts, not popped
     self._completion_offset.pop(request_id, None)
@@ -713,16 +720,19 @@ class Node:
       next_shard = self.get_current_shard(base_shard, next_idx)
       target_id = self.partitioning_strategy.partition(self.topology)[next_idx].node_id
       peer = next((p for p in self.peers if p.id() == target_id), None)
+      discard = getattr(self.inference_engine, "discard_span", lambda _rid: None)
       if peer is None:
-        if train:
-          self.inference_engine.discard_span(request_id)
+        discard(request_id)  # drops the stashed VJP (train) and aux (both modes)
         raise ValueError(f"downstream training peer {target_id} not found")
       try:
         loss, d_out = await peer.send_example(next_shard, h, target, length, train, request_id)
       except Exception:
-        if train:
-          self.inference_engine.discard_span(request_id)
+        discard(request_id)
         raise
+      # This span's MoE load-balancing aux joins the loss on the way back —
+      # by the time the reply reaches the caller it equals the single-node
+      # CE + coef*sum(aux) objective (train/trainer.py ring section).
+      loss = float(loss) + getattr(self.inference_engine, "pop_span_aux", lambda _rid: 0.0)(request_id)
       if not train:
         return float(loss), None
       d_in = await self.inference_engine.backward_span(request_id, shard, d_out)
@@ -961,6 +971,7 @@ class Node:
       # the response-timeout horizon (origin nodes never run
       # _finish_request for remote flows).
       self._pending_chunks.pop(request_id, None)
+      self._disarm_gap_flush(request_id)
       self._expire_dedup_state(request_id)
       return
     # Deliver any held chunk that now abuts or overlaps the advanced mark
@@ -975,6 +986,8 @@ class Node:
             self._pending_chunks.pop(request_id, None)
           self.trigger_on_token_callbacks(request_id, held_tokens, held_fin, start_pos=sp)
           break
+    if request_id not in self._pending_chunks:
+      self._disarm_gap_flush(request_id)  # gap filled naturally
 
   def _expire_dedup_state(self, request_id: str) -> None:
     def clear() -> None:
@@ -988,11 +1001,13 @@ class Node:
   def _arm_gap_flush(self, request_id: str) -> None:
     """Bound how long held chunks wait for a gap to fill (a lost broadcast
     would otherwise stall the stream forever): after GAP_FLUSH_S, release
-    everything held in position order, accepting the hole."""
-    if request_id in self._gap_flush_armed:
+    everything held in position order, accepting the hole. The timer is
+    cancelled when the gap fills naturally (_disarm_gap_flush) so a stale
+    timer can never force-flush a LATER hole early."""
+    if request_id in self._gap_flush_timers:
       return
     def flush() -> None:
-      self._gap_flush_armed.discard(request_id)
+      self._gap_flush_timers.pop(request_id, None)
       pend = self._pending_chunks.pop(request_id, None)
       if not pend:
         return
@@ -1001,10 +1016,14 @@ class Node:
         self._emitted_counts[request_id] = max(self._emitted_counts.get(request_id, 0), sp)  # jump the mark over the hole
         self.trigger_on_token_callbacks(request_id, held_tokens, held_fin, start_pos=sp)
     try:
-      asyncio.get_running_loop().call_later(GAP_FLUSH_S, flush)
-      self._gap_flush_armed.add(request_id)
+      self._gap_flush_timers[request_id] = asyncio.get_running_loop().call_later(GAP_FLUSH_S, flush)
     except RuntimeError:
       pass
+
+  def _disarm_gap_flush(self, request_id: str) -> None:
+    handle = self._gap_flush_timers.pop(request_id, None)
+    if handle is not None:
+      handle.cancel()
 
   def handle_remote_result(self, request_id: str, result, is_finished: bool, start_pos: int | None = None) -> None:
     """Results arriving over the wire (gRPC SendResult) — token lists route

@@ -89,13 +89,18 @@ def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") 
 # the RPC *reply* carries (loss, d_activations) back up, and every node
 # applies its own optimizer update to its own span — elementwise optimizers
 # (adamw/sgd) make this exactly equivalent to a single-node full-model step.
-# MoE load-balancing aux loss is omitted on this path (the cache-less
-# shard_forward discards per-layer aux); dense and LoRA models are exact.
+# MoE load-balancing aux: each span folds its OWN layers' aux gradient into
+# its local update (the aux term is local to the span's params plus the
+# activation chain, which the ring cotangent already carries) and adds
+# coef·aux to the loss scalar riding the reply — so ring MoE training is
+# exactly the single-node CE + moe_aux_loss_coef·Σaux step, with no extra
+# wire traffic.
 
 
 class _RingState:
   def __init__(self):
     self.vjps: dict = {}  # request_id -> (vjp_fn, is_first_layer)
+    self.aux: dict = {}  # request_id -> this span's coef-scaled MoE aux loss (float)
     self.opt = None
     self.opt_state = None
 
@@ -131,10 +136,12 @@ def engine_forward_span(engine, shard, x, request_id: str, train: bool) -> np.nd
   """Forward a non-last span: tokens (first shard) or activations → hidden.
 
   With ``train`` the VJP closure is stashed under ``request_id`` for the
-  backward hop (``engine_backward_span``)."""
+  backward hop (``engine_backward_span``). The span's coef-scaled MoE aux
+  loss is stashed either way — the Node adds it to the loss scalar riding
+  the ring reply (``pop_span_aux``)."""
   import jax.numpy as jnp
 
-  from ..models.decoder import shard_forward
+  from ..models.decoder import shard_forward_aux
 
   cfg = engine.cfg
   x = jnp.asarray(np.asarray(x))
@@ -143,30 +150,42 @@ def engine_forward_span(engine, shard, x, request_id: str, train: bool) -> np.nd
   positions = _span_positions(x)
 
   def fwd(params, x):
-    return shard_forward(params, cfg, shard, x, positions, None)[0]
+    return shard_forward_aux(params, cfg, shard, x, positions)
 
   if train:
-    h, vjp_fn = jax.vjp(fwd, engine.params, x)
+    (h, aux), vjp_fn = jax.vjp(fwd, engine.params, x)
     _ring_state(engine).vjps[request_id] = (vjp_fn, shard.is_first_layer)
   else:
-    h = fwd(engine.params, x)
+    h, aux = fwd(engine.params, x)
+  _ring_state(engine).aux[request_id] = float(cfg.moe_aux_loss_coef * jax.device_get(aux))
   return jax.device_get(h)
 
 
 def engine_backward_span(engine, shard, d_out, request_id: str, opt: str = "adamw", lr: float = 1e-5) -> np.ndarray | None:
   """Backward through a stashed span: applies this span's optimizer update,
-  returns d_input activations (None on the first shard — nothing upstream)."""
+  returns d_input activations (None on the first shard — nothing upstream).
+
+  The aux output's cotangent is ``moe_aux_loss_coef`` — exactly the weight
+  the single-node objective gives the aux term — so each span's update
+  carries its own load-balancing gradient locally."""
   import jax.numpy as jnp
 
   vjp_fn, is_first = _ring_state(engine).vjps.pop(request_id)
-  grads, d_x = vjp_fn(jnp.asarray(np.asarray(d_out)).astype(engine.cfg.dtype))
+  cot = (jnp.asarray(np.asarray(d_out)).astype(engine.cfg.dtype), jnp.float32(engine.cfg.moe_aux_loss_coef))
+  grads, d_x = vjp_fn(cot)
   _ring_update(engine, grads, lr, opt)
   return None if is_first else jax.device_get(d_x)
+
+
+def engine_pop_span_aux(engine, request_id: str) -> float:
+  """This span's coef-scaled aux loss for the ring reply (0.0 for dense)."""
+  return _ring_state(engine).aux.pop(request_id, 0.0)
 
 
 def engine_discard_span(engine, request_id: str) -> None:
   """Drop a stashed VJP (downstream hop failed)."""
   _ring_state(engine).vjps.pop(request_id, None)
+  _ring_state(engine).aux.pop(request_id, None)
 
 
 def engine_last_span_step(engine, shard, h, targets, lengths, train: bool, opt: str = "adamw", lr: float = 1e-5) -> tuple[float, np.ndarray | None]:
@@ -174,7 +193,7 @@ def engine_last_span_step(engine, shard, h, targets, lengths, train: bool, opt: 
   span and return d_activations for the upstream reply."""
   import jax.numpy as jnp
 
-  from ..models.decoder import shard_forward
+  from ..models.decoder import shard_forward_aux
   from ..parallel.train_step import cross_entropy_loss
 
   cfg = engine.cfg
@@ -186,8 +205,8 @@ def engine_last_span_step(engine, shard, h, targets, lengths, train: bool, opt: 
   positions = _span_positions(h)
 
   def loss_fn(params, h):
-    logits, _ = shard_forward(params, cfg, shard, h, positions, None)
-    return cross_entropy_loss(logits, targets, mask)
+    logits, aux = shard_forward_aux(params, cfg, shard, h, positions)
+    return cross_entropy_loss(logits, targets, mask) + cfg.moe_aux_loss_coef * aux
 
   if not train:
     return float(jax.device_get(loss_fn(engine.params, h))), None
