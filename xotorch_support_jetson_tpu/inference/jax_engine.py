@@ -177,6 +177,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
       start = round(shard.start_layer * cfg.n_layers / shard.n_layers)
       end = round((shard.end_layer + 1) * cfg.n_layers / shard.n_layers) - 1
       eff = Shard(shard.model_id, start, max(start, end), cfg.n_layers)
+    # Ahead-of-time HBM budget (SURVEY §7): refuse BEFORE reading weights if
+    # this (remapped) shard cannot fit the local chips under the plan the
+    # engine will actually build (_planned_mesh — single source of truth).
+    self._check_hbm_budget(self._planned_mesh(cfg), cfg=cfg, shard=eff)
     self.params = load_shard_weights(model_dir, cfg, eff)
     if self.quant:
       from ..models.quantize import quantize_params
@@ -226,6 +230,42 @@ class JaxShardedInferenceEngine(InferenceEngine):
         cap = min(cap, cfg.rope_scaling.original_max_position_embeddings)
     return cap
 
+  def _planned_mesh(self, cfg=None):
+    """The serving plan this engine will build for the loaded model — the
+    SINGLE source of truth shared by the pre-load HBM check and
+    _maybe_shard_over_local_mesh (so the validated plan is the built plan)."""
+    from ..parallel.mesh import MeshPlan, inference_plan, pow2_degree
+
+    cfg = cfg or self.cfg
+    n = len(jax.devices())
+    sp = int(os.getenv("XOT_TPU_SP", "0") or 0)
+    if sp > 1:
+      return MeshPlan(sp=sp, tp=pow2_degree(max(n // sp, 1), cfg.n_heads))
+    if self.pp > 1:
+      return MeshPlan(pp=self.pp, tp=pow2_degree(max(n // self.pp, 1), cfg.n_heads))
+    if self.use_local_mesh and n > 1:
+      return inference_plan(n, n_heads=cfg.n_heads, n_experts=cfg.n_experts or 0)
+    return MeshPlan()
+
+  def _check_hbm_budget(self, plan, cfg=None, shard=None) -> None:
+    """Refuse a serving plan that cannot fit BEFORE any compile (SURVEY §7
+    ahead-of-time budgeting; the reference dropped the model after the OOM).
+    No-op when the backend doesn't report HBM (CPU/virtual meshes) or when
+    disabled via XOT_TPU_HBM_CHECK=0."""
+    if os.getenv("XOT_TPU_HBM_CHECK", "1") in ("0", "false"):
+      return
+    from ..parallel.hbm_planner import check_plan, device_hbm_bytes
+
+    hbm = device_hbm_bytes()
+    if hbm is None:
+      return
+    cfg = cfg or self.cfg
+    shard = shard or getattr(self, "_effective_shard", self.shard)
+    max_seq = min(self.max_seq_len, cfg.max_seq_len)
+    check_plan(cfg, plan, len(jax.devices()), hbm, batch=1, max_seq=max_seq, quant=self.quant, shard=shard)
+    if DEBUG >= 1:
+      print(f"[jax_engine] HBM budget ok for plan {plan.describe()}")
+
   def _maybe_shard_over_local_mesh(self) -> None:
     sp = int(os.getenv("XOT_TPU_SP", "0") or 0)
     if sp > 1:
@@ -247,8 +287,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
       # Leftover chips go to tp: weights shard megatron-style over tp while
       # the cache shards over sp, so long context stops paying sp x the
       # weight HBM (VERDICT r2 weak #3).
-      tp = pow2_degree(n // sp, self.cfg.n_heads)
-      self.mesh = build_mesh(MeshPlan(sp=sp, tp=tp))
+      plan = self._planned_mesh()
+      self._check_hbm_budget(plan)
+      self.mesh = build_mesh(plan)
       eff = getattr(self, "_effective_shard", self.shard)
       self._pp = SPServing(self.mesh, self.cfg, self.params, sp, eff.is_first_layer, eff.is_last_layer)
       self.params = None
@@ -268,8 +309,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
         raise ValueError("XOT_TPU_PP pipeline serving does not support vision models yet")
       from ..parallel.mesh import pow2_degree
 
-      tp = pow2_degree(n // self.pp, self.cfg.n_heads)
-      self.mesh = build_mesh(MeshPlan(pp=self.pp, tp=tp))
+      plan = self._planned_mesh()
+      self._check_hbm_budget(plan)
+      self.mesh = build_mesh(plan)
       eff = getattr(self, "_effective_shard", self.shard)
       self._pp = PPServing(self.mesh, self.cfg, self.params, self.pp, eff.is_first_layer, eff.is_last_layer)
       # The pp-placed stage/head copies are the serving params; drop the
@@ -281,7 +323,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
       return
     from ..parallel.mesh import build_mesh, inference_plan, shard_params
 
-    plan = inference_plan(len(jax.devices()), n_heads=self.cfg.n_heads, n_experts=self.cfg.n_experts or 0)
+    plan = self._planned_mesh()
+    self._check_hbm_budget(plan)
     self.mesh = build_mesh(plan)
     self.params = shard_params(self.params, self.mesh)
 

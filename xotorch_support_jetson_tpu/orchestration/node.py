@@ -729,10 +729,13 @@ class Node:
       except Exception:
         discard(request_id)
         raise
-      # This span's MoE load-balancing aux joins the loss on the way back —
-      # by the time the reply reaches the caller it equals the single-node
-      # CE + coef*sum(aux) objective (train/trainer.py ring section).
-      loss = float(loss) + getattr(self.inference_engine, "pop_span_aux", lambda _rid: 0.0)(request_id)
+      # This span's MoE load-balancing aux joins the TRAINING loss on the way
+      # back — the reply then equals the single-node CE + coef*sum(aux)
+      # objective (train/trainer.py ring section). Eval stays pure CE like
+      # single-node make_eval_step; the stash is popped either way.
+      aux = getattr(self.inference_engine, "pop_span_aux", lambda _rid: 0.0)(request_id)
+      if train:
+        loss = float(loss) + aux
       if not train:
         return float(loss), None
       d_in = await self.inference_engine.backward_span(request_id, shard, d_out)
@@ -987,7 +990,13 @@ class Node:
           self.trigger_on_token_callbacks(request_id, held_tokens, held_fin, start_pos=sp)
           break
     if request_id not in self._pending_chunks:
-      self._disarm_gap_flush(request_id)  # gap filled naturally
+      self._disarm_gap_flush(request_id)  # all gaps filled naturally
+    elif start_pos is not None and tokens:
+      # Progress was made but a LATER hole still blocks held chunks: restart
+      # the window so that hole gets its own full GAP_FLUSH_S, not the stale
+      # remainder of the previous hole's timer.
+      self._disarm_gap_flush(request_id)
+      self._arm_gap_flush(request_id)
 
   def _expire_dedup_state(self, request_id: str) -> None:
     def clear() -> None:

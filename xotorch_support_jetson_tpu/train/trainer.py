@@ -206,7 +206,9 @@ def engine_last_span_step(engine, shard, h, targets, lengths, train: bool, opt: 
 
   def loss_fn(params, h):
     logits, aux = shard_forward_aux(params, cfg, shard, h, positions)
-    return cross_entropy_loss(logits, targets, mask) + cfg.moe_aux_loss_coef * aux
+    # Aux joins the objective only when TRAINING — single-node eval is pure
+    # CE (make_eval_step), and ring eval must report the same number.
+    return cross_entropy_loss(logits, targets, mask) + (cfg.moe_aux_loss_coef * aux if train else 0.0)
 
   if not train:
     return float(jax.device_get(loss_fn(engine.params, h))), None
