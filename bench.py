@@ -235,6 +235,7 @@ def main() -> None:
   # number — reported alongside so the trade is visible.
   spec_tok_s = None
   spec_acceptance = None
+  spec_vs_plain = None
   if on_accel:
     from xotorch_support_jetson_tpu.models.decoder import fused_speculative_generate
 
@@ -257,6 +258,12 @@ def main() -> None:
     sn, srounds = int(sn), max(int(srounds), 1)
     spec_tok_s = round(min(sn, n_decode) / (time.perf_counter() - t0), 2)
     spec_acceptance = round((sn / srounds - 1) / gamma, 3)
+    # Self-describing record: on these RANDOM weights acceptance is a FLOOR
+    # (near-uniform logits flip under int8 noise); the engine's load-time
+    # autocalibration (XOT_TPU_SPEC_AUTOCAL) disables the mode when plain
+    # wins, so a sub-1.0 ratio here is a measured demotion, not a shipped
+    # regression.
+    spec_vs_plain = round(spec_tok_s / serving_tok_s, 3) if serving_tok_s else None
 
   # Pipeline-parallel serving decode (parallel/pp_serving.py): only runs when
   # the host exposes >=2 accelerator chips (the driver's bench env tunnels one
@@ -413,6 +420,7 @@ def main() -> None:
         "paged_batch16_aggregate_tok_s": paged16_tok_s,
         "spec_decode_tok_s": spec_tok_s,
         "spec_acceptance": spec_acceptance,
+        "spec_vs_plain": spec_vs_plain,
         "int8_8b_decode_tok_s": int8_8b_tok_s,
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,

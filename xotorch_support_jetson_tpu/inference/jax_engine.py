@@ -200,9 +200,11 @@ class JaxShardedInferenceEngine(InferenceEngine):
     if DEBUG >= 1:
       print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
 
-  def _maybe_build_draft(self) -> None:
+  def _maybe_build_draft(self, calibrate: bool = True) -> None:
     """Self-speculative int8 draft: same weights, half the HBM bytes per
-    step. Requires a full-model shard (sampling feeds the next embed)."""
+    step. Requires a full-model shard (sampling feeds the next embed).
+    ``calibrate=False`` (test-model injection) skips the load-time A/B so
+    tests exercise the speculative path deterministically."""
     self._draft_params = None
     eff = getattr(self, "_effective_shard", None)
     if self.spec_decode != "int8" or eff is None or not (eff.is_first_layer and eff.is_last_layer) or self.params is None:
@@ -212,6 +214,72 @@ class JaxShardedInferenceEngine(InferenceEngine):
     from ..models.quantize import quantize_params
 
     self._draft_params = quantize_params(self.params)
+    if calibrate:
+      self._maybe_calibrate_spec()
+
+  def _maybe_calibrate_spec(self) -> None:
+    """Gate speculative decoding on MEASURED benefit (VERDICT r2 #4): low
+    acceptance (poorly-quantizing or random-like weights) makes speculation
+    strictly slower than plain decode, so the mode must not advertise itself
+    on hope. A quick on-device A/B at load disables it with a log line when
+    plain wins. Decode is weight-bandwidth-bound, so a SMALL calibration
+    cache (tiny compiles, tiny HBM) still measures the serving-relevant
+    ratio; caches go through _place_cache so multi-chip layouts time the
+    real sharded execution. Skipped on CPU (tests/dev) and via
+    XOT_TPU_SPEC_AUTOCAL=0; the demotion clears only the per-MODEL draft,
+    so the next loaded model recalibrates."""
+    if jax.devices()[0].platform == "cpu" or os.getenv("XOT_TPU_SPEC_AUTOCAL", "1") in ("0", "false"):
+      return
+    import time as _time
+
+    from ..models.decoder import fused_decode, fused_speculative_generate
+
+    eff = self._effective_shard
+    cfg = self.cfg
+    n = 64
+    max_seq = min(256, self.max_seq_len, cfg.max_seq_len)
+    tok = jnp.ones((1, 1), jnp.int32)
+
+    def time_plain() -> float:
+      cache = self._place_cache(init_kv_cache(cfg, eff.n_shard_layers, 1, max_seq))
+      toks, cache = fused_decode(self.params, cfg, eff, tok, cache, jnp.zeros((1,), jnp.int32), n)
+      _ = np.asarray(toks)  # warm compile + honest fetch
+      best = 0.0
+      for start in (n, 2 * n):  # best-of-2: one readback's jitter must not decide the verdict
+        t0 = _time.perf_counter()
+        toks, cache = fused_decode(self.params, cfg, eff, tok, cache, jnp.full((1,), start, jnp.int32), n)
+        _ = np.asarray(toks)
+        best = max(best, n / (_time.perf_counter() - t0))
+      return best
+
+    def time_spec() -> float:
+      def run() -> float:
+        ct = self._place_cache(init_kv_cache(cfg, eff.n_shard_layers, 1, max_seq))
+        cd = self._place_cache(init_kv_cache(cfg, eff.n_shard_layers, 1, max_seq))
+        t0 = _time.perf_counter()
+        buf, m, rounds, ct, cd = fused_speculative_generate(
+          self.params, cfg, eff, self._draft_params, cfg, eff, tok, ct, cd, 0, n, gamma=self.spec_gamma, eos_ids=(-1,)
+        )
+        _ = np.asarray(buf)
+        return min(int(np.asarray(m)), n) / (_time.perf_counter() - t0)
+
+      run()  # warm compile
+      return max(run(), run())
+
+    try:
+      plain_tok_s, spec_tok_s = time_plain(), time_spec()
+    except Exception as e:  # noqa: BLE001 — calibration must never block serving
+      if DEBUG >= 1:
+        print(f"[jax_engine] spec calibration failed ({e!r}); keeping speculative mode")
+      return
+    if spec_tok_s < 0.95 * plain_tok_s:
+      print(
+        f"[jax_engine] speculative decode DISABLED for this model: measured {spec_tok_s:.1f} tok/s vs plain "
+        f"{plain_tok_s:.1f} (low draft acceptance); set XOT_TPU_SPEC_AUTOCAL=0 to force it"
+      )
+      self._draft_params = None
+    elif DEBUG >= 1:
+      print(f"[jax_engine] speculative decode kept: {spec_tok_s:.1f} vs plain {plain_tok_s:.1f} tok/s")
 
   def _serving_cap(self, cfg) -> int:
     """The effective serving max_seq_len for a loaded config.
@@ -356,7 +424,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = cfg
     self.params = params
     self.tokenizer = tokenizer
-    self._maybe_build_draft()
+    self._maybe_build_draft(calibrate=False)  # tests must exercise the spec path deterministically
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
 
@@ -578,6 +646,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
     session.spec_pos_dev = new_pos
     session.spec_inflight_slots += worst
     session.next_token_dev = None  # plain chain broken while spec is active
+    # Double-buffered readback (NOTES r2 item 3): enqueue the device->host
+    # copy NOW, behind the compute — read_chunk's fetch then completes
+    # immediately instead of paying the full tunnel RTT after the chunk.
+    try:
+      packed.copy_to_host_async()
+    except AttributeError:  # backend without async copies
+      pass
     return ("spec", request_id, worst, packed)
 
   def _dispatch_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
@@ -631,6 +706,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
       )
     session.next_token_dev = toks[:, -1:]
     session.curr_pos += n_steps
+    try:
+      toks.copy_to_host_async()  # overlap the readback with the next chunk's compute
+    except AttributeError:
+      pass
     return toks
 
   async def generate_oneshot(
