@@ -150,24 +150,104 @@ def test_pp_paged_batch_decode_matches_single_device(flavor):
   np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
 
 
-def test_pp_batch_rejects_dense_prefix_moe():
-  cfg = tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2, moe_hidden_dim=32, first_k_dense=2)
-  params, _ = full_model_params(jax.random.PRNGKey(1), cfg, "m")
-  with pytest.raises(ValueError, match="dense-prefix"):
-    PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+@pytest.mark.parametrize("mla", [False, True], ids=["gqa", "mla"])
+@pytest.mark.parametrize("paged", [False, True], ids=["dense-cache", "paged"])
+def test_pp_batch_dense_prefix_moe_matches_single_device(paged, mla):
+  """deepseek-style first_k_dense models through the batched pipeline: the
+  dense prefix runs at stage 0 with a stage-owned cache — token-identical to
+  the single-device fused paths (round-3 composition; previously refused).
+  The mla variant is the REAL deepseek shape: MLA latent cache + dense
+  prefix + MoE stack."""
+  mla_kw = dict(n_heads=4, n_kv_heads=4, kv_lora_rank=16, q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16) if mla else {}
+  cfg = tiny_test_config(
+    n_layers=6, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2,
+    moe_hidden_dim=32, first_k_dense=2, **mla_kw,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(13), cfg, "m")
+  ppb = PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+  assert ppb.n_prefix == 2
+  n_steps = 6
+  tok_args = (jnp.full((4,), 35, jnp.int32), n_steps)
+  pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  active = jnp.asarray([True, True, False, True])
+  temps = jnp.zeros((4,), jnp.float32)
+  if paged:
+    pool_ref, bt, firsts_ref = _prefill_paged(params, cfg, shard, PROMPTS)
+    pool_pp, _, firsts_pp = _prefill_paged(params, cfg, shard, PROMPTS, ppb)
+    assert firsts_pp == firsts_ref
+    tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
+    ref_toks, _, pool_ref = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
+    pp_toks, _, pool_pp = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, *tok_args, page_size=PS)
+  else:
+    cache_ref, firsts_ref = _prefill_dense(params, cfg, shard, PROMPTS)
+    cache_pp, firsts_pp = _prefill_dense(params, cfg, shard, PROMPTS, ppb)
+    assert firsts_pp == firsts_ref
+    tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
+    ref_toks, _, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+    pp_toks, _, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, *tok_args)
+  np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
+  # Second chunk: the prefix cache's decode-time writes (stage-owned slices)
+  # must land where the next chunk reads them.
+  tok2 = jnp.asarray(np.asarray(ref_toks)[:, -1:])
+  pos2 = jnp.where(active, pos + n_steps, pos)
+  if paged:
+    ref2, _, _ = fused_paged_batch_decode(params, cfg, shard, tok2, pool_ref, bt, pos2, active, temps, n_steps, page_size=PS, use_kernel=False)
+    pp2, _, _ = ppb.paged_batch_decode(tok2, pool_pp, bt, pos2, active, temps, *tok_args, page_size=PS)
+  else:
+    ref2, _, _ = fused_batch_decode(params, cfg, shard, tok2, cache_ref, pos2, active, temps, n_steps)
+    pp2, _, _ = ppb.batch_decode(tok2, cache_pp, pos2, active, temps, *tok_args)
+  np.testing.assert_array_equal(np.asarray(pp2), np.asarray(ref2))
 
 
-def test_supports_batched_gates_dense_prefix_moe_under_pp():
-  """The Node's batched eligibility check consults engine.supports_batched:
-  dense-prefix MoE under PP falls back to the plain serving path instead of
-  erroring per request."""
+def test_pp_batch_dense_prefix_paged_prefix_reuse_is_exact():
+  """The scheduler's shared-prefix admission (prefill_into_pages with
+  prefix_len > 0) through the dense-prefix pipeline: a request admitted on
+  top of another's cached prompt pages produces the same last-token logits
+  as the single-device path."""
+  cfg = tiny_test_config(
+    n_layers=6, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2,
+    moe_hidden_dim=32, first_k_dense=2,
+  )
+  params, shard = full_model_params(jax.random.PRNGKey(17), cfg, "m")
+  ppb = PPBatchedServing(build_mesh(MeshPlan(pp=2)), cfg, params, 2)
+  rng = np.random.default_rng(2)
+  mp = MAX_SEQ // PS
+  prompt = rng.integers(0, cfg.vocab_size, size=(2 * PS + 4,)).astype(np.int32)
+
+  def run(prefill_fn, pool):
+    bt_full = np.zeros((mp,), np.int32)
+    bt_full[:4] = [1, 2, 3, 4]
+    pad = np.zeros((1, 48), np.int32)
+    pad[0, : len(prompt)] = prompt
+    last_full, pool = prefill_fn(jnp.asarray(pad), pool, jnp.asarray(bt_full), 0, len(prompt), PS)
+    # Second request: same first 2 pages, different private tail.
+    bt_new = np.zeros((mp,), np.int32)
+    bt_new[:4] = [1, 2, 5, 6]
+    suffix = np.zeros((1, 16), np.int32)
+    suffix[0, :4] = prompt[2 * PS :]
+    last_reuse, pool = prefill_fn(jnp.asarray(suffix), pool, jnp.asarray(bt_new), 2 * PS, len(prompt), PS)
+    return np.asarray(last_full), np.asarray(last_reuse)
+
+  pool_ref = init_paged_pool(cfg, shard.n_shard_layers, 8, PS)
+  ref_fn = lambda t, pl, b, pre, pr, ps: prefill_into_pages(params, cfg, shard, t, pl, b, jnp.int32(pre), jnp.int32(pr), ps)
+  ref_full, ref_reuse = run(ref_fn, pool_ref)
+  pool_pp = ppb.place_pool(init_paged_pool(cfg, shard.n_shard_layers, 8, PS))
+  pp_full, pp_reuse = run(ppb.prefill_into_pages, pool_pp)
+  np.testing.assert_allclose(pp_full, ref_full, atol=2e-4)
+  np.testing.assert_allclose(pp_reuse, ref_reuse, atol=2e-4)
+  assert np.argmax(pp_reuse) == np.argmax(ref_reuse) == np.argmax(ref_full)
+
+
+def test_supports_batched_allows_dense_prefix_moe_under_pp():
+  """engine.supports_batched: PP composes with batching for every model
+  family, dense-prefix MoE included (stage-owned prefix cache)."""
   cfg = tiny_test_config(n_layers=4, max_seq_len=MAX_SEQ, n_experts=4, n_active_experts=2, moe_hidden_dim=32, first_k_dense=2)
   params, shard = full_model_params(jax.random.PRNGKey(1), cfg, "m")
   engine = JaxShardedInferenceEngine(use_local_mesh=True, pp=2)
   engine.load_test_model(shard, cfg, params)
   engine._maybe_shard_over_local_mesh()
   assert engine._pp is not None and engine._pp.n_prefix == 2
-  assert not engine.supports_batched()
+  assert engine.supports_batched()  # round 3: dense-prefix MoE composes too
 
   plain = JaxShardedInferenceEngine(use_local_mesh=False)
   plain.load_test_model(*((shard, cfg, params)))

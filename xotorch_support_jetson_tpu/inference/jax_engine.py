@@ -858,14 +858,14 @@ class JaxShardedInferenceEngine(InferenceEngine):
     """Whether batched serving can run for the loaded model + serving mesh.
 
     The Node falls back to the plain serving path when False: SP mode has no
-    batched composition yet, and dense-prefix MoE models (deepseek
-    first_k_dense) are excluded from the pp-batched pipeline (their
-    replicated prefix cache would diverge across stages)."""
+    batched composition yet. PP composes fully (dense-prefix MoE included —
+    parallel/pp_batch.py runs the prefix at stage 0 with a stage-owned
+    cache)."""
     if self._pp is None:
       return True
     from ..parallel.pp_serving import PPServing
 
-    return isinstance(self._pp, PPServing) and not self._pp.n_prefix
+    return isinstance(self._pp, PPServing)
 
   @property
   def batch_ops(self):

@@ -95,16 +95,23 @@ def param_bytes_per_chip(cfg: ModelConfig, plan: MeshPlan, shard: Shard | None =
 
 def kv_cache_bytes_per_chip(cfg: ModelConfig, plan: MeshPlan, batch: int, max_seq: int, n_layers: int | None = None) -> int:
   """Per-chip KV cache bytes: layers 1/pp, sequence 1/sp, heads 1/tp (when
-  divisible) — matching pp_cache_spec / SPServing's cache spec."""
+  divisible) — matching pp_cache_spec / SPServing's cache spec. Under pp,
+  a dense-prefix MoE model's ``first_k_dense`` layers are NOT divided: the
+  prefix cache lives full-size on every stage (replicated in pp_serving,
+  stage-owned in pp_batch)."""
   from ..models.decoder import init_kv_cache
 
   L = n_layers if n_layers is not None else cfg.n_layers
   shapes = jax.eval_shape(lambda: init_kv_cache(cfg, L, batch, max_seq))
+  total = _tree_bytes(shapes)
   div = max(plan.pp, 1) * max(plan.sp, 1)
   heads = cfg.cache_kv_heads
   if plan.tp > 1 and heads > 1 and heads % plan.tp == 0:
     div *= plan.tp
-  return math.ceil(_tree_bytes(shapes) / div)
+  n_pre = min(int(getattr(cfg, "first_k_dense", 0) or 0), L) if plan.pp > 1 else 0
+  per_layer = total / max(L, 1)
+  pre_bytes = per_layer * n_pre  # full-size on every stage
+  return math.ceil(pre_bytes + (total - pre_bytes) / div)
 
 
 @dataclass(frozen=True)

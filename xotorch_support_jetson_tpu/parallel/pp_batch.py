@@ -39,10 +39,13 @@ its ring (``reference/xotorch/orchestration/node.py:424-443``) — this is the
 Composes with tensor parallelism like pp_serving: shard_map is manual ONLY
 over pp; GSPMD shards each stage's matmuls over tp.
 
-Limitation: dense-prefix MoE models (deepseek ``first_k_dense``) are not
-supported in the batched pipeline (their replicated prefix cache would
-diverge across stages under masked updates); the engine keeps the plain
-(non-batched) PP path for those.
+Dense-prefix MoE models (deepseek ``first_k_dense``): the 1-3 dense prefix
+layers run at stage 0 before its MoE stage layers (SPMD: every stage
+executes them, only stage 0's result — whose input is the embedded token —
+is selected). Their cache carries a leading STAGE axis sharded over pp, so
+each stage owns its slice: stage 0's is authoritative, later stages' hold
+discarded junk — honest shard_map semantics instead of a falsely
+"replicated" cache that would diverge under the group schedule.
 """
 
 from __future__ import annotations
@@ -80,9 +83,7 @@ class PPBatchedServing:
     self.mesh = mesh
     self.cfg = cfg
     self.n_stages = n_stages
-    stack_name, stage_params, head, n_prefix = split_pp_params(params, n_stages)
-    if n_prefix:
-      raise ValueError("pp batched serving does not support dense-prefix MoE models (first_k_dense); use plain XOT_TPU_PP serving")
+    stack_name, stage_params, head, self.n_prefix = split_pp_params(params, n_stages)
     self.stage_params, self.head = place_pp_params(stage_params, head, mesh, stack_name)
     self._cache_spec = pp_cache_spec(cfg, mesh)
     self._sm = partial(jax.shard_map, mesh=mesh, axis_names={"pp"}, check_vma=False)
@@ -93,9 +94,8 @@ class PPBatchedServing:
     """Share an existing ``PPServing``'s placed stage params (no second
     weight copy in HBM) — the engine builds this when batched serving is
     requested in XOT_TPU_PP mode."""
-    if pps.n_prefix:
-      raise ValueError("pp batched serving does not support dense-prefix MoE models (first_k_dense); use plain XOT_TPU_PP serving")
     self = cls.__new__(cls)
+    self.n_prefix = pps.n_prefix
     self.mesh, self.cfg, self.n_stages = pps.mesh, pps.cfg, pps.n_stages
     self.stage_params, self.head = pps.stage_params, pps.head
     self._cache_spec = pp_cache_spec(self.cfg, self.mesh)
@@ -105,30 +105,62 @@ class PPBatchedServing:
 
   # --------------------------------------------------------------- placement
 
+  def _split_prefix(self, full: dict, sharding) -> dict:
+    """Split an [L_total, ...] cache/pool: the dense-prefix layers' slice
+    gains a leading STAGE axis sharded over pp (each stage owns a copy;
+    stage 0's is authoritative), the pipelined layers shard over pp."""
+    n, P_ = self.n_prefix, self.n_stages
+    stage_sharding = NamedSharding(self.mesh, P("pp"))
+    out = {}
+    for key in ("k", "v"):
+      pre = jnp.broadcast_to(full[key][:n][None], (P_, *full[key][:n].shape))
+      out[f"{key}_pre"] = jax.device_put(pre, stage_sharding)
+      out[key] = jax.device_put(full[key][n:], sharding)
+    return out
+
   def place_cache(self, cache: dict) -> dict:
     sharding = NamedSharding(self.mesh, self._cache_spec)
+    if self.n_prefix:
+      return self._split_prefix(cache, sharding)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
 
   def place_pool(self, pool: dict) -> dict:
     sharding = NamedSharding(self.mesh, P("pp"))
+    if self.n_prefix:
+      return self._split_prefix(pool, sharding)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), pool)
 
   # ---------------------------------------------------------------- programs
 
   def _build(self) -> None:
-    cfg, n_stages = self.cfg, self.n_stages
+    cfg, n_stages, n_prefix = self.cfg, self.n_stages, self.n_prefix
     cache_spec = {"k": P("pp"), "v": P("pp")}
+    if n_prefix:
+      cache_spec = {**cache_spec, "k_pre": P("pp"), "v_pre": P("pp")}
     stage_spec = P("pp")
     sm = self._sm
+
+    def prefix_layers_of(head):
+      return head["prefix_layers"] if n_prefix else None
 
     # ---- prefill (one request, masked-stage pipeline — compute-bound)
 
     def prefill_slot_sm(stage_params, head, tokens, positions, cache, row, prompt_len):
       stage_layers = {k: v[0] for k, v in stage_params.items()}
-      sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in cache.items()}
       h0 = embed_tokens(head, cfg, tokens)
+      if n_prefix:
+        # Dense prefix: every stage computes the SAME prefill (tokens are
+        # replicated), so each stage's pre-cache slice stays identical.
+        pre = {k: cache[f"{k}_pre"][0] for k in ("k", "v")}
+        pre_sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in pre.items()}
+        h0, pre_out = _stage_forward(prefix_layers_of(head), h0, positions, pre_sub, rope_inv_freq(cfg), cfg)
+        cache = {
+          **cache,
+          **{f"{k}_pre": jax.lax.dynamic_update_slice_in_dim(pre[k], pre_out[k], row, axis=1)[None] for k in ("k", "v")},
+        }
+      sub = {k: jax.lax.dynamic_slice_in_dim(cache[k], row, 1, axis=1) for k in ("k", "v")}
       h, sub = _pp_tick_loop(stage_layers, h0, positions, sub, cfg, n_stages, gather_pos=prompt_len)
-      cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in cache}
+      cache = {**cache, **{k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in ("k", "v")}}
       return h, cache
 
     @jax.jit  # NOT donated: a failed prefill must leave the pool intact
@@ -144,14 +176,11 @@ class PPBatchedServing:
       S = tokens.shape[1]
       mp = bt_row.shape[0]
 
-      def row_gather(pool_part):  # [L/P, Pg, H, ps, hd] → [L/P, 1, mp·ps, H, hd]
+      def row_gather(pool_part):  # [L, Pg, H, ps, hd] → [L, 1, mp·ps, H, hd]
         g = jnp.take(pool_part, bt_row, axis=1)
         L, _, H, ps, hd = g.shape
         return jnp.swapaxes(g, 2, 3).reshape(L, 1, mp * ps, H, hd)
 
-      temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
-      h0 = embed_tokens(head, cfg, tokens)
-      h, temp = _pp_tick_loop(stage_layers, h0, positions, temp, cfg, n_stages, gather_pos=(prompt_len - prefix_len).reshape(1))
       page_ids = jnp.arange(mp, dtype=jnp.int32)
       touched = (page_ids >= prefix_len // page_size) & (page_ids * page_size < prompt_len)
       target = jnp.where(touched, bt_row, 0)  # trash page for the rest
@@ -161,7 +190,16 @@ class PPBatchedServing:
         pages = jnp.swapaxes(t.reshape(L, mp, page_size, H, hd), 2, 3)
         return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
 
-      return h, {"k": row_scatter(pool["k"], temp["k"]), "v": row_scatter(pool["v"], temp["v"])}
+      h0 = embed_tokens(head, cfg, tokens)
+      out = dict(pool)
+      if n_prefix:
+        pre_temp = {k: row_gather(pool[f"{k}_pre"][0]) for k in ("k", "v")}
+        h0, pre_temp = _stage_forward(prefix_layers_of(head), h0, positions, pre_temp, rope_inv_freq(cfg), cfg)
+        out.update({f"{k}_pre": row_scatter(pool[f"{k}_pre"][0], pre_temp[k])[None] for k in ("k", "v")})
+      temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+      h, temp = _pp_tick_loop(stage_layers, h0, positions, temp, cfg, n_stages, gather_pos=(prompt_len - prefix_len).reshape(1))
+      out.update({k: row_scatter(pool[k], temp[k]) for k in ("k", "v")})
+      return h, out
 
     @partial(jax.jit, static_argnames=("page_size",))  # NOT donated (failed prefill)
     def _prefill_pages(stage_params, head, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
@@ -198,11 +236,47 @@ class PPBatchedServing:
         h0 = jnp.zeros((G, 1, cfg.dim), cfg.dtype)
         buf0 = jnp.zeros((P_, G, n_steps), jnp.int32)
 
+        if paged:
+          from ..models.decoder import _paged_layer_step
+
+        def paged_bt(write_ok, g):
+          # Masked rows (and fill/drain junk ticks) write to the trash page.
+          return jnp.where(write_ok[:, None], _take(bt_g, g), 0)
+
+        def prefix_compute(h_in, cur_pos, write_ok, g, cache):
+          """Dense-prefix layers (deepseek first_k_dense) for the current
+          group. SPMD: every stage runs them, but only STAGE 0's result is
+          selected — its h_in is the embedded token; later stages' ring
+          activations already include the prefix. Each stage writes its OWN
+          pre-cache slice (stage 0's is the authoritative one)."""
+          if not n_prefix:
+            return h_in, cache
+          pre_layers = prefix_layers_of(head)
+          if paged:
+            bt_eff = paged_bt(write_ok, g)
+
+            def body(h, per_layer):
+              lp, kp, vp = per_layer
+              h, kp, vp = _paged_layer_step(h, lp, kp, vp, bt_eff, cur_pos[:, None], inv_freq, cfg, page_size, False)
+              return h, (kp, vp)
+
+            h_out, (nk, nv) = jax.lax.scan(body, h_in, (pre_layers, cache["k_pre"][0], cache["v_pre"][0]))
+            cache = {**cache, "k_pre": nk[None], "v_pre": nv[None]}
+          else:
+            pre = {k: cache[f"{k}_pre"][0] for k in ("k", "v")}
+            sub = {k: jax.lax.dynamic_slice_in_dim(v, g * G, G, axis=1) for k, v in pre.items()}
+            h_out, new_sub = _stage_forward(pre_layers, h_in, cur_pos[:, None], sub, inv_freq, cfg)
+            merged = {k: _merge_written(sub[k], new_sub[k], cur_pos, 1, write_ok) for k in sub}
+            cache = {
+              **cache,
+              **{f"{k}_pre": jax.lax.dynamic_update_slice_in_dim(pre[k], merged[k], g * G, axis=1)[None] for k in ("k", "v")},
+            }
+          return jnp.where((stage == 0)[..., None, None], h_out, h_in), cache
+
         def stage_compute(h_in, cur_pos, write_ok, g, cache):
           """This stage's layers for its current group; masked cache write."""
           if paged:
-            bt_eff = jnp.where(write_ok[:, None], _take(bt_g, g), 0)  # trash page
-            from ..models.decoder import _paged_layer_step
+            bt_eff = paged_bt(write_ok, g)
 
             def body(h, per_layer):
               lp, kp, vp = per_layer
@@ -210,11 +284,11 @@ class PPBatchedServing:
               return h, (kp, vp)
 
             h_out, (nk, nv) = jax.lax.scan(body, h_in, (stage_layers, cache["k"], cache["v"]))
-            return h_out, {"k": nk, "v": nv}
-          sub = {k: jax.lax.dynamic_slice_in_dim(v, g * G, G, axis=1) for k, v in cache.items()}
+            return h_out, {**cache, "k": nk, "v": nv}
+          sub = {k: jax.lax.dynamic_slice_in_dim(cache[k], g * G, G, axis=1) for k in ("k", "v")}
           h_out, new_sub = _stage_forward(stage_layers, h_in, cur_pos[:, None], sub, inv_freq, cfg)
           merged = {k: _merge_written(sub[k], new_sub[k], cur_pos, 1, write_ok) for k in sub}
-          return h_out, {k: jax.lax.dynamic_update_slice_in_dim(cache[k], merged[k], g * G, axis=1) for k in cache}
+          return h_out, {**cache, **{k: jax.lax.dynamic_update_slice_in_dim(cache[k], merged[k], g * G, axis=1) for k in ("k", "v")}}
 
         def tick(carry, t):
           h, tok, cache, buf, keys = carry
@@ -231,6 +305,7 @@ class PPBatchedServing:
           # Stage 0 embeds the ring-carried token id; later stages consume
           # the ring-carried activation.
           h_in = jnp.where((stage == 0)[..., None, None], embed_tokens(head, cfg, tok[:, None]), h)
+          h_in, cache = prefix_compute(h_in, cur_pos, write_ok, g, cache)
           h_out, cache = stage_compute(h_in, cur_pos, write_ok, g, cache)
           # Last stage: sample this group's next token and record it. Other
           # stages run the same (cheap, [G,V]) ops and mask the result.
