@@ -170,3 +170,105 @@ def test_sp_tp_composed_matches_and_shards_weights(cfg, plan):
   assert int(first[0, 0]) == first_ref
   toks, cache = sps.fused_decode(first, cache, jnp.full((1,), S, jnp.int32), 10)
   assert np.array_equal(np.asarray(toks)[0], ref)
+
+
+def test_sp_batched_decode_matches_single_device():
+  """SP x batched composition (parallel/sp_batch.py): the slot pool's fused
+  chunk decode with the cache sharded over sp is token-identical to the
+  single-device fused_batch_decode — concurrent long-context streams."""
+  from xotorch_support_jetson_tpu.models.decoder import fused_batch_decode, prefill_into_slot
+  from xotorch_support_jetson_tpu.parallel.sp_batch import SPBatchedServing
+
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(21), cfg, "tiny")
+  mesh = build_mesh(MeshPlan(sp=2, tp=2))
+  spb = SPBatchedServing(SPServing(mesh, cfg, params, 2, True, True))
+
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+  B, max_seq, n_steps = 4, 64, 6
+  cache_ref = init_kv_cache(cfg, cfg.n_layers, B, max_seq)
+  cache_sp = spb.place_cache(init_kv_cache(cfg, cfg.n_layers, B, max_seq))
+  firsts_ref, firsts_sp = [], []
+  for r, p in enumerate(prompts):
+    pad = np.zeros((1, 16), np.int32)
+    pad[0, : len(p)] = p
+    last_r, cache_ref = prefill_into_slot(params, cfg, shard, jnp.asarray(pad), cache_ref, jnp.int32(r), jnp.int32(len(p)))
+    last_s, cache_sp = spb.prefill_into_slot(jnp.asarray(pad), cache_sp, r, len(p))
+    firsts_ref.append(int(np.argmax(np.asarray(last_r)[0])))
+    firsts_sp.append(int(np.argmax(np.asarray(last_s)[0])))
+  assert firsts_sp == firsts_ref
+
+  tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
+  pos = jnp.asarray([len(p) for p in prompts], jnp.int32)
+  active = jnp.asarray([True, True, False, True])
+  temps = jnp.zeros((B,), jnp.float32)
+  top_ks = jnp.full((B,), 35, jnp.int32)
+  for _ in range(2):  # two chained chunks: writes land where the next reads
+    ref_toks, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+    sp_toks, pos_sp, cache_sp = spb.batch_decode(tok, cache_sp, pos, active, temps, top_ks, n_steps)
+    np.testing.assert_array_equal(np.asarray(sp_toks), np.asarray(ref_toks))
+    np.testing.assert_array_equal(np.asarray(pos_sp), np.asarray(pos_ref))
+    tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])
+    pos = pos_ref
+
+
+def test_sp_batched_through_scheduler(monkeypatch):
+  """End-to-end: an XOT_TPU_SP=2 engine's batch scheduler (dense cache,
+  XOT_TPU_PAGED=0) serves concurrent requests token-identically to solo
+  runs; with paged on, supports_batched() routes around the composition."""
+  import asyncio
+
+  from tests.test_batched import _single_row_reference
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  monkeypatch.setenv("XOT_TPU_SP", "2")
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(23), cfg, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert isinstance(engine._pp, SPServing)
+  assert engine.supports_batched()
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  assert not engine.supports_batched()  # paged pool not sp-sharded yet
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+  n_gen = 5
+  expected = [_single_row_reference(params, shard, p, n_gen - 1, cfg=cfg) for p in prompts]
+
+  async def run():
+    return await asyncio.gather(
+      *(
+        server.submit(f"sp{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+        for i, p in enumerate(prompts)
+      )
+    )
+
+  outs = asyncio.run(run())
+  for i, out in enumerate(outs):
+    assert out == expected[i], f"req {i}: {out} != {expected[i]}"
+
+
+def test_supports_batched_requires_full_model_shard(monkeypatch):
+  """A ring member serving a partial layer range must NOT route into the
+  batched mesh paths (they embed tokens and run the head): supports_batched
+  returns False so the Node falls back to plain mesh serving."""
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.inference.shard import Shard
+
+  monkeypatch.setenv("XOT_TPU_SP", "2")
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  cfg = DENSE
+  params, full = full_model_params(jax.random.PRNGKey(29), cfg, "tiny")
+  from xotorch_support_jetson_tpu.models.decoder import slice_shard_params
+
+  partial = Shard("tiny", 1, cfg.n_layers - 1, cfg.n_layers)  # last but not first
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(partial, cfg, slice_shard_params(params, cfg, full, partial))
+  engine._maybe_shard_over_local_mesh()
+  assert isinstance(engine._pp, SPServing) and not engine._pp.is_first
+  assert not engine.supports_batched()

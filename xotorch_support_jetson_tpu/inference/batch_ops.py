@@ -110,3 +110,39 @@ class PPBatchOps:
     return self.pp.paged_batch_decode(
       token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max=k_max, page_size=page_size, key=key
     )
+
+
+class SPBatchOps:
+  """Batched serving over the sp x tp mesh (parallel/sp_batch.py).
+
+  Dense slot cache only — the engine's ``supports_batched`` admits sp meshes
+  only when XOT_TPU_PAGED=0, so the paged entry points below are
+  unreachable guards, not features."""
+
+  def __init__(self, engine, sp_batched):
+    self.engine = engine
+    self.sp = sp_batched
+
+  def round_slots(self, n: int) -> int:
+    return n
+
+  def init_cache(self, n_slots: int, max_seq: int):
+    from ..models.decoder import init_kv_cache
+
+    eng = self.engine
+    return self.sp.place_cache(init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, n_slots, max_seq))
+
+  def init_pool(self, n_pages: int, page_size: int):
+    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
+
+  def prefill_into_slot(self, tokens, cache, row, prompt_len):
+    return self.sp.prefill_into_slot(tokens, cache, row, prompt_len)
+
+  def prefill_into_pages(self, *a, **k):
+    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
+
+  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
+    return self.sp.batch_decode(token, cache, positions, active, temps, top_ks, n_steps, k_max=k_max, key=key)
+
+  def paged_batch_decode(self, *a, **k):
+    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")

@@ -857,15 +857,24 @@ class JaxShardedInferenceEngine(InferenceEngine):
   def supports_batched(self) -> bool:
     """Whether batched serving can run for the loaded model + serving mesh.
 
-    The Node falls back to the plain serving path when False: SP mode has no
-    batched composition yet. PP composes fully (dense-prefix MoE included —
-    parallel/pp_batch.py runs the prefix at stage 0 with a stage-owned
-    cache)."""
+    The Node falls back to the plain serving path when False. PP composes
+    fully (dense-prefix MoE included — parallel/pp_batch.py). SP composes
+    for the DENSE slot cache (parallel/sp_batch.py); the default paged pool
+    does not shard its page axis over sp yet, so sp + XOT_TPU_PAGED=1 (the
+    default) falls back to plain sp serving."""
     if self._pp is None:
       return True
     from ..parallel.pp_serving import PPServing
+    from ..parallel.sp_serving import SPServing
 
-    return isinstance(self._pp, PPServing)
+    # Both batched mesh paths embed tokens and run the head, so a multi-node
+    # ring member serving a PARTIAL layer range must fall back to the plain
+    # mesh path (which supports hidden-in/hidden-out shards).
+    if not (self._pp.is_first and self._pp.is_last):
+      return False
+    if isinstance(self._pp, PPServing):
+      return True
+    return isinstance(self._pp, SPServing) and os.getenv("XOT_TPU_PAGED", "1") in ("0", "false")
 
   @property
   def batch_ops(self):
@@ -882,7 +891,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
         ops = PPBatchOps(self, PPBatchedServing.from_pp_serving(self._pp))
       elif self._pp is not None:
-        raise RuntimeError("batched serving (XOT_TPU_BATCHED) is not yet composed with XOT_TPU_SP sequence-parallel serving")
+        from ..parallel.sp_batch import SPBatchedServing
+        from .batch_ops import SPBatchOps
+
+        ops = SPBatchOps(self, SPBatchedServing(self._pp))
       else:
         ops = DecoderBatchOps(self)
       self._batch_ops = ops

@@ -1,0 +1,126 @@
+"""Sequence-parallel CONTINUOUS-BATCHING serving: the batched slot pool with
+its KV cache sharded over ``sp`` (weights over tp) — concurrent long-context
+streams.
+
+The round-3 sp × tp composition (sp_serving.py) serves ONE stream with the
+cache read split across chips; this module runs the batch scheduler's slot
+pool the same way: cache [L, B, S, H, hd] shards the SEQUENCE axis over sp,
+every rank computes all B rows' attention over its slot range, and the
+per-rank online-softmax partials merge with one pmax + two psum per layer
+(sp_serving._sp_gqa_attention handles [B]-row q positions natively, so the
+batched variant reuses the exact same layer step).
+
+DENSE slot cache only: the paged pool's block-table indirection does not yet
+compose with a sequence-sharded page axis — the engine keeps the default
+paged scheduler off sp meshes (``supports_batched``) and serves this mode
+under ``XOT_TPU_PAGED=0``.
+
+No reference counterpart (one request at a time around its ring); with the
+platform's cache-read wall (NOTES.md), sp is the structural long-context
+answer and this makes it a multi-stream one.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.decoder import _next_token_batched, embed_tokens, head_logits
+from .sp_serving import AXIS, SPServing, _sp_forward
+
+
+class SPBatchedServing:
+  """Compiled sp-sharded batched programs for one loaded full-model shard.
+
+  Shares the SPServing instance's tp-placed params; exposes the same
+  operation set the batch scheduler uses for the dense slot cache."""
+
+  def __init__(self, sps: SPServing):
+    self.mesh: Mesh = sps.mesh
+    self.cfg: ModelConfig = sps.cfg
+    self.n_ranks = sps.n_ranks
+    self.params = sps.params
+    self._cache_spec = sps._cache_spec
+    self._sm = partial(jax.shard_map, mesh=self.mesh, axis_names={AXIS}, check_vma=False)
+    self._build()
+
+  def place_cache(self, cache: dict) -> dict:
+    if cache["k"].shape[2] % self.n_ranks:
+      raise ValueError(f"cache max_seq {cache['k'].shape[2]} not divisible by sp={self.n_ranks}")
+    sharding = NamedSharding(self.mesh, self._cache_spec)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
+
+  def _build(self) -> None:
+    cfg = self.cfg
+    sm = self._sm
+    cache_inner = P(None, None, AXIS, None, None)
+
+    def rank_offset(cache):
+      return jax.lax.axis_index(AXIS) * cache["k"].shape[2]
+
+    def prefill_slot_sm(params, tokens, positions, cache, row):
+      sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in cache.items()}
+      h0 = embed_tokens(params, cfg, tokens)
+      h, sub = _sp_forward(params, h0, positions, sub, cfg, rank_offset(sub))
+      cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in cache}
+      return h, cache
+
+    @jax.jit  # NOT donated: a failed prefill must leave the pool intact
+    def _prefill_slot(params, tokens, cache, row, prompt_len):
+      B, S = tokens.shape
+      positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+      fn = sm(prefill_slot_sm, in_specs=(P(), P(), P(), cache_inner, P()), out_specs=(P(), cache_inner))
+      h, cache = fn(params, tokens, positions, cache, row)
+      idx = (prompt_len - 1).reshape(1, 1, 1)
+      last = jnp.take_along_axis(h, jnp.broadcast_to(idx, (1, 1, h.shape[-1])), axis=1)
+      return head_logits(params, cfg, last)[:, 0, :], cache
+
+    def decode_sm(n_steps: int, k_max: int):
+      def fn(params, token, cache, positions, active, temps, top_ks, key):
+        off = rank_offset(cache)
+
+        def body(carry, _):
+          tok, pos, cache, key = carry
+          h0 = embed_tokens(params, cfg, tok)
+          h, cache = _sp_forward(params, h0, pos[:, None], cache, cfg, off)
+          logits = head_logits(params, cfg, h)[:, 0, :]
+          nxt, key = _next_token_batched(logits, key, temps, top_ks, k_max)
+          nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold
+          pos = jnp.where(active, pos + 1, pos)
+          return (nxt[:, None], pos, cache, key), nxt
+
+        (_, pos, cache, _), toks = jax.lax.scan(body, (token, positions, cache, key), None, length=n_steps)
+        return jnp.moveaxis(toks, 0, 1), pos, cache
+
+      return fn
+
+    @partial(jax.jit, static_argnames=("n_steps", "k_max"), donate_argnums=(2,))
+    def _batch_decode(params, token, cache, positions, active, temps, top_ks, key, n_steps: int, k_max: int):
+      fn = sm(
+        decode_sm(n_steps, k_max),
+        in_specs=(P(), P(), cache_inner, P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), cache_inner),
+      )
+      return fn(params, token, cache, positions, active, temps, top_ks, key)
+
+    self._prefill_slot_fn = _prefill_slot
+    self._batch_decode_fn = _batch_decode
+
+  # ------------------------------------------------------------ entry points
+
+  def prefill_into_slot(self, tokens, cache, row, prompt_len):
+    """tokens [1, S_pad] int32 → (last-token logits [1, V], cache)."""
+    return self._prefill_slot_fn(self.params, jnp.asarray(tokens), cache, jnp.int32(row), jnp.int32(prompt_len))
+
+  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int = 64, key=None):
+    if key is None:
+      key = jax.random.PRNGKey(0)
+    return self._batch_decode_fn(
+      self.params, jnp.asarray(token), cache, jnp.asarray(positions, jnp.int32),
+      jnp.asarray(active, jnp.bool_), jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32),
+      key, int(n_steps), int(k_max),
+    )
