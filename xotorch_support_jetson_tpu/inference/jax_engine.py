@@ -862,16 +862,18 @@ class JaxShardedInferenceEngine(InferenceEngine):
     for the DENSE slot cache (parallel/sp_batch.py); the default paged pool
     does not shard its page axis over sp yet, so sp + XOT_TPU_PAGED=1 (the
     default) falls back to plain sp serving."""
+    # Every batched path embeds tokens and runs the head, so a multi-node
+    # ring member serving a PARTIAL layer range must fall back to the plain
+    # serving path (which supports hidden-in/hidden-out shards) — with or
+    # without a local mesh.
+    eff = getattr(self, "_effective_shard", None)
+    if eff is not None and not (eff.is_first_layer and eff.is_last_layer):
+      return False
     if self._pp is None:
       return True
     from ..parallel.pp_serving import PPServing
     from ..parallel.sp_serving import SPServing
 
-    # Both batched mesh paths embed tokens and run the head, so a multi-node
-    # ring member serving a PARTIAL layer range must fall back to the plain
-    # mesh path (which supports hidden-in/hidden-out shards).
-    if not (self._pp.is_first and self._pp.is_last):
-      return False
     if isinstance(self._pp, PPServing):
       return True
     return isinstance(self._pp, SPServing) and os.getenv("XOT_TPU_PAGED", "1") in ("0", "false")

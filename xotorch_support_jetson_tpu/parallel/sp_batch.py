@@ -26,7 +26,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.decoder import _next_token_batched, embed_tokens, head_logits
@@ -40,19 +40,16 @@ class SPBatchedServing:
   operation set the batch scheduler uses for the dense slot cache."""
 
   def __init__(self, sps: SPServing):
+    self._sps = sps
     self.mesh: Mesh = sps.mesh
     self.cfg: ModelConfig = sps.cfg
     self.n_ranks = sps.n_ranks
     self.params = sps.params
-    self._cache_spec = sps._cache_spec
     self._sm = partial(jax.shard_map, mesh=self.mesh, axis_names={AXIS}, check_vma=False)
     self._build()
 
   def place_cache(self, cache: dict) -> dict:
-    if cache["k"].shape[2] % self.n_ranks:
-      raise ValueError(f"cache max_seq {cache['k'].shape[2]} not divisible by sp={self.n_ranks}")
-    sharding = NamedSharding(self.mesh, self._cache_spec)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
+    return self._sps.place_cache(cache)  # same spec + divisibility check
 
   def _build(self) -> None:
     cfg = self.cfg
