@@ -52,3 +52,19 @@ def pytest_pyfunc_call(pyfuncitem):
     asyncio.run(fn(**kwargs))
     return True
   return None
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+  """Drop compiled executables between test modules.
+
+  ~270 tests in one process accumulate hundreds of live XLA CPU executables;
+  full-suite runs (and only full-suite runs — every module passes in
+  isolation) intermittently segfault inside backend_compile_and_load under
+  that load. Executables are rarely shared across modules (each uses its own
+  tiny configs), so clearing costs little and keeps the native state small.
+  """
+  yield
+  import jax
+
+  jax.clear_caches()
