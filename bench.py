@@ -105,12 +105,18 @@ def main() -> None:
   _ = np.asarray(jnp.argmax(last, axis=-1))
 
   # TTFT: prefill + on-device sample + first token on the host (what a client
-  # actually waits for), compiled.
-  cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
-  t0 = time.perf_counter()
-  last, cache = prefill_jit(params, tokens, cache)
-  _ = np.asarray(jnp.argmax(last, axis=-1))
-  ttft_ms = (time.perf_counter() - t0) * 1e3
+  # actually waits for), compiled. Median of 5 runs with the spread recorded:
+  # the tunnel RTT component drifts ±30% day-to-day (BASELINE.md "TTFT band"),
+  # and a single-shot sample made r03 look like a +31% regression.
+  ttft_samples = []
+  for _ in range(5):
+    cache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
+    t0 = time.perf_counter()
+    last, cache = prefill_jit(params, tokens, cache)
+    _ = np.asarray(jnp.argmax(last, axis=-1))
+    ttft_samples.append((time.perf_counter() - t0) * 1e3)
+  ttft_ms = float(np.median(ttft_samples))
+  ttft_spread_ms = float(max(ttft_samples) - min(ttft_samples))
 
   first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
   start_pos = jnp.full((B,), prompt_len, dtype=jnp.int32)
@@ -233,6 +239,64 @@ def main() -> None:
     _ = np.asarray(ptoks)
     paged16_tok_s = round(Bp * n_decode / (time.perf_counter() - t0), 2)
     del pool
+
+  # TTFT under concurrent load: 8 requests arriving together at the REAL
+  # batch scheduler (inference/batch_scheduler.py). Batched admission
+  # prefills all 8 in one padded dispatch, so p50 TTFT stays ≈ the solo
+  # number instead of degrading linearly in queue depth (serial admission
+  # would pay 8 × prefill for the median request). Measured end-to-end:
+  # submit → first emitted token, default (paged) serving mode.
+  ttft_batch8_p50_ms = None
+  ttft_batch8_max_ms = None
+  server = eng = None
+  try:
+    if not on_accel:  # scheduler covered by tests on CPU; keep the smoke quick
+      raise RuntimeError("skip on cpu")
+    import asyncio
+
+    from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+    eng = JaxShardedInferenceEngine(use_local_mesh=False)
+    eng.load_test_model(shard, cfg, params)
+    from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+    server = BatchedServer(eng, n_slots=8, chunk=8)
+    rng = np.random.default_rng(7)
+
+    def batch_prompts(tag):
+      return {f"{tag}{i}": rng.integers(1, cfg.vocab_size, (96 + i,)).astype(np.int32) for i in range(8)}
+
+    async def ttft_round(prompts):
+      first_at: dict[str, float] = {}
+
+      def emit(rid, toks, finished):
+        if toks and rid not in first_at:
+          first_at[rid] = time.perf_counter()
+
+      t0 = time.perf_counter()
+      await asyncio.gather(
+        *(
+          server.submit(rid, p, max_tokens=9, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+          for rid, p in prompts.items()
+        )
+      )
+      return sorted((first_at[rid] - t0) * 1e3 for rid in prompts)
+
+    async def ttft_bench():
+      await ttft_round(batch_prompts("w"))  # warm the K=8 admission + chunk programs
+      return await ttft_round(batch_prompts("b"))
+
+    ttfts = asyncio.run(ttft_bench())
+    ttft_batch8_p50_ms = round(float(np.median(ttfts)), 2)
+    ttft_batch8_max_ms = round(ttfts[-1], 2)
+  except Exception:  # noqa: BLE001 — keep the bench line printing
+    pass
+  finally:
+    # Release the pool's HBM on BOTH paths — a leaked 8-slot paged cache
+    # would starve the later spec/8B sections and corrupt their numbers.
+    if server is not None:
+      server.shutdown()
+    server = eng = None
 
   # Speculative decoding (XOT_TPU_SPEC_DECODE=int8, models/decoder.py
   # fused_speculative_generate): greedy int8 self-draft + bf16 target in one
@@ -386,6 +450,7 @@ def main() -> None:
 
   vs_baseline = None
   int8_vs_prev = None
+  ttft_vs_prev = None
   try:  # compare to the previous round's recorded value if the driver left one
     import glob
 
@@ -408,6 +473,14 @@ def main() -> None:
         # Regression gate (VERDICT r1 weak #1): flag int8 decode drift
         # round-over-round right in the bench line.
         int8_vs_prev = round(int8_tok_s / float(prev_int8), 4)
+      # TTFT drift gate (VERDICT r3 weak #6): same pattern. A recorded TTFT
+      # below the tunnel's one-RTT floor is an artifact (the host cannot see
+      # a token in less than one round trip), not a denominator.
+      prev_ttft = prev.get("ttft_ms_prefill128")
+      if prev_ttft and on_accel and float(prev_ttft) < 40.0:
+        prev_ttft = None
+      if prev_ttft:
+        ttft_vs_prev = round(ttft_ms / float(prev_ttft), 4)
   except Exception:  # noqa: BLE001
     pass
 
@@ -433,6 +506,10 @@ def main() -> None:
         "pp_decode_tok_s": pp_decode_tok_s,
         "pp_batched_aggregate_tok_s": pp_batched_tok_s,
         "ttft_ms_prefill128": round(ttft_ms, 2),
+        "ttft_ms_spread": round(ttft_spread_ms, 2),
+        "ttft_vs_prev": ttft_vs_prev,
+        "ttft_ms_batch8_p50": ttft_batch8_p50_ms,
+        "ttft_ms_batch8_max": ttft_batch8_max_ms,
         "platform": platform,
         "device": str(jax.devices()[0]),
         "n_decode": n_decode,

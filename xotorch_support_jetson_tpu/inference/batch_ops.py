@@ -40,19 +40,21 @@ class DecoderBatchOps:
     eng = self.engine
     return init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size)
 
-  def prefill_into_slot(self, tokens, cache, row, prompt_len):
-    from ..models.decoder import prefill_into_slot
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+    from ..models.decoder import prefill_into_slots
 
     eng = self.engine
-    return prefill_into_slot(eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.int32(row), jnp.int32(prompt_len))
+    return prefill_into_slots(
+      eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32)
+    )
 
-  def prefill_into_pages(self, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
-    from ..models.decoder import prefill_into_pages
+  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+    from ..models.decoder import prefill_into_pages_many
 
     eng = self.engine
-    return prefill_into_pages(
-      eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_row, jnp.int32),
-      jnp.int32(prefix_len), jnp.int32(prompt_len), int(page_size),
+    return prefill_into_pages_many(
+      eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_rows, jnp.int32),
+      jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
     )
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
@@ -97,11 +99,11 @@ class PPBatchOps:
     eng = self.engine
     return self.pp.place_pool(init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size))
 
-  def prefill_into_slot(self, tokens, cache, row, prompt_len):
-    return self.pp.prefill_into_slot(tokens, cache, row, prompt_len)
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+    return self.pp.prefill_into_slots(tokens, cache, rows, prompt_lens)
 
-  def prefill_into_pages(self, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
-    return self.pp.prefill_into_pages(tokens, pool, bt_row, prefix_len, prompt_len, page_size)
+  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+    return self.pp.prefill_into_pages_many(tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size)
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
     return self.pp.batch_decode(token, cache, positions, active, temps, top_ks, n_steps, k_max=k_max, key=key)
@@ -135,10 +137,10 @@ class SPBatchOps:
   def init_pool(self, n_pages: int, page_size: int):
     raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
 
-  def prefill_into_slot(self, tokens, cache, row, prompt_len):
-    return self.sp.prefill_into_slot(tokens, cache, row, prompt_len)
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+    return self.sp.prefill_into_slots(tokens, cache, rows, prompt_lens)
 
-  def prefill_into_pages(self, *a, **k):
+  def prefill_into_pages_many(self, *a, **k):
     raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):

@@ -9,9 +9,12 @@ aggregate tokens/s by ~B. This scheduler keeps XLA happy with fully static
 shapes:
 
 - ONE pooled KV cache ``[L, n_slots, max_seq, H, hd]`` allocated up front;
-- prefill scatters a single request into its row
-  (``models/decoder.py prefill_into_slot`` — row index and prompt length are
-  traced scalars, so one compiled program per pad bucket serves every slot);
+- admission is BATCHED: all requests admissible at a chunk boundary prefill
+  in ONE padded dispatch (``models/decoder.py prefill_into_slots`` /
+  ``prefill_into_pages_many`` — row indices and prompt lengths are traced,
+  so one compiled program per (row-bucket, pad-bucket) serves every
+  combination). K concurrent arrivals cost ≈ one prefill's wall-clock
+  instead of K serial dispatches — the p50-TTFT fix under load;
 - decode runs ``fused_batch_decode`` chunks over ALL rows every tick with
   per-row positions/temperature/active mask — one compiled program total;
 - admission happens between chunks: new requests claim free slots and
@@ -56,6 +59,19 @@ class _Request:
   emit: Callable[[str, list, bool], None]  # (request_id, new_tokens, finished)
   future: asyncio.Future = None
   page_demand: int = 0  # pages still needed at the last failed paged admission
+
+
+@dataclass
+class _Ready:
+  """A host-prepared admission awaiting its batched prefill dispatch."""
+
+  req: _Request
+  row: int
+  pad_to: int  # this request's own padded suffix length
+  prefix_len: int = 0
+  shared_pages: list = field(default_factory=list)
+  new_pages: list = field(default_factory=list)
+  chain_keys: list = field(default_factory=list)
 
 
 @dataclass
@@ -192,101 +208,249 @@ class BatchedServer:
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
 
-  def _free_slot(self) -> int | None:
+  def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
     for i, s in enumerate(self.slots):
-      if s is None:
+      if s is None and i not in taken:
         return i
     return None
 
+  def _prepare(self, req: _Request, row: int, *, reserve: int = 0, others_active: bool = False) -> tuple[str, _Ready | None]:
+    """Host-side admission of one request: validate and allocate pages.
 
-  async def _admit(self, req: _Request, row: int, *, reserve: int = 0) -> bool:
-    """Prefill one request into a pool row and emit its first token.
-
-    A failed prefill fails THIS request's future (the pool keeps serving).
-    Returns False when pages are scarce (only possible while other rows are
-    active — the caller parks the request via ``_park`` so it retries ahead
-    of younger arrivals; ``req.page_demand`` is set for reserve accounting).
-    ``reserve`` pages are kept back for earlier parked requests."""
-    eng = self.engine
+    Returns ``("ready", _Ready)`` when the request awaits the batched
+    prefill dispatch; ``("done", None)`` when it settled synchronously (its
+    future is resolved — cancelled while queued, or failed validation: a
+    failed request never blocks the pool); ``("park", None)`` when pages
+    are scarce while other requests hold them (``req.page_demand`` set for
+    reserve accounting; re-registered in ``_queued`` NOW so a cancel landing
+    before the re-park still finds it). ``reserve`` pages are kept back for
+    earlier parked requests; ``others_active`` extends the "pages will
+    recycle" test to admissions prepared in this same round but not yet
+    dispatched."""
     self._queued.pop(req.request_id, None)
-    self._admitting.add(req.request_id)
     shared_pages: list = []
-    new_pages: list = []
-    chain_keys: list = []
-    prefix_len = 0
     try:
       if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
         req.emit(req.request_id, [], True)
         if not req.future.done():
           req.future.set_result([])
-        return True
+        return "done", None
       S = int(req.tokens.shape[0])
       if S + 1 >= self.max_seq:
         # A too-long prompt is a client error, not an empty completion.
         raise PromptTooLongError(f"prompt of {S} tokens exceeds the {self.max_seq}-token context window")
 
-      if self.paged:
-        ps = self.page_size
-        chain_keys = self.allocator.chain_keys(req.tokens, ps)
-        # Reuse at most (S-1)//ps pages: at least one suffix token must run
-        # through prefill to produce the last-position logits.
-        shared_pages = self.allocator.lookup_prefix(chain_keys[: (S - 1) // ps])
-        prefix_len = len(shared_pages) * ps
-        total = (S + 1 + ps - 1) // ps  # cover positions [0, S] (first generated token)
-        need = total - len(shared_pages)
-        new_pages = None if self.allocator.n_available - need < reserve else self.allocator.alloc(need)
-        if new_pages is None:
-          for p in shared_pages:
-            self.allocator.release(p)
-          shared_pages = []  # already released — the except handler must not release again
-          if any(s is not None for s in self.slots):
-            # Other requests are draining pages — the caller parks us to
-            # retry at the next chunk boundary, keeping arrival order.
-            # Re-register for cancel lookup NOW (not at _park time): the
-            # caller may await other admissions before re-parking, and a
-            # cancel landing in that window must still find the request.
-            req.page_demand = need
-            self._queued[req.request_id] = req
-            return False
-          raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
-        # The padded suffix writes at offset prefix_len and must stay inside
-        # the row's logical window — dynamic_update_slice CLAMPS out-of-range
-        # starts, which would silently corrupt slot 0.
-        pad_to = min(_round_up(S - prefix_len, PREFILL_BUCKET), self.max_seq - prefix_len)
-        tok_pad = np.zeros((1, pad_to), dtype=np.int32)
-        tok_pad[0, : S - prefix_len] = req.tokens[prefix_len:]
-        bt_row = np.zeros((self.pages_per_row,), dtype=np.int32)
-        bt_row[: len(shared_pages)] = shared_pages
-        bt_row[len(shared_pages) : total] = new_pages
-
-        def run():
-          last, self.cache = self.ops.prefill_into_pages(jnp.asarray(tok_pad), self.cache, bt_row, prefix_len, S, self.page_size)
-          return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
-
-      else:
+      if not self.paged:
         pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
-        tok_pad = np.zeros((1, pad_to), dtype=np.int32)
-        tok_pad[0, :S] = req.tokens
+        return "ready", _Ready(req=req, row=row, pad_to=pad_to)
 
-        def run():
-          # Prefill AND first-token sample stay on the engine executor — the
-          # single thread that serializes all device work (and owns eng._key).
-          last, self.cache = self.ops.prefill_into_slot(jnp.asarray(tok_pad), self.cache, row, S)
-          return int(np.asarray(eng._sample_sync(np.asarray(last), req.temp, min(req.top_k, self.k_max))).reshape(-1)[0])
-
-      first = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+      ps = self.page_size
+      chain_keys = self.allocator.chain_keys(req.tokens, ps)
+      # Reuse at most (S-1)//ps pages: at least one suffix token must run
+      # through prefill to produce the last-position logits.
+      shared_pages = self.allocator.lookup_prefix(chain_keys[: (S - 1) // ps])
+      prefix_len = len(shared_pages) * ps
+      total = (S + 1 + ps - 1) // ps  # cover positions [0, S] (first generated token)
+      need = total - len(shared_pages)
+      new_pages = None if self.allocator.n_available - need < reserve else self.allocator.alloc(need)
+      if new_pages is None:
+        for p in shared_pages:
+          self.allocator.release(p)
+        shared_pages = []  # already released — the except handler must not release again
+        if others_active or any(s is not None for s in self.slots):
+          # Other requests are draining pages — park to retry at the next
+          # chunk boundary, keeping arrival order.
+          req.page_demand = need
+          self._queued[req.request_id] = req
+          return "park", None
+        raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
+      # The padded suffix writes at offset prefix_len and must stay inside
+      # the row's logical window — dynamic_update_slice CLAMPS out-of-range
+      # starts, which would silently corrupt slot 0 (_dispatch groups rows
+      # by this constraint before padding them to a common length).
+      pad_to = min(_round_up(S - prefix_len, PREFILL_BUCKET), self.max_seq - prefix_len)
+      return "ready", _Ready(
+        req=req, row=row, pad_to=pad_to, prefix_len=prefix_len, shared_pages=shared_pages,
+        new_pages=list(new_pages), chain_keys=chain_keys,
+      )
     except Exception as e:  # noqa: BLE001
       for p in shared_pages:
         self.allocator.release(p)
-      if new_pages:
-        self.allocator.free(new_pages)
       if not req.future.done():
         req.future.set_exception(e)
       self._cancelled_ids.discard(req.request_id)  # a raced cancel is moot now
-      return True
+      return "done", None
+
+  async def _admit_pending(self, woken: _Request | None = None) -> None:
+    """Collect every admissible request — parked (page-starved) first, in
+    arrival order, then the queue — and prefill them in ONE batched dispatch
+    (more only when the scatter-clamp grouping splits; see ``_dispatch``).
+    ``woken`` is a request the idle wait already popped from the queue — it
+    admits first. Every still-unmet parked request's page demand accumulates
+    into ``reserve``: younger requests may only admit out of the surplus
+    beyond it, so freed pages accumulate toward the parked requests instead
+    of being consumed by later small prompts."""
+    ready: list[_Ready] = []
+    taken: set[int] = set()
+    reserve = 0
+    if woken is not None and (row := self._free_slot(taken)) is not None:
+      status, r = self._prepare(woken, row)
+      if status == "park":
+        self._parked.append(woken)
+      elif r is not None:
+        ready.append(r)
+        taken.add(row)
+    scan = 0  # parked entries stay IN the deque while being retried, so a
+    # teardown (_fail_all) or a concurrent submit's backpressure check
+    # during the dispatch await still sees them; drop only on admission.
+    while scan < len(self._parked) and (row := self._free_slot(taken)) is not None:
+      req = self._parked[scan]
+      status, r = self._prepare(req, row, reserve=reserve, others_active=bool(ready))
+      if status == "park":
+        reserve += req.page_demand
+        scan += 1
+        continue
+      del self._parked[scan]
+      if r is not None:
+        ready.append(r)
+        taken.add(row)
+    while (row := self._free_slot(taken)) is not None and not self.queue.empty():
+      req = self.queue.get_nowait()
+      status, r = self._prepare(req, row, reserve=reserve, others_active=bool(ready))
+      if status == "park":
+        self._parked.append(req)  # _prepare re-registered it in _queued
+        break
+      if r is not None:
+        ready.append(r)
+        taken.add(row)
+    if ready:
+      await self._dispatch(ready)
+
+  def _dispatch_groups(self, ready: list[_Ready]) -> list[list[_Ready]]:
+    """Split admissions so every row in a group satisfies
+    ``prefix_len + S_pad <= max_seq`` (the scatter-clamp constraint: a row
+    reusing a long cached prefix cannot share a dispatch with a fresh long
+    prompt). Groups are seeded longest-first, so each group's S_pad is its
+    first member's pad_to; in practice one group."""
+    groups: list[list[_Ready]] = []
+    for r in sorted(ready, key=lambda x: x.pad_to, reverse=True):
+      for g in groups:
+        if r.prefix_len + g[0].pad_to <= self.max_seq:
+          g.append(r)
+          break
+      else:
+        groups.append([r])
+    return groups
+
+  async def _dispatch(self, ready: list[_Ready]) -> None:
+    """Prefill K prepared admissions in one device dispatch per group and
+    emit their first tokens. All-or-nothing per group: a device failure
+    fails every request in the group, releases their pages, and the pool
+    keeps serving."""
+    for r in ready:
+      self._admitting.add(r.req.request_id)
+    try:
+      for group in self._dispatch_groups(ready):
+        await self._dispatch_group(group, all_rows={r.row for r in ready})
+    except BaseException as e:  # loop teardown mid-dispatch (CancelledError):
+      # device errors are handled per group — only make sure no admitted
+      # request's future leaks unresolved before the task dies.
+      for r in ready:
+        self._admitting.discard(r.req.request_id)
+        if not r.req.future.done():
+          r.req.future.set_exception(RuntimeError(f"batched server shut down mid-admission: {e!r}"))
+      raise
+
+  def _row_bucket(self, K: int) -> int:
+    """Round the admission batch up to a power of two (capped at n_slots) so
+    a handful of compiled programs covers every batch size."""
+    kpad = 1
+    while kpad < K:
+      kpad *= 2
+    return max(min(kpad, self.n_slots), K)
+
+  async def _dispatch_group(self, group: list[_Ready], all_rows: set[int]) -> None:
+    eng = self.engine
+    K = len(group)
+    S_pad = max(r.pad_to for r in group)
+    kpad = self._row_bucket(K)
+    if not self.paged:
+      # Dense padding rows scatter garbage into a real slot, so each needs a
+      # DISTINCT spare free slot (never a slot another admission owns —
+      # scatter order between duplicate rows is undefined). Without enough
+      # spares the batch stays exact-K: one more compiled variant, rare.
+      spare = [i for i, s in enumerate(self.slots) if s is None and i not in all_rows]
+      kpad = K + min(kpad - K, len(spare))
+    n_rows = kpad
+    tok = np.zeros((n_rows, S_pad), dtype=np.int32)
+    prompt_lens = np.ones((n_rows,), dtype=np.int32)
+    temps = np.zeros((n_rows,), dtype=np.float32)
+    top_ks = np.ones((n_rows,), dtype=np.int32)
+    for i, r in enumerate(group):
+      S = int(r.req.tokens.shape[0])
+      tok[i, : S - r.prefix_len] = r.req.tokens[r.prefix_len :]
+      prompt_lens[i] = S
+      temps[i] = r.req.temp
+      top_ks[i] = min(r.req.top_k, self.k_max)
+
+    if self.paged:
+      bts = np.zeros((n_rows, self.pages_per_row), dtype=np.int32)
+      prefix_lens = np.zeros((n_rows,), dtype=np.int32)
+      for i, r in enumerate(group):
+        n_sh = len(r.shared_pages)
+        total = n_sh + len(r.new_pages)
+        bts[i, :n_sh] = r.shared_pages
+        bts[i, n_sh:total] = r.new_pages
+        prefix_lens[i] = r.prefix_len
+      # Padding rows: all-zero block table (writes land in the trash page),
+      # prefix 0, prompt_len 1.
+      prompt_lens[K:] = 1
+
+      def run():
+        from ..models.decoder import sample_rows
+
+        eng._key, sub = jax.random.split(eng._key)
+        last, self.cache = self.ops.prefill_into_pages_many(
+          jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size
+        )
+        return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
+
+    else:
+      rows = np.asarray([r.row for r in group] + spare[: n_rows - K], dtype=np.int32)
+
+      def run():
+        # Prefill AND first-token sampling stay on the engine executor — the
+        # single thread that serializes all device work (and owns eng._key).
+        from ..models.decoder import sample_rows
+
+        eng._key, sub = jax.random.split(eng._key)
+        last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
+        return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
+
+    try:
+      firsts = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    except Exception as e:  # noqa: BLE001
+      for r in group:
+        for p in r.shared_pages:
+          self.allocator.release(p)
+        if r.new_pages:
+          self.allocator.free(r.new_pages)
+        if not r.req.future.done():
+          r.req.future.set_exception(e)
+        self._cancelled_ids.discard(r.req.request_id)
+      return
     finally:
-      self._admitting.discard(req.request_id)
-    slot = _Slot(req=req, pos=S, generated=1, last_token=first, shared_pages=shared_pages, pages=list(new_pages or []), chain_keys=chain_keys)
+      for r in group:
+        self._admitting.discard(r.req.request_id)
+    for i, r in enumerate(group):
+      self._finish_admission(r, int(firsts[i]))
+
+  def _finish_admission(self, r: _Ready, first: int) -> None:
+    req = r.req
+    slot = _Slot(
+      req=req, pos=int(req.tokens.shape[0]), generated=1, last_token=first,
+      shared_pages=r.shared_pages, pages=list(r.new_pages), chain_keys=r.chain_keys,
+    )
     slot.out_tokens.append(first)
     cancelled = req.request_id in self._cancelled_ids  # raced during prefill
     finished = cancelled or first in req.eos_ids or slot.generated >= req.max_tokens
@@ -297,13 +461,12 @@ class BatchedServer:
       self._release_pages(slot)
       if not req.future.done():
         req.future.set_result(slot.out_tokens)
-      return True
-    self.slots[row] = slot
+      return
+    self.slots[r.row] = slot
     if self.paged:
-      self.block_tables[row, :] = 0
+      self.block_tables[r.row, :] = 0
       n = len(slot.shared_pages) + len(slot.pages)
-      self.block_tables[row, : n] = slot.shared_pages + slot.pages
-    return True
+      self.block_tables[r.row, :n] = slot.shared_pages + slot.pages
 
   def _release_pages(self, slot: _Slot) -> None:
     """Return a finished slot's pages: shared prefix refs drop; private FULL
@@ -347,38 +510,26 @@ class BatchedServer:
     self._ensure_cache()
     try:
       while True:
-        # Admission: parked (page-starved) requests retry FIRST, in arrival
-        # order; then fill remaining free slots from the queue (no await while
-        # any row is active — keep the pool stepping). Every still-unmet
-        # parked request's page demand accumulates into ``reserve``: younger
-        # requests may only admit out of the surplus beyond it, so freed
-        # pages accumulate toward the parked requests instead of being
-        # consumed by later small prompts.
-        reserve = 0
-        scan = 0  # parked entries stay IN the deque while being retried, so a
-        # teardown (_fail_all) or a concurrent submit's backpressure check
-        # during an admission await still sees them; drop only on admission.
-        while scan < len(self._parked) and (row := self._free_slot()) is not None:
-          req = self._parked[scan]
-          if await self._admit(req, row, reserve=reserve):
-            del self._parked[scan]
-          else:
-            reserve += req.page_demand
-            scan += 1
-        while (row := self._free_slot()) is not None and not self.queue.empty():
-          req = self.queue.get_nowait()
-          if not await self._admit(req, row, reserve=reserve):
-            self._parked.append(req)  # _admit re-registered it in _queued
-            break
+        # Admission: every admissible request — parked (page-starved) first,
+        # in arrival order, then the queue — prefills in ONE batched dispatch
+        # between decode chunks (no await while any row is active — keep the
+        # pool stepping).
+        await self._admit_pending()
         if all(s is None for s in self.slots):
-          # _parked is necessarily empty here: with every slot free the retry
-          # loop above ran each parked entry through _admit, which can only
-          # ask to park again while some row is active (otherwise it admits
-          # or fails the request as overloaded).
-          assert not self._parked
-          # Idle: block on the queue (the task persists — no exit/restart race).
+          if self._parked:
+            # A ready batch that insta-finished (eos or max_tokens at its
+            # first token, a raced cancel, or a failed dispatch) can leave
+            # entries parked behind it with every slot free — their park was
+            # justified by ``others_active=ready`` pages that are now
+            # released. Retry immediately: with nothing in flight each one
+            # either admits or fails honestly as overloaded (every pass
+            # resolves at least one request, so this cannot spin).
+            continue
+          # Idle: block on the queue (the task persists — no exit/restart
+          # race). The woken request and anything else that queued while
+          # idle admit together in one batched dispatch.
           req = await self.queue.get()
-          await self._admit(req, self._free_slot())
+          await self._admit_pending(woken=req)
           continue
 
         active = np.array([s is not None for s in self.slots])

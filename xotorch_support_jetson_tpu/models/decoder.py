@@ -462,12 +462,18 @@ def shard_forward(
   x: jnp.ndarray,  # [B,S] int tokens (first shard) | [B,S,D] hidden
   positions: jnp.ndarray,  # [B,S] absolute positions
   kv_cache: Params | None = None,
+  head_pos: jnp.ndarray | None = None,  # [B] per-row S-axis index for the head
 ) -> tuple[jnp.ndarray, Params | None]:
   """Run the shard's layer range. Returns (hidden|logits, updated cache).
 
   With a cache: queries attend to all cache slots ≤ their absolute position
   (prefill writes slots [0..S), decode writes slot p then reads ≤ p).
   Without a cache: plain causal attention within the call (training path).
+
+  ``head_pos`` (last shard only): gather each row's hidden state at that
+  S-axis index BEFORE the LM head, returning logits [B, 1, V] instead of
+  [B, S, V] — a batched prefill over K rows would otherwise materialize
+  K·S·V fp32 logits it immediately discards.
   """
   if x.ndim == 2:  # token ids — valid only on the first shard
     h = embed_tokens(params, cfg, x)
@@ -514,6 +520,10 @@ def shard_forward(
     new_cache = None
 
   if shard.is_last_layer:
+    if head_pos is not None:
+      B = h.shape[0]
+      idx = head_pos.reshape(B, 1, 1)
+      h = jnp.take_along_axis(h, jnp.broadcast_to(idx, (B, 1, h.shape[-1])), axis=1)
     return head_logits(params, cfg, h), new_cache
   return h, new_cache
 
@@ -853,6 +863,79 @@ def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row
   idx = (prompt_len - 1).reshape(1, 1, 1)
   last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (1, 1, logits.shape[-1])), axis=1)[:, 0, :]
   return last, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard"))
+def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens):
+  """Prefill K requests into K pool rows in ONE dispatch.
+
+  tokens [K, S_pad] int32 (each row its own prompt, zero-padded to the
+  group's bucket); rows [K] int32 (distinct slot indices — padding rows may
+  duplicate EACH OTHER but never a real row: scatter order between
+  duplicates is undefined, and only unoccupied slots can absorb garbage);
+  prompt_lens [K] int32 traced. Returns (last-token logits [K, V], cache).
+
+  This is the admission-latency fix for concurrent arrivals: K requests
+  queued together cost one weight pass instead of K serial prefill
+  dispatches while the decode pool stalls (prefill is weight-bandwidth-bound
+  at short prompts, so K rows cost ≈ 1). Not donated, same as
+  ``prefill_into_slot``: a failed prefill must leave the pooled cache
+  intact.
+  """
+  K, S = tokens.shape
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
+  sub = {k: jnp.take(v, rows, axis=1) for k, v in cache.items()}
+  logits, sub = shard_forward(params, cfg, shard, tokens, positions, sub, head_pos=prompt_lens - 1)
+  cache = {k: cache[k].at[:, rows].set(sub[k]) for k in cache}
+  return logits[:, 0, :], cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "page_size"))
+def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+  """``prefill_into_pages`` for K requests in ONE dispatch.
+
+  tokens [K, S_pad] int32 — each row's prompt SUFFIX from its own
+  ``prefix_lens[k]`` on; bt_rows [K, mp] int32 (padding rows all-zero: their
+  writes land in the trash page). The caller must group rows so that
+  ``prefix_lens[k] + S_pad <= max_seq`` for every row — ``_write_cache``'s
+  dynamic_update_slice clamps out-of-range starts, which would shift a
+  row's writes onto wrong slots (batch_scheduler groups admissions by
+  this constraint). Returns (last-token logits [K, V], pool).
+  """
+  K, S = tokens.shape
+  mp = bt_rows.shape[1]
+
+  def row_gather(pool_part):  # [L, P, Hkv, ps, hd] → [L, K, mp·ps, Hkv, hd]
+    g = jnp.take(pool_part, bt_rows, axis=1)  # [L, K, mp, Hkv, ps, hd]
+    L = g.shape[0]
+    Hkv, ps, hd = g.shape[3], g.shape[4], g.shape[5]
+    return jnp.swapaxes(g, 3, 4).reshape(L, K, mp * ps, Hkv, hd)
+
+  temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+  positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+  logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp, head_pos=prompt_lens - prefix_lens - 1)
+
+  page_ids = jnp.arange(mp, dtype=jnp.int32)[None, :]
+  touched = (page_ids >= prefix_lens[:, None] // page_size) & (page_ids * page_size < prompt_lens[:, None])
+  target = jnp.where(touched, bt_rows, 0)  # [K, mp]; trash page for the rest
+
+  def row_scatter(pool_part, t):  # write each row's touched pages back
+    L = t.shape[0]
+    Hkv, hd = t.shape[3], t.shape[4]
+    pages = jnp.swapaxes(t.reshape(L, K, mp, page_size, Hkv, hd), 3, 4)  # [L, K, mp, Hkv, ps, hd]
+    return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
+
+  pool = {"k": row_scatter(pool["k"], temp["k"]), "v": row_scatter(pool["v"], temp["v"])}
+  return logits[:, 0, :], pool
+
+
+@partial(jax.jit, static_argnames=("k_max",))
+def sample_rows(logits, key, temps, top_ks, k_max: int):
+  """First-token sampling for a batched admission: per-row temp/top_k over
+  [K, V] logits in one device call (K host-side _sample_sync round-trips
+  would pay K tunnel RTTs — the thing batched admission exists to avoid)."""
+  tok, _ = _next_token_batched(logits, key, temps, top_ks, k_max)
+  return tok
 
 
 def _next_token_batched(rows, key, temps, top_ks, k_max: int):

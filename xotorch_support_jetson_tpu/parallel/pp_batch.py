@@ -143,51 +143,53 @@ class PPBatchedServing:
     def prefix_layers_of(head):
       return head["prefix_layers"] if n_prefix else None
 
-    # ---- prefill (one request, masked-stage pipeline — compute-bound)
+    # ---- prefill (K requests in one dispatch, masked-stage pipeline —
+    # compute-bound; the single-request entries are K=1 views of the same
+    # programs, so batched admission shares their compile cache shape-wise)
 
-    def prefill_slot_sm(stage_params, head, tokens, positions, cache, row, prompt_len):
+    def prefill_slot_sm(stage_params, head, tokens, positions, cache, rows, prompt_lens):
       stage_layers = {k: v[0] for k, v in stage_params.items()}
       h0 = embed_tokens(head, cfg, tokens)
       if n_prefix:
         # Dense prefix: every stage computes the SAME prefill (tokens are
         # replicated), so each stage's pre-cache slice stays identical.
         pre = {k: cache[f"{k}_pre"][0] for k in ("k", "v")}
-        pre_sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in pre.items()}
+        pre_sub = {k: jnp.take(v, rows, axis=1) for k, v in pre.items()}
         h0, pre_out = _stage_forward(prefix_layers_of(head), h0, positions, pre_sub, rope_inv_freq(cfg), cfg)
         cache = {
           **cache,
-          **{f"{k}_pre": jax.lax.dynamic_update_slice_in_dim(pre[k], pre_out[k], row, axis=1)[None] for k in ("k", "v")},
+          **{f"{k}_pre": pre[k].at[:, rows].set(pre_out[k])[None] for k in ("k", "v")},
         }
-      sub = {k: jax.lax.dynamic_slice_in_dim(cache[k], row, 1, axis=1) for k in ("k", "v")}
-      h, sub = _pp_tick_loop(stage_layers, h0, positions, sub, cfg, n_stages, gather_pos=prompt_len)
-      cache = {**cache, **{k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in ("k", "v")}}
+      sub = {k: jnp.take(cache[k], rows, axis=1) for k in ("k", "v")}
+      h, sub = _pp_tick_loop(stage_layers, h0, positions, sub, cfg, n_stages, gather_pos=prompt_lens)
+      cache = {**cache, **{k: cache[k].at[:, rows].set(sub[k]) for k in ("k", "v")}}
       return h, cache
 
     @jax.jit  # NOT donated: a failed prefill must leave the pool intact
-    def _prefill_slot(stage_params, head, tokens, cache, row, prompt_len):
-      B, S = tokens.shape
-      positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    def _prefill_slots(stage_params, head, tokens, cache, rows, prompt_lens):
+      K, S = tokens.shape
+      positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
       fn = sm(prefill_slot_sm, in_specs=(stage_spec, P(), P(), P(), cache_spec, P(), P()), out_specs=(P(), cache_spec))
-      h, cache = fn(stage_params, head, tokens, positions, cache, row, prompt_len.reshape(1))
+      h, cache = fn(stage_params, head, tokens, positions, cache, rows, prompt_lens)
       return head_logits(head, cfg, h)[:, 0, :], cache
 
-    def prefill_pages_sm(stage_params, head, tokens, positions, pool, bt_row, prefix_len, prompt_len, page_size: int):
+    def prefill_pages_sm(stage_params, head, tokens, positions, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
       stage_layers = {k: v[0] for k, v in stage_params.items()}
-      S = tokens.shape[1]
-      mp = bt_row.shape[0]
+      K, S = tokens.shape
+      mp = bt_rows.shape[1]
 
-      def row_gather(pool_part):  # [L, Pg, H, ps, hd] → [L, 1, mp·ps, H, hd]
-        g = jnp.take(pool_part, bt_row, axis=1)
-        L, _, H, ps, hd = g.shape
-        return jnp.swapaxes(g, 2, 3).reshape(L, 1, mp * ps, H, hd)
+      def row_gather(pool_part):  # [L, Pg, H, ps, hd] → [L, K, mp·ps, H, hd]
+        g = jnp.take(pool_part, bt_rows, axis=1)  # [L, K, mp, H, ps, hd]
+        L, H, ps, hd = g.shape[0], g.shape[3], g.shape[4], g.shape[5]
+        return jnp.swapaxes(g, 3, 4).reshape(L, K, mp * ps, H, hd)
 
-      page_ids = jnp.arange(mp, dtype=jnp.int32)
-      touched = (page_ids >= prefix_len // page_size) & (page_ids * page_size < prompt_len)
-      target = jnp.where(touched, bt_row, 0)  # trash page for the rest
+      page_ids = jnp.arange(mp, dtype=jnp.int32)[None, :]
+      touched = (page_ids >= prefix_lens[:, None] // page_size) & (page_ids * page_size < prompt_lens[:, None])
+      target = jnp.where(touched, bt_rows, 0)  # [K, mp]; trash page for the rest
 
       def row_scatter(pool_part, t):
-        L, _, Stot, H, hd = t.shape
-        pages = jnp.swapaxes(t.reshape(L, mp, page_size, H, hd), 2, 3)
+        L, H, hd = t.shape[0], t.shape[3], t.shape[4]
+        pages = jnp.swapaxes(t.reshape(L, K, mp, page_size, H, hd), 3, 4)
         return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
 
       h0 = embed_tokens(head, cfg, tokens)
@@ -197,20 +199,20 @@ class PPBatchedServing:
         h0, pre_temp = _stage_forward(prefix_layers_of(head), h0, positions, pre_temp, rope_inv_freq(cfg), cfg)
         out.update({f"{k}_pre": row_scatter(pool[f"{k}_pre"][0], pre_temp[k])[None] for k in ("k", "v")})
       temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
-      h, temp = _pp_tick_loop(stage_layers, h0, positions, temp, cfg, n_stages, gather_pos=(prompt_len - prefix_len).reshape(1))
+      h, temp = _pp_tick_loop(stage_layers, h0, positions, temp, cfg, n_stages, gather_pos=prompt_lens - prefix_lens)
       out.update({k: row_scatter(pool[k], temp[k]) for k in ("k", "v")})
       return h, out
 
     @partial(jax.jit, static_argnames=("page_size",))  # NOT donated (failed prefill)
-    def _prefill_pages(stage_params, head, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
+    def _prefill_pages(stage_params, head, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
       S = tokens.shape[1]
-      positions = (prefix_len + jnp.arange(S, dtype=jnp.int32))[None, :]
+      positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
       fn = sm(
         partial(prefill_pages_sm, page_size=page_size),
         in_specs=(stage_spec, P(), P(), P(), cache_spec, P(), P(), P()),
         out_specs=(P(), cache_spec),
       )
-      h, pool = fn(stage_params, head, tokens, positions, pool, bt_row, prefix_len, prompt_len)
+      h, pool = fn(stage_params, head, tokens, positions, pool, bt_rows, prefix_lens, prompt_lens)
       return head_logits(head, cfg, h)[:, 0, :], pool
 
     # ---- pipelined chunk decode (see module docstring)
@@ -359,7 +361,7 @@ class PPBatchedServing:
       pos = jnp.where(active, positions + n_steps, positions)
       return toks, pos, pool
 
-    self._prefill_slot_fn = _prefill_slot
+    self._prefill_slots_fn = _prefill_slots
     self._prefill_pages_fn = _prefill_pages
     self._batch_decode_fn = _batch_decode
     self._paged_batch_decode_fn = _paged_batch_decode
@@ -368,12 +370,26 @@ class PPBatchedServing:
 
   def prefill_into_slot(self, tokens, cache, row, prompt_len):
     """tokens [1, S_pad] int32 → (last-token logits [1, V], cache)."""
-    return self._prefill_slot_fn(self.stage_params, self.head, jnp.asarray(tokens), cache, jnp.int32(row), jnp.int32(prompt_len))
+    last, cache = self.prefill_into_slots(tokens, cache, jnp.asarray([row], jnp.int32), jnp.asarray([prompt_len], jnp.int32))
+    return last, cache
+
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+    """tokens [K, S_pad] int32 → (last-token logits [K, V], cache) — K
+    admissions in one pipeline prefill dispatch."""
+    return self._prefill_slots_fn(
+      self.stage_params, self.head, jnp.asarray(tokens), cache, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32)
+    )
 
   def prefill_into_pages(self, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
+    bt = jnp.asarray(bt_row, jnp.int32).reshape(1, -1)
+    return self.prefill_into_pages_many(
+      tokens, pool, bt, jnp.asarray([prefix_len], jnp.int32), jnp.asarray([prompt_len], jnp.int32), page_size
+    )
+
+  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
     return self._prefill_pages_fn(
-      self.stage_params, self.head, jnp.asarray(tokens), pool, jnp.asarray(bt_row, jnp.int32),
-      jnp.int32(prefix_len), jnp.int32(prompt_len), int(page_size),
+      self.stage_params, self.head, jnp.asarray(tokens), pool, jnp.asarray(bt_rows, jnp.int32),
+      jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
     )
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int = 64, key=None):

@@ -59,21 +59,21 @@ class SPBatchedServing:
     def rank_offset(cache):
       return jax.lax.axis_index(AXIS) * cache["k"].shape[2]
 
-    def prefill_slot_sm(params, tokens, positions, cache, row):
-      sub = {k: jax.lax.dynamic_slice_in_dim(v, row, 1, axis=1) for k, v in cache.items()}
+    def prefill_slots_sm(params, tokens, positions, cache, rows):
+      sub = {k: jnp.take(v, rows, axis=1) for k, v in cache.items()}
       h0 = embed_tokens(params, cfg, tokens)
       h, sub = _sp_forward(params, h0, positions, sub, cfg, rank_offset(sub))
-      cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], sub[k], row, axis=1) for k in cache}
+      cache = {k: cache[k].at[:, rows].set(sub[k]) for k in cache}
       return h, cache
 
     @jax.jit  # NOT donated: a failed prefill must leave the pool intact
-    def _prefill_slot(params, tokens, cache, row, prompt_len):
-      B, S = tokens.shape
-      positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-      fn = sm(prefill_slot_sm, in_specs=(P(), P(), P(), cache_inner, P()), out_specs=(P(), cache_inner))
-      h, cache = fn(params, tokens, positions, cache, row)
-      idx = (prompt_len - 1).reshape(1, 1, 1)
-      last = jnp.take_along_axis(h, jnp.broadcast_to(idx, (1, 1, h.shape[-1])), axis=1)
+    def _prefill_slots(params, tokens, cache, rows, prompt_lens):
+      K, S = tokens.shape
+      positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
+      fn = sm(prefill_slots_sm, in_specs=(P(), P(), P(), cache_inner, P()), out_specs=(P(), cache_inner))
+      h, cache = fn(params, tokens, positions, cache, rows)
+      idx = (prompt_lens - 1).reshape(K, 1, 1)
+      last = jnp.take_along_axis(h, jnp.broadcast_to(idx, (K, 1, h.shape[-1])), axis=1)
       return head_logits(params, cfg, last)[:, 0, :], cache
 
     def decode_sm(n_steps: int, k_max: int):
@@ -104,14 +104,21 @@ class SPBatchedServing:
       )
       return fn(params, token, cache, positions, active, temps, top_ks, key)
 
-    self._prefill_slot_fn = _prefill_slot
+    self._prefill_slots_fn = _prefill_slots
     self._batch_decode_fn = _batch_decode
 
   # ------------------------------------------------------------ entry points
 
   def prefill_into_slot(self, tokens, cache, row, prompt_len):
     """tokens [1, S_pad] int32 → (last-token logits [1, V], cache)."""
-    return self._prefill_slot_fn(self.params, jnp.asarray(tokens), cache, jnp.int32(row), jnp.int32(prompt_len))
+    return self.prefill_into_slots(tokens, cache, jnp.asarray([row], jnp.int32), jnp.asarray([prompt_len], jnp.int32))
+
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+    """tokens [K, S_pad] int32 → (last-token logits [K, V], cache) — K
+    admissions in one sp-sharded prefill dispatch."""
+    return self._prefill_slots_fn(
+      self.params, jnp.asarray(tokens), cache, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32)
+    )
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int = 64, key=None):
     if key is None:
