@@ -1,0 +1,201 @@
+"""Paged pool × sequence parallelism (parallel/sp_batch.py, VERDICT r3 #2).
+
+The pool's page-slot axis stripes over sp: every rank holds ps/sp slots of
+every page, so block tables and the host allocator stay global/unchanged
+while each rank reads 1/sp of the cache. Correctness claim: prefill and
+fused chunk decode against the striped pool are TOKEN-IDENTICAL to the
+single-device paged programs — for dense GQA, MLA (latent pages), and
+gemma2 (softcap + sliding window over strided positions), on sp and sp×tp
+meshes — and the engine's default batched mode now runs on sp meshes.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  fused_paged_batch_decode,
+  prefill_into_pages_many,
+)
+from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan, build_mesh
+from xotorch_support_jetson_tpu.parallel.sp_batch import SPBatchedServing
+from xotorch_support_jetson_tpu.parallel.sp_serving import SPServing
+
+DENSE = tiny_test_config(n_layers=2, max_seq_len=128)
+MLA = tiny_test_config(
+  n_layers=2, max_seq_len=128, n_heads=4, n_kv_heads=4, kv_lora_rank=16,
+  q_lora_rank=24, qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+)
+GEMMA = tiny_test_config(
+  n_layers=2, max_seq_len=128, post_norms=True, mlp_act="gelu_tanh",
+  attn_logit_softcap=50.0, final_logit_softcap=30.0, query_pre_attn_scalar=24.0,
+  sliding_window=4, embed_scale=8.0, tied_embedding=True,
+)
+
+PS = 16
+PROMPTS = [[3, 25, 9], list(range(40, 60)), [9, 9, 9, 1], [100]]
+
+
+def _bt_for(i, p, mp):
+  """Disjoint page ranges per row (page 0 is the trash page)."""
+  total = (len(p) + 1 + PS - 1) // PS
+  bt = np.zeros((mp,), np.int32)
+  bt[:total] = np.arange(1 + 4 * i, 1 + 4 * i + total)
+  return bt
+
+
+def _prefill_all(cfg, params, shard, pool, prefill_many, mp):
+  toks = np.zeros((len(PROMPTS), 32), np.int32)
+  bts = np.zeros((len(PROMPTS), mp), np.int32)
+  for i, p in enumerate(PROMPTS):
+    toks[i, : len(p)] = p
+    bts[i] = _bt_for(i, p, mp)
+  lens = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  last, pool = prefill_many(jnp.asarray(toks), pool, jnp.asarray(bts), jnp.zeros((len(PROMPTS),), jnp.int32), lens, PS)
+  return np.asarray(last), pool, bts
+
+
+@pytest.mark.parametrize("cfg,plan", [
+  (DENSE, MeshPlan(sp=2)),
+  (DENSE, MeshPlan(sp=4)),
+  (DENSE, MeshPlan(sp=2, tp=2)),
+  (MLA, MeshPlan(sp=2)),
+  (GEMMA, MeshPlan(sp=2)),
+], ids=["dense-sp2", "dense-sp4", "dense-sp2tp2", "mla-sp2", "gemma-sp2"])
+def test_sp_paged_prefill_and_decode_match_single_device(cfg, plan):
+  params, shard = full_model_params(jax.random.PRNGKey(31), cfg, "tiny")
+  spb = SPBatchedServing(SPServing(build_mesh(plan), cfg, params, plan.sp, True, True))
+  B, mp, n_pages, n_steps = len(PROMPTS), 8, 40, 5
+
+  pool_ref = init_paged_pool(cfg, cfg.n_layers, n_pages, PS)
+  last_ref, pool_ref, bts = _prefill_all(
+    cfg, params, shard, pool_ref,
+    lambda t, pl, b, pre, pr, ps: prefill_into_pages_many(params, cfg, shard, t, pl, b, pre, pr, ps), mp,
+  )
+  pool_sp = spb.place_pool(init_paged_pool(cfg, cfg.n_layers, n_pages, PS))
+  # Striped placement: each rank holds ps/sp slots of every page.
+  assert pool_sp["k"].addressable_shards[0].data.shape[3] == PS // plan.sp
+  last_sp, pool_sp, _ = _prefill_all(cfg, params, shard, pool_sp, spb.prefill_into_pages_many, mp)
+
+  firsts_ref = np.argmax(last_ref, axis=-1)
+  firsts_sp = np.argmax(last_sp, axis=-1)
+  np.testing.assert_array_equal(firsts_sp, firsts_ref)
+
+  tok = jnp.asarray(firsts_ref[:, None].astype(np.int32))
+  pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
+  active = jnp.asarray([True, True, False, True])
+  temps = jnp.zeros((B,), jnp.float32)
+  top_ks = jnp.full((B,), 35, jnp.int32)
+  bt_j = jnp.asarray(bts)
+  for _ in range(2):  # chained chunks: writes land where the next chunk reads
+    ref_toks, pos_ref, pool_ref = fused_paged_batch_decode(
+      params, cfg, shard, tok, pool_ref, bt_j, pos, active, temps, n_steps, page_size=PS
+    )
+    sp_toks, pos_sp, pool_sp = spb.paged_batch_decode(tok, pool_sp, bt_j, pos, active, temps, top_ks, n_steps, page_size=PS)
+    np.testing.assert_array_equal(np.asarray(sp_toks), np.asarray(ref_toks))
+    np.testing.assert_array_equal(np.asarray(pos_sp), np.asarray(pos_ref))
+    tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])
+    pos = pos_ref
+
+
+def test_sp_paged_prefix_reuse_matches_single_device():
+  """A nonzero prefix_len (shared cached prefix pages) prefills identically
+  through the striped pool: only the suffix runs, reused pages are read in
+  place across ranks."""
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(37), cfg, "tiny")
+  spb = SPBatchedServing(SPServing(build_mesh(MeshPlan(sp=2)), cfg, params, 2, True, True))
+  prompt = [(7 * i) % 120 + 1 for i in range(2 * PS + 5)]  # 2 full pages + tail
+  mp, n_pages = 8, 16
+
+  def run(prefill_many, pool):
+    # Full prefill into pages 1..3, then a REUSE prefill of the same prompt
+    # sharing the two full prefix pages (new private page 10 for the tail).
+    bt_full = np.zeros((1, mp), np.int32)
+    bt_full[0, :3] = [1, 2, 3]
+    last_full, pool = prefill_many(
+      jnp.asarray(np.pad(np.asarray([prompt], np.int32), ((0, 0), (0, 64 - len(prompt))))), pool,
+      jnp.asarray(bt_full), jnp.zeros((1,), jnp.int32), jnp.asarray([len(prompt)], jnp.int32), PS,
+    )
+    bt_reuse = np.zeros((1, mp), np.int32)
+    bt_reuse[0, :3] = [1, 2, 10]
+    suffix = np.zeros((1, 32), np.int32)
+    suffix[0, : len(prompt) - 2 * PS] = prompt[2 * PS :]
+    last_reuse, pool = prefill_many(
+      jnp.asarray(suffix), pool, jnp.asarray(bt_reuse),
+      jnp.asarray([2 * PS], jnp.int32), jnp.asarray([len(prompt)], jnp.int32), PS,
+    )
+    return np.asarray(last_full), np.asarray(last_reuse)
+
+  ref_full, ref_reuse = run(
+    lambda t, pl, b, pre, pr, ps: prefill_into_pages_many(params, cfg, shard, t, pl, b, pre, pr, ps),
+    init_paged_pool(cfg, cfg.n_layers, 16, PS),
+  )
+  sp_full, sp_reuse = run(spb.prefill_into_pages_many, spb.place_pool(init_paged_pool(cfg, cfg.n_layers, 16, PS)))
+  np.testing.assert_array_equal(np.argmax(sp_full, -1), np.argmax(ref_full, -1))
+  np.testing.assert_array_equal(np.argmax(sp_reuse, -1), np.argmax(ref_reuse, -1))
+  # Same-logits check (reuse path must read the shared pages, not recompute).
+  np.testing.assert_allclose(sp_reuse, ref_reuse, rtol=2e-4, atol=2e-4)
+
+
+def test_sp_engine_default_batched_mode_serves_paged(monkeypatch):
+  """End-to-end: an XOT_TPU_SP=2 engine with the DEFAULT paged mode now
+  reports supports_batched() and serves concurrent requests through the
+  striped pool token-identically to solo greedy (the round-3 silent
+  degradation is gone)."""
+  from tests.test_batched import _single_row_reference
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  monkeypatch.setenv("XOT_TPU_SP", "2")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(41), cfg, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert isinstance(engine._pp, SPServing)
+  assert engine.supports_batched(), "sp + default paged mode must be batched now"
+
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  assert server.paged
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+  n_gen = 5
+  expected = [_single_row_reference(params, shard, p, n_gen - 1, cfg=cfg) for p in prompts]
+
+  async def run():
+    return await asyncio.gather(
+      *(
+        server.submit(f"spp{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+        for i, p in enumerate(prompts)
+      )
+    )
+
+  outs = asyncio.run(run())
+  for i, out in enumerate(outs):
+    assert out == expected[i], f"req {i}: {out} != {expected[i]}"
+
+
+def test_supports_batched_requires_divisible_page_size(monkeypatch):
+  """An sp rank count that does not divide the page size cannot stripe the
+  pool — supports_batched() routes around it (plain sp serving)."""
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  monkeypatch.setenv("XOT_TPU_SP", "2")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "63")  # 63 % 2 != 0
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(43), cfg, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert not engine.supports_batched()
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "64")
+  assert engine.supports_batched()

@@ -215,7 +215,7 @@ def test_sp_batched_decode_matches_single_device():
 def test_sp_batched_through_scheduler(monkeypatch):
   """End-to-end: an XOT_TPU_SP=2 engine's batch scheduler (dense cache,
   XOT_TPU_PAGED=0) serves concurrent requests token-identically to solo
-  runs; with paged on, supports_batched() routes around the composition."""
+  runs. (The default paged mode composes too — tests/test_sp_paged.py.)"""
   import asyncio
 
   from tests.test_batched import _single_row_reference
@@ -232,7 +232,7 @@ def test_sp_batched_through_scheduler(monkeypatch):
   assert isinstance(engine._pp, SPServing)
   assert engine.supports_batched()
   monkeypatch.setenv("XOT_TPU_PAGED", "1")
-  assert not engine.supports_batched()  # paged pool not sp-sharded yet
+  assert engine.supports_batched()  # striped paged pool composes with sp now
   monkeypatch.setenv("XOT_TPU_PAGED", "0")
 
   server = BatchedServer(engine, n_slots=4, chunk=2)

@@ -115,11 +115,9 @@ class PPBatchOps:
 
 
 class SPBatchOps:
-  """Batched serving over the sp x tp mesh (parallel/sp_batch.py).
-
-  Dense slot cache only — the engine's ``supports_batched`` admits sp meshes
-  only when XOT_TPU_PAGED=0, so the paged entry points below are
-  unreachable guards, not features."""
+  """Batched serving over the sp x tp mesh (parallel/sp_batch.py): dense
+  slot cache (sequence axis over sp) or the default paged pool (page-slot
+  axis striped over sp — global page ids, host allocator unchanged)."""
 
   def __init__(self, engine, sp_batched):
     self.engine = engine
@@ -135,16 +133,21 @@ class SPBatchOps:
     return self.sp.place_cache(init_kv_cache(eng.cfg, eng._effective_shard.n_shard_layers, n_slots, max_seq))
 
   def init_pool(self, n_pages: int, page_size: int):
-    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
+    from ..ops.paged import init_paged_pool
+
+    eng = self.engine
+    return self.sp.place_pool(init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size))
 
   def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
     return self.sp.prefill_into_slots(tokens, cache, rows, prompt_lens)
 
-  def prefill_into_pages_many(self, *a, **k):
-    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
+  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+    return self.sp.prefill_into_pages_many(tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size)
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
     return self.sp.batch_decode(token, cache, positions, active, temps, top_ks, n_steps, k_max=k_max, key=key)
 
-  def paged_batch_decode(self, *a, **k):
-    raise RuntimeError("paged KV does not compose with XOT_TPU_SP yet; set XOT_TPU_PAGED=0")
+  def paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key):
+    return self.sp.paged_batch_decode(
+      token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max=k_max, page_size=page_size, key=key
+    )

@@ -859,9 +859,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     The Node falls back to the plain serving path when False. PP composes
     fully (dense-prefix MoE included — parallel/pp_batch.py). SP composes
-    for the DENSE slot cache (parallel/sp_batch.py); the default paged pool
-    does not shard its page axis over sp yet, so sp + XOT_TPU_PAGED=1 (the
-    default) falls back to plain sp serving."""
+    for both cache layouts (parallel/sp_batch.py): dense slots shard the
+    sequence axis, and the DEFAULT paged pool stripes its page-slot axis
+    over sp — the one divisibility requirement is page_size % sp == 0
+    (default 64 divides every power-of-two sp)."""
     # Every batched path embeds tokens and runs the head, so a multi-node
     # ring member serving a PARTIAL layer range must fall back to the plain
     # serving path (which supports hidden-in/hidden-out shards) — with or
@@ -876,7 +877,12 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     if isinstance(self._pp, PPServing):
       return True
-    return isinstance(self._pp, SPServing) and os.getenv("XOT_TPU_PAGED", "1") in ("0", "false")
+    if not isinstance(self._pp, SPServing):
+      return False
+    if os.getenv("XOT_TPU_PAGED", "1") in ("0", "false"):
+      return True
+    page_size = int(os.getenv("XOT_TPU_PAGE_SIZE", "64"))
+    return page_size % self._pp.n_ranks == 0
 
   @property
   def batch_ops(self):

@@ -902,30 +902,14 @@ def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool
   row's writes onto wrong slots (batch_scheduler groups admissions by
   this constraint). Returns (last-token logits [K, V], pool).
   """
+  from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
+
   K, S = tokens.shape
-  mp = bt_rows.shape[1]
-
-  def row_gather(pool_part):  # [L, P, Hkv, ps, hd] → [L, K, mp·ps, Hkv, hd]
-    g = jnp.take(pool_part, bt_rows, axis=1)  # [L, K, mp, Hkv, ps, hd]
-    L = g.shape[0]
-    Hkv, ps, hd = g.shape[3], g.shape[4], g.shape[5]
-    return jnp.swapaxes(g, 3, 4).reshape(L, K, mp * ps, Hkv, hd)
-
-  temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+  temp = {"k": gather_row_pages(pool["k"], bt_rows), "v": gather_row_pages(pool["v"], bt_rows)}
   positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
   logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp, head_pos=prompt_lens - prefix_lens - 1)
-
-  page_ids = jnp.arange(mp, dtype=jnp.int32)[None, :]
-  touched = (page_ids >= prefix_lens[:, None] // page_size) & (page_ids * page_size < prompt_lens[:, None])
-  target = jnp.where(touched, bt_rows, 0)  # [K, mp]; trash page for the rest
-
-  def row_scatter(pool_part, t):  # write each row's touched pages back
-    L = t.shape[0]
-    Hkv, hd = t.shape[3], t.shape[4]
-    pages = jnp.swapaxes(t.reshape(L, K, mp, page_size, Hkv, hd), 3, 4)  # [L, K, mp, Hkv, ps, hd]
-    return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
-
-  pool = {"k": row_scatter(pool["k"], temp["k"]), "v": row_scatter(pool["v"], temp["v"])}
+  target = touched_page_targets(bt_rows, prefix_lens, prompt_lens, page_size)
+  pool = {"k": scatter_row_pages(pool["k"], temp["k"], target), "v": scatter_row_pages(pool["v"], temp["v"], target)}
   return logits[:, 0, :], pool
 
 

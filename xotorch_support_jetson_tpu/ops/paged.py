@@ -73,6 +73,40 @@ def gather_pages(pool_l: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
   return jnp.swapaxes(g, 2, 3).reshape(B, mp * ps, Hkv, hd)
 
 
+def gather_row_pages(pool_part: jnp.ndarray, bt_rows: jnp.ndarray) -> jnp.ndarray:
+  """All-layer per-row page gather: [L, P, H, slots, hd] × [K, mp] →
+  position-ordered [L, K, mp·slots, H, hd].
+
+  ``slots`` is the per-device page width: the full page_size on a single
+  device, or ps/sp when the pool's page-slot axis is striped over sp
+  (parallel/sp_batch.py) — the shape carries the difference.
+  """
+  g = jnp.take(pool_part, bt_rows, axis=1)  # [L, K, mp, H, slots, hd]
+  L, K, mp, H, st, hd = g.shape
+  return jnp.swapaxes(g, 3, 4).reshape(L, K, mp * st, H, hd)
+
+
+def touched_page_targets(bt_rows: jnp.ndarray, prefix_lens: jnp.ndarray, prompt_lens: jnp.ndarray, page_size: int) -> jnp.ndarray:
+  """Per-row scatter targets for a prefill: each row's pages from its reused
+  prefix boundary up to its prompt end scatter back to their real page ids;
+  everything else (shared prefix pages, unallocated entries, padding rows)
+  targets the trash page 0."""
+  mp = bt_rows.shape[1]
+  page_ids = jnp.arange(mp, dtype=jnp.int32)[None, :]
+  touched = (page_ids >= prefix_lens[:, None] // page_size) & (page_ids * page_size < prompt_lens[:, None])
+  return jnp.where(touched, bt_rows, 0)
+
+
+def scatter_row_pages(pool_part: jnp.ndarray, t: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+  """Inverse of ``gather_row_pages`` restricted to ``target`` pages:
+  t [L, K, mp·slots, H, hd] scatters back into [L, P, H, slots, hd]."""
+  L, K, N, H, hd = t.shape
+  mp = target.shape[1]
+  st = pool_part.shape[3]
+  pages = jnp.swapaxes(t.reshape(L, K, mp, st, H, hd), 3, 4)  # [L, K, mp, H, slots, hd]
+  return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
+
+
 def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, **attn_opts) -> jnp.ndarray:
   """Reference paged decode attention via gather (q [B, 1, Hq, hd]).
   ``attn_opts`` forward gemma2's scale/softcap/sliding-window

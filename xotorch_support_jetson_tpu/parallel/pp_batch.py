@@ -174,23 +174,12 @@ class PPBatchedServing:
       return head_logits(head, cfg, h)[:, 0, :], cache
 
     def prefill_pages_sm(stage_params, head, tokens, positions, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+      from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
+
       stage_layers = {k: v[0] for k, v in stage_params.items()}
-      K, S = tokens.shape
-      mp = bt_rows.shape[1]
-
-      def row_gather(pool_part):  # [L, Pg, H, ps, hd] → [L, K, mp·ps, H, hd]
-        g = jnp.take(pool_part, bt_rows, axis=1)  # [L, K, mp, H, ps, hd]
-        L, H, ps, hd = g.shape[0], g.shape[3], g.shape[4], g.shape[5]
-        return jnp.swapaxes(g, 3, 4).reshape(L, K, mp * ps, H, hd)
-
-      page_ids = jnp.arange(mp, dtype=jnp.int32)[None, :]
-      touched = (page_ids >= prefix_lens[:, None] // page_size) & (page_ids * page_size < prompt_lens[:, None])
-      target = jnp.where(touched, bt_rows, 0)  # [K, mp]; trash page for the rest
-
-      def row_scatter(pool_part, t):
-        L, H, hd = t.shape[0], t.shape[3], t.shape[4]
-        pages = jnp.swapaxes(t.reshape(L, K, mp, page_size, H, hd), 3, 4)
-        return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
+      target = touched_page_targets(bt_rows, prefix_lens, prompt_lens, page_size)
+      row_gather = lambda pool_part: gather_row_pages(pool_part, bt_rows)  # noqa: E731
+      row_scatter = lambda pool_part, t: scatter_row_pages(pool_part, t, target)  # noqa: E731
 
       h0 = embed_tokens(head, cfg, tokens)
       out = dict(pool)

@@ -132,29 +132,39 @@ def _write_chunk(cache, new, start, rank_offset):
   return jax.vmap(row)(cache, new, start)
 
 
-def _sp_layer_step(h, p, k_cache, v_cache, positions, rank_offset, inv_freq, cfg: ModelConfig):
-  """One decoder layer with an sp-sharded cache. h replicated [B,S,D];
-  k/v_cache this rank's chunk [B,Sloc,H,hd]."""
+def _sp_layer_step(h, p, k_cache, v_cache, positions, rank_offset, inv_freq, cfg: ModelConfig, kv_positions_local=None, write_kv=None, read_kv=None):
+  """One decoder layer with an sp-sharded cache. h replicated [B,S,D].
+
+  Default layout: k/v_cache are this rank's CONTIGUOUS chunk [B,Sloc,H,hd]
+  (slot positions ``rank_offset + arange``, ``_write_chunk`` writes). The
+  striped paged layout (parallel/sp_batch.py) overrides the three knobs:
+  ``kv_positions_local`` gives each stored slot's absolute position,
+  ``write_kv(kc, vc, k, v, start)`` scatters new KV, ``read_kv(cache)``
+  yields the position-ordered KV the attention reads — so the attention/
+  norm/MLP skeleton exists exactly once for both layouts.
+  """
   B, S, D = h.shape
-  Sloc = k_cache.shape[1]
-  kv_positions_local = rank_offset + jnp.arange(Sloc, dtype=jnp.int32)
+  if kv_positions_local is None:
+    kv_positions_local = rank_offset + jnp.arange(k_cache.shape[1], dtype=jnp.int32)
+  if write_kv is None:
+    write_kv = lambda kc, vc, k, v, start: (_write_chunk(kc, k, start, rank_offset), _write_chunk(vc, v, start, rank_offset))  # noqa: E731
+  if read_kv is None:
+    read_kv = lambda c: c  # noqa: E731
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
   start = positions[:, 0]
   if "wkv_a" in p:
     q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
-    k_cache = _write_chunk(k_cache, c_kv[:, :, None, :], start, rank_offset)
-    v_cache = _write_chunk(v_cache, k_pe[:, :, None, :], start, rank_offset)
+    k_cache, v_cache = write_kv(k_cache, v_cache, c_kv[:, :, None, :], k_pe[:, :, None, :], start)
     attn = _sp_mla_attention(
-      q_nope, q_pe, k_cache[:, :, 0, :].astype(h.dtype), v_cache[:, :, 0, :].astype(h.dtype),
+      q_nope, q_pe, read_kv(k_cache)[:, :, 0, :].astype(h.dtype), read_kv(v_cache)[:, :, 0, :].astype(h.dtype),
       _mla_w_kv_b(p, h.dtype), positions, kv_positions_local, cfg.v_head_dim,
     )
   else:
     from ..models.decoder import _attn_opts
 
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
-    k_cache = _write_chunk(k_cache, k, start, rank_offset)
-    v_cache = _write_chunk(v_cache, v, start, rank_offset)
-    attn = _sp_gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions_local, **_attn_opts(cfg, p.get("is_sliding")))
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, start)
+    attn = _sp_gqa_attention(q, read_kv(k_cache).astype(h.dtype), read_kv(v_cache).astype(h.dtype), positions, kv_positions_local, **_attn_opts(cfg, p.get("is_sliding")))
   from ..models.decoder import _mm
 
   attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
