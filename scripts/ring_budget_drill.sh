@@ -1,0 +1,86 @@
+#!/bin/bash
+# Two-daemon ahead-of-time ring HBM refusal drill (VERDICT r3 #3): start a
+# 2-node manual-discovery ring whose members report deliberately undersized
+# memory (XOT_TPU_MEMORY_MB override), send a prompt, and assert the API
+# returns a clear "ring cannot hold the model" error (HTTP 507) BEFORE any
+# load — no OOM, no download. Then restart the ring with enough memory and
+# assert the same prompt completes (the re-plan).
+#
+# Self-contained: builds its own ~34 MB fp32 checkpoint (the memory-weighted
+# partitioner sizes spans proportionally, so the refusal fires exactly when
+# the AGGREGATE ring memory cannot hold the model — tiny test checkpoints
+# fit any ring).
+#
+# Usage: scripts/ring_budget_drill.sh
+set -euo pipefail
+WORK=$(mktemp -d)
+trap 'kill $(cat "$WORK"/*.pid 2>/dev/null) 2>/dev/null || true' EXIT
+
+echo "== building a ~34 MB drill checkpoint"
+python - "$WORK/ckpt" <<'EOF'
+import torch, sys
+from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+from transformers import AutoConfig, AutoModelForCausalLM, PreTrainedTokenizerFast
+path = sys.argv[1]
+torch.manual_seed(0)
+cfg = AutoConfig.for_model("llama", vocab_size=8192, hidden_size=256, intermediate_size=1024,
+  num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2, rms_norm_eps=1e-5,
+  rope_theta=10000.0, max_position_embeddings=256, tie_word_embeddings=False,
+  torch_dtype="float32", eos_token_id=2, bos_token_id=1)
+AutoModelForCausalLM.from_config(cfg).to(torch.float32).eval().save_pretrained(path, safe_serialization=True)
+tm = Tokenizer(models.BPE(unk_token="<unk>")); tm.pre_tokenizer = pre_tokenizers.Whitespace()
+tm.train_from_iterator(["hello world how are you today", "the quick brown fox"] * 50,
+                       trainers.BpeTrainer(vocab_size=512, special_tokens=["<unk>", "<s>", "</s>"]))
+tok = PreTrainedTokenizerFast(tokenizer_object=tm, unk_token="<unk>", bos_token="<s>", eos_token="</s>")
+tok.chat_template = "{% for m in messages %}{{ m['content'] }} {% endfor %}"
+tok.save_pretrained(path)
+EOF
+
+python - "$WORK" <<'EOF'
+import json, sys
+caps = {"model": "test", "chip": "cpu", "memory": 8192, "flops": {"fp32": 1.0, "fp16": 2.0, "int8": 4.0}}
+w = sys.argv[1]
+json.dump({"peers": {"nodeB": {"address": "127.0.0.1", "port": 53162, "device_capabilities": caps}}}, open(f"{w}/a.json", "w"))
+json.dump({"peers": {"nodeA": {"address": "127.0.0.1", "port": 53161, "device_capabilities": caps}}}, open(f"{w}/b.json", "w"))
+EOF
+
+export JAX_PLATFORMS=cpu XOT_TPU_MODEL_DIR="$WORK/ckpt" HF_HUB_OFFLINE=1 DEBUG=1 PYTHONUNBUFFERED=1
+COMMON=(--disable-tui --temp 0.0 --max-generate-tokens 24 --default-model llama-3.2-1b --discovery-module manual)
+
+start_ring() { # $1 = memory MB each member reports
+  XOT_TPU_UUID=nodeA XOT_TPU_MEMORY_MB=$1 python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
+    --discovery-config-path "$WORK/a.json" --node-port 53161 --chatgpt-api-port 52517 > "$WORK/a.log" 2>&1 &
+  echo $! > "$WORK/a.pid"
+  XOT_TPU_UUID=nodeB XOT_TPU_MEMORY_MB=$1 python -m xotorch_support_jetson_tpu.main "${COMMON[@]}" \
+    --discovery-config-path "$WORK/b.json" --node-port 53162 --chatgpt-api-port 52518 > "$WORK/b.log" 2>&1 &
+  echo $! > "$WORK/b.pid"
+}
+
+# Phase 1: 8 MB per member — each ~8.1 MB (bf16-accounted) span exceeds the
+# member's 8 MB * (1 - headroom) budget, so the ring cannot hold the model.
+start_ring 8
+sleep 24
+echo "== topology view (both members must report 8 MB):"
+curl -sf --max-time 5 "http://127.0.0.1:52517/v1/topology" | python -c "
+import json, sys; t = json.load(sys.stdin)
+print('  ', {k: v['memory'] for k, v in t['nodes'].items()})"
+
+echo "== prompt against the undersized ring (expect HTTP 507, refused before load):"
+CODE=$(curl -s -o "$WORK/refusal.json" -w "%{http_code}" --max-time 60 http://127.0.0.1:52517/v1/chat/completions \
+  -H 'Content-Type: application/json' \
+  -d '{"model":"llama-3.2-1b","messages":[{"role":"user","content":"hello world"}],"stream":false}')
+cat "$WORK/refusal.json"; echo
+[ "$CODE" = "507" ] || { echo "FAIL: expected 507, got $CODE"; exit 1; }
+grep -q "ring cannot hold the model" "$WORK/refusal.json" || { echo "FAIL: refusal message missing"; exit 1; }
+
+echo "== restart the ring with enough memory; it re-plans and the prompt completes:"
+kill "$(cat "$WORK/a.pid")" "$(cat "$WORK/b.pid")" 2>/dev/null || true
+sleep 2
+start_ring 8192
+sleep 24
+CODE=$(curl -s -o "$WORK/ok.json" -w "%{http_code}" --max-time 180 http://127.0.0.1:52517/v1/chat/completions \
+  -H 'Content-Type: application/json' \
+  -d '{"model":"llama-3.2-1b","messages":[{"role":"user","content":"hello world"}],"stream":false}')
+[ "$CODE" = "200" ] || { echo "FAIL: expected 200 after re-plan, got $CODE"; cat "$WORK/ok.json"; exit 1; }
+python -c "import json; d=json.load(open('$WORK/ok.json')); assert d['choices'][0]['message']['content'] is not None; print('   completion:', repr(d['choices'][0]['message']['content']))"
+echo "== PASS: undersized ring refused with 507 before load; re-planned ring serves"

@@ -1,0 +1,80 @@
+"""Ahead-of-time ring HBM refusal (VERDICT r3 #3): a multi-node partition
+map that cannot hold the model is refused at the prompt — BEFORE any
+download or weight load — and re-planned automatically when the topology
+changes (parallel/hbm_planner.ring_partition_fits wired into
+orchestration/node.py)."""
+
+import jax
+import pytest
+
+from tests.test_node import NoDiscovery, StubServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.parallel.hbm_planner import RingBudgetError
+from xotorch_support_jetson_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+# Big enough that per-span weight bytes are MBs (the refusal has teeth).
+CFG = tiny_test_config(n_layers=4, dim=256, hidden_dim=1024, vocab_size=8192, max_seq_len=128)
+
+
+def caps(mem_mb: int) -> DeviceCapabilities:
+  return DeviceCapabilities(model="test", chip="test", memory=mem_mb, flops=DeviceFlops(fp32=1.0, fp16=1.0, int8=1.0))
+
+
+def _node_with_engine():
+  params, shard = full_model_params(jax.random.PRNGKey(0), CFG, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  node = Node(
+    "n1", StubServer(), engine, NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy(),
+    max_generate_tokens=4, default_sample_temp=0.0,
+  )
+  return node, shard
+
+
+@pytest.mark.asyncio
+async def test_undersized_ring_refused_before_load_and_replans():
+  node, shard = _node_with_engine()
+  node.topology.update_node("n1", caps(10))
+  node.topology.update_node("tiny-peer", caps(2))  # cannot hold its span
+
+  with pytest.raises(RingBudgetError, match="ring cannot hold the model"):
+    await node.process_prompt(shard, "hello", "rb-1")
+  assert node._ring_budget_problems(shard), "problems should be cached non-empty"
+
+  # Re-plan: probed memories change (a bigger peer joins / caps update) —
+  # the fingerprint changes, the check re-runs and passes.
+  node.topology.update_node("n1", caps(32000))
+  node.topology.update_node("tiny-peer", caps(32000))
+  assert node._ring_budget_problems(shard) == []
+
+
+@pytest.mark.asyncio
+async def test_ring_budget_skips_single_node_and_unprobed_peers():
+  node, shard = _node_with_engine()
+  # Single node: the engine's own check_plan guards the local mesh path.
+  node.topology.update_node("n1", caps(1))
+  assert node._ring_budget_problems(shard) == []
+  # A 0-memory member is an un-probed placeholder — never false-refuse.
+  node.topology.update_node("ghost", caps(0))
+  assert node._ring_budget_problems(shard) == []
+
+
+@pytest.mark.asyncio
+async def test_ring_budget_skips_unknown_geometry():
+  """No loaded model, no local checkpoint for the id → the check defers to
+  the engine's post-download check_plan instead of guessing."""
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+
+  node = Node(
+    "n1", StubServer(), DummyInferenceEngine(), NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=4,
+  )
+  node.topology.update_node("n1", caps(4))
+  node.topology.update_node("peer", caps(4))
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+  assert node._ring_budget_problems(shard) == []

@@ -452,6 +452,7 @@ class ChatGPTAPI:
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     from ..inference.engine import PromptTooLongError, ServerOverloadedError
+    from ..parallel.hbm_planner import RingBudgetError
 
     def completion_body(text: str, finish_reason, logprobs_obj=None, n_gen: int = 0) -> dict:
       return {
@@ -531,6 +532,10 @@ class ChatGPTAPI:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
       return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
+    except RingBudgetError as e:
+      # Ahead-of-time refusal (node.py): the current ring cannot hold the
+      # model — nothing was downloaded or loaded.
+      return web.json_response({"error": {"message": str(e), "type": "insufficient_resources"}}, status=507)
     except Exception as e:  # noqa: BLE001
       if DEBUG >= 1:
         import traceback
@@ -659,6 +664,7 @@ class ChatGPTAPI:
     need_usage = not chat_request.stream or include_usage
     prompt_tokens = len(tokenizer.encode(prompt)) if need_usage and hasattr(tokenizer, "encode") else 0
     from ..inference.engine import PromptTooLongError, ServerOverloadedError
+    from ..parallel.hbm_planner import RingBudgetError
 
     try:
       if chat_request.stream:
@@ -698,6 +704,10 @@ class ChatGPTAPI:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
       return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
+    except RingBudgetError as e:
+      # Ahead-of-time refusal (node.py): the current ring cannot hold the
+      # model — nothing was downloaded or loaded.
+      return web.json_response({"error": {"message": str(e), "type": "insufficient_resources"}}, status=507)
     except Exception as e:  # noqa: BLE001
       if DEBUG >= 1:
         import traceback

@@ -103,6 +103,11 @@ class Node:
     self.buffered_inputs: dict[str, list] = {}
     self.checkpoints: dict[str, dict[str, int]] = {}
     self.outstanding_requests: dict[str, str] = {}
+    # Ahead-of-time ring HBM validation cache: (fingerprint, problems) for
+    # the last (model, partition-map) checked — a topology change (peer
+    # joins/leaves, probed memory update) changes the fingerprint, so the
+    # ring re-plans automatically (parallel/hbm_planner.ring_partition_fits).
+    self._ring_budget_cache: tuple | None = None
 
     self._on_token: AsyncCallbackSystem[str, str, list, bool] = AsyncCallbackSystem()
     self._on_opaque_status: AsyncCallbackSystem[str, str, str] = AsyncCallbackSystem()
@@ -237,6 +242,20 @@ class Node:
     # this node's topology view. The flag is explicit because a head owning
     # only layer 0 is structurally identical to the API's (0,0,n) marker.
     shard = base_shard if wire_concrete else self.get_current_shard(base_shard)
+    # Ahead-of-time ring HBM budget (VERDICT r3 #3): refuse a partition map
+    # that cannot hold the model BEFORE any download/load starts — the
+    # reference's failure mode was an OOM mid-prefill after the full
+    # download. Runs on the node the client hit, BEFORE any per-request
+    # state registers (nothing to clean up on refusal), and only for LOCAL
+    # callers: a wire-forwarded prompt was already validated by its sender,
+    # and a head-side re-raise would surface to the client as a delayed
+    # generic RPC failure instead of the typed 507.
+    if not wire_concrete:
+      problems = self._ring_budget_problems(base_shard)
+      if problems:
+        from ..parallel.hbm_planner import RingBudgetError
+
+        raise RingBudgetError("ring cannot hold the model: " + "; ".join(problems))
     self._adopt_options(request_id, inference_state, shard)
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0,
@@ -790,6 +809,73 @@ class Node:
     partitions = self.partitioning_strategy.partition(self.topology)
     shards = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)
     return shards[min(index, len(shards) - 1)]
+
+  # ------------------------------------------------- ring HBM budget (AOT)
+
+  def _model_cfg_for_budget(self, model_id: str):
+    """Best-effort model geometry WITHOUT downloading weights: the loaded
+    engine's cfg, an ``XOT_TPU_MODEL_DIR`` checkpoint, or an
+    already-downloaded snapshot's config.json. ``None`` (skip the ring
+    check) when no local geometry exists — the engine's own ``check_plan``
+    still guards its local mesh after the download."""
+    eng = self.inference_engine
+    cfg = getattr(eng, "cfg", None)
+    eng_shard = getattr(eng, "shard", None)
+    if cfg is not None and eng_shard is not None and eng_shard.model_id == model_id:
+      return cfg
+    from pathlib import Path
+
+    candidates = []
+    if local := os.getenv("XOT_TPU_MODEL_DIR"):
+      candidates.append(Path(local))
+    try:
+      from ..download.downloader import get_models_dir, repo_to_dirname
+
+      repo = registry.get_repo(model_id, type(eng).__name__)
+      if repo:
+        candidates.append(get_models_dir() / repo_to_dirname(repo))
+    except Exception:  # noqa: BLE001
+      pass
+    from ..models.config import load_model_config
+
+    for d in candidates:
+      try:
+        if (d / "config.json").exists():
+          return load_model_config(d)
+      except Exception:  # noqa: BLE001
+        continue
+    return None
+
+  def _ring_budget_problems(self, base_shard: Shard) -> list[str]:
+    """Validate the CURRENT multi-node partition map against each member's
+    probed memory (parallel/hbm_planner.ring_partition_fits). Returns
+    human-readable problems; empty when the ring fits, when this node
+    serves alone (the engine's check_plan guards that path), when any
+    member's memory is an un-probed placeholder (0 — never false-refuse),
+    or when the model geometry is unknown locally."""
+    partitions = self.partitioning_strategy.partition(self.topology)
+    if len(partitions) <= 1:
+      return []
+    mems_mb = [int(getattr(self.topology.nodes.get(p.node_id), "memory", 0) or 0) for p in partitions]
+    if any(m <= 0 for m in mems_mb):
+      return []
+    fingerprint = (base_shard.model_id, tuple(zip([p.node_id for p in partitions], mems_mb)))
+    if self._ring_budget_cache and self._ring_budget_cache[0] == fingerprint:
+      return self._ring_budget_cache[1]
+    cfg = self._model_cfg_for_budget(base_shard.model_id)
+    if cfg is None:
+      # Unknown geometry: skip WITHOUT caching — once the config lands on
+      # disk (first download), the next prompt must run the real check.
+      return []
+    from ..parallel.hbm_planner import ring_partition_fits
+
+    # Map onto the checkpoint's REAL depth (the engine remaps the same way
+    # when registry layer counts disagree with a local checkpoint).
+    shards = map_partitions_to_shards(partitions, cfg.n_layers, base_shard.model_id)
+    quant = os.getenv("XOT_TPU_QUANT") or None
+    problems = ring_partition_fits(cfg, shards, [m * 1024**2 for m in mems_mb], quant=quant)
+    self._ring_budget_cache = (fingerprint, problems)
+    return problems
 
   # -------------------------------------------------------------- topology
 

@@ -234,17 +234,25 @@ def device_hbm_bytes() -> int | None:
   return None
 
 
+class RingBudgetError(RuntimeError):
+  """A multi-node ring partition cannot hold the model — raised by the Node
+  BEFORE any download or weight load begins (orchestration/node.py
+  ``_ring_budget_problems``), instead of the reference's OOM mid-prefill."""
+
+
 def ring_partition_fits(cfg: ModelConfig, shards: list[Shard], memories_bytes: list[int], quant: str | None = None, headroom: float = DEFAULT_HEADROOM) -> list[str]:
   """Validate a ring partition (topology/partitioning map_partitions_to_shards
   output) against each node's reported memory: returns human-readable
-  problems (empty = fits). Used to surface 'this ring cannot hold the model'
-  before the download/load begins rather than as an OOM mid-prefill."""
+  problems (empty = fits). Wired into the Node's prompt path (node.py): the
+  head validates the current partition map against every peer's probed
+  memory before the download/load begins rather than as an OOM
+  mid-prefill."""
+  def fmt(n: int) -> str:
+    return f"{n / 1024**3:.2f} GiB" if n >= 1024**3 else f"{n / 1024**2:.1f} MiB"
+
   problems = []
-  gib = 1024**3
   for shard, mem in zip(shards, memories_bytes):
     need = model_bytes(cfg, shard, quant)
     if need > mem * (1.0 - headroom):
-      problems.append(
-        f"node span [{shard.start_layer}-{shard.end_layer}] needs {need / gib:.2f} GiB weights but has {mem / gib:.2f} GiB"
-      )
+      problems.append(f"node span [{shard.start_layer}-{shard.end_layer}] needs {fmt(need)} weights but has {fmt(mem)}")
   return problems

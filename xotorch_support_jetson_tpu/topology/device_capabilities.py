@@ -250,16 +250,25 @@ def _apple_device_capabilities() -> DeviceCapabilities | None:
 
 
 def _probe() -> DeviceCapabilities:
+  caps = None
   for probe in (_tpu_device_capabilities, _jetson_device_capabilities, _cuda_device_capabilities, _apple_device_capabilities):
     caps = probe()
     if caps is not None:
-      return caps
-  return DeviceCapabilities(
-    model=f"CPU host ({os.uname().machine})" if hasattr(os, "uname") else "CPU host",
-    chip="cpu",
-    memory=_host_memory_mb(),
-    flops=DeviceFlops(fp32=0.1, fp16=0.1, int8=0.2),
-  )
+      break
+  if caps is None:
+    caps = DeviceCapabilities(
+      model=f"CPU host ({os.uname().machine})" if hasattr(os, "uname") else "CPU host",
+      chip="cpu",
+      memory=_host_memory_mb(),
+      flops=DeviceFlops(fp32=0.1, fp16=0.1, int8=0.2),
+    )
+  # Test/drill override: report a fixed memory (MB) regardless of the probe —
+  # lets a drill stand up a deliberately undersized ring member to exercise
+  # the ahead-of-time ring HBM refusal (scripts/ring_budget_drill.sh).
+  override = os.getenv("XOT_TPU_MEMORY_MB")
+  if override:
+    caps = DeviceCapabilities(model=caps.model, chip=caps.chip, memory=int(override), flops=caps.flops)
+  return caps
 
 
 async def device_capabilities() -> DeviceCapabilities:
