@@ -325,6 +325,8 @@ def test_pp_engine_batched_admission(monkeypatch):
   params, shard = full_model_params(KEY, cfg)
   engine = JaxShardedInferenceEngine(use_local_mesh=True, pp=2)
   engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert engine._pp is not None and engine.mesh.shape["pp"] == 2
 
   from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
 

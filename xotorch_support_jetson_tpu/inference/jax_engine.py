@@ -189,6 +189,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = cfg
     self.shard = shard
     self._effective_shard = eff
+    self._vision_params = None  # set by _split_vision_params in mesh modes
+    self._train_state = None  # model-specific jits/opt state (train/trainer.py)
+    self._mesh_eval_fn = None
     self._maybe_shard_over_local_mesh()
     # Build the draft AFTER mesh placement so the int8 copy derives from the
     # already-sharded params (its leaves inherit their shardings).
@@ -334,6 +337,31 @@ class JaxShardedInferenceEngine(InferenceEngine):
     if DEBUG >= 1:
       print(f"[jax_engine] HBM budget ok for plan {plan.describe()}")
 
+  def _split_vision_params(self) -> None:
+    """Keep the llava tower + projector OUT of a serving-mesh layout (they
+    are tiny next to the decoder and run once per request): the multimodal
+    path encodes images with them eagerly and hands the merged embeddings
+    to the mesh prefill as hidden input — this is what lifts the former
+    PP/SP vision refusals (VERDICT r3 #4)."""
+    if self.cfg.vision is None or self.params is None:
+      return
+    self._vision_params = {k: self.params[k] for k in ("vision", "projector") if k in self.params}
+    self.params = {k: v for k, v in self.params.items() if k not in ("vision", "projector")}
+
+  def _vision_leaves(self) -> dict:
+    vp = getattr(self, "_vision_params", None)
+    if vp:
+      return vp
+    return {"vision": self.params["vision"], "projector": self.params["projector"]}
+
+  def _serving_embed(self):
+    """The embedding table wherever the serving mode placed it."""
+    if self._pp is None:
+      return self.params["embed"]
+    from ..parallel.pp_serving import PPServing
+
+    return self._pp.head["embed"] if isinstance(self._pp, PPServing) else self._pp.params["embed"]
+
   def _maybe_shard_over_local_mesh(self) -> None:
     sp = int(os.getenv("XOT_TPU_SP", "0") or 0)
     if sp > 1:
@@ -346,8 +374,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       n = len(jax.devices())
       if n < sp:
         raise ValueError(f"XOT_TPU_SP={sp} but only {n} local devices")
-      if self.cfg.vision is not None:
-        raise ValueError("XOT_TPU_SP serving does not support vision models yet")
+      self._split_vision_params()
       if min(self.max_seq_len, self.cfg.max_seq_len) % sp:
         raise ValueError(f"serving max_seq must be divisible by XOT_TPU_SP={sp}")
       from ..parallel.mesh import pow2_degree
@@ -370,11 +397,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       n = len(jax.devices())
       if n < self.pp:
         raise ValueError(f"XOT_TPU_PP={self.pp} but only {n} local devices")
-      if self.cfg.vision is not None:
-        # Reject at load: the pp split keeps only the decoder stack + head, so
-        # an image request would otherwise crash mid-request on the missing
-        # vision tower params.
-        raise ValueError("XOT_TPU_PP pipeline serving does not support vision models yet")
+      self._split_vision_params()
       from ..parallel.mesh import pow2_degree
 
       plan = self._planned_mesh()
@@ -424,6 +447,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.cfg = cfg
     self.params = params
     self.tokenizer = tokenizer
+    self._vision_params = None
+    self._train_state = None
+    self._mesh_eval_fn = None
     self._maybe_build_draft(calibrate=False)  # tests must exercise the spec path deterministically
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
@@ -494,11 +520,12 @@ class JaxShardedInferenceEngine(InferenceEngine):
     pixel_values = np.asarray(out["pixel_values"], dtype=np.float32)
     B, S = tokens.shape
 
-    feats = encode_images(self.params["vision"], self.params["projector"], self.cfg.vision, jnp.asarray(pixel_values))
+    vp = self._vision_leaves()
+    feats = encode_images(vp["vision"], vp["projector"], self.cfg.vision, jnp.asarray(pixel_values))
     pad_to = min(_round_up(S, PREFILL_BUCKET), min(self.max_seq_len, self.cfg.max_seq_len))
     tok_pad = np.zeros((B, pad_to), dtype=np.int32)
     tok_pad[:, :S] = tokens
-    embeds = jnp.take(self.params["embed"], jnp.asarray(tok_pad), axis=0).astype(self.cfg.dtype)
+    embeds = jnp.take(self._serving_embed(), jnp.asarray(tok_pad), axis=0).astype(self.cfg.dtype)
     merged = merge_image_embeddings(embeds, jnp.asarray(tok_pad), feats, self.cfg.image_token_id)
 
     state.prompt_len = S
@@ -944,6 +971,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.mesh = None
     self._pp = None
     self._batch_ops = None
+    self._vision_params = None
+    self._train_state = None
+    self._mesh_eval_fn = None
     self.sessions.clear()
     self._drop_batched_server()
 
@@ -955,8 +985,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
   #  see engine.py module docstring re the reference's missing train/evaluate)
 
   async def train(self, request_id, shard, inputs, targets, lengths, loss="ce", opt="adamw", lr=1e-5):
-    if self._pp is not None:
-      raise RuntimeError("training is not supported in XOT_TPU_PP serving mode (use parallel/train_step.py pipeline training)")
+    # Works in every serving mode: plain/tp engines step their flat params;
+    # pp/sp mesh engines run the SAME distributed step over the serving mesh
+    # (pp routes through the GPipe pipeline — train/trainer.py mesh branch).
     from ..train.trainer import engine_train_step
 
     return await asyncio.get_event_loop().run_in_executor(
@@ -964,11 +995,62 @@ class JaxShardedInferenceEngine(InferenceEngine):
     )
 
   async def evaluate(self, request_id, shard, inputs, targets, lengths, loss="ce"):
-    if self._pp is not None:
-      raise RuntimeError("evaluate is not supported in XOT_TPU_PP serving mode")
     from ..train.trainer import engine_eval_step
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, engine_eval_step, self, shard, inputs, targets, lengths, loss)
+
+  def _flat_params_view(self, include_vision: bool = False):
+    """The flat param tree regardless of serving mode. PP stage stacks
+    reassemble with the layer axis still pp-sharded (no gather —
+    parallel/pp_serving.reassemble_params); sp/tp params are already flat.
+
+    ``include_vision`` merges the mesh-mode split-off llava tower/projector
+    back in — checkpointing needs the COMPLETE tree so mesh and plain
+    checkpoints interoperate; the train path must NOT include them (unused
+    leaves would still collect optimizer moments and adamw weight decay)."""
+    if self._pp is None:
+      flat = self.params
+    else:
+      from ..parallel.pp_serving import PPServing
+
+      flat = self._pp.reassemble_params() if isinstance(self._pp, PPServing) else self._pp.params
+    vp = getattr(self, "_vision_params", None)
+    if include_vision and vp:
+      flat = {**flat, **vp}
+    return flat
+
+  def _adopt_flat_params(self, params) -> None:
+    """Install an updated flat tree (train step / checkpoint load / LoRA
+    attach) into the active layout and drop weight-derived state: live KV
+    sessions and the batched pool backend (pp_batch/sp_batch share the old
+    arrays). A tree carrying vision leaves (a full-checkpoint restore in a
+    mesh mode) splits them back off first. The cached train state is NOT
+    reset here — a train loop adopts every step and must keep its optimizer
+    momentum; structure-changing callers (attach_lora, load_checkpoint)
+    reset it themselves."""
+    if self._pp is not None and any(k in params for k in ("vision", "projector")):
+      self._vision_params = {k: params[k] for k in ("vision", "projector") if k in params}
+      params = {k: v for k, v in params.items() if k not in ("vision", "projector")}
+    if self._pp is None:
+      self.params = params
+    else:
+      from ..parallel.pp_serving import PPServing
+
+      if isinstance(self._pp, PPServing):
+        self._pp.adopt_params(params)
+      else:
+        self._pp.params = params
+    self.sessions.clear()
+    self._drop_batched_server()
+
+  def attach_lora(self, rank: int, key=None) -> None:
+    """Attach LoRA adapters to the loaded model in ANY serving mode (the
+    train CLI's --lora-rank path; train/lora.py add_lora)."""
+    from ..train.lora import add_lora
+
+    key = jax.random.PRNGKey(0) if key is None else key
+    self._adopt_flat_params(add_lora(self._flat_params_view(), rank, key))
+    self._train_state = None  # param structure changed: new opt state + jits
 
   async def score_tokens(self, shard: Shard, tokens, n_scored: int, top_n: int):
     """Post-hoc logprobs for the last ``n_scored`` tokens (OpenAI logprobs).
@@ -1039,16 +1121,21 @@ class JaxShardedInferenceEngine(InferenceEngine):
     return engine_pop_span_aux(self, request_id)
 
   async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
-    if self._pp is not None:
-      raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")
+    # PP mode saves the REASSEMBLED flat tree, so a pipeline-trained
+    # checkpoint restores into any serving mode (and vice versa).
     from ..train.checkpoint import save_params
 
-    await asyncio.get_event_loop().run_in_executor(self.executor, save_params, self.params, path)
+    def run():
+      save_params(self._flat_params_view(include_vision=True), path)
+
+    await asyncio.get_event_loop().run_in_executor(self.executor, run)
 
   async def load_checkpoint(self, shard: Shard, path: str | Path) -> None:
-    if self._pp is not None:
-      raise RuntimeError("checkpointing is not supported in XOT_TPU_PP serving mode")
     from ..train.checkpoint import load_params
 
-    loaded = await asyncio.get_event_loop().run_in_executor(self.executor, load_params, path, self.params)
-    self.params = loaded
+    def run():
+      loaded = load_params(path, self._flat_params_view(include_vision=True))
+      self._adopt_flat_params(loaded)  # drops stale KV sessions + batch pool
+      self._train_state = None  # resumed opt state must not mix with the old
+
+    await asyncio.get_event_loop().run_in_executor(self.executor, run)

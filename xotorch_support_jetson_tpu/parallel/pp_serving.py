@@ -211,10 +211,56 @@ class PPServing:
     self.is_first = is_first
     self.is_last = is_last
     stack_name, stage_params, head, self.n_prefix = split_pp_params(params, n_stages)
+    self._stack_name = stack_name
     self.stage_params, self.head = place_pp_params(stage_params, head, mesh, stack_name)
     self._cache_spec = pp_cache_spec(cfg, mesh)
     self._sm = partial(jax.shard_map, mesh=mesh, axis_names={"pp"}, check_vma=False)
     self._build()
+
+  # ------------------------------------------------ flat-params round trip
+  # (PP-mode train/eval/checkpoint/LoRA — VERDICT r3 #4): the lifecycle
+  # paths need the ordinary flat tree; the stage stacks merge back with the
+  # LAYER axis sharded over pp (a reshape of the stage axis — each rank
+  # keeps its contiguous layer block, no gather), so a 70B pipeline never
+  # materializes unsharded weights.
+
+  def reassemble_params(self) -> dict:
+    """Inverse of ``split_pp_params``: flat tree with [L, ...] stacks
+    (layer axis pp-sharded), dense-prefix stack back under "layers", head
+    leaves at top level. Leaves stay device-resident; the merge jit is
+    cached (train loops call this every step)."""
+    if getattr(self, "_reassemble_fn", None) is None:
+      from .mesh import decoder_param_specs
+
+      layer_specs = decoder_param_specs()[self._stack_name]
+      out_sh = {
+        k: NamedSharding(self.mesh, P("pp", *tuple(layer_specs.get(k, P()))[1:]))  # flat spec minus its leading L dim
+        for k in self.stage_params
+      }
+      self._reassemble_fn = jax.jit(
+        lambda st: {k: v.reshape(v.shape[0] * v.shape[1], *v.shape[2:]) for k, v in st.items()},
+        out_shardings=out_sh,
+      )
+    out = {self._stack_name: self._reassemble_fn(self.stage_params)}
+    for k, v in self.head.items():
+      if k == "prefix_layers":
+        out["layers"] = v
+      else:
+        out[k] = v
+    return out
+
+  def adopt_params(self, params: dict) -> None:
+    """Re-place an updated flat tree (post-train / checkpoint load / LoRA
+    attach) into the serving layout. The compiled programs take the placed
+    params as call arguments, so no recompile — but any object sharing the
+    OLD stage arrays (pp_batch.from_pp_serving) must be rebuilt by the
+    caller (the engine drops its batch backend)."""
+    stack_name, stage_params, head, n_prefix = split_pp_params(params, self.n_stages)
+    if stack_name != self._stack_name or n_prefix != self.n_prefix:
+      raise ValueError(f"adopt_params structure changed: {stack_name}/{n_prefix} != {self._stack_name}/{self.n_prefix}")
+    if set(stage_params) != set(self.stage_params):
+      self._reassemble_fn = None  # leaf set changed (LoRA attach): new merge jit
+    self.stage_params, self.head = place_pp_params(stage_params, head, self.mesh, stack_name)
 
   def place_cache(self, cache: dict) -> dict:
     """Engine cache [L_total, ...] → pp placement. With a dense prefix the

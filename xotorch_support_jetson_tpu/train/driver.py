@@ -19,13 +19,16 @@ async def _prepare(node, engine_classname: str, args):
   engine = node.inference_engine
   await engine.ensure_shard(shard)
   if args.lora_rank and args.lora_rank > 0:
-    import jax
+    if hasattr(engine, "attach_lora"):
+      engine.attach_lora(args.lora_rank)  # mode-aware (plain / pp / sp)
+    else:
+      import jax
 
-    from .lora import add_lora
+      from .lora import add_lora
 
-    engine.params = add_lora(engine.params, args.lora_rank, jax.random.PRNGKey(0))
-    if hasattr(engine, "_train_state"):
-      del engine._train_state
+      engine.params = add_lora(engine.params, args.lora_rank, jax.random.PRNGKey(0))
+      if hasattr(engine, "_train_state"):
+        del engine._train_state
   if args.resume_checkpoint:
     await engine.load_checkpoint(shard, args.resume_checkpoint)
   if not args.data:

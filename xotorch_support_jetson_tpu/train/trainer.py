@@ -25,12 +25,14 @@ class _TrainState:
     self.opt_state = opt_state
 
 
-def _get_train_state(engine, lr: float, opt: str, lora: bool) -> _TrainState:
+def _get_train_state(engine, lr: float, opt: str, lora: bool, params=None, mesh=None, plan=None) -> _TrainState:
   state = getattr(engine, "_train_state", None)
   if state is not None:
     return state
   cfg = engine.cfg
-  mesh = build_mesh(MeshPlan())  # single-device; multi-chip via parallel API
+  mesh = mesh if mesh is not None else build_mesh(MeshPlan())  # single-device; multi-chip via the mesh branch below
+  plan = plan or MeshPlan()
+  params = engine.params if params is None else params
   if opt == "sgd":
     optimizer = optax.sgd(lr)
   elif lora:
@@ -40,12 +42,34 @@ def _get_train_state(engine, lr: float, opt: str, lora: bool) -> _TrainState:
   else:
     optimizer = optax.adamw(lr)
   grad_post = lora_grad_mask if lora else None
-  init_fn, step_fn = make_train_step(mesh, cfg, MeshPlan(), optimizer=optimizer, remat=True, grad_postprocess=grad_post)
-  eval_fn = make_eval_step(mesh, cfg, MeshPlan())
-  opt_state = init_fn(engine.params)
+  init_fn, step_fn = make_train_step(mesh, cfg, plan, optimizer=optimizer, remat=True, grad_postprocess=grad_post)
+  eval_fn = make_eval_step(mesh, cfg, plan)
+  opt_state = init_fn(params)
   state = _TrainState(step_fn, eval_fn, opt_state)
   engine._train_state = state
   return state
+
+
+def _mesh_mode(engine):
+  """(mode, serving) for an engine in a mesh serving mode: ("pp", PPServing)
+  or ("sp", SPServing); (None, None) for plain/tp engines."""
+  srv = getattr(engine, "_pp", None)
+  if srv is None:
+    return None, None
+  from ..parallel.pp_serving import PPServing
+
+  return ("pp" if isinstance(srv, PPServing) else "sp"), srv
+
+
+def _mesh_train_setup(engine, srv, mode):
+  """(params, plan) for a mesh-mode train/eval step: the flat view of the
+  placed weights and the matching mesh plan. PP routes through the GPipe
+  pipeline (plan.pp = its stage count); sp/tp params train under plain
+  GSPMD on the same mesh (sp is a serving-cache axis, not a batch axis)."""
+  params = engine._flat_params_view()
+  tp = srv.mesh.shape.get("tp", 1)
+  plan = MeshPlan(pp=srv.n_stages, tp=tp) if mode == "pp" else MeshPlan(tp=tp)
+  return params, plan
 
 
 def _has_lora(params) -> bool:
@@ -64,19 +88,47 @@ def _make_batch(inputs, targets, lengths):
 def engine_train_step(engine, shard, inputs, targets, lengths, loss: str = "ce", opt: str = "adamw", lr: float = 1e-5) -> float:
   if not (shard.is_first_layer and shard.is_last_layer):
     raise NotImplementedError("engine-side training requires a full-model shard (pipeline training rides the ring protocol)")
-  lora = _has_lora(engine.params)
-  state = _get_train_state(engine, lr, opt, lora)
-  batch = _make_batch(inputs, targets, lengths)
-  engine.params, state.opt_state, loss_val = state.step_fn(engine.params, state.opt_state, batch)
+  mode, srv = _mesh_mode(engine)
+  if mode is None:
+    lora = _has_lora(engine.params)
+    state = _get_train_state(engine, lr, opt, lora)
+    batch = _make_batch(inputs, targets, lengths)
+    engine.params, state.opt_state, loss_val = state.step_fn(engine.params, state.opt_state, batch)
+    return float(jax.device_get(loss_val))
+  # Mesh serving modes (VERDICT r3 #4): the SAME distributed train step runs
+  # over the serving mesh — pp's flat view keeps the layer axis pp-sharded
+  # and the step pipelines it (GPipe); the updated tree re-places into the
+  # serving layout so the deep-pipeline engine fine-tunes in place.
+  from ..parallel.train_step import shard_batch
+
+  params, plan = _mesh_train_setup(engine, srv, mode)
+  state = _get_train_state(engine, lr, opt, _has_lora(params), params=params, mesh=srv.mesh, plan=plan)
+  batch = shard_batch(_make_batch(inputs, targets, lengths), srv.mesh)
+  new_params, state.opt_state, loss_val = state.step_fn(params, state.opt_state, batch)
+  engine._adopt_flat_params(new_params)
   return float(jax.device_get(loss_val))
 
 
 def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") -> float:
   if not (shard.is_first_layer and shard.is_last_layer):
     raise NotImplementedError("engine-side eval requires a full-model shard")
-  state = _get_train_state(engine, 1e-5, "adamw", _has_lora(engine.params))
-  batch = _make_batch(inputs, targets, lengths)
-  return float(jax.device_get(state.eval_fn(engine.params, batch)))
+  mode, srv = _mesh_mode(engine)
+  if mode is None:
+    state = _get_train_state(engine, 1e-5, "adamw", _has_lora(engine.params))
+    batch = _make_batch(inputs, targets, lengths)
+    return float(jax.device_get(state.eval_fn(engine.params, batch)))
+  from ..parallel.train_step import shard_batch
+
+  params, plan = _mesh_train_setup(engine, srv, mode)
+  # Eval-only: never build optimizer state (adamw moments are ~2x model
+  # bytes — fatal on a pipeline mesh sized for serving). The eval jit takes
+  # params as an argument, so the cached fn survives weight updates.
+  eval_fn = getattr(engine, "_mesh_eval_fn", None)
+  if eval_fn is None:
+    eval_fn = make_eval_step(srv.mesh, engine.cfg, plan)
+    engine._mesh_eval_fn = eval_fn
+  batch = shard_batch(_make_batch(inputs, targets, lengths), srv.mesh)
+  return float(jax.device_get(eval_fn(params, batch)))
 
 
 # ----------------------------- ring pipeline training (partial shards)
