@@ -82,6 +82,38 @@ def test_spec_decode_unrelated_draft_is_exact(gamma):
   assert got[: len(ref)] == ref
 
 
+def test_peaked_echo_model_hits_high_acceptance_and_stays_exact():
+  """The peaked-logit synthetic model (utils/synthetic.py): the int8
+  self-draft reaches near-full acceptance — the speculative win is
+  measurable OFFLINE (bench.py spec_peak_* fields record it) — while the
+  output stays token-identical to plain greedy."""
+  from xotorch_support_jetson_tpu.models.quantize import quantize_params
+  from xotorch_support_jetson_tpu.utils.synthetic import peaked_echo_params
+
+  cfg = tiny_test_config(n_layers=4, max_seq_len=128, tied_embedding=True)
+  base, shard = full_model_params(jax.random.PRNGKey(7), cfg, "m")
+  params = peaked_echo_params(base)
+  qp = quantize_params(params)
+  gamma, max_steps = 4, 24
+  prompt = np.array([[5, 9, 2, 71]], dtype=np.int32)
+  ref = _greedy_reference(cfg, params, shard, prompt, max_steps, eos_ids=(-1,))
+
+  B, S = prompt.shape
+  cache_t = init_kv_cache(cfg, shard.n_shard_layers, B, cfg.max_seq_len)
+  cache_d = init_kv_cache(cfg, shard.n_shard_layers, B, cfg.max_seq_len)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+  logits, cache_t = shard_forward(params, cfg, shard, jnp.asarray(prompt), positions, cache_t)
+  _, cache_d = shard_forward(qp, cfg, shard, jnp.asarray(prompt), positions, cache_d)
+  first = jnp.argmax(logits[:, S - 1, :], axis=-1).astype(jnp.int32)[:, None]
+  buf, n, rounds, _, _ = fused_speculative_generate(
+    params, cfg, shard, qp, cfg, shard, first, cache_t, cache_d, jnp.int32(S), max_steps, gamma=gamma, eos_ids=(-1,)
+  )
+  got = [int(first[0, 0])] + [int(t) for t in np.asarray(buf)[: int(n)]][:max_steps]
+  assert got[: len(ref)] == ref
+  acceptance = (int(n) / max(int(rounds), 1) - 1) / gamma
+  assert acceptance >= 0.9, f"peaked model acceptance {acceptance} — the ceiling construction regressed"
+
+
 @pytest.mark.asyncio
 async def test_engine_spec_decode_matches_plain_oneshot():
   """XOT_TPU_SPEC_DECODE=int8 engine path (prefill + generate_oneshot) must

@@ -1,0 +1,34 @@
+"""Synthetic model variants for offline measurement.
+
+``peaked_echo_params`` manufactures the speculative-decoding acceptance
+CEILING (VERDICT r3 #6): on RANDOM weights the logits are near-uniform, so
+the int8 self-draft disagrees with the bf16 target ~36% of the time and
+speculation measurably loses — an acceptance FLOOR no offline benchmark
+could previously escape. The echo variant scales the residual-stream write
+projections (wo / w_down) toward zero, so each layer contributes ~nothing
+and the hidden state stays ≈ the token embedding; with a tied (or
+self-similar) head the logits then peak sharply at the CURRENT token —
+greedy generation echoes it, and the quantized draft agrees with the target
+almost always. Measuring spec-vs-plain on BOTH variants brackets any real
+checkpoint's behavior without network egress (real acceptance for chatty
+models sits between the floor and this ceiling).
+"""
+
+from __future__ import annotations
+
+
+def peaked_echo_params(params: dict, damp: float = 0.05) -> dict:
+  """A peaked-logit variant of ``params``: residual-stream writes scaled by
+  ``damp``. Returns a shallow-copied tree (untouched leaves shared)."""
+  out = dict(params)
+  for name in ("layers", "moe_layers"):
+    if name not in params:
+      continue
+    stack = dict(params[name])
+    for k in list(stack):
+      # Residual-stream writes: attention out-proj and every MLP
+      # down-projection (dense w_down, MoE w_experts_down / w_shared_down).
+      if k == "wo" or k.endswith("_down"):
+        stack[k] = stack[k] * damp
+    out[name] = stack
+  return out
