@@ -316,6 +316,73 @@ def test_parked_request_survives_insta_finished_batchmate(monkeypatch):
   assert out_b == _solo(params, shard, big, 4)
 
 
+def test_chunked_prefill_interleaves_decode(monkeypatch):
+  """A long prompt prefills in XOT_TPU_PREFILL_CHUNK-sized chunks with
+  decode ticks for resident rows BETWEEN the chunks — one long arrival no
+  longer stalls every stream for its whole prefill — and every output stays
+  token-identical to solo greedy."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "128")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512)
+  params, shard = full_model_params(KEY, cfg)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, cfg, params)
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  events = []  # ordered ("prefill", n_rows) / ("decode",) trace
+
+  orig_prefill = server.ops.prefill_into_pages_many
+  orig_decode = server.ops.paged_batch_decode
+
+  def rec_prefill(tokens, *a, **k):
+    events.append(("prefill", int(np.asarray(tokens).shape[0])))
+    return orig_prefill(tokens, *a, **k)
+
+  def rec_decode(*a, **k):
+    events.append(("decode",))
+    return orig_decode(*a, **k)
+
+  server.ops.prefill_into_pages_many = rec_prefill
+  server.ops.paged_batch_decode = rec_decode
+
+  long_prompt = [(7 * i) % 120 + 1 for i in range(400)]  # 4 chunks of 128
+  short = [3, 25, 9]
+
+  async def run():
+    streamed: dict[str, list] = {}
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      streamed.setdefault(rid, []).extend(toks)
+      if rid == "s0" and len(streamed[rid]) >= 2:
+        started.set()
+
+    async def late_long():
+      await started.wait()  # the short stream is mid-decode when this lands
+      return await server.submit("L", np.asarray(long_prompt, np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+
+    return await asyncio.gather(
+      server.submit("s0", np.asarray(short, np.int32), max_tokens=30, temp=0.0, top_k=35, eos_ids=(), emit=emit),
+      late_long(),
+    )
+
+  out_short, out_long = asyncio.run(run())
+  assert out_short == _solo(params, shard, short, 30, cfg=cfg)
+  assert out_long == _solo(params, shard, long_prompt, 3, cfg=cfg)
+  # The long prompt took >= 4 prefill dispatches (400 tokens / 128-chunk) on
+  # top of the short request's admission…
+  p_idx = [i for i, e in enumerate(events) if e[0] == "prefill"]
+  assert len(p_idx) >= 5, events
+  # …and decode ticks ran BETWEEN its chunks (the stall per tick is bounded
+  # by one chunk, not the whole 400-token prefill).
+  long_chunks = p_idx[-4:]
+  interleaved = any(("decode",) in events[a + 1 : b] for a, b in zip(long_chunks, long_chunks[1:]))
+  assert interleaved, f"no decode tick between prefill chunks: {events}"
+
+
 def test_pp_engine_batched_admission(monkeypatch):
   """XOT_TPU_PP=2: the pp-pipelined backend admits a burst in one dispatch
   too (dense slots), outputs exact."""

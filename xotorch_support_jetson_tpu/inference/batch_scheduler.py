@@ -15,6 +15,10 @@ shapes:
   so one compiled program per (row-bucket, pad-bucket) serves every
   combination). K concurrent arrivals cost ≈ one prefill's wall-clock
   instead of K serial dispatches — the p50-TTFT fix under load;
+- long prompts prefill in CHUNKS (paged mode, ``XOT_TPU_PREFILL_CHUNK``
+  tokens per tick, default 2048) with decode ticks interleaved, so one 32K
+  arrival cannot stall every resident stream for its whole prefill — the
+  paged prefill program natively resumes from a per-row prefix offset;
 - decode runs ``fused_batch_decode`` chunks over ALL rows every tick with
   per-row positions/temperature/active mask — one compiled program total;
 - admission happens between chunks: new requests claim free slots and
@@ -63,15 +67,18 @@ class _Request:
 
 @dataclass
 class _Ready:
-  """A host-prepared admission awaiting its batched prefill dispatch."""
+  """A host-prepared admission awaiting its batched prefill dispatch (or,
+  mid-chunked-prefill, its NEXT chunk dispatch — ``prefix_len`` advances to
+  the end of each completed chunk)."""
 
   req: _Request
   row: int
-  pad_to: int  # this request's own padded suffix length
+  pad_to: int  # this request's own padded suffix length (current chunk)
   prefix_len: int = 0
   shared_pages: list = field(default_factory=list)
   new_pages: list = field(default_factory=list)
   chain_keys: list = field(default_factory=list)
+  chunk_end: int = 0  # 0 = the dispatch covers the full prompt; else the chunk's end position
 
 
 @dataclass
@@ -117,6 +124,14 @@ class BatchedServer:
     # XOT_TPU_PAGED=0 restores the dense slot-per-max_seq cache.
     self.paged = os.getenv("XOT_TPU_PAGED", "1") not in ("0", "false")
     self.page_size = int(os.getenv("XOT_TPU_PAGE_SIZE", "64"))
+    # Chunked prefill (paged mode): a prompt longer than this many tokens
+    # prefills in chunks with DECODE TICKS interleaved between them, so one
+    # very long arrival cannot stall every resident stream for its whole
+    # prefill (the paged prefill program natively resumes from a per-row
+    # prefix offset). 0 disables; dense mode always prefills whole (its
+    # program has no resume offset — and it is the opt-in layout).
+    self.prefill_chunk = int(os.getenv("XOT_TPU_PREFILL_CHUNK", "2048"))
+    self._prefilling: list[_Ready] = []  # admissions mid-chunked-prefill (rows reserved)
     self.allocator = None
     self.block_tables = None
     self.cache = None
@@ -171,6 +186,12 @@ class BatchedServer:
       if slot is not None and slot.req.request_id == request_id:
         slot.cancelled = True
         return
+    for r in self._prefilling:
+      if r.req.request_id == request_id:
+        # Mid-chunked-prefill: settled (pages released) at the next tick's
+        # continuation sweep in _admit_pending.
+        self._cancelled_ids.add(request_id)
+        return
     queued = self._queued.get(request_id)
     if queued is not None and not queued.future.done():
       queued.max_tokens = 0  # admitted-then-finished immediately
@@ -209,6 +230,9 @@ class BatchedServer:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
 
   def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
+    # Mid-chunked-prefill rows are protected by ``taken``: _admit_pending
+    # swaps _prefilling out and seeds taken with those rows before any
+    # _free_slot call.
     for i, s in enumerate(self.slots):
       if s is None and i not in taken:
         return i
@@ -241,8 +265,9 @@ class BatchedServer:
         raise PromptTooLongError(f"prompt of {S} tokens exceeds the {self.max_seq}-token context window")
 
       if not self.paged:
-        pad_to = min(_round_up(S, PREFILL_BUCKET), self.max_seq)
-        return "ready", _Ready(req=req, row=row, pad_to=pad_to)
+        # pad_to is computed per dispatch by _chunk_ready (the single source
+        # of truth — chunking advances it as prefix_len grows).
+        return "ready", _Ready(req=req, row=row, pad_to=0)
 
       ps = self.page_size
       chain_keys = self.allocator.chain_keys(req.tokens, ps)
@@ -264,13 +289,8 @@ class BatchedServer:
           self._queued[req.request_id] = req
           return "park", None
         raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
-      # The padded suffix writes at offset prefix_len and must stay inside
-      # the row's logical window — dynamic_update_slice CLAMPS out-of-range
-      # starts, which would silently corrupt slot 0 (_dispatch groups rows
-      # by this constraint before padding them to a common length).
-      pad_to = min(_round_up(S - prefix_len, PREFILL_BUCKET), self.max_seq - prefix_len)
       return "ready", _Ready(
-        req=req, row=row, pad_to=pad_to, prefix_len=prefix_len, shared_pages=shared_pages,
+        req=req, row=row, pad_to=0, prefix_len=prefix_len, shared_pages=shared_pages,
         new_pages=list(new_pages), chain_keys=chain_keys,
       )
     except Exception as e:  # noqa: BLE001
@@ -293,6 +313,20 @@ class BatchedServer:
     ready: list[_Ready] = []
     taken: set[int] = set()
     reserve = 0
+    # Chunked-prefill continuations go FIRST: their rows/pages are already
+    # committed, and each tick advances every in-flight prefill by one chunk
+    # (a cancel that landed between chunks settles the request here).
+    prefilling, self._prefilling = self._prefilling, []
+    for r in prefilling:
+      if r.req.request_id in self._cancelled_ids:
+        self._cancelled_ids.discard(r.req.request_id)
+        self._release_ready_pages(r)
+        r.req.emit(r.req.request_id, [], True)
+        if not r.req.future.done():
+          r.req.future.set_result([])
+        continue
+      ready.append(r)
+      taken.add(r.row)  # _prefilling was just emptied; keep the row reserved
     if woken is not None and (row := self._free_slot(taken)) is not None:
       status, r = self._prepare(woken, row)
       if status == "park":
@@ -326,6 +360,30 @@ class BatchedServer:
     if ready:
       await self._dispatch(ready)
 
+  def _chunk_ready(self, r: _Ready) -> None:
+    """Set this dispatch's padded span (the ONE source of pad_to), capping
+    long prompts to a chunk (paged mode): cover [prefix_len, chunk_end)
+    only; the admission loop re-dispatches the rest next tick, with decode
+    chunks interleaved. The pad stays inside the row's logical window —
+    dynamic_update_slice CLAMPS out-of-range starts, which would silently
+    corrupt slot 0 (_dispatch_groups enforces the same bound per group)."""
+    S = int(r.req.tokens.shape[0])
+    cap = self.prefill_chunk
+    if not self.paged or cap <= 0 or S - r.prefix_len <= cap:
+      r.chunk_end = 0
+      r.pad_to = min(_round_up(max(S - r.prefix_len, 1), PREFILL_BUCKET), self.max_seq - r.prefix_len)
+      return
+    r.chunk_end = r.prefix_len + cap
+    r.pad_to = min(_round_up(cap, PREFILL_BUCKET), self.max_seq - r.prefix_len)
+
+  def _release_ready_pages(self, r: _Ready) -> None:
+    """Free a not-yet-finished admission's pages (cancel or failure)."""
+    for p in r.shared_pages:
+      self.allocator.release(p)
+    if r.new_pages:
+      self.allocator.free(r.new_pages)
+    r.shared_pages, r.new_pages = [], []
+
   def _dispatch_groups(self, ready: list[_Ready]) -> list[list[_Ready]]:
     """Split admissions so every row in a group satisfies
     ``prefix_len + S_pad <= max_seq`` (the scatter-clamp constraint: a row
@@ -348,6 +406,7 @@ class BatchedServer:
     fails every request in the group, releases their pages, and the pool
     keeps serving."""
     for r in ready:
+      self._chunk_ready(r)  # cap long prompts to one prefill chunk per tick
       self._admitting.add(r.req.request_id)
     try:
       for group in self._dispatch_groups(ready):
@@ -387,20 +446,35 @@ class BatchedServer:
     temps = np.zeros((n_rows,), dtype=np.float32)
     top_ks = np.ones((n_rows,), dtype=np.int32)
     for i, r in enumerate(group):
-      S = int(r.req.tokens.shape[0])
-      tok[i, : S - r.prefix_len] = r.req.tokens[r.prefix_len :]
-      prompt_lens[i] = S
+      # A chunked prefill covers [prefix_len, chunk_end) only; the final
+      # chunk (chunk_end == 0) runs to the prompt's end and samples.
+      end = r.chunk_end or int(r.req.tokens.shape[0])
+      tok[i, : end - r.prefix_len] = r.req.tokens[r.prefix_len : end]
+      prompt_lens[i] = end
       temps[i] = r.req.temp
       top_ks[i] = min(r.req.top_k, self.k_max)
 
     if self.paged:
-      bts = np.zeros((n_rows, self.pages_per_row), dtype=np.int32)
+      # Truncate the gathered page window to this dispatch's span: the
+      # prefill only reads/writes pages covering [0, max prompt_lens), so
+      # gathering each row's full max_seq window would multiply KV-pool
+      # copy traffic — by the chunk count for chunked prefills, and by
+      # window/prompt for ordinary short-prompt admissions. Power-of-two
+      # bucketing bounds the compiled-shape count at log2(pages_per_row).
+      ps = self.page_size
+      # The window must cover each row's PADDED write reach (the program
+      # writes S_pad slots from prefix_len; pad garbage scatters to trash),
+      # which the scatter-clamp grouping already bounds to max_seq.
+      need_pages = (max(int(r.prefix_len) for r in group) + S_pad + ps - 1) // ps
+      mp_used = 1
+      while mp_used < need_pages:
+        mp_used *= 2
+      mp_used = min(mp_used, self.pages_per_row)
+      bts = np.zeros((n_rows, mp_used), dtype=np.int32)
       prefix_lens = np.zeros((n_rows,), dtype=np.int32)
       for i, r in enumerate(group):
-        n_sh = len(r.shared_pages)
-        total = n_sh + len(r.new_pages)
-        bts[i, :n_sh] = r.shared_pages
-        bts[i, n_sh:total] = r.new_pages
+        row_pages = (r.shared_pages + r.new_pages)[:mp_used]
+        bts[i, : len(row_pages)] = row_pages
         prefix_lens[i] = r.prefix_len
       # Padding rows: all-zero block table (writes land in the trash page),
       # prefix 0, prompt_len 1.
@@ -431,10 +505,7 @@ class BatchedServer:
       firsts = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     except Exception as e:  # noqa: BLE001
       for r in group:
-        for p in r.shared_pages:
-          self.allocator.release(p)
-        if r.new_pages:
-          self.allocator.free(r.new_pages)
+        self._release_ready_pages(r)
         if not r.req.future.done():
           r.req.future.set_exception(e)
         self._cancelled_ids.discard(r.req.request_id)
@@ -443,6 +514,10 @@ class BatchedServer:
       for r in group:
         self._admitting.discard(r.req.request_id)
     for i, r in enumerate(group):
+      if r.chunk_end:  # intermediate chunk: advance and re-queue; no sample
+        r.prefix_len = r.chunk_end
+        self._prefilling.append(r)
+        continue
       self._finish_admission(r, int(firsts[i]))
 
   def _finish_admission(self, r: _Ready, first: int) -> None:
@@ -516,6 +591,10 @@ class BatchedServer:
         # pool stepping).
         await self._admit_pending()
         if all(s is None for s in self.slots):
+          if self._prefilling:
+            # A chunked prefill is mid-flight with no resident decoders:
+            # loop straight back to dispatch its next chunk.
+            continue
           if self._parked:
             # A ready batch that insta-finished (eos or max_tokens at its
             # first token, a raced cancel, or a failed dispatch) can leave
@@ -635,6 +714,10 @@ class BatchedServer:
       if slot is not None and not slot.req.future.done():
         slot.req.future.set_exception(exc)
       self.slots[i] = None
+    while self._prefilling:
+      r = self._prefilling.pop()
+      if not r.req.future.done():
+        r.req.future.set_exception(exc)
     self._queued.clear()
     while self._parked:
       req = self._parked.popleft()
