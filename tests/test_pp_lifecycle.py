@@ -224,6 +224,33 @@ def test_pp_vision_checkpoint_keeps_tower(tmp_path):
   )
 
 
+@pytest.mark.parametrize("mode", ["pp", "sp"])
+def test_mesh_engine_scores_logprobs(mode, monkeypatch):
+  """score_tokens (OpenAI logprobs) works on pp/sp mesh engines through the
+  flat params view — no more None for mesh serving modes — and matches the
+  plain engine's numbers."""
+  if mode == "pp":
+    engine, params, shard = _pp_engine(seed=29)
+  else:
+    monkeypatch.setenv("XOT_TPU_SP", "2")
+    params, shard = full_model_params(jax.random.PRNGKey(29), CFG, "tiny")
+    engine = JaxShardedInferenceEngine(use_local_mesh=True)
+    engine.load_test_model(shard, CFG, params)
+    engine._maybe_shard_over_local_mesh()
+    assert engine._pp is not None
+  plain, _, _ = _plain_engine(seed=29)
+  toks = np.asarray([5, 9, 2, 71, 33, 8, 14, 60], np.int32)
+
+  async def score(eng):
+    return await eng.score_tokens(shard, toks, n_scored=3, top_n=5)
+
+  got = asyncio.run(score(engine))
+  ref = asyncio.run(score(plain))
+  assert got is not None and ref is not None
+  for g, r in zip(got, ref):
+    np.testing.assert_allclose(np.asarray(g, np.float64), np.asarray(r, np.float64), rtol=2e-4, atol=2e-4)
+
+
 def test_sp_train_and_checkpoint(tmp_path):
   """SP-mode engines train and checkpoint too (same mesh branch)."""
   import os

@@ -1058,12 +1058,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
     One cache-less parallel forward over prompt+completion
     (models/decoder.py score_last_tokens). Returns (chosen_logprobs [n],
     top_ids [n, top_n], top_logprobs [n, top_n]) as numpy, or None when this
-    engine can't score (mesh serving modes hold no flat params; partial ring
-    shards lack the head)."""
-    if self._pp is not None or self.params is None or self.cfg is None:
+    engine can't score (partial ring shards lack the head). Mesh serving
+    modes score through the flat params view (pp stage stacks reassemble
+    with the layer axis still sharded)."""
+    if self.cfg is None or (self._pp is None and self.params is None):
       return None
     eff = self._effective_shard
-    if not (eff.is_first_layer and eff.is_last_layer):
+    if eff is None or not (eff.is_first_layer and eff.is_last_layer):
       return None
     from ..models.decoder import score_last_tokens
 
@@ -1080,7 +1081,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     n_bucket = min(_round_up(int(n_scored), 32), pad_to - 1)
 
     def run():
-      out = score_last_tokens(self.params, self.cfg, eff, jnp.asarray(buf), jnp.int32(S), n_bucket, 20)
+      # The flat view (and its first-call reassemble jit on pp meshes) is
+      # device work — it belongs on the engine's single executor thread.
+      params = self._flat_params_view()
+      out = score_last_tokens(params, self.cfg, eff, jnp.asarray(buf), jnp.int32(S), n_bucket, 20)
       chosen_lp, top_ids, top_lp = (np.asarray(x) for x in out)
       n, t = int(n_scored), max(int(top_n), 1)
       return chosen_lp[-n:], top_ids[-n:, :t], top_lp[-n:, :t]
