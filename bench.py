@@ -173,11 +173,10 @@ def main() -> None:
   # Continuous-batching aggregate (XOT_TPU_BATCHED=1 serving mode,
   # inference/batch_scheduler.py): decode is weight-bandwidth-bound, so an
   # 8-row slot pool multiplies aggregate tokens/s ~4.5× on v5e-1.
-  def _bench_batch8(p) -> float:
-    """8-row batched chunk aggregate for any params pytree (bf16 / int8)."""
+  def _bench_batch(p, Bb: int) -> float:
+    """Bb-row batched chunk aggregate for any params pytree (bf16 / int8)."""
     from xotorch_support_jetson_tpu.models.decoder import fused_batch_decode
 
-    Bb = 8
     bcache = init_kv_cache(cfg, shard.n_shard_layers, Bb, 1024)
     btok = jnp.ones((Bb, 1), jnp.int32)
     bpos = jnp.full((Bb,), prompt_len, jnp.int32)
@@ -190,11 +189,15 @@ def main() -> None:
     _ = np.asarray(btoks)
     return round(Bb * n_decode / (time.perf_counter() - t0), 2)
 
-  batch8_tok_s = _bench_batch8(params) if on_accel else None
-  # int8 x continuous batching: the best single-chip aggregate config —
-  # halved weight bytes per step AND 8 streams amortizing each read
-  # (XOT_TPU_QUANT=int8 + XOT_TPU_BATCHED=1 together).
-  int8_batch8_tok_s = _bench_batch8(qp) if on_accel else None
+  batch8_tok_s = _bench_batch(params, 8) if on_accel else None
+  # int8 x continuous batching: halved weight bytes per step AND the rows
+  # amortizing each read (XOT_TPU_QUANT=int8 + XOT_TPU_BATCHED=1 together).
+  int8_batch8_tok_s = _bench_batch(qp, 8) if on_accel else None
+  # 16 rows is the measured single-chip sweet spot at int8 (round-4 probe:
+  # B=8 1148, B=16 1466, B=32 1328 — beyond 16 the per-row attention reads
+  # start to dominate the amortized weight stream). The BEST aggregate
+  # config: XOT_TPU_QUANT=int8 XOT_TPU_BATCHED=1 XOT_TPU_BATCH_SLOTS=16.
+  int8_batch16_tok_s = _bench_batch(qp, 16) if on_accel else None
 
   # Long-context decode: the 1B model at a 32K-token context (cache ~1.1 GB
   # bf16 on top of 2.45 GB weights — the §5.7 long-context serving story).
@@ -520,6 +523,7 @@ def main() -> None:
         "int8_decode_tok_s": int8_tok_s,
         "batch8_aggregate_tok_s": batch8_tok_s,
         "int8_batch8_aggregate_tok_s": int8_batch8_tok_s,
+        "int8_batch16_aggregate_tok_s": int8_batch16_tok_s,
         "paged_batch16_aggregate_tok_s": paged16_tok_s,
         "spec_decode_tok_s": spec_tok_s,
         "spec_acceptance": spec_acceptance,
