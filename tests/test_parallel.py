@@ -118,6 +118,28 @@ def test_ring_sp_forward_matches():
   np.testing.assert_allclose(np.asarray(logits), _ref_logits(params, tokens), rtol=2e-4, atol=2e-4)
 
 
+def test_ring_sp_forward_matches_gemma2():
+  """gemma2 trains under ring sequence parallelism: the scale override,
+  logit softcap, and per-layer sliding window are per-score transforms that
+  commute with the ring's blockwise merge (the former NotImplementedError
+  guard is gone)."""
+  gcfg = tiny_test_config(
+    n_layers=4, post_norms=True, mlp_act="gelu_tanh", attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, query_pre_attn_scalar=24.0, sliding_window=4,
+    embed_scale=8.0, tied_embedding=True,
+  )
+  plan = MeshPlan(sp=2)
+  mesh = build_mesh(plan)
+  params, shard = full_model_params(jax.random.PRNGKey(17), gcfg, "g")
+  tokens = jax.random.randint(jax.random.PRNGKey(19), (2, 16), 0, gcfg.vocab_size, dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+  forward = make_forward_fn(mesh, gcfg, plan, n_micro=1, ring_sp=True, remat=False)
+  with jax.default_matmul_precision("highest"):
+    logits, _ = jax.jit(forward)(params, tokens, positions)
+    ref, _ = shard_forward(params, gcfg, shard, tokens, positions, None)
+  np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=3e-4, atol=3e-4)
+
+
 def test_full_train_step_dp_pp_sp_tp():
   """One composed dp×pp×sp×tp training step: runs, loss finite, params move."""
   plan = MeshPlan(dp=2, pp=2, sp=1, tp=2)

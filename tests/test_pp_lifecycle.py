@@ -251,6 +251,29 @@ def test_mesh_engine_scores_logprobs(mode, monkeypatch):
     np.testing.assert_allclose(np.asarray(g, np.float64), np.asarray(r, np.float64), rtol=2e-4, atol=2e-4)
 
 
+def test_local_mesh_engine_trains(monkeypatch):
+  """The DEFAULT in-slice tp/dp GSPMD engine (use_local_mesh, no _pp) trains
+  on ITS OWN mesh — the trainer used to build a fresh single-device mesh
+  that conflicted with the 8-device param placement (found driving the
+  train CLI on a multi-device host)."""
+  params, shard = full_model_params(jax.random.PRNGKey(31), CFG, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(shard, CFG, params)
+  engine._maybe_shard_over_local_mesh()
+  assert engine._pp is None and engine.mesh is not None  # local GSPMD mode
+  plain, _, _ = _plain_engine(seed=31)
+  inputs, targets, lengths = _batch(seed=13)
+
+  async def run(eng):
+    losses = [await eng.train("t", shard, inputs, targets, lengths, lr=1e-3) for _ in range(2)]
+    losses.append(await eng.evaluate("e", shard, inputs, targets, lengths))
+    return losses
+
+  got = asyncio.run(run(engine))
+  ref = asyncio.run(run(plain))
+  np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_sp_train_and_checkpoint(tmp_path):
   """SP-mode engines train and checkpoint too (same mesh branch)."""
   import os

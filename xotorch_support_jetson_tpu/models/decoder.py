@@ -409,12 +409,10 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
       else:
         attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions, **_attn_opts(cfg, p.get("is_sliding")))
     else:
-      if attn_fn is not None and not cfg.plain_attention:
-        # Fail loudly: the ring-attention override computes plain attention
-        # and would silently drop softcap/sliding-window/scale.
-        raise NotImplementedError("the attention override (ring sp) does not support gemma2 attention options")
-      default_attn = lambda q, k, v, qp, kp: gqa_attention(q, k, v, qp, kp, **_attn_opts(cfg, p.get("is_sliding")))  # noqa: E731
-      attn = (attn_fn or default_attn)(q, k, v, positions, positions[0])
+      # The override (ring sp — parallel/ring_attention.py) takes the same
+      # attention options as gqa_attention, so gemma2's scale/softcap/window
+      # ride through either path.
+      attn = (attn_fn or gqa_attention)(q, k, v, positions, positions[0], **_attn_opts(cfg, p.get("is_sliding")))
 
   attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
   if "post_attn_norm" in p:  # gemma2 post-attention layernorm

@@ -78,7 +78,7 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg: ModelConfig, n_stages: int, n_micro
   from .ring_attention import ring_attention
 
   seq = "sp" if ring_sp else None
-  attn_fn = (lambda q, k, v, qp, kp: ring_attention(q, k, v, qp, kp, axis_name="sp")) if ring_sp else None
+  attn_fn = (lambda q, k, v, qp, kp, **opts: ring_attention(q, k, v, qp, kp, axis_name="sp", **opts)) if ring_sp else None
 
   if n_stages == 1 and not ring_sp:
     # No manual axes needed: plain GSPMD layer stack (XLA's SPMD partitioner

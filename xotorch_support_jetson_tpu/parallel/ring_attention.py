@@ -24,15 +24,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, q_pos, kv_pos, scale):
+def _block_attn(q, k, v, q_pos, kv_pos, scale, logit_softcap=0.0, sliding_window=None):
   """One blockwise attention contribution, returning (numerator, row-max, row-sum).
 
   q [B,Sq,Hkv,G,hd]; k [B,Skv,Hkv,hd]; v [B,Skv,Hkv,hd_v] (MLA's naive
-  training K/V has v narrower than q/k). All math fp32.
+  training K/V has v narrower than q/k). All math fp32. The gemma2 options
+  go through the SHARED cap/mask helper (ops/attention.py
+  cap_and_mask_scores) — per-score transforms commute with the ring's
+  blockwise log-sum-exp merge, and one implementation keeps ring training
+  bit-consistent with serving attention.
   """
+  from ..ops.attention import cap_and_mask_scores
+
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
-  mask = kv_pos[None, None, None, None, :] <= q_pos[:, None, None, :, None]
-  scores = jnp.where(mask, scores, NEG_INF)
+  scores = cap_and_mask_scores(scores, q_pos, kv_pos, logit_softcap, sliding_window)
   m = jnp.max(scores, axis=-1)  # [B,H,G,Sq]
   p = jnp.exp(scores - m[..., None])
   # Fully-masked rows: m == NEG_INF → p would be exp(0)=1 garbage; zero them.
@@ -42,22 +47,26 @@ def _block_attn(q, k, v, q_pos, kv_pos, scale):
   return num, m, l
 
 
-def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp"):
+def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp", scale=None, logit_softcap: float = 0.0, sliding_window=None):
   """Blockwise ring attention; call inside shard_map with sequence sharded
   over ``axis_name``.
 
   q [B,Sq_local,Hq,hd]; k [B,Skv_local,Hkv,hd]; v [B,Skv_local,Hkv,hd_v]
   (hd_v may differ — MLA); q_positions [B,Sq_local]; kv_positions
   [Skv_local] (absolute positions of the local KV block — 1-D, shared
-  across batch; it rotates around the ring with K/V). The scale is
-  1/sqrt(hd), matching gqa_attention. Returns [B,Sq_local,Hq,hd_v].
+  across batch; it rotates around the ring with K/V). ``scale`` defaults to
+  1/sqrt(hd), matching gqa_attention; the gemma2 options (scale override,
+  logit softcap, sliding window — possibly a traced per-layer scalar)
+  match ops/attention.py cap_and_mask_scores semantics, so gemma2 trains
+  under ring sequence parallelism too. Returns [B,Sq_local,Hq,hd_v].
   """
   axis_size = jax.lax.psum(1, axis_name)
   B, Sq, Hq, hd = q.shape
   Hkv = k.shape[2]
   hd_v = v.shape[3]  # MLA: v head dim differs from q/k's (192 vs 128 on deepseek)
   G = Hq // Hkv
-  scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+  if scale is None:
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
   qg = q.reshape(B, Sq, Hkv, G, hd).astype(jnp.float32)
 
   num0 = jnp.zeros((B, Sq, Hkv, G, hd_v), jnp.float32)
@@ -66,7 +75,10 @@ def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp"):
 
   def body(carry, _):
     k_blk, v_blk, kv_pos, num, m, l = carry
-    blk_num, blk_m, blk_l = _block_attn(qg, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), q_positions, kv_pos, scale)
+    blk_num, blk_m, blk_l = _block_attn(
+      qg, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), q_positions, kv_pos, scale,
+      logit_softcap=logit_softcap, sliding_window=sliding_window,
+    )
     new_m = jnp.maximum(m, blk_m)
     alpha = jnp.exp(m - new_m)
     beta = jnp.exp(blk_m - new_m)
@@ -87,8 +99,10 @@ def ring_attention(q, k, v, q_positions, kv_positions, axis_name: str = "sp"):
   return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)
 
 
-def make_sharded_ring_attention(mesh: Mesh):
-  """shard_map-wrapped ring attention, manual over ``sp`` only (dp/tp auto)."""
+def make_sharded_ring_attention(mesh: Mesh, **attn_opts):
+  """shard_map-wrapped ring attention, manual over ``sp`` only (dp/tp auto).
+  ``attn_opts`` (scale / logit_softcap / sliding_window) close over the
+  wrapper — concrete values, as in tests."""
   spec_q = P(None, "sp", None, None)
   spec_pos = P(None, "sp")
 
@@ -101,7 +115,7 @@ def make_sharded_ring_attention(mesh: Mesh):
     check_vma=False,
   )
   def fn(q, k, v, q_positions, kv_positions):
-    return ring_attention(q, k, v, q_positions, kv_positions, axis_name="sp")
+    return ring_attention(q, k, v, q_positions, kv_positions, axis_name="sp", **attn_opts)
 
   # Partial-manual shard_map composes with the auto axes only under jit.
   return jax.jit(fn)

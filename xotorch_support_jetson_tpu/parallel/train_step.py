@@ -23,7 +23,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..ops.norm import rms_norm
 from .mesh import MeshPlan, specs_for_params
 from .pipeline import make_pipeline_layers_fn, run_layer_stack, stack_stage_params
 
@@ -65,8 +64,15 @@ def make_forward_fn(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int =
   layers_fn = make_pipeline_layers_fn(mesh, cfg, plan.pp, n_micro, ring_sp=ring, remat=remat)
 
   def forward(params, tokens, positions):
+    # embed/head via the decoder's own helpers so every config knob the
+    # serving path honors (gemma's embed_scale, tied heads, quantized
+    # lm_head_scale, final_logit_softcap) applies to TRAINING too — the
+    # previous inline take/matmul silently dropped embed_scale and the
+    # final softcap for gemma2.
+    from ..models.decoder import embed_tokens, head_logits
+
     tokens = jax.lax.with_sharding_constraint(tokens, NamedSharding(mesh, P("dp", "sp" if ring else None)))
-    h = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    h = embed_tokens(params, cfg, tokens)
     if "moe_layers" in params:
       # MoE model: a dense prefix (deepseek's first_k_dense — tiny, and not
       # divisible into pp stages) runs under plain GSPMD; the MoE stack is
@@ -79,11 +85,7 @@ def make_forward_fn(mesh: Mesh, cfg: ModelConfig, plan: MeshPlan, n_micro: int =
     else:
       stage_params = stack_stage_params(params["layers"], plan.pp)
     h, aux = layers_fn(stage_params, h, positions)
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    w_out = params.get("lm_head")
-    if w_out is None:
-      w_out = params["embed"].T
-    return h.astype(jnp.float32) @ w_out.astype(jnp.float32), aux
+    return head_logits(params, cfg, h).astype(jnp.float32), aux
 
   return forward
 

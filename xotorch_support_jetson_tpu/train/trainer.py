@@ -51,25 +51,34 @@ def _get_train_state(engine, lr: float, opt: str, lora: bool, params=None, mesh=
 
 
 def _mesh_mode(engine):
-  """(mode, serving) for an engine in a mesh serving mode: ("pp", PPServing)
-  or ("sp", SPServing); (None, None) for plain/tp engines."""
+  """(mode, serving) for an engine whose weights live on a mesh: ("pp",
+  PPServing) / ("sp", SPServing) for the explicit serving modes, ("local",
+  None) for the default in-slice tp/dp/ep GSPMD sharding (engine.mesh set,
+  no _pp), or (None, None) for a truly single-device engine."""
   srv = getattr(engine, "_pp", None)
-  if srv is None:
-    return None, None
-  from ..parallel.pp_serving import PPServing
+  if srv is not None:
+    from ..parallel.pp_serving import PPServing
 
-  return ("pp" if isinstance(srv, PPServing) else "sp"), srv
+    return ("pp" if isinstance(srv, PPServing) else "sp"), srv
+  if getattr(engine, "mesh", None) is not None:
+    return "local", None
+  return None, None
 
 
 def _mesh_train_setup(engine, srv, mode):
-  """(params, plan) for a mesh-mode train/eval step: the flat view of the
-  placed weights and the matching mesh plan. PP routes through the GPipe
-  pipeline (plan.pp = its stage count); sp/tp params train under plain
-  GSPMD on the same mesh (sp is a serving-cache axis, not a batch axis)."""
+  """(params, mesh, plan) for a mesh-mode train/eval step. PP routes
+  through the GPipe pipeline (plan.pp = its stage count); sp/tp/local
+  params train under plain GSPMD on the SAME mesh the weights already live
+  on (a fresh single-device mesh would conflict with their placement —
+  sp/ep are serving axes, not batch axes here)."""
+  if mode == "local":
+    mesh = engine.mesh
+    plan = MeshPlan(dp=mesh.shape.get("dp", 1), ep=mesh.shape.get("ep", 1), tp=mesh.shape.get("tp", 1))
+    return engine.params, mesh, plan
   params = engine._flat_params_view()
   tp = srv.mesh.shape.get("tp", 1)
   plan = MeshPlan(pp=srv.n_stages, tp=tp) if mode == "pp" else MeshPlan(tp=tp)
-  return params, plan
+  return params, srv.mesh, plan
 
 
 def _has_lora(params) -> bool:
@@ -95,16 +104,19 @@ def engine_train_step(engine, shard, inputs, targets, lengths, loss: str = "ce",
     batch = _make_batch(inputs, targets, lengths)
     engine.params, state.opt_state, loss_val = state.step_fn(engine.params, state.opt_state, batch)
     return float(jax.device_get(loss_val))
-  # Mesh serving modes (VERDICT r3 #4): the SAME distributed train step runs
-  # over the serving mesh — pp's flat view keeps the layer axis pp-sharded
-  # and the step pipelines it (GPipe); the updated tree re-places into the
-  # serving layout so the deep-pipeline engine fine-tunes in place.
+  # Mesh modes (VERDICT r3 #4): the SAME distributed train step runs over
+  # the mesh the weights already live on — pp's flat view keeps the layer
+  # axis pp-sharded and the step pipelines it (GPipe); sp/local params
+  # train in place under GSPMD.
   from ..parallel.train_step import shard_batch
 
-  params, plan = _mesh_train_setup(engine, srv, mode)
-  state = _get_train_state(engine, lr, opt, _has_lora(params), params=params, mesh=srv.mesh, plan=plan)
-  batch = shard_batch(_make_batch(inputs, targets, lengths), srv.mesh)
+  params, mesh, plan = _mesh_train_setup(engine, srv, mode)
+  state = _get_train_state(engine, lr, opt, _has_lora(params), params=params, mesh=mesh, plan=plan)
+  batch = shard_batch(_make_batch(inputs, targets, lengths), mesh)
   new_params, state.opt_state, loss_val = state.step_fn(params, state.opt_state, batch)
+  # _adopt_flat_params handles every layout (plain assign when _pp is None)
+  # AND drops weight-derived state — live KV sessions and the batched pool
+  # must not keep decoding from pre-update weights.
   engine._adopt_flat_params(new_params)
   return float(jax.device_get(loss_val))
 
@@ -119,15 +131,15 @@ def engine_eval_step(engine, shard, inputs, targets, lengths, loss: str = "ce") 
     return float(jax.device_get(state.eval_fn(engine.params, batch)))
   from ..parallel.train_step import shard_batch
 
-  params, plan = _mesh_train_setup(engine, srv, mode)
+  params, mesh, plan = _mesh_train_setup(engine, srv, mode)
   # Eval-only: never build optimizer state (adamw moments are ~2x model
   # bytes — fatal on a pipeline mesh sized for serving). The eval jit takes
   # params as an argument, so the cached fn survives weight updates.
   eval_fn = getattr(engine, "_mesh_eval_fn", None)
   if eval_fn is None:
-    eval_fn = make_eval_step(srv.mesh, engine.cfg, plan)
+    eval_fn = make_eval_step(mesh, engine.cfg, plan)
     engine._mesh_eval_fn = eval_fn
-  batch = shard_batch(_make_batch(inputs, targets, lengths), srv.mesh)
+  batch = shard_batch(_make_batch(inputs, targets, lengths), mesh)
   return float(jax.device_get(eval_fn(params, batch)))
 
 
