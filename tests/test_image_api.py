@@ -1,0 +1,155 @@
+"""/v1/image/generations end-to-end: the reference exposes this surface over
+dead code (its SD registry entry is commented out, reference models.py:167-168;
+handler at chatgpt_api.py:445-535); here the JAX diffusion pipeline actually
+serves it. Covers: progress-line streaming + saved-PNG URL, img2img via
+base64 image_url, 501 on engines without image support, 400 on non-SD models.
+"""
+
+import base64
+import io
+import json
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.diffusion import tiny_diffusion_config
+from xotorch_support_jetson_tpu.models.diffusion_loader import init_diffusion_params
+from xotorch_support_jetson_tpu.orchestration.node import Node
+from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+from tests_support_stubs import NoDiscovery, StubServer
+
+MODEL = "stable-diffusion-2-1-base"
+
+
+async def _make_api(engine):
+  node = Node(
+    "img-node", StubServer(), engine, NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(),
+  )
+  await node.start()
+  api = ChatGPTAPI(node, type(engine).__name__, response_timeout=60, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+def _jax_engine_with_tiny_sd():
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  cfg = tiny_diffusion_config()
+  params = init_diffusion_params(jax.random.PRNGKey(0), cfg)
+  full = Shard(MODEL, 0, 30, 31)  # registry card depth (vestigial for SD)
+  engine.load_test_diffusion(full, cfg, params)
+  return engine
+
+
+async def _read_lines(resp):
+  lines = []
+  async for chunk in resp.content:
+    chunk = chunk.strip()
+    if chunk:
+      lines.append(json.loads(chunk))
+  return lines
+
+
+@pytest.mark.asyncio
+async def test_image_generation_streams_progress_and_url():
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    resp = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "a red cube", "steps": 6, "seed": 3})
+    assert resp.status == 200
+    lines = await _read_lines(resp)
+
+    progress = [l for l in lines if "progress" in l]
+    assert progress, lines
+    assert progress[0]["step"] == 0 and progress[-1]["step"] == progress[-1]["total_steps"] == 6
+    assert "Progress: [" in progress[-1]["progress"]
+
+    final = [l for l in lines if "images" in l]
+    assert len(final) == 1
+    url = final[0]["images"][0]["url"]
+    assert final[0]["images"][0]["content_type"] == "image/png"
+
+    # the URL must serve a real PNG of the pipeline's output size
+    png = await client.get(url[url.index("/images/"):])
+    assert png.status == 200
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(await png.read()))
+    assert img.size == (16, 16)
+
+    # deterministic per seed: same request → same bytes
+    resp2 = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "a red cube", "steps": 6, "seed": 3})
+    lines2 = await _read_lines(resp2)
+    url2 = [l for l in lines2 if "images" in l][0]["images"][0]["url"]
+    png2 = await client.get(url2[url2.index("/images/"):])
+    assert await png2.read() == await (await client.get(url[url.index("/images/"):])).read()
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_image_generation_img2img():
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (16, 16), (200, 30, 30)).save(buf, format="PNG")
+    data_url = "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+    resp = await client.post(
+      "/v1/image/generations",
+      json={"model": MODEL, "prompt": "bluer", "steps": 4, "image_url": data_url, "strength": 0.5},
+    )
+    assert resp.status == 200
+    lines = await _read_lines(resp)
+    final = [l for l in lines if "images" in l]
+    assert len(final) == 1
+    # img2img runs strength*steps denoise steps
+    progress = [l for l in lines if "progress" in l]
+    assert progress[-1]["total_steps"] == 2
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_image_generation_rejects_non_sd_model_and_dummy_engine():
+  node, api, client = await _make_api(DummyInferenceEngine())
+  try:
+    resp = await client.post("/v1/image/generations", json={"model": "llama-3.2-1b", "prompt": "x"})
+    assert resp.status == 400
+    resp = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "x"})
+    assert resp.status == 501  # engine cannot generate images (reference-parity refusal)
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_image_generation_bad_params_are_400():
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    for bad in ({"steps": "thirty"}, {"size": 512}, {"seed": None}, {"steps": 0}, {"size": [512]}):
+      resp = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "x", **bad})
+      assert resp.status == 400, bad
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_image_generation_bad_image_url_is_400():
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    resp = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "x", "image_url": "data:image/png;base64,!!!notb64"})
+    assert resp.status == 400
+  finally:
+    await client.close()
+    await node.stop()

@@ -270,6 +270,12 @@ class ChatGPTAPI:
     r.add_post("/v1/image/generations", self.handle_image_generations)
     r.add_post("/quit", self.handle_quit)
 
+    from ..utils.helpers import XOT_HOME
+
+    self.images_dir = XOT_HOME / "images"
+    self.images_dir.mkdir(parents=True, exist_ok=True)
+    r.add_static("/images/", self.images_dir, name="static_images")
+
     static_dir = Path(__file__).parent.parent / "tinychat"
     if static_dir.exists():
       r.add_get("/", self.handle_root)
@@ -297,6 +303,12 @@ class ChatGPTAPI:
   def _make_timeout_middleware(self):
     @web.middleware
     async def timeout(request, handler):
+      # The image handler manages its own per-wait stall timeout (the
+      # reference likewise gives images a 10x budget, chatgpt_api.py:529);
+      # wrapping the whole stream in wait_for would kill healthy long
+      # generations after 200 headers are out.
+      if request.path.endswith("/image/generations"):
+        return await handler(request)
       try:
         return await asyncio.wait_for(handler(request), timeout=self.response_timeout)
       except asyncio.TimeoutError:
@@ -566,10 +578,146 @@ class ChatGPTAPI:
     )
 
   async def handle_image_generations(self, request):
-    # Endpoint surface parity with the reference's stable-diffusion path
-    # (chatgpt_api.py:445-535); diffusion models are not in the registry
-    # (the reference ships the entry commented out too, models.py:168-169).
-    return web.json_response({"detail": "image generation models are not supported by this engine"}, status=501)
+    """POST /v1/image/generations — streaming progress + saved-PNG URL.
+
+    Surface parity with the reference handler (chatgpt_api.py:445-535):
+    same request fields (model, prompt, image_url for img2img), same
+    octet-stream of JSON lines ({"progress": ...} then {"images": [{url,
+    content_type}]}), same images static mount. Difference: this one
+    actually generates (the reference's SD registry entry is commented out,
+    reference models.py:167-168, so its path is unreachable). Extra fields
+    beyond the reference: negative_prompt, steps, guidance, seed, size,
+    strength.
+    """
+    data = await request.json()
+    model = data.get("model", "")
+    prompt = data.get("prompt", "")
+    if registry.get_family(model) != "stable-diffusion":
+      return web.json_response({"error": f"Unsupported model for image generation: {model}"}, status=400)
+    if not getattr(self.node.inference_engine, "can_generate_images", False):
+      return web.json_response({"detail": "image generation models are not supported by this engine"}, status=501)
+    shard = registry.build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return web.json_response({"error": f"Unsupported model: {model} with engine {self.inference_engine_classname}"}, status=400)
+
+    init_image = None
+    image_url = data.get("image_url") or ""
+    if image_url:
+      try:
+        init_image = self._decode_image_b64(image_url)
+      except Exception as e:  # noqa: BLE001
+        return web.json_response({"error": f"invalid image_url: {e}"}, status=400)
+
+    # Coerce every numeric field BEFORE the 200 headers go out — malformed
+    # input must be a clean 400, not a truncated stream.
+    try:
+      gen_kwargs = dict(
+        negative=str(data.get("negative_prompt", "")),
+        steps=int(data.get("steps", 30)),
+        guidance=float(data.get("guidance", 7.5)),
+        seed=int(data.get("seed", 0)),
+        size=tuple(int(v) for v in data["size"]) if data.get("size") else None,
+        strength=float(data.get("strength", 0.8)),
+      )
+      if gen_kwargs["size"] is not None and len(gen_kwargs["size"]) != 2:
+        raise ValueError("size must be [height, width]")
+      if not 1 <= gen_kwargs["steps"] <= 1000:
+        raise ValueError("steps must be in [1, 1000]")
+    except (TypeError, ValueError) as e:
+      return web.json_response({"error": f"invalid parameters: {e}"}, status=400)
+
+    request_id = str(uuid.uuid4())
+    response = web.StreamResponse(
+      status=200, reason="OK",
+      headers={"Content-Type": "application/octet-stream", "Cache-Control": "no-cache"},
+    )
+    await response.prepare(request)
+
+    progress_q: asyncio.Queue = asyncio.Queue()
+
+    def on_progress(done: int, total: int) -> None:
+      progress_q.put_nowait((done, total))
+
+    import threading
+
+    # Client-disconnect cancellation: asyncio cancel can't interrupt the
+    # engine's worker thread, so the pipeline polls this event between
+    # denoise chunks (same contract as chat streaming's disconnect path).
+    cancel_event = threading.Event()
+    gen = asyncio.create_task(
+      self.node.process_image_prompt(
+        shard, prompt, request_id, init_image=init_image, progress_cb=on_progress,
+        cancel_event=cancel_event, **gen_kwargs,
+      )
+    )
+    try:
+      while True:
+        get_q = asyncio.create_task(progress_q.get())
+        finished, _ = await asyncio.wait({gen, get_q}, return_when=asyncio.FIRST_COMPLETED, timeout=self.response_timeout)
+        if get_q in finished:
+          done, total = get_q.result()
+          pct = int(100 * done / max(total, 1))
+          bar = "-" * max(pct // 2 - 1, 0) + ">" + " " * (50 - max(pct // 2, 1))
+          await response.write(
+            json.dumps({"progress": f"Progress: [{bar}] {pct}% ({done}/{total})", "step": done, "total_steps": total}).encode() + b"\n"
+          )
+          continue
+        get_q.cancel()
+        if gen in finished:
+          break
+        cancel_event.set()
+        gen.cancel()
+        await asyncio.gather(gen, return_exceptions=True)
+        await response.write(json.dumps({"error": "image generation timed out"}).encode() + b"\n")
+        await response.write_eof()
+        return response
+
+      image = gen.result()  # uint8 [H, W, 3]
+      from PIL import Image
+
+      path = self.images_dir / f"{request_id}.png"
+      await asyncio.get_event_loop().run_in_executor(None, lambda: Image.fromarray(image).save(path))
+      url = f"{request.scheme}://{request.host}" + str(request.app.router["static_images"].url_for(filename=path.name))
+      await response.write(json.dumps({"images": [{"url": url, "content_type": "image/png"}]}).encode() + b"\n")
+      await response.write_eof()
+      return response
+    except Exception as e:  # noqa: BLE001 — incl. client-disconnect write errors
+      # Stop the denoise loop: the worker thread polls cancel_event between
+      # chunks; the abandoned task's outcome is retrieved so it never logs
+      # as an un-awaited exception.
+      cancel_event.set()
+      gen.cancel()
+      await asyncio.gather(gen, return_exceptions=True)
+      if DEBUG >= 2:
+        import traceback
+
+        traceback.print_exc()
+      try:
+        await response.write(json.dumps({"error": str(e)}).encode() + b"\n")
+        await response.write_eof()
+      except (ConnectionError, RuntimeError):
+        pass  # client is gone; nothing to tell them
+      return response
+
+  @staticmethod
+  def _decode_image_b64(image_url: str):
+    """data-URL or raw base64 → uint8 RGB array, dims floored to /8. The
+    pipeline itself snaps to the loaded model's exact pixel grid
+    (DiffusionPipeline.px_multiple) before encoding; this host-side floor
+    just keeps absurd sizes from shipping to the device."""
+    import base64
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    payload = image_url.split(",", 1)[1] if image_url.startswith("data:") else image_url
+    img = Image.open(io.BytesIO(base64.b64decode(payload))).convert("RGB")
+    w, h = img.size
+    w8, h8 = max(w // 8 * 8, 8), max(h // 8 * 8, 8)
+    if (w8, h8) != (w, h):
+      img = img.resize((w8, h8))
+    return np.asarray(img, dtype=np.uint8)
 
   async def handle_post_chat_token_encode(self, request):
     data = await request.json()

@@ -68,6 +68,18 @@ def get_allow_patterns(weight_map: dict[str, str] | None, shard: Shard) -> list[
   """
   patterns = list(DEFAULT_ALLOW_PATTERNS)
   if not weight_map:
+    from .. import registry
+
+    if registry.get_family(shard.model_id) == "stable-diffusion":
+      # Diffusers layout: fetch ONLY the per-component weights the loader
+      # reads (models/diffusion_loader.py) — the bare '*.safetensors'
+      # fallback would also pull the repo's multi-GB monolithic root
+      # checkpoints and every .fp16 duplicate.
+      return patterns + [
+        "text_encoder/model.safetensors",
+        "unet/diffusion_pytorch_model.safetensors",
+        "vae/diffusion_pytorch_model.safetensors",
+      ]
     return patterns + ["*.safetensors"]
   needed: set[str] = set()
   for name, filename in weight_map.items():

@@ -1,0 +1,185 @@
+"""Text→image / img2img pipeline over the JAX diffusion stack.
+
+Role of the reference's (dead) stable-diffusion execution path: the Node
+special case at ``reference orchestration/node.py:116-147,613-620`` steps a
+sampler once per ring pass and streams ``[step, total]`` progress; the API
+turns the final ndarray into a PNG (``chatgpt_api.py:445-535``). Here the
+whole denoising loop is device-resident: timesteps are sliced into chunks,
+each chunk is one compiled ``lax.scan`` dispatch (models/diffusion.py
+``sample_chunk``), and progress is emitted between dispatches — the same
+observable contract without a host round-trip per step.
+
+Everything jits against static (batch, size, steps, method) keys; guidance
+is a traced scalar so changing it never recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.diffusion import (
+  DiffusionConfig,
+  Params,
+  add_noise,
+  alphas_cumprod,
+  clip_text_encode,
+  ddim_timesteps,
+  sample_chunk,
+  vae_decode,
+  vae_encode,
+  vae_sample_latents,
+)
+
+ProgressCb = Callable[[int, int], None]
+
+
+class GenerationCancelled(Exception):
+  """Raised between denoise chunks when the caller's cancel check fires
+  (client disconnect): the single engine worker must not keep burning a full
+  denoise for a dead request."""
+
+
+class DiffusionPipeline:
+  """Holds params + compiled stages for one loaded diffusion model."""
+
+  def __init__(self, cfg: DiffusionConfig, params: Params, tokenizer=None, dtype=jnp.bfloat16, progress_chunk: int = 5):
+    self.cfg = cfg
+    self.tokenizer = tokenizer
+    self.dtype = dtype
+    self.progress_chunk = max(1, progress_chunk)
+    self.params = jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+    self.alphas = np.asarray(alphas_cumprod(cfg), np.float32)
+
+    self._encode_text = jax.jit(functools.partial(clip_text_encode, cfg=cfg.clip))
+    self._vae_decode = jax.jit(functools.partial(vae_decode, cfg=cfg.vae))
+    self._vae_encode = jax.jit(functools.partial(vae_encode, cfg=cfg.vae))
+    self._chunk_fns: dict = {}
+    # pixel-space grid: VAE stride x UNet stride — latents must divide by the
+    # UNet's downsample depth or the up path's skip concats shape-mismatch
+    self.vae_stride = 2 ** (len(cfg.vae.block_out_channels) - 1)
+    self.px_multiple = self.vae_stride * 2 ** (len(cfg.unet.block_out_channels) - 1)
+
+  # ------------------------------------------------------------- prompts
+
+  def _tokenize(self, text: str) -> np.ndarray:
+    m = self.cfg.clip.max_positions
+    if self.tokenizer is not None:
+      enc = self.tokenizer(text, padding="max_length", max_length=m, truncation=True, return_tensors="np")
+      return np.asarray(enc["input_ids"], np.int32)
+    # deterministic fallback (tests / tokenizerless tiny models): stable
+    # crc32 word hash — Python's hash() is salted per process and would make
+    # tokenizerless generation differ across restarts
+    import zlib
+
+    ids = [(zlib.crc32(w.encode()) % (self.cfg.clip.vocab_size - 2)) + 2 for w in text.split()][: m - 2]
+    row = [0] + ids + [1] + [1] * (m - 2 - len(ids))
+    return np.asarray([row], np.int32)
+
+  def encode_prompt(self, prompt: str, negative: str = "") -> jnp.ndarray:
+    """→ ctx_pair [2,S,D]: row 0 unconditional, row 1 conditional."""
+    tokens = np.concatenate([self._tokenize(negative), self._tokenize(prompt)], axis=0)
+    return self._encode_text(self.params["clip"], tokens=jnp.asarray(tokens)).astype(self.dtype)
+
+  # ------------------------------------------------------------ sampling
+
+  def _chunk_fn(self, method: str):
+    fn = self._chunk_fns.get(method)
+    if fn is None:
+      fn = jax.jit(functools.partial(sample_chunk, cfg=self.cfg, method=method))
+      self._chunk_fns[method] = fn
+    return fn
+
+  def _snap(self, px: int) -> int:
+    """Nearest (half-up) multiple of the model's pixel grid, min one unit."""
+    return max(int(px / self.px_multiple + 0.5), 1) * self.px_multiple
+
+  def _schedule(self, steps: int):
+    ts = np.asarray(ddim_timesteps(self.cfg, steps), np.int32)
+    a_ts = self.alphas[ts]
+    prev = ts - (self.cfg.num_train_timesteps // steps)
+    # SD's DDIMScheduler ships set_alpha_to_one=False: the step past t=0
+    # uses final_alpha_cumprod = alphas_cumprod[0], not 1.0 (diffusers
+    # scheduling_ddim parity for real checkpoints).
+    final_alpha = 1.0 if self.cfg.set_alpha_to_one else float(self.alphas[0])
+    a_prevs = np.where(prev >= 0, self.alphas[np.clip(prev, 0, None)], final_alpha).astype(np.float32)
+    return ts, a_ts, a_prevs
+
+  def generate(
+    self,
+    prompt: str,
+    negative: str = "",
+    steps: int = 50,
+    guidance: float = 7.5,
+    seed: int = 0,
+    size: tuple[int, int] | None = None,
+    init_image: np.ndarray | None = None,
+    strength: float = 0.8,
+    method: str = "ddim",
+    progress_cb: ProgressCb | None = None,
+    should_cancel: Callable[[], bool] | None = None,
+  ) -> np.ndarray:
+    """Returns a uint8 [H, W, 3] image.
+
+    ``init_image`` (uint8 [H,W,3]) switches to img2img: VAE-encode, noise to
+    ``strength`` of the schedule, denoise the remainder — the reference's
+    ``image_url`` path (``chatgpt_api.py:463-467``). Requested sizes and
+    init images snap to the model's pixel grid (``px_multiple``: 64 for the
+    SD geometry) so off-grid input can never shape-mismatch the UNet's skip
+    concats. ``should_cancel`` is polled between denoise chunks; a truthy
+    return raises GenerationCancelled.
+    """
+    cfg = self.cfg
+    rng = jax.random.PRNGKey(seed)
+    ts, a_ts, a_prevs = self._schedule(steps)
+
+    if init_image is not None:
+      img = jnp.asarray(init_image, jnp.float32) / 127.5 - 1.0
+      ih, iw = img.shape[0], img.shape[1]
+      gh, gw = self._snap(ih), self._snap(iw)
+      if (gh, gw) != (ih, iw):
+        img = jax.image.resize(img, (gh, gw, 3), method="linear")
+      moments = self._vae_encode(self.params["vae"], images=img[None].astype(self.dtype))
+      rng, sub = jax.random.split(rng)
+      x0 = vae_sample_latents(moments.astype(jnp.float32), sub, cfg.vae.scaling_factor)
+      start = max(1, min(steps, int(round(steps * strength))))
+      ts, a_ts, a_prevs = ts[steps - start:], a_ts[steps - start:], a_prevs[steps - start:]
+      rng, sub = jax.random.split(rng)
+      latents = add_noise(x0, jax.random.normal(sub, x0.shape, x0.dtype), a_ts[0]).astype(self.dtype)
+      h, w = latents.shape[1], latents.shape[2]
+    else:
+      h = w = cfg.sample_size
+      if size is not None:
+        h, w = self._snap(size[0]) // self.vae_stride, self._snap(size[1]) // self.vae_stride
+      rng, sub = jax.random.split(rng)
+      latents = jax.random.normal(sub, (1, h, w, cfg.unet.in_channels), jnp.float32).astype(self.dtype)
+
+    ctx_pair = self.encode_prompt(prompt, negative)
+    total = len(ts)
+    if progress_cb:
+      progress_cb(0, total)
+
+    chunk_fn = self._chunk_fn(method)
+    g = jnp.asarray(guidance, jnp.float32)
+    done = 0
+    while done < total:
+      if should_cancel is not None and should_cancel():
+        raise GenerationCancelled(f"cancelled at step {done}/{total}")
+      n = min(self.progress_chunk, total - done)
+      sl = slice(done, done + n)
+      latents = chunk_fn(
+        self.params["unet"], latents=latents, ctx_pair=ctx_pair,
+        ts=jnp.asarray(ts[sl]), a_ts=jnp.asarray(a_ts[sl]), a_prevs=jnp.asarray(a_prevs[sl]),
+        guidance=g,
+      )
+      done += n
+      if progress_cb:
+        progress_cb(done, total)
+
+    img = self._vae_decode(self.params["vae"], latents=latents.astype(self.dtype))
+    img = np.asarray(jnp.clip((img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)[0], np.float32)
+    return img.astype(np.uint8)

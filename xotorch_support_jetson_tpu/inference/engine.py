@@ -102,6 +102,19 @@ class InferenceEngine(ABC):
   async def evaluate(self, request_id: str, shard: Shard, inputs: np.ndarray, targets: np.ndarray, lengths: np.ndarray, loss: str = "ce"):
     raise NotImplementedError(f"{type(self).__name__} does not support evaluation")
 
+  # --- image generation (stable-diffusion family; JAX engine only) ---
+
+  #: class capability — True on engines whose generate_image can work at all;
+  #: generate_image itself still refuses when the loaded checkpoint is not a
+  #: diffusion model.
+  can_generate_images: bool = False
+
+  async def generate_image(self, shard: Shard, prompt: str, **kwargs) -> np.ndarray:
+    """→ uint8 [H, W, 3]. The reference exposes this surface but has no
+    working model behind it (its SD registry entry is commented out,
+    reference models.py:167-168); engines that can't generate refuse."""
+    raise NotImplementedError(f"{type(self).__name__} does not support image generation")
+
   async def save_checkpoint(self, shard: Shard, path: str | Path) -> None:
     ...
 

@@ -106,6 +106,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
   layer range across all of its own chips.
   """
 
+  can_generate_images = True
+
   def __init__(self, shard_downloader=None, max_seq_len: int | None = None, seed: int = 0, use_local_mesh: bool | None = None, quant: str | None = None, pp: int | None = None, spec_decode: str | None = None):
     super().__init__()
     self.shard_downloader = shard_downloader
@@ -136,6 +138,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.pp = pp if pp is not None else int(os.getenv("XOT_TPU_PP", "0") or 0)
     self._pp = None
     self._batch_ops = None
+    self.diffusion = None  # DiffusionPipeline when an SD card is loaded
     self.mesh = None
     self.sessions: dict[str, _Session] = {}
     # One worker thread serializes all device work off the asyncio loop —
@@ -160,6 +163,14 @@ class JaxShardedInferenceEngine(InferenceEngine):
   def _load_shard_sync(self, shard: Shard, model_dir) -> None:
     from ..models.config import load_model_config
     from ..models.loader import load_shard_weights
+
+    # Diffusers-format checkpoints carry model_index.json at the root; they
+    # take the image-generation path (the reference's SD special case,
+    # reference node.py:116, is dead code — this one runs).
+    if (Path(model_dir) / "model_index.json").exists():
+      self._load_diffusion_sync(shard, model_dir)
+      return
+    self.diffusion = None
 
     cfg = load_model_config(model_dir)
     # Clamp the config's max_seq_len to the engine's serving cap: cache
@@ -432,6 +443,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     return jax.tree.map(lambda x: jax.device_put(x, spec), cache)
 
   async def _load_tokenizer(self, shard: Shard) -> None:
+    if self.diffusion is not None:  # CLIP tokenizer already loaded from disk
+      return
     from .. import registry
     from .tokenizers import resolve_tokenizer
 
@@ -454,10 +467,109 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.sessions.clear()
     self._key = jax.random.PRNGKey(self._seed)
 
+  # ------------------------------------------------------- image generation
+
+  def _load_diffusion_sync(self, shard: Shard, model_dir) -> None:
+    """Load a diffusers-format checkpoint as a DiffusionPipeline.
+
+    Diffusion serving is deliberately single-device full-model: SD2's
+    ~2.6 GB of bf16 weights fit any TPU chip, and the denoising loop is
+    compute-bound MXU work — ring-sharding the UNet (what the reference's
+    dead 31-"layer" split would have done, reference models.py:168) buys
+    nothing on this hardware. Scale throughput with data parallelism
+    (one request per node) instead.
+    """
+    from ..models.diffusion_loader import diffusion_config_from_dir, load_diffusion_params
+    from .diffusion_pipeline import DiffusionPipeline
+
+    model_dir = Path(model_dir)
+    cfg = diffusion_config_from_dir(model_dir)
+    params = load_diffusion_params(model_dir, cfg)
+    tokenizer = None
+    if (model_dir / "tokenizer").exists():
+      from transformers import AutoTokenizer
+
+      tokenizer = AutoTokenizer.from_pretrained(str(model_dir / "tokenizer"))
+    self.diffusion = DiffusionPipeline(cfg, params, tokenizer)
+    self.tokenizer = tokenizer
+    # Release EVERY piece of the previous text model's device state — a
+    # stale int8 draft / vision tower / jitted eval closure would pin HBM
+    # under the diffusion weights.
+    self.params = None
+    self.cfg = None
+    self._draft_params = None
+    self._vision_params = None
+    self._train_state = None
+    self._mesh_eval_fn = None
+    self.shard = shard
+    self._effective_shard = shard
+    self._model_dir = model_dir
+    self.sessions.clear()
+    self._drop_batched_server()
+    if DEBUG >= 1:
+      print(f"[jax_engine] loaded diffusion pipeline {shard.model_id} from {model_dir}")
+
+  def load_test_diffusion(self, shard: Shard, cfg, params, tokenizer=None) -> None:
+    """Directly inject a diffusion model (unit tests)."""
+    import jax.numpy as jnp
+
+    from .diffusion_pipeline import DiffusionPipeline
+
+    self.diffusion = DiffusionPipeline(cfg, params, tokenizer, dtype=jnp.float32)
+    self.tokenizer = tokenizer
+    self.params = None
+    self.cfg = None
+    self.shard = shard
+    self._effective_shard = shard
+
+  async def generate_image(
+    self,
+    shard: Shard,
+    prompt: str,
+    negative: str = "",
+    steps: int = 30,
+    guidance: float = 7.5,
+    seed: int = 0,
+    size: tuple[int, int] | None = None,
+    init_image: np.ndarray | None = None,
+    strength: float = 0.8,
+    progress_cb=None,
+    cancel_event=None,
+  ) -> np.ndarray:
+    """Text→image (or img2img) on the loaded diffusion pipeline.
+
+    Runs on the engine's single worker thread like all device work; the
+    progress callback is marshalled back onto the event loop.
+    ``cancel_event`` (threading.Event) aborts between denoise chunks —
+    asyncio cancellation cannot interrupt the worker thread, so a dead
+    client's request must be stopped cooperatively.
+    """
+    await self.ensure_shard(shard)
+    # Snapshot: a concurrent text-model load on the worker thread may null
+    # self.diffusion between this check and the executor slot.
+    pipeline = self.diffusion
+    if pipeline is None:
+      raise NotImplementedError(f"{shard.model_id} is not an image-generation model")
+    loop = asyncio.get_event_loop()
+    cb = None
+    if progress_cb is not None:
+      def cb(done, total):  # noqa: E306 — worker-thread → loop marshal
+        loop.call_soon_threadsafe(progress_cb, done, total)
+    return await loop.run_in_executor(
+      self.executor,
+      lambda: pipeline.generate(
+        prompt, negative=negative, steps=steps, guidance=guidance, seed=seed,
+        size=size, init_image=init_image, strength=strength, progress_cb=cb,
+        should_cancel=cancel_event.is_set if cancel_event is not None else None,
+      ),
+    )
+
   # ---------------------------------------------------------------- contract
 
   async def encode(self, shard: Shard, prompt: str) -> np.ndarray:
     await self.ensure_shard(shard)
+    if self.diffusion is not None:
+      raise NotImplementedError(f"{shard.model_id} is an image-generation model; use /v1/image/generations")
     ids = self.tokenizer.encode(prompt)
     return np.asarray(ids, dtype=np.int32)
 

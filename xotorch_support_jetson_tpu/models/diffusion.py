@@ -1,0 +1,461 @@
+"""JAX stable-diffusion stack: CLIP text encoder, UNet2DCondition, VAE, samplers.
+
+The reference ships a stable-diffusion *surface* with no model behind it:
+an API route (``reference chatgpt_api.py:445-535``), a Node special case
+(``node.py:116,613``), and a registry entry that is commented out
+(``models.py:167-168``) — the path is unreachable dead code. This module is
+the working TPU-native equivalent: the full text-to-image (and img2img)
+pipeline for the stable-diffusion-2 family geometry, built the JAX way:
+
+- **NHWC convolutions** (``lax.conv_general_dilated``) — XLA's native TPU
+  layout; torch OIHW kernels are transposed once at load time
+  (models/diffusion_loader.py).
+- **CLIP text layers scan-stacked** like the text decoder (models/decoder.py):
+  homogeneous layers ride one ``lax.scan``, O(1) compile depth. The UNet's
+  blocks are heterogeneous (channel widths change per level) so they unroll
+  at trace time — static Python loops over a static config, the idiomatic
+  XLA pattern for a fixed topology.
+- **The denoising loop is a ``lax.scan`` over timesteps** with
+  classifier-free guidance batched as 2 rows through one UNet call per step
+  — one compiled program per (size, steps) pair, no per-step dispatch.
+- Everything is pure-functional: params are nested dict pytrees, jit/vmap
+  compose (batched image generation = a bigger leading axis).
+
+Geometry parity target: stabilityai/stable-diffusion-2-1-base in diffusers
+format (UNet2DConditionModel + AutoencoderKL + CLIPTextModel). The CLIP text
+encoder is golden-verified against ``transformers.CLIPTextModel``
+(tests/test_diffusion.py); UNet/VAE follow the published architecture and
+are validated by structural/analytic tests (diffusers is not installable in
+this environment).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict
+
+# ----------------------------------------------------------------- configs
+
+
+@dataclass(frozen=True)
+class ClipTextConfig:
+  vocab_size: int = 49408
+  hidden_size: int = 1024
+  intermediate_size: int = 4096
+  n_layers: int = 23
+  n_heads: int = 16
+  max_positions: int = 77
+  layer_norm_eps: float = 1e-5
+  act: str = "gelu"  # SD2 (OpenCLIP-H) "gelu"; SD1 (CLIP ViT-L) "quick_gelu"
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+  in_channels: int = 4
+  out_channels: int = 4
+  block_out_channels: tuple[int, ...] = (320, 640, 1280, 1280)
+  layers_per_block: int = 2
+  cross_attention_dim: int = 1024
+  attention_head_dim: int = 64  # SD2: heads = channels // 64
+  norm_groups: int = 32
+  norm_eps: float = 1e-5
+  # which levels carry cross-attention transformers (SD: all but the last)
+  cross_levels: tuple[bool, ...] = (True, True, True, False)
+
+
+@dataclass(frozen=True)
+class VaeConfig:
+  in_channels: int = 3
+  latent_channels: int = 4
+  block_out_channels: tuple[int, ...] = (128, 256, 512, 512)
+  layers_per_block: int = 2
+  norm_groups: int = 32
+  norm_eps: float = 1e-6
+  scaling_factor: float = 0.18215
+
+
+@dataclass(frozen=True)
+class DiffusionConfig:
+  """One bundle for the three submodels + scheduler constants."""
+
+  clip: ClipTextConfig = field(default_factory=ClipTextConfig)
+  unet: UNetConfig = field(default_factory=UNetConfig)
+  vae: VaeConfig = field(default_factory=VaeConfig)
+  sample_size: int = 64  # latent H=W at 512px
+  prediction_type: str = "epsilon"  # or "v_prediction"
+  num_train_timesteps: int = 1000
+  beta_start: float = 0.00085
+  beta_end: float = 0.012
+  beta_schedule: str = "scaled_linear"
+  # diffusers DDIMScheduler: SD ships set_alpha_to_one=False, so the step
+  # past t=0 uses alphas_cumprod[0] instead of 1.0
+  set_alpha_to_one: bool = False
+  # diffusers leading spacing adds steps_offset to every timestep (SD ships 1)
+  steps_offset: int = 0
+
+
+def tiny_diffusion_config(**over) -> DiffusionConfig:
+  """A miniature geometry for tests: full topology, toy widths."""
+  cfg = DiffusionConfig(
+    clip=ClipTextConfig(vocab_size=128, hidden_size=32, intermediate_size=64, n_layers=2, n_heads=4, max_positions=16),
+    unet=UNetConfig(
+      block_out_channels=(16, 32), layers_per_block=1, cross_attention_dim=32,
+      attention_head_dim=8, norm_groups=4, cross_levels=(True, False),
+    ),
+    vae=VaeConfig(block_out_channels=(8, 16), layers_per_block=1, norm_groups=4),
+    sample_size=8,
+  )
+  return cfg if not over else DiffusionConfig(**{**cfg.__dict__, **over})
+
+
+# ------------------------------------------------------------- primitives
+
+
+def _gelu(x, act: str):
+  if act == "quick_gelu":
+    return x * jax.nn.sigmoid(1.702 * x)
+  return jax.nn.gelu(x, approximate=False)
+
+
+def _group_norm(x, scale, bias, groups: int, eps: float):
+  """GroupNorm over NHWC (stats per group of channels, per sample)."""
+  n, h, w, c = x.shape
+  xg = x.reshape(n, h * w, groups, c // groups)
+  mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
+  var = jnp.var(xg, axis=(1, 3), keepdims=True)
+  xg = (xg - mean) * lax.rsqrt(var + eps)
+  return xg.reshape(n, h, w, c) * scale + bias
+
+
+def _layer_norm(x, scale, bias, eps: float):
+  mean = jnp.mean(x, axis=-1, keepdims=True)
+  var = jnp.var(x, axis=-1, keepdims=True)
+  return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _conv(x, w, b, stride: int = 1, pad: int = 1):
+  """NHWC conv, HWIO kernel. MXU-shaped: XLA tiles the im2col matmul."""
+  out = lax.conv_general_dilated(
+    x, w, window_strides=(stride, stride),
+    padding=[(pad, pad), (pad, pad)],
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+  )
+  return out + b
+
+
+def _attention(q, k, v, n_heads: int):
+  """Full (non-causal) MHA over token axes. [B,S,D] x [B,T,D] -> [B,S,D]."""
+  b, s, _d = q.shape
+  t = k.shape[1]
+  qh = q.reshape(b, s, n_heads, -1).transpose(0, 2, 1, 3)
+  kh = k.reshape(b, t, n_heads, -1).transpose(0, 2, 1, 3)
+  vh = v.reshape(b, t, n_heads, -1).transpose(0, 2, 1, 3)
+  scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(qh.shape[-1])
+  probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+  out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+  return out.transpose(0, 2, 1, 3).reshape(b, s, -1)
+
+
+# ----------------------------------------------------------- CLIP text
+
+
+def clip_text_encode(params: Params, cfg: ClipTextConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+  """tokens [B,S] -> last hidden state [B,S,D] (after final layer norm).
+
+  Standard CLIPTextModel: learned positions, pre-LN layers, causal mask.
+  Layers are scan-stacked [L, ...] (same SoA layout as models/decoder.py).
+  """
+  b, s = tokens.shape
+  x = params["tok_emb"][tokens] + params["pos_emb"][:s]
+  causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+  neg = jnp.asarray(-1e9, dtype=x.dtype)
+
+  def layer(h, lp):
+    r = _layer_norm(h, lp["ln1_s"], lp["ln1_b"], cfg.layer_norm_eps)
+    q = r @ lp["wq"] + lp["bq"]
+    k = r @ lp["wk"] + lp["bk"]
+    v = r @ lp["wv"] + lp["bv"]
+    hd = cfg.hidden_size // cfg.n_heads
+    qh = q.reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) / math.sqrt(hd)
+    scores = jnp.where(causal, scores, neg)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+    attn = jnp.einsum("bhst,bhtd->bhsd", probs, vh).transpose(0, 2, 1, 3).reshape(b, s, -1)
+    h = h + attn @ lp["wo"] + lp["bo"]
+    r = _layer_norm(h, lp["ln2_s"], lp["ln2_b"], cfg.layer_norm_eps)
+    h = h + _gelu(r @ lp["w_fc1"] + lp["b_fc1"], cfg.act) @ lp["w_fc2"] + lp["b_fc2"]
+    return h, None
+
+  x, _ = lax.scan(layer, x, params["layers"])
+  return _layer_norm(x, params["final_ln_s"], params["final_ln_b"], cfg.layer_norm_eps)
+
+
+# ----------------------------------------------------------------- UNet
+
+
+def _timestep_embedding(t: jnp.ndarray, dim: int) -> jnp.ndarray:
+  """Sinusoidal embedding, diffusers convention (flip_sin_to_cos=True,
+  downscale_freq_shift=0): [cos | sin] of t * exp(-ln(1e4) * i/half)."""
+  half = dim // 2
+  freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+  ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+  return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def _resnet(x, temb, p: Params, groups: int, eps: float):
+  h = _group_norm(x, p["norm1_s"], p["norm1_b"], groups, eps)
+  h = _conv(jax.nn.silu(h), p["conv1_w"], p["conv1_b"])
+  h = h + (jax.nn.silu(temb) @ p["time_w"] + p["time_b"])[:, None, None, :]
+  h = _group_norm(h, p["norm2_s"], p["norm2_b"], groups, eps)
+  h = _conv(jax.nn.silu(h), p["conv2_w"], p["conv2_b"])
+  if "skip_w" in p:
+    x = _conv(x, p["skip_w"], p["skip_b"], pad=0)
+  return x + h
+
+
+def _transformer_block(x, ctx, p: Params, n_heads: int, groups: int):
+  """Transformer2DModel depth-1: GN, linear proj in, self-attn, cross-attn,
+  GEGLU FF, linear proj out, residual. SD2 uses use_linear_projection."""
+  n, h, w, c = x.shape
+  res = x
+  y = _group_norm(x, p["norm_s"], p["norm_b"], groups, 1e-6)
+  y = y.reshape(n, h * w, c) @ p["proj_in_w"] + p["proj_in_b"]
+  # self-attention (no biases on q/k/v in diffusers CrossAttention)
+  r = _layer_norm(y, p["ln1_s"], p["ln1_b"], 1e-5)
+  y = y + _attention(r @ p["attn1_wq"], r @ p["attn1_wk"], r @ p["attn1_wv"], n_heads) @ p["attn1_wo"] + p["attn1_bo"]
+  # cross-attention over the text context
+  r = _layer_norm(y, p["ln2_s"], p["ln2_b"], 1e-5)
+  y = y + _attention(r @ p["attn2_wq"], ctx @ p["attn2_wk"], ctx @ p["attn2_wv"], n_heads) @ p["attn2_wo"] + p["attn2_bo"]
+  # GEGLU feed-forward
+  r = _layer_norm(y, p["ln3_s"], p["ln3_b"], 1e-5)
+  gg = r @ p["ff_w1"] + p["ff_b1"]
+  a, g = jnp.split(gg, 2, axis=-1)
+  y = y + (a * jax.nn.gelu(g, approximate=False)) @ p["ff_w2"] + p["ff_b2"]
+  y = y @ p["proj_out_w"] + p["proj_out_b"]
+  return res + y.reshape(n, h, w, c)
+
+
+def unet_apply(params: Params, cfg: UNetConfig, latents: jnp.ndarray, t: jnp.ndarray, ctx: jnp.ndarray) -> jnp.ndarray:
+  """latents [B,H,W,Cin], t [B], ctx [B,S,cross_dim] -> prediction [B,H,W,Cout].
+
+  Static topology (down/mid/up with skip concats) unrolled at trace time;
+  every conv/attention is an MXU-shaped matmul under one jit.
+  """
+  temb = _timestep_embedding(t, cfg.block_out_channels[0]).astype(latents.dtype)
+  temb = jax.nn.silu(temb @ params["time_w1"] + params["time_b1"])
+  temb = temb @ params["time_w2"] + params["time_b2"]
+
+  x = _conv(latents, params["conv_in_w"], params["conv_in_b"])
+  skips = [x]
+
+  for li, blk in enumerate(params["down"]):
+    ch = cfg.block_out_channels[li]
+    heads = max(1, ch // cfg.attention_head_dim)
+    for ri, rp in enumerate(blk["resnets"]):
+      x = _resnet(x, temb, rp, cfg.norm_groups, cfg.norm_eps)
+      if cfg.cross_levels[li]:
+        x = _transformer_block(x, ctx, blk["attns"][ri], heads, cfg.norm_groups)
+      skips.append(x)
+    if "down_w" in blk:  # all levels but the last downsample (stride-2 conv)
+      x = _conv(x, blk["down_w"], blk["down_b"], stride=2)
+      skips.append(x)
+
+  mid = params["mid"]
+  mid_heads = max(1, cfg.block_out_channels[-1] // cfg.attention_head_dim)
+  x = _resnet(x, temb, mid["resnet1"], cfg.norm_groups, cfg.norm_eps)
+  if "attn" in mid:
+    x = _transformer_block(x, ctx, mid["attn"], mid_heads, cfg.norm_groups)
+  x = _resnet(x, temb, mid["resnet2"], cfg.norm_groups, cfg.norm_eps)
+
+  n_levels = len(cfg.block_out_channels)
+  for ui, blk in enumerate(params["up"]):
+    li = n_levels - 1 - ui
+    ch = cfg.block_out_channels[li]
+    heads = max(1, ch // cfg.attention_head_dim)
+    for ri, rp in enumerate(blk["resnets"]):
+      x = jnp.concatenate([x, skips.pop()], axis=-1)
+      x = _resnet(x, temb, rp, cfg.norm_groups, cfg.norm_eps)
+      if cfg.cross_levels[li]:
+        x = _transformer_block(x, ctx, blk["attns"][ri], heads, cfg.norm_groups)
+    if "up_w" in blk:  # all levels but level 0 upsample (nearest 2x + conv)
+      n, h, w, c = x.shape
+      x = jax.image.resize(x, (n, h * 2, w * 2, c), method="nearest")
+      x = _conv(x, blk["up_w"], blk["up_b"])
+
+  x = _group_norm(x, params["norm_out_s"], params["norm_out_b"], cfg.norm_groups, cfg.norm_eps)
+  return _conv(jax.nn.silu(x), params["conv_out_w"], params["conv_out_b"])
+
+
+# ------------------------------------------------------------------ VAE
+
+
+def _vae_attn(x, p: Params, groups: int, eps: float):
+  """Single-head full attention at the VAE mid block."""
+  n, h, w, c = x.shape
+  y = _group_norm(x, p["norm_s"], p["norm_b"], groups, eps)
+  y = y.reshape(n, h * w, c)
+  out = _attention(y @ p["wq"] + p["bq"], y @ p["wk"] + p["bk"], y @ p["wv"] + p["bv"], 1)
+  return x + (out @ p["wo"] + p["bo"]).reshape(n, h, w, c)
+
+
+def _vae_resnet(x, p: Params, groups: int, eps: float):
+  h = _group_norm(x, p["norm1_s"], p["norm1_b"], groups, eps)
+  h = _conv(jax.nn.silu(h), p["conv1_w"], p["conv1_b"])
+  h = _group_norm(h, p["norm2_s"], p["norm2_b"], groups, eps)
+  h = _conv(jax.nn.silu(h), p["conv2_w"], p["conv2_b"])
+  if "skip_w" in p:
+    x = _conv(x, p["skip_w"], p["skip_b"], pad=0)
+  return x + h
+
+
+def vae_encode(params: Params, cfg: VaeConfig, images: jnp.ndarray) -> jnp.ndarray:
+  """images [B,H,W,3] in [-1,1] -> latent distribution moments [B,h,w,2*Cz]."""
+  p = params["encoder"]
+  x = _conv(images, p["conv_in_w"], p["conv_in_b"])
+  for li, blk in enumerate(p["down"]):
+    for rp in blk["resnets"]:
+      x = _vae_resnet(x, rp, cfg.norm_groups, cfg.norm_eps)
+    if "down_w" in blk:
+      # diffusers VAE downsample pads asymmetrically (right/bottom only)
+      x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+      x = lax.conv_general_dilated(
+        x, blk["down_w"], window_strides=(2, 2), padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+      ) + blk["down_b"]
+  x = _vae_resnet(x, p["mid_resnet1"], cfg.norm_groups, cfg.norm_eps)
+  x = _vae_attn(x, p["mid_attn"], cfg.norm_groups, cfg.norm_eps)
+  x = _vae_resnet(x, p["mid_resnet2"], cfg.norm_groups, cfg.norm_eps)
+  x = _group_norm(x, p["norm_out_s"], p["norm_out_b"], cfg.norm_groups, cfg.norm_eps)
+  x = _conv(jax.nn.silu(x), p["conv_out_w"], p["conv_out_b"])
+  return _conv(x, params["quant_w"], params["quant_b"], pad=0)
+
+
+def vae_sample_latents(moments: jnp.ndarray, rng, scaling: float) -> jnp.ndarray:
+  mean, logvar = jnp.split(moments, 2, axis=-1)
+  logvar = jnp.clip(logvar, -30.0, 20.0)
+  z = mean + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mean.shape, mean.dtype)
+  return z * scaling
+
+
+def vae_decode(params: Params, cfg: VaeConfig, latents: jnp.ndarray) -> jnp.ndarray:
+  """scaled latents [B,h,w,Cz] -> images [B,H,W,3] in [-1,1]."""
+  p = params["decoder"]
+  x = latents / cfg.scaling_factor
+  x = _conv(x, params["post_quant_w"], params["post_quant_b"], pad=0)
+  x = _conv(x, p["conv_in_w"], p["conv_in_b"])
+  x = _vae_resnet(x, p["mid_resnet1"], cfg.norm_groups, cfg.norm_eps)
+  x = _vae_attn(x, p["mid_attn"], cfg.norm_groups, cfg.norm_eps)
+  x = _vae_resnet(x, p["mid_resnet2"], cfg.norm_groups, cfg.norm_eps)
+  for blk in p["up"]:
+    for rp in blk["resnets"]:
+      x = _vae_resnet(x, rp, cfg.norm_groups, cfg.norm_eps)
+    if "up_w" in blk:
+      n, h, w, c = x.shape
+      x = jax.image.resize(x, (n, h * 2, w * 2, c), method="nearest")
+      x = _conv(x, blk["up_w"], blk["up_b"])
+  x = _group_norm(x, p["norm_out_s"], p["norm_out_b"], cfg.norm_groups, cfg.norm_eps)
+  return _conv(jax.nn.silu(x), p["conv_out_w"], p["conv_out_b"])
+
+
+# ------------------------------------------------------------- scheduler
+
+
+def alphas_cumprod(cfg: DiffusionConfig) -> jnp.ndarray:
+  if cfg.beta_schedule == "scaled_linear":
+    betas = jnp.linspace(cfg.beta_start**0.5, cfg.beta_end**0.5, cfg.num_train_timesteps, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32) ** 2
+  else:
+    betas = jnp.linspace(cfg.beta_start, cfg.beta_end, cfg.num_train_timesteps, dtype=jnp.float32)
+  return jnp.cumprod(1.0 - betas)
+
+
+def ddim_timesteps(cfg: DiffusionConfig, steps: int) -> jnp.ndarray:
+  """Descending timesteps, diffusers DDIM leading spacing:
+  arange(steps)*stride + steps_offset (SD scheduler configs ship offset 1)."""
+  stride = cfg.num_train_timesteps // steps
+  ts = jnp.arange(steps) * stride + cfg.steps_offset
+  return jnp.clip(ts, 0, cfg.num_train_timesteps - 1)[::-1]
+
+
+def _predict_x0_eps(x, model_out, a_t, prediction_type: str):
+  """Return (x0, eps) from the model output under either parameterization."""
+  sqrt_a = jnp.sqrt(a_t)
+  sqrt_1ma = jnp.sqrt(1.0 - a_t)
+  if prediction_type == "v_prediction":
+    x0 = sqrt_a * x - sqrt_1ma * model_out
+    eps = sqrt_a * model_out + sqrt_1ma * x
+  else:
+    x0 = (x - sqrt_1ma * model_out) / sqrt_a
+    eps = model_out
+  return x0, eps
+
+
+def ddim_step(x, model_out, a_t, a_prev, prediction_type: str):
+  """Deterministic DDIM (eta=0) update t -> t_prev."""
+  x0, eps = _predict_x0_eps(x, model_out, a_t, prediction_type)
+  return jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
+
+
+def euler_step(x, model_out, a_t, a_prev, prediction_type: str):
+  """Euler method in sigma-space (karras-style discrete Euler, no churn).
+
+  With x_t = sqrt(a_t) * (x0 + sigma_t * eps), sigma_t = sqrt(1/a_t - 1);
+  the probability-flow derivative is d = (xs - x0) / sigma in the scaled
+  frame xs = x / sqrt(a_t).
+  """
+  x0, _eps = _predict_x0_eps(x, model_out, a_t, prediction_type)
+  sigma_t = jnp.sqrt(1.0 / a_t - 1.0)
+  sigma_prev = jnp.sqrt(1.0 / a_prev - 1.0)
+  xs = x / jnp.sqrt(a_t)
+  d = (xs - x0) / sigma_t
+  xs = xs + (sigma_prev - sigma_t) * d
+  return xs * jnp.sqrt(a_prev)
+
+
+def sample_chunk(
+  unet_params: Params,
+  cfg: DiffusionConfig,
+  latents: jnp.ndarray,
+  ctx_pair: jnp.ndarray,
+  ts: jnp.ndarray,
+  a_ts: jnp.ndarray,
+  a_prevs: jnp.ndarray,
+  guidance: float,
+  method: str = "ddim",
+  unet_fn=None,
+) -> jnp.ndarray:
+  """Run a chunk of denoising steps under one scan.
+
+  ctx_pair [2B,S,D] = uncond rows then cond rows; each step batches both
+  through one UNet call and combines with classifier-free guidance. The
+  pipeline slices the full (ts, a_t, a_prev) schedule into chunks so the
+  serving layer can emit progress between dispatches (reference progress
+  contract: node.py:613-620) without a per-step host round-trip.
+  """
+  b = latents.shape[0]
+  step_fn = euler_step if method == "euler" else ddim_step
+  model = unet_fn or (lambda p, x, t, c: unet_apply(p, cfg.unet, x, t, c))
+
+  def step(x, sched):
+    t, a_t, a_prev = sched
+    xin = jnp.concatenate([x, x], axis=0)
+    tin = jnp.full((2 * b,), t, dtype=jnp.int32)
+    out = model(unet_params, xin, tin, ctx_pair)
+    out_u, out_c = jnp.split(out, 2, axis=0)
+    out = out_u + guidance * (out_c - out_u)
+    x = step_fn(x.astype(jnp.float32), out.astype(jnp.float32), a_t, a_prev, cfg.prediction_type).astype(x.dtype)
+    return x, None
+
+  latents, _ = lax.scan(step, latents, (ts, a_ts, a_prevs))
+  return latents
+
+
+def add_noise(x0: jnp.ndarray, noise: jnp.ndarray, a_t) -> jnp.ndarray:
+  return jnp.sqrt(a_t) * x0 + jnp.sqrt(1.0 - a_t) * noise

@@ -1,0 +1,548 @@
+"""Stable-diffusion params: random init + diffusers-format checkpoint loading.
+
+The reference has no working diffusion loader (its SD registry entry is
+commented out, ``reference models.py:167-168``). This loader targets the
+diffusers on-disk layout (``text_encoder/``, ``unet/``, ``vae/`` safetensors)
+used by stabilityai/stable-diffusion-2-1-base and friends.
+
+Conventions:
+- torch Linear ``[out, in]`` → transposed to ``[in, out]`` (x @ w).
+- torch conv OIHW → HWIO once at load (models/diffusion.py runs NHWC).
+- 1x1 conv projections (SD1-style ``proj_in``/VAE attention) are squeezed to
+  matrices so one code path serves both ``use_linear_projection`` variants.
+- CLIP text layers are stacked ``[L, ...]`` for ``lax.scan`` (the same
+  AoS→SoA transpose models/loader.py does for the text decoder).
+
+``init_diffusion_params`` walks the same topology and emits the same tree
+with random weights — tests and the synthetic pipeline use it, and it is the
+structural authority the loader must match (asserted by round-trip tests).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .diffusion import ClipTextConfig, DiffusionConfig, Params, UNetConfig, VaeConfig
+
+# ---------------------------------------------------------------- topology
+
+
+def _unet_down_plan(cfg: UNetConfig) -> list[dict]:
+  """Per-level: resnet (cin, cout) pairs, has_downsample. Mirrors unet_apply."""
+  plan = []
+  prev = cfg.block_out_channels[0]
+  for li, ch in enumerate(cfg.block_out_channels):
+    resnets = []
+    for ri in range(cfg.layers_per_block):
+      resnets.append((prev if ri == 0 else ch, ch))
+    plan.append({"resnets": resnets, "down": li < len(cfg.block_out_channels) - 1, "ch": ch})
+    prev = ch
+  return plan
+
+
+def _unet_up_plan(cfg: UNetConfig) -> list[dict]:
+  """Per up-block resnet (cin, cout) with skip-concat widths, mirrors unet_apply."""
+  skips = [cfg.block_out_channels[0]]
+  for li, ch in enumerate(cfg.block_out_channels):
+    for _ in range(cfg.layers_per_block):
+      skips.append(ch)
+    if li < len(cfg.block_out_channels) - 1:
+      skips.append(ch)
+  plan = []
+  x_ch = cfg.block_out_channels[-1]
+  n = len(cfg.block_out_channels)
+  for ui in range(n):
+    li = n - 1 - ui
+    ch = cfg.block_out_channels[li]
+    resnets = []
+    for _ in range(cfg.layers_per_block + 1):
+      resnets.append((x_ch + skips.pop(), ch))
+      x_ch = ch
+    plan.append({"resnets": resnets, "up": ui < n - 1, "ch": ch, "level": li})
+  return plan
+
+
+# -------------------------------------------------------------- random init
+
+
+def _norm(shape):
+  return jnp.ones(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+
+class _Rng:
+  def __init__(self, key):
+    self.key = key
+
+  def take(self):
+    self.key, sub = jax.random.split(self.key)
+    return sub
+
+  def dense(self, cin, cout, scale=None):
+    s = scale if scale is not None else 1.0 / np.sqrt(cin)
+    return jax.random.normal(self.take(), (cin, cout), jnp.float32) * s
+
+  def conv(self, cin, cout, k=3):
+    s = 1.0 / np.sqrt(cin * k * k)
+    return jax.random.normal(self.take(), (k, k, cin, cout), jnp.float32) * s
+
+
+def _init_resnet(r: _Rng, cin: int, cout: int, t_dim: int) -> Params:
+  n1s, n1b = _norm((cin,))
+  n2s, n2b = _norm((cout,))
+  p = {
+    "norm1_s": n1s, "norm1_b": n1b, "conv1_w": r.conv(cin, cout), "conv1_b": jnp.zeros((cout,)),
+    "time_w": r.dense(t_dim, cout), "time_b": jnp.zeros((cout,)),
+    "norm2_s": n2s, "norm2_b": n2b, "conv2_w": r.conv(cout, cout), "conv2_b": jnp.zeros((cout,)),
+  }
+  if cin != cout:
+    p["skip_w"] = r.conv(cin, cout, k=1)
+    p["skip_b"] = jnp.zeros((cout,))
+  return p
+
+
+def _init_vae_resnet(r: _Rng, cin: int, cout: int) -> Params:
+  p = _init_resnet(r, cin, cout, 1)
+  del p["time_w"], p["time_b"]
+  return p
+
+
+def _init_tx_block(r: _Rng, ch: int, cross_dim: int) -> Params:
+  ns, nb = _norm((ch,))
+  ff_inner = 4 * ch
+  p = {"norm_s": ns, "norm_b": nb, "proj_in_w": r.dense(ch, ch), "proj_in_b": jnp.zeros((ch,))}
+  for i, kv_dim in (("1", ch), ("2", cross_dim)):
+    ls, lb = _norm((ch,))
+    p[f"ln{i}_s"], p[f"ln{i}_b"] = ls, lb
+    p[f"attn{i}_wq"] = r.dense(ch, ch)
+    p[f"attn{i}_wk"] = r.dense(kv_dim, ch)
+    p[f"attn{i}_wv"] = r.dense(kv_dim, ch)
+    p[f"attn{i}_wo"] = r.dense(ch, ch)
+    p[f"attn{i}_bo"] = jnp.zeros((ch,))
+  l3s, l3b = _norm((ch,))
+  p.update({
+    "ln3_s": l3s, "ln3_b": l3b,
+    "ff_w1": r.dense(ch, 2 * ff_inner), "ff_b1": jnp.zeros((2 * ff_inner,)),
+    "ff_w2": r.dense(ff_inner, ch), "ff_b2": jnp.zeros((ch,)),
+    "proj_out_w": r.dense(ch, ch, scale=0.02), "proj_out_b": jnp.zeros((ch,)),
+  })
+  return p
+
+
+def init_unet_params(rng, cfg: UNetConfig) -> Params:
+  r = _Rng(rng)
+  c0 = cfg.block_out_channels[0]
+  t_dim = 4 * c0
+  params: Params = {
+    "conv_in_w": r.conv(cfg.in_channels, c0), "conv_in_b": jnp.zeros((c0,)),
+    "time_w1": r.dense(c0, t_dim), "time_b1": jnp.zeros((t_dim,)),
+    "time_w2": r.dense(t_dim, t_dim), "time_b2": jnp.zeros((t_dim,)),
+  }
+  down = []
+  for li, lvl in enumerate(_unet_down_plan(cfg)):
+    blk: Params = {"resnets": [], "attns": []}
+    for cin, cout in lvl["resnets"]:
+      blk["resnets"].append(_init_resnet(r, cin, cout, t_dim))
+      if cfg.cross_levels[li]:
+        blk["attns"].append(_init_tx_block(r, cout, cfg.cross_attention_dim))
+    if not cfg.cross_levels[li]:
+      del blk["attns"]
+    if lvl["down"]:
+      blk["down_w"] = r.conv(lvl["ch"], lvl["ch"])
+      blk["down_b"] = jnp.zeros((lvl["ch"],))
+    down.append(blk)
+  params["down"] = down
+
+  cm = cfg.block_out_channels[-1]
+  params["mid"] = {
+    "resnet1": _init_resnet(r, cm, cm, t_dim),
+    "attn": _init_tx_block(r, cm, cfg.cross_attention_dim),
+    "resnet2": _init_resnet(r, cm, cm, t_dim),
+  }
+
+  up = []
+  for lvl in _unet_up_plan(cfg):
+    blk = {"resnets": [], "attns": []}
+    for cin, cout in lvl["resnets"]:
+      blk["resnets"].append(_init_resnet(r, cin, cout, t_dim))
+      if cfg.cross_levels[lvl["level"]]:
+        blk["attns"].append(_init_tx_block(r, cout, cfg.cross_attention_dim))
+    if not cfg.cross_levels[lvl["level"]]:
+      del blk["attns"]
+    if lvl["up"]:
+      blk["up_w"] = r.conv(lvl["ch"], lvl["ch"])
+      blk["up_b"] = jnp.zeros((lvl["ch"],))
+    up.append(blk)
+  params["up"] = up
+
+  s, b = _norm((c0,))
+  params["norm_out_s"], params["norm_out_b"] = s, b
+  params["conv_out_w"] = r.conv(c0, cfg.out_channels)
+  params["conv_out_b"] = jnp.zeros((cfg.out_channels,))
+  return params
+
+
+def _init_vae_attn(r: _Rng, ch: int) -> Params:
+  ns, nb = _norm((ch,))
+  return {
+    "norm_s": ns, "norm_b": nb,
+    "wq": r.dense(ch, ch), "bq": jnp.zeros((ch,)),
+    "wk": r.dense(ch, ch), "bk": jnp.zeros((ch,)),
+    "wv": r.dense(ch, ch), "bv": jnp.zeros((ch,)),
+    "wo": r.dense(ch, ch), "bo": jnp.zeros((ch,)),
+  }
+
+
+def init_vae_params(rng, cfg: VaeConfig) -> Params:
+  r = _Rng(rng)
+  chans = cfg.block_out_channels
+  c_last = chans[-1]
+
+  enc: Params = {"conv_in_w": r.conv(cfg.in_channels, chans[0]), "conv_in_b": jnp.zeros((chans[0],))}
+  down = []
+  prev = chans[0]
+  for li, ch in enumerate(chans):
+    blk = {"resnets": [_init_vae_resnet(r, prev if ri == 0 else ch, ch) for ri in range(cfg.layers_per_block)]}
+    if li < len(chans) - 1:
+      blk["down_w"] = r.conv(ch, ch)
+      blk["down_b"] = jnp.zeros((ch,))
+    down.append(blk)
+    prev = ch
+  enc["down"] = down
+  enc["mid_resnet1"] = _init_vae_resnet(r, c_last, c_last)
+  enc["mid_attn"] = _init_vae_attn(r, c_last)
+  enc["mid_resnet2"] = _init_vae_resnet(r, c_last, c_last)
+  s, b = _norm((c_last,))
+  enc["norm_out_s"], enc["norm_out_b"] = s, b
+  enc["conv_out_w"] = r.conv(c_last, 2 * cfg.latent_channels)
+  enc["conv_out_b"] = jnp.zeros((2 * cfg.latent_channels,))
+
+  dec: Params = {"conv_in_w": r.conv(cfg.latent_channels, c_last), "conv_in_b": jnp.zeros((c_last,))}
+  dec["mid_resnet1"] = _init_vae_resnet(r, c_last, c_last)
+  dec["mid_attn"] = _init_vae_attn(r, c_last)
+  dec["mid_resnet2"] = _init_vae_resnet(r, c_last, c_last)
+  up = []
+  prev = c_last
+  rev = list(reversed(chans))
+  for ui, ch in enumerate(rev):
+    blk = {"resnets": [_init_vae_resnet(r, prev if ri == 0 else ch, ch) for ri in range(cfg.layers_per_block + 1)]}
+    if ui < len(rev) - 1:
+      blk["up_w"] = r.conv(ch, ch)
+      blk["up_b"] = jnp.zeros((ch,))
+    up.append(blk)
+    prev = ch
+  dec["up"] = up
+  s, b = _norm((chans[0],))
+  dec["norm_out_s"], dec["norm_out_b"] = s, b
+  dec["conv_out_w"] = r.conv(chans[0], cfg.in_channels)
+  dec["conv_out_b"] = jnp.zeros((cfg.in_channels,))
+
+  zc = cfg.latent_channels
+  return {
+    "encoder": enc, "decoder": dec,
+    "quant_w": r.conv(2 * zc, 2 * zc, k=1), "quant_b": jnp.zeros((2 * zc,)),
+    "post_quant_w": r.conv(zc, zc, k=1), "post_quant_b": jnp.zeros((zc,)),
+  }
+
+
+def init_clip_text_params(rng, cfg: ClipTextConfig) -> Params:
+  r = _Rng(rng)
+  d, ff, L = cfg.hidden_size, cfg.intermediate_size, cfg.n_layers
+
+  def stack(make):
+    return jnp.stack([make() for _ in range(L)])
+
+  ones, zeros = jnp.ones((L, d)), jnp.zeros((L, d))
+  return {
+    "tok_emb": jax.random.normal(r.take(), (cfg.vocab_size, d)) * 0.02,
+    "pos_emb": jax.random.normal(r.take(), (cfg.max_positions, d)) * 0.01,
+    "layers": {
+      "ln1_s": ones, "ln1_b": zeros, "ln2_s": ones, "ln2_b": zeros,
+      "wq": stack(lambda: r.dense(d, d)), "bq": jnp.zeros((L, d)),
+      "wk": stack(lambda: r.dense(d, d)), "bk": jnp.zeros((L, d)),
+      "wv": stack(lambda: r.dense(d, d)), "bv": jnp.zeros((L, d)),
+      "wo": stack(lambda: r.dense(d, d)), "bo": jnp.zeros((L, d)),
+      "w_fc1": stack(lambda: r.dense(d, ff)), "b_fc1": jnp.zeros((L, ff)),
+      "w_fc2": stack(lambda: r.dense(ff, d)), "b_fc2": jnp.zeros((L, d)),
+    },
+    "final_ln_s": jnp.ones((d,)), "final_ln_b": jnp.zeros((d,)),
+  }
+
+
+def init_diffusion_params(rng, cfg: DiffusionConfig) -> Params:
+  k1, k2, k3 = jax.random.split(rng, 3)
+  return {
+    "clip": init_clip_text_params(k1, cfg.clip),
+    "unet": init_unet_params(k2, cfg.unet),
+    "vae": init_vae_params(k3, cfg.vae),
+  }
+
+
+# --------------------------------------------------------- checkpoint load
+
+
+def _to_np(t) -> np.ndarray:
+  if hasattr(t, "detach"):
+    t = t.detach()
+  if hasattr(t, "float"):
+    t = t.float().numpy() if t.dtype.__str__() == "torch.bfloat16" else t.numpy()
+  return np.asarray(t)
+
+
+def _lin(t) -> np.ndarray:
+  """torch Linear [out,in] (or 1x1 conv [out,in,1,1]) → [in,out]."""
+  a = _to_np(t)
+  if a.ndim == 4:
+    a = a[:, :, 0, 0]
+  return np.ascontiguousarray(a.T)
+
+
+def _cw(t) -> np.ndarray:
+  """torch conv OIHW → HWIO."""
+  return np.ascontiguousarray(_to_np(t).transpose(2, 3, 1, 0))
+
+
+def _vec(t) -> np.ndarray:
+  return _to_np(t)
+
+
+def _load_safetensors_dir(subdir: Path) -> dict[str, np.ndarray]:
+  from safetensors import safe_open
+
+  out: dict[str, np.ndarray] = {}
+  files = sorted(subdir.glob("*.safetensors"))
+  if not files:
+    raise FileNotFoundError(f"no safetensors under {subdir}")
+  for f in files:
+    with safe_open(str(f), framework="pt") as sf:
+      for name in sf.keys():
+        out[name] = sf.get_tensor(name)
+  return out
+
+
+def load_clip_text(subdir: Path, cfg: ClipTextConfig) -> Params:
+  raw = _load_safetensors_dir(subdir)
+  g = lambda n: raw[n if n in raw else f"text_model.{n}"]
+
+  def per_layer(suffix, conv):
+    return jnp.stack([jnp.asarray(conv(g(f"encoder.layers.{i}.{suffix}"))) for i in range(cfg.n_layers)])
+
+  return {
+    "tok_emb": jnp.asarray(_to_np(g("embeddings.token_embedding.weight"))),
+    "pos_emb": jnp.asarray(_to_np(g("embeddings.position_embedding.weight"))),
+    "layers": {
+      "ln1_s": per_layer("layer_norm1.weight", _vec), "ln1_b": per_layer("layer_norm1.bias", _vec),
+      "wq": per_layer("self_attn.q_proj.weight", _lin), "bq": per_layer("self_attn.q_proj.bias", _vec),
+      "wk": per_layer("self_attn.k_proj.weight", _lin), "bk": per_layer("self_attn.k_proj.bias", _vec),
+      "wv": per_layer("self_attn.v_proj.weight", _lin), "bv": per_layer("self_attn.v_proj.bias", _vec),
+      "wo": per_layer("self_attn.out_proj.weight", _lin), "bo": per_layer("self_attn.out_proj.bias", _vec),
+      "ln2_s": per_layer("layer_norm2.weight", _vec), "ln2_b": per_layer("layer_norm2.bias", _vec),
+      "w_fc1": per_layer("mlp.fc1.weight", _lin), "b_fc1": per_layer("mlp.fc1.bias", _vec),
+      "w_fc2": per_layer("mlp.fc2.weight", _lin), "b_fc2": per_layer("mlp.fc2.bias", _vec),
+    },
+    "final_ln_s": jnp.asarray(_to_np(g("final_layer_norm.weight"))),
+    "final_ln_b": jnp.asarray(_to_np(g("final_layer_norm.bias"))),
+  }
+
+
+def _resnet_from(raw, prefix: str, with_time: bool = True) -> Params:
+  p = {
+    "norm1_s": jnp.asarray(_vec(raw[f"{prefix}.norm1.weight"])), "norm1_b": jnp.asarray(_vec(raw[f"{prefix}.norm1.bias"])),
+    "conv1_w": jnp.asarray(_cw(raw[f"{prefix}.conv1.weight"])), "conv1_b": jnp.asarray(_vec(raw[f"{prefix}.conv1.bias"])),
+    "norm2_s": jnp.asarray(_vec(raw[f"{prefix}.norm2.weight"])), "norm2_b": jnp.asarray(_vec(raw[f"{prefix}.norm2.bias"])),
+    "conv2_w": jnp.asarray(_cw(raw[f"{prefix}.conv2.weight"])), "conv2_b": jnp.asarray(_vec(raw[f"{prefix}.conv2.bias"])),
+  }
+  if with_time:
+    p["time_w"] = jnp.asarray(_lin(raw[f"{prefix}.time_emb_proj.weight"]))
+    p["time_b"] = jnp.asarray(_vec(raw[f"{prefix}.time_emb_proj.bias"]))
+  if f"{prefix}.conv_shortcut.weight" in raw:
+    p["skip_w"] = jnp.asarray(_cw(raw[f"{prefix}.conv_shortcut.weight"]))
+    p["skip_b"] = jnp.asarray(_vec(raw[f"{prefix}.conv_shortcut.bias"]))
+  return p
+
+
+def _tx_from(raw, prefix: str) -> Params:
+  tb = f"{prefix}.transformer_blocks.0"
+  p = {
+    "norm_s": jnp.asarray(_vec(raw[f"{prefix}.norm.weight"])), "norm_b": jnp.asarray(_vec(raw[f"{prefix}.norm.bias"])),
+    "proj_in_w": jnp.asarray(_lin(raw[f"{prefix}.proj_in.weight"])), "proj_in_b": jnp.asarray(_vec(raw[f"{prefix}.proj_in.bias"])),
+    "proj_out_w": jnp.asarray(_lin(raw[f"{prefix}.proj_out.weight"])), "proj_out_b": jnp.asarray(_vec(raw[f"{prefix}.proj_out.bias"])),
+    "ff_w1": jnp.asarray(_lin(raw[f"{tb}.ff.net.0.proj.weight"])), "ff_b1": jnp.asarray(_vec(raw[f"{tb}.ff.net.0.proj.bias"])),
+    "ff_w2": jnp.asarray(_lin(raw[f"{tb}.ff.net.2.weight"])), "ff_b2": jnp.asarray(_vec(raw[f"{tb}.ff.net.2.bias"])),
+  }
+  for i in ("1", "2", "3"):
+    p[f"ln{i}_s"] = jnp.asarray(_vec(raw[f"{tb}.norm{i}.weight"]))
+    p[f"ln{i}_b"] = jnp.asarray(_vec(raw[f"{tb}.norm{i}.bias"]))
+  for i in ("1", "2"):
+    p[f"attn{i}_wq"] = jnp.asarray(_lin(raw[f"{tb}.attn{i}.to_q.weight"]))
+    p[f"attn{i}_wk"] = jnp.asarray(_lin(raw[f"{tb}.attn{i}.to_k.weight"]))
+    p[f"attn{i}_wv"] = jnp.asarray(_lin(raw[f"{tb}.attn{i}.to_v.weight"]))
+    p[f"attn{i}_wo"] = jnp.asarray(_lin(raw[f"{tb}.attn{i}.to_out.0.weight"]))
+    p[f"attn{i}_bo"] = jnp.asarray(_vec(raw[f"{tb}.attn{i}.to_out.0.bias"]))
+  return p
+
+
+def load_unet(subdir: Path, cfg: UNetConfig) -> Params:
+  raw = _load_safetensors_dir(subdir)
+  params: Params = {
+    "conv_in_w": jnp.asarray(_cw(raw["conv_in.weight"])), "conv_in_b": jnp.asarray(_vec(raw["conv_in.bias"])),
+    "time_w1": jnp.asarray(_lin(raw["time_embedding.linear_1.weight"])), "time_b1": jnp.asarray(_vec(raw["time_embedding.linear_1.bias"])),
+    "time_w2": jnp.asarray(_lin(raw["time_embedding.linear_2.weight"])), "time_b2": jnp.asarray(_vec(raw["time_embedding.linear_2.bias"])),
+    "norm_out_s": jnp.asarray(_vec(raw["conv_norm_out.weight"])), "norm_out_b": jnp.asarray(_vec(raw["conv_norm_out.bias"])),
+    "conv_out_w": jnp.asarray(_cw(raw["conv_out.weight"])), "conv_out_b": jnp.asarray(_vec(raw["conv_out.bias"])),
+  }
+
+  down = []
+  for li in range(len(cfg.block_out_channels)):
+    pre = f"down_blocks.{li}"
+    blk: Params = {"resnets": [_resnet_from(raw, f"{pre}.resnets.{ri}") for ri in range(cfg.layers_per_block)]}
+    if cfg.cross_levels[li]:
+      blk["attns"] = [_tx_from(raw, f"{pre}.attentions.{ri}") for ri in range(cfg.layers_per_block)]
+    if f"{pre}.downsamplers.0.conv.weight" in raw:
+      blk["down_w"] = jnp.asarray(_cw(raw[f"{pre}.downsamplers.0.conv.weight"]))
+      blk["down_b"] = jnp.asarray(_vec(raw[f"{pre}.downsamplers.0.conv.bias"]))
+    down.append(blk)
+  params["down"] = down
+
+  params["mid"] = {
+    "resnet1": _resnet_from(raw, "mid_block.resnets.0"),
+    "attn": _tx_from(raw, "mid_block.attentions.0"),
+    "resnet2": _resnet_from(raw, "mid_block.resnets.1"),
+  }
+
+  up = []
+  n = len(cfg.block_out_channels)
+  for ui in range(n):
+    pre = f"up_blocks.{ui}"
+    li = n - 1 - ui
+    blk = {"resnets": [_resnet_from(raw, f"{pre}.resnets.{ri}") for ri in range(cfg.layers_per_block + 1)]}
+    if cfg.cross_levels[li]:
+      blk["attns"] = [_tx_from(raw, f"{pre}.attentions.{ri}") for ri in range(cfg.layers_per_block + 1)]
+    if f"{pre}.upsamplers.0.conv.weight" in raw:
+      blk["up_w"] = jnp.asarray(_cw(raw[f"{pre}.upsamplers.0.conv.weight"]))
+      blk["up_b"] = jnp.asarray(_vec(raw[f"{pre}.upsamplers.0.conv.bias"]))
+    up.append(blk)
+  params["up"] = up
+  return params
+
+
+def _vae_attn_from(raw, prefix: str) -> Params:
+  # newer diffusers: group_norm + to_q/to_k/to_v/to_out.0; older: norm + query/key/value/proj_attn
+  if f"{prefix}.group_norm.weight" in raw:
+    names = {"norm": "group_norm", "q": "to_q", "k": "to_k", "v": "to_v", "o": "to_out.0"}
+  else:
+    names = {"norm": "norm", "q": "query", "k": "key", "v": "value", "o": "proj_attn"}
+  return {
+    "norm_s": jnp.asarray(_vec(raw[f"{prefix}.{names['norm']}.weight"])),
+    "norm_b": jnp.asarray(_vec(raw[f"{prefix}.{names['norm']}.bias"])),
+    "wq": jnp.asarray(_lin(raw[f"{prefix}.{names['q']}.weight"])), "bq": jnp.asarray(_vec(raw[f"{prefix}.{names['q']}.bias"])),
+    "wk": jnp.asarray(_lin(raw[f"{prefix}.{names['k']}.weight"])), "bk": jnp.asarray(_vec(raw[f"{prefix}.{names['k']}.bias"])),
+    "wv": jnp.asarray(_lin(raw[f"{prefix}.{names['v']}.weight"])), "bv": jnp.asarray(_vec(raw[f"{prefix}.{names['v']}.bias"])),
+    "wo": jnp.asarray(_lin(raw[f"{prefix}.{names['o']}.weight"])), "bo": jnp.asarray(_vec(raw[f"{prefix}.{names['o']}.bias"])),
+  }
+
+
+def load_vae(subdir: Path, cfg: VaeConfig) -> Params:
+  raw = _load_safetensors_dir(subdir)
+
+  def half(side: str, n_res: int, blocks_key: str, sampler: str) -> Params:
+    p: Params = {
+      "conv_in_w": jnp.asarray(_cw(raw[f"{side}.conv_in.weight"])), "conv_in_b": jnp.asarray(_vec(raw[f"{side}.conv_in.bias"])),
+      "mid_resnet1": _resnet_from(raw, f"{side}.mid_block.resnets.0", with_time=False),
+      "mid_attn": _vae_attn_from(raw, f"{side}.mid_block.attentions.0"),
+      "mid_resnet2": _resnet_from(raw, f"{side}.mid_block.resnets.1", with_time=False),
+      "norm_out_s": jnp.asarray(_vec(raw[f"{side}.conv_norm_out.weight"])), "norm_out_b": jnp.asarray(_vec(raw[f"{side}.conv_norm_out.bias"])),
+      "conv_out_w": jnp.asarray(_cw(raw[f"{side}.conv_out.weight"])), "conv_out_b": jnp.asarray(_vec(raw[f"{side}.conv_out.bias"])),
+    }
+    blocks = []
+    for li in range(len(cfg.block_out_channels)):
+      pre = f"{side}.{blocks_key}.{li}"
+      blk = {"resnets": [_resnet_from(raw, f"{pre}.resnets.{ri}", with_time=False) for ri in range(n_res)]}
+      if f"{pre}.{sampler}s.0.conv.weight" in raw:
+        wkey, bkey = ("down_w", "down_b") if sampler == "downsampler" else ("up_w", "up_b")
+        blk[wkey] = jnp.asarray(_cw(raw[f"{pre}.{sampler}s.0.conv.weight"]))
+        blk[bkey] = jnp.asarray(_vec(raw[f"{pre}.{sampler}s.0.conv.bias"]))
+      blocks.append(blk)
+    p["down" if sampler == "downsampler" else "up"] = blocks
+    return p
+
+  return {
+    "encoder": half("encoder", cfg.layers_per_block, "down_blocks", "downsampler"),
+    "decoder": half("decoder", cfg.layers_per_block + 1, "up_blocks", "upsampler"),
+    "quant_w": jnp.asarray(_cw(raw["quant_conv.weight"])), "quant_b": jnp.asarray(_vec(raw["quant_conv.bias"])),
+    "post_quant_w": jnp.asarray(_cw(raw["post_quant_conv.weight"])), "post_quant_b": jnp.asarray(_vec(raw["post_quant_conv.bias"])),
+  }
+
+
+def diffusion_config_from_dir(model_dir: Path) -> DiffusionConfig:
+  """Assemble a DiffusionConfig from a diffusers model directory's configs."""
+
+  def read(name: str) -> dict:
+    p = model_dir / name
+    return json.loads(p.read_text()) if p.exists() else {}
+
+  te = read("text_encoder/config.json")
+  un = read("unet/config.json")
+  va = read("vae/config.json")
+  sc = read("scheduler/scheduler_config.json")
+
+  n_levels = len(un.get("block_out_channels", (320, 640, 1280, 1280)))
+  down_types = un.get("down_block_types", ["CrossAttnDownBlock2D"] * (n_levels - 1) + ["DownBlock2D"])
+  head_dim = un.get("attention_head_dim", 64)
+  if isinstance(head_dim, (list, tuple)):
+    # per-level head counts (SD1 style [8,8,8,8] are heads; SD2 [5,10,20,20]
+    # are heads too) — convert to a uniform per-head width when possible
+    chans = un.get("block_out_channels", (320, 640, 1280, 1280))
+    widths = {c // h for c, h in zip(chans, head_dim)}
+    head_dim = widths.pop() if len(widths) == 1 else 64
+  return DiffusionConfig(
+    clip=ClipTextConfig(
+      vocab_size=te.get("vocab_size", 49408),
+      hidden_size=te.get("hidden_size", 1024),
+      intermediate_size=te.get("intermediate_size", 4096),
+      n_layers=te.get("num_hidden_layers", 23),
+      n_heads=te.get("num_attention_heads", 16),
+      max_positions=te.get("max_position_embeddings", 77),
+      layer_norm_eps=te.get("layer_norm_eps", 1e-5),
+      act=te.get("hidden_act", "gelu"),
+    ),
+    unet=UNetConfig(
+      in_channels=un.get("in_channels", 4),
+      out_channels=un.get("out_channels", 4),
+      block_out_channels=tuple(un.get("block_out_channels", (320, 640, 1280, 1280))),
+      layers_per_block=un.get("layers_per_block", 2),
+      cross_attention_dim=un.get("cross_attention_dim", 1024),
+      attention_head_dim=int(head_dim),
+      norm_groups=un.get("norm_num_groups", 32),
+      norm_eps=un.get("norm_eps", 1e-5),
+      cross_levels=tuple(t != "DownBlock2D" for t in down_types),
+    ),
+    vae=VaeConfig(
+      in_channels=va.get("in_channels", 3),
+      latent_channels=va.get("latent_channels", 4),
+      block_out_channels=tuple(va.get("block_out_channels", (128, 256, 512, 512))),
+      layers_per_block=va.get("layers_per_block", 2),
+      norm_groups=va.get("norm_num_groups", 32),
+      scaling_factor=va.get("scaling_factor", 0.18215),
+    ),
+    sample_size=un.get("sample_size", 64),
+    prediction_type=sc.get("prediction_type", "epsilon"),
+    num_train_timesteps=sc.get("num_train_timesteps", 1000),
+    beta_start=sc.get("beta_start", 0.00085),
+    beta_end=sc.get("beta_end", 0.012),
+    beta_schedule=sc.get("beta_schedule", "scaled_linear"),
+    set_alpha_to_one=bool(sc.get("set_alpha_to_one", False)),
+    steps_offset=int(sc.get("steps_offset", 0)),
+  )
+
+
+def load_diffusion_params(model_dir: Path, cfg: DiffusionConfig) -> Params:
+  return {
+    "clip": load_clip_text(model_dir / "text_encoder", cfg.clip),
+    "unet": load_unet(model_dir / "unet", cfg.unet),
+    "vae": load_vae(model_dir / "vae", cfg.vae),
+  }

@@ -235,6 +235,42 @@ class Node:
     )
     return result
 
+  async def process_image_prompt(
+    self,
+    base_shard: Shard,
+    prompt: str,
+    request_id: str | None = None,
+    *,
+    negative: str = "",
+    steps: int = 30,
+    guidance: float = 7.5,
+    seed: int = 0,
+    size: tuple[int, int] | None = None,
+    init_image=None,
+    strength: float = 0.8,
+    progress_cb=None,
+    cancel_event=None,
+  ):
+    """Image generation (stable-diffusion family) → uint8 [H, W, 3].
+
+    Role of the reference's SD special case (reference node.py:116-147,
+    613-620), which steps a sampler once per ring pass through dead code.
+    Here diffusion runs single-node full-model by design (the whole SD2
+    pipeline fits one chip; see jax_engine._load_diffusion_sync) so the ring
+    forwarding layer is bypassed: progress streams from the denoise loop's
+    chunk boundaries instead of ring hops.
+    """
+    if request_id is None:
+      request_id = str(uuid.uuid4())
+    full = Shard(base_shard.model_id, 0, base_shard.n_layers - 1, base_shard.n_layers)
+    metrics.inc("requests_total")
+    with tracer.start_span("request.process_image_prompt", request_id, {"node_id": self.id, "model": base_shard.model_id}):
+      return await self.inference_engine.generate_image(
+        full, prompt, negative=negative, steps=steps, guidance=guidance,
+        seed=seed, size=size, init_image=init_image, strength=strength,
+        progress_cb=progress_cb, cancel_event=cancel_event,
+      )
+
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None, wire_concrete: bool = False):
     # Sender-authoritative rule (see process_tensor): a shard that arrived
     # over the wire is the sender's concrete routing decision — obey it.

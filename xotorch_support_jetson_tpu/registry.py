@@ -89,6 +89,13 @@ _CARDS: list[ModelCard] = [
   # sliding window); here the general decoder runs them (models/decoder.py).
   _card("gemma2-9b", 42, "Gemma2 9B", "gemma2", "google/gemma-2-9b-it"),
   _card("gemma2-27b", 46, "Gemma2 27B", "gemma2", "google/gemma-2-27b-it"),
+  # stable diffusion — the reference ships this entry commented out with no
+  # model implementation (reference models.py:167-168); here the JAX pipeline
+  # actually generates (models/diffusion.py, /v1/image/generations). The
+  # layer count mirrors the reference's vestigial 31 but is unused: diffusion
+  # serves single-device full-model (inference/jax_engine.py
+  # _load_diffusion_sync).
+  _card("stable-diffusion-2-1-base", 31, "Stable Diffusion 2.1", "stable-diffusion", "stabilityai/stable-diffusion-2-1-base"),
 ]
 
 model_cards: dict[str, ModelCard] = {c.model_id: c for c in _CARDS}
@@ -105,6 +112,11 @@ def get_repo(model_id: str, inference_engine_classname: str) -> str | None:
 
 def get_pretty_name(model_id: str) -> str | None:
   return pretty_name.get(model_id)
+
+
+def get_family(model_id: str) -> str | None:
+  card = model_cards.get(model_id)
+  return card.family if card else None
 
 
 def build_base_shard(model_id: str, inference_engine_classname: str) -> Shard | None:
