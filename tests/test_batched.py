@@ -34,7 +34,7 @@ def _single_row_reference(params, shard, prompt, n_steps, cfg=None):
   S = len(prompt)
   tokens = jnp.asarray([prompt], dtype=jnp.int32)
   positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
-  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 64)
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, max(64, S + n_steps + 1))
   logits, cache = shard_forward(params, cfg, shard, tokens, positions, cache)
   first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
   toks, _ = fused_decode(params, cfg, shard, first, cache, jnp.full((1,), S, jnp.int32), n_steps, temp=0.0)

@@ -233,113 +233,25 @@ def test_vae_sample_latents_deterministic_at_zero_var():
 
 
 def test_loader_tree_matches_init_tree():
-  """A diffusers-named checkpoint written from the init tree must load back
-  into the identical structure and values (UNet + VAE name-map round trip)."""
-  pytest.importorskip("torch")
-  import torch
-  from safetensors.torch import save_file
-  from xotorch_support_jetson_tpu.models.diffusion_loader import load_unet, load_vae
+  """A diffusers-named checkpoint written by the SHIPPING exporter
+  (export_diffusers_checkpoint — the same name map the verify drill uses)
+  must load back into the identical tree, values, and behavior: one name
+  map, round-tripped in both directions."""
+  from xotorch_support_jetson_tpu.models.diffusion_loader import (
+    export_diffusers_checkpoint,
+    load_unet,
+    load_vae,
+  )
 
   rng = jax.random.PRNGKey(17)
-  unet_p = init_unet_params(rng, CFG.unet)
-  vae_p = init_vae_params(jax.random.fold_in(rng, 1), CFG.vae)
-
-  def t_lin(w):  # [in,out] -> torch [out,in]
-    return torch.from_numpy(np.asarray(w).T.copy())
-
-  def t_conv(w):  # HWIO -> OIHW
-    return torch.from_numpy(np.asarray(w).transpose(3, 2, 0, 1).copy())
-
-  def t_vec(v):
-    return torch.from_numpy(np.asarray(v).copy())
-
-  sd = {}
-
-  def emit_resnet(prefix, p, with_time=True):
-    sd[f"{prefix}.norm1.weight"] = t_vec(p["norm1_s"]); sd[f"{prefix}.norm1.bias"] = t_vec(p["norm1_b"])
-    sd[f"{prefix}.conv1.weight"] = t_conv(p["conv1_w"]); sd[f"{prefix}.conv1.bias"] = t_vec(p["conv1_b"])
-    sd[f"{prefix}.norm2.weight"] = t_vec(p["norm2_s"]); sd[f"{prefix}.norm2.bias"] = t_vec(p["norm2_b"])
-    sd[f"{prefix}.conv2.weight"] = t_conv(p["conv2_w"]); sd[f"{prefix}.conv2.bias"] = t_vec(p["conv2_b"])
-    if with_time:
-      sd[f"{prefix}.time_emb_proj.weight"] = t_lin(p["time_w"]); sd[f"{prefix}.time_emb_proj.bias"] = t_vec(p["time_b"])
-    if "skip_w" in p:
-      sd[f"{prefix}.conv_shortcut.weight"] = t_conv(p["skip_w"]); sd[f"{prefix}.conv_shortcut.bias"] = t_vec(p["skip_b"])
-
-  def emit_tx(prefix, p):
-    tb = f"{prefix}.transformer_blocks.0"
-    sd[f"{prefix}.norm.weight"] = t_vec(p["norm_s"]); sd[f"{prefix}.norm.bias"] = t_vec(p["norm_b"])
-    sd[f"{prefix}.proj_in.weight"] = t_lin(p["proj_in_w"]); sd[f"{prefix}.proj_in.bias"] = t_vec(p["proj_in_b"])
-    sd[f"{prefix}.proj_out.weight"] = t_lin(p["proj_out_w"]); sd[f"{prefix}.proj_out.bias"] = t_vec(p["proj_out_b"])
-    sd[f"{tb}.ff.net.0.proj.weight"] = t_lin(p["ff_w1"]); sd[f"{tb}.ff.net.0.proj.bias"] = t_vec(p["ff_b1"])
-    sd[f"{tb}.ff.net.2.weight"] = t_lin(p["ff_w2"]); sd[f"{tb}.ff.net.2.bias"] = t_vec(p["ff_b2"])
-    for i in ("1", "2", "3"):
-      sd[f"{tb}.norm{i}.weight"] = t_vec(p[f"ln{i}_s"]); sd[f"{tb}.norm{i}.bias"] = t_vec(p[f"ln{i}_b"])
-    for i in ("1", "2"):
-      sd[f"{tb}.attn{i}.to_q.weight"] = t_lin(p[f"attn{i}_wq"])
-      sd[f"{tb}.attn{i}.to_k.weight"] = t_lin(p[f"attn{i}_wk"])
-      sd[f"{tb}.attn{i}.to_v.weight"] = t_lin(p[f"attn{i}_wv"])
-      sd[f"{tb}.attn{i}.to_out.0.weight"] = t_lin(p[f"attn{i}_wo"]); sd[f"{tb}.attn{i}.to_out.0.bias"] = t_vec(p[f"attn{i}_bo"])
-
-  # UNet
-  sd["conv_in.weight"] = t_conv(unet_p["conv_in_w"]); sd["conv_in.bias"] = t_vec(unet_p["conv_in_b"])
-  sd["time_embedding.linear_1.weight"] = t_lin(unet_p["time_w1"]); sd["time_embedding.linear_1.bias"] = t_vec(unet_p["time_b1"])
-  sd["time_embedding.linear_2.weight"] = t_lin(unet_p["time_w2"]); sd["time_embedding.linear_2.bias"] = t_vec(unet_p["time_b2"])
-  sd["conv_norm_out.weight"] = t_vec(unet_p["norm_out_s"]); sd["conv_norm_out.bias"] = t_vec(unet_p["norm_out_b"])
-  sd["conv_out.weight"] = t_conv(unet_p["conv_out_w"]); sd["conv_out.bias"] = t_vec(unet_p["conv_out_b"])
-  for li, blk in enumerate(unet_p["down"]):
-    for ri, rp in enumerate(blk["resnets"]):
-      emit_resnet(f"down_blocks.{li}.resnets.{ri}", rp)
-    for ri, ap in enumerate(blk.get("attns", [])):
-      emit_tx(f"down_blocks.{li}.attentions.{ri}", ap)
-    if "down_w" in blk:
-      sd[f"down_blocks.{li}.downsamplers.0.conv.weight"] = t_conv(blk["down_w"]); sd[f"down_blocks.{li}.downsamplers.0.conv.bias"] = t_vec(blk["down_b"])
-  emit_resnet("mid_block.resnets.0", unet_p["mid"]["resnet1"])
-  emit_tx("mid_block.attentions.0", unet_p["mid"]["attn"])
-  emit_resnet("mid_block.resnets.1", unet_p["mid"]["resnet2"])
-  for ui, blk in enumerate(unet_p["up"]):
-    for ri, rp in enumerate(blk["resnets"]):
-      emit_resnet(f"up_blocks.{ui}.resnets.{ri}", rp)
-    for ri, ap in enumerate(blk.get("attns", [])):
-      emit_tx(f"up_blocks.{ui}.attentions.{ri}", ap)
-    if "up_w" in blk:
-      sd[f"up_blocks.{ui}.upsamplers.0.conv.weight"] = t_conv(blk["up_w"]); sd[f"up_blocks.{ui}.upsamplers.0.conv.bias"] = t_vec(blk["up_b"])
-
-  # VAE
-  vsd = {}
-  sd_save, sd = sd, vsd
-  for side, half, n_res, key, sampler in (
-    ("encoder", vae_p["encoder"], CFG.vae.layers_per_block, "down", "downsamplers"),
-    ("decoder", vae_p["decoder"], CFG.vae.layers_per_block + 1, "up", "upsamplers"),
-  ):
-    vsd[f"{side}.conv_in.weight"] = t_conv(half["conv_in_w"]); vsd[f"{side}.conv_in.bias"] = t_vec(half["conv_in_b"])
-    emit_resnet(f"{side}.mid_block.resnets.0", half["mid_resnet1"], with_time=False)
-    attn = half["mid_attn"]
-    vsd[f"{side}.mid_block.attentions.0.group_norm.weight"] = t_vec(attn["norm_s"]); vsd[f"{side}.mid_block.attentions.0.group_norm.bias"] = t_vec(attn["norm_b"])
-    for nm, w, b in (("to_q", "wq", "bq"), ("to_k", "wk", "bk"), ("to_v", "wv", "bv"), ("to_out.0", "wo", "bo")):
-      vsd[f"{side}.mid_block.attentions.0.{nm}.weight"] = t_lin(attn[w]); vsd[f"{side}.mid_block.attentions.0.{nm}.bias"] = t_vec(attn[b])
-    emit_resnet(f"{side}.mid_block.resnets.1", half["mid_resnet2"], with_time=False)
-    vsd[f"{side}.conv_norm_out.weight"] = t_vec(half["norm_out_s"]); vsd[f"{side}.conv_norm_out.bias"] = t_vec(half["norm_out_b"])
-    vsd[f"{side}.conv_out.weight"] = t_conv(half["conv_out_w"]); vsd[f"{side}.conv_out.bias"] = t_vec(half["conv_out_b"])
-    for li, blk in enumerate(half[key]):
-      pre = f"{side}.{'down_blocks' if key == 'down' else 'up_blocks'}.{li}"
-      for ri, rp in enumerate(blk["resnets"]):
-        emit_resnet(f"{pre}.resnets.{ri}", rp, with_time=False)
-      wk = "down_w" if key == "down" else "up_w"
-      if wk in blk:
-        vsd[f"{pre}.{sampler}.0.conv.weight"] = t_conv(blk[wk]); vsd[f"{pre}.{sampler}.0.conv.bias"] = t_vec(blk[wk.replace("_w", "_b")])
-  vsd["quant_conv.weight"] = t_conv(vae_p["quant_w"]); vsd["quant_conv.bias"] = t_vec(vae_p["quant_b"])
-  vsd["post_quant_conv.weight"] = t_conv(vae_p["post_quant_w"]); vsd["post_quant_conv.bias"] = t_vec(vae_p["post_quant_b"])
-  sd = sd_save
+  params = init_diffusion_params(rng, CFG)
 
   with tempfile.TemporaryDirectory() as d:
-    (Path(d) / "unet").mkdir()
-    (Path(d) / "vae").mkdir()
-    save_file(sd, str(Path(d) / "unet" / "diffusion_pytorch_model.safetensors"))
-    save_file(vsd, str(Path(d) / "vae" / "diffusion_pytorch_model.safetensors"))
+    export_diffusers_checkpoint(Path(d), CFG, params)
     unet_l = load_unet(Path(d) / "unet", CFG.unet)
     vae_l = load_vae(Path(d) / "vae", CFG.vae)
 
-  for orig, loaded, name in ((unet_p, unet_l, "unet"), (vae_p, vae_l, "vae")):
+  for orig, loaded, name in ((params["unet"], unet_l, "unet"), (params["vae"], vae_l, "vae")):
     flat_o = jax.tree_util.tree_flatten_with_path(orig)[0]
     flat_l = jax.tree_util.tree_flatten_with_path(loaded)[0]
     assert len(flat_o) == len(flat_l), name
@@ -350,9 +262,32 @@ def test_loader_tree_matches_init_tree():
   # the loaded tree must also RUN identically
   x = jax.random.normal(jax.random.PRNGKey(18), (1, 8, 8, 4))
   ctx = jax.random.normal(jax.random.PRNGKey(19), (1, 5, CFG.unet.cross_attention_dim))
-  a = unet_apply(unet_p, CFG.unet, x, jnp.asarray([3]), ctx)
+  a = unet_apply(params["unet"], CFG.unet, x, jnp.asarray([3]), ctx)
   b = unet_apply(unet_l, CFG.unet, x, jnp.asarray([3]), ctx)
   np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_exported_checkpoint_loads_as_full_pipeline():
+  """export → diffusion_config_from_dir → load_diffusion_params: the whole
+  offline-checkpoint path the verify drill and the engine take."""
+  from xotorch_support_jetson_tpu.models.diffusion_loader import (
+    diffusion_config_from_dir,
+    export_diffusers_checkpoint,
+    load_diffusion_params,
+  )
+
+  params = init_diffusion_params(jax.random.PRNGKey(21), CFG)
+  with tempfile.TemporaryDirectory() as d:
+    export_diffusers_checkpoint(Path(d), CFG, params)
+    cfg2 = diffusion_config_from_dir(Path(d))
+    assert cfg2.unet == CFG.unet and cfg2.vae == CFG.vae and cfg2.clip == CFG.clip
+    assert cfg2.set_alpha_to_one == CFG.set_alpha_to_one and cfg2.steps_offset == CFG.steps_offset
+    loaded = load_diffusion_params(Path(d), cfg2)
+  pipe_a = DiffusionPipeline(CFG, params, dtype=jnp.float32)
+  pipe_b = DiffusionPipeline(cfg2, loaded, dtype=jnp.float32)
+  img_a = pipe_a.generate("same words", steps=4, seed=9)
+  img_b = pipe_b.generate("same words", steps=4, seed=9)
+  np.testing.assert_array_equal(img_a, img_b)
 
 
 # -------------------------------------------------------------- pipeline

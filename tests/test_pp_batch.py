@@ -287,3 +287,57 @@ def test_batch_scheduler_serves_concurrently_over_pp(monkeypatch):
   outs = asyncio.run(run())
   for i, out in enumerate(outs):
     assert out == expected[i], f"req {i}: {out} != {expected[i]}"
+
+
+def test_chunked_prefill_over_pp(monkeypatch):
+  """XOT_TPU_PREFILL_CHUNK composes with pp-batched paged serving: a long
+  arrival prefills in chunks (the pp paged program natively resumes from
+  prefix_lens) with decode ticks between, and output stays token-identical
+  to solo greedy on the deep mesh too."""
+  from tests.test_batched import _single_row_reference
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "16")
+  cfg = _cfg()
+  params, shard = full_model_params(jax.random.PRNGKey(23), cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True, pp=2)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert engine._pp is not None and engine.mesh.shape["pp"] == 2
+
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  assert server.paged and server.prefill_chunk == 16
+
+  events = []
+  orig_prefill = server.ops.prefill_into_pages_many
+  orig_decode = server.ops.paged_batch_decode
+  server.ops.prefill_into_pages_many = lambda tokens, *a, **k: events.append("prefill") or orig_prefill(tokens, *a, **k)
+  server.ops.paged_batch_decode = lambda *a, **k: events.append("decode") or orig_decode(*a, **k)
+
+  long_prompt = [(7 * i) % 120 + 1 for i in range(48)]  # 3 chunks of 16
+  short = [3, 25, 9]
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "s":
+        started.set()
+
+    async def late_long():
+      await started.wait()
+      return await server.submit("L", np.asarray(long_prompt, np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+
+    return await asyncio.gather(
+      server.submit("s", np.asarray(short, np.int32), max_tokens=12, temp=0.0, top_k=35, eos_ids=(), emit=emit),
+      late_long(),
+    )
+
+  out_short, out_long = asyncio.run(run())
+  assert out_short == _single_row_reference(params, shard, short, 11, cfg=cfg)
+  assert out_long == _single_row_reference(params, shard, long_prompt, 2, cfg=cfg)
+  assert events.count("prefill") >= 4, events  # short + >=3 chunks
+  first, last = events.index("prefill"), len(events) - 1 - events[::-1].index("prefill")
+  assert "decode" in events[first:last], events  # decode ticks BETWEEN chunks

@@ -199,3 +199,58 @@ def test_supports_batched_requires_divisible_page_size(monkeypatch):
   assert not engine.supports_batched()
   monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "64")
   assert engine.supports_batched()
+
+
+def test_chunked_prefill_over_sp(monkeypatch):
+  """XOT_TPU_PREFILL_CHUNK composes with the sp striped pool: chunked
+  prefill resumes from prefix offsets across rank-striped page slots, decode
+  ticks run between chunks, outputs token-identical to solo greedy."""
+  from tests.test_batched import _single_row_reference
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+  monkeypatch.setenv("XOT_TPU_SP", "2")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "32")
+  cfg = DENSE
+  params, shard = full_model_params(jax.random.PRNGKey(47), cfg, "tiny")
+  engine = JaxShardedInferenceEngine(use_local_mesh=True)
+  engine.load_test_model(shard, cfg, params)
+  engine._maybe_shard_over_local_mesh()
+  assert isinstance(engine._pp, SPServing) and engine.supports_batched()
+
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  assert server.paged and server.prefill_chunk == 32
+
+  events = []
+  orig_prefill = server.ops.prefill_into_pages_many
+  orig_decode = server.ops.paged_batch_decode
+  server.ops.prefill_into_pages_many = lambda tokens, *a, **k: events.append("prefill") or orig_prefill(tokens, *a, **k)
+  server.ops.paged_batch_decode = lambda *a, **k: events.append("decode") or orig_decode(*a, **k)
+
+  long_prompt = [(11 * i) % 120 + 1 for i in range(100)]  # 4 chunks of 32
+  short = [3, 25, 9]
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "s":
+        started.set()
+
+    async def late_long():
+      await started.wait()
+      return await server.submit("L", np.asarray(long_prompt, np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+
+    return await asyncio.gather(
+      server.submit("s", np.asarray(short, np.int32), max_tokens=12, temp=0.0, top_k=35, eos_ids=(), emit=emit),
+      late_long(),
+    )
+
+  out_short, out_long = asyncio.run(run())
+  assert out_short == _single_row_reference(params, shard, short, 11, cfg=cfg)
+  assert out_long == _single_row_reference(params, shard, long_prompt, 2, cfg=cfg)
+  assert events.count("prefill") >= 5, events  # short + 4 chunks
+  first, last = events.index("prefill"), len(events) - 1 - events[::-1].index("prefill")
+  assert "decode" in events[first:last], events

@@ -589,7 +589,10 @@ class ChatGPTAPI:
     beyond the reference: negative_prompt, steps, guidance, seed, size,
     strength.
     """
-    data = await request.json()
+    try:
+      data = await request.json()
+    except Exception:  # noqa: BLE001 — same contract as the chat endpoints
+      return web.json_response({"error": "invalid JSON body"}, status=400)
     model = data.get("model", "")
     prompt = data.get("prompt", "")
     if registry.get_family(model) != "stable-diffusion":
@@ -681,6 +684,16 @@ class ChatGPTAPI:
       await response.write(json.dumps({"images": [{"url": url, "content_type": "image/png"}]}).encode() + b"\n")
       await response.write_eof()
       return response
+    except asyncio.CancelledError:
+      # aiohttp cancels the handler task on client disconnect —
+      # CancelledError is a BaseException, so the generic branch below never
+      # sees it. Stop the denoise (the worker polls cancel_event between
+      # chunks), retrieve the task outcome, and let the cancellation
+      # propagate as aiohttp expects.
+      cancel_event.set()
+      gen.cancel()
+      await asyncio.gather(gen, return_exceptions=True)
+      raise
     except Exception as e:  # noqa: BLE001 — incl. client-disconnect write errors
       # Stop the denoise loop: the worker thread polls cancel_event between
       # chunks; the abandoned task's outcome is retrieved so it never logs

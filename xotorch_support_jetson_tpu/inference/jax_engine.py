@@ -492,15 +492,18 @@ class JaxShardedInferenceEngine(InferenceEngine):
       tokenizer = AutoTokenizer.from_pretrained(str(model_dir / "tokenizer"))
     self.diffusion = DiffusionPipeline(cfg, params, tokenizer)
     self.tokenizer = tokenizer
-    # Release EVERY piece of the previous text model's device state — a
-    # stale int8 draft / vision tower / jitted eval closure would pin HBM
-    # under the diffusion weights.
+    # Release EVERY piece of the previous text model's device state (same
+    # set as clear_model) — a stale int8 draft / PPServing-held sharded
+    # params / jitted eval closure would pin HBM under the diffusion weights.
     self.params = None
     self.cfg = None
     self._draft_params = None
     self._vision_params = None
     self._train_state = None
     self._mesh_eval_fn = None
+    self.mesh = None
+    self._pp = None
+    self._batch_ops = None
     self.shard = shard
     self._effective_shard = shard
     self._model_dir = model_dir

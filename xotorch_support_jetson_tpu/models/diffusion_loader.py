@@ -546,3 +546,172 @@ def load_diffusion_params(model_dir: Path, cfg: DiffusionConfig) -> Params:
     "unet": load_unet(model_dir / "unet", cfg.unet),
     "vae": load_vae(model_dir / "vae", cfg.vae),
   }
+
+
+# ------------------------------------------------- diffusers-format export
+# Inverse of the loader above: write a params tree back out in the diffusers
+# on-disk layout (model_index.json + text_encoder/ unet/ vae/ scheduler/).
+# Used by scripts/make_tiny_diffusion.py (offline verify checkpoints) and by
+# the loader round-trip test — ONE name map for both directions.
+
+def _lin_out(w):  # [in,out] -> torch-Linear layout [out,in]
+  return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+
+def _conv_out(w):  # HWIO -> OIHW
+  return np.ascontiguousarray(np.asarray(w, np.float32).transpose(3, 2, 0, 1))
+
+
+def _vec_out(v):
+  return np.ascontiguousarray(np.asarray(v, np.float32))
+
+
+def _export_resnet(sd, prefix, p, with_time=True):
+  sd[f"{prefix}.norm1.weight"] = _vec_out(p["norm1_s"]); sd[f"{prefix}.norm1.bias"] = _vec_out(p["norm1_b"])
+  sd[f"{prefix}.conv1.weight"] = _conv_out(p["conv1_w"]); sd[f"{prefix}.conv1.bias"] = _vec_out(p["conv1_b"])
+  sd[f"{prefix}.norm2.weight"] = _vec_out(p["norm2_s"]); sd[f"{prefix}.norm2.bias"] = _vec_out(p["norm2_b"])
+  sd[f"{prefix}.conv2.weight"] = _conv_out(p["conv2_w"]); sd[f"{prefix}.conv2.bias"] = _vec_out(p["conv2_b"])
+  if with_time:
+    sd[f"{prefix}.time_emb_proj.weight"] = _lin_out(p["time_w"]); sd[f"{prefix}.time_emb_proj.bias"] = _vec_out(p["time_b"])
+  if "skip_w" in p:
+    sd[f"{prefix}.conv_shortcut.weight"] = _conv_out(p["skip_w"]); sd[f"{prefix}.conv_shortcut.bias"] = _vec_out(p["skip_b"])
+
+
+def _export_tx(sd, prefix, p):
+  tb = f"{prefix}.transformer_blocks.0"
+  sd[f"{prefix}.norm.weight"] = _vec_out(p["norm_s"]); sd[f"{prefix}.norm.bias"] = _vec_out(p["norm_b"])
+  sd[f"{prefix}.proj_in.weight"] = _lin_out(p["proj_in_w"]); sd[f"{prefix}.proj_in.bias"] = _vec_out(p["proj_in_b"])
+  sd[f"{prefix}.proj_out.weight"] = _lin_out(p["proj_out_w"]); sd[f"{prefix}.proj_out.bias"] = _vec_out(p["proj_out_b"])
+  sd[f"{tb}.ff.net.0.proj.weight"] = _lin_out(p["ff_w1"]); sd[f"{tb}.ff.net.0.proj.bias"] = _vec_out(p["ff_b1"])
+  sd[f"{tb}.ff.net.2.weight"] = _lin_out(p["ff_w2"]); sd[f"{tb}.ff.net.2.bias"] = _vec_out(p["ff_b2"])
+  for i in ("1", "2", "3"):
+    sd[f"{tb}.norm{i}.weight"] = _vec_out(p[f"ln{i}_s"]); sd[f"{tb}.norm{i}.bias"] = _vec_out(p[f"ln{i}_b"])
+  for i in ("1", "2"):
+    sd[f"{tb}.attn{i}.to_q.weight"] = _lin_out(p[f"attn{i}_wq"])
+    sd[f"{tb}.attn{i}.to_k.weight"] = _lin_out(p[f"attn{i}_wk"])
+    sd[f"{tb}.attn{i}.to_v.weight"] = _lin_out(p[f"attn{i}_wv"])
+    sd[f"{tb}.attn{i}.to_out.0.weight"] = _lin_out(p[f"attn{i}_wo"]); sd[f"{tb}.attn{i}.to_out.0.bias"] = _vec_out(p[f"attn{i}_bo"])
+
+
+def export_diffusers_checkpoint(out_dir: Path, cfg, params) -> None:
+  from safetensors.numpy import save_file
+
+  out_dir.mkdir(parents=True, exist_ok=True)
+  (out_dir / "model_index.json").write_text(json.dumps({"_class_name": "StableDiffusionPipeline"}))
+
+  # ---- text encoder (transformers CLIPTextModel names)
+  clip = params["clip"]
+  sd: dict[str, np.ndarray] = {
+    "text_model.embeddings.token_embedding.weight": _vec_out(clip["tok_emb"]),
+    "text_model.embeddings.position_embedding.weight": _vec_out(clip["pos_emb"]),
+    "text_model.final_layer_norm.weight": _vec_out(clip["final_ln_s"]),
+    "text_model.final_layer_norm.bias": _vec_out(clip["final_ln_b"]),
+  }
+  L = cfg.clip.n_layers
+  lp = clip["layers"]
+  name_map = [
+    ("layer_norm1.weight", "ln1_s", _vec), ("layer_norm1.bias", "ln1_b", _vec),
+    ("self_attn.q_proj.weight", "wq", _lin), ("self_attn.q_proj.bias", "bq", _vec),
+    ("self_attn.k_proj.weight", "wk", _lin), ("self_attn.k_proj.bias", "bk", _vec),
+    ("self_attn.v_proj.weight", "wv", _lin), ("self_attn.v_proj.bias", "bv", _vec),
+    ("self_attn.out_proj.weight", "wo", _lin), ("self_attn.out_proj.bias", "bo", _vec),
+    ("layer_norm2.weight", "ln2_s", _vec), ("layer_norm2.bias", "ln2_b", _vec),
+    ("mlp.fc1.weight", "w_fc1", _lin), ("mlp.fc1.bias", "b_fc1", _vec),
+    ("mlp.fc2.weight", "w_fc2", _lin), ("mlp.fc2.bias", "b_fc2", _vec),
+  ]
+  for i in range(L):
+    for hf_name, key, conv in name_map:
+      sd[f"text_model.encoder.layers.{i}.{hf_name}"] = conv(lp[key][i])
+  (out_dir / "text_encoder").mkdir(exist_ok=True)
+  save_file(sd, str(out_dir / "text_encoder" / "model.safetensors"))
+  (out_dir / "text_encoder" / "config.json").write_text(json.dumps({
+    "vocab_size": cfg.clip.vocab_size, "hidden_size": cfg.clip.hidden_size,
+    "intermediate_size": cfg.clip.intermediate_size, "num_hidden_layers": cfg.clip.n_layers,
+    "num_attention_heads": cfg.clip.n_heads, "max_position_embeddings": cfg.clip.max_positions,
+    "layer_norm_eps": cfg.clip.layer_norm_eps, "hidden_act": cfg.clip.act,
+  }))
+
+  # ---- unet
+  unet = params["unet"]
+  sd = {
+    "conv_in.weight": _conv_out(unet["conv_in_w"]), "conv_in.bias": _vec_out(unet["conv_in_b"]),
+    "time_embedding.linear_1.weight": _lin_out(unet["time_w1"]), "time_embedding.linear_1.bias": _vec_out(unet["time_b1"]),
+    "time_embedding.linear_2.weight": _lin_out(unet["time_w2"]), "time_embedding.linear_2.bias": _vec_out(unet["time_b2"]),
+    "conv_norm_out.weight": _vec_out(unet["norm_out_s"]), "conv_norm_out.bias": _vec_out(unet["norm_out_b"]),
+    "conv_out.weight": _conv_out(unet["conv_out_w"]), "conv_out.bias": _vec_out(unet["conv_out_b"]),
+  }
+  for li, blk in enumerate(unet["down"]):
+    for ri, rp in enumerate(blk["resnets"]):
+      _export_resnet(sd, f"down_blocks.{li}.resnets.{ri}", rp)
+    for ri, ap in enumerate(blk.get("attns", [])):
+      _export_tx(sd, f"down_blocks.{li}.attentions.{ri}", ap)
+    if "down_w" in blk:
+      sd[f"down_blocks.{li}.downsamplers.0.conv.weight"] = _conv_out(blk["down_w"])
+      sd[f"down_blocks.{li}.downsamplers.0.conv.bias"] = _vec_out(blk["down_b"])
+  _export_resnet(sd, "mid_block.resnets.0", unet["mid"]["resnet1"])
+  _export_tx(sd, "mid_block.attentions.0", unet["mid"]["attn"])
+  _export_resnet(sd, "mid_block.resnets.1", unet["mid"]["resnet2"])
+  for ui, blk in enumerate(unet["up"]):
+    for ri, rp in enumerate(blk["resnets"]):
+      _export_resnet(sd, f"up_blocks.{ui}.resnets.{ri}", rp)
+    for ri, ap in enumerate(blk.get("attns", [])):
+      _export_tx(sd, f"up_blocks.{ui}.attentions.{ri}", ap)
+    if "up_w" in blk:
+      sd[f"up_blocks.{ui}.upsamplers.0.conv.weight"] = _conv_out(blk["up_w"])
+      sd[f"up_blocks.{ui}.upsamplers.0.conv.bias"] = _vec_out(blk["up_b"])
+  (out_dir / "unet").mkdir(exist_ok=True)
+  save_file(sd, str(out_dir / "unet" / "diffusion_pytorch_model.safetensors"))
+  down_types = ["CrossAttnDownBlock2D" if c else "DownBlock2D" for c in cfg.unet.cross_levels]
+  (out_dir / "unet" / "config.json").write_text(json.dumps({
+    "in_channels": cfg.unet.in_channels, "out_channels": cfg.unet.out_channels,
+    "block_out_channels": list(cfg.unet.block_out_channels),
+    "layers_per_block": cfg.unet.layers_per_block,
+    "cross_attention_dim": cfg.unet.cross_attention_dim,
+    "attention_head_dim": cfg.unet.attention_head_dim,
+    "norm_num_groups": cfg.unet.norm_groups, "norm_eps": cfg.unet.norm_eps,
+    "down_block_types": down_types, "sample_size": cfg.sample_size,
+  }))
+
+  # ---- vae
+  vae = params["vae"]
+  sd = {
+    "quant_conv.weight": _conv_out(vae["quant_w"]), "quant_conv.bias": _vec_out(vae["quant_b"]),
+    "post_quant_conv.weight": _conv_out(vae["post_quant_w"]), "post_quant_conv.bias": _vec_out(vae["post_quant_b"]),
+  }
+  for side, half, key, sampler in (("encoder", vae["encoder"], "down", "downsamplers"),
+                                   ("decoder", vae["decoder"], "up", "upsamplers")):
+    sd[f"{side}.conv_in.weight"] = _conv_out(half["conv_in_w"]); sd[f"{side}.conv_in.bias"] = _vec_out(half["conv_in_b"])
+    _export_resnet(sd, f"{side}.mid_block.resnets.0", half["mid_resnet1"], with_time=False)
+    attn = half["mid_attn"]
+    ap = f"{side}.mid_block.attentions.0"
+    sd[f"{ap}.group_norm.weight"] = _vec_out(attn["norm_s"]); sd[f"{ap}.group_norm.bias"] = _vec_out(attn["norm_b"])
+    for nm, w, b in (("to_q", "wq", "bq"), ("to_k", "wk", "bk"), ("to_v", "wv", "bv"), ("to_out.0", "wo", "bo")):
+      sd[f"{ap}.{nm}.weight"] = _lin_out(attn[w]); sd[f"{ap}.{nm}.bias"] = _vec_out(attn[b])
+    _export_resnet(sd, f"{side}.mid_block.resnets.1", half["mid_resnet2"], with_time=False)
+    sd[f"{side}.conv_norm_out.weight"] = _vec_out(half["norm_out_s"]); sd[f"{side}.conv_norm_out.bias"] = _vec_out(half["norm_out_b"])
+    sd[f"{side}.conv_out.weight"] = _conv_out(half["conv_out_w"]); sd[f"{side}.conv_out.bias"] = _vec_out(half["conv_out_b"])
+    blocks_key = "down_blocks" if key == "down" else "up_blocks"
+    for li, blk in enumerate(half[key]):
+      pre = f"{side}.{blocks_key}.{li}"
+      for ri, rp in enumerate(blk["resnets"]):
+        _export_resnet(sd, f"{pre}.resnets.{ri}", rp, with_time=False)
+      wk = "down_w" if key == "down" else "up_w"
+      if wk in blk:
+        sd[f"{pre}.{sampler}.0.conv.weight"] = _conv_out(blk[wk])
+        sd[f"{pre}.{sampler}.0.conv.bias"] = _vec_out(blk[wk.replace("_w", "_b")])
+  (out_dir / "vae").mkdir(exist_ok=True)
+  save_file(sd, str(out_dir / "vae" / "diffusion_pytorch_model.safetensors"))
+  (out_dir / "vae" / "config.json").write_text(json.dumps({
+    "in_channels": cfg.vae.in_channels, "latent_channels": cfg.vae.latent_channels,
+    "block_out_channels": list(cfg.vae.block_out_channels),
+    "layers_per_block": cfg.vae.layers_per_block,
+    "norm_num_groups": cfg.vae.norm_groups, "scaling_factor": cfg.vae.scaling_factor,
+  }))
+
+  (out_dir / "scheduler").mkdir(exist_ok=True)
+  (out_dir / "scheduler" / "scheduler_config.json").write_text(json.dumps({
+    "prediction_type": cfg.prediction_type, "num_train_timesteps": cfg.num_train_timesteps,
+    "beta_start": cfg.beta_start, "beta_end": cfg.beta_end,
+    "beta_schedule": cfg.beta_schedule, "set_alpha_to_one": cfg.set_alpha_to_one,
+    "steps_offset": cfg.steps_offset,
+  }))
