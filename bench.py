@@ -472,6 +472,40 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — smaller-HBM devices: skip, don't abort the bench
       int8_8b_tok_s = None
 
+  # --- stable-diffusion UNet denoise step (round 4: the image path is real —
+  # models/diffusion.py). One classifier-free-guidance step at the SD2-base
+  # geometry (865M-param UNet, 64x64 latents, 77x1024 text ctx, bf16): batch
+  # 2 through the UNet per step, the MXU-bound core of image generation.
+  sd_unet_step_ms = None
+  try:
+    from xotorch_support_jetson_tpu.models.diffusion import (
+      DiffusionConfig,
+      alphas_cumprod as sd_alphas,
+      sample_chunk,
+      tiny_diffusion_config,
+    )
+    from xotorch_support_jetson_tpu.models.diffusion_loader import init_unet_params
+
+    sd_cfg = DiffusionConfig() if on_accel else tiny_diffusion_config()
+    sd_unet = init_unet_params(jax.random.PRNGKey(11), sd_cfg.unet)
+    if on_accel:
+      sd_unet = jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), sd_unet)
+    sd_lat = jnp.zeros((1, sd_cfg.sample_size, sd_cfg.sample_size, sd_cfg.unet.in_channels), jnp.bfloat16 if on_accel else jnp.float32)
+    sd_ctx = jnp.zeros((2, 77 if on_accel else 8, sd_cfg.unet.cross_attention_dim), sd_lat.dtype)
+    sd_a = np.asarray(sd_alphas(sd_cfg), np.float32)
+    n_sd = 8 if on_accel else 2
+    ts = np.linspace(900, 100, n_sd).astype(np.int32)
+    sd_args = (jnp.asarray(ts), jnp.asarray(sd_a[ts]), jnp.asarray(sd_a[np.clip(ts - 50, 0, None)]))
+    sd_fn = jax.jit(lambda p, lat, ctx, t, at, ap: sample_chunk(p, sd_cfg, lat, ctx, t, at, ap, guidance=7.5))
+    _ = np.asarray(sd_fn(sd_unet, sd_lat, sd_ctx, *sd_args))  # compile
+    t0 = time.perf_counter
+    start = t0()
+    _ = np.asarray(sd_fn(sd_unet, sd_lat, sd_ctx, *sd_args))
+    sd_unet_step_ms = round((t0() - start) * 1000.0 / n_sd, 2)
+    del sd_unet, sd_lat, sd_ctx
+  except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+    sd_unet_step_ms = None
+
   headline, gate_tripped = gate_headline(tok_per_s, serving_tok_s)
 
   vs_baseline = None
@@ -532,6 +566,7 @@ def main() -> None:
         "spec_peak_acceptance": spec_peak_acceptance,
         "spec_peak_vs_plain": spec_peak_vs_plain,
         "int8_8b_decode_tok_s": int8_8b_tok_s,
+        "sd_unet_step_ms": sd_unet_step_ms,
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,
         "pp_batched_aggregate_tok_s": pp_batched_tok_s,

@@ -91,6 +91,35 @@ def test_gqa_and_bias_variants():
   assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+def test_qk_norm_cached_decode_consistency():
+  """qwen3's per-head q/k RMSNorm (init creates q_norm/k_norm; _dense_qkv
+  applies them before rope): prefill + cached decode == cache-less forward,
+  and the norm actually changes the output."""
+  cfg = tiny_test_config(qk_norm=True, n_layers=2)
+  params, shard = full_model_params(KEY, cfg)
+  assert "q_norm" in params["layers"] and "k_norm" in params["layers"]
+  prompt = jnp.array([[5, 11, 42, 7]], dtype=jnp.int32)
+
+  cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
+  logits, cache = shard_forward(params, cfg, shard, prompt, _positions(1, 4), cache)
+  nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[None, :]
+  step_logits, _ = shard_forward(params, cfg, shard, nxt, _positions(1, 1, start=4), cache)
+
+  seq = jnp.concatenate([prompt, nxt], axis=1)
+  ref, _ = shard_forward(params, cfg, shard, seq, _positions(1, 5), None)
+  np.testing.assert_allclose(np.asarray(step_logits[:, 0, :]), np.asarray(ref[:, -1, :]), rtol=2e-4, atol=2e-4)
+
+  # a non-unit norm weight must change the logits (the flag is live)
+  import jax as _jax
+
+  bent = dict(params)
+  bent["layers"] = dict(params["layers"])
+  bent["layers"]["q_norm"] = params["layers"]["q_norm"] * 2.0
+  out_b, _ = shard_forward(bent, cfg, shard, prompt, _positions(1, 4), None)
+  out_a, _ = shard_forward(params, cfg, shard, prompt, _positions(1, 4), None)
+  assert not np.allclose(np.asarray(out_a), np.asarray(out_b))
+
+
 def test_tied_embedding_fallback():
   cfg = tiny_test_config(tied_embedding=True)
   params, shard = full_model_params(KEY, cfg)

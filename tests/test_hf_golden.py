@@ -77,6 +77,42 @@ def _save_tiny_hf(tmp_path, family: str):
       tie_word_embeddings=True,
       torch_dtype="float32",
     )
+  elif family == "qwen3":
+    cfg = AutoConfig.for_model(
+      "qwen3",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=160,
+      num_hidden_layers=3,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      head_dim=16,
+      rms_norm_eps=1e-5,
+      rope_theta=1000000.0,
+      tie_word_embeddings=True,
+      torch_dtype="float32",
+    )
+  elif family == "qwen3-moe":
+    cfg = AutoConfig.for_model(
+      "qwen3_moe",
+      vocab_size=128,
+      hidden_size=64,
+      intermediate_size=96,
+      moe_intermediate_size=48,
+      num_hidden_layers=2,
+      num_attention_heads=4,
+      num_key_value_heads=2,
+      head_dim=16,
+      num_experts=4,
+      num_experts_per_tok=2,
+      decoder_sparse_step=1,
+      norm_topk_prob=True,
+      mlp_only_layers=[],
+      rms_norm_eps=1e-5,
+      rope_theta=1000000.0,
+      tie_word_embeddings=False,
+      torch_dtype="float32",
+    )
   elif family == "mistral":
     cfg = AutoConfig.for_model(
       "mistral",
@@ -263,6 +299,8 @@ def _save_tiny_hf(tmp_path, family: str):
     "llama",
     "llama3-scaled",
     "qwen2",
+    "qwen3",
+    "qwen3-moe",
     "mistral",
     "mixtral",
     "qwen2-moe",

@@ -590,7 +590,12 @@ class ChatGPTAPI:
     strength.
     """
     try:
-      data = await request.json()
+      # The timeout middleware exempts this route so the STREAMING phase can
+      # outlive response_timeout — but the body read must stay bounded or a
+      # slow-loris client holds the connection forever.
+      data = await asyncio.wait_for(request.json(), timeout=30)
+    except asyncio.TimeoutError:
+      return web.json_response({"error": "request body read timed out"}, status=408)
     except Exception:  # noqa: BLE001 — same contract as the chat endpoints
       return web.json_response({"error": "invalid JSON body"}, status=400)
     model = data.get("model", "")
@@ -622,8 +627,11 @@ class ChatGPTAPI:
         size=tuple(int(v) for v in data["size"]) if data.get("size") else None,
         strength=float(data.get("strength", 0.8)),
       )
-      if gen_kwargs["size"] is not None and len(gen_kwargs["size"]) != 2:
-        raise ValueError("size must be [height, width]")
+      if gen_kwargs["size"] is not None:
+        if len(gen_kwargs["size"]) != 2:
+          raise ValueError("size must be [height, width]")
+        if not all(8 <= v <= 2048 for v in gen_kwargs["size"]):
+          raise ValueError("size dims must be in [8, 2048]")
       if not 1 <= gen_kwargs["steps"] <= 1000:
         raise ValueError("steps must be in [1, 1000]")
     except (TypeError, ValueError) as e:
@@ -653,6 +661,7 @@ class ChatGPTAPI:
         cancel_event=cancel_event, **gen_kwargs,
       )
     )
+    get_q = None  # tracked outside the loop so EVERY exit path can cancel it
     try:
       while True:
         get_q = asyncio.create_task(progress_q.get())
@@ -711,6 +720,11 @@ class ChatGPTAPI:
       except (ConnectionError, RuntimeError):
         pass  # client is gone; nothing to tell them
       return response
+    finally:
+      # The pending progress_q.get() would otherwise linger un-awaited and
+      # log "Task was destroyed but it is pending!" on every disconnect.
+      if get_q is not None and not get_q.done():
+        get_q.cancel()
 
   @staticmethod
   def _decode_image_b64(image_url: str):
@@ -727,8 +741,11 @@ class ChatGPTAPI:
     payload = image_url.split(",", 1)[1] if image_url.startswith("data:") else image_url
     img = Image.open(io.BytesIO(base64.b64decode(payload))).convert("RGB")
     w, h = img.size
+    if max(w, h) > 2048:  # cap like explicit sizes — one request must not OOM the worker
+      scale = 2048 / max(w, h)
+      w, h = max(int(w * scale), 8), max(int(h * scale), 8)
     w8, h8 = max(w // 8 * 8, 8), max(h // 8 * 8, 8)
-    if (w8, h8) != (w, h):
+    if (w8, h8) != img.size:
       img = img.resize((w8, h8))
     return np.asarray(img, dtype=np.uint8)
 

@@ -76,6 +76,7 @@ class ModelConfig:
   rope_scaling: RopeScaling | YarnScaling | LongRopeScaling | None = None
   max_seq_len: int = 8192
   qkv_bias: bool = False  # qwen2 uses attention biases
+  qk_norm: bool = False  # qwen3: per-head RMSNorm on q and k before rope
   attn_out_bias: bool = False
   partial_rotary_factor: float = 1.0  # phi3/phi-4: rope only the leading channels
   tied_embedding: bool = False
@@ -206,7 +207,11 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
   arch = (hf.get("architectures") or [""])[0].lower()
   model_type = hf.get("model_type", "").lower()
   family = "llama"
-  if "qwen2_moe" in model_type or "qwen2moe" in arch:
+  if "qwen3_moe" in model_type or "qwen3moe" in arch:
+    family = "qwen3-moe"
+  elif "qwen3" in model_type or "qwen3" in arch:
+    family = "qwen3"
+  elif "qwen2_moe" in model_type or "qwen2moe" in arch:
     family = "qwen2-moe"
   elif "qwen2" in model_type or "qwen2" in arch:
     family = "qwen2"
@@ -356,6 +361,7 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     rope_scaling=rope_scaling,
     max_seq_len=int(hf.get("max_position_embeddings", 8192)),
     qkv_bias=family in ("qwen2", "qwen2-moe") or bool(hf.get("attention_bias", False)),
+    qk_norm=family in ("qwen3", "qwen3-moe"),
     partial_rotary_factor=float(hf.get("partial_rotary_factor", 1.0)),
     tied_embedding=bool(hf.get("tie_word_embeddings", family in ("gemma2",) or (family == "qwen2" and int(hf["hidden_size"]) < 2048))),
     family=family,

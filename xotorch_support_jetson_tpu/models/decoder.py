@@ -143,6 +143,9 @@ def init_shard_params(key: jax.Array, cfg: ModelConfig, shard: Shard, dtype=None
       leaves["bq"] = jnp.zeros((L, Qd), dtype=dtype)
       leaves["bk"] = jnp.zeros((L, Kd), dtype=dtype)
       leaves["bv"] = jnp.zeros((L, Kd), dtype=dtype)
+    if cfg.qk_norm:  # qwen3 per-head q/k RMSNorm weights [hd]
+      leaves["q_norm"] = jnp.ones((L, cfg.head_dim), dtype=dtype)
+      leaves["k_norm"] = jnp.ones((L, cfg.head_dim), dtype=dtype)
     return leaves
 
   def dense_stack(L):
@@ -276,6 +279,9 @@ def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
   q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
   k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
   v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+  if "q_norm" in p:  # qwen3: per-head RMSNorm on q/k before rope
+    q = rms_norm(q, p["q_norm"], cfg.norm_eps)
+    k = rms_norm(k, p["k_norm"], cfg.norm_eps)
   m = rope_attention_factor(cfg)
   q = apply_rope(q, positions, inv_freq, m)
   k = apply_rope(k, positions, inv_freq, m)

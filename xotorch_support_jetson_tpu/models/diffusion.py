@@ -62,11 +62,21 @@ class UNetConfig:
   block_out_channels: tuple[int, ...] = (320, 640, 1280, 1280)
   layers_per_block: int = 2
   cross_attention_dim: int = 1024
-  attention_head_dim: int = 64  # SD2: heads = channels // 64
+  attention_head_dim: int = 64  # per-head WIDTH: heads = channels // this
+  # Per-level head COUNTS — overrides attention_head_dim when set. diffusers
+  # configs' "attention_head_dim" is historically the head COUNT (scalar 8 on
+  # SD1, [5,10,20,20] on SD2 — see UNet2DConditionModel's num_attention_heads
+  # fallback); the loader maps that semantics onto this field.
+  attn_heads: tuple[int, ...] | None = None
   norm_groups: int = 32
   norm_eps: float = 1e-5
   # which levels carry cross-attention transformers (SD: all but the last)
   cross_levels: tuple[bool, ...] = (True, True, True, False)
+
+  def heads_at(self, level: int) -> int:
+    if self.attn_heads is not None:
+      return self.attn_heads[level]
+    return max(1, self.block_out_channels[level] // self.attention_head_dim)
 
 
 @dataclass(frozen=True)
@@ -257,8 +267,7 @@ def unet_apply(params: Params, cfg: UNetConfig, latents: jnp.ndarray, t: jnp.nda
   skips = [x]
 
   for li, blk in enumerate(params["down"]):
-    ch = cfg.block_out_channels[li]
-    heads = max(1, ch // cfg.attention_head_dim)
+    heads = cfg.heads_at(li)
     for ri, rp in enumerate(blk["resnets"]):
       x = _resnet(x, temb, rp, cfg.norm_groups, cfg.norm_eps)
       if cfg.cross_levels[li]:
@@ -269,7 +278,7 @@ def unet_apply(params: Params, cfg: UNetConfig, latents: jnp.ndarray, t: jnp.nda
       skips.append(x)
 
   mid = params["mid"]
-  mid_heads = max(1, cfg.block_out_channels[-1] // cfg.attention_head_dim)
+  mid_heads = cfg.heads_at(len(cfg.block_out_channels) - 1)
   x = _resnet(x, temb, mid["resnet1"], cfg.norm_groups, cfg.norm_eps)
   if "attn" in mid:
     x = _transformer_block(x, ctx, mid["attn"], mid_heads, cfg.norm_groups)
@@ -278,8 +287,7 @@ def unet_apply(params: Params, cfg: UNetConfig, latents: jnp.ndarray, t: jnp.nda
   n_levels = len(cfg.block_out_channels)
   for ui, blk in enumerate(params["up"]):
     li = n_levels - 1 - ui
-    ch = cfg.block_out_channels[li]
-    heads = max(1, ch // cfg.attention_head_dim)
+    heads = cfg.heads_at(li)
     for ri, rp in enumerate(blk["resnets"]):
       x = jnp.concatenate([x, skips.pop()], axis=-1)
       x = _resnet(x, temb, rp, cfg.norm_groups, cfg.norm_eps)

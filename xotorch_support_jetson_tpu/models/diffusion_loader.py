@@ -490,15 +490,18 @@ def diffusion_config_from_dir(model_dir: Path) -> DiffusionConfig:
   va = read("vae/config.json")
   sc = read("scheduler/scheduler_config.json")
 
-  n_levels = len(un.get("block_out_channels", (320, 640, 1280, 1280)))
+  chans = tuple(un.get("block_out_channels", (320, 640, 1280, 1280)))
+  n_levels = len(chans)
   down_types = un.get("down_block_types", ["CrossAttnDownBlock2D"] * (n_levels - 1) + ["DownBlock2D"])
-  head_dim = un.get("attention_head_dim", 64)
-  if isinstance(head_dim, (list, tuple)):
-    # per-level head counts (SD1 style [8,8,8,8] are heads; SD2 [5,10,20,20]
-    # are heads too) — convert to a uniform per-head width when possible
-    chans = un.get("block_out_channels", (320, 640, 1280, 1280))
-    widths = {c // h for c, h in zip(chans, head_dim)}
-    head_dim = widths.pop() if len(widths) == 1 else 64
+  # diffusers semantics: num_attention_heads wins; otherwise the misnamed
+  # attention_head_dim IS the head count (scalar 8 on SD1 ⇒ 8 heads at every
+  # level with per-level widths 40/80/160/160; [5,10,20,20] on SD2 ⇒ uniform
+  # 64-wide heads). See UNet2DConditionModel's num_attention_heads fallback.
+  heads = un.get("num_attention_heads") or un.get("attention_head_dim", 64)
+  if isinstance(heads, (list, tuple)):
+    attn_heads = tuple(int(h) for h in heads)
+  else:
+    attn_heads = (int(heads),) * n_levels
   return DiffusionConfig(
     clip=ClipTextConfig(
       vocab_size=te.get("vocab_size", 49408),
@@ -516,7 +519,7 @@ def diffusion_config_from_dir(model_dir: Path) -> DiffusionConfig:
       block_out_channels=tuple(un.get("block_out_channels", (320, 640, 1280, 1280))),
       layers_per_block=un.get("layers_per_block", 2),
       cross_attention_dim=un.get("cross_attention_dim", 1024),
-      attention_head_dim=int(head_dim),
+      attn_heads=attn_heads,
       norm_groups=un.get("norm_num_groups", 32),
       norm_eps=un.get("norm_eps", 1e-5),
       cross_levels=tuple(t != "DownBlock2D" for t in down_types),
@@ -667,7 +670,9 @@ def export_diffusers_checkpoint(out_dir: Path, cfg, params) -> None:
     "block_out_channels": list(cfg.unet.block_out_channels),
     "layers_per_block": cfg.unet.layers_per_block,
     "cross_attention_dim": cfg.unet.cross_attention_dim,
-    "attention_head_dim": cfg.unet.attention_head_dim,
+    # emit explicit per-level head counts — immune to the attention_head_dim
+    # naming ambiguity the reader has to special-case for published configs
+    "num_attention_heads": [cfg.unet.heads_at(i) for i in range(len(cfg.unet.block_out_channels))],
     "norm_num_groups": cfg.unet.norm_groups, "norm_eps": cfg.unet.norm_eps,
     "down_block_types": down_types, "sample_size": cfg.sample_size,
   }))

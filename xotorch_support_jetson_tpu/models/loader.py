@@ -42,6 +42,9 @@ _LAYER_MAP: dict[str, tuple[str, bool]] = {
   "self_attn.q_proj.bias": ("bq", False),
   "self_attn.k_proj.bias": ("bk", False),
   "self_attn.v_proj.bias": ("bv", False),
+  # qwen3: per-head RMSNorm on q/k (weights [head_dim], applied before rope)
+  "self_attn.q_norm.weight": ("q_norm", False),
+  "self_attn.k_norm.weight": ("k_norm", False),
   # MLA projections (deepseek-v2/v3, HF DeepseekV2Attention): q optionally
   # LoRA-compressed; KV compressed to a latent + MQA rope channel.
   "self_attn.q_a_proj.weight": ("wq_a", True),

@@ -80,6 +80,17 @@ _CARDS: list[ModelCard] = [
   _card("qwen-2.5-coder-32b", 64, "Qwen 2.5 Coder 32B", "qwen2", "Qwen/Qwen2.5-Coder-32B-Instruct"),
   _card("qwen-2.5-72b", 80, "Qwen 2.5 72B", "qwen2", "Qwen/Qwen2.5-72B-Instruct"),
   _card("qwen-2.5-math-72b", 80, "Qwen 2.5 72B (Math)", "qwen2", "Qwen/Qwen2.5-Math-72B-Instruct"),
+  # qwen 3 — beyond reference parity (the reference predates the family):
+  # per-head q/k RMSNorm rides the shared decoder (models/decoder.py
+  # _dense_qkv), golden-verified vs HF Qwen3ForCausalLM
+  _card("qwen-3-0.6b", 28, "Qwen 3 0.6B", "qwen3", "Qwen/Qwen3-0.6B"),
+  _card("qwen-3-1.7b", 28, "Qwen 3 1.7B", "qwen3", "Qwen/Qwen3-1.7B"),
+  _card("qwen-3-4b", 36, "Qwen 3 4B", "qwen3", "Qwen/Qwen3-4B"),
+  _card("qwen-3-8b", 36, "Qwen 3 8B", "qwen3", "Qwen/Qwen3-8B"),
+  _card("qwen-3-14b", 40, "Qwen 3 14B", "qwen3", "Qwen/Qwen3-14B"),
+  _card("qwen-3-32b", 64, "Qwen 3 32B", "qwen3", "Qwen/Qwen3-32B"),
+  _card("qwen-3-30b-a3b", 48, "Qwen 3 30B-A3B (MoE)", "qwen3-moe", "Qwen/Qwen3-30B-A3B"),
+  _card("qwen-3-235b-a22b", 94, "Qwen 3 235B-A22B (MoE)", "qwen3-moe", "Qwen/Qwen3-235B-A22B"),
   # nemotron
   _card("nemotron-70b", 80, "Nemotron 70B", "llama", "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF"),
   # phi
