@@ -110,8 +110,6 @@ def test_qk_norm_cached_decode_consistency():
   np.testing.assert_allclose(np.asarray(step_logits[:, 0, :]), np.asarray(ref[:, -1, :]), rtol=2e-4, atol=2e-4)
 
   # a non-unit norm weight must change the logits (the flag is live)
-  import jax as _jax
-
   bent = dict(params)
   bent["layers"] = dict(params["layers"])
   bent["layers"]["q_norm"] = params["layers"]["q_norm"] * 2.0

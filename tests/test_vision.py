@@ -135,3 +135,74 @@ def test_engine_multimodal_prefill_and_decode(tmp_path):
     assert np.all(np.isfinite(out2))
 
   asyncio.run(run())
+
+
+def _save_tiny_llava_next(tmp_path, img_hw):
+  """Tiny LlavaNextForConditionalGeneration; returns (input_ids, pixel_values,
+  image_sizes, ref_logits). Placeholder count comes from HF's OWN packing
+  (get_image_features), so the expected length is computed independently of
+  this repo's implementation."""
+  import torch
+  from transformers import CLIPVisionConfig, LlamaConfig, LlavaNextConfig, LlavaNextForConditionalGeneration
+
+  torch.manual_seed(0)
+  vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=3, num_attention_heads=4, image_size=28, patch_size=14)
+  tc = LlamaConfig(
+    vocab_size=128, hidden_size=48, intermediate_size=96, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, rms_norm_eps=1e-5,
+    rope_theta=10000.0, tie_word_embeddings=False,
+  )
+  cfg = LlavaNextConfig(vision_config=vc, text_config=tc, image_token_index=IMAGE_TOKEN, image_grid_pinpoints=[[56, 56]])
+  model = LlavaNextForConditionalGeneration(cfg).to(torch.float32).eval()
+  model.save_pretrained(tmp_path, safe_serialization=True)
+
+  h, w = img_hw
+  image_sizes = torch.tensor([[h, w]])
+  # anyres tile count for the [[56,56]] pinpoint: 1 base + 2x2 grid = 5 tiles
+  pixel_values = torch.randn(1, 5, 3, 28, 28)
+  with torch.no_grad():
+    feats = model.get_image_features(pixel_values=pixel_values, image_sizes=image_sizes, vision_feature_layer=-2, vision_feature_select_strategy="default")
+    n_tokens = feats[0].shape[0]
+    input_ids = torch.tensor([[1] + [IMAGE_TOKEN] * n_tokens + [5, 9, 2]])
+    ref = model(input_ids=input_ids, pixel_values=pixel_values, image_sizes=image_sizes).logits.numpy()
+  return np.asarray(input_ids.numpy()), pixel_values.numpy(), (h, w), ref, n_tokens
+
+
+def _run_llava_next(tmp_path, img_hw):
+  from xotorch_support_jetson_tpu.models.vision import anyres_grid_shape, pack_anyres_features
+
+  tokens_np, pixels_np, osize, ref_logits, n_tokens = _save_tiny_llava_next(tmp_path, img_hw)
+
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  assert cfg.vision is not None and cfg.vision.anyres
+  assert cfg.vision.grid_pinpoints == ((56, 56),)
+
+  shard = Shard("tiny-llava-next", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+  assert "image_newline" in params["projector"]
+
+  gh, gw = anyres_grid_shape(osize, cfg.vision.grid_pinpoints, cfg.vision.image_size)
+  tiles = jnp.asarray(pixels_np[0, : 1 + gh * gw])
+  tile_feats = encode_images(params["vision"], params["projector"], cfg.vision, tiles)
+  packed = pack_anyres_features(tile_feats, osize, cfg.vision, params["projector"]["image_newline"])
+  assert packed.shape[0] == n_tokens, f"packed {packed.shape[0]} != HF {n_tokens}"
+
+  tokens = jnp.asarray(tokens_np, dtype=jnp.int32)
+  embeds = jnp.take(params["embed"], tokens, axis=0)
+  merged = merge_image_embeddings(embeds, tokens, packed[None], cfg.image_token_id)
+  positions = jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+  logits, _ = shard_forward(params, cfg, shard, merged, positions, None)
+  np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_llava_next_golden_square(tmp_path):
+  """Exact-aspect image: unpad is a no-op; 1 base + 2x2 grid tiles with a
+  newline per feature row. Token-exact vs HF LlavaNextForConditionalGeneration."""
+  _run_llava_next(tmp_path, (56, 56))
+
+
+def test_llava_next_golden_unpadded_wide(tmp_path):
+  """2:1 image on a square pinpoint: the aspect-preserving resize pads
+  vertically, and packing must CROP those feature rows (HF unpad_image) —
+  the case that distinguishes anyres from naive tiling."""
+  _run_llava_next(tmp_path, (28, 56))

@@ -630,13 +630,40 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # (inference/tokenizers.py) — it expands each <image> into n_patches
     # placeholder ids and normalizes pixels to the CLIP layout.
     proc = self.tokenizer
-    out = proc(text=prompt, images=pil_images, return_tensors="np")
+    try:
+      out = proc(text=prompt, images=pil_images, return_tensors="np")
+    except StopIteration:
+      # HF processors raise bare StopIteration on a placeholder/image count
+      # mismatch — inside run_in_executor that surfaces as an opaque
+      # RuntimeError; turn it into an actionable client error instead.
+      raise ValueError(
+        f"prompt has more <image> placeholders than attached images ({len(pil_images)}); "
+        "the API inserts one per image_url part — don't also write <image> in the text"
+      ) from None
     tokens = np.asarray(out["input_ids"], dtype=np.int32)
     pixel_values = np.asarray(out["pixel_values"], dtype=np.float32)
     B, S = tokens.shape
 
     vp = self._vision_leaves()
-    feats = encode_images(vp["vision"], vp["projector"], self.cfg.vision, jnp.asarray(pixel_values))
+    if pixel_values.ndim == 5:
+      # llava-next anyres: [n_images, tiles, 3, H, W] + per-image original
+      # sizes. Each image's tiles batch through the tower in one dispatch;
+      # packing (spatial re-assembly + unpad + newline) is host bookkeeping
+      # (models/vision.py pack_anyres_features).
+      from ..models.vision import anyres_grid_shape, pack_anyres_features
+
+      image_sizes = np.asarray(out["image_sizes"], dtype=np.int64)
+      newline = vp["projector"]["image_newline"]
+      packed = []
+      for i in range(pixel_values.shape[0]):
+        osize = (int(image_sizes[i][0]), int(image_sizes[i][1]))
+        gh, gw = anyres_grid_shape(osize, self.cfg.vision.grid_pinpoints, self.cfg.vision.image_size)
+        tiles = jnp.asarray(pixel_values[i, : 1 + gh * gw])
+        tile_feats = encode_images(vp["vision"], vp["projector"], self.cfg.vision, tiles)
+        packed.append(pack_anyres_features(tile_feats, osize, self.cfg.vision, newline))
+      feats = jnp.concatenate(packed, axis=0)[None]  # [1, total, D]
+    else:
+      feats = encode_images(vp["vision"], vp["projector"], self.cfg.vision, jnp.asarray(pixel_values))
     pad_to = min(_round_up(S, PREFILL_BUCKET), min(self.max_seq_len, self.cfg.max_seq_len))
     tok_pad = np.zeros((B, pad_to), dtype=np.int32)
     tok_pad[:, :S] = tokens

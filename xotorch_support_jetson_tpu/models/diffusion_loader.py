@@ -497,7 +497,7 @@ def diffusion_config_from_dir(model_dir: Path) -> DiffusionConfig:
   # attention_head_dim IS the head count (scalar 8 on SD1 ⇒ 8 heads at every
   # level with per-level widths 40/80/160/160; [5,10,20,20] on SD2 ⇒ uniform
   # 64-wide heads). See UNet2DConditionModel's num_attention_heads fallback.
-  heads = un.get("num_attention_heads") or un.get("attention_head_dim", 64)
+  heads = un.get("num_attention_heads") or un.get("attention_head_dim", 8)  # diffusers' signature default: 8 heads
   if isinstance(heads, (list, tuple)):
     attn_heads = tuple(int(h) for h in heads)
   else:
@@ -670,9 +670,11 @@ def export_diffusers_checkpoint(out_dir: Path, cfg, params) -> None:
     "block_out_channels": list(cfg.unet.block_out_channels),
     "layers_per_block": cfg.unet.layers_per_block,
     "cross_attention_dim": cfg.unet.cross_attention_dim,
-    # emit explicit per-level head counts — immune to the attention_head_dim
-    # naming ambiguity the reader has to special-case for published configs
-    "num_attention_heads": [cfg.unet.heads_at(i) for i in range(len(cfg.unet.block_out_channels))],
+    # per-level head counts under the key diffusers actually accepts:
+    # UNet2DConditionModel REJECTS num_attention_heads (its issue-2011
+    # naming guard), so interop requires the misnamed attention_head_dim,
+    # whose list/scalar value diffusers treats as head counts.
+    "attention_head_dim": [cfg.unet.heads_at(i) for i in range(len(cfg.unet.block_out_channels))],
     "norm_num_groups": cfg.unet.norm_groups, "norm_eps": cfg.unet.norm_eps,
     "down_block_types": down_types, "sample_size": cfg.sample_size,
   }))

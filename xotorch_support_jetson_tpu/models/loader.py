@@ -165,7 +165,7 @@ def _weight_files_for_shard(model_dir: Path, shard: Shard) -> list[Path]:
       needed.add(fname)
     elif (name.startswith("model.norm") or name.startswith("lm_head")) and shard.is_last_layer:
       needed.add(fname)
-    elif raw_name.startswith(("vision_tower.", "multi_modal_projector.")) and shard.is_first_layer:
+    elif (raw_name.startswith(("vision_tower.", "multi_modal_projector.")) or raw_name == "image_newline") and shard.is_first_layer:
       needed.add(fname)
   return [model_dir / f for f in sorted(needed)]
 
@@ -185,6 +185,10 @@ def load_shard_weights(model_dir: str | Path, cfg: ModelConfig, shard: Shard) ->
     with safe_open(str(file), framework="pt") as f:
       for raw_name in f.keys():
         name = _normalize_name(raw_name)
+        if raw_name == "image_newline":  # llava-next: learned row terminator
+          if shard.is_first_layer and cfg.vision is not None:
+            projector["image_newline"] = _to_numpy(f.get_tensor(raw_name))
+          continue
         if raw_name.startswith(("vision_tower.", "multi_modal_projector.")):
           # llava vision tower + projector ride with the FIRST shard (the
           # node that embeds the prompt also embeds the images).
@@ -351,6 +355,10 @@ def check_shard_params(params: Params, cfg: ModelConfig, shard: Shard) -> None:
     }
     if cfg.qkv_bias:
       exp.update({"bq": (L, cfg.q_dim), "bk": (L, cfg.kv_dim), "bv": (L, cfg.kv_dim)})
+    if cfg.qk_norm:  # qwen3: the decoder gates on key presence, so a missing
+      # q/k norm must fail HERE, not silently skip the norm
+      exp["q_norm"] = (L, cfg.head_dim)
+      exp["k_norm"] = (L, cfg.head_dim)
     if cfg.post_norms:  # gemma2: the decoder gates on key presence, so a
       # missing post-norm must fail HERE, not silently skip the norm.
       exp["post_attn_norm"] = (L, cfg.dim)

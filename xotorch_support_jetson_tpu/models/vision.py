@@ -39,6 +39,11 @@ class VisionConfig:
   feature_layer: int = -2  # HF vision_feature_layer
   drop_cls: bool = True  # vision_feature_select_strategy == "default"
   projector_dim: int = 0  # text embedding width
+  # llava-next (1.6) anyres tiling: the image is resized onto the best grid
+  # pinpoint, split into image_size tiles, and the per-tile features are
+  # re-assembled spatially with unpadding + a learned newline per row.
+  anyres: bool = False
+  grid_pinpoints: tuple[tuple[int, int], ...] = ()
 
   @property
   def n_patches(self) -> int:
@@ -58,6 +63,8 @@ def vision_config_from_hf(vision_hf: dict, text_dim: int, top: dict | None = Non
     feature_layer=int(top.get("vision_feature_layer", -2)),
     drop_cls=top.get("vision_feature_select_strategy", "default") == "default",
     projector_dim=text_dim,
+    anyres=top.get("model_type") == "llava_next" or bool(top.get("image_grid_pinpoints")),
+    grid_pinpoints=tuple(tuple(int(v) for v in p) for p in top.get("image_grid_pinpoints") or ()),
   )
 
 
@@ -188,3 +195,66 @@ def init_vision_params(key: jax.Array, vcfg: VisionConfig, dtype=jnp.float32) ->
     "b2": jnp.zeros((vcfg.projector_dim,), dtype),
   }
   return vision, projector
+
+
+# ------------------------------------------------------- llava-next anyres
+# Parity target: HF LlavaNextForConditionalGeneration.pack_image_features +
+# its select_best_resolution / get_anyres_image_grid_shape / unpad_image
+# helpers — verified by golden test (tests/test_vision.py llava-next cases).
+# All of this is small host-side bookkeeping; the tile batch through the
+# tower (encode_images) is the device work.
+
+
+def select_best_resolution(original_size: tuple[int, int], pinpoints) -> tuple[int, int]:
+  """(h, w) → the grid pinpoint with max effective then min wasted pixels."""
+  oh, ow = original_size
+  best, best_fit, min_waste = None, -1, None
+  for height, width in pinpoints:
+    scale = min(width / ow, height / oh)
+    dw, dh = int(ow * scale), int(oh * scale)
+    effective = min(dw * dh, ow * oh)
+    wasted = width * height - effective
+    if effective > best_fit or (effective == best_fit and (min_waste is None or wasted < min_waste)):
+      best, best_fit, min_waste = (height, width), effective, wasted
+  return best
+
+
+def anyres_grid_shape(original_size: tuple[int, int], pinpoints, tile_size: int) -> tuple[int, int]:
+  """→ (tiles_h, tiles_w) of the selected pinpoint canvas."""
+  bh, bw = select_best_resolution(original_size, pinpoints)
+  return bh // tile_size, bw // tile_size
+
+
+def _unpad_grid(grid: jnp.ndarray, original_size: tuple[int, int]) -> jnp.ndarray:
+  """grid [H, W, D]: crop the padding the aspect-preserving resize added."""
+  oh, ow = original_size
+  ch, cw = grid.shape[0], grid.shape[1]
+  original_aspect = ow / oh
+  current_aspect = cw / ch
+  if original_aspect > current_aspect:
+    new_h = int(round(oh * (cw / ow), 7))
+    pad = (ch - new_h) // 2
+    return grid[pad : ch - pad, :, :]
+  new_w = int(round(ow * (ch / oh), 7))
+  pad = (cw - new_w) // 2
+  return grid[:, pad : cw - pad, :]
+
+
+def pack_anyres_features(
+  tile_feats: jnp.ndarray,
+  original_size: tuple[int, int],
+  vcfg: VisionConfig,
+  image_newline: jnp.ndarray,
+) -> jnp.ndarray:
+  """tile_feats [T, P, D] (T = 1 base tile + grid tiles, P = patches/tile)
+  → packed [n, D]: base features, then the unpadded spatial grid with a
+  newline feature terminating each row."""
+  p = vcfg.image_size // vcfg.patch_size
+  d = tile_feats.shape[-1]
+  base = tile_feats[0]
+  gh, gw = anyres_grid_shape(original_size, vcfg.grid_pinpoints, vcfg.image_size)
+  grid = tile_feats[1 : 1 + gh * gw].reshape(gh, gw, p, p, d).transpose(0, 2, 1, 3, 4).reshape(gh * p, gw * p, d)
+  grid = _unpad_grid(grid, original_size)
+  newline_col = jnp.broadcast_to(image_newline.astype(grid.dtype), (grid.shape[0], 1, d))
+  grid = jnp.concatenate([grid, newline_col], axis=1)
+  return jnp.concatenate([base, grid.reshape(-1, d)], axis=0)

@@ -66,6 +66,10 @@ _CARDS: list[ModelCard] = [
   _card("deepseek-r1-distill-llama-70b", 80, "DeepSeek R1 Distill Llama 70B", "llama", "unsloth/DeepSeek-R1-Distill-Llama-70B"),
   # llava (vision)
   _card("llava-1.5-7b-hf", 32, "LLaVa 1.5 7B (Vision Model)", "llava", "llava-hf/llava-1.5-7b-hf"),
+  # llava-next (1.6) — anyres tiling (models/vision.py pack_anyres_features);
+  # beyond reference parity (its llava entry can't even run the 1.5 tower)
+  _card("llava-1.6-vicuna-7b", 32, "LLaVa 1.6 Vicuna 7B (Vision Model)", "llava", "llava-hf/llava-v1.6-vicuna-7b-hf"),
+  _card("llava-1.6-mistral-7b", 32, "LLaVa 1.6 Mistral 7B (Vision Model)", "llava", "llava-hf/llava-v1.6-mistral-7b-hf"),
   # qwen 2.5
   _card("qwen-2.5-0.5b", 24, "Qwen 2.5 0.5B", "qwen2", "unsloth/Qwen2.5-0.5B-Instruct"),
   _card("qwen-2.5-1.5b", 28, "Qwen 2.5 1.5B", "qwen2", "unsloth/Qwen2.5-1.5B-Instruct"),
