@@ -391,3 +391,34 @@ def test_sd_download_patterns_skip_monolithic_checkpoints():
   # text models keep the bare-safetensors fallback
   llama = get_allow_patterns(None, Shard("llama-3.2-1b", 0, 15, 16))
   assert "*.safetensors" in llama
+
+
+def test_pipeline_n_candidates(params):
+  """n>1 denoises as one batch; candidates differ (per-candidate noise) and
+  n=1 output equals the first... of nothing — n=1 keeps the 3-D contract."""
+  pipe = DiffusionPipeline(CFG, params, dtype=jnp.float32)
+  batch = pipe.generate("cubes", steps=4, seed=11, n=3)
+  assert batch.shape == (3, 16, 16, 3) and batch.dtype == np.uint8
+  assert not np.array_equal(batch[0], batch[1])
+  single = pipe.generate("cubes", steps=4, seed=11)
+  assert single.shape == (16, 16, 3)
+
+
+def test_sd1_style_geometry_runs():
+  """SD1-family layout: per-level head COUNTS (attn_heads), quick_gelu CLIP,
+  v-prediction scheduler — the variant axes a real 1.5 checkpoint exercises."""
+  from dataclasses import replace
+
+  base = tiny_diffusion_config()
+  cfg = replace(
+    base,
+    clip=ClipTextConfig(**{**base.clip.__dict__, "act": "quick_gelu"}),
+    unet=replace(base.unet, attn_heads=(2, 2), attention_head_dim=999),  # head counts win
+    prediction_type="v_prediction",
+  )
+  assert cfg.unet.heads_at(0) == 2 and cfg.unet.heads_at(1) == 2
+  params = init_diffusion_params(jax.random.PRNGKey(31), cfg)
+  pipe = DiffusionPipeline(cfg, params, dtype=jnp.float32)
+  img = pipe.generate("a cube", steps=4, seed=2)
+  assert img.shape == (16, 16, 3)
+  assert np.isfinite(img.astype(np.float32)).all()

@@ -153,3 +153,55 @@ async def test_image_generation_bad_image_url_is_400():
   finally:
     await client.close()
     await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_openai_images_alias_url_and_b64():
+  """/v1/images/generations (plural) — the OpenAI Images API shape the
+  reference never had: blocking {created, data:[{url}|{b64_json}]}."""
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    resp = await client.post("/v1/images/generations", json={"prompt": "a cube", "n": 2, "steps": 4})
+    assert resp.status == 200
+    body = await resp.json()
+    assert "created" in body and len(body["data"]) == 2
+    for entry in body["data"]:
+      png = await client.get(entry["url"][entry["url"].index("/images/"):])
+      assert png.status == 200
+
+    resp = await client.post("/v1/images/generations", json={"prompt": "a cube", "response_format": "b64_json", "steps": 3})
+    body = await resp.json()
+    import base64 as b64mod
+
+    from PIL import Image
+
+    raw = b64mod.b64decode(body["data"][0]["b64_json"])
+    img = Image.open(io.BytesIO(raw))
+    assert img.size == (16, 16)
+
+    # OpenAI-style size string parses; bad values are clean 400s
+    resp = await client.post("/v1/images/generations", json={"prompt": "x", "size": "16x16", "steps": 2})
+    assert resp.status == 200
+    for bad in ({"n": 9}, {"size": "0x16"}, {"response_format": "gif"}):
+      resp = await client.post("/v1/images/generations", json={"prompt": "x", **bad})
+      assert resp.status == 400, bad
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_streaming_route_n_images():
+  """n>1 on the reference-shaped streaming route: one denoise batch, n URLs."""
+  node, api, client = await _make_api(_jax_engine_with_tiny_sd())
+  try:
+    resp = await client.post("/v1/image/generations", json={"model": MODEL, "prompt": "cubes", "steps": 4, "n": 3, "seed": 5})
+    assert resp.status == 200
+    lines = await _read_lines(resp)
+    final = [l for l in lines if "images" in l]
+    assert len(final) == 1 and len(final[0]["images"]) == 3
+    urls = {img["url"] for img in final[0]["images"]}
+    assert len(urls) == 3  # distinct files
+  finally:
+    await client.close()
+    await node.stop()

@@ -268,6 +268,7 @@ class ChatGPTAPI:
     r.add_post("/download", self.handle_post_download)
     r.add_delete("/models/{model_name}", self.handle_delete_model)
     r.add_post("/v1/image/generations", self.handle_image_generations)
+    r.add_post("/v1/images/generations", self.handle_openai_image_generations)  # OpenAI Images API shape
     r.add_post("/quit", self.handle_quit)
 
     from ..utils.helpers import XOT_HOME
@@ -307,7 +308,7 @@ class ChatGPTAPI:
       # reference likewise gives images a 10x budget, chatgpt_api.py:529);
       # wrapping the whole stream in wait_for would kill healthy long
       # generations after 200 headers are out.
-      if request.path.endswith("/image/generations"):
+      if request.path.endswith(("/image/generations", "/images/generations")):
         return await handler(request)
       try:
         return await asyncio.wait_for(handler(request), timeout=self.response_timeout)
@@ -589,24 +590,10 @@ class ChatGPTAPI:
     beyond the reference: negative_prompt, steps, guidance, seed, size,
     strength.
     """
-    try:
-      # The timeout middleware exempts this route so the STREAMING phase can
-      # outlive response_timeout — but the body read must stay bounded or a
-      # slow-loris client holds the connection forever.
-      data = await asyncio.wait_for(request.json(), timeout=30)
-    except asyncio.TimeoutError:
-      return web.json_response({"error": "request body read timed out"}, status=408)
-    except Exception:  # noqa: BLE001 — same contract as the chat endpoints
-      return web.json_response({"error": "invalid JSON body"}, status=400)
-    model = data.get("model", "")
+    data, shard, err = await self._image_request_prologue(request, data_model_default="")
+    if err is not None:
+      return err
     prompt = data.get("prompt", "")
-    if registry.get_family(model) != "stable-diffusion":
-      return web.json_response({"error": f"Unsupported model for image generation: {model}"}, status=400)
-    if not getattr(self.node.inference_engine, "can_generate_images", False):
-      return web.json_response({"detail": "image generation models are not supported by this engine"}, status=501)
-    shard = registry.build_base_shard(model, self.inference_engine_classname)
-    if shard is None:
-      return web.json_response({"error": f"Unsupported model: {model} with engine {self.inference_engine_classname}"}, status=400)
 
     init_image = None
     image_url = data.get("image_url") or ""
@@ -626,7 +613,10 @@ class ChatGPTAPI:
         seed=int(data.get("seed", 0)),
         size=tuple(int(v) for v in data["size"]) if data.get("size") else None,
         strength=float(data.get("strength", 0.8)),
+        n=int(data.get("n", 1)),
       )
+      if not 1 <= gen_kwargs["n"] <= 4:
+        raise ValueError("n must be in [1, 4]")
       if gen_kwargs["size"] is not None:
         if len(gen_kwargs["size"]) != 2:
           raise ValueError("size must be [height, width]")
@@ -684,13 +674,9 @@ class ChatGPTAPI:
         await response.write_eof()
         return response
 
-      image = gen.result()  # uint8 [H, W, 3]
-      from PIL import Image
-
-      path = self.images_dir / f"{request_id}.png"
-      await asyncio.get_event_loop().run_in_executor(None, lambda: Image.fromarray(image).save(path))
-      url = f"{request.scheme}://{request.host}" + str(request.app.router["static_images"].url_for(filename=path.name))
-      await response.write(json.dumps({"images": [{"url": url, "content_type": "image/png"}]}).encode() + b"\n")
+      image = gen.result()  # uint8 [H, W, 3] (or [n, H, W, 3] when n > 1)
+      urls = await self._save_images(request, request_id, image)
+      await response.write(json.dumps({"images": [{"url": u, "content_type": "image/png"} for u in urls]}).encode() + b"\n")
       await response.write_eof()
       return response
     except asyncio.CancelledError:
@@ -725,6 +711,129 @@ class ChatGPTAPI:
       # log "Task was destroyed but it is pending!" on every disconnect.
       if get_q is not None and not get_q.done():
         get_q.cancel()
+
+  async def _image_request_prologue(self, request, data_model_default: str = ""):
+    """Shared body-read + model/engine validation for both image routes.
+
+    → (data, shard, None) on success, (None, None, web.Response) on refusal.
+    The body read is bounded even though the timeout middleware exempts
+    these routes (a slow-loris client must not hold the connection forever).
+    """
+    try:
+      data = await asyncio.wait_for(request.json(), timeout=30)
+    except asyncio.TimeoutError:
+      return None, None, web.json_response({"error": "request body read timed out"}, status=408)
+    except Exception:  # noqa: BLE001 — same contract as the chat endpoints
+      return None, None, web.json_response({"error": "invalid JSON body"}, status=400)
+    model = data.get("model") or data_model_default
+    if not model:  # OpenAI alias: default to the first SD card
+      model = next((m for m in registry.model_cards if registry.get_family(m) == "stable-diffusion"), "")
+      data = {**data, "model": model}
+    if registry.get_family(model) != "stable-diffusion":
+      return None, None, web.json_response({"error": f"Unsupported model for image generation: {model}"}, status=400)
+    if not getattr(self.node.inference_engine, "can_generate_images", False):
+      return None, None, web.json_response({"detail": "image generation models are not supported by this engine"}, status=501)
+    shard = registry.build_base_shard(model, self.inference_engine_classname)
+    if shard is None:
+      return None, None, web.json_response({"error": f"Unsupported model: {model} with engine {self.inference_engine_classname}"}, status=400)
+    return data, shard, None
+
+  async def _save_images(self, request, request_id: str, image) -> list[str]:
+    """uint8 [H,W,3] or [n,H,W,3] → saved PNGs under /images/, absolute URLs."""
+    from PIL import Image
+
+    batch = image if image.ndim == 4 else image[None]
+    base = f"{request.scheme}://{request.host}"
+    urls = []
+    for i, arr in enumerate(batch):
+      path = self.images_dir / (f"{request_id}.png" if len(batch) == 1 else f"{request_id}-{i}.png")
+      await asyncio.get_event_loop().run_in_executor(None, lambda a=arr, p=path: Image.fromarray(a).save(p))
+      urls.append(base + str(request.app.router["static_images"].url_for(filename=path.name)))
+    return urls
+
+  async def handle_openai_image_generations(self, request):
+    """POST /v1/images/generations — the OpenAI Images API shape (note the
+    plural): blocking JSON {created, data: [{url} | {b64_json}]}. The
+    reference only has the singular streaming route; this alias exists so
+    OpenAI image clients work unmodified. Supports prompt, n (1-4), size
+    ("512x512"), response_format ("url" | "b64_json"), and model (defaults
+    to the first stable-diffusion registry card)."""
+    data, shard, err = await self._image_request_prologue(request)
+    if err is not None:
+      return err
+    try:
+      n = int(data.get("n", 1))
+      if not 1 <= n <= 4:
+        raise ValueError("n must be in [1, 4]")
+      size = None
+      if data.get("size"):
+        w, h = (int(v) for v in str(data["size"]).lower().split("x"))
+        if not (8 <= w <= 2048 and 8 <= h <= 2048):
+          raise ValueError("size dims must be in [8, 2048]")
+        size = (h, w)
+      steps = int(data.get("steps", 30))
+      if not 1 <= steps <= 1000:
+        raise ValueError("steps must be in [1, 1000]")
+      seed = int(data.get("seed", 0))
+      negative = str(data.get("negative_prompt", ""))
+      response_format = str(data.get("response_format", "url"))
+      if response_format not in ("url", "b64_json"):
+        raise ValueError("response_format must be 'url' or 'b64_json'")
+    except (TypeError, ValueError) as e:
+      return web.json_response({"error": f"invalid parameters: {e}"}, status=400)
+
+    request_id = str(uuid.uuid4())
+    import threading
+
+    cancel_event = threading.Event()
+    try:
+      # 10x budget like the reference's image wait (chatgpt_api.py:529);
+      # on timeout OR client disconnect the denoise loop is cooperatively
+      # cancelled so the single engine worker doesn't keep burning for a
+      # dead request.
+      image = await asyncio.wait_for(
+        self.node.process_image_prompt(
+          shard, str(data.get("prompt", "")), request_id,
+          negative=negative, steps=steps, seed=seed, size=size, n=n,
+          cancel_event=cancel_event,
+        ),
+        timeout=self.response_timeout * 10,
+      )
+    except asyncio.TimeoutError:
+      cancel_event.set()
+      return web.json_response({"error": "image generation timed out"}, status=408)
+    except asyncio.CancelledError:
+      cancel_event.set()
+      raise
+    except NotImplementedError as e:
+      return web.json_response({"error": str(e)}, status=501)
+    except Exception as e:  # noqa: BLE001
+      if DEBUG >= 2:
+        import traceback
+
+        traceback.print_exc()
+      return web.json_response({"error": str(e)}, status=500)
+
+    if response_format == "b64_json":
+      def encode_all(batch):
+        import base64
+        import io
+
+        from PIL import Image
+
+        out = []
+        for arr in batch:
+          buf = io.BytesIO()
+          Image.fromarray(arr).save(buf, format="PNG")
+          out.append({"b64_json": base64.b64encode(buf.getvalue()).decode()})
+        return out
+
+      batch = image if image.ndim == 4 else image[None]
+      entries = await asyncio.get_event_loop().run_in_executor(None, encode_all, batch)
+    else:
+      urls = await self._save_images(request, request_id, image)
+      entries = [{"url": u} for u in urls]
+    return web.json_response({"created": int(time.time()), "data": entries})
 
   @staticmethod
   def _decode_image_b64(image_url: str):

@@ -122,9 +122,12 @@ class DiffusionPipeline:
     method: str = "ddim",
     progress_cb: ProgressCb | None = None,
     should_cancel: Callable[[], bool] | None = None,
+    n: int = 1,
   ) -> np.ndarray:
-    """Returns a uint8 [H, W, 3] image.
+    """Returns a uint8 [H, W, 3] image (or [n, H, W, 3] when n > 1).
 
+    ``n`` candidates denoise as one batch through the UNet (2n rows with
+    CFG) — decode is MXU-bound, so n images cost far less than n runs.
     ``init_image`` (uint8 [H,W,3]) switches to img2img: VAE-encode, noise to
     ``strength`` of the schedule, denoise the remainder — the reference's
     ``image_url`` path (``chatgpt_api.py:463-467``). Requested sizes and
@@ -146,19 +149,21 @@ class DiffusionPipeline:
       moments = self._vae_encode(self.params["vae"], images=img[None].astype(self.dtype))
       rng, sub = jax.random.split(rng)
       x0 = vae_sample_latents(moments.astype(jnp.float32), sub, cfg.vae.scaling_factor)
+      x0 = jnp.repeat(x0, n, axis=0)  # same encoded image, per-candidate noise
       start = max(1, min(steps, int(round(steps * strength))))
       ts, a_ts, a_prevs = ts[steps - start:], a_ts[steps - start:], a_prevs[steps - start:]
       rng, sub = jax.random.split(rng)
       latents = add_noise(x0, jax.random.normal(sub, x0.shape, x0.dtype), a_ts[0]).astype(self.dtype)
-      h, w = latents.shape[1], latents.shape[2]
     else:
       h = w = cfg.sample_size
       if size is not None:
         h, w = self._snap(size[0]) // self.vae_stride, self._snap(size[1]) // self.vae_stride
       rng, sub = jax.random.split(rng)
-      latents = jax.random.normal(sub, (1, h, w, cfg.unet.in_channels), jnp.float32).astype(self.dtype)
+      latents = jax.random.normal(sub, (n, h, w, cfg.unet.in_channels), jnp.float32).astype(self.dtype)
 
-    ctx_pair = self.encode_prompt(prompt, negative)
+    ctx_single = self.encode_prompt(prompt, negative)
+    # CFG batch layout for sample_chunk: n uncond rows then n cond rows.
+    ctx_pair = jnp.concatenate([jnp.repeat(ctx_single[:1], n, 0), jnp.repeat(ctx_single[1:], n, 0)], axis=0)
     total = len(ts)
     if progress_cb:
       progress_cb(0, total)
@@ -169,17 +174,17 @@ class DiffusionPipeline:
     while done < total:
       if should_cancel is not None and should_cancel():
         raise GenerationCancelled(f"cancelled at step {done}/{total}")
-      n = min(self.progress_chunk, total - done)
-      sl = slice(done, done + n)
+      span = min(self.progress_chunk, total - done)
+      sl = slice(done, done + span)
       latents = chunk_fn(
         self.params["unet"], latents=latents, ctx_pair=ctx_pair,
         ts=jnp.asarray(ts[sl]), a_ts=jnp.asarray(a_ts[sl]), a_prevs=jnp.asarray(a_prevs[sl]),
         guidance=g,
       )
-      done += n
+      done += span
       if progress_cb:
         progress_cb(done, total)
 
     img = self._vae_decode(self.params["vae"], latents=latents.astype(self.dtype))
-    img = np.asarray(jnp.clip((img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)[0], np.float32)
-    return img.astype(np.uint8)
+    img = np.asarray(jnp.clip((img.astype(jnp.float32) + 1.0) * 127.5, 0, 255), np.float32).astype(np.uint8)
+    return img[0] if n == 1 else img

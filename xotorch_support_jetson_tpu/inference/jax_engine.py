@@ -538,6 +538,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     strength: float = 0.8,
     progress_cb=None,
     cancel_event=None,
+    n: int = 1,
   ) -> np.ndarray:
     """Text→image (or img2img) on the loaded diffusion pipeline.
 
@@ -564,6 +565,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
         prompt, negative=negative, steps=steps, guidance=guidance, seed=seed,
         size=size, init_image=init_image, strength=strength, progress_cb=cb,
         should_cancel=cancel_event.is_set if cancel_event is not None else None,
+        n=n,
       ),
     )
 

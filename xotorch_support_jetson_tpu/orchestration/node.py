@@ -250,6 +250,7 @@ class Node:
     strength: float = 0.8,
     progress_cb=None,
     cancel_event=None,
+    n: int = 1,
   ):
     """Image generation (stable-diffusion family) → uint8 [H, W, 3].
 
@@ -268,7 +269,7 @@ class Node:
       return await self.inference_engine.generate_image(
         full, prompt, negative=negative, steps=steps, guidance=guidance,
         seed=seed, size=size, init_image=init_image, strength=strength,
-        progress_cb=progress_cb, cancel_event=cancel_event,
+        progress_cb=progress_cb, cancel_event=cancel_event, n=n,
       )
 
   async def _process_prompt(self, base_shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None, wire_concrete: bool = False):

@@ -111,6 +111,12 @@ _CARDS: list[ModelCard] = [
   # serves single-device full-model (inference/jax_engine.py
   # _load_diffusion_sync).
   _card("stable-diffusion-2-1-base", 31, "Stable Diffusion 2.1", "stable-diffusion", "stabilityai/stable-diffusion-2-1-base"),
+  # SD 1.5 (quick_gelu CLIP, conv proj_in, 8-head UNet levels) and the
+  # 768 v-prediction variant — the loader handles all three layouts
+  # (models/diffusion_loader.py attention_head_dim semantics, legacy VAE
+  # attention names; models/diffusion.py prediction_type).
+  _card("stable-diffusion-1-5", 31, "Stable Diffusion 1.5", "stable-diffusion", "stable-diffusion-v1-5/stable-diffusion-v1-5"),
+  _card("stable-diffusion-2-1", 31, "Stable Diffusion 2.1 (768, v-pred)", "stable-diffusion", "stabilityai/stable-diffusion-2-1"),
 ]
 
 model_cards: dict[str, ModelCard] = {c.model_id: c for c in _CARDS}
