@@ -590,7 +590,7 @@ class ChatGPTAPI:
     beyond the reference: negative_prompt, steps, guidance, seed, size,
     strength.
     """
-    data, shard, err = await self._image_request_prologue(request, data_model_default="")
+    data, shard, err = await self._image_request_prologue(request)
     if err is not None:
       return err
     prompt = data.get("prompt", "")
@@ -712,12 +712,15 @@ class ChatGPTAPI:
       if get_q is not None and not get_q.done():
         get_q.cancel()
 
-  async def _image_request_prologue(self, request, data_model_default: str = ""):
+  async def _image_request_prologue(self, request, allow_default_model: bool = False):
     """Shared body-read + model/engine validation for both image routes.
 
     → (data, shard, None) on success, (None, None, web.Response) on refusal.
     The body read is bounded even though the timeout middleware exempts
     these routes (a slow-loris client must not hold the connection forever).
+    ``allow_default_model`` (the OpenAI alias, where model is optional)
+    falls back to the first SD registry card; the reference-shaped streaming
+    route keeps its explicit-model 400.
     """
     try:
       data = await asyncio.wait_for(request.json(), timeout=30)
@@ -725,8 +728,8 @@ class ChatGPTAPI:
       return None, None, web.json_response({"error": "request body read timed out"}, status=408)
     except Exception:  # noqa: BLE001 — same contract as the chat endpoints
       return None, None, web.json_response({"error": "invalid JSON body"}, status=400)
-    model = data.get("model") or data_model_default
-    if not model:  # OpenAI alias: default to the first SD card
+    model = data.get("model", "")
+    if not model and allow_default_model:
       model = next((m for m in registry.model_cards if registry.get_family(m) == "stable-diffusion"), "")
       data = {**data, "model": model}
     if registry.get_family(model) != "stable-diffusion":
@@ -758,7 +761,7 @@ class ChatGPTAPI:
     OpenAI image clients work unmodified. Supports prompt, n (1-4), size
     ("512x512"), response_format ("url" | "b64_json"), and model (defaults
     to the first stable-diffusion registry card)."""
-    data, shard, err = await self._image_request_prologue(request)
+    data, shard, err = await self._image_request_prologue(request, allow_default_model=True)
     if err is not None:
       return err
     try:

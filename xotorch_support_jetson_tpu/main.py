@@ -30,7 +30,7 @@ from .utils.helpers import DEBUG, find_available_port, get_or_create_node_id
 
 def build_parser() -> argparse.ArgumentParser:
   parser = argparse.ArgumentParser(prog="xot-tpu", description="TPU-native distributed LLM inference and fine-tuning")
-  parser.add_argument("command", nargs="?", choices=["run", "eval", "train"], help="Command to run (default: daemon with API server)")
+  parser.add_argument("command", nargs="?", choices=["run", "eval", "train", "export"], help="Command to run (default: daemon with API server)")
   parser.add_argument("model_name", nargs="?", help="Model id (see registry)")
   parser.add_argument("--default-model", type=str, default="llama-3.2-1b")
   parser.add_argument("--node-id", type=str, default=None)
@@ -65,6 +65,8 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--save-every", type=int, default=0)
   parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
   parser.add_argument("--resume-checkpoint", type=str, default=None)
+  parser.add_argument("--export-dir", type=str, default=None, help="output directory for the `export` command (HF-format checkpoint)")
+  parser.add_argument("--export-dtype", type=str, default="float32", choices=["float32", "bfloat16"], help="tensor dtype for the `export` command")
   parser.add_argument("--allowed-node-ids", type=str, default=None, help="comma-separated")
   # Multi-host SPMD (one mesh spanning hosts over ICI/DCN): initializes
   # jax.distributed so every process sees the global device set; the in-slice
@@ -263,6 +265,37 @@ async def eval_model_cli(node, engine_classname: str, args) -> None:
   await run_eval(node, engine_classname, args)
 
 
+async def export_model_cli(node, engine_classname: str, args) -> None:
+  """`export MODEL --export-dir OUT [--resume-checkpoint CKPT]` — load the
+  model (plus an optional trained checkpoint incl. LoRA adapters), write an
+  HF-format checkpoint AutoModelForCausalLM loads directly
+  (models/hf_export.py). The reference has no training→HF path at all."""
+  from . import registry
+  from .models.hf_export import export_hf_checkpoint
+
+  if not args.export_dir:
+    raise SystemExit("export requires --export-dir")
+  model = args.model_name or args.default_model
+  shard = registry.build_full_shard(model, engine_classname)
+  if shard is None:
+    raise SystemExit(f"unknown model {model!r} for engine {engine_classname}")
+  engine = node.inference_engine
+  await engine.ensure_shard(shard)
+  if args.resume_checkpoint:
+    await engine.load_checkpoint(shard, args.resume_checkpoint)
+  out = export_hf_checkpoint(args.export_dir, engine.cfg, engine.params, dtype=args.export_dtype)
+  # ship the tokenizer alongside so the export is a complete HF repo
+  src = getattr(engine, "_model_dir", None)
+  if src is not None:
+    import shutil
+
+    for name in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model", "special_tokens_map.json", "vocab.json", "merges.txt"):
+      p = src / name
+      if p.exists():
+        shutil.copy2(p, out / name)
+  print(f"exported HF checkpoint to {out}")
+
+
 async def async_main(args) -> None:
   if args.models_seed_dir:
     from .download.downloader import seed_models
@@ -294,6 +327,8 @@ async def async_main(args) -> None:
       await train_model_cli(node, engine_classname, args)
     elif args.command == "eval":
       await eval_model_cli(node, engine_classname, args)
+    elif args.command == "export":
+      await export_model_cli(node, engine_classname, args)
     elif args.chat_tui:
       # Interactive terminal chat against this daemon (reference --chat-tui):
       # the API still serves alongside the REPL. SIGINT/SIGTERM must still

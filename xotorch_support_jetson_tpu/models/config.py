@@ -283,7 +283,8 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
   if isinstance(eos, int):
     eos = [eos]
 
-  torch_dtype = str(hf.get("torch_dtype", "bfloat16"))
+  # transformers ≥4.56 writes "dtype"; older checkpoints carry "torch_dtype"
+  torch_dtype = str(hf.get("torch_dtype") or hf.get("dtype") or "bfloat16")
   dtype_map = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16, "float32": jnp.float32}
 
   # MoE key space: mixtral (num_local_experts, expert width = intermediate_size),

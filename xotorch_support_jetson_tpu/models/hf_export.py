@@ -1,0 +1,159 @@
+"""Export decoder params back to HF-transformers format (inverse of loader.py).
+
+The reference fine-tunes through torchtune but has no path from its training
+state back to a standard HF checkpoint; here ``export_hf_checkpoint`` writes
+``config.json`` + ``model.safetensors`` that ``AutoModelForCausalLM`` loads
+directly — train or LoRA-tune on TPU with this framework, then serve the
+result anywhere. Golden round trip is verified THROUGH HF itself
+(tests/test_hf_export.py: load → export → HF forward == original HF forward).
+
+Scope: the dense decoder families whose load maps are bijective —
+llama (incl. llama3 rope scaling), qwen2 (attention biases), qwen3
+(per-head q/k RMSNorm), mistral, gemma2 (zero-centered norms re-centered,
+four-norm layout, softcaps). MoE / MLA / fused-projection (phi3) exports
+are refused with a clear message. LoRA adapters (train/lora.py), if present
+in the tree, are merged into the base projections (w + 2·A@B — alpha=2·rank
+so the scale is always 2, matching models/decoder.py's forward).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from .config import ModelConfig, RopeScaling
+
+_MODEL_TYPE = {
+  "llama": "llama",
+  "qwen2": "qwen2",
+  "qwen3": "qwen3",
+  "mistral": "mistral",
+  "gemma2": "gemma2",
+}
+
+
+def _np32(x) -> np.ndarray:
+  return np.asarray(x, dtype=np.float32)
+
+
+def _lin(w, lora_a=None, lora_b=None) -> np.ndarray:
+  """Our [in, out] (+ optional merged LoRA) → torch Linear [out, in]."""
+  w = _np32(w)
+  if lora_a is not None:
+    w = w + 2.0 * (_np32(lora_a) @ _np32(lora_b))
+  return np.ascontiguousarray(w.T)
+
+
+def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dtype: str = "float32") -> Path:
+  """Write an HF-loadable checkpoint; returns the directory.
+
+  ``params`` is a FULL-model tree (embed + all layers + final_norm [+
+  lm_head]) in the decoder layout (stacked [L, ...] leaves).
+  """
+  if cfg.family not in _MODEL_TYPE:
+    raise NotImplementedError(f"HF export supports {sorted(_MODEL_TYPE)}; {cfg.family!r} (MoE/MLA/fused layouts) is not exportable")
+  if cfg.n_experts or cfg.is_mla:
+    raise NotImplementedError("HF export of MoE / MLA trees is not supported")
+  if cfg.vision is not None:
+    raise NotImplementedError("HF export of vision (llava) trees is not supported — the tower/projector would be silently dropped")
+  if not isinstance(params, dict) or "embed" not in params or "final_norm" not in params:
+    raise ValueError("export needs a FULL model tree (first+last shard params); mesh serving modes (pp/sp) hold params elsewhere — export from a plain load")
+  if any(k.endswith("_scale") for k in params.get("layers", {})):
+    raise NotImplementedError("params are int8-quantized (XOT_TPU_QUANT); export from an unquantized load — casting int8 codes to float would silently corrupt the checkpoint")
+
+  gemma = cfg.post_norms  # zero-centered norms were re-centered (+1) at load
+  out_dir = Path(out_dir)
+  out_dir.mkdir(parents=True, exist_ok=True)
+
+  def norm(w) -> np.ndarray:
+    w = _np32(w)
+    return np.ascontiguousarray(w - 1.0 if gemma else w)
+
+  sd: dict[str, np.ndarray] = {"model.embed_tokens.weight": _np32(params["embed"])}
+  stack = params["layers"]
+  L = stack["attn_norm"].shape[0]
+  for i in range(L):
+    p = {k: v[i] for k, v in stack.items()}
+    pre = f"model.layers.{i}"
+    sd[f"{pre}.input_layernorm.weight"] = norm(p["attn_norm"])
+    sd[f"{pre}.self_attn.q_proj.weight"] = _lin(p["wq"], p.get("wq_lora_a"), p.get("wq_lora_b"))
+    sd[f"{pre}.self_attn.k_proj.weight"] = _lin(p["wk"])
+    sd[f"{pre}.self_attn.v_proj.weight"] = _lin(p["wv"], p.get("wv_lora_a"), p.get("wv_lora_b"))
+    sd[f"{pre}.self_attn.o_proj.weight"] = _lin(p["wo"])
+    if "bq" in p:
+      sd[f"{pre}.self_attn.q_proj.bias"] = _np32(p["bq"])
+      sd[f"{pre}.self_attn.k_proj.bias"] = _np32(p["bk"])
+      sd[f"{pre}.self_attn.v_proj.bias"] = _np32(p["bv"])
+    if "q_norm" in p:  # qwen3 per-head q/k RMSNorm
+      sd[f"{pre}.self_attn.q_norm.weight"] = _np32(p["q_norm"])
+      sd[f"{pre}.self_attn.k_norm.weight"] = _np32(p["k_norm"])
+    if gemma:  # four-norm layout
+      sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["post_attn_norm"])
+      sd[f"{pre}.pre_feedforward_layernorm.weight"] = norm(p["mlp_norm"])
+      sd[f"{pre}.post_feedforward_layernorm.weight"] = norm(p["post_mlp_norm"])
+    else:
+      sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["mlp_norm"])
+    sd[f"{pre}.mlp.gate_proj.weight"] = _lin(p["w_gate"])
+    sd[f"{pre}.mlp.up_proj.weight"] = _lin(p["w_up"])
+    sd[f"{pre}.mlp.down_proj.weight"] = _lin(p["w_down"])
+  sd["model.norm.weight"] = norm(params["final_norm"])
+  tied = "lm_head" not in params
+  if not tied:
+    sd["lm_head.weight"] = np.ascontiguousarray(_np32(params["lm_head"]).T)
+
+  import torch
+  from safetensors.torch import save_file
+
+  torch_dtype = {"float32": torch.float32, "bfloat16": torch.bfloat16}[dtype]
+  save_file({k: torch.from_numpy(np.ascontiguousarray(v).copy()).to(torch_dtype) for k, v in sd.items()}, str(out_dir / "model.safetensors"))
+
+  hf_cfg: dict = {
+    "architectures": [_arch(cfg.family)],
+    "model_type": _MODEL_TYPE[cfg.family],
+    "vocab_size": cfg.vocab_size,
+    "hidden_size": cfg.dim,
+    "intermediate_size": cfg.hidden_dim,
+    "num_hidden_layers": cfg.n_layers,
+    "num_attention_heads": cfg.n_heads,
+    "num_key_value_heads": cfg.n_kv_heads,
+    "head_dim": cfg.head_dim,
+    "rms_norm_eps": cfg.norm_eps,
+    "rope_theta": cfg.rope_theta,
+    "max_position_embeddings": cfg.max_seq_len,
+    "tie_word_embeddings": tied,
+    "torch_dtype": dtype,  # legacy key; transformers ≥4.56 reads "dtype"
+    "dtype": dtype,
+  }
+  if cfg.eos_token_ids:
+    hf_cfg["eos_token_id"] = list(cfg.eos_token_ids) if len(cfg.eos_token_ids) > 1 else cfg.eos_token_ids[0]
+  if isinstance(cfg.rope_scaling, RopeScaling):
+    hf_cfg["rope_scaling"] = {
+      "rope_type": "llama3",
+      "factor": cfg.rope_scaling.factor,
+      "low_freq_factor": cfg.rope_scaling.low_freq_factor,
+      "high_freq_factor": cfg.rope_scaling.high_freq_factor,
+      "original_max_position_embeddings": cfg.rope_scaling.original_max_position_embeddings,
+    }
+  if gemma:
+    hf_cfg.update(
+      attn_logit_softcapping=cfg.attn_logit_softcap or None,
+      final_logit_softcapping=cfg.final_logit_softcap or None,
+      query_pre_attn_scalar=cfg.query_pre_attn_scalar or cfg.head_dim,
+      sliding_window=cfg.sliding_window or None,
+      hidden_act="gelu_pytorch_tanh",
+      hidden_activation="gelu_pytorch_tanh",
+    )
+  (out_dir / "config.json").write_text(json.dumps(hf_cfg, indent=2))
+  return out_dir
+
+
+def _arch(family: str) -> str:
+  return {
+    "llama": "LlamaForCausalLM",
+    "qwen2": "Qwen2ForCausalLM",
+    "qwen3": "Qwen3ForCausalLM",
+    "mistral": "MistralForCausalLM",
+    "gemma2": "Gemma2ForCausalLM",
+  }[family]
