@@ -206,3 +206,65 @@ def test_llava_next_golden_unpadded_wide(tmp_path):
   vertically, and packing must CROP those feature rows (HF unpad_image) —
   the case that distinguishes anyres from naive tiling."""
   _run_llava_next(tmp_path, (28, 56))
+
+
+def test_llava_next_engine_two_images(tmp_path, monkeypatch):
+  """Two anyres images with DIFFERENT aspects in one prompt: the engine
+  slices each image's true tile count out of the processor's padded batch,
+  packs each with its own grid/unpad, and prefills the merged embeddings."""
+  import asyncio
+
+  import torch
+  from transformers import (
+    AutoTokenizer,
+    CLIPVisionConfig,
+    LlamaConfig,
+    LlavaNextConfig,
+    LlavaNextForConditionalGeneration,
+    LlavaNextImageProcessor,
+    LlavaNextProcessor,
+    PreTrainedTokenizerFast,
+  )
+  from tokenizers import Tokenizer, models as tok_models, pre_tokenizers, trainers
+
+  torch.manual_seed(0)
+  tm = Tokenizer(tok_models.BPE(unk_token="<unk>"))
+  tm.pre_tokenizer = pre_tokenizers.Whitespace()
+  tm.train_from_iterator(["compare the images please"] * 30, trainers.BpeTrainer(vocab_size=120, special_tokens=["<unk>", "<s>", "</s>"]))
+  tok = PreTrainedTokenizerFast(tokenizer_object=tm, unk_token="<unk>", bos_token="<s>", eos_token="</s>")
+  tok.add_special_tokens({"additional_special_tokens": ["<image>"]})
+  img_id = tok.convert_tokens_to_ids("<image>")
+
+  vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=2, num_attention_heads=4, image_size=28, patch_size=14)
+  tc = LlamaConfig(vocab_size=128, hidden_size=48, intermediate_size=96, num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+  cfg = LlavaNextConfig(vision_config=vc, text_config=tc, image_token_index=img_id, image_grid_pinpoints=[[56, 56]])
+  LlavaNextForConditionalGeneration(cfg).to(torch.float32).eval().save_pretrained(tmp_path, safe_serialization=True)
+  ip = LlavaNextImageProcessor(size={"shortest_edge": 28}, crop_size={"height": 28, "width": 28}, image_grid_pinpoints=[[56, 56]])
+  LlavaNextProcessor(image_processor=ip, tokenizer=tok, patch_size=14, vision_feature_select_strategy="default", image_token="<image>").save_pretrained(tmp_path)
+
+  import base64
+  import io
+
+  from PIL import Image
+
+  from xotorch_support_jetson_tpu.download.downloader import NoopShardDownloader
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.inference.state import InferenceState
+
+  monkeypatch.setenv("XOT_TPU_MODEL_DIR", str(tmp_path))
+
+  def b64(color, size):
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+  async def run():
+    eng = JaxShardedInferenceEngine(shard_downloader=NoopShardDownloader(), use_local_mesh=False)
+    shard = Shard("llava-1.6-vicuna-7b", 0, 1, 2)
+    st = InferenceState(extras={"images": [b64((200, 40, 40), (56, 28)), b64((40, 40, 200), (28, 56))]})
+    out, st = await eng.infer_prompt("r2", shard, "compare <image> and <image>", st)
+    return out
+
+  out = asyncio.run(run())
+  assert out.shape == (1, 128)
+  assert np.isfinite(out).all()

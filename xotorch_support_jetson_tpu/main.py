@@ -281,7 +281,14 @@ async def export_model_cli(node, engine_classname: str, args) -> None:
     raise SystemExit(f"unknown model {model!r} for engine {engine_classname}")
   engine = node.inference_engine
   await engine.ensure_shard(shard)
+  if getattr(engine, "diffusion", None) is not None:
+    raise SystemExit(f"{model!r} is an image-generation model; HF export covers text decoders only")
   if args.resume_checkpoint:
+    # A LoRA-trained checkpoint carries adapter leaves the plain tree lacks;
+    # attach matching adapters FIRST or load_checkpoint would silently drop
+    # the fine-tune (npz restore only fills keys present in the template).
+    if args.lora_rank:
+      engine.attach_lora(args.lora_rank)
     await engine.load_checkpoint(shard, args.resume_checkpoint)
   out = export_hf_checkpoint(args.export_dir, engine.cfg, engine.params, dtype=args.export_dtype)
   # ship the tokenizer alongside so the export is a complete HF repo

@@ -38,12 +38,9 @@ def _np32(x) -> np.ndarray:
   return np.asarray(x, dtype=np.float32)
 
 
-def _lin(w, lora_a=None, lora_b=None) -> np.ndarray:
-  """Our [in, out] (+ optional merged LoRA) → torch Linear [out, in]."""
-  w = _np32(w)
-  if lora_a is not None:
-    w = w + 2.0 * (_np32(lora_a) @ _np32(lora_b))
-  return np.ascontiguousarray(w.T)
+def _lin(w) -> np.ndarray:
+  """Our [in, out] → torch Linear [out, in]."""
+  return np.ascontiguousarray(_np32(w).T)
 
 
 def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dtype: str = "float32") -> Path:
@@ -63,6 +60,14 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
   if any(k.endswith("_scale") for k in params.get("layers", {})):
     raise NotImplementedError("params are int8-quantized (XOT_TPU_QUANT); export from an unquantized load — casting int8 codes to float would silently corrupt the checkpoint")
 
+  # LoRA adapters fold into the base weights through THE training/decode
+  # merge (train/lora.py — one scale definition), not a local copy.
+  if any(k.endswith("_lora_a") for k in params.get("layers", {})):
+    from ..train.lora import merge_lora
+
+    rank = next(v for k, v in params["layers"].items() if k.endswith("_lora_a")).shape[-1]
+    params = merge_lora(params, rank)
+
   gemma = cfg.post_norms  # zero-centered norms were re-centered (+1) at load
   out_dir = Path(out_dir)
   out_dir.mkdir(parents=True, exist_ok=True)
@@ -78,9 +83,9 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
     p = {k: v[i] for k, v in stack.items()}
     pre = f"model.layers.{i}"
     sd[f"{pre}.input_layernorm.weight"] = norm(p["attn_norm"])
-    sd[f"{pre}.self_attn.q_proj.weight"] = _lin(p["wq"], p.get("wq_lora_a"), p.get("wq_lora_b"))
+    sd[f"{pre}.self_attn.q_proj.weight"] = _lin(p["wq"])
     sd[f"{pre}.self_attn.k_proj.weight"] = _lin(p["wk"])
-    sd[f"{pre}.self_attn.v_proj.weight"] = _lin(p["wv"], p.get("wv_lora_a"), p.get("wv_lora_b"))
+    sd[f"{pre}.self_attn.v_proj.weight"] = _lin(p["wv"])
     sd[f"{pre}.self_attn.o_proj.weight"] = _lin(p["wo"])
     if "bq" in p:
       sd[f"{pre}.self_attn.q_proj.bias"] = _np32(p["bq"])
@@ -123,6 +128,9 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
     "rope_theta": cfg.rope_theta,
     "max_position_embeddings": cfg.max_seq_len,
     "tie_word_embeddings": tied,
+    # without this, architectures defaulting to bias=False would silently
+    # drop the exported q/k/v bias tensors at from_pretrained
+    "attention_bias": bool(cfg.qkv_bias),
     "torch_dtype": dtype,  # legacy key; transformers ≥4.56 reads "dtype"
     "dtype": dtype,
   }
