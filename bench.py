@@ -152,14 +152,16 @@ def main() -> None:
   # int8 weight-quantized decode (XOT_TPU_QUANT=int8 engine mode): halves the
   # HBM bytes per step — the decode roofline is weight bandwidth, so this is
   # the fast serving mode (~1.5× measured on v5e).
-  int8_tok_s = None
-  if on_accel:
+  def _bench_quant_decode(mode: str):
+    """Solo quantized decode for one XOT_TPU_QUANT mode (shared timing
+    methodology: warm compile, full np.asarray host fetch — block_until_ready
+    can lie on the tunnel — best of 2). Returns (tok/s, quantized tree)."""
     from xotorch_support_jetson_tpu.models.quantize import quantize_params
 
-    qp = quantize_params(params)
+    qp = quantize_params(params, mode)
     qcache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
     qtoks, qcache = fused_decode(qp, cfg, shard, first_tok, qcache, jnp.zeros((B,), jnp.int32), n_decode)
-    _ = np.asarray(qtoks)  # warm compile; full host fetch (block_until_ready can lie on the tunnel)
+    _ = np.asarray(qtoks)
     qpos = n_decode
     best = 0.0
     for _ in range(2):
@@ -168,7 +170,21 @@ def main() -> None:
       _ = np.asarray(qtoks)
       best = max(best, n_decode * B / (time.perf_counter() - t0))
       qpos += n_decode
-    int8_tok_s = round(best, 2)
+    return round(best, 2), qp
+
+  int8_tok_s = None
+  int4_tok_s = None
+  if on_accel:
+    int8_tok_s, qp = _bench_quant_decode("int8")
+    # int4 (packed w4a16, round 4): the HBM-CAPACITY mode. The two-dot qdot
+    # keeps the unpack streamable but reads the packed buffer twice, so the
+    # expected number is ~half of int8 (BASELINE.md) — recorded for drift,
+    # not as a recommendation.
+    try:
+      int4_tok_s, qp4 = _bench_quant_decode("int4")
+      del qp4
+    except Exception:  # noqa: BLE001 — optional section
+      int4_tok_s = None
 
   # Continuous-batching aggregate (XOT_TPU_BATCHED=1 serving mode,
   # inference/batch_scheduler.py): decode is weight-bandwidth-bound, so an
@@ -555,6 +571,7 @@ def main() -> None:
         "serving_chunked_tok_s": round(serving_tok_s, 2),
         "decode_tok_s_ctx32k": ctx32k_tok_s,
         "int8_decode_tok_s": int8_tok_s,
+        "int4_decode_tok_s": int4_tok_s,
         "batch8_aggregate_tok_s": batch8_tok_s,
         "int8_batch8_aggregate_tok_s": int8_batch8_tok_s,
         "int8_batch16_aggregate_tok_s": int8_batch16_tok_s,

@@ -287,8 +287,16 @@ async def export_model_cli(node, engine_classname: str, args) -> None:
     # A LoRA-trained checkpoint carries adapter leaves the plain tree lacks;
     # attach matching adapters FIRST or load_checkpoint would silently drop
     # the fine-tune (npz restore only fills keys present in the template).
-    if args.lora_rank:
-      engine.attach_lora(args.lora_rank)
+    # The rank is DETECTED from the checkpoint so forgetting --lora-rank
+    # cannot lose the fine-tune; an explicit flag must agree.
+    from .train.checkpoint import checkpoint_lora_rank
+
+    detected = checkpoint_lora_rank(args.resume_checkpoint)
+    if detected and args.lora_rank and args.lora_rank != detected:
+      raise SystemExit(f"--lora-rank {args.lora_rank} does not match the checkpoint's adapter rank {detected}")
+    rank = args.lora_rank or detected
+    if rank:
+      engine.attach_lora(rank)
     await engine.load_checkpoint(shard, args.resume_checkpoint)
   out = export_hf_checkpoint(args.export_dir, engine.cfg, engine.params, dtype=args.export_dtype)
   # ship the tokenizer alongside so the export is a complete HF repo

@@ -236,10 +236,13 @@ def _mla_latents(x, p, cfg: ModelConfig, positions, inv_freq):
 
 
 def _mla_w_kv_b(p, dtype):
-  """The kv_b up-projection with int8 scales / LoRA folded in ([rank, H*(nope+v)])."""
+  """The kv_b up-projection with int8/int4 scales / LoRA folded in
+  ([rank, H*(nope+v)])."""
   w = p["wkv_b"]
   if "wkv_b_scale" in p:
-    w = w.astype(dtype) * p["wkv_b_scale"][None, :].astype(dtype)
+    from .quantize import dequantize_leaf
+
+    w = dequantize_leaf(w, p["wkv_b_scale"], p["kv_a_norm"].shape[-1], dtype)
   if "wkv_b_lora_a" in p:
     w = w.astype(dtype) + (p["wkv_b_lora_a"] @ p["wkv_b_lora_b"]).astype(dtype) * 2.0
   return w
@@ -317,11 +320,14 @@ def _mlp_block(h, p, cfg: ModelConfig):
     from ..ops.moe import moe_ffn
 
     def expert_w(name):
-      # int8 expert weights: dequantize next to the einsum (XLA fuses the
-      # scale multiply into the operand read — w8a16-style).
+      # int8/int4 expert weights: dequantize next to the einsum (XLA fuses
+      # the scale multiply into the operand read — w8a16-style).
       w = p[name]
       if f"{name}_scale" in p:
-        return w.astype(h.dtype) * p[f"{name}_scale"][..., None, :].astype(h.dtype)
+        from .quantize import dequantize_leaf
+
+        in_dim = cfg.moe_hidden_dim if name == "w_experts_down" else D
+        return dequantize_leaf(w, p[f"{name}_scale"], in_dim, h.dtype)
       return w
 
     xt = x.reshape(B * S, D)

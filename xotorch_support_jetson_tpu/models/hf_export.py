@@ -58,7 +58,7 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
   if not isinstance(params, dict) or "embed" not in params or "final_norm" not in params:
     raise ValueError("export needs a FULL model tree (first+last shard params); mesh serving modes (pp/sp) hold params elsewhere — export from a plain load")
   if any(k.endswith("_scale") for k in params.get("layers", {})):
-    raise NotImplementedError("params are int8-quantized (XOT_TPU_QUANT); export from an unquantized load — casting int8 codes to float would silently corrupt the checkpoint")
+    raise NotImplementedError("params are int8/int4-quantized (XOT_TPU_QUANT); export from an unquantized load — casting quantized codes to float would silently corrupt the checkpoint")
 
   # LoRA adapters fold into the base weights through THE training/decode
   # merge (train/lora.py — one scale definition), not a local copy.

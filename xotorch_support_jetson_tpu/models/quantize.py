@@ -61,11 +61,41 @@ def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
   return q, scale
 
 
+def quantize_weight_int4(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Symmetric per-output-channel int4, PACKED two values per int8 byte
+  along the IN axis (even rows in the low nibble, odd rows in the high):
+  w [..., in, out] → (packed int8 [..., in/2, out], scale f32 [..., out]).
+
+  The halved in-axis is how the quantization is detected downstream
+  (``qdot`` / decoder._mm compare it against the activation width), so scale
+  leaves keep the same ``<name>_scale`` name and every sharding spec /
+  checkpoint path treats int4 exactly like int8.
+  """
+  if w.shape[-2] % 2:
+    raise ValueError(f"int4 packing needs an even in-dim; got {w.shape}")
+  absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+  scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+  q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -8, 7).astype(jnp.int8)
+  lo = q[..., 0::2, :] & 0x0F
+  hi = (q[..., 1::2, :] & 0x0F) << 4
+  return (lo | hi).astype(jnp.int8), scale
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+  """packed int8 [..., in/2, out] → int8 [..., in, out] (sign-extended)."""
+  lo = (packed << 4) >> 4  # arithmetic shifts on int8 sign-extend the nibble
+  hi = packed >> 4
+  pair = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+  return pair.reshape(*packed.shape[:-2], packed.shape[-2] * 2, packed.shape[-1])
+
+
 def quantize_params(params: dict, mode: str = "int8") -> dict:
   """Quantize a shard's params in place-shape: returns a new pytree where
-  each eligible leaf ``w`` becomes int8 with a sibling ``w_scale``."""
-  if mode not in ("int8",):
+  each eligible leaf ``w`` becomes int8 (or packed int4) with a sibling
+  ``w_scale``."""
+  if mode not in ("int8", "int4"):
     raise ValueError(f"unsupported quantization mode {mode!r}")
+  quant = quantize_weight if mode == "int8" else quantize_weight_int4
   out = dict(params)
   for stack_name, eligible in QUANT_STACK_LEAVES.items():
     if stack_name not in params:
@@ -73,31 +103,52 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
     stack = dict(params[stack_name])
     for name in eligible:
       if name in stack and stack[name].dtype != jnp.int8:
-        q, s = quantize_weight(stack[name])
+        if mode == "int4" and stack[name].shape[-2] % 2:
+          continue  # odd in-dim can't pack; leaf stays full precision
+        q, s = quant(stack[name])
         stack[name] = q
         stack[f"{name}_scale"] = s
     out[stack_name] = stack
   for name in QUANT_TOP_LEAVES:
     if name in out and out[name].dtype != jnp.int8:
-      q, s = quantize_weight(out[name])
+      q, s = quant(out[name])
       out[name] = q
       out[f"{name}_scale"] = s
   if "lm_head" not in out and "embed" in out and "final_norm" in out:
-    # Tied embeddings: materialize an int8 copy of the head so decode reads
-    # ~1 byte/param for the [D,V] projection (the single biggest weight read
-    # per token); the bf16 table stays for the embedding gather.
-    q, s = quantize_weight(out["embed"].T)
+    # Tied embeddings: materialize a quantized copy of the head so decode
+    # reads ≤1 byte/param for the [D,V] projection (the single biggest
+    # weight read per token); the bf16 table stays for the embedding gather.
+    q, s = quant(out["embed"].T)
     out["lm_head"] = q
     out["lm_head_scale"] = s
   return out
 
 
 def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a16") -> jnp.ndarray:
-  """x [..., in] @ quantized w [in, out] → [..., out] in x.dtype.
+  """x [..., in] @ quantized w → [..., out] in x.dtype.
 
+  ``w`` is int8 [in, out] or PACKED int4 [in/2, out] (detected by the
+  halved in-axis; unpacked next to the dot, w4a16-style).
   ``compute='w8a8'`` additionally quantizes x per-row to int8 and runs the
-  dot on the int8 MXU path with int32 accumulation.
+  dot on the int8 MXU path with int32 accumulation (int8 layout only).
   """
+  if w.shape[-2] * 2 == x.shape[-1]:  # packed int4
+    # TWO-DOT formulation: y = x_even @ signext(packed) + x_odd @ (packed>>4).
+    # Each operand is a pure shift of the packed buffer, which XLA streams
+    # into the dot like int8's astype; the obvious stack/reshape interleave
+    # instead MATERIALIZES the unpacked weights to HBM every step — measured
+    # 26 vs 185 tok/s on the 1B geometry on v5e-1 (NOTES round-4). Traffic
+    # is int8-equivalent (both dots read the packed buffer), so int4 is the
+    # HBM-CAPACITY mode (weights at rest: 0.5 byte/param), not the speed
+    # mode — int8 decodes ~2x faster (BASELINE.md).
+    xe = x[..., 0::2]
+    xo = x[..., 1::2]
+    lo = ((w << 4) >> 4).astype(x.dtype)
+    hi = (w >> 4).astype(x.dtype)
+    dn = (((x.ndim - 1,), (0,)), ((), ()))
+    acc = jax.lax.dot_general(xe, lo, dn, preferred_element_type=jnp.float32)
+    acc = acc + jax.lax.dot_general(xo, hi, dn, preferred_element_type=jnp.float32)
+    return (acc * scale.astype(jnp.float32)).astype(x.dtype)
   if compute == "w8a8":
     xf = x.astype(jnp.float32)
     row = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
@@ -112,3 +163,13 @@ def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a
 
 def is_quantized(p: dict, name: str) -> bool:
   return f"{name}_scale" in p
+
+
+def dequantize_leaf(w: jnp.ndarray, scale: jnp.ndarray, in_dim: int, dtype) -> jnp.ndarray:
+  """Materialize a quantized leaf (int8 OR packed int4, detected against the
+  expected ``in_dim``) back to ``dtype`` — for the few sites that need the
+  full matrix rather than a fused qdot (MLA weight absorption, MoE expert
+  einsums)."""
+  if w.shape[-2] * 2 == in_dim:
+    w = unpack_int4(w)
+  return w.astype(dtype) * scale[..., None, :].astype(dtype)

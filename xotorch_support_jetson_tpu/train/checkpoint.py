@@ -47,3 +47,33 @@ def load_params(path: str | Path, like):
       leaves.append(jax.numpy.asarray(arr, dtype=leaf.dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
   raise FileNotFoundError(f"no checkpoint at {orbax_path} or {npz_path}")
+
+
+def checkpoint_lora_rank(path: str | Path) -> int | None:
+  """Detect LoRA adapters (and their rank) inside a saved checkpoint.
+
+  The export CLI uses this so a LoRA fine-tune can never be silently dropped
+  by restoring into an adapter-less template: npz restores fill only keys
+  present in the template, so the caller must attach adapters FIRST.
+  """
+  path = Path(path)
+  npz_path = path.with_suffix(".npz")
+  if npz_path.exists():
+    data = np.load(str(npz_path))
+    for k in data.files:
+      if "_lora_a" in k:
+        return int(data[k].shape[-1])
+    return None
+  orbax_path = path.absolute().with_suffix(".orbax")
+  if orbax_path.exists():
+    try:
+      import orbax.checkpoint as ocp
+
+      meta = ocp.StandardCheckpointer().metadata(orbax_path)
+      meta = getattr(meta, "item_metadata", meta)  # StepMetadata wraps the tree
+      for key_path, leaf in jax.tree_util.tree_flatten_with_path(meta)[0]:
+        if "_lora_a" in jax.tree_util.keystr(key_path):
+          return int(leaf.shape[-1])
+    except Exception:  # noqa: BLE001 — orbax metadata API drift: fall through
+      return None
+  return None
