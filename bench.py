@@ -61,6 +61,7 @@ def plausible_value(rec: dict) -> float | None:
 def main() -> None:
   from xotorch_support_jetson_tpu.models.config import ModelConfig
   from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache, shard_forward
+  from xotorch_support_jetson_tpu.models.quantize import quantize_params
 
   platform = jax.devices()[0].platform
   on_accel = platform != "cpu"
@@ -156,8 +157,6 @@ def main() -> None:
     """Solo quantized decode for one XOT_TPU_QUANT mode (shared timing
     methodology: warm compile, full np.asarray host fetch — block_until_ready
     can lie on the tunnel — best of 2). Returns (tok/s, quantized tree)."""
-    from xotorch_support_jetson_tpu.models.quantize import quantize_params
-
     qp = quantize_params(params, mode)
     qcache = init_kv_cache(cfg, shard.n_shard_layers, B, max_seq)
     qtoks, qcache = fused_decode(qp, cfg, shard, first_tok, qcache, jnp.zeros((B,), jnp.int32), n_decode)

@@ -196,3 +196,19 @@ def test_70b_structural_plan_and_stage0_shapes():
   assert out.shape == (B, S, CFG_70B.dim)  # stage 0 emits hidden, not logits
   assert out.dtype == CFG_70B.dtype
   assert new_cache["k"].shape == cache["k"].shape
+
+
+def test_70b_int4_capacity_mode():
+  """int4 is the capacity mode (BASELINE.md): 70B packs to ~33 GiB, so the
+  planner admits meshes bf16 can't touch — the eval_shape path counts packed
+  leaves automatically."""
+  b16 = model_bytes(CFG_70B) / GIB
+  i8 = model_bytes(CFG_70B, quant="int8") / GIB
+  i4 = model_bytes(CFG_70B, quant="int4") / GIB
+  assert 128 < b16 < 134
+  assert 64 < i8 < 70
+  assert 32 < i4 < 36
+  # bf16 over 8 v5e chips: refused outright (existing test); int4 over the
+  # SAME 8 chips fits with a 16K cache.
+  report = check_plan(CFG_70B, MeshPlan(tp=8), 8, V5E, batch=1, max_seq=16384, quant="int4")
+  assert report.fits

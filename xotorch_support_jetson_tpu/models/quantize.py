@@ -111,10 +111,12 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
     out[stack_name] = stack
   for name in QUANT_TOP_LEAVES:
     if name in out and out[name].dtype != jnp.int8:
+      if mode == "int4" and out[name].shape[-2] % 2:
+        continue  # odd in-dim can't pack; leaf stays full precision
       q, s = quant(out[name])
       out[name] = q
       out[f"{name}_scale"] = s
-  if "lm_head" not in out and "embed" in out and "final_norm" in out:
+  if "lm_head" not in out and "embed" in out and "final_norm" in out and not (mode == "int4" and out["embed"].shape[-1] % 2):
     # Tied embeddings: materialize a quantized copy of the head so decode
     # reads ≤1 byte/param for the [D,V] projection (the single biggest
     # weight read per token); the bf16 table stays for the embedding gather.

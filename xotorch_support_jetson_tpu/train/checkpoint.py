@@ -56,14 +56,9 @@ def checkpoint_lora_rank(path: str | Path) -> int | None:
   by restoring into an adapter-less template: npz restores fill only keys
   present in the template, so the caller must attach adapters FIRST.
   """
+  # Probe in the SAME precedence order load_params restores (orbax first):
+  # inspecting a stale sibling file would defeat the whole check.
   path = Path(path)
-  npz_path = path.with_suffix(".npz")
-  if npz_path.exists():
-    data = np.load(str(npz_path))
-    for k in data.files:
-      if "_lora_a" in k:
-        return int(data[k].shape[-1])
-    return None
   orbax_path = path.absolute().with_suffix(".orbax")
   if orbax_path.exists():
     try:
@@ -75,5 +70,12 @@ def checkpoint_lora_rank(path: str | Path) -> int | None:
         if "_lora_a" in jax.tree_util.keystr(key_path):
           return int(leaf.shape[-1])
     except Exception:  # noqa: BLE001 — orbax metadata API drift: fall through
-      return None
+      pass
+    return None
+  npz_path = path.with_suffix(".npz")
+  if npz_path.exists():
+    data = np.load(str(npz_path))
+    for k in data.files:
+      if "_lora_a" in k:
+        return int(data[k].shape[-1])
   return None
