@@ -70,6 +70,24 @@ def test_export_roundtrip_through_hf(tmp_path, family):
   np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("family", ["phi3", "mixtral", "qwen2-moe"])
+def test_export_roundtrip_fused_and_moe(tmp_path, family):
+  """phi3 re-fuses qkv/gate_up; MoE families unstack experts + routers
+  (+ qwen2-moe's gated shared expert) back to HF names. Verified through
+  HF's own forward, reusing the golden harness's tiny builders."""
+  from tests.test_hf_golden import _save_tiny_hf
+
+  _save_tiny_hf(tmp_path, "qwen2-moe" if family == "qwen2-moe" else family)
+  ref = _hf_logits(tmp_path)
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  shard = Shard("tiny", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+
+  out = export_hf_checkpoint(tmp_path / "out", cfg, params)
+  got = _hf_logits(out)
+  np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_export_merges_lora(tmp_path):
   """LoRA adapters in the tree merge into the exported base weights: HF's
   forward of the export must equal THIS repo's forward with adapters live."""
@@ -100,6 +118,6 @@ def test_export_merges_lora(tmp_path):
 def test_export_refuses_unsupported():
   from xotorch_support_jetson_tpu.models.config import tiny_test_config
 
-  moe = tiny_test_config(n_experts=4, n_active_experts=2, moe_hidden_dim=32)
+  mla = tiny_test_config(kv_lora_rank=16, qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8, family="deepseek-v2")
   with pytest.raises(NotImplementedError):
-    export_hf_checkpoint("/tmp/never", moe, {})
+    export_hf_checkpoint("/tmp/never", mla, {})

@@ -31,6 +31,9 @@ _MODEL_TYPE = {
   "qwen3": "qwen3",
   "mistral": "mistral",
   "gemma2": "gemma2",
+  "phi3": "phi3",  # fused qkv / gate_up re-fused on write
+  "mixtral": "mixtral",  # expert stacks unstacked to per-expert names
+  "qwen2-moe": "qwen2_moe",
 }
 
 
@@ -50,15 +53,16 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
   lm_head]) in the decoder layout (stacked [L, ...] leaves).
   """
   if cfg.family not in _MODEL_TYPE:
-    raise NotImplementedError(f"HF export supports {sorted(_MODEL_TYPE)}; {cfg.family!r} (MoE/MLA/fused layouts) is not exportable")
-  if cfg.n_experts or cfg.is_mla:
-    raise NotImplementedError("HF export of MoE / MLA trees is not supported")
+    raise NotImplementedError(f"HF export supports {sorted(_MODEL_TYPE)}; {cfg.family!r} (MLA layouts) is not exportable")
+  if cfg.is_mla:
+    raise NotImplementedError("HF export of MLA (deepseek) trees is not supported")
   if cfg.vision is not None:
     raise NotImplementedError("HF export of vision (llava) trees is not supported — the tower/projector would be silently dropped")
   if not isinstance(params, dict) or "embed" not in params or "final_norm" not in params:
     raise ValueError("export needs a FULL model tree (first+last shard params); mesh serving modes (pp/sp) hold params elsewhere — export from a plain load")
-  if any(k.endswith("_scale") for k in params.get("layers", {})):
-    raise NotImplementedError("params are int8/int4-quantized (XOT_TPU_QUANT); export from an unquantized load — casting quantized codes to float would silently corrupt the checkpoint")
+  for stack_key in ("layers", "moe_layers"):
+    if any(k.endswith("_scale") for k in params.get(stack_key, {})):
+      raise NotImplementedError("params are int8/int4-quantized (XOT_TPU_QUANT); export from an unquantized load — casting quantized codes to float would silently corrupt the checkpoint")
 
   # LoRA adapters fold into the base weights through THE training/decode
   # merge (train/lora.py — one scale definition), not a local copy.
@@ -76,33 +80,65 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
     w = _np32(w)
     return np.ascontiguousarray(w - 1.0 if gemma else w)
 
+  phi3 = cfg.family == "phi3"
   sd: dict[str, np.ndarray] = {"model.embed_tokens.weight": _np32(params["embed"])}
-  stack = params["layers"]
-  L = stack["attn_norm"].shape[0]
-  for i in range(L):
-    p = {k: v[i] for k, v in stack.items()}
-    pre = f"model.layers.{i}"
-    sd[f"{pre}.input_layernorm.weight"] = norm(p["attn_norm"])
-    sd[f"{pre}.self_attn.q_proj.weight"] = _lin(p["wq"])
-    sd[f"{pre}.self_attn.k_proj.weight"] = _lin(p["wk"])
-    sd[f"{pre}.self_attn.v_proj.weight"] = _lin(p["wv"])
-    sd[f"{pre}.self_attn.o_proj.weight"] = _lin(p["wo"])
-    if "bq" in p:
-      sd[f"{pre}.self_attn.q_proj.bias"] = _np32(p["bq"])
-      sd[f"{pre}.self_attn.k_proj.bias"] = _np32(p["bk"])
-      sd[f"{pre}.self_attn.v_proj.bias"] = _np32(p["bv"])
-    if "q_norm" in p:  # qwen3 per-head q/k RMSNorm
-      sd[f"{pre}.self_attn.q_norm.weight"] = _np32(p["q_norm"])
-      sd[f"{pre}.self_attn.k_norm.weight"] = _np32(p["k_norm"])
-    if gemma:  # four-norm layout
-      sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["post_attn_norm"])
-      sd[f"{pre}.pre_feedforward_layernorm.weight"] = norm(p["mlp_norm"])
-      sd[f"{pre}.post_feedforward_layernorm.weight"] = norm(p["post_mlp_norm"])
-    else:
-      sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["mlp_norm"])
-    sd[f"{pre}.mlp.gate_proj.weight"] = _lin(p["w_gate"])
-    sd[f"{pre}.mlp.up_proj.weight"] = _lin(p["w_up"])
-    sd[f"{pre}.mlp.down_proj.weight"] = _lin(p["w_down"])
+  # MoE stacks live under "moe_layers" (dense-prefix models) or "layers".
+  stacks = [params[k] for k in ("layers", "moe_layers") if k in params]
+  i = -1
+  for stack in stacks:
+    L = stack["attn_norm"].shape[0]
+    for li in range(L):
+      i += 1
+      p = {k: v[li] for k, v in stack.items()}
+      pre = f"model.layers.{i}"
+      sd[f"{pre}.input_layernorm.weight"] = norm(p["attn_norm"])
+      if phi3:  # fused projections, as the HF checkpoint stores them
+        sd[f"{pre}.self_attn.qkv_proj.weight"] = np.concatenate([_lin(p["wq"]), _lin(p["wk"]), _lin(p["wv"])], axis=0)
+      else:
+        sd[f"{pre}.self_attn.q_proj.weight"] = _lin(p["wq"])
+        sd[f"{pre}.self_attn.k_proj.weight"] = _lin(p["wk"])
+        sd[f"{pre}.self_attn.v_proj.weight"] = _lin(p["wv"])
+      sd[f"{pre}.self_attn.o_proj.weight"] = _lin(p["wo"])
+      if "bq" in p:
+        sd[f"{pre}.self_attn.q_proj.bias"] = _np32(p["bq"])
+        sd[f"{pre}.self_attn.k_proj.bias"] = _np32(p["bk"])
+        sd[f"{pre}.self_attn.v_proj.bias"] = _np32(p["bv"])
+      if "q_norm" in p:  # qwen3 per-head q/k RMSNorm
+        sd[f"{pre}.self_attn.q_norm.weight"] = _np32(p["q_norm"])
+        sd[f"{pre}.self_attn.k_norm.weight"] = _np32(p["k_norm"])
+      if gemma:  # four-norm layout
+        sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["post_attn_norm"])
+        sd[f"{pre}.pre_feedforward_layernorm.weight"] = norm(p["mlp_norm"])
+        sd[f"{pre}.post_feedforward_layernorm.weight"] = norm(p["post_mlp_norm"])
+      else:
+        sd[f"{pre}.post_attention_layernorm.weight"] = norm(p["mlp_norm"])
+      if "w_experts_gate" in p:  # routed MoE: unstack experts to HF names
+        E = p["w_experts_gate"].shape[0]
+        if cfg.family == "mixtral":
+          sd[f"{pre}.block_sparse_moe.gate.weight"] = _lin(p["w_router"])
+          for e in range(E):
+            sd[f"{pre}.block_sparse_moe.experts.{e}.w1.weight"] = _lin(p["w_experts_gate"][e])
+            sd[f"{pre}.block_sparse_moe.experts.{e}.w3.weight"] = _lin(p["w_experts_up"][e])
+            sd[f"{pre}.block_sparse_moe.experts.{e}.w2.weight"] = _lin(p["w_experts_down"][e])
+        else:  # qwen2-moe
+          sd[f"{pre}.mlp.gate.weight"] = _lin(p["w_router"])
+          for e in range(E):
+            sd[f"{pre}.mlp.experts.{e}.gate_proj.weight"] = _lin(p["w_experts_gate"][e])
+            sd[f"{pre}.mlp.experts.{e}.up_proj.weight"] = _lin(p["w_experts_up"][e])
+            sd[f"{pre}.mlp.experts.{e}.down_proj.weight"] = _lin(p["w_experts_down"][e])
+          if "w_shared_gate" in p:
+            sd[f"{pre}.mlp.shared_expert.gate_proj.weight"] = _lin(p["w_shared_gate"])
+            sd[f"{pre}.mlp.shared_expert.up_proj.weight"] = _lin(p["w_shared_up"])
+            sd[f"{pre}.mlp.shared_expert.down_proj.weight"] = _lin(p["w_shared_down"])
+          if "w_shared_expert_gate" in p:
+            sd[f"{pre}.mlp.shared_expert_gate.weight"] = _lin(p["w_shared_expert_gate"])
+      elif phi3:
+        sd[f"{pre}.mlp.gate_up_proj.weight"] = np.concatenate([_lin(p["w_gate"]), _lin(p["w_up"])], axis=0)
+        sd[f"{pre}.mlp.down_proj.weight"] = _lin(p["w_down"])
+      else:
+        sd[f"{pre}.mlp.gate_proj.weight"] = _lin(p["w_gate"])
+        sd[f"{pre}.mlp.up_proj.weight"] = _lin(p["w_up"])
+        sd[f"{pre}.mlp.down_proj.weight"] = _lin(p["w_down"])
   sd["model.norm.weight"] = norm(params["final_norm"])
   tied = "lm_head" not in params
   if not tied:
@@ -153,6 +189,18 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
       hidden_act="gelu_pytorch_tanh",
       hidden_activation="gelu_pytorch_tanh",
     )
+  if cfg.n_experts:
+    hf_cfg.update(num_experts_per_tok=cfg.n_active_experts, norm_topk_prob=cfg.norm_topk_prob)
+    if cfg.family == "mixtral":
+      hf_cfg["num_local_experts"] = cfg.n_experts
+    else:  # qwen2-moe
+      hf_cfg.update(
+        num_experts=cfg.n_experts,
+        moe_intermediate_size=cfg.moe_hidden_dim,
+        shared_expert_intermediate_size=cfg.shared_expert_dim,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+      )
   (out_dir / "config.json").write_text(json.dumps(hf_cfg, indent=2))
   return out_dir
 
@@ -164,4 +212,7 @@ def _arch(family: str) -> str:
     "qwen3": "Qwen3ForCausalLM",
     "mistral": "MistralForCausalLM",
     "gemma2": "Gemma2ForCausalLM",
+    "phi3": "Phi3ForCausalLM",
+    "mixtral": "MixtralForCausalLM",
+    "qwen2-moe": "Qwen2MoeForCausalLM",
   }[family]
