@@ -88,6 +88,27 @@ def test_export_roundtrip_fused_and_moe(tmp_path, family):
   np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_export_carries_source_token_ids(tmp_path):
+  """Regression (round-4 phi3 break): exported configs must carry the source's
+  bos/pad/eos token ids. Dropping them lets transformers re-apply architecture
+  defaults on import — Phi3Config's pad_token_id=32000 crashes nn.Embedding
+  for any vocab smaller than that."""
+  import json
+
+  from tests.test_hf_golden import _save_tiny_hf
+
+  _save_tiny_hf(tmp_path, "phi3")
+  src_cfg = json.loads((tmp_path / "config.json").read_text())
+  cfg = load_model_config(tmp_path, dtype=jnp.float32)
+  shard = Shard("tiny", 0, cfg.n_layers - 1, cfg.n_layers)
+  params = load_shard_weights(tmp_path, cfg, shard)
+
+  out = export_hf_checkpoint(tmp_path / "out", cfg, params)
+  got_cfg = json.loads((out / "config.json").read_text())
+  for key in ("bos_token_id", "pad_token_id", "eos_token_id"):
+    assert got_cfg.get(key) == src_cfg.get(key), f"{key}: exported {got_cfg.get(key)!r} != source {src_cfg.get(key)!r}"
+
+
 def test_export_merges_lora(tmp_path):
   """LoRA adapters in the tree merge into the exported base weights: HF's
   forward of the export must equal THIS repo's forward with adapters live."""

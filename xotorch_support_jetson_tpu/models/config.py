@@ -82,7 +82,17 @@ class ModelConfig:
   tied_embedding: bool = False
   family: str = "llama"
   dtype: Any = jnp.bfloat16
+  # Quantized-matmul compute mode for int8 weights ("w8a16" | "w8a8"); ""
+  # defers to the process-wide XOT_TPU_QUANT_COMPUTE. Lives on the config —
+  # a STATIC jit argument — so swapping modes via dataclasses.replace keys a
+  # fresh compiled program (models/decoder.py _mm).
+  quant_compute: str = ""
   eos_token_ids: tuple[int, ...] = ()
+  # bos/pad ids ride along so hf_export can reproduce the source config
+  # verbatim — dropping them lets transformers re-apply architecture defaults
+  # (e.g. Phi3Config's pad_token_id=32000) that can be out of vocab range.
+  bos_token_id: int | None = None
+  pad_token_id: int | None = None
   # --- MoE (ops/moe.py). n_experts == 0 ⇒ dense model; first_k_dense layers
   # stay dense even in an MoE model (deepseek puts layer 0 dense).
   n_experts: int = 0
@@ -368,6 +378,8 @@ def config_from_hf(hf: dict, dtype=None) -> ModelConfig:
     family=family,
     dtype=dtype or dtype_map.get(torch_dtype, jnp.bfloat16),
     eos_token_ids=tuple(int(e) for e in eos),
+    bos_token_id=None if hf.get("bos_token_id") is None else int(hf["bos_token_id"]),
+    pad_token_id=None if hf.get("pad_token_id") is None else int(hf["pad_token_id"]),
     vision=vision_cfg,
     image_token_id=image_token_id,
     **moe,

@@ -170,8 +170,17 @@ def export_hf_checkpoint(out_dir: str | Path, cfg: ModelConfig, params: dict, dt
     "torch_dtype": dtype,  # legacy key; transformers ≥4.56 reads "dtype"
     "dtype": dtype,
   }
+  if cfg.partial_rotary_factor != 1.0:  # phi3/phi-4: rope only leading channels
+    hf_cfg["partial_rotary_factor"] = cfg.partial_rotary_factor
   if cfg.eos_token_ids:
     hf_cfg["eos_token_id"] = list(cfg.eos_token_ids) if len(cfg.eos_token_ids) > 1 else cfg.eos_token_ids[0]
+  # Carry the source's bos/pad ids verbatim. Omitting them lets transformers
+  # re-apply architecture defaults on import — Phi3Config defaults
+  # pad_token_id=32000, which crashes nn.Embedding for any smaller vocab.
+  if cfg.bos_token_id is not None:
+    hf_cfg["bos_token_id"] = cfg.bos_token_id
+  if cfg.pad_token_id is not None:
+    hf_cfg["pad_token_id"] = cfg.pad_token_id
   if isinstance(cfg.rope_scaling, RopeScaling):
     hf_cfg["rope_scaling"] = {
       "rope_type": "llama3",
