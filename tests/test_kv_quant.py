@@ -1,0 +1,187 @@
+"""int8 KV-cache quantization (models/quantize.py quantize_kv + the factored
+attention read in ops/attention.py).
+
+The reference has no KV quantization (its long-context story is absent —
+SURVEY.md §5.7 greenfield); here it attacks the measured cache-read wall
+(~35-45 GB/s effective at 32K, flash_decode_supported's rationale): halving
+cached bytes ≈ halving long-context decode latency and doubling paged-pool
+residency. Fidelity contract: the factored int8 path (codes in the einsum,
+scales outside the contraction) must equal explicit dequantize-then-attend
+to float-associativity noise, and end-to-end logits must track the bf16-cache
+engine within quantization tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.shard import Shard
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import (
+  full_model_params,
+  fused_batch_decode,
+  fused_decode,
+  fused_paged_batch_decode,
+  init_kv_cache,
+  kv_quant_mode,
+  prefill_into_slots,
+  shard_forward,
+)
+from xotorch_support_jetson_tpu.models.quantize import dequantize_kv, quantize_kv
+from xotorch_support_jetson_tpu.ops.attention import gqa_attention
+
+
+def test_quantize_kv_roundtrip_bound():
+  """Per-(token, head) symmetric int8: |x - deq(q(x))| <= scale/2 = absmax/254."""
+  x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 64), dtype=jnp.float32) * 3.0
+  codes, scale = quantize_kv(x)
+  assert codes.dtype == jnp.int8 and scale.shape == (2, 16, 4, 1)
+  err = jnp.abs(dequantize_kv(codes, scale, jnp.float32) - x)
+  bound = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 254.0 + 1e-6
+  assert bool(jnp.all(err <= bound))
+
+
+@pytest.mark.parametrize("opts", [{}, {"logit_softcap": 30.0, "sliding_window": 5}])
+def test_factored_int8_attention_equals_dequant(opts):
+  """gqa_attention(k_scale=, v_scale=) — scales applied OUTSIDE the einsum —
+  must equal attending over the explicitly dequantized cache (the two differ
+  only in float association). Softcap/window must see the TRUE (descaled)
+  scores, hence the parametrized gemma2-style case."""
+  key = jax.random.PRNGKey(1)
+  B, Sq, Skv, Hq, Hkv, hd = 2, 1, 32, 8, 2, 16
+  q = jax.random.normal(key, (B, Sq, Hq, hd), dtype=jnp.float32)
+  k = jax.random.normal(jax.random.fold_in(key, 1), (B, Skv, Hkv, hd), dtype=jnp.float32)
+  v = jax.random.normal(jax.random.fold_in(key, 2), (B, Skv, Hkv, hd), dtype=jnp.float32)
+  q_pos = jnp.full((B, Sq), Skv - 1, jnp.int32)
+  kv_pos = jnp.arange(Skv, dtype=jnp.int32)
+
+  kq, ks = quantize_kv(k)
+  vq, vs = quantize_kv(v)
+  got = gqa_attention(q, kq, vq, q_pos, kv_pos, k_scale=ks, v_scale=vs, **opts)
+  want = gqa_attention(q, dequantize_kv(kq, ks, jnp.float32), dequantize_kv(vq, vs, jnp.float32), q_pos, kv_pos, **opts)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def _tiny(seed=0, **kw):
+  cfg = tiny_test_config(dtype=jnp.float32, **kw)
+  params, shard = full_model_params(jax.random.PRNGKey(seed), cfg)
+  return cfg, params, shard
+
+
+def test_shard_forward_int8kv_logits_close():
+  """Teacher-forced prefill + decode logits with the quantized cache track
+  the bf16-cache path within quantization tolerance (same tokens, so error
+  cannot compound through sampling)."""
+  cfg, params, shard = _tiny()
+  toks = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0, cfg.vocab_size)
+  positions = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+
+  logits_ref, cache_ref = shard_forward(params, cfg, shard, toks, positions, init_kv_cache(cfg, cfg.n_layers, 1, 32, quant=""))
+  logits_q, cache_q = shard_forward(params, cfg, shard, toks, positions, init_kv_cache(cfg, cfg.n_layers, 1, 32, quant="int8"))
+  assert cache_q["k"].dtype == jnp.int8 and "k_scale" in cache_q
+  np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_ref), rtol=0.08, atol=0.15)
+  # greedy continuation agrees on the argmax trajectory for this fixture
+  for step in range(4):
+    tok = jnp.argmax(logits_ref[:, -1:, :], axis=-1).astype(jnp.int32)
+    pos = jnp.full((1, 1), 8 + step, jnp.int32)
+    logits_ref, cache_ref = shard_forward(params, cfg, shard, tok, pos, cache_ref)
+    logits_q, cache_q = shard_forward(params, cfg, shard, tok, pos, cache_q)
+    np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_ref), rtol=0.08, atol=0.15)
+
+
+def test_fused_decode_int8kv_matches_stepped():
+  """The fused decode scan over a quantized cache must reproduce the
+  manually-stepped shard_forward loop over the SAME quantized cache exactly
+  (token-for-token) — validates the scan carries codes+scales correctly.
+  (Trajectory agreement vs the bf16 cache is not asserted: random tiny
+  weights give near-uniform logits where any argmax tie-flip desyncs the
+  rest; the teacher-forced logit-closeness test above is the fidelity
+  check.)"""
+  cfg, params, shard = _tiny(seed=5)
+  tok = jnp.ones((1, 1), jnp.int32)
+  n = 12
+  t_fused, _ = fused_decode(params, cfg, shard, tok, init_kv_cache(cfg, cfg.n_layers, 1, 64, quant="int8"), jnp.zeros((1,), jnp.int32), n)
+
+  cache = init_kv_cache(cfg, cfg.n_layers, 1, 64, quant="int8")
+  cur, out = tok, []
+  for step in range(n):
+    logits, cache = shard_forward(params, cfg, shard, cur, jnp.full((1, 1), step, jnp.int32), cache)
+    cur = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+    out.append(int(cur[0, 0]))
+  np.testing.assert_array_equal(np.asarray(t_fused)[0], np.asarray(out))
+
+
+def test_paged_int8kv_matches_slot_int8kv():
+  """The paged pool with int8 KV must reproduce the dense-slot int8 path
+  EXACTLY at the token level: both quantize the same K/V at write, so the
+  only difference is page indirection."""
+  cfg, params, shard = _tiny(seed=7)
+  from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+
+  B, ps, mp = 2, 8, 4
+  tok = jnp.asarray([[3], [11]], jnp.int32)
+  positions = jnp.zeros((B,), jnp.int32)
+  active = jnp.ones((B,), bool)
+  temps = jnp.zeros((B,), jnp.float32)
+
+  cache = init_kv_cache(cfg, cfg.n_layers, B, ps * mp, quant="int8")
+  t_slot, _, _ = fused_batch_decode(params, cfg, shard, tok, cache, positions, active, temps, 10)
+
+  pool = init_paged_pool(cfg, cfg.n_layers, 1 + B * mp, ps, quant="int8")
+  assert pool["k"].dtype == jnp.int8 and "k_scale" in pool
+  bt = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+  t_paged, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, bt, positions, active, temps, 10, page_size=ps)
+  np.testing.assert_array_equal(np.asarray(t_slot), np.asarray(t_paged))
+
+
+def test_prefill_into_slots_int8kv():
+  """Batched slot admission writes quantized K/V through the dict-generic
+  scatter; decode logits from the pooled rows track the unquantized pool."""
+  cfg, params, shard = _tiny(seed=9)
+  B, S = 4, 8
+  toks = jax.random.randint(jax.random.PRNGKey(11), (2, S), 1, cfg.vocab_size)
+  rows = jnp.asarray([0, 2], jnp.int32)
+  lens = jnp.asarray([S, S - 2], jnp.int32)
+
+  out = {}
+  for quant in ("", "int8"):
+    cache = init_kv_cache(cfg, cfg.n_layers, B, 32, quant=quant)
+    logits, cache = prefill_into_slots(params, cfg, shard, toks, cache, rows, lens)
+    out[quant or "ref"] = logits
+  np.testing.assert_allclose(np.asarray(out["int8"]), np.asarray(out["ref"]), rtol=0.08, atol=0.15)
+
+
+def test_kv_quant_mode_mla_refuses_quietly():
+  """MLA (deepseek) caches the latent — quantization is declined, not an
+  error: the cache allocates in model dtype and the engine path is unchanged."""
+  mla = tiny_test_config(kv_lora_rank=16, qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8, family="deepseek-v2", dtype=jnp.float32)
+  assert kv_quant_mode(mla, "int8") == ""
+  cache = init_kv_cache(mla, mla.n_layers, 1, 16, quant="int8")
+  assert cache["k"].dtype == jnp.float32 and "k_scale" not in cache
+  with pytest.raises(ValueError):
+    kv_quant_mode(tiny_test_config(), "int3")
+
+
+def test_sp_serving_int8kv_matches_single_device():
+  """SPServing with a quantized cache: the rank-local scale application
+  commutes with the cross-rank stat merge, so sp decode must match the
+  single-device quantized path."""
+  from jax.sharding import Mesh
+
+  devs = jax.devices()
+  if len(devs) < 2:
+    pytest.skip("needs the virtual multi-device mesh")
+  from xotorch_support_jetson_tpu.parallel.sp_serving import SPServing
+
+  cfg, params, shard = _tiny(seed=13)
+  mesh = Mesh(np.array(devs[:2]).reshape(2, 1), ("sp", "tp"))
+  sps = SPServing(mesh, cfg, params, 2, True, True)
+
+  tok = jnp.full((1, 1), 2, jnp.int32)
+  cache_1d = init_kv_cache(cfg, cfg.n_layers, 1, 32, quant="int8")
+  t_ref, _ = fused_decode(params, cfg, shard, tok, cache_1d, jnp.zeros((1,), jnp.int32), 12)
+
+  cache_sp = sps.place_cache(init_kv_cache(cfg, cfg.n_layers, 1, 32, quant="int8"))
+  t_sp, _ = sps.fused_decode(tok, cache_sp, jnp.zeros((1,), jnp.int32), 12)
+  np.testing.assert_array_equal(np.asarray(t_ref), np.asarray(t_sp))

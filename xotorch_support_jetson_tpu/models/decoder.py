@@ -45,27 +45,58 @@ Params = dict
 QUANT_COMPUTE = os.getenv("XOT_TPU_QUANT_COMPUTE", "w8a16")
 
 
-def _mm(x: jnp.ndarray, p: Params, name: str) -> jnp.ndarray:
-  """x @ p[name], transparently dequantizing int8 leaves (``<name>_scale``)."""
+def _mm(x: jnp.ndarray, p: Params, name: str, compute: str = "") -> jnp.ndarray:
+  """x @ p[name], transparently dequantizing int8 leaves (``<name>_scale``).
+
+  ``compute`` (normally ``cfg.quant_compute``) selects the quantized matmul
+  mode per-trace; "" falls back to the process-wide XOT_TPU_QUANT_COMPUTE.
+  Because cfg is a STATIC jit argument, a caller that swaps the mode via
+  ``dataclasses.replace(cfg, quant_compute=...)`` gets a fresh compiled
+  program — mutating the module global would silently reuse stale traces."""
   if f"{name}_scale" in p:
-    return qdot(x, p[name], p[f"{name}_scale"], QUANT_COMPUTE)
+    return qdot(x, p[name], p[f"{name}_scale"], compute or QUANT_COMPUTE)
   return x @ p[name]
 
 
 # ---------------------------------------------------------------- KV cache
 
 
-def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: int, dtype=None) -> Params:
+def kv_quant_mode(cfg: ModelConfig, quant: str | None = None) -> str:
+  """Resolve the KV-cache quantization mode: explicit arg wins, else the
+  ``XOT_TPU_KV_QUANT`` env ("" or "int8"). MLA (deepseek) caches the latent —
+  already 9-71× smaller than per-head K/V — and reconstructs BOTH k and v
+  from it, so quantization there is all risk and little bandwidth; it stays
+  in model dtype."""
+  mode = os.getenv("XOT_TPU_KV_QUANT", "") if quant is None else quant
+  if mode not in ("", "int8"):
+    raise ValueError(f"XOT_TPU_KV_QUANT supports '' or 'int8'; got {mode!r}")
+  return "" if cfg.is_mla else mode
+
+
+def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: int, dtype=None, quant: str | None = None) -> Params:
   """Slot-indexed KV cache: slot j holds the KV of absolute position j.
 
   Geometry comes from the config: GQA heads for dense models; for MLA
   (deepseek) the cache is the *latent* — "k" holds the shared kv latent
   (kv_lora_rank wide), "v" the MQA rope channel (qk_rope_head_dim), one
   head axis entry (see ops/attention.py mla_absorbed_attention).
+
+  ``quant="int8"`` (default from ``XOT_TPU_KV_QUANT``; dense models only —
+  see kv_quant_mode) stores int8 codes plus per-(token, head) f32 scale
+  leaves ``k_scale``/``v_scale`` shaped [..., 1] — same rank and axis
+  semantics as the codes, so slot/page/sp plumbing is layout-blind to them.
   """
   dtype = dtype or cfg.dtype
   k_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_k_dim)
   v_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_v_dim)
+  if kv_quant_mode(cfg, quant):
+    scale_shape = k_shape[:-1] + (1,)
+    return {
+      "k": jnp.zeros(k_shape, dtype=jnp.int8),
+      "v": jnp.zeros(v_shape, dtype=jnp.int8),
+      "k_scale": jnp.ones(scale_shape, dtype=jnp.float32),
+      "v_scale": jnp.ones(scale_shape, dtype=jnp.float32),
+    }
   return {"k": jnp.zeros(k_shape, dtype=dtype), "v": jnp.zeros(v_shape, dtype=dtype)}
 
 
@@ -215,18 +246,18 @@ def _mla_latents(x, p, cfg: ModelConfig, positions, inv_freq):
   # LoRA adapters attach to the per-head q up-projection (wq or wq_b) and the
   # kv up-projection wkv_b (train/lora.py maps wv→wkv_b for MLA).
   if "wq_a" in p:
-    ql = rms_norm(_mm(x, p, "wq_a"), p["q_a_norm"], _MLA_NORM_EPS)
-    q = _mm(ql, p, "wq_b")
+    ql = rms_norm(_mm(x, p, "wq_a", cfg.quant_compute), p["q_a_norm"], _MLA_NORM_EPS)
+    q = _mm(ql, p, "wq_b", cfg.quant_compute)
     if "wq_b_lora_a" in p:
       q = q + ((ql @ p["wq_b_lora_a"]) @ p["wq_b_lora_b"]) * 2.0
   else:
-    q = _mm(x, p, "wq")
+    q = _mm(x, p, "wq", cfg.quant_compute)
     if "wq_lora_a" in p:
       q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
   q = q.reshape(B, S, H, nope + rope)
   q_nope, q_pe = q[..., :nope], q[..., nope:]
 
-  kv_a = _mm(x, p, "wkv_a")  # [B, S, kv_lora_rank + rope]
+  kv_a = _mm(x, p, "wkv_a", cfg.quant_compute)  # [B, S, kv_lora_rank + rope]
   c_kv = rms_norm(kv_a[..., : cfg.kv_lora_rank], p["kv_a_norm"], _MLA_NORM_EPS)
 
   m = rope_attention_factor(cfg)
@@ -267,9 +298,9 @@ def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
   layer step below and the paged decode step (``_paged_layer_step``).
   """
   B, S, _ = x.shape
-  q = _mm(x, p, "wq")
-  k = _mm(x, p, "wk")
-  v = _mm(x, p, "wv")
+  q = _mm(x, p, "wq", cfg.quant_compute)
+  k = _mm(x, p, "wk", cfg.quant_compute)
+  v = _mm(x, p, "wv", cfg.quant_compute)
   # LoRA adapters (train/lora.py): alpha = 2·rank, so the scale is always 2.
   if "wq_lora_a" in p:
     q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
@@ -349,24 +380,26 @@ def _mlp_block(h, p, cfg: ModelConfig):
       group_mode=cfg.group_mode,
     )
     if "w_shared_gate" in p:
-      shared = jax.nn.silu(_mm(xt, p, "w_shared_gate").astype(jnp.float32)).astype(h.dtype) * _mm(xt, p, "w_shared_up")
-      shared = _mm(shared, p, "w_shared_down")
+      shared = jax.nn.silu(_mm(xt, p, "w_shared_gate", cfg.quant_compute).astype(jnp.float32)).astype(h.dtype) * _mm(xt, p, "w_shared_up", cfg.quant_compute)
+      shared = _mm(shared, p, "w_shared_down", cfg.quant_compute)
       if "w_shared_expert_gate" in p:  # qwen2-moe sigmoid-gated shared expert
         shared = shared * jax.nn.sigmoid((xt @ p["w_shared_expert_gate"]).astype(jnp.float32)).astype(h.dtype)
       out = out + shared
     h = h + out.reshape(B, S, D)
   else:
-    gated = _mlp_act(_mm(x, p, "w_gate"), cfg).astype(h.dtype) * _mm(x, p, "w_up")
-    out = _mm(gated, p, "w_down")
+    gated = _mlp_act(_mm(x, p, "w_gate", cfg.quant_compute), cfg).astype(h.dtype) * _mm(x, p, "w_up", cfg.quant_compute)
+    out = _mm(gated, p, "w_down", cfg.quant_compute)
     if "post_mlp_norm" in p:  # gemma2 post-feedforward layernorm
       out = rms_norm(out, p["post_mlp_norm"], cfg.norm_eps)
     h = h + out
   return h, aux
 
 
-def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
-  """One decoder layer. h [B,S,D] → (h, new_k_cache, new_v_cache, aux).
+def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
+  """One decoder layer. h [B,S,D] → (h, new_kv, aux).
 
+  ``kv`` is this layer's cache dict ({"k", "v"} [+ "k_scale"/"v_scale" when
+  int8-quantized — init_kv_cache]) or None on the cache-less path.
   ``aux`` is the MoE load-balancing loss for this layer (0.0 for dense
   layers); the training path accumulates it (parallel/train_step.py).
   ``attn_fn(q, k, v, q_pos, kv_pos)`` overrides the attention op on the
@@ -385,13 +418,15 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
 
     q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
     start = positions[:, 0]
-    k_cache = _write_cache(k_cache, c_kv[:, :, None, :], start)
-    v_cache = _write_cache(v_cache, k_pe[:, :, None, :], start)
+    kv = {
+      "k": _write_cache(kv["k"], c_kv[:, :, None, :], start),
+      "v": _write_cache(kv["v"], k_pe[:, :, None, :], start),
+    }
     attn = mla_absorbed_attention(
       q_nope,
       q_pe,
-      k_cache[:, :, 0, :].astype(h.dtype),
-      v_cache[:, :, 0, :].astype(h.dtype),
+      kv["k"][:, :, 0, :].astype(h.dtype),
+      kv["v"][:, :, 0, :].astype(h.dtype),
       _mla_w_kv_b(p, h.dtype),
       positions,
       kv_positions,
@@ -405,33 +440,58 @@ def _layer_step(h, layer_params, k_cache, v_cache, positions, kv_positions, inv_
 
     if use_cache:
       start = positions[:, 0]
-      k_cache = _write_cache(k_cache, k, start)
-      v_cache = _write_cache(v_cache, v, start)
       from ..ops.pallas_attention import flash_attention_prefill, flash_decode_attention, flash_decode_supported, flash_supported
 
-      # The Pallas kernels don't implement gemma2's softcap/sliding window.
-      if cfg.plain_attention and S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
-        # Prefill on TPU: flash kernel against the full cache (stale slots
-        # beyond the prompt are positionally masked — slot index > position).
-        attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=positions[:, 0])
-      elif cfg.plain_attention and S == 1 and not cfg.is_mla and flash_decode_supported(q.shape, k_cache.shape[1]):
-        # Long-cache decode step via the split-K flash-decode kernel —
-        # opt-in; see flash_decode_supported for the measured rationale.
-        attn = flash_decode_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions)
+      if "k_scale" in kv:  # int8 KV (models/quantize.py quantize_kv)
+        from .quantize import dequantize_kv, quantize_kv
+
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        kv = {
+          "k": _write_cache(kv["k"], kq, start),
+          "k_scale": _write_cache(kv["k_scale"], ks, start),
+          "v": _write_cache(kv["v"], vq, start),
+          "v_scale": _write_cache(kv["v_scale"], vs, start),
+        }
+        if cfg.plain_attention and S > 1 and flash_supported(q.shape, kv["k"].shape[1]):
+          # Prefill: the flash kernel wants materialized bf16 operands; the
+          # dequant copy is one pass over the cache, amortized across the
+          # whole chunk's queries (prefill is MXU-bound, decode is not).
+          attn = flash_attention_prefill(
+            q, dequantize_kv(kv["k"], kv["k_scale"], h.dtype), dequantize_kv(kv["v"], kv["v_scale"], h.dtype), q_offset=positions[:, 0]
+          )
+        else:
+          # Decode reads the cache as int8 CODES — the convert fuses into
+          # the einsum, so the HBM-bound cache read moves half the bytes.
+          attn = gqa_attention(
+            q, kv["k"], kv["v"], positions, kv_positions, k_scale=kv["k_scale"], v_scale=kv["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
+          )
       else:
-        attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions, **_attn_opts(cfg, p.get("is_sliding")))
+        kv = {"k": _write_cache(kv["k"], k, start), "v": _write_cache(kv["v"], v, start)}
+        k_cache, v_cache = kv["k"], kv["v"]
+        # The Pallas kernels don't implement gemma2's softcap/sliding window.
+        if cfg.plain_attention and S > 1 and not cfg.is_mla and flash_supported(q.shape, k_cache.shape[1]):
+          # Prefill on TPU: flash kernel against the full cache (stale slots
+          # beyond the prompt are positionally masked — slot index > position).
+          attn = flash_attention_prefill(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), q_offset=positions[:, 0])
+        elif cfg.plain_attention and S == 1 and not cfg.is_mla and flash_decode_supported(q.shape, k_cache.shape[1]):
+          # Long-cache decode step via the split-K flash-decode kernel —
+          # opt-in; see flash_decode_supported for the measured rationale.
+          attn = flash_decode_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions)
+        else:
+          attn = gqa_attention(q, k_cache.astype(h.dtype), v_cache.astype(h.dtype), positions, kv_positions, **_attn_opts(cfg, p.get("is_sliding")))
     else:
       # The override (ring sp — parallel/ring_attention.py) takes the same
       # attention options as gqa_attention, so gemma2's scale/softcap/window
       # ride through either path.
       attn = (attn_fn or gqa_attention)(q, k, v, positions, positions[0], **_attn_opts(cfg, p.get("is_sliding")))
 
-  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo", cfg.quant_compute)
   if "post_attn_norm" in p:  # gemma2 post-attention layernorm
     attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
   h = h + attn_out
   h, aux = _mlp_block(h, p, cfg)
-  return h, k_cache, v_cache, aux
+  return h, kv, aux
 
 
 def embed_tokens(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -452,7 +512,7 @@ def head_logits(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray
   """
   h = rms_norm(h, params["final_norm"], cfg.norm_eps)
   if "lm_head_scale" in params:
-    logits = qdot(h, params["lm_head"], params["lm_head_scale"], QUANT_COMPUTE).astype(jnp.float32)
+    logits = qdot(h, params["lm_head"], params["lm_head_scale"], cfg.quant_compute or QUANT_COMPUTE).astype(jnp.float32)
   else:
     w_out = params.get("lm_head")
     if w_out is None:
@@ -500,29 +560,26 @@ def shard_forward(
   stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
 
   if use_cache:
-    new_k_parts, new_v_parts = [], []
+    parts = []
     off = 0
     for stack in stacks:
       L = next(iter(stack.values())).shape[0]
 
       def body(carry, per_layer):
         h = carry
-        lp, kc, vc = per_layer
-        h, kc, vc, _ = _layer_step(h, lp, kc, vc, positions, kv_positions, inv_freq, cfg, True)
-        return h, (kc, vc)
+        lp, kv = per_layer
+        h, kv, _ = _layer_step(h, lp, kv, positions, kv_positions, inv_freq, cfg, True)
+        return h, kv
 
-      h, (nk, nv) = jax.lax.scan(body, h, (stack, kv_cache["k"][off : off + L], kv_cache["v"][off : off + L]))
-      new_k_parts.append(nk)
-      new_v_parts.append(nv)
+      h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in kv_cache.items()}))
+      parts.append(new_sub)
       off += L
-    new_k = new_k_parts[0] if len(new_k_parts) == 1 else jnp.concatenate(new_k_parts, axis=0)
-    new_v = new_v_parts[0] if len(new_v_parts) == 1 else jnp.concatenate(new_v_parts, axis=0)
-    new_cache: Params | None = {"k": new_k, "v": new_v}
+    new_cache: Params | None = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
   else:
 
     def body(carry, lp):
       h = carry
-      h, _, _, _ = _layer_step(h, lp, None, None, positions, kv_positions, inv_freq, cfg, False)
+      h, _, _ = _layer_step(h, lp, None, positions, kv_positions, inv_freq, cfg, False)
       return h, None
 
     for stack in stacks:
@@ -566,7 +623,7 @@ def shard_forward_aux(
 
   def body(carry, lp):
     h, a = carry
-    h, _, _, aux = _layer_step(h, lp, None, None, positions, kv_positions, inv_freq, cfg, False)
+    h, _, aux = _layer_step(h, lp, None, positions, kv_positions, inv_freq, cfg, False)
     return (h, a + aux), None
 
   a = jnp.float32(0.0)
@@ -809,10 +866,10 @@ def fused_speculative_generate(
   )
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
-def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_limit, steps: int, gamma: int, eos_ids: tuple, cfg: ModelConfig, shard: Shard):
+@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
+def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_limit, steps: int, gamma: int, eos_ids: tuple, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard):
   buf, n, _rounds, cache_t, cache_d = _fused_spec_generate_impl(
-    params_t, params_d, cfg, cfg, shard, shard, cache_t, cache_d, token, pos, steps, gamma, eos_ids, n_limit
+    params_t, params_d, cfg, cfg_d, shard, shard_d, cache_t, cache_d, token, pos, steps, gamma, eos_ids, n_limit
   )
   m = jnp.minimum(n, n_limit)
   # [m, tokens...] in ONE array: the host learns the count and the tokens in
@@ -824,7 +881,7 @@ def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_l
   return packed, seed, pos + m, cache_t, cache_d
 
 
-def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, token, cache_t, cache_d, pos, steps: int, gamma: int = 4, eos_ids: tuple = (), n_limit=None):
+def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, token, cache_t, cache_d, pos, steps: int, gamma: int = 4, eos_ids: tuple = (), n_limit=None, cfg_d: ModelConfig | None = None, shard_d: Shard | None = None):
   """One STREAMING speculative chunk with a device-resident chain.
 
   Same math as ``fused_speculative_generate`` (greedy, exact vs plain greedy
@@ -841,7 +898,8 @@ def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, 
     raise ValueError("speculative decoding requires full-model shards")
   limit = jnp.int32(steps if n_limit is None else n_limit)
   return _fused_spec_chunk_impl(
-    params_t, params_d, token, cache_t, cache_d, jnp.int32(pos) if not hasattr(pos, "dtype") else pos, limit, int(steps), int(gamma), tuple(eos_ids), cfg, shard
+    params_t, params_d, token, cache_t, cache_d, jnp.int32(pos) if not hasattr(pos, "dtype") else pos, limit, int(steps), int(gamma), tuple(eos_ids),
+    cfg, shard, cfg_d or cfg, shard_d or shard,
   )
 
 
@@ -915,11 +973,11 @@ def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool
   from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
 
   K, S = tokens.shape
-  temp = {"k": gather_row_pages(pool["k"], bt_rows), "v": gather_row_pages(pool["v"], bt_rows)}
+  temp = {key: gather_row_pages(val, bt_rows) for key, val in pool.items()}
   positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
   logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp, head_pos=prompt_lens - prefix_lens - 1)
   target = touched_page_targets(bt_rows, prefix_lens, prompt_lens, page_size)
-  pool = {"k": scatter_row_pages(pool["k"], temp["k"], target), "v": scatter_row_pages(pool["v"], temp["v"], target)}
+  pool = {key: scatter_row_pages(pool[key], temp[key], target) for key in pool}
   return logits[:, 0, :], pool
 
 
@@ -988,10 +1046,12 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
 # writes land in the reserved trash page 0).
 
 
-def _paged_layer_step(h, p, k_pool, v_pool, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool):
+def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool):
   """One decoder layer against the page pool — decode only (S == 1).
 
-  k_pool/v_pool [P, Hkv, ps, hd] (this layer's pages); positions [B, 1].
+  ``pool_l`` is this layer's page dict: {"k", "v"} [P, Hkv, ps, hd]
+  (+ "k_scale"/"v_scale" [P, Hkv, ps, 1] when int8-quantized); positions
+  [B, 1]. Returns (h, pool_l).
   """
   B, S, D = h.shape
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
@@ -1002,23 +1062,41 @@ def _paged_layer_step(h, p, k_pool, v_pool, block_tables, positions, inv_freq, c
   if "wkv_a" in p:
     # MLA: pages hold the latent ("k") and rope channel ("v"), one head entry.
     q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
-    k_pool = write_token_kv(k_pool, c_kv[:, 0][:, None, :], block_tables, pos, page_size)
-    v_pool = write_token_kv(v_pool, k_pe[:, 0][:, None, :], block_tables, pos, page_size)
+    k_pool = write_token_kv(pool_l["k"], c_kv[:, 0][:, None, :], block_tables, pos, page_size)
+    v_pool = write_token_kv(pool_l["v"], k_pe[:, 0][:, None, :], block_tables, pos, page_size)
     attn = paged_mla_attention_ref(q_nope, q_pe, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, _mla_w_kv_b(p, h.dtype), cfg.v_head_dim, page_size)
+    pool_l = {"k": k_pool, "v": v_pool}
   else:
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
-    k_pool = write_token_kv(k_pool, k[:, 0], block_tables, pos, page_size)
-    v_pool = write_token_kv(v_pool, v[:, 0], block_tables, pos, page_size)
-    if use_kernel and cfg.plain_attention:  # the Pallas kernel has no softcap/window
-      attn = paged_decode_attention(q[:, 0], k_pool, v_pool, block_tables, lengths, page_size)[:, None]
+    if "k_scale" in pool_l:  # int8 KV pages (models/quantize.py quantize_kv)
+      from .quantize import quantize_kv
+
+      kq, ks = quantize_kv(k[:, 0])
+      vq, vs = quantize_kv(v[:, 0])
+      pool_l = {
+        "k": write_token_kv(pool_l["k"], kq, block_tables, pos, page_size),
+        "k_scale": write_token_kv(pool_l["k_scale"], ks, block_tables, pos, page_size),
+        "v": write_token_kv(pool_l["v"], vq, block_tables, pos, page_size),
+        "v_scale": write_token_kv(pool_l["v_scale"], vs, block_tables, pos, page_size),
+      }
+      attn = paged_gqa_attention_ref(
+        q, pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
+        k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
+      )
     else:
-      attn = paged_gqa_attention_ref(q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size, **_attn_opts(cfg, p.get("is_sliding")))
-  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+      k_pool = write_token_kv(pool_l["k"], k[:, 0], block_tables, pos, page_size)
+      v_pool = write_token_kv(pool_l["v"], v[:, 0], block_tables, pos, page_size)
+      if use_kernel and cfg.plain_attention:  # the Pallas kernel has no softcap/window
+        attn = paged_decode_attention(q[:, 0], k_pool, v_pool, block_tables, lengths, page_size)[:, None]
+      else:
+        attn = paged_gqa_attention_ref(q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size, **_attn_opts(cfg, p.get("is_sliding")))
+      pool_l = {"k": k_pool, "v": v_pool}
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo", cfg.quant_compute)
   if "post_attn_norm" in p:  # gemma2
     attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
   h = h + attn_out
   h, _ = _mlp_block(h, p, cfg)
-  return h, k_pool, v_pool
+  return h, pool_l
 
 
 def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool):
@@ -1029,24 +1107,22 @@ def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
   h = embed_tokens(params, cfg, tokens)
   inv_freq = rope_inv_freq(cfg)
   stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
-  new_k_parts, new_v_parts = [], []
+  parts = []
   off = 0
   for stack in stacks:
     L = next(iter(stack.values())).shape[0]
 
     def body(carry, per_layer):
       h = carry
-      lp, kp, vp = per_layer
-      h, kp, vp = _paged_layer_step(h, lp, kp, vp, block_tables, positions, inv_freq, cfg, page_size, use_kernel)
-      return h, (kp, vp)
+      lp, pool_l = per_layer
+      h, pool_l = _paged_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel)
+      return h, pool_l
 
-    h, (nk, nv) = jax.lax.scan(body, h, (stack, pool["k"][off : off + L], pool["v"][off : off + L]))
-    new_k_parts.append(nk)
-    new_v_parts.append(nv)
+    h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
+    parts.append(new_sub)
     off += L
-  new_k = new_k_parts[0] if len(new_k_parts) == 1 else jnp.concatenate(new_k_parts, axis=0)
-  new_v = new_v_parts[0] if len(new_v_parts) == 1 else jnp.concatenate(new_v_parts, axis=0)
-  return head_logits(params, cfg, h), {"k": new_k, "v": new_v}
+  new_pool = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
+  return head_logits(params, cfg, h), new_pool
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
@@ -1116,7 +1192,7 @@ def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_
     L, _, Hkv, ps, hd = g.shape
     return jnp.swapaxes(g, 2, 3).reshape(L, 1, mp * ps, Hkv, hd)
 
-  temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+  temp = {key: row_gather(val) for key, val in pool.items()}
   positions = (prefix_len + jnp.arange(S, dtype=jnp.int32))[None, :]
   logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp)
 
@@ -1129,7 +1205,7 @@ def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_
     pages = jnp.swapaxes(t.reshape(L, mp, page_size, Hkv, hd), 2, 3)  # [L, mp, Hkv, ps, hd]
     return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
 
-  pool = {"k": row_scatter(pool["k"], temp["k"]), "v": row_scatter(pool["v"], temp["v"])}
+  pool = {key: row_scatter(pool[key], temp[key]) for key in pool}
   idx = (prompt_len - prefix_len - 1).reshape(1, 1, 1)
   last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (1, 1, logits.shape[-1])), axis=1)[:, 0, :]
   return last, pool
@@ -1159,7 +1235,7 @@ def score_last_tokens(params, cfg: ModelConfig, shard: Shard, tokens, seq_len, n
 
   def body(carry, lp):
     h, _aux = carry
-    h, _, _, aux = _layer_step(h, lp, None, None, positions, positions[0], inv_freq, cfg, False)
+    h, _, aux = _layer_step(h, lp, None, positions, positions[0], inv_freq, cfg, False)
     return (h, _aux + aux), None
 
   stacks = [params[name] for name in ("layers", "moe_layers") if name in params]

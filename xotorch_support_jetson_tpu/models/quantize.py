@@ -126,6 +126,38 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
   return out
 
 
+# ------------------------------------------------------------ int8 KV cache
+#
+# Long-context decode is HBM-bound on the CACHE read (measured ~35-45 GB/s
+# effective at 32K on v5e — ops/pallas_attention.py flash_decode_supported),
+# so halving cached bytes ≈ halving the cache-read time AND doubling paged-
+# pool residency. K/V vectors quantize at cache-write time, symmetric int8
+# per (token, head); the scale rides as a sibling cache leaf with a trailing
+# [..., 1] axis — SAME rank/axis semantics as the codes, so every dict-
+# generic cache path (slot gather/scatter, pp merge, sp striping, paged
+# row gather) handles it untouched. The attention read keeps the int8 codes
+# as the einsum operand (a fused convert — HBM reads stay 1 byte/element)
+# and applies the scale OUTSIDE the contraction: k's scale multiplies the
+# scores (it depends only on output dims), v's folds into the probs.
+# See ops/attention.py gqa_attention(k_scale=, v_scale=).
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Symmetric per-(token, head) int8 for KV vectors.
+
+  x [..., hd] → (codes int8 [..., hd], scale f32 [..., 1])."""
+  xf = x.astype(jnp.float32)
+  absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+  scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+  return jnp.round(xf / scale).astype(jnp.int8), scale
+
+
+def dequantize_kv(codes: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+  """codes [..., hd] × scale [..., 1] → [..., hd] in ``dtype`` — for the few
+  consumers that need materialized K/V (the Pallas flash-prefill kernel)."""
+  return (codes.astype(jnp.float32) * scale).astype(dtype)
+
+
 def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a16") -> jnp.ndarray:
   """x [..., in] @ quantized w → [..., out] in x.dtype.
 

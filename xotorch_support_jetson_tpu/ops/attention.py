@@ -21,15 +21,24 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def kv_scale_to_scores(scale_leaf: jnp.ndarray) -> jnp.ndarray:
+  """Cache scale leaf [B, Skv, Hkv, 1] → broadcastable over scores
+  [B, Hkv, group, Sq, Skv]. Shared with the sp stat-merge path so both stay
+  bit-consistent."""
+  return jnp.transpose(scale_leaf[..., 0], (0, 2, 1))[:, :, None, None, :]
+
+
 def gqa_attention(
   q: jnp.ndarray,  # [B, Sq, Hq, hd]
-  k: jnp.ndarray,  # [B, Skv, Hkv, hd]
+  k: jnp.ndarray,  # [B, Skv, Hkv, hd] (int8 codes when k_scale is given)
   v: jnp.ndarray,  # [B, Skv, Hkv, hd]
   q_positions: jnp.ndarray,  # [B, Sq] absolute positions of queries
   kv_positions: jnp.ndarray,  # [Skv] absolute positions (slot indices) of keys
   scale: float | None = None,
   logit_softcap: float = 0.0,
   sliding_window=None,  # int or traced scalar; None ⇒ global attention
+  k_scale: jnp.ndarray | None = None,  # [B, Skv, Hkv, 1] int8-KV scales
+  v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
   """Returns [B, Sq, Hq, hd_v]; softmax in fp32; output in q.dtype.
 
@@ -37,6 +46,13 @@ def gqa_attention(
   scale is 1/sqrt(qk head dim) (gemma2 overrides via query_pre_attn_scalar).
   ``logit_softcap`` applies gemma2's ``cap·tanh(s/cap)`` before masking;
   ``sliding_window`` restricts each query to the last W kv positions.
+
+  With ``k_scale``/``v_scale`` (models/quantize.py quantize_kv) k/v are int8
+  codes; the einsum operand stays the raw codes (the int8→f32 convert fuses
+  into the contraction, so HBM reads 1 byte/element — the long-context
+  decode win) and the per-(token, head) scales apply outside it: k's on the
+  scores BEFORE softcap/mask (the true score is code·scale), v's folded
+  into the probs.
   """
   B, Sq, Hq, hd = q.shape
   Hkv = k.shape[2]
@@ -48,8 +64,12 @@ def gqa_attention(
   qg = q.reshape(B, Sq, Hkv, group, hd)
   # scores: [B, Hkv, group, Sq, Skv]
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32)) * scale
+  if k_scale is not None:
+    scores = scores * kv_scale_to_scores(k_scale)
   scores = cap_and_mask_scores(scores, q_positions, kv_positions, logit_softcap, sliding_window)
   probs = jax.nn.softmax(scores, axis=-1)
+  if v_scale is not None:
+    probs = probs * kv_scale_to_scores(v_scale)
   out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
   return out.reshape(B, Sq, Hq, hd_v).astype(q.dtype)
 

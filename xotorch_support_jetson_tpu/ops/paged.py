@@ -38,15 +38,28 @@ from .attention import NEG_INF, gqa_attention, mla_absorbed_attention
 DEFAULT_PAGE_SIZE = 64
 
 
-def init_paged_pool(cfg, n_shard_layers: int, n_pages: int, page_size: int, dtype=None) -> dict:
+def init_paged_pool(cfg, n_shard_layers: int, n_pages: int, page_size: int, dtype=None, quant: str | None = None) -> dict:
   """Page pool for a shard. ``n_pages`` INCLUDES the reserved trash page 0.
 
   Geometry follows ``models/decoder.py init_kv_cache``: GQA heads for dense
   models; for MLA "k" holds the kv latent and "v" the rope channel.
+  ``quant="int8"`` (default from ``XOT_TPU_KV_QUANT``; dense only) adds
+  per-(slot, head) scale leaves [..., 1] — halving pool bytes DOUBLES the
+  contexts resident at a fixed HBM budget.
   """
+  from ..models.decoder import kv_quant_mode
+
   dtype = dtype or cfg.dtype
   k_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_k_dim)
   v_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_v_dim)
+  if kv_quant_mode(cfg, quant):
+    scale_shape = k_shape[:-1] + (1,)
+    return {
+      "k": jnp.zeros(k_shape, dtype=jnp.int8),
+      "v": jnp.zeros(v_shape, dtype=jnp.int8),
+      "k_scale": jnp.ones(scale_shape, dtype=jnp.float32),
+      "v_scale": jnp.ones(scale_shape, dtype=jnp.float32),
+    }
   return {"k": jnp.zeros(k_shape, dtype=dtype), "v": jnp.zeros(v_shape, dtype=dtype)}
 
 
@@ -107,14 +120,18 @@ def scatter_row_pages(pool_part: jnp.ndarray, t: jnp.ndarray, target: jnp.ndarra
   return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
 
 
-def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, **attn_opts) -> jnp.ndarray:
+def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, k_scale_pool_l=None, v_scale_pool_l=None, **attn_opts) -> jnp.ndarray:
   """Reference paged decode attention via gather (q [B, 1, Hq, hd]).
   ``attn_opts`` forward gemma2's scale/softcap/sliding-window
-  (models/decoder.py _attn_opts)."""
+  (models/decoder.py _attn_opts). With scale pools (int8 KV), the gathered
+  codes stay the einsum operand and the scales gather alongside — the page
+  gather itself moves int8 bytes."""
   k = gather_pages(k_pool_l, block_tables)
   v = gather_pages(v_pool_l, block_tables)
   kv_positions = jnp.arange(k.shape[1], dtype=jnp.int32)
   q_positions = (lengths - 1)[:, None]  # current token's position
+  if k_scale_pool_l is not None:
+    attn_opts = dict(attn_opts, k_scale=gather_pages(k_scale_pool_l, block_tables), v_scale=gather_pages(v_scale_pool_l, block_tables))
   return gqa_attention(q, k, v, q_positions, kv_positions, **attn_opts)
 
 

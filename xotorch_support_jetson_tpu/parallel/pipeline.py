@@ -57,7 +57,7 @@ def run_layer_stack(stage_layers: dict, h: jnp.ndarray, positions: jnp.ndarray, 
 
   def one_layer(carry, lp):
     h, aux = carry
-    out, _, _, a = _layer_step(h, lp, None, None, positions, positions[0], inv_freq, cfg, False, attn_fn)
+    out, _, a = _layer_step(h, lp, None, positions, positions[0], inv_freq, cfg, False, attn_fn)
     return (out, aux + a), None
 
   body = jax.checkpoint(one_layer) if remat else one_layer

@@ -112,19 +112,31 @@ class PPBatchedServing:
     n, P_ = self.n_prefix, self.n_stages
     stage_sharding = NamedSharding(self.mesh, P("pp"))
     out = {}
-    for key in ("k", "v"):
+    for key in full:
       pre = jnp.broadcast_to(full[key][:n][None], (P_, *full[key][:n].shape))
       out[f"{key}_pre"] = jax.device_put(pre, stage_sharding)
       out[key] = jax.device_put(full[key][n:], sharding)
     return out
 
+  def _check_keys(self, cache: dict) -> None:
+    # Same env-vs-arg guard as pp_serving.place_cache: the compiled specs
+    # were keyed off XOT_TPU_KV_QUANT at build; a cache allocated with a
+    # conflicting explicit quant= must fail HERE with the cause.
+    if set(cache) != set(self._kv_keys):
+      raise ValueError(
+        f"cache leaves {sorted(cache)} != built specs {sorted(self._kv_keys)} — "
+        "PPBatchedServing keys its programs off XOT_TPU_KV_QUANT at construction; allocate with the same mode"
+      )
+
   def place_cache(self, cache: dict) -> dict:
+    self._check_keys(cache)
     sharding = NamedSharding(self.mesh, self._cache_spec)
     if self.n_prefix:
       return self._split_prefix(cache, sharding)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
 
   def place_pool(self, pool: dict) -> dict:
+    self._check_keys(pool)
     sharding = NamedSharding(self.mesh, P("pp"))
     if self.n_prefix:
       return self._split_prefix(pool, sharding)
@@ -134,9 +146,14 @@ class PPBatchedServing:
 
   def _build(self) -> None:
     cfg, n_stages, n_prefix = self.cfg, self.n_stages, self.n_prefix
-    cache_spec = {"k": P("pp"), "v": P("pp")}
+    from ..models.decoder import kv_quant_mode
+
+    # int8-KV scale leaves ride the same specs (env-driven, known at build).
+    kv_keys = ("k", "v", "k_scale", "v_scale") if kv_quant_mode(cfg) else ("k", "v")
+    self._kv_keys = kv_keys
+    cache_spec = {key: P("pp") for key in kv_keys}
     if n_prefix:
-      cache_spec = {**cache_spec, "k_pre": P("pp"), "v_pre": P("pp")}
+      cache_spec = {**cache_spec, **{f"{key}_pre": P("pp") for key in kv_keys}}
     stage_spec = P("pp")
     sm = self._sm
 
@@ -153,16 +170,16 @@ class PPBatchedServing:
       if n_prefix:
         # Dense prefix: every stage computes the SAME prefill (tokens are
         # replicated), so each stage's pre-cache slice stays identical.
-        pre = {k: cache[f"{k}_pre"][0] for k in ("k", "v")}
+        pre = {k: cache[f"{k}_pre"][0] for k in kv_keys}
         pre_sub = {k: jnp.take(v, rows, axis=1) for k, v in pre.items()}
         h0, pre_out = _stage_forward(prefix_layers_of(head), h0, positions, pre_sub, rope_inv_freq(cfg), cfg)
         cache = {
           **cache,
-          **{f"{k}_pre": pre[k].at[:, rows].set(pre_out[k])[None] for k in ("k", "v")},
+          **{f"{k}_pre": pre[k].at[:, rows].set(pre_out[k])[None] for k in kv_keys},
         }
-      sub = {k: jnp.take(cache[k], rows, axis=1) for k in ("k", "v")}
+      sub = {k: jnp.take(cache[k], rows, axis=1) for k in kv_keys}
       h, sub = _pp_tick_loop(stage_layers, h0, positions, sub, cfg, n_stages, gather_pos=prompt_lens)
-      cache = {**cache, **{k: cache[k].at[:, rows].set(sub[k]) for k in ("k", "v")}}
+      cache = {**cache, **{k: cache[k].at[:, rows].set(sub[k]) for k in kv_keys}}
       return h, cache
 
     @jax.jit  # NOT donated: a failed prefill must leave the pool intact
@@ -184,12 +201,12 @@ class PPBatchedServing:
       h0 = embed_tokens(head, cfg, tokens)
       out = dict(pool)
       if n_prefix:
-        pre_temp = {k: row_gather(pool[f"{k}_pre"][0]) for k in ("k", "v")}
+        pre_temp = {k: row_gather(pool[f"{k}_pre"][0]) for k in kv_keys}
         h0, pre_temp = _stage_forward(prefix_layers_of(head), h0, positions, pre_temp, rope_inv_freq(cfg), cfg)
-        out.update({f"{k}_pre": row_scatter(pool[f"{k}_pre"][0], pre_temp[k])[None] for k in ("k", "v")})
-      temp = {"k": row_gather(pool["k"]), "v": row_gather(pool["v"])}
+        out.update({f"{k}_pre": row_scatter(pool[f"{k}_pre"][0], pre_temp[k])[None] for k in kv_keys})
+      temp = {key: row_gather(pool[key]) for key in kv_keys}
       h, temp = _pp_tick_loop(stage_layers, h0, positions, temp, cfg, n_stages, gather_pos=prompt_lens - prefix_lens)
-      out.update({k: row_scatter(pool[k], temp[k]) for k in ("k", "v")})
+      out.update({k: row_scatter(pool[k], temp[k]) for k in kv_keys})
       return h, out
 
     @partial(jax.jit, static_argnames=("page_size",))  # NOT donated (failed prefill)
@@ -247,20 +264,20 @@ class PPBatchedServing:
             bt_eff = paged_bt(write_ok, g)
 
             def body(h, per_layer):
-              lp, kp, vp = per_layer
-              h, kp, vp = _paged_layer_step(h, lp, kp, vp, bt_eff, cur_pos[:, None], inv_freq, cfg, page_size, False)
-              return h, (kp, vp)
+              lp, pool_l = per_layer
+              h, pool_l = _paged_layer_step(h, lp, pool_l, bt_eff, cur_pos[:, None], inv_freq, cfg, page_size, False)
+              return h, pool_l
 
-            h_out, (nk, nv) = jax.lax.scan(body, h_in, (pre_layers, cache["k_pre"][0], cache["v_pre"][0]))
-            cache = {**cache, "k_pre": nk[None], "v_pre": nv[None]}
+            h_out, new = jax.lax.scan(body, h_in, (pre_layers, {key: cache[f"{key}_pre"][0] for key in kv_keys}))
+            cache = {**cache, **{f"{key}_pre": new[key][None] for key in kv_keys}}
           else:
-            pre = {k: cache[f"{k}_pre"][0] for k in ("k", "v")}
+            pre = {k: cache[f"{k}_pre"][0] for k in kv_keys}
             sub = {k: jax.lax.dynamic_slice_in_dim(v, g * G, G, axis=1) for k, v in pre.items()}
             h_out, new_sub = _stage_forward(pre_layers, h_in, cur_pos[:, None], sub, inv_freq, cfg)
             merged = {k: _merge_written(sub[k], new_sub[k], cur_pos, 1, write_ok) for k in sub}
             cache = {
               **cache,
-              **{f"{k}_pre": jax.lax.dynamic_update_slice_in_dim(pre[k], merged[k], g * G, axis=1)[None] for k in ("k", "v")},
+              **{f"{k}_pre": jax.lax.dynamic_update_slice_in_dim(pre[k], merged[k], g * G, axis=1)[None] for k in kv_keys},
             }
           return jnp.where((stage == 0)[..., None, None], h_out, h_in), cache
 
@@ -270,16 +287,16 @@ class PPBatchedServing:
             bt_eff = paged_bt(write_ok, g)
 
             def body(h, per_layer):
-              lp, kp, vp = per_layer
-              h, kp, vp = _paged_layer_step(h, lp, kp, vp, bt_eff, cur_pos[:, None], inv_freq, cfg, page_size, False)
-              return h, (kp, vp)
+              lp, pool_l = per_layer
+              h, pool_l = _paged_layer_step(h, lp, pool_l, bt_eff, cur_pos[:, None], inv_freq, cfg, page_size, False)
+              return h, pool_l
 
-            h_out, (nk, nv) = jax.lax.scan(body, h_in, (stage_layers, cache["k"], cache["v"]))
-            return h_out, {**cache, "k": nk, "v": nv}
-          sub = {k: jax.lax.dynamic_slice_in_dim(cache[k], g * G, G, axis=1) for k in ("k", "v")}
+            h_out, new = jax.lax.scan(body, h_in, (stage_layers, {key: cache[key] for key in kv_keys}))
+            return h_out, {**cache, **{key: new[key] for key in kv_keys}}
+          sub = {k: jax.lax.dynamic_slice_in_dim(cache[k], g * G, G, axis=1) for k in kv_keys}
           h_out, new_sub = _stage_forward(stage_layers, h_in, cur_pos[:, None], sub, inv_freq, cfg)
           merged = {k: _merge_written(sub[k], new_sub[k], cur_pos, 1, write_ok) for k in sub}
-          return h_out, {**cache, **{k: jax.lax.dynamic_update_slice_in_dim(cache[k], merged[k], g * G, axis=1) for k in ("k", "v")}}
+          return h_out, {**cache, **{k: jax.lax.dynamic_update_slice_in_dim(cache[k], merged[k], g * G, axis=1) for k in kv_keys}}
 
         def tick(carry, t):
           h, tok, cache, buf, keys = carry

@@ -118,16 +118,16 @@ def _merge_written(old: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray, width
 
 
 def _stage_forward(stage_layers: dict, h: jnp.ndarray, positions: jnp.ndarray, cache: dict, inv_freq, cfg: ModelConfig):
-  """This stage's layer range with cache (lax.scan, like shard_forward)."""
+  """This stage's layer range with cache (lax.scan, like shard_forward).
+  Dict-generic over cache leaves, so int8-KV scale leaves ride through."""
   kv_positions = jnp.arange(cache["k"].shape[2], dtype=jnp.int32)
 
   def body(carry, per_layer):
-    lp, kc, vc = per_layer
-    h2, kc, vc, _ = _layer_step(carry, lp, kc, vc, positions, kv_positions, inv_freq, cfg, True)
-    return h2, (kc, vc)
+    lp, kv = per_layer
+    h2, kv, _ = _layer_step(carry, lp, kv, positions, kv_positions, inv_freq, cfg, True)
+    return h2, kv
 
-  h, (nk, nv) = jax.lax.scan(body, h, (stage_layers, cache["k"], cache["v"]))
-  return h, {"k": nk, "v": nv}
+  return jax.lax.scan(body, h, (stage_layers, cache))
 
 
 def _pp_tick_loop(stage_layers: dict, h0: jnp.ndarray, positions: jnp.ndarray, cache: dict, cfg: ModelConfig, n_stages: int, gather_pos=None):
@@ -171,14 +171,16 @@ def _run_prefix(head: dict, h: jnp.ndarray, positions: jnp.ndarray, cache: dict,
   same result before the masked-stage pipeline starts."""
   if "prefix_layers" not in head:
     return h, cache
-  h, pre = _stage_forward(head["prefix_layers"], h, positions, {"k": cache["k_pre"], "v": cache["v_pre"]}, rope_inv_freq(cfg), cfg)
-  return h, {**cache, "k_pre": pre["k"], "v_pre": pre["v"]}
+  sub = {key[: -len("_pre")]: val for key, val in cache.items() if key.endswith("_pre")}
+  h, pre = _stage_forward(head["prefix_layers"], h, positions, sub, rope_inv_freq(cfg), cfg)
+  return h, {**cache, **{f"{key}_pre": val for key, val in pre.items()}}
 
 
 def _full_forward(stage_layers: dict, head: dict, h0: jnp.ndarray, positions: jnp.ndarray, cache: dict, cfg: ModelConfig, n_stages: int, gather_pos=None):
   """Replicated dense prefix (if any) + the masked-stage pipeline."""
   h0, cache = _run_prefix(head, h0, positions, cache, cfg)
-  h, moe_cache = _pp_tick_loop(stage_layers, h0, positions, {"k": cache["k"], "v": cache["v"]}, cfg, n_stages, gather_pos=gather_pos)
+  main = {key: val for key, val in cache.items() if not key.endswith("_pre")}
+  h, moe_cache = _pp_tick_loop(stage_layers, h0, positions, main, cfg, n_stages, gather_pos=gather_pos)
   return h, {**cache, **moe_cache}
 
 
@@ -266,17 +268,25 @@ class PPServing:
     """Engine cache [L_total, ...] → pp placement. With a dense prefix the
     first n_prefix layers split off as replicated ``*_pre`` buffers; the
     pipelined layers shard over pp."""
+    # The compiled programs' cache specs were keyed at build time from
+    # kv_quant_mode (env). A cache built with an explicit quant= override
+    # that disagrees would die later as an opaque pytree mismatch — fail
+    # here with the actual cause instead.
+    if set(cache) != set(self._cache_keys):
+      raise ValueError(
+        f"cache leaves {sorted(cache)} != built specs {sorted(self._cache_keys)} — "
+        "PPServing keys its programs off XOT_TPU_KV_QUANT at construction; allocate the cache with the same mode"
+      )
     sharding = NamedSharding(self.mesh, self._cache_spec)
     if not self.n_prefix:
       return jax.tree.map(lambda x: jax.device_put(x, sharding), cache)
     repl = NamedSharding(self.mesh, P(*[None] * cache["k"].ndim))
     n = self.n_prefix
-    return {
-      "k_pre": jax.device_put(cache["k"][:n], repl),
-      "v_pre": jax.device_put(cache["v"][:n], repl),
-      "k": jax.device_put(cache["k"][n:], sharding),
-      "v": jax.device_put(cache["v"][n:], sharding),
-    }
+    out = {}
+    for key, val in cache.items():
+      out[f"{key}_pre"] = jax.device_put(val[:n], repl)
+      out[key] = jax.device_put(val[n:], sharding)
+    return out
 
   # ------------------------------------------------------------- programs
 
@@ -285,9 +295,15 @@ class PPServing:
     is_first, is_last = self.is_first, self.is_last
     # Per-key cache specs: pipelined layers shard over pp; a dense prefix's
     # buffers are replicated (every stage computes the prefix identically).
-    cache_spec = {"k": P("pp"), "v": P("pp")}
+    # Scale keys appear when the engine allocates an int8-quantized cache
+    # (models/decoder.py kv_quant_mode — env-driven, so known at build time).
+    from ..models.decoder import kv_quant_mode
+
+    cache_keys = ("k", "v", "k_scale", "v_scale") if kv_quant_mode(cfg) else ("k", "v")
+    self._cache_keys = cache_keys
+    cache_spec = {key: P("pp") for key in cache_keys}
     if self.n_prefix:
-      cache_spec = {**cache_spec, "k_pre": P(), "v_pre": P()}
+      cache_spec = {**cache_spec, **{f"{key}_pre": P() for key in cache_keys}}
     stage_spec = P("pp")
 
     def make_forward_sm(gather_last: bool):

@@ -85,29 +85,28 @@ def _write_span_local(gathered: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarra
   return jax.vmap(row)(gathered, new, start)
 
 
-def _sp_paged_layer_prefill(h, p, temp_k, temp_v, positions, kv_pos_local, inv_freq, cfg: ModelConfig):
+def _sp_paged_layer_prefill(h, p, temp, positions, kv_pos_local, inv_freq, cfg: ModelConfig):
   """One layer of striped-pool prefill against the GATHERED local slots
-  (temp_k/v [B, N, H, hd]); per-row positions [B, S]. The shared sp layer
-  skeleton with the span write + strided positions plugged in."""
+  (``temp`` leaf dict, [B, N, H, hd] each); per-row positions [B, S]. The
+  shared sp layer skeleton with the span write + strided positions plugged
+  in (scale leaves ride the same per-leaf writer)."""
   return _sp_layer_step(
-    h, p, temp_k, temp_v, positions, 0, inv_freq, cfg,
+    h, p, temp, positions, 0, inv_freq, cfg,
     kv_positions_local=kv_pos_local,
-    write_kv=lambda kc, vc, k, v, start: (_write_span_local(kc, k, start, kv_pos_local), _write_span_local(vc, v, start, kv_pos_local)),
+    write_one=lambda leaf, new, start: _write_span_local(leaf, new, start, kv_pos_local),
   )
 
 
-def _sp_paged_layer_decode(h, p, k_pool, v_pool, bt, positions, kv_pos_local, inv_freq, cfg: ModelConfig, page_size: int, stripe: int, rank):
+def _sp_paged_layer_decode(h, p, pool_l, bt, positions, kv_pos_local, inv_freq, cfg: ModelConfig, page_size: int, stripe: int, rank):
   """One decode layer against this rank's stripe of the page pool
-  (k/v_pool [P, Hkv, stripe, hd]): token write into the owning rank's
-  stripe, gather-on-read, strided positions — same shared skeleton."""
+  (``pool_l`` leaf dict, [P, Hkv, stripe, hd] each): token write into the
+  owning rank's stripe, gather-on-read, strided positions — same shared
+  skeleton."""
   return _sp_layer_step(
-    h, p, k_pool, v_pool, positions, 0, inv_freq, cfg,
+    h, p, pool_l, positions, 0, inv_freq, cfg,
     kv_positions_local=kv_pos_local,
-    write_kv=lambda kc, vc, k, v, start: (
-      _write_token_local(kc, k[:, 0], bt, start, page_size, stripe, rank),
-      _write_token_local(vc, v[:, 0], bt, start, page_size, stripe, rank),
-    ),
-    read_kv=lambda c: _gather_local(c, bt),
+    write_one=lambda leaf, new, start: _write_token_local(leaf, new[:, 0], bt, start, page_size, stripe, rank),
+    read_one=lambda leaf: _gather_local(leaf, bt),
   )
 
 
@@ -215,24 +214,22 @@ class SPBatchedServing:
         scatter_l = lambda pool_part, t: scatter_row_pages(pool_part, t, target)  # noqa: E731
 
         h = embed_tokens(params, cfg, tokens)
-        temp_k, temp_v = gather_row_pages(pool["k"], bt_rows), gather_row_pages(pool["v"], bt_rows)
+        temp = {key: gather_row_pages(val, bt_rows) for key, val in pool.items()}
         off = 0
-        nk_parts, nv_parts = [], []
+        parts = []
         for stack in stacks_of(params):
           L = next(iter(stack.values())).shape[0]
 
           def body(carry, per_layer):
-            lp, tk, tv = per_layer
-            h2, tk, tv = _sp_paged_layer_prefill(carry, lp, tk, tv, positions, kv_pos_local, inv_freq, cfg)
-            return h2, (tk, tv)
+            lp, sub = per_layer
+            h2, sub = _sp_paged_layer_prefill(carry, lp, sub, positions, kv_pos_local, inv_freq, cfg)
+            return h2, sub
 
-          h, (nk, nv) = jax.lax.scan(body, h, (stack, temp_k[off : off + L], temp_v[off : off + L]))
-          nk_parts.append(nk)
-          nv_parts.append(nv)
+          h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in temp.items()}))
+          parts.append(new_sub)
           off += L
-        tk = nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts, axis=0)
-        tv = nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts, axis=0)
-        return h, {"k": scatter_l(pool["k"], tk), "v": scatter_l(pool["v"], tv)}
+        new_temp = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
+        return h, {key: scatter_l(pool[key], new_temp[key]) for key in pool}
 
       return fn
 
@@ -265,23 +262,19 @@ class SPBatchedServing:
           bt = jnp.where(active[:, None], block_tables, 0)
           h = embed_tokens(params, cfg, tok)
           off = 0
-          nk_parts, nv_parts = [], []
+          parts = []
           for stack in stacks_of(params):
             L = next(iter(stack.values())).shape[0]
 
             def body(hc, per_layer):
-              lp, kp, vp = per_layer
-              h2, kp, vp = _sp_paged_layer_decode(hc, lp, kp, vp, bt, pos[:, None], kv_pos_local, inv_freq, cfg, page_size, stripe, rank)
-              return h2, (kp, vp)
+              lp, pool_l = per_layer
+              h2, pool_l = _sp_paged_layer_decode(hc, lp, pool_l, bt, pos[:, None], kv_pos_local, inv_freq, cfg, page_size, stripe, rank)
+              return h2, pool_l
 
-            h, (nk, nv) = jax.lax.scan(body, h, (stack, pool["k"][off : off + L], pool["v"][off : off + L]))
-            nk_parts.append(nk)
-            nv_parts.append(nv)
+            h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
+            parts.append(new_sub)
             off += L
-          pool = {
-            "k": nk_parts[0] if len(nk_parts) == 1 else jnp.concatenate(nk_parts, axis=0),
-            "v": nv_parts[0] if len(nv_parts) == 1 else jnp.concatenate(nv_parts, axis=0),
-          }
+          pool = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
           logits = head_logits(params, cfg, h)[:, 0, :]
           nxt, key = _next_token_batched(logits, key, temps, top_ks, k_max)
           nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold

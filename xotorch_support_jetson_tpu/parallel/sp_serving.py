@@ -59,11 +59,17 @@ def _partial_stats(scores):
   return m, jnp.sum(p, axis=-1, keepdims=True), p
 
 
-def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=None, logit_softcap: float = 0.0, sliding_window=None):
+def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=None, logit_softcap: float = 0.0, sliding_window=None, k_scale=None, v_scale=None):
   """q [B,Sq,Hq,hd]; k/v local chunk [B,Skv_loc,Hkv,hd] → merged [B,Sq,Hq,hd].
   The gemma2 options (softcap before masking, window into the mask) commute
   with the cross-rank merge — each rank's partials see the same scores a
-  single device would."""
+  single device would. ``k_scale``/``v_scale`` [B,Skv_loc,Hkv,1] are this
+  rank's int8-KV scales (ops/attention.py): k's applies to the local scores
+  BEFORE the partial stats (so the merged softmax sees true scores), v's
+  folds into the local probs — both are rank-local, so the merge itself is
+  unchanged."""
+  from ..ops.attention import kv_scale_to_scores
+
   B, Sq, Hq, hd = q.shape
   Hkv = k_loc.shape[2]
   hd_v = v_loc.shape[3]
@@ -72,8 +78,12 @@ def _sp_gqa_attention(q, k_loc, v_loc, q_positions, kv_positions_local, scale=No
     scale = 1.0 / float(hd) ** 0.5
   qg = q.reshape(B, Sq, Hkv, group, hd)
   scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k_loc.astype(jnp.float32)) * scale
+  if k_scale is not None:
+    scores = scores * kv_scale_to_scores(k_scale)
   scores = cap_and_mask_scores(scores, q_positions, kv_positions_local, logit_softcap, sliding_window)
   m, l, p = _partial_stats(scores)  # [B,Hkv,g,Sq,1], p [B,Hkv,g,Sq,Skv]
+  if v_scale is not None:
+    p = p * kv_scale_to_scores(v_scale)
   acc = jnp.einsum("bhgqk,bkhd->bhgqd", p, v_loc.astype(jnp.float32))
   l_g, acc_g = _merge_stats(m, l, acc)
   out = acc_g / l_g  # [B, Hkv, g, Sq, hd_v] → [B, Sq, Hkv, g, hd_v]
@@ -132,69 +142,86 @@ def _write_chunk(cache, new, start, rank_offset):
   return jax.vmap(row)(cache, new, start)
 
 
-def _sp_layer_step(h, p, k_cache, v_cache, positions, rank_offset, inv_freq, cfg: ModelConfig, kv_positions_local=None, write_kv=None, read_kv=None):
+def _sp_layer_step(h, p, kv, positions, rank_offset, inv_freq, cfg: ModelConfig, kv_positions_local=None, write_one=None, read_one=None):
   """One decoder layer with an sp-sharded cache. h replicated [B,S,D].
 
-  Default layout: k/v_cache are this rank's CONTIGUOUS chunk [B,Sloc,H,hd]
-  (slot positions ``rank_offset + arange``, ``_write_chunk`` writes). The
-  striped paged layout (parallel/sp_batch.py) overrides the three knobs:
-  ``kv_positions_local`` gives each stored slot's absolute position,
-  ``write_kv(kc, vc, k, v, start)`` scatters new KV, ``read_kv(cache)``
-  yields the position-ordered KV the attention reads — so the attention/
-  norm/MLP skeleton exists exactly once for both layouts.
+  ``kv`` is this layer's cache dict ({"k", "v"} [+ "k_scale"/"v_scale" int8
+  KV — models/decoder.py init_kv_cache]). Default layout: leaves are this
+  rank's CONTIGUOUS chunk [B,Sloc,H,hd] (slot positions ``rank_offset +
+  arange``, ``_write_chunk`` writes). The striped paged layout
+  (parallel/sp_batch.py) overrides the three knobs: ``kv_positions_local``
+  gives each stored slot's absolute position, ``write_one(leaf, new, start)``
+  scatters one leaf's new values, ``read_one(leaf)`` yields the
+  position-ordered view the attention reads — so the attention/norm/MLP
+  skeleton (and the int8-KV quantize-at-write) exists exactly once for both
+  layouts; scale leaves ride the same writers (trailing [..., 1] axis).
   """
   B, S, D = h.shape
   if kv_positions_local is None:
-    kv_positions_local = rank_offset + jnp.arange(k_cache.shape[1], dtype=jnp.int32)
-  if write_kv is None:
-    write_kv = lambda kc, vc, k, v, start: (_write_chunk(kc, k, start, rank_offset), _write_chunk(vc, v, start, rank_offset))  # noqa: E731
-  if read_kv is None:
-    read_kv = lambda c: c  # noqa: E731
+    kv_positions_local = rank_offset + jnp.arange(kv["k"].shape[1], dtype=jnp.int32)
+  if write_one is None:
+    write_one = lambda leaf, new, start: _write_chunk(leaf, new, start, rank_offset)  # noqa: E731
+  if read_one is None:
+    read_one = lambda leaf: leaf  # noqa: E731
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
   start = positions[:, 0]
   if "wkv_a" in p:
     q_nope, q_pe, c_kv, k_pe = _mla_latents(x, p, cfg, positions, inv_freq)
-    k_cache, v_cache = write_kv(k_cache, v_cache, c_kv[:, :, None, :], k_pe[:, :, None, :], start)
+    kv = {"k": write_one(kv["k"], c_kv[:, :, None, :], start), "v": write_one(kv["v"], k_pe[:, :, None, :], start)}
     attn = _sp_mla_attention(
-      q_nope, q_pe, read_kv(k_cache)[:, :, 0, :].astype(h.dtype), read_kv(v_cache)[:, :, 0, :].astype(h.dtype),
+      q_nope, q_pe, read_one(kv["k"])[:, :, 0, :].astype(h.dtype), read_one(kv["v"])[:, :, 0, :].astype(h.dtype),
       _mla_w_kv_b(p, h.dtype), positions, kv_positions_local, cfg.v_head_dim,
     )
   else:
     from ..models.decoder import _attn_opts
 
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
-    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, start)
-    attn = _sp_gqa_attention(q, read_kv(k_cache).astype(h.dtype), read_kv(v_cache).astype(h.dtype), positions, kv_positions_local, **_attn_opts(cfg, p.get("is_sliding")))
+    if "k_scale" in kv:  # int8 KV: quantize at write, codes stay the read operand
+      from ..models.quantize import quantize_kv
+
+      kq, ks = quantize_kv(k)
+      vq, vs = quantize_kv(v)
+      kv = {
+        "k": write_one(kv["k"], kq, start),
+        "k_scale": write_one(kv["k_scale"], ks, start),
+        "v": write_one(kv["v"], vq, start),
+        "v_scale": write_one(kv["v_scale"], vs, start),
+      }
+      attn = _sp_gqa_attention(
+        q, read_one(kv["k"]), read_one(kv["v"]), positions, kv_positions_local,
+        k_scale=read_one(kv["k_scale"]), v_scale=read_one(kv["v_scale"]), **_attn_opts(cfg, p.get("is_sliding"))
+      )
+    else:
+      kv = {"k": write_one(kv["k"], k, start), "v": write_one(kv["v"], v, start)}
+      attn = _sp_gqa_attention(q, read_one(kv["k"]).astype(h.dtype), read_one(kv["v"]).astype(h.dtype), positions, kv_positions_local, **_attn_opts(cfg, p.get("is_sliding")))
   from ..models.decoder import _mm
 
-  attn_out = _mm(attn.reshape(B, S, -1), p, "wo")
+  attn_out = _mm(attn.reshape(B, S, -1), p, "wo", cfg.quant_compute)
   if "post_attn_norm" in p:  # gemma2
     attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
   h = h + attn_out
   h, _ = _mlp_block(h, p, cfg)
-  return h, k_cache, v_cache
+  return h, kv
 
 
 def _sp_forward(params, h, positions, cache, cfg: ModelConfig, rank_offset):
   inv_freq = rope_inv_freq(cfg)
-  new_k_parts, new_v_parts = [], []
+  parts = []
   off = 0
   stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
   for stack in stacks:
     L = next(iter(stack.values())).shape[0]
 
     def body(carry, per_layer):
-      lp, kc, vc = per_layer
-      h2, kc, vc = _sp_layer_step(carry, lp, kc, vc, positions, rank_offset, inv_freq, cfg)
-      return h2, (kc, vc)
+      lp, kv = per_layer
+      h2, kv = _sp_layer_step(carry, lp, kv, positions, rank_offset, inv_freq, cfg)
+      return h2, kv
 
-    h, (nk, nv) = jax.lax.scan(body, h, (stack, cache["k"][off : off + L], cache["v"][off : off + L]))
-    new_k_parts.append(nk)
-    new_v_parts.append(nv)
+    h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in cache.items()}))
+    parts.append(new_sub)
     off += L
-  new_k = new_k_parts[0] if len(new_k_parts) == 1 else jnp.concatenate(new_k_parts, axis=0)
-  new_v = new_v_parts[0] if len(new_v_parts) == 1 else jnp.concatenate(new_v_parts, axis=0)
-  return h, {"k": new_k, "v": new_v}
+  new_cache = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
+  return h, new_cache
 
 
 class SPServing:

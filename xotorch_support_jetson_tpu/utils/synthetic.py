@@ -19,7 +19,11 @@ from __future__ import annotations
 
 def peaked_echo_params(params: dict, damp: float = 0.05) -> dict:
   """A peaked-logit variant of ``params``: residual-stream writes scaled by
-  ``damp``. Returns a shallow-copied tree (untouched leaves shared)."""
+  ``damp``. Returns a shallow-copied tree (untouched leaves shared).
+
+  Works on QUANTIZED trees too: damping int8 codes would round them to
+  nothing, so when a ``<name>_scale`` sibling exists the *scale* leaf is
+  damped instead — mathematically the same model, codes untouched."""
   out = dict(params)
   for name in ("layers", "moe_layers"):
     if name not in params:
@@ -28,7 +32,10 @@ def peaked_echo_params(params: dict, damp: float = 0.05) -> dict:
     for k in list(stack):
       # Residual-stream writes: attention out-proj and every MLP
       # down-projection (dense w_down, MoE w_experts_down / w_shared_down).
-      if k == "wo" or k.endswith("_down"):
-        stack[k] = stack[k] * damp
+      if (k == "wo" or k.endswith("_down")) and not k.endswith("_scale"):
+        if f"{k}_scale" in stack:
+          stack[f"{k}_scale"] = stack[f"{k}_scale"] * damp
+        else:
+          stack[k] = stack[k] * damp
     out[name] = stack
   return out
