@@ -140,3 +140,44 @@ def test_dataset_loading_and_batching():
   np.testing.assert_array_equal(inputs[0, :n], row_tokens[:n])
   np.testing.assert_array_equal(targets[0, :n], row_tokens[1 : n + 1])
   assert lengths[0] == n
+
+
+def test_checkpoint_orbax_failure_raises_not_degrades(tmp_path, monkeypatch):
+  """VERDICT r4 #9: a REAL orbax save failure (disk full, bad sharding) must
+  surface, not silently degrade to npz — only orbax being absent/renamed
+  (ImportError/AttributeError at import) selects the fallback."""
+  import orbax.checkpoint as ocp
+  import pytest
+
+  from xotorch_support_jetson_tpu.train.checkpoint import save_params
+
+  params = {"w": jax.numpy.ones((4, 4), jax.numpy.float32)}
+
+  def boom(self, *a, **k):
+    raise OSError("disk full")
+
+  monkeypatch.setattr(ocp.StandardCheckpointer, "save", boom)
+  with pytest.raises(OSError, match="disk full"):
+    save_params(params, tmp_path / "ckpt")
+  assert not (tmp_path / "ckpt.npz").exists(), "orbax failure must not masquerade as an npz format choice"
+
+
+def test_checkpoint_npz_fallback_when_orbax_absent(tmp_path, monkeypatch):
+  """With orbax unimportable the flat-npz fallback still round-trips."""
+  import builtins
+
+  from xotorch_support_jetson_tpu.train.checkpoint import load_params, save_params
+
+  real_import = builtins.__import__
+
+  def no_orbax(name, *a, **k):
+    if name.startswith("orbax"):
+      raise ImportError("orbax not installed")
+    return real_import(name, *a, **k)
+
+  monkeypatch.setattr(builtins, "__import__", no_orbax)
+  params = {"w": jax.numpy.arange(16, dtype=jax.numpy.float32).reshape(4, 4)}
+  save_params(params, tmp_path / "ckpt")
+  assert (tmp_path / "ckpt.npz").exists()
+  restored = load_params(tmp_path / "ckpt", params)
+  np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(params["w"]))

@@ -7,25 +7,36 @@ SURVEY.md §5.4). Here save/restore round-trips the params pytree for real.
 
 from __future__ import annotations
 
+import logging
 from pathlib import Path
 
 import jax
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 
 def save_params(params, path: str | Path) -> None:
+  """Save a params pytree — orbax, with an npz fallback ONLY for the two
+  failure classes that mean "orbax can't be used here" (VERDICT r4 #9):
+  the library being absent/renamed (ImportError/AttributeError at the API
+  surface). A real save failure inside a working orbax — disk full, bad
+  sharding, permissions — RE-RAISES: degrading it to npz would silently
+  mask data loss as a format choice."""
   path = Path(path)
   path.parent.mkdir(parents=True, exist_ok=True)
   try:
     import orbax.checkpoint as ocp
 
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path.absolute().with_suffix(".orbax"), params, force=True)
-    ckptr.wait_until_finished()
-  except Exception:  # noqa: BLE001 — orbax API drift: flat-npz fallback
+  except (ImportError, AttributeError) as e:  # orbax absent or API drifted
+    logger.warning("orbax unavailable (%r); saving flat npz fallback to %s", e, path.with_suffix(".npz"))
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
     np.savez(str(path.with_suffix(".npz")), **arrays)
+    return
+  ckptr.save(path.absolute().with_suffix(".orbax"), params, force=True)
+  ckptr.wait_until_finished()
 
 
 def load_params(path: str | Path, like):
