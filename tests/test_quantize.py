@@ -244,3 +244,23 @@ def test_int4_moe_expert_path():
   full = _logits(params, cfg, shard, toks)
   assert np.isfinite(out).all()
   assert np.corrcoef(out.ravel(), full.ravel())[0, 1] > 0.9
+
+
+def test_int4_kernel_matches_two_dot_reference():
+  """The in-register-unpack Pallas matmul (ops/pallas_int4.py, interpret
+  mode on CPU) must match the shipped two-dot qdot formulation on the same
+  packed weights — identical math, single HBM read."""
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.models.quantize import qdot, quantize_weight_int4
+  from xotorch_support_jetson_tpu.ops.pallas_int4 import BLOCK_IN, BLOCK_OUT, int4_matmul
+
+  key = jax.random.PRNGKey(0)
+  T, d_in, d_out = 4, BLOCK_IN * 2, BLOCK_OUT
+  w = jax.random.normal(key, (d_in, d_out), jnp.float32) * 0.05
+  packed, scale = quantize_weight_int4(w)
+  x = jax.random.normal(jax.random.fold_in(key, 1), (T, d_in), jnp.float32)
+
+  want = qdot(x, packed, scale)  # two-dot reference
+  got = int4_matmul(x, packed, scale, interpret=True)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)

@@ -167,6 +167,16 @@ def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a
   dot on the int8 MXU path with int32 accumulation (int8 layout only).
   """
   if w.shape[-2] * 2 == x.shape[-1]:  # packed int4
+    if w.ndim == 2:
+      from ..ops.pallas_int4 import int4_kernel_supported, int4_matmul
+
+      x2 = x.reshape(-1, x.shape[-1])
+      if int4_kernel_supported(x2.shape, w.shape):
+        # In-register unpack (ops/pallas_int4.py): the packed tile is read
+        # from HBM ONCE — true 0.5 bytes/param streaming, vs the two-dot
+        # fallback below whose dots each re-read it (int8-equivalent
+        # traffic). Opt-in via XOT_TPU_INT4_KERNEL=1.
+        return int4_matmul(x2, w, scale.astype(jnp.float32)).reshape(*x.shape[:-1], w.shape[-1])
     # TWO-DOT formulation: y = x_even @ signext(packed) + x_odd @ (packed>>4).
     # Each operand is a pure shift of the packed buffer, which XLA streams
     # into the dot like int8's astype; the obvious stack/reshape interleave

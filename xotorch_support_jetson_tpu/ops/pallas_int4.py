@@ -1,0 +1,129 @@
+"""Pallas int4 matmul: in-register nibble unpack, 0.5 bytes/param streamed.
+
+The XLA two-dot formulation (models/quantize.py qdot) keeps the unpack
+streamable but issues TWO dots that each read the packed buffer from HBM —
+traffic is int8-equivalent, so int4 decodes at ~half int8 speed (BASELINE.md
+"int4"). This kernel reads each packed tile ONCE into VMEM, sign-extends the
+two nibbles there (pure VPU shifts), and runs both half-dots against the
+same resident tile — HBM moves 0.5 bytes/param, the only route to int4 as a
+SPEED mode rather than a capacity mode.
+
+Contract matches the packed layout quantize_weight_int4 writes: packed int8
+[in/2, out], even in-rows in the low nibble, odd in the high;
+y[t, f] = (Σ_d x[t, d]·unpack(w)[d, f]) · scale[f]. The caller splits x into
+its even/odd in-channels host-side (two [T, in/2] views — tiny next to the
+weight read), so the kernel needs no strided slicing.
+
+Gating: ``XOT_TPU_INT4_KERNEL=1`` routes eligible qdot calls here
+(models/quantize.py); correctness runs in interpret mode on CPU against the
+two-dot reference every CI (tests/test_quantize.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+BLOCK_IN = 512  # packed rows per step = BLOCK_IN//2
+BLOCK_OUT = 512
+
+
+def _int4_kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_ref, *, n_in_blocks: int):
+  import jax.experimental.pallas as pl
+
+  d = pl.program_id(1)
+
+  @pl.when(d == 0)
+  def _init():
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+  w = w_ref[...].astype(jnp.int32)  # [BLOCK_IN//2, BLOCK_OUT] packed; ONE HBM read (int8), widened in-register
+  # Sign-extend both nibbles via int32 shifts (int8 shifts upset Mosaic);
+  # the bf16 casts feed the MXU natively — int values ≤ |8| are exact in bf16.
+  lo = ((w << 28) >> 28).astype(jnp.bfloat16)
+  hi = ((w << 24) >> 28).astype(jnp.bfloat16)
+  xe = xe_ref[...].astype(jnp.bfloat16)  # [T, BLOCK_IN//2] even in-channels
+  xo = xo_ref[...].astype(jnp.bfloat16)
+  dn = (((1,), (0,)), ((), ()))
+  acc_ref[...] += jax.lax.dot_general(xe, lo, dn, preferred_element_type=jnp.float32)
+  acc_ref[...] += jax.lax.dot_general(xo, hi, dn, preferred_element_type=jnp.float32)
+
+  @pl.when(d == n_in_blocks - 1)
+  def _finish():
+    o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _block_out(d_out: int) -> int:
+  """Largest supported out-tile that divides d_out (llama's 128256-wide
+  head needs 256; the hidden/projection dims take 512)."""
+  for b in (BLOCK_OUT, 256, 128):
+    if d_out % b == 0:
+      return b
+  return 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_matmul(x: jnp.ndarray, w_packed: jnp.ndarray, scale: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+  """x [T, in] (bf16/f32) @ packed int4 w [in/2, out] → [T, out] in x.dtype.
+
+  ``scale`` [out] f32 (per-output-channel, quantize_weight_int4's). Shapes
+  must satisfy in % BLOCK_IN == 0 and _block_out(out) > 0
+  (int4_kernel_supported gates callers; qdot falls back to the two-dot path
+  otherwise).
+
+  Numerics: activations feed the MXU in bf16 (weights' int values ≤ |8| are
+  exact in bf16, so for a bf16 model the result bit-matches the two-dot
+  path; f32 activations are ROUNDED to bf16 here where the two-dot keeps
+  them f32 — a ~1e-2-relative difference across the flag, not a bug).
+  """
+  import jax.experimental.pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  T, d_in = x.shape
+  d_out = w_packed.shape[1]
+  block_out = _block_out(d_out)
+  n_in = d_in // BLOCK_IN
+  n_out = d_out // block_out
+  # Mosaic wants 8-sublane tiling on the token axis; decode runs T=1-16, so
+  # round up to a multiple of 8 (padded rows cost nothing against the
+  # weight-dominated read).
+  Tp = max(8, ((T + 7) // 8) * 8)
+  xp = x if T == Tp else jnp.pad(x, ((0, Tp - T), (0, 0)))
+  xe = xp[:, 0::2]  # [Tp, in/2] — tiny vs the weight read; XLA fuses the gather
+  xo = xp[:, 1::2]
+  scale2 = scale.reshape(1, d_out)  # 2-D operand (1-D tiles are not Mosaic-friendly)
+
+  grid = (n_out, n_in)  # in-blocks innermost: sequential accumulation per out-tile
+  out = pl.pallas_call(
+    functools.partial(_int4_kernel, n_in_blocks=n_in),
+    out_shape=jax.ShapeDtypeStruct((Tp, d_out), x.dtype),
+    grid=grid,
+    in_specs=[
+      pl.BlockSpec((Tp, BLOCK_IN // 2), lambda f, d: (0, d)),
+      pl.BlockSpec((Tp, BLOCK_IN // 2), lambda f, d: (0, d)),
+      pl.BlockSpec((BLOCK_IN // 2, block_out), lambda f, d: (d, f)),
+      pl.BlockSpec((1, block_out), lambda f, d: (0, f)),
+    ],
+    out_specs=pl.BlockSpec((Tp, block_out), lambda f, d: (0, f)),
+    scratch_shapes=[pltpu.VMEM((Tp, block_out), jnp.float32)],
+    interpret=interpret,
+  )(xe, xo, w_packed, scale2)
+  return out[:T]
+
+
+def int4_kernel_supported(x_shape, w_shape, platform: str | None = None) -> bool:
+  """OPT-IN (``XOT_TPU_INT4_KERNEL=1``): the in-register-unpack matmul for
+  packed int4 leaves. Requires TPU, 2-D operands, tile-divisible dims, and a
+  small token count (decode/short-prefill; VMEM holds [T, block_out] f32)."""
+  from ..utils.helpers import env_flag
+
+  if os.getenv("XOT_TPU_NO_FLASH") or not env_flag("XOT_TPU_INT4_KERNEL"):
+    return False
+  platform = platform or jax.default_backend()
+  if platform != "tpu" or len(x_shape) != 2:
+    return False
+  T, d_in = x_shape
+  return T <= 256 and d_in % BLOCK_IN == 0 and _block_out(w_shape[-1]) > 0 and w_shape[-2] * 2 == d_in
