@@ -185,3 +185,25 @@ def test_sp_serving_int8kv_matches_single_device():
   cache_sp = sps.place_cache(init_kv_cache(cfg, cfg.n_layers, 1, 32, quant="int8"))
   t_sp, _ = sps.fused_decode(tok, cache_sp, jnp.zeros((1,), jnp.int32), 12)
   np.testing.assert_array_equal(np.asarray(t_ref), np.asarray(t_sp))
+
+
+def test_flash_prefill_int8kv_matches_dequant_reference():
+  """The quantized flash-prefill kernel (in-register per-block dequant,
+  interpret mode) must match flash over explicitly dequantized K/V — guards
+  the ks/vs ref wiring and the GQA h//group scale index map."""
+  from xotorch_support_jetson_tpu.ops.pallas_attention import BLOCK_K, BLOCK_Q, flash_attention_prefill
+
+  key = jax.random.PRNGKey(21)
+  B, Sq, Skv, Hq, Hkv, hd = 2, BLOCK_Q, 2 * BLOCK_K, 8, 2, 64
+  q = jax.random.normal(key, (B, Sq, Hq, hd), jnp.float32)
+  k = jax.random.normal(jax.random.fold_in(key, 1), (B, Skv, Hkv, hd), jnp.float32)
+  v = jax.random.normal(jax.random.fold_in(key, 2), (B, Skv, Hkv, hd), jnp.float32)
+  kq, ks = quantize_kv(k)
+  vq, vs = quantize_kv(v)
+  offs = jnp.asarray([0, 64], jnp.int32)  # one mid-cache row (prefix-cached start)
+
+  got = flash_attention_prefill(q, kq, vq, q_offset=offs, k_scale=ks, v_scale=vs, interpret=True)
+  want = flash_attention_prefill(
+    q, dequantize_kv(kq, ks, jnp.float32), dequantize_kv(vq, vs, jnp.float32), q_offset=offs, interpret=True
+  )
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)

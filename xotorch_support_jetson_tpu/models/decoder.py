@@ -443,7 +443,7 @@ def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: Mod
       from ..ops.pallas_attention import flash_attention_prefill, flash_decode_attention, flash_decode_supported, flash_supported
 
       if "k_scale" in kv:  # int8 KV (models/quantize.py quantize_kv)
-        from .quantize import dequantize_kv, quantize_kv
+        from .quantize import quantize_kv
 
         kq, ks = quantize_kv(k)
         vq, vs = quantize_kv(v)
@@ -454,12 +454,10 @@ def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: Mod
           "v_scale": _write_cache(kv["v_scale"], vs, start),
         }
         if cfg.plain_attention and S > 1 and flash_supported(q.shape, kv["k"].shape[1]):
-          # Prefill: the flash kernel wants materialized bf16 operands; the
-          # dequant copy is one pass over the cache, amortized across the
-          # whole chunk's queries (prefill is MXU-bound, decode is not).
-          attn = flash_attention_prefill(
-            q, dequantize_kv(kv["k"], kv["k_scale"], h.dtype), dequantize_kv(kv["v"], kv["v_scale"], h.dtype), q_offset=positions[:, 0]
-          )
+          # Prefill: int8 codes + scales stream straight through the flash
+          # kernel (per-block in-register dequant) — no materialized bf16
+          # cache copy, 1 byte/element HBM traffic.
+          attn = flash_attention_prefill(q, kv["k"], kv["v"], q_offset=positions[:, 0], k_scale=kv["k_scale"], v_scale=kv["v_scale"])
         else:
           # Decode reads the cache as int8 CODES — the convert fuses into
           # the einsum, so the HBM-bound cache read moves half the bytes.

@@ -153,8 +153,10 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def dequantize_kv(codes: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
-  """codes [..., hd] × scale [..., 1] → [..., hd] in ``dtype`` — for the few
-  consumers that need materialized K/V (the Pallas flash-prefill kernel)."""
+  """codes [..., hd] × scale [..., 1] → [..., hd] in ``dtype``. No serving
+  path materializes dequantized K/V anymore (the flash-prefill kernel
+  dequantizes per block in-register); this is the reference definition the
+  fidelity tests compare against (tests/test_kv_quant.py)."""
   return (codes.astype(jnp.float32) * scale).astype(dtype)
 
 

@@ -27,54 +27,89 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _flash_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
+def _flash_kernel(off_ref, q_ref, k_ref, v_ref, *scale_refs_and_out, block_k: int, scale: float, quantized: bool):
+  """Grid: (B, Hq, Sq/BQ, Skv/BK) — the KV axis is GRID-tiled (innermost,
+  sequential) with the online-softmax state carried in VMEM scratch, so
+  VMEM holds one [BK, hd] K/V tile at a time regardless of Skv. (The first
+  design kept the whole [Skv, hd] row resident and fori_loop'ed over it —
+  at a 32K cache that is ~16.2 MB of operand stack, over the 16 MB scoped
+  VMEM limit: long-context chunked prefill crashed at COMPILE time.)
+
+  ``quantized``: k/v refs hold int8 codes and two extra [BK, 1] f32 scale
+  refs precede the outputs — dequantization is per-(token, head) scales
+  applied to scores/probs in-register (cf. ops/attention.py gqa_attention),
+  so the HBM stream stays 1 byte/element and the quantized prefill never
+  materializes a dequantized cache."""
   import jax.experimental.pallas as pl
 
-  b, qi = pl.program_id(0), pl.program_id(2)
+  if quantized:
+    ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = scale_refs_and_out
+  else:
+    o_ref, m_ref, l_ref, acc_ref = scale_refs_and_out
+  b, qi, kb = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+
+  @pl.when(kb == 0)
+  def _init():
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
   q = q_ref[0, 0].astype(jnp.float32)  # [BQ, hd]
   bq = q.shape[0]
-  skv = k_ref.shape[2]
-  n_kv_blocks = pl.cdiv(skv, block_k)
-
-  # Per-row dynamic offset (SMEM): query row i is at absolute position
-  # off[b] + i. Prefix-cached prefills start mid-sequence (models/decoder.py
-  # prefill_into_pages), so the offset cannot be a static 0.
+  # Per-row dynamic offset (scalar-prefetched): query row i is at absolute
+  # position off[b] + i. Prefix-cached prefills start mid-sequence
+  # (models/decoder.py prefill_into_pages), so the offset cannot be static 0.
   q_pos = off_ref[b] + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ,1]
+  start = kb * block_k
 
-  def body(kb, carry):
-    m, l, acc = carry
-    k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)  # [BK, hd]
-    v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+  # Blocks entirely past this query tile's causal horizon contribute only
+  # NEG_INF columns: skip their COMPUTE. (Their DMA still streams — a
+  # scalar-prefetched index-map clamp that skips the DMA too was measured
+  # 20× SLOWER end-to-end on the v5e tunnel: PrefetchScalarGridSpec
+  # serialized the pipeline, 22.5 s vs 1.1 s per 512-token chunk. The
+  # compute skip alone keeps the MXU work O(context), which is what
+  # matters while the DMA stream runs at full rate.)
+  @pl.when(start <= off_ref[b] + (qi + 1) * bq - 1)
+  def _block():
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, hd]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
     scores = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-    kv_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)  # [1,BK]
+    if quantized:
+      # codes·scale = true k: the per-token scale multiplies each score
+      # COLUMN ([BK,1] transposed to a [1,BK] row broadcast).
+      scores = scores * jnp.transpose(ks_ref[0, 0], (1, 0))
+    kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)  # [1,BK]
     mask = kv_pos <= q_pos
     scores = jnp.where(mask, scores, NEG_INF)
+    m = m_ref[...]
     blk_m = jnp.max(scores, axis=1, keepdims=True)  # [BQ,1]
     new_m = jnp.maximum(m, blk_m)
     p = jnp.exp(scores - new_m)
     p = jnp.where(new_m <= NEG_INF / 2, 0.0, p)
     alpha = jnp.exp(m - new_m)
-    l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc = acc * alpha + jax.lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    return new_m, l, acc
+    m_ref[...] = new_m
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    if quantized:
+      p = p * jnp.transpose(vs_ref[0, 0], (1, 0))  # v's scale folds into probs (after the l update)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-  hd = q.shape[1]
-  m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-  l0 = jnp.zeros((bq, 1), jnp.float32)
-  acc0 = jnp.zeros((bq, hd), jnp.float32)
-  m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m0, l0, acc0))
-  l = jnp.where(l == 0.0, 1.0, l)
-  o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+  @pl.when(kb == pl.num_programs(3) - 1)
+  def _finish():
+    l = l_ref[...]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def flash_attention_prefill(q, k, v, q_offset=0, interpret: bool = False):
+def flash_attention_prefill(q, k, v, q_offset=0, k_scale=None, v_scale=None, interpret: bool = False):
   """q [B,Sq,Hq,hd], k/v [B,Skv,Hkv,hd] → [B,Sq,Hq,hd].
 
   ``q_offset`` — int or [B] int32 (TRACED): absolute position of each row's
   first query. Requires Sq % BLOCK_Q == 0 and Skv % BLOCK_K == 0 (callers
   pad; the positional mask keeps padded KV slots (slot index > pos) inert as
-  long as they hold finite values).
+  long as they hold finite values). With ``k_scale``/``v_scale``
+  [B,Skv,Hkv,1] (int8 KV — models/quantize.py quantize_kv), k/v are int8
+  codes dequantized in-register per block.
   """
   import jax.experimental.pallas as pl
   from jax.experimental.pallas import tpu as pltpu
@@ -84,27 +119,43 @@ def flash_attention_prefill(q, k, v, q_offset=0, interpret: bool = False):
   group = Hq // Hkv
   scale = float(1.0 / (hd**0.5))
   offsets = jnp.broadcast_to(jnp.asarray(q_offset, jnp.int32), (B,))
+  quantized = k_scale is not None
 
   # Layout: [B, H, S, hd] so the S×hd tile is contiguous per (b, h).
   qt = jnp.moveaxis(q, 2, 1)  # [B, Hq, Sq, hd]
   kt = jnp.moveaxis(k, 2, 1)
   vt = jnp.moveaxis(v, 2, 1)
 
-  grid = (B, Hq, Sq // BLOCK_Q)
-  kernel = functools.partial(_flash_kernel, block_k=BLOCK_K, scale=scale)
+  # KV grid-block size: as LARGE as divides Skv (≤2048). Grid-step overhead
+  # on this platform is ~25 µs; at BLOCK_K=128 a 32K cache is 512K steps
+  # (~13 s per 512-token chunk, measured) — at 2048 it is 32× fewer. VMEM
+  # per step stays ≤ ~1 MB ([2048, hd] K+V tiles + the [BQ, 2048] scores).
+  block_k = next((bk for bk in (2048, 1024, 512, 256, 128) if Skv % bk == 0), BLOCK_K)
+  grid = (B, Hq, Sq // BLOCK_Q, Skv // block_k)
+  kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale, quantized=quantized)
+  in_specs = [
+    pl.BlockSpec(memory_space=pltpu.SMEM),
+    pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i, kb: (b, h, i, 0)),
+    pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, kb: (b, h // group, kb, 0)),
+    pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, kb: (b, h // group, kb, 0)),
+  ]
+  operands = [offsets, qt, kt, vt]
+  if quantized:
+    in_specs += [pl.BlockSpec((1, 1, block_k, 1), lambda b, h, i, kb: (b, h // group, kb, 0))] * 2
+    operands += [jnp.moveaxis(k_scale, 2, 1), jnp.moveaxis(v_scale, 2, 1)]
   out = pl.pallas_call(
     kernel,
     out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, hd), q.dtype),
     grid=grid,
-    in_specs=[
-      pl.BlockSpec(memory_space=pltpu.SMEM),
-      pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
-      pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
-      pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // group, 0, 0)),
+    in_specs=in_specs,
+    out_specs=pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i, kb: (b, h, i, 0)),
+    scratch_shapes=[
+      pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running max
+      pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running denom
+      pltpu.VMEM((BLOCK_Q, hd), jnp.float32),  # accumulator
     ],
-    out_specs=pl.BlockSpec((1, 1, BLOCK_Q, hd), lambda b, h, i: (b, h, i, 0)),
     interpret=interpret,
-  )(offsets, qt, kt, vt)
+  )(*operands)
   return jnp.moveaxis(out, 1, 2)  # [B, Sq, Hq, hd]
 
 
