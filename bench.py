@@ -225,11 +225,15 @@ def main() -> None:
   # start to dominate the amortized weight stream).
   int8_batch16_tok_s = _bench_batch(qp, 16) if on_accel else None
   # int8 weights + int8 KV cache (round 5): the KV read is the other
-  # bandwidth stream at batch — quantizing it too is the measured BEST
-  # single-chip aggregate (probe: 1649 vs 1447 agg tok/s). The shipping
+  # bandwidth stream at batch — quantizing it too lifts the aggregate AND
+  # moves the batch sweet spot: halved per-row attention reads push the
+  # knee from B=16 to B=48 (median-of-3 sweep: 16→1560, 32→1841, 48→1967,
+  # 64→1771, 128→1627). DENSE SLOTS ONLY — the paged pool's gather
+  # indirection keeps its knee at 16. The BEST single-chip aggregate
   # config: XOT_TPU_QUANT=int8 XOT_TPU_KV_QUANT=int8 XOT_TPU_BATCHED=1
-  # XOT_TPU_BATCH_SLOTS=16.
+  # XOT_TPU_PAGED=0 XOT_TPU_BATCH_SLOTS=48.
   int8_int8kv_batch16_tok_s = _bench_batch(qp, 16, kv_quant="int8") if on_accel else None
+  int8_int8kv_batch48_tok_s = _bench_batch(qp, 48, kv_quant="int8") if on_accel else None
 
   # w8a8 at batch (VERDICT r4 #7): dynamic activation quant puts the decode
   # matmuls on the MXU's int8 path — at B=16 the batch dot is big enough
@@ -677,6 +681,7 @@ def main() -> None:
         "int8_batch8_aggregate_tok_s": int8_batch8_tok_s,
         "int8_batch16_aggregate_tok_s": int8_batch16_tok_s,
         "int8_int8kv_batch16_aggregate_tok_s": int8_int8kv_batch16_tok_s,
+        "int8_int8kv_batch48_aggregate_tok_s": int8_int8kv_batch48_tok_s,
         "int8_w8a8_batch16_aggregate_tok_s": int8_w8a8_batch16_tok_s,
         "paged_batch16_aggregate_tok_s": paged16_tok_s,
         "paged_batch16_int8kv_aggregate_tok_s": paged16_int8kv_tok_s,
