@@ -299,3 +299,42 @@ def test_batched_decode_with_moe_model():
   )
   got += [int(t) for t in np.asarray(toks)[1]]
   assert got == solo
+
+
+def test_batched_server_48_slots_dense_int8kv(monkeypatch):
+  """The round-5 max-throughput config end-to-end through the REAL server:
+  dense slot pool (XOT_TPU_PAGED=0) at 48 slots with int8 KV — 60 concurrent
+  requests (more than slots) each get their solo greedy answer."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+
+  rng = np.random.default_rng(5)
+  prompts = [list(rng.integers(1, CFG.vocab_size, rng.integers(2, 7))) for _ in range(60)]
+  n_gen = 4
+  # References computed with the SAME int8 KV mode (env is set): quantized
+  # logits near-tie differently than bf16 on random weights, and the claim
+  # under test is pool isolation, not quantization fidelity (test_kv_quant).
+  expected = [_single_row_reference(params, shard, p, n_gen - 1) for p in prompts]
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  server = BatchedServer(engine, n_slots=48, chunk=2)
+  assert server.n_slots == 48
+
+  async def run():
+    return await asyncio.gather(
+      *(
+        server.submit(f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=lambda *a: None)
+        for i, p in enumerate(prompts)
+      )
+    )
+
+  outs = asyncio.run(run())
+  # the lazily-built pool really is the dense int8-KV one
+  assert "k_scale" in server.cache and server.cache["k"].dtype == jnp.int8
+  assert server.cache["k"].shape[1] == 48
+  for i, out in enumerate(outs):
+    assert out == expected[i], f"req {i}: {out} != {expected[i]}"
