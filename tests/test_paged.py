@@ -26,7 +26,9 @@ from xotorch_support_jetson_tpu.models.decoder import (
   fused_paged_batch_decode,
   init_kv_cache,
   prefill_into_pages,
+  prefill_into_pages_many,
   prefill_into_slot,
+  prefill_into_slots,
 )
 from xotorch_support_jetson_tpu.ops.paged import (
   init_paged_pool,
@@ -50,6 +52,70 @@ def test_paged_kernel_matches_gather_reference():
   ref = paged_gqa_attention_ref(q[:, None], kp, vp, bt, lengths, ps)[:, 0]
   ker = paged_decode_attention(q, kp, vp, bt, lengths, ps, interpret=True)
   assert jnp.allclose(ref, ker, atol=1e-5)
+
+
+@pytest.mark.parametrize("pages_per_step", [1, 2, 4])
+def test_paged_kernel_page_tile_geometry_matches_reference(pages_per_step):
+  """Every page-tile width (including tiles that do not divide mp — trailing
+  slots clamp to the last valid page and mask) gives the same output as the
+  single-page gather reference."""
+  rng = np.random.default_rng(5)
+  B, Hq, Hkv, hd, ps, P = 2, 4, 2, 64, 8, 16
+  mp = 6  # deliberately not a multiple of 4
+  q = jnp.asarray(rng.normal(size=(B, Hq, hd)), jnp.float32)
+  kp = jnp.asarray(rng.normal(size=(P, Hkv, ps, hd)), jnp.float32)
+  vp = jnp.asarray(rng.normal(size=(P, Hkv, ps, hd)), jnp.float32)
+  bt = jnp.asarray([[3, 5, 7, 9, 11, 0], [1, 2, 4, 0, 0, 0]], jnp.int32)
+  lengths = jnp.asarray([5 * ps - 3, 2 * ps + 1], jnp.int32)  # page-boundary crossings
+  ref = paged_gqa_attention_ref(q[:, None], kp, vp, bt, lengths, ps)[:, 0]
+  ker = paged_decode_attention(q, kp, vp, bt, lengths, ps, pages_per_step=pages_per_step, interpret=True)
+  assert jnp.allclose(ref, ker, atol=1e-5), f"page tile {pages_per_step} diverges"
+
+
+def test_paged_kernel_int8kv_dequant_matches_gather_reference():
+  """int8-KV pools through the kernel (in-register dequant) == the gather
+  reference consuming the same codes + scale pools."""
+  rng = np.random.default_rng(9)
+  B, Hq, Hkv, hd, ps, P = 2, 4, 2, 64, 8, 10
+  q = jnp.asarray(rng.normal(size=(B, Hq, hd)), jnp.float32)
+  kp = jnp.asarray(rng.integers(-127, 128, size=(P, Hkv, ps, hd)), jnp.int8)
+  vp = jnp.asarray(rng.integers(-127, 128, size=(P, Hkv, ps, hd)), jnp.int8)
+  ks = jnp.asarray(rng.uniform(0.005, 0.02, size=(P, Hkv, ps, 1)), jnp.float32)
+  vs = jnp.asarray(rng.uniform(0.005, 0.02, size=(P, Hkv, ps, 1)), jnp.float32)
+  bt = jnp.asarray([[3, 5, 7, 0], [1, 2, 0, 0]], jnp.int32)
+  lengths = jnp.asarray([3 * ps - 2, ps + 3], jnp.int32)
+  ref = paged_gqa_attention_ref(q[:, None], kp, vp, bt, lengths, ps, k_scale_pool_l=ks, v_scale_pool_l=vs)[:, 0]
+  for g in (1, 2):
+    ker = paged_decode_attention(q, kp, vp, bt, lengths, ps, k_scale_pool_l=ks, v_scale_pool_l=vs, pages_per_step=g, interpret=True)
+    assert jnp.allclose(ref, ker, atol=1e-5), f"int8 kernel (tile {g}) diverges"
+  with pytest.raises(ValueError):
+    paged_decode_attention(q, kp, vp, bt, lengths, ps, k_scale_pool_l=ks, interpret=True)
+
+
+def test_decode_path_dispatch_table(monkeypatch):
+  """Representative (batch, context, quant) points hit the measured winners;
+  the env override forces either in-program path."""
+  from xotorch_support_jetson_tpu.inference.paging import select_decode_path
+
+  monkeypatch.delenv("XOT_TPU_PAGED_KERNEL", raising=False)
+  # Small-batch serving shapes: the fused XLA gather (round-2 measurement).
+  assert select_decode_path(16, 1024, "", platform="tpu") == "gather"
+  assert select_decode_path(8, 4096, "int8", platform="tpu") == "gather"
+  # Past the B=16 knee with bf16 KV: dense slots (round-5 knee study).
+  assert select_decode_path(48, 1024, "", platform="tpu") == "dense"
+  # Past the knee with int8-KV pages: the kernel (in-kernel dequant).
+  assert select_decode_path(48, 1024, "int8", platform="tpu") == "kernel"
+  assert select_decode_path(32, 4096, "int8", platform="tpu") == "kernel"
+  # Long contexts: the kernel's clamped-DMA design target, any quant.
+  assert select_decode_path(8, 32768, "", platform="tpu") == "kernel"
+  assert select_decode_path(16, 8192, "int8", platform="tpu") == "kernel"
+  # Non-TPU platforms always take the gather reference.
+  assert select_decode_path(48, 32768, "int8", platform="cpu") == "gather"
+  # Env forcing keeps the old opt-in/off behaviors.
+  monkeypatch.setenv("XOT_TPU_PAGED_KERNEL", "1")
+  assert select_decode_path(16, 1024, "", platform="tpu") == "kernel"
+  monkeypatch.setenv("XOT_TPU_PAGED_KERNEL", "0")
+  assert select_decode_path(48, 32768, "int8", platform="tpu") == "gather"
 
 
 def _prefill_both(params, shard, prompts, n_slots, max_seq=128):
@@ -91,6 +157,91 @@ def test_paged_decode_matches_dense_decode():
   td, tp = np.asarray(td), np.asarray(tp)
   assert np.array_equal(td[:2], tp[:2])
   assert np.array_equal(np.asarray(pd), np.asarray(pp))
+
+
+@pytest.mark.parametrize("B", [16, 48])
+def test_paged_int8kv_batched_decode_matches_dense(B):
+  """Paged int8-KV batched decode == dense int8-KV batched decode, token for
+  token, at B=16 and at the B=48 dense knee on the CPU virtual mesh. The
+  batch includes a prompt that crosses a page boundary (PS+2), a row whose
+  DECODE run crosses into a fresh page (prompt PS-1), and a prefix-cache-hit
+  admission (the last row reuses the first row's leading prompt page and
+  prefills only its suffix, prefix_len > 0)."""
+  params, shard = full_model_params(KEY, CFG)
+  rng = np.random.default_rng(11)
+  mp = 128 // PS
+  lens = [PS + 2, PS - 1] + [int(rng.integers(2, 2 * PS + 4)) for _ in range(B - 3)] + [PS + 2]
+  prompts = [list(rng.integers(1, CFG.vocab_size, size=(s,))) for s in lens]
+  prompts[-1] = list(prompts[0])  # prefix-cache-hit row: same prompt as row 0
+
+  S_pad = 48
+  tok = np.zeros((B, S_pad), np.int32)
+  prompt_lens = np.asarray(lens, np.int32)
+  for i, p in enumerate(prompts):
+    tok[i, : len(p)] = p
+
+  dense = init_kv_cache(CFG, shard.n_shard_layers, B, 128, quant="int8")
+  last_d, dense = prefill_into_slots(params, CFG, shard, jnp.asarray(tok), dense, jnp.arange(B, dtype=jnp.int32), jnp.asarray(prompt_lens))
+
+  pool = init_paged_pool(CFG, shard.n_shard_layers, 1 + B * mp, PS, quant="int8")
+  bts = np.zeros((B, mp), np.int32)
+  for r in range(B):
+    bts[r] = range(1 + r * mp, 1 + (r + 1) * mp)
+  # First dispatch: all rows except the prefix-reuser, from position 0.
+  last_p1, pool = prefill_into_pages_many(
+    params, CFG, shard, jnp.asarray(tok[: B - 1]), pool, jnp.asarray(bts[: B - 1]),
+    jnp.zeros((B - 1,), jnp.int32), jnp.asarray(prompt_lens[: B - 1]), PS,
+  )
+  # Second dispatch: the last row reuses row 0's (now-written) first page —
+  # the scheduler's prefix-cache-hit shape — and prefills only its suffix.
+  bts[-1, 0] = bts[0, 0]
+  suffix = np.zeros((1, 16), np.int32)
+  suffix[0, : lens[-1] - PS] = prompts[-1][PS:]
+  last_p2, pool = prefill_into_pages(
+    params, CFG, shard, jnp.asarray(suffix), pool, jnp.asarray(bts[-1]), jnp.int32(PS), jnp.int32(lens[-1]), PS
+  )
+  last_p = np.concatenate([np.asarray(last_p1), np.asarray(last_p2)])
+
+  assert np.allclose(np.asarray(last_d), last_p, atol=1e-4)
+  firsts = np.argmax(np.asarray(last_d), axis=-1).astype(np.int32)
+  assert np.array_equal(firsts, np.argmax(last_p, axis=-1))
+
+  tok1 = jnp.asarray(firsts[:, None], jnp.int32)
+  positions = jnp.asarray(prompt_lens, jnp.int32)
+  active = jnp.ones((B,), bool)
+  temps = jnp.zeros((B,), jnp.float32)
+  n_steps = PS + 3  # every row's decode crosses at least one page boundary
+  td, pd, _ = fused_batch_decode(params, CFG, shard, tok1, dense, positions, active, temps, n_steps)
+  tp, pq, _ = fused_paged_batch_decode(
+    params, CFG, shard, tok1, pool, jnp.asarray(bts), positions, active, temps, n_steps, page_size=PS, use_kernel=False
+  )
+  assert np.array_equal(np.asarray(td), np.asarray(tp))
+  assert np.array_equal(np.asarray(pd), np.asarray(pq))
+
+
+def test_scheduler_int8kv_pool_uses_block_math_capacity(monkeypatch):
+  """With int8-KV pages (half the bytes per token) the default pool holds 2x
+  the dense layout's pages — large-batch admission is bounded by
+  paged+int8-KV block math, not dense-slot math — and requests still serve."""
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+
+  params, shard = full_model_params(KEY, CFG)
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", str(PS))
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  monkeypatch.delenv("XOT_TPU_BATCH_PAGES", raising=False)
+  server = BatchedServer(_engine(params, shard), n_slots=2, chunk=2)
+
+  async def run():
+    return await server.submit("q", np.asarray([3, 25, 9], np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+
+  out = asyncio.run(run())
+  assert len(out) == 4
+  mp = 128 // PS
+  hd = CFG.head_dim  # int8 page bytes/token = hd + 4 (scale) vs 2*hd bf16
+  assert server.allocator.n_pages == (2 * server.n_slots * mp * hd) // (hd + 4) + 1
+  assert server.allocator.n_pages > server.n_slots * mp + 1  # strictly beyond dense-slot math
+  assert server.cache["k"].dtype == jnp.int8
 
 
 def test_paged_prefix_reuse_is_exact():

@@ -42,6 +42,17 @@ def test_flash_masks_garbage_beyond_positions():
   np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5)
 
 
+def test_flash_prefill_half_specified_quant_raises():
+  """Passing only one of k_scale/v_scale is a caller bug (the other leaf
+  would be silently ignored / int8 codes read as values): fail loudly."""
+  q, k, v = _make(Sq=128, Skv=128)
+  scale = jnp.ones((2, 128, 2, 1), jnp.float32)
+  with pytest.raises(ValueError, match="k_scale and v_scale"):
+    flash_attention_prefill(q, k, v, k_scale=scale, interpret=True)
+  with pytest.raises(ValueError, match="k_scale and v_scale"):
+    flash_attention_prefill(q, k, v, v_scale=scale, interpret=True)
+
+
 def test_flash_supported_gating(monkeypatch):
   assert not flash_supported((1, 100, 4, 64), 256, platform="tpu")  # Sq not blocked
   assert not flash_supported((1, 128, 4, 63), 256, platform="tpu")  # odd head dim

@@ -119,10 +119,15 @@ class BatchedServer:
     self.max_queue = max_queue if max_queue is not None else int(os.getenv("XOT_TPU_BATCH_MAX_QUEUE", "64"))
     # Paged KV cache (default): positions map onto fixed-size pages through
     # per-row block tables (ops/paged.py), so HBM is bounded by aggregate
-    # context — XOT_TPU_BATCH_PAGES sizes the pool (default: full dense
-    # capacity) — and page-aligned prompt prefixes dedup across requests.
-    # XOT_TPU_PAGED=0 restores the dense slot-per-max_seq cache.
-    self.paged = os.getenv("XOT_TPU_PAGED", "1") not in ("0", "false")
+    # context — XOT_TPU_BATCH_PAGES sizes the pool (default: the dense
+    # layout's HBM budget in PAGES, which under int8-KV quantization is 2x
+    # the dense slot count's worth of contexts; see _ensure_cache) — and
+    # page-aligned prompt prefixes dedup across requests. XOT_TPU_PAGED=0
+    # restores the dense slot-per-max_seq cache; XOT_TPU_PAGED=auto defers
+    # the layout to the dispatch table (inference/paging.py
+    # select_decode_path) at cache-build time.
+    self._paged_mode = os.getenv("XOT_TPU_PAGED", "1")
+    self.paged = self._paged_mode not in ("0", "false")
     self.page_size = int(os.getenv("XOT_TPU_PAGE_SIZE", "64"))
     # Chunked prefill (paged mode): a prompt longer than this many tokens
     # prefills in chunks with DECODE TICKS interleaved between them, so one
@@ -216,13 +221,35 @@ class BatchedServer:
     if self.cache is not None:
       return
     eng = self.engine
+    from ..models.decoder import kv_quant_mode
+
+    kv_quant = kv_quant_mode(eng.cfg)
     self.max_seq = min(eng.max_seq_len, eng.cfg.max_seq_len)
+    if self._paged_mode == "auto":
+      # Defer the LAYOUT to the dispatch table: "dense" at this pool's
+      # (slots, window, quant) point means the dense slot cache beats both
+      # paged paths and per-slot HBM is affordable by construction (the
+      # dense pool is the budget the paged default is sized from).
+      from .paging import select_decode_path
+
+      self.paged = select_decode_path(self.n_slots, self.max_seq, kv_quant) != "dense"
     if self.paged:
       from .paging import PageAllocator
 
       ps = self.page_size
       self.pages_per_row = (self.max_seq + ps - 1) // ps
-      n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or self.n_slots * self.pages_per_row + 1
+      # Default pool size: the dense layout's HBM budget expressed in
+      # PAGES, not its slot count. An int8-KV token costs hd code bytes +
+      # 4 scale bytes per head per side vs 2·hd bf16 bytes, so the same
+      # budget holds 2·hd/(hd+4) ≈ 1.88x (hd=64) the pages — admission at
+      # large batch (the B=48 knee) is bounded by paged+int8-KV block math
+      # instead of dense-slot math, and the pool actually holds the
+      # aggregate context 48 rows need without exceeding the bf16 budget.
+      per_dense = self.n_slots * self.pages_per_row
+      if kv_quant:
+        hd = max(eng.cfg.cache_k_dim, 1)
+        per_dense = (2 * per_dense * hd) // (hd + 4)
+      n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or per_dense + 1
       self.allocator = PageAllocator(n_pages, ps)
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
       self.cache = self.ops.init_pool(n_pages, ps)

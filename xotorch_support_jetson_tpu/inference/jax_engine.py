@@ -45,6 +45,33 @@ def _round_up(n: int, multiple: int) -> int:
   return ((n + multiple - 1) // multiple) * multiple
 
 
+def _tokenizer_fingerprint(d: Path) -> dict[str, str] | None:
+  """Best-effort tokenizer identity for a checkpoint dir: per-artifact
+  digests over the VOCABULARY files (tokenizer.json / sentencepiece model /
+  vocab+merges). Kept per-file so two dirs compare only on the artifacts
+  BOTH ship — identical tokenizers serialized with different artifact sets
+  (e.g. tokenizer.json alone vs +tokenizer.model) must not read as a
+  mismatch. ``tokenizer_config.json`` is deliberately excluded —
+  chat-template and padding metadata differ across same-tokenizer model
+  families. None when no artifact exists (nothing to compare)."""
+  import hashlib
+
+  digests = {}
+  for name in ("tokenizer.json", "tokenizer.model", "vocab.json", "merges.txt"):
+    f = d / name
+    if f.is_file():
+      digests[name] = hashlib.blake2b(f.read_bytes(), digest_size=16).hexdigest()
+  return digests or None
+
+
+def _tokenizers_differ(fp_a: dict[str, str] | None, fp_b: dict[str, str] | None) -> bool:
+  """True only when some artifact PRESENT IN BOTH checkpoints differs."""
+  if not fp_a or not fp_b:
+    return False
+  common = fp_a.keys() & fp_b.keys()
+  return bool(common) and any(fp_a[n] != fp_b[n] for n in common)
+
+
 # --- jitted steps (cfg/shard static; cache donated so decode is in-place) ---
 
 
@@ -225,8 +252,11 @@ class JaxShardedInferenceEngine(InferenceEngine):
       (int8-quantized at load) drafts for the target — the configuration
       where speculation mathematically wins (the 1B draft decodes ~4× faster
       than the 8B target; the measured self-draft ratio is only ~1.6×).
-      Vocab compatibility is checked at load; the draft proposes target-vocab
-      token ids, so mismatched tokenizers are refused, not mistranslated.
+      Compatibility checks at load: vocab SIZE equality always, plus
+      tokenizer-artifact identity when both checkpoints carry tokenizer
+      files. Equal-sized but differently-TOKENIZING pairs with no artifacts
+      to compare slip through — greedy verification keeps the output exact
+      regardless; acceptance just collapses.
     - otherwise (``XOT_TPU_SPEC_DECODE=int8`` alone): the int8 self-draft.
 
     Requires a full-model shard (sampling feeds the next embed).
@@ -279,16 +309,37 @@ class JaxShardedInferenceEngine(InferenceEngine):
         "draft tokens are target-vocab ids, so this pair cannot speculate; draft disabled"
       )
       return
+    # Vocab-size equality is a weak tokenizer-identity proxy: when both
+    # checkpoints carry tokenizer artifacts, compare them too — a draft that
+    # tokenizes DIFFERENTLY proposes wrong ids (greedy verify stays exact;
+    # acceptance silently collapses to ~0, i.e. pure slowdown).
+    target_dir = getattr(self, "_model_dir", None)
+    fp_t = _tokenizer_fingerprint(Path(target_dir)) if target_dir else None
+    fp_d = _tokenizer_fingerprint(d)
+    if _tokenizers_differ(fp_t, fp_d):
+      print(
+        f"[jax_engine] XOT_TPU_SPEC_DRAFT={spec!r}: draft tokenizer artifacts differ from the target's "
+        "(same vocab size, different vocabulary) — the draft would propose wrong ids; draft disabled"
+      )
+      return
     shard_d = Shard(spec, 0, cfg_d.n_layers - 1, cfg_d.n_layers)
     # int8 draft: drafting is decode-bound like everything else — the whole
     # point of the small model is fewer bytes per proposed token.
     draft = quantize_params(load_shard_weights(d, cfg_d, shard_d))
     if self.mesh is not None and self._pp is None:
       # The self-draft inherits shardings from the already-placed target;
-      # a cross-model draft is loaded fresh and must be placed itself.
+      # a cross-model draft is loaded fresh and must be placed itself. The
+      # target-generic specs can be indivisible for the draft's geometry
+      # (head/hidden axes vs mesh tp) — that must DEGRADE like every other
+      # _build_cross_draft failure mode, not abort the engine load: fall
+      # back to a replicated draft (drafting is small-model decode; the
+      # replicated copy costs HBM, not correctness).
       from ..parallel.mesh import shard_params
 
-      draft = shard_params(draft, self.mesh)
+      try:
+        draft = shard_params(draft, self.mesh)
+      except Exception as e:  # noqa: BLE001
+        print(f"[jax_engine] XOT_TPU_SPEC_DRAFT={spec!r}: draft sharding failed ({e!r}); keeping the draft replicated")
     self._draft_params = draft
     self._draft_cfg = cfg_d
     self._draft_shard = shard_d

@@ -2,7 +2,11 @@
 
 The device never sees this: pages are allocated/freed/shared here and the
 resulting block tables ride into the compiled decode program as traced
-operands. Two pieces:
+operands. Three pieces:
+
+- ``select_decode_path`` — the (batch, context, quant-mode) dispatch table
+  that picks XLA-gather vs the Pallas paged kernel vs dense slots per shape
+  (the measured winner flips; see the table's provenance comments).
 
 - ``PageAllocator`` — a free list over pages ``1..n_pages-1`` (page 0 is the
   device-side trash page and is never handed out).
@@ -29,9 +33,73 @@ XLA shapes.
 from __future__ import annotations
 
 import hashlib
+import os
 from collections import OrderedDict
 
 import numpy as np
+
+# ------------------------------------------------- decode-path dispatch table
+#
+# Which decode attention path wins flips with (batch, context, KV quant mode)
+# — measured, not guessed — so neither path is hardwired:
+#
+# - "gather": XLA's fused jnp.take+attention over the page pool. Round-2
+#   measurement: 1000 vs kernel 854 vs dense 926 aggregate tok/s at B=16×1K
+#   — XLA fuses the gather without materializing pages, and at small batch
+#   the grid-step overhead of the kernel doesn't amortize.
+# - "kernel": the Pallas paged kernel (ops/paged.py) — block-table
+#   indirection via scalar prefetch, page-tiled split-K, in-kernel int8-KV
+#   dequant. Wins where gather degrades: the round-5 knee study showed the
+#   page-gather indirection growing with B (paged B=32 1259 vs B=16 1472),
+#   and the kernel's clamped no-op DMA is the design answer for long ragged
+#   caches; with int8-KV pools the in-kernel dequant halves the pool-read
+#   bytes that the out-of-kernel dequant path was doubling.
+# - "dense": advisory only — the dense slot layout beats BOTH paged paths
+#   (round-5: dense int8-KV B=48 1967 vs paged-B=16-knee 1472). Only
+#   honorable where the LAYOUT is still a free choice (batch_scheduler
+#   _ensure_cache under XOT_TPU_PAGED=auto); inside an already-paged
+#   program the decoder degrades it to "kernel" (the closest-to-dense
+#   paged path — no materialized gather).
+#
+# Rows are (max_batch, max_context_tokens, kv_quant, path); None = any.
+# First row whose bounds cover the query wins.
+
+_DECODE_PATH_TABLE = (
+  (16, 4096, None, "gather"),  # small batch, serving ctx: fused XLA gather (r2 measurement)
+  (None, 4096, "", "dense"),  # bf16 KV past the B=16 knee: dense slots win when HBM affords
+  (None, None, None, "kernel"),  # large batch or long context (and all int8-KV past the knee)
+)
+
+
+def select_decode_path(batch: int, context: int, kv_quant: str = "", platform: str | None = None) -> str:
+  """Pick the decode attention path for a (batch, context, quant) point.
+
+  Returns "gather" | "kernel" | "dense" per the measured table above.
+  ``context`` is the per-row KV window in TOKENS (block-table width × page
+  size). ``XOT_TPU_PAGED_KERNEL=1`` forces "kernel", ``=0`` forces "gather"
+  (the old opt-in/off behaviors); non-TPU platforms always take the gather
+  reference path.
+  """
+  forced = os.getenv("XOT_TPU_PAGED_KERNEL")
+  if forced is not None:
+    from ..utils.helpers import env_flag
+
+    return "kernel" if env_flag("XOT_TPU_PAGED_KERNEL") else "gather"
+  if platform is None:
+    import jax
+
+    platform = jax.default_backend()
+  if platform != "tpu":
+    return "gather"
+  for max_b, max_ctx, quant, path in _DECODE_PATH_TABLE:
+    if max_b is not None and batch > max_b:
+      continue
+    if max_ctx is not None and context > max_ctx:
+      continue
+    if quant is not None and quant != kv_quant:
+      continue
+    return path
+  return "gather"
 
 
 class PageAllocator:

@@ -1077,10 +1077,20 @@ def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: Mode
         "v": write_token_kv(pool_l["v"], vq, block_tables, pos, page_size),
         "v_scale": write_token_kv(pool_l["v_scale"], vs, block_tables, pos, page_size),
       }
-      attn = paged_gqa_attention_ref(
-        q, pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
-        k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
-      )
+      if use_kernel and cfg.plain_attention:
+        # int8-KV pages straight through the kernel: codes + scales stream
+        # per page tile with in-register dequant — the pool read stays
+        # 1 byte/element (the gather fallback below moves int8 bytes too,
+        # but materializes the gathered window).
+        attn = paged_decode_attention(
+          q[:, 0], pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
+          k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"],
+        )[:, None]
+      else:
+        attn = paged_gqa_attention_ref(
+          q, pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
+          k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
+        )
     else:
       k_pool = write_token_kv(pool_l["k"], k[:, 0], block_tables, pos, page_size)
       v_pool = write_token_kv(pool_l["v"], v[:, 0], block_tables, pos, page_size)
@@ -1148,7 +1158,16 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   allocated pages covering [pos, pos + n_steps) for every active row before
   dispatch (inference/batch_scheduler.py does). Returns
   (tokens [B, n_steps], positions [B], pool).
+
+  ``use_kernel=None`` resolves per shape through the dispatch table
+  (inference/paging.py select_decode_path): the XLA gather stays the
+  small-batch serving winner, the Pallas kernel takes large-batch and
+  long-context shapes (with in-kernel int8-KV dequant when the pool is
+  quantized). A "dense" verdict degrades to the kernel here — the layout is
+  already paged, and the kernel is the no-materialized-gather path closest
+  to dense behavior.
   """
+  from ..inference.paging import select_decode_path
   from ..ops.paged import paged_kernel_supported
 
   if not (shard.is_first_layer and shard.is_last_layer):
@@ -1156,7 +1175,9 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   if key is None:
     key = jax.random.PRNGKey(0)
   if use_kernel is None:
-    use_kernel = paged_kernel_supported(cfg)
+    kv_quant = "int8" if "k_scale" in pool else ""
+    context = int(jnp.shape(block_tables)[1]) * int(page_size)
+    use_kernel = paged_kernel_supported(cfg) and select_decode_path(token.shape[0], context, kv_quant) != "gather"
   B = token.shape[0]
   top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
   return _fused_paged_batch_decode_impl(

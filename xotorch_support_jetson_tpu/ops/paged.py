@@ -147,18 +147,56 @@ def paged_mla_attention_ref(q_nope, q_pe, k_pool_l, v_pool_l, block_tables, leng
 # ------------------------------------------------- Pallas paged decode kernel
 #
 # One-token-per-row decode attention straight off the page pool. Split-K
-# flash-decode over pages: grid (B, Hkv, mp) — the innermost page axis runs
-# sequentially per (row, kv-head) carrying online-softmax state in VMEM
+# flash-decode over pages: grid (B, Hkv, ceil(mp/G)) — the innermost axis
+# runs sequentially per (row, kv-head) carrying online-softmax state in VMEM
 # scratch, so long contexts stream page tiles through VMEM without ever
-# materializing the gathered cache. The block table and per-row lengths are
-# scalar-prefetched: the index map picks each step's page BEFORE the body
-# runs, and clamps past-the-end steps to the last valid page so their DMA is
-# a no-op re-fetch (Pallas skips the copy when the block index repeats).
+# materializing the gathered cache. Each grid step fetches a TILE of G pages
+# (G separate block-spec'd views of the same pool operand, one index map per
+# tile slot): at serving shapes (B=8-48, ctx 1K-32K, ps=64) the per-page
+# grid was step-overhead-bound — G=4 cuts the sequential step count 4× while
+# each page's DMA stays a contiguous [ps, hd] block. The block table and
+# per-row lengths are scalar-prefetched: the index map picks each step's
+# pages BEFORE the body runs, and clamps past-the-end steps to the last
+# valid page so their DMA is a no-op re-fetch (Pallas skips the copy when
+# the block index repeats).
+#
+# int8-KV pools ride through IN-KERNEL: k/v hold int8 codes and the
+# per-(token, head) scale pools [P, Hkv, ps, 1] stream alongside as extra
+# [ps, 1] tiles — k's scale multiplies each score column, v's folds into the
+# probabilities after the denominator update (same factoring as
+# ops/pallas_attention.py _flash_kernel), so the HBM page reads stay
+# 1 byte/element and the paged path never materializes a dequantized cache.
+# (The previous design dequantized OUTSIDE the kernel path via the gather
+# reference — doubling cache-read bytes exactly where the paged path was
+# losing to dense slots.)
+
+_PAGE_TILE_DEFAULT = 4
 
 
-def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, page_size: int, scale: float):
+def _page_tile(mp: int) -> int:
+  """Pages fetched per grid step: the largest power of two ≤ mp, capped at
+  ``XOT_TPU_PAGED_TILE`` (default 4 — retuned at the measured serving shapes;
+  beyond 4 the extra operand streams stop paying on v5e). mp need not divide
+  the tile: trailing slots clamp to the last valid page and mask."""
+  import os
+
+  cap = int(os.getenv("XOT_TPU_PAGED_TILE", str(_PAGE_TILE_DEFAULT)))
+  g = 1
+  while g * 2 <= min(mp, max(cap, 1)):
+    g *= 2
+  return g
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: float, pages_per_step: int, quantized: bool):
   import jax.experimental.pallas as pl
 
+  G = pages_per_step
+  k_refs, v_refs = refs[0:G], refs[G : 2 * G]
+  if quantized:
+    ks_refs, vs_refs = refs[2 * G : 3 * G], refs[3 * G : 4 * G]
+    o_ref, m_ref, l_ref, acc_ref = refs[4 * G :]
+  else:
+    o_ref, m_ref, l_ref, acc_ref = refs[2 * G :]
   b, i = pl.program_id(0), pl.program_id(2)
 
   @pl.when(i == 0)
@@ -168,25 +206,36 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_r
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
   length = len_ref[b]
-  start = i * page_size
+  q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+  # Static unroll over the tile: each page's block chains the online-softmax
+  # state exactly like a dedicated grid step would (same math, G× fewer
+  # sequential steps). Pages clamped by the index map land with start >=
+  # length, so their whole block is skipped.
+  for j in range(G):
+    start = (i * G + j) * page_size
 
-  @pl.when(start < length)
-  def _block():
-    q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
-    k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
-    v = v_ref[0, 0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [group, ps]
-    kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-    s = jnp.where(kv_pos < length, s, NEG_INF)
-    m_prev = m_ref[...]
-    blk_m = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, blk_m)
-    p = jnp.exp(s - m_new)
-    p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
-    alpha = jnp.exp(m_prev - m_new)
-    m_ref[...] = m_new
-    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    @pl.when(start < length)
+    def _block(j=j, start=start):
+      k = k_refs[j][0, 0].astype(jnp.float32)  # [ps, hd]
+      v = v_refs[j][0, 0].astype(jnp.float32)
+      s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [group, ps]
+      if quantized:
+        # codes·scale = true k: the per-token scale multiplies each score
+        # COLUMN ([ps, 1] transposed to a [1, ps] row broadcast).
+        s = s * jnp.transpose(ks_refs[j][0, 0], (1, 0))
+      kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+      s = jnp.where(kv_pos < length, s, NEG_INF)
+      m_prev = m_ref[...]
+      blk_m = jnp.max(s, axis=1, keepdims=True)
+      m_new = jnp.maximum(m_prev, blk_m)
+      p = jnp.exp(s - m_new)
+      p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+      alpha = jnp.exp(m_prev - m_new)
+      m_ref[...] = m_new
+      l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+      if quantized:
+        p = p * jnp.transpose(vs_refs[j][0, 0], (1, 0))  # v's scale folds into probs (after the l update)
+      acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
   @pl.when(i == pl.num_programs(2) - 1)
   def _finish():
@@ -195,40 +244,74 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_r
     o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
-def paged_decode_attention(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, interpret: bool = False):
+def paged_decode_attention(
+  q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int,
+  k_scale_pool_l=None, v_scale_pool_l=None, pages_per_step: int | None = None, interpret: bool = False,
+):
   """Decode attention off the page pool (dense GQA models).
 
   q [B, Hq, hd] (the single new token per row); k/v pool [P, Hkv, ps, hd];
   block_tables [B, mp] int32 (unallocated entries may hold anything — steps
   past ``lengths`` are clamped to the last valid page and masked);
   lengths [B] int32 = number of valid KV slots INCLUDING the token just
-  written. Returns [B, Hq, hd].
+  written. With ``k_scale_pool_l``/``v_scale_pool_l`` [P, Hkv, ps, 1]
+  (int8-KV pools — init_paged_pool quant="int8"), k/v hold int8 codes
+  dequantized in-register per page tile. ``pages_per_step`` (static)
+  overrides the tuned page-tile width. Returns [B, Hq, hd].
   """
+  if (k_scale_pool_l is None) != (v_scale_pool_l is None):
+    raise ValueError("paged_decode_attention: k_scale_pool_l and v_scale_pool_l must be passed together")
+  # Resolve the env-tunable tile width OUTSIDE the jitted body: baked-in-at-
+  # first-trace env reads silently ignore later changes for identical shapes
+  # (an in-process XOT_TPU_PAGED_TILE sweep would re-time one width forever).
+  G = pages_per_step or _page_tile(jnp.shape(block_tables)[1])
+  return _paged_decode_attention_impl(
+    q, k_pool_l, v_pool_l, block_tables, lengths, k_scale_pool_l, v_scale_pool_l,
+    page_size=page_size, pages_per_step=G, interpret=interpret,
+  )
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "pages_per_step", "interpret"))
+def _paged_decode_attention_impl(
+  q, k_pool_l, v_pool_l, block_tables, lengths, k_scale_pool_l, v_scale_pool_l,
+  page_size: int, pages_per_step: int, interpret: bool,
+):
   import jax.experimental.pallas as pl
   from jax.experimental.pallas import tpu as pltpu
 
+  quantized = k_scale_pool_l is not None
   B, Hq, hd = q.shape
   Hkv = k_pool_l.shape[1]
   group = Hq // Hkv
   mp = block_tables.shape[1]
+  G = pages_per_step
+  n_steps = (mp + G - 1) // G
   scale = float(1.0 / (hd**0.5))
   qg = q.reshape(B, Hkv, group, hd)
 
-  def page_index(b, h, i, bt_ref, len_ref):
-    # Clamp past-the-end steps to the row's last valid page: the repeated
-    # block index makes the DMA a no-op instead of fetching garbage.
-    last = jnp.maximum(len_ref[b] - 1, 0) // page_size
-    return (bt_ref[b, jnp.minimum(i, last)], h, 0, 0)
+  def page_index(j):
+    def index(b, h, i, bt_ref, len_ref):
+      # Clamp past-the-end tile slots to the row's last valid page: the
+      # repeated block index makes the DMA a no-op instead of fetching
+      # garbage (also covers mp % G != 0 trailing slots).
+      last = jnp.maximum(len_ref[b] - 1, 0) // page_size
+      return (bt_ref[b, jnp.minimum(i * G + j, last)], h, 0, 0)
+
+    return index
+
+  in_specs = [pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0))]
+  in_specs += [pl.BlockSpec((1, 1, page_size, hd), page_index(j)) for j in range(G)]
+  in_specs += [pl.BlockSpec((1, 1, page_size, hd), page_index(j)) for j in range(G)]
+  operands = [qg] + [k_pool_l] * G + [v_pool_l] * G
+  if quantized:
+    in_specs += [pl.BlockSpec((1, 1, page_size, 1), page_index(j)) for j in range(G)]
+    in_specs += [pl.BlockSpec((1, 1, page_size, 1), page_index(j)) for j in range(G)]
+    operands += [k_scale_pool_l] * G + [v_scale_pool_l] * G
 
   grid_spec = pltpu.PrefetchScalarGridSpec(
     num_scalar_prefetch=2,
-    grid=(B, Hkv, mp),
-    in_specs=[
-      pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0)),
-      pl.BlockSpec((1, 1, page_size, hd), page_index),
-      pl.BlockSpec((1, 1, page_size, hd), page_index),
-    ],
+    grid=(B, Hkv, n_steps),
+    in_specs=in_specs,
     out_specs=pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0)),
     scratch_shapes=[
       pltpu.VMEM((group, 1), jnp.float32),
@@ -237,24 +320,27 @@ def paged_decode_attention(q, k_pool_l, v_pool_l, block_tables, lengths, page_si
     ],
   )
   out = pl.pallas_call(
-    functools.partial(_paged_decode_kernel, page_size=page_size, scale=scale),
+    functools.partial(_paged_decode_kernel, page_size=page_size, scale=scale, pages_per_step=G, quantized=quantized),
     out_shape=jax.ShapeDtypeStruct((B, Hkv, group, hd), q.dtype),
     grid_spec=grid_spec,
     interpret=interpret,
-  )(block_tables, lengths, qg, k_pool_l, v_pool_l)
+  )(block_tables, lengths, *operands)
   return out.reshape(B, Hq, hd)
 
 
 def paged_kernel_supported(cfg, platform: str | None = None) -> bool:
-  """Whether the Pallas paged kernel should run. OPT-IN (XOT_TPU_PAGED_KERNEL=1):
-  at serving-scale contexts (≤4K) XLA's fused gather+attention beats the
-  kernel on v5e (measured: 1000 vs 854 aggregate tok/s at 16×1K rows) —
-  the kernel's page-clamped DMA pays off only on long, ragged caches."""
+  """Whether the Pallas paged kernel CAN run for this model/platform.
+
+  Capability + kill-switches only — whether it SHOULD run for a given
+  (batch, context, quant-mode) is the dispatch table's call
+  (inference/paging.py select_decode_path; models/decoder.py resolves
+  ``use_kernel`` through both). ``XOT_TPU_NO_FLASH`` and
+  ``XOT_TPU_PAGED_KERNEL=0`` force it off everywhere."""
   import os
 
   from ..utils.helpers import env_flag
 
-  if os.getenv("XOT_TPU_NO_FLASH") or not env_flag("XOT_TPU_PAGED_KERNEL"):
+  if os.getenv("XOT_TPU_NO_FLASH") or not env_flag("XOT_TPU_PAGED_KERNEL", default=True):
     return False
   platform = platform or jax.default_backend()
   return platform == "tpu" and not cfg.is_mla and cfg.head_dim in (64, 128, 256)

@@ -114,6 +114,10 @@ def flash_attention_prefill(q, k, v, q_offset=0, k_scale=None, v_scale=None, int
   import jax.experimental.pallas as pl
   from jax.experimental.pallas import tpu as pltpu
 
+  if (k_scale is None) != (v_scale is None):
+    # A half-specified quant call would silently ignore v_scale (or treat
+    # int8 v codes as values): fail loudly instead (ADVICE r5).
+    raise ValueError("flash_attention_prefill: k_scale and v_scale must be passed together (int8-KV codes carry both scale leaves)")
   B, Sq, Hq, hd = q.shape
   Skv, Hkv = k.shape[1], k.shape[2]
   group = Hq // Hkv

@@ -41,6 +41,38 @@ class MeshPlan:
     return f"dp={self.dp} pp={self.pp} sp={self.sp} ep={self.ep} tp={self.tp}"
 
 
+def shard_map_compat(f, *, mesh, in_specs=None, out_specs=None, axis_names=frozenset(), check_vma=True):
+  """``jax.shard_map`` across jax versions, in the NEW API's spelling.
+
+  Newer jax exposes top-level ``jax.shard_map(f, ..., axis_names=manual,
+  check_vma=...)``; older releases (≤0.4.x) only have
+  ``jax.experimental.shard_map.shard_map`` with the equivalent knobs named
+  ``auto`` (the COMPLEMENT of axis_names) and ``check_rep``. Every partial-
+  manual program in this package routes through here so one tree runs on
+  both. Use exactly like ``partial(jax.shard_map, ...)`` — empty
+  ``axis_names`` means fully manual (the new API's default), normalized
+  here so the old-API complement doesn't invert the meaning.
+  """
+  axis_names = frozenset(axis_names) or frozenset(mesh.axis_names)
+  if hasattr(jax, "shard_map"):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, axis_names=axis_names, check_vma=check_vma)
+  from jax.experimental.shard_map import shard_map as _shard_map
+
+  auto = frozenset(mesh.axis_names) - axis_names
+  if any(mesh.shape[a] > 1 for a in auto):
+    # Old-jax partial-auto shard_map lowers the manual region's
+    # axis_index/collectives through PartitionId, which XLA's SPMD
+    # partitioner rejects whenever a GSPMD-auto axis is actually >1 device.
+    # Fail at build time with the real reason instead of minutes into an
+    # XLA compile with an opaque UNIMPLEMENTED error.
+    raise NotImplementedError(
+      f"partial-manual shard_map (manual={sorted(axis_names)}) over a multi-device auto axis "
+      f"({ {a: mesh.shape[a] for a in sorted(auto) if mesh.shape[a] > 1} }) needs jax's top-level "
+      "jax.shard_map (>= 0.5); this jax build only supports it when every auto axis is size 1"
+    )
+  return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma, auto=auto)
+
+
 def build_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
   devices = devices if devices is not None else jax.devices()
   if len(devices) < plan.n_devices:

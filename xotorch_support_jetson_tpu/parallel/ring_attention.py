@@ -20,6 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import shard_map_compat
 
 NEG_INF = -1e30
 
@@ -107,7 +108,7 @@ def make_sharded_ring_attention(mesh: Mesh, **attn_opts):
   spec_pos = P(None, "sp")
 
   @partial(
-    jax.shard_map,
+    shard_map_compat,
     mesh=mesh,
     in_specs=(spec_q, spec_q, spec_q, spec_pos, P("sp")),
     out_specs=spec_q,

@@ -39,6 +39,7 @@ from ..models.config import ModelConfig
 from ..models.decoder import _next_token_batched, embed_tokens, head_logits
 from ..ops.rope import rope_inv_freq
 from .sp_serving import AXIS, SPServing, _sp_forward, _sp_layer_step
+from .mesh import shard_map_compat
 
 
 def _stripe_positions(mp: int, stripe: int, page_size: int, rank) -> jnp.ndarray:
@@ -124,7 +125,7 @@ class SPBatchedServing:
     self.cfg: ModelConfig = sps.cfg
     self.n_ranks = sps.n_ranks
     self.params = sps.params
-    self._sm = partial(jax.shard_map, mesh=self.mesh, axis_names={AXIS}, check_vma=False)
+    self._sm = partial(shard_map_compat, mesh=self.mesh, axis_names={AXIS}, check_vma=False)
     self._build()
 
   def place_cache(self, cache: dict) -> dict:

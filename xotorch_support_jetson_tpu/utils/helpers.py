@@ -15,7 +15,12 @@ import random
 import socket
 import uuid
 from pathlib import Path
-from typing import Any, Callable, Generic, TypeVar, TypeVarTuple, Unpack
+from typing import Any, Callable, Generic, TypeVar
+
+try:  # TypeVarTuple/Unpack land in typing at 3.11; 3.10 runs on the backport
+  from typing import TypeVarTuple, Unpack
+except ImportError:
+  from typing_extensions import TypeVarTuple, Unpack
 
 DEBUG = int(os.getenv("DEBUG", "0"))
 DEBUG_DISCOVERY = int(os.getenv("DEBUG_DISCOVERY", "0"))
