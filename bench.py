@@ -343,6 +343,25 @@ def main() -> None:
   # submit → first emitted token, default (paged) serving mode.
   ttft_batch8_p50_ms = None
   ttft_batch8_max_ms = None
+  ttft_batch8_p95_ms = None
+  itl_p50_ms = None
+  itl_p99_ms = None
+
+  def _hist_delta_quantile(before: dict, after: dict, name: str, q: float) -> float | None:
+    """Quantile of a histogram's growth BETWEEN two registry snapshots —
+    isolates the measured round from warm-up observations (the scheduler
+    records TTFT/ITL into the global registry on every round, and the warm
+    round's compile time would otherwise own the tail)."""
+    ha = (after.get("histograms") or {}).get(name)
+    if ha is None:
+      return None
+    hb = (before.get("histograms") or {}).get(name)
+    delta_counts = [int(a) - (int(hb["counts"][i]) if hb else 0) for i, a in enumerate(ha["counts"])]
+    from xotorch_support_jetson_tpu.utils.metrics import Metrics
+
+    m = Metrics.merged([{"histograms": {name: {"buckets": ha["buckets"], "counts": delta_counts, "sum": 0.0}}}])
+    return m.quantile(name, q)
+
   server = eng = None
   try:
     if not on_accel:  # scheduler covered by tests on CPU; keep the smoke quick
@@ -379,11 +398,24 @@ def main() -> None:
 
     async def ttft_bench():
       await ttft_round(batch_prompts("w"))  # warm the K=8 admission + chunk programs
-      return await ttft_round(batch_prompts("b"))
+      from xotorch_support_jetson_tpu.utils.metrics import metrics as global_metrics
 
-    ttfts = asyncio.run(ttft_bench())
+      before = global_metrics.snapshot()
+      measured = await ttft_round(batch_prompts("b"))
+      return measured, before, global_metrics.snapshot()
+
+    ttfts, snap_before, snap_after = asyncio.run(ttft_bench())
     ttft_batch8_p50_ms = round(float(np.median(ttfts)), 2)
     ttft_batch8_max_ms = round(ttfts[-1], 2)
+    # Tail latency from the scheduler's own histograms (utils/metrics.py):
+    # the measured round's delta only, so warm-compile samples don't own
+    # the tail. These are what BENCH rounds track instead of just means.
+    p95 = _hist_delta_quantile(snap_before, snap_after, "ttft_seconds", 0.95)
+    ttft_batch8_p95_ms = round(p95 * 1e3, 2) if p95 is not None else None
+    itl50 = _hist_delta_quantile(snap_before, snap_after, "itl_seconds", 0.50)
+    itl99 = _hist_delta_quantile(snap_before, snap_after, "itl_seconds", 0.99)
+    itl_p50_ms = round(itl50 * 1e3, 3) if itl50 is not None else None
+    itl_p99_ms = round(itl99 * 1e3, 3) if itl99 is not None else None
   except Exception:  # noqa: BLE001 — keep the bench line printing
     pass
   finally:
@@ -735,7 +767,10 @@ def main() -> None:
         "ttft_ms_spread": round(ttft_spread_ms, 2),
         "ttft_vs_prev": ttft_vs_prev,
         "ttft_ms_batch8_p50": ttft_batch8_p50_ms,
+        "ttft_ms_batch8_p95": ttft_batch8_p95_ms,
         "ttft_ms_batch8_max": ttft_batch8_max_ms,
+        "itl_ms_p50": itl_p50_ms,
+        "itl_ms_p99": itl_p99_ms,
         "platform": platform,
         "device": str(jax.devices()[0]),
         "n_decode": n_decode,

@@ -384,6 +384,10 @@ def test_chunked_prefill_interleaves_decode(monkeypatch):
 
 
 def test_pp_engine_batched_admission(monkeypatch):
+  from tests_support_stubs import require_partial_manual
+  from xotorch_support_jetson_tpu.parallel.mesh import MeshPlan as _MP
+
+  require_partial_manual(_MP(pp=2, tp=4))
   """XOT_TPU_PP=2: the pp-pipelined backend admits a burst in one dispatch
   too (dense slots), outputs exact."""
   monkeypatch.setenv("XOT_TPU_PAGED", "0")

@@ -101,6 +101,25 @@ async def test_full_serving_path_blocking_and_streaming(serving_stack):
     assert data["usage"]["completion_tokens"] > 0
     assert data["choices"][0]["finish_reason"] in ("stop", "length")
 
+    # Stage timeline for the finished request (ISSUE 2): the full breakdown
+    # queued → admitted → prefill → decode → detokenize is served by id.
+    request_id = data["id"].removeprefix("chatcmpl-")
+    resp = await client.get(f"/v1/requests/{request_id}/timeline")
+    assert resp.status == 200, await resp.text()
+    tl = await resp.json()
+    assert tl["finished"] and tl["tokens"] == data["usage"]["completion_tokens"]
+    stages = [s["stage"] for s in tl["stages"]]
+    for expected in ("queued", "admitted", "prefill_chunk", "decode", "detokenize"):
+      assert expected in stages, (expected, stages)
+    assert tl["total_ms"] > 0
+
+    # The real serving path populated the latency histograms.
+    resp = await client.get("/metrics")
+    metrics_text = await resp.text()
+    assert 'xot_tpu_ttft_seconds_bucket{le="+Inf"}' in metrics_text
+    assert 'xot_tpu_itl_seconds_bucket{le="+Inf"}' in metrics_text
+    assert 'xot_tpu_decode_chunks_total{path="dense"}' in metrics_text
+
     # Same request again, streamed: greedy sampling must reproduce content.
     resp = await client.post("/v1/chat/completions", json={**body, "stream": True})
     assert resp.status == 200

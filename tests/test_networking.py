@@ -129,6 +129,18 @@ async def test_two_node_grpc_pipeline_generation():
     await asyncio.wait_for(done.wait(), timeout=30)
     assert collected[-1] == DUMMY_EOS
     assert collected == list(range(5, DUMMY_EOS + 1))
+
+    # Data-plane RPC telemetry: the ring traffic that just flowed is counted
+    # per method in the metrics registry (networking/grpc/grpc_server.py).
+    from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+    assert gm.counter_value("grpc_rpcs_total", labels={"method": "SendResult"}) >= 1
+
+    # Cluster-scope aggregation over the REAL gRPC opaque-status channel:
+    # each node answers the pull with its registry snapshot.
+    snaps = await nodes[0].collect_cluster_metrics(timeout=5.0)
+    assert len(snaps) == 1
+    assert "counters" in snaps[0] and "histograms" in snaps[0]
   finally:
     for node in nodes:
       await node.stop()

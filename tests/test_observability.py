@@ -1,6 +1,16 @@
-"""Tracing + metrics: live (unlike the reference's dead tracer, SURVEY §5.1)."""
+"""Tracing + metrics: live (unlike the reference's dead tracer, SURVEY §5.1).
+
+ISSUE 2 coverage: histogram bucket math and quantile edge cases, scheduler
+gauge/counter lifecycle under admit/evict/grow, decode-path attribution
+against the ``select_decode_path`` dispatch table, per-request stage
+timelines (+ the ``/v1/requests/{id}/timeline`` endpoint and slow-request
+log), the buffered-export / residual-token-group tracer fixes, cluster
+snapshot merging, and a metric-name snapshot so the ``/metrics`` exposition
+stays stable.
+"""
 
 import asyncio
+import json
 
 import pytest
 
@@ -80,3 +90,522 @@ async def test_node_generates_spans_and_metrics():
   names = [s["name"] for s in global_tracer.recent_spans(500)]
   assert "request.process_prompt" in names
   assert "token_group" in names
+
+
+# ------------------------------------------------------------- histograms
+
+
+def test_histogram_buckets_cumulative_exposition():
+  m = Metrics()
+  for v in (0.0005, 0.002, 0.02, 0.02, 0.3, 200.0):  # 200 s lands in +Inf
+    m.observe_hist("ttft_seconds", v)
+  text = m.render_prometheus()
+  assert "# TYPE xot_tpu_ttft_seconds histogram" in text
+  assert 'xot_tpu_ttft_seconds_bucket{le="0.001"} 1' in text  # cumulative
+  assert 'xot_tpu_ttft_seconds_bucket{le="0.0025"} 2' in text
+  assert 'xot_tpu_ttft_seconds_bucket{le="0.025"} 4' in text
+  assert 'xot_tpu_ttft_seconds_bucket{le="+Inf"} 6' in text
+  assert "xot_tpu_ttft_seconds_count 6" in text
+  assert abs(float(text.split("xot_tpu_ttft_seconds_sum ")[1].split("\n")[0]) - 200.3425) < 1e-6
+
+
+def test_histogram_quantile_edge_cases():
+  m = Metrics()
+  assert m.quantile("absent", 0.5) is None  # never created
+  m.observe_hist("h", 0.02)
+  # Single observation: every quantile lands inside its (0.01, 0.025] bucket.
+  for q in (0.0, 0.5, 1.0):
+    v = m.quantile("h", q)
+    assert 0.01 <= v <= 0.025, (q, v)
+  # +Inf landings clamp to the last finite edge (the histogram can't resolve
+  # beyond its ladder).
+  m2 = Metrics()
+  m2.observe_hist("h", 1e9)
+  assert m2.quantile("h", 0.99) == 60.0
+  # Out-of-range q clamps instead of raising.
+  assert m2.quantile("h", 7.0) == 60.0
+  assert m2.quantile("h", -1.0) == 60.0
+  # Interpolation: 100 uniform values in (0.01, 0.025] → median ≈ bucket mid.
+  m3 = Metrics()
+  for _ in range(100):
+    m3.observe_hist("h", 0.02)
+  v = m3.quantile("h", 0.5)
+  assert 0.01 < v <= 0.025
+
+
+def test_labeled_counters_and_gauges():
+  m = Metrics()
+  m.inc("decode_chunks_total", labels={"path": "kernel"})
+  m.inc("decode_chunks_total", 2, labels={"path": "gather"})
+  m.inc("decode_chunks_total", labels={"path": "kernel"})
+  m.set_gauge("pool", 1.5, labels={"node": "a"})
+  assert m.counter_value("decode_chunks_total", labels={"path": "kernel"}) == 2.0
+  text = m.render_prometheus()
+  assert 'xot_tpu_decode_chunks_total{path="gather"} 2.0' in text
+  assert 'xot_tpu_decode_chunks_total{path="kernel"} 2.0' in text
+  assert text.count("# TYPE xot_tpu_decode_chunks_total counter") == 1
+  assert 'xot_tpu_pool{node="a"} 1.5' in text
+
+
+def test_snapshot_merge_cluster_semantics():
+  a, b = Metrics(), Metrics()
+  a.inc("requests_total", 3)
+  b.inc("requests_total", 4)
+  a.set_gauge("scheduler_queue_depth", 2)
+  b.set_gauge("scheduler_queue_depth", 5)
+  a.set_gauge("page_pool_utilization", 0.9)
+  b.set_gauge("page_pool_utilization", 0.4)
+  a.inc("decode_chunks_total", labels={"path": "kernel"})
+  b.inc("decode_chunks_total", labels={"path": "kernel"})
+  for v in (0.01, 0.02):
+    a.observe_hist("itl_seconds", v)
+  b.observe_hist("itl_seconds", 0.04)
+  a.observe_latency("req", 1.0)
+  b.observe_latency("req", 3.0)
+  snaps = [a.snapshot(), b.snapshot()]
+  json.dumps(snaps)  # must be wire-safe (rides the opaque-status channel)
+  merged = Metrics.merged(snaps)
+  assert merged.counter_value("requests_total") == 7.0
+  assert merged.gauges["scheduler_queue_depth"] == 7.0  # additive across nodes
+  assert merged.gauges["page_pool_utilization"] == 0.9  # ratio gauges: max, not sum
+  assert merged.counter_value("decode_chunks_total", labels={"path": "kernel"}) == 2.0
+  assert merged.hist_count("itl_seconds") == 3
+  text = merged.render_prometheus()
+  assert "xot_tpu_req_seconds_count 2" in text
+  assert 'xot_tpu_itl_seconds_bucket{le="+Inf"} 3' in text
+
+
+# -------------------------------------------------- decode-path attribution
+
+
+def test_resolved_decode_path_matches_dispatch_table():
+  from xotorch_support_jetson_tpu.inference.paging import resolved_decode_path, select_decode_path
+
+  # Fixture points straight from the dispatch table (TPU platform).
+  assert select_decode_path(16, 4096, "", platform="tpu") == "gather"
+  assert select_decode_path(48, 4096, "", platform="tpu") == "dense"
+  assert select_decode_path(48, 4096, "int8", platform="tpu") == "kernel"
+  assert select_decode_path(8, 32768, "", platform="tpu") == "kernel"
+  # Attribution: non-paged layouts are "dense"; a paged program degrades a
+  # "dense" verdict to "kernel" (same rule as fused_paged_batch_decode);
+  # non-TPU platforms always take the gather reference path.
+  assert resolved_decode_path(16, 4096, "", paged=False, platform="tpu") == "dense"
+  assert resolved_decode_path(16, 4096, "", paged=True, platform="tpu") == "gather"
+  assert resolved_decode_path(48, 4096, "", paged=True, platform="tpu") == "kernel"
+  assert resolved_decode_path(48, 4096, "int8", paged=True, platform="tpu") == "kernel"
+  assert resolved_decode_path(48, 4096, "int8", paged=True, platform="cpu") == "gather"
+
+
+# ------------------------------------------------------ scheduler telemetry
+
+
+def _tiny_batched_server(n_slots=2, chunk=2):
+  import jax
+
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=128)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, cfg, params)
+  return BatchedServer(engine, n_slots=n_slots, chunk=chunk)
+
+
+def test_scheduler_gauges_counters_and_histograms(monkeypatch):
+  """Admit → decode → grow → release lifecycle populates the scheduler
+  telemetry: occupancy is live DURING the run, queue-wait/TTFT/ITL
+  histograms fill, page grow/release counters move, and the decode-path
+  chunk counter is attributed to the pool's resolved path."""
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "8")  # force page growth mid-decode
+  server = _tiny_batched_server(n_slots=2, chunk=2)
+  assert server.paged
+  before = {
+    "admit": gm.counter_value("scheduler_admissions_total"),
+    "grow": gm.counter_value("page_grow_events_total"),
+    "release": gm.counter_value("page_release_events_total"),
+    "chunks": gm.counter_value("decode_chunks_total", labels={"path": server.decode_path}),
+    "ttft": gm.hist_count("ttft_seconds"),
+    "qwait": gm.hist_count("queue_wait_seconds"),
+    "itl": gm.hist_count("itl_seconds"),
+    "chunk_t": gm.hist_count("decode_chunk_seconds"),
+  }
+  seen_occupancy = []
+
+  async def run():
+    def emit(rid, toks, finished):
+      seen_occupancy.append(gm.gauges.get("scheduler_batch_occupancy", 0))
+
+    await asyncio.gather(
+      *(
+        server.submit(f"g{i}", np.asarray([3, 25, 9 + i], np.int32), max_tokens=12, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+        for i in range(3)
+      )
+    )
+
+  asyncio.run(run())
+  assert gm.counter_value("scheduler_admissions_total") - before["admit"] == 3
+  assert gm.counter_value("page_grow_events_total") > before["grow"]  # 12 tokens cross 8-token pages
+  assert gm.counter_value("page_release_events_total") - before["release"] >= 3
+  assert gm.counter_value("decode_chunks_total", labels={"path": server.decode_path}) > before["chunks"]
+  assert gm.hist_count("ttft_seconds") - before["ttft"] == 3
+  assert gm.hist_count("queue_wait_seconds") - before["qwait"] == 3
+  assert gm.hist_count("itl_seconds") > before["itl"]
+  assert gm.hist_count("decode_chunk_seconds") > before["chunk_t"]
+  assert max(seen_occupancy) >= 1  # rows were visibly resident mid-run
+  # Idle again: gauges settle back to an empty pool.
+  assert gm.gauges["scheduler_batch_occupancy"] == 0
+  assert gm.gauges["scheduler_queue_depth"] == 0
+  assert gm.gauges["page_pool_utilization"] == 0.0
+  assert gm.gauges["page_pool_pages_total"] > 0
+  server.shutdown()
+
+
+def test_scheduler_rejection_counter(monkeypatch):
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.inference.engine import ServerOverloadedError
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+  server = _tiny_batched_server()
+  server.max_queue = 0
+  before = gm.counter_value("scheduler_rejections_total")
+
+  async def run():
+    with pytest.raises(ServerOverloadedError):
+      await server.submit("rej", np.asarray([1, 2], np.int32), max_tokens=2, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+
+  asyncio.run(run())
+  assert gm.counter_value("scheduler_rejections_total") == before + 1
+  server.shutdown()
+
+
+# --------------------------------------------------------- tracer fixes
+
+
+def test_end_request_flushes_residual_token_group():
+  t = Tracer()
+  t.request_context("r-res")
+  for _ in range(13):  # one full group of 10 + 3 residual
+    t.handle_token("r-res")
+  t.end_request("r-res")
+  groups = [s for s in t.recent_spans() if s["name"] == "token_group"]
+  assert [g["attributes"]["n_tokens"] for g in groups] == [10, 3]
+  assert groups[-1]["attributes"]["total_tokens"] == 13
+  # A request ending exactly on a group boundary must NOT emit an extra span.
+  t2 = Tracer()
+  t2.request_context("r-even")
+  for _ in range(20):
+    t2.handle_token("r-even")
+  t2.end_request("r-even")
+  groups = [s for s in t2.recent_spans() if s["name"] == "token_group"]
+  assert [g["attributes"]["n_tokens"] for g in groups] == [10, 10]
+
+
+def test_trace_file_export_buffered_outside_lock(tmp_path, monkeypatch):
+  """Spans still reach the JSONL file — but the hot path only queues them;
+  the file write happens after the tracer lock is released."""
+  path = tmp_path / "trace.jsonl"
+  monkeypatch.setenv("XOT_TPU_TRACE_FILE", str(path))
+  t = Tracer()  # reads the env at construction
+  t.request_context("r-exp")
+  with t.start_span("request.x", "r-exp"):
+    pass
+  for _ in range(12):
+    t.handle_token("r-exp")
+  t.end_request("r-exp")
+  lines = [json.loads(line) for line in path.read_text().splitlines()]
+  names = [entry["name"] for entry in lines]
+  assert "request.x" in names
+  assert names.count("token_group") == 2  # 10 + residual 2
+  assert not t._export_pending  # everything flushed
+
+
+# ----------------------------------------------------------- timelines
+
+
+def test_stage_timeline_shape_and_rollup():
+  t = Tracer()
+  t.request_context("r-tl")
+  t.stage("r-tl", "queued")
+  t.stage("r-tl", "admitted", {"row": 1})
+  t.stage("r-tl", "prefill_chunk", {"tokens": 2048})
+  t.stage("r-tl", "prefill_chunk", {"tokens": 512})
+  t.stage("r-tl", "decode")
+  for _ in range(5):
+    t.handle_token("r-tl")
+  t.end_request("r-tl")
+  t.stage("r-tl", "detokenize")  # API-side, lands after the finish
+  tl = t.timeline("r-tl")
+  assert tl["finished"] and tl["tokens"] == 5
+  assert [s["stage"] for s in tl["stages"]] == ["queued", "admitted", "prefill_chunk", "decode", "detokenize"]
+  chunks = next(s for s in tl["stages"] if s["stage"] == "prefill_chunk")
+  assert chunks["count"] == 2
+  assert tl["total_ms"] >= 0
+  assert [e["attributes"].get("tokens") for e in tl["events"] if e["stage"] == "prefill_chunk"] == [2048, 512]
+  assert all(e["at_ms"] >= 0 for e in tl["events"])
+  assert t.timeline("never-seen") is None
+
+
+def test_timeline_lru_bounded():
+  from xotorch_support_jetson_tpu.orchestration import tracing
+
+  t = Tracer()
+  for i in range(tracing.MAX_TIMELINES + 10):
+    t.stage(f"r{i}", "queued")
+  assert len(t.timelines) == tracing.MAX_TIMELINES
+  assert t.timeline("r0") is None  # oldest evicted
+  assert t.timeline(f"r{tracing.MAX_TIMELINES + 9}") is not None
+
+
+def test_slow_request_log(monkeypatch, capsys):
+  monkeypatch.setenv("XOT_TPU_SLOW_REQUEST_MS", "0.000001")
+  t = Tracer()
+  t.request_context("r-slow")
+  t.stage("r-slow", "queued")
+  t.stage("r-slow", "decode")
+  t.handle_token("r-slow")
+  t.end_request("r-slow")
+  out = capsys.readouterr().out
+  line = next(json.loads(entry) for entry in out.splitlines() if '"slow_request"' in entry)
+  assert line["event"] == "slow_request" and line["request_id"] == "r-slow"
+  assert [s["stage"] for s in line["stages"]] == ["queued", "decode"]
+  assert line["tokens"] == 1
+  # Below threshold: silent.
+  monkeypatch.setenv("XOT_TPU_SLOW_REQUEST_MS", "1e9")
+  t.request_context("r-fast")
+  t.stage("r-fast", "queued")
+  t.end_request("r-fast")
+  assert "slow_request" not in capsys.readouterr().out
+
+
+# ------------------------------------------------------- metric-name snapshot
+
+# The serving stack's exposition contract: every name the instrumentation
+# emits, frozen so dashboards/alerts don't silently break. Adding a metric
+# means adding it HERE (and to the README table); renaming one is a breaking
+# change and should be called out in CHANGES.md.
+EXPECTED_METRIC_NAMES = {
+  # counters
+  "xot_tpu_requests_total",
+  "xot_tpu_requests_replayed_total",
+  "xot_tpu_tokens_generated_total",
+  "xot_tpu_scheduler_submitted_total",
+  "xot_tpu_scheduler_admissions_total",
+  "xot_tpu_scheduler_rejections_total",
+  "xot_tpu_scheduler_parked_total",
+  "xot_tpu_scheduler_admission_failures_total",
+  "xot_tpu_scheduler_preemptions_total",
+  "xot_tpu_scheduler_page_starved_total",
+  "xot_tpu_decode_chunks_total",
+  "xot_tpu_decode_tokens_total",
+  "xot_tpu_prefill_chunks_total",
+  "xot_tpu_prefix_cache_hit_pages_total",
+  "xot_tpu_page_grow_events_total",
+  "xot_tpu_page_grow_pages_total",
+  "xot_tpu_page_release_events_total",
+  "xot_tpu_grpc_rpcs_total",
+  "xot_tpu_grpc_rpc_failures_total",
+  "xot_tpu_peer_broadcast_failures_total",
+  # gauges
+  "xot_tpu_scheduler_batch_occupancy",
+  "xot_tpu_scheduler_queue_depth",
+  "xot_tpu_scheduler_parked",
+  "xot_tpu_scheduler_prefilling",
+  "xot_tpu_scheduler_slots_total",
+  "xot_tpu_page_pool_pages_total",
+  "xot_tpu_page_pool_pages_free",
+  "xot_tpu_page_pool_pages_cached",
+  "xot_tpu_page_pool_utilization",
+  "xot_tpu_engine_sessions",
+  # histograms
+  "xot_tpu_ttft_seconds",
+  "xot_tpu_itl_seconds",
+  "xot_tpu_queue_wait_seconds",
+  "xot_tpu_prefill_chunk_seconds",
+  "xot_tpu_decode_chunk_seconds",
+  "xot_tpu_prefill_seconds",
+  "xot_tpu_decode_step_seconds",
+}
+
+
+def test_metric_name_snapshot_after_serving():
+  """Drive the batched scheduler once, then assert the exposition carries
+  every frozen metric name (and only well-formed xot_tpu_* families)."""
+  import re
+
+  import numpy as np
+
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+  server = _tiny_batched_server()
+
+  async def run():
+    await server.submit("snap", np.asarray([5, 6, 7], np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+
+  asyncio.run(run())
+  server.shutdown()
+  # Families emitted by paths this scheduler-only drive doesn't hit (node
+  # ring/replay, gRPC plane, rarely-taken scheduler branches): materialize
+  # them at zero so the pin covers the WHOLE documented exposition contract.
+  for name in (
+    "requests_total", "requests_replayed_total", "tokens_generated_total",
+    "scheduler_rejections_total", "scheduler_parked_total",
+    "scheduler_admission_failures_total", "scheduler_preemptions_total",
+    "scheduler_page_starved_total", "prefix_cache_hit_pages_total",
+  ):
+    gm.inc(name, 0)
+  gm.inc("grpc_rpcs_total", 0, labels={"method": "SendResult"})
+  gm.inc("grpc_rpc_failures_total", 0, labels={"method": "SendResult"})
+  gm.inc("peer_broadcast_failures_total", 0, labels={"kind": "result"})
+  gm.observe_hist("prefill_seconds", 0.0)
+  gm.observe_hist("decode_step_seconds", 0.0)
+  gm.set_gauge("engine_sessions", 0)
+  text = gm.render_prometheus()
+  families = set(re.findall(r"# TYPE (xot_tpu_[a-z0-9_]+) \w+", text))
+  missing = EXPECTED_METRIC_NAMES - families
+  assert not missing, f"exposition lost metric families: {sorted(missing)}"
+  assert all(re.fullmatch(r"xot_tpu_[a-z0-9_]+", f) for f in families)
+
+
+# ------------------------------------------------- cluster-wide aggregation
+
+
+@pytest.mark.asyncio
+async def test_cluster_metrics_pull_over_opaque_status():
+  """Two nodes bridged by in-process 'peers': the API node's pull broadcast
+  reaches the peer, the peer replies with its snapshot over the same opaque
+  channel, and the merged exposition carries both registries."""
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from xotorch_support_jetson_tpu.utils.metrics import Metrics, metrics as gm
+  from tests_support_stubs import NoDiscovery, StubServer
+
+  def make_node(name):
+    return Node(name, StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+
+  a, b = make_node("agg-a"), make_node("agg-b")
+
+  class BridgePeer:
+    def __init__(self, me, other):
+      self._me, self._other = me, other
+
+    def id(self):
+      return self._other.id
+
+    async def send_opaque_status(self, request_id, status):
+      self._other.on_opaque_status.trigger_all(request_id, status)
+      await asyncio.sleep(0)  # let the receiver's created tasks run
+
+  a.peers = [BridgePeer(a, b)]
+  b.peers = [BridgePeer(b, a)]
+
+  gm.inc("requests_total", 0)  # ensure the family exists locally
+  snaps = await a.collect_cluster_metrics(timeout=2.0)
+  assert len(snaps) == 1
+  merged = Metrics.merged([gm.snapshot(), *snaps])
+  text = merged.render_prometheus()
+  assert "xot_tpu_requests_total" in text
+
+  # No peers → instant empty pull (the API then renders local-only).
+  a.peers = []
+  assert await a.collect_cluster_metrics(timeout=0.1) == []
+
+
+# ------------------------------------------------------------ API endpoints
+
+
+async def _dummy_api():
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from aiohttp.test_utils import TestClient, TestServer
+  from tests_support_stubs import NoDiscovery, StubServer
+
+  node = Node(
+    "obs-api-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=16,
+  )
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+@pytest.mark.asyncio
+async def test_timeline_endpoint_and_metrics_scope():
+  node, api, client = await _dummy_api()
+  try:
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False},
+    )
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    request_id = data["id"].removeprefix("chatcmpl-")
+
+    resp = await client.get(f"/v1/requests/{request_id}/timeline")
+    assert resp.status == 200, await resp.text()
+    tl = await resp.json()
+    assert tl["request_id"] == request_id and tl["finished"]
+    stages = [s["stage"] for s in tl["stages"]]
+    for expected in ("queued", "admitted", "prefill_chunk", "decode", "detokenize"):
+      assert expected in stages, (expected, stages)
+    assert tl["total_ms"] > 0 and tl["tokens"] > 0
+    assert {"stage", "count", "first_at_ms", "duration_ms"} <= set(tl["stages"][0])
+
+    resp = await client.get("/v1/requests/not-a-request/timeline")
+    assert resp.status == 404
+
+    # /metrics local and cluster scopes both render; cluster adds the
+    # reporting-node gauge even with zero peers.
+    resp = await client.get("/metrics")
+    assert resp.status == 200
+    local_text = await resp.text()
+    assert "xot_tpu_requests_total" in local_text
+    resp = await client.get("/metrics?scope=cluster")
+    assert resp.status == 200
+    cluster_text = await resp.text()
+    assert "xot_tpu_cluster_nodes_reporting 1" in cluster_text
+    assert "xot_tpu_requests_total" in cluster_text
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_profile_endpoint(tmp_path, monkeypatch):
+  node, api, client = await _dummy_api()
+  try:
+    monkeypatch.setenv("XOT_TPU_PROFILE", "0")
+    resp = await client.post("/v1/profile", json={})
+    assert resp.status == 403
+    monkeypatch.delenv("XOT_TPU_PROFILE")
+
+    resp = await client.post("/v1/profile", json={"duration_ms": -5})
+    assert resp.status == 400
+
+    out_dir = str(tmp_path / "prof")
+    resp = await client.post("/v1/profile", json={"duration_ms": 50, "dir": out_dir})
+    # 200 when jax.profiler works here; 503 is the documented no-op when the
+    # backend can't trace — either way the endpoint must not 500.
+    assert resp.status in (200, 503), await resp.text()
+    if resp.status == 200:
+      data = await resp.json()
+      assert data["dir"] == out_dir
+      assert data["duration_ms"] >= 50
+      import os
+
+      assert os.path.isdir(out_dir)
+  finally:
+    await client.close()
+    await node.stop()

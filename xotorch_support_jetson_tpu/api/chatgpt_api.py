@@ -262,6 +262,9 @@ class ChatGPTAPI:
     r.add_get("/healthcheck", self.handle_healthcheck)
     r.add_get("/metrics", self.handle_metrics)
     r.add_get("/v1/traces", self.handle_traces)
+    r.add_get("/v1/requests/{request_id}/timeline", self.handle_request_timeline)
+    r.add_post("/v1/profile", self.handle_profile)
+    self._profiling = False  # one jax.profiler capture at a time
     r.add_get("/v1/topology", self.handle_get_topology)
     r.add_get("/topology", self.handle_get_topology)
     r.add_get("/v1/download/progress", self.handle_get_download_progress)
@@ -326,9 +329,118 @@ class ChatGPTAPI:
     return web.json_response({"status": "ok"})
 
   async def handle_metrics(self, request):
+    from ..utils.metrics import Metrics, metrics
+
+    if request.query.get("scope") == "cluster":
+      # Merge every peer's snapshot (pulled over the gRPC opaque-status
+      # channel) with the local registry: one exposition for the whole ring.
+      collect = getattr(self.node, "collect_cluster_metrics", None)
+      snapshots = [metrics.snapshot()]
+      n_peers = 0
+      if collect is not None:
+        try:
+          peer_snaps = await collect()
+          n_peers = len(peer_snaps)
+          snapshots.extend(peer_snaps)
+        except Exception:  # noqa: BLE001 — cluster scrape degrades to local
+          if DEBUG >= 1:
+            import traceback
+
+            traceback.print_exc()
+      merged = Metrics.merged(snapshots)
+      merged.set_gauge("cluster_nodes_reporting", 1 + n_peers)
+      return web.Response(text=merged.render_prometheus(), content_type="text/plain")
+    return web.Response(text=metrics.render_prometheus(), content_type="text/plain")
+
+  async def handle_request_timeline(self, request):
+    """GET /v1/requests/{id}/timeline — the request's stage breakdown
+    (queued → admitted → prefill chunks → decode → detokenize) from the
+    tracer's bounded timeline LRU. 404 once the entry has aged out."""
+    from ..orchestration.tracing import tracer
+
+    request_id = request.match_info.get("request_id", "")
+    tl = tracer.timeline(request_id)
+    if tl is None:
+      return web.json_response({"detail": f"no timeline for request {request_id}"}, status=404)
+    return web.json_response(tl)
+
+  async def handle_profile(self, request):
+    """POST /v1/profile — on-demand jax.profiler capture to a directory.
+
+    Body: {"duration_ms": float (default 1000, capped 60000)} or
+    {"steps": int} — a step capture runs until ``steps`` more decode chunks
+    complete (the engine-wide ``decode_chunks_total`` counters advance) or
+    the duration cap elapses. ``dir`` overrides the output directory
+    (default ``$XOT_TPU_PROFILE_DIR`` or XOT_HOME/profiles/<ts>). Guarded:
+    one capture at a time (409), and a clean 503 no-op when the profiler is
+    unavailable on this backend. Disable the endpoint entirely with
+    XOT_TPU_PROFILE=0.
+    """
+    import os as _os
+
     from ..utils.metrics import metrics
 
-    return web.Response(text=metrics.render_prometheus(), content_type="text/plain")
+    if _os.getenv("XOT_TPU_PROFILE", "1") in ("0", "false"):
+      return web.json_response({"detail": "profiling disabled (XOT_TPU_PROFILE=0)"}, status=403)
+    try:
+      data = await request.json()
+    except Exception:  # noqa: BLE001 — empty body is fine
+      data = {}
+    try:
+      steps = int(data.get("steps", 0))
+      # A step-bounded capture without an explicit duration gets the full
+      # 60 s deadline — the 1 s default would silently end a quiet node's
+      # capture with ~0 steps; duration_ms stays the hard cap either way.
+      default_ms = 60000.0 if steps > 0 else 1000.0
+      duration_ms = min(float(data.get("duration_ms", default_ms)), 60000.0)
+      if duration_ms <= 0 or steps < 0:
+        raise ValueError
+    except (TypeError, ValueError):
+      return web.json_response({"error": "'duration_ms' must be > 0 and 'steps' >= 0"}, status=400)
+    if self._profiling:
+      return web.json_response({"detail": "a profile capture is already running"}, status=409)
+    from ..utils.helpers import XOT_HOME
+
+    out_dir = str(data.get("dir") or _os.getenv("XOT_TPU_PROFILE_DIR") or (XOT_HOME / "profiles" / f"trace-{int(time.time())}"))
+    try:
+      import jax.profiler as jax_profiler
+
+      Path(out_dir).mkdir(parents=True, exist_ok=True)
+      jax_profiler.start_trace(out_dir)
+    except Exception as e:  # noqa: BLE001 — profiler unavailable: no-op, not a crash
+      return web.json_response({"detail": f"profiler unavailable: {e}"}, status=503)
+    self._profiling = True
+    t0 = time.perf_counter()
+    steps_seen = 0
+    try:
+      def chunk_total() -> float:
+        return sum(
+          metrics.counter_value("decode_chunks_total", labels={"path": p})
+          for p in ("dense", "gather", "kernel")
+        )
+
+      if steps > 0:
+        base = chunk_total()
+        deadline = t0 + duration_ms / 1e3
+        while time.perf_counter() < deadline:
+          steps_seen = int(chunk_total() - base)
+          if steps_seen >= steps:
+            break
+          await asyncio.sleep(0.02)
+      else:
+        await asyncio.sleep(duration_ms / 1e3)
+    finally:
+      self._profiling = False
+      try:
+        jax_profiler.stop_trace()
+      except Exception:  # noqa: BLE001
+        pass
+    return web.json_response({
+      "dir": out_dir,
+      "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
+      "steps_requested": steps,
+      "steps_captured": steps_seen,
+    })
 
   async def handle_traces(self, request):
     from ..orchestration.tracing import tracer
@@ -1046,6 +1158,8 @@ class ChatGPTAPI:
     # pre-first-token timeout) propagate to the handler and get their proper
     # 400/429/408 status instead of a 200 stream with an in-band error.
     tokens, is_finished = await self._next_tokens(request_id, gen_task)
+    from ..orchestration.tracing import tracer
+
     response = web.StreamResponse(
       status=200,
       reason="OK",
@@ -1090,6 +1204,11 @@ class ChatGPTAPI:
           await emit(make_finish_chunk(self._finish_reason(tokenizer, tokens[-1] if tokens else -1, True, False)))
           break
         tokens, is_finished = await self._next_tokens(request_id, gen_task)
+      # Detokenization was incremental (interleaved with decode); mark the
+      # stage at stream end so the timeline doesn't attribute decode time to
+      # it (the duration-to-next-event rollup would otherwise absorb the
+      # whole stream into "detokenize").
+      tracer.stage(request_id, "detokenize", {"streaming": True, "tokens": n_completion})
       if make_trailer_chunk is not None:
         trailer = make_trailer_chunk(n_completion)
         if trailer is not None:
@@ -1173,6 +1292,9 @@ class ChatGPTAPI:
         break
     # Generation already completed (the handler awaits process_prompt before
     # calling here), so stop strings are a single post-hoc scan + truncation.
+    from ..orchestration.tracing import tracer
+
+    tracer.stage(request_id, "detokenize", {"tokens": len(all_tokens)})
     content = tokenizer.decode([t for t in all_tokens if t not in eos_set])
     finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
     if chat_request.stop:

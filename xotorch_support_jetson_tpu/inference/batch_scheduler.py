@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
@@ -42,7 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..orchestration.tracing import tracer
 from ..utils.helpers import DEBUG
+from ..utils.metrics import metrics
 from .engine import PromptTooLongError, ServerOverloadedError
 
 PREFILL_BUCKET = 128
@@ -63,6 +66,7 @@ class _Request:
   emit: Callable[[str, list, bool], None]  # (request_id, new_tokens, finished)
   future: asyncio.Future = None
   page_demand: int = 0  # pages still needed at the last failed paged admission
+  t_submit: float = 0.0  # perf_counter at submit (queue-wait / TTFT histograms)
 
 
 @dataclass
@@ -140,6 +144,7 @@ class BatchedServer:
     self.allocator = None
     self.block_tables = None
     self.cache = None
+    self.decode_path = "dense"  # resolved per pool config in _ensure_cache
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self.queue: asyncio.Queue[_Request] = asyncio.Queue()
@@ -160,6 +165,7 @@ class BatchedServer:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
     ``emit(request_id, new_tokens, finished)`` as chunks complete."""
     if self.queue.qsize() + len(self._parked) >= self.max_queue:
+      metrics.inc("scheduler_rejections_total")
       raise ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
     req = _Request(
       request_id=request_id,
@@ -170,9 +176,13 @@ class BatchedServer:
       eos_ids=tuple(int(e) for e in eos_ids),
       emit=emit,
       future=asyncio.get_event_loop().create_future(),
+      t_submit=time.perf_counter(),
     )
     self._queued[request_id] = req
+    metrics.inc("scheduler_submitted_total")
+    tracer.stage(request_id, "queued", {"queue_depth": self.queue.qsize() + len(self._parked)})
     await self.queue.put(req)
+    self._update_gauges()
     if self._loop_task is None or self._loop_task.done():
       self._loop_task = asyncio.create_task(self._run())
     return await req.future
@@ -253,8 +263,33 @@ class BatchedServer:
       self.allocator = PageAllocator(n_pages, ps)
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
       self.cache = self.ops.init_pool(n_pages, ps)
+      metrics.set_gauge("page_pool_pages_total", n_pages - 1)  # page 0 = trash page
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
+    # Decode-path attribution label for this pool's compiled chunk program:
+    # fixed per (layout, slots, window, quant) — the same resolution
+    # fused_paged_batch_decode applies to use_kernel=None.
+    from .paging import resolved_decode_path
+
+    self.decode_path = resolved_decode_path(
+      self.n_slots, (self.pages_per_row * self.page_size) if self.paged else self.max_seq,
+      kv_quant, paged=self.paged, cfg=eng.cfg,
+    )
+    self._update_gauges()
+
+  def _update_gauges(self) -> None:
+    """Scheduler health gauges — refreshed at every loop boundary (cheap:
+    a handful of dict writes)."""
+    metrics.set_gauge("scheduler_batch_occupancy", sum(1 for s in self.slots if s is not None))
+    metrics.set_gauge("scheduler_queue_depth", self.queue.qsize() + len(self._parked))
+    metrics.set_gauge("scheduler_parked", len(self._parked))
+    metrics.set_gauge("scheduler_prefilling", len(self._prefilling))
+    metrics.set_gauge("scheduler_slots_total", self.n_slots)
+    if self.paged and self.allocator is not None:
+      total = max(self.allocator.n_pages - 1, 1)
+      metrics.set_gauge("page_pool_pages_free", self.allocator.n_free)
+      metrics.set_gauge("page_pool_pages_cached", self.allocator.n_available - self.allocator.n_free)
+      metrics.set_gauge("page_pool_utilization", round(1.0 - self.allocator.n_available / total, 6))
 
   def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
     # Mid-chunked-prefill rows are protected by ``taken``: _admit_pending
@@ -294,6 +329,7 @@ class BatchedServer:
       if not self.paged:
         # pad_to is computed per dispatch by _chunk_ready (the single source
         # of truth — chunking advances it as prefix_len grows).
+        self._note_admitted(req, row)
         return "ready", _Ready(req=req, row=row, pad_to=0)
 
       ps = self.page_size
@@ -314,8 +350,13 @@ class BatchedServer:
           # chunk boundary, keeping arrival order.
           req.page_demand = need
           self._queued[req.request_id] = req
+          metrics.inc("scheduler_parked_total")
+          tracer.stage(req.request_id, "parked", {"page_demand": need})
           return "park", None
         raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
+      if shared_pages:
+        metrics.inc("prefix_cache_hit_pages_total", len(shared_pages))
+      self._note_admitted(req, row, shared=len(shared_pages), fresh=need)
       return "ready", _Ready(
         req=req, row=row, pad_to=0, prefix_len=prefix_len, shared_pages=shared_pages,
         new_pages=list(new_pages), chain_keys=chain_keys,
@@ -325,8 +366,15 @@ class BatchedServer:
         self.allocator.release(p)
       if not req.future.done():
         req.future.set_exception(e)
+      metrics.inc("scheduler_admission_failures_total")
       self._cancelled_ids.discard(req.request_id)  # a raced cancel is moot now
       return "done", None
+
+  def _note_admitted(self, req: _Request, row: int, shared: int = 0, fresh: int = 0) -> None:
+    metrics.inc("scheduler_admissions_total")
+    if req.t_submit:
+      metrics.observe_hist("queue_wait_seconds", time.perf_counter() - req.t_submit)
+    tracer.stage(req.request_id, "admitted", {"row": row, "shared_pages": shared, "new_pages": fresh})
 
   async def _admit_pending(self, woken: _Request | None = None) -> None:
     """Collect every admissible request — parked (page-starved) first, in
@@ -528,6 +576,12 @@ class BatchedServer:
         last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
         return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
 
+    # Stage marks go down BEFORE the dispatch so the timeline's
+    # prefill_chunk duration covers the device work, not the gap after it.
+    for r in group:
+      end = r.chunk_end or int(r.req.tokens.shape[0])
+      tracer.stage(r.req.request_id, "prefill_chunk", {"tokens": end - r.prefix_len, "batched_with": K - 1})
+    t_dispatch = time.perf_counter()
     try:
       firsts = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     except Exception as e:  # noqa: BLE001
@@ -540,6 +594,8 @@ class BatchedServer:
     finally:
       for r in group:
         self._admitting.discard(r.req.request_id)
+    metrics.observe_hist("prefill_chunk_seconds", time.perf_counter() - t_dispatch)
+    metrics.inc("prefill_chunks_total")
     for i, r in enumerate(group):
       if r.chunk_end:  # intermediate chunk: advance and re-queue; no sample
         r.prefix_len = r.chunk_end
@@ -554,9 +610,12 @@ class BatchedServer:
       shared_pages=r.shared_pages, pages=list(r.new_pages), chain_keys=r.chain_keys,
     )
     slot.out_tokens.append(first)
+    if req.t_submit:
+      metrics.observe_hist("ttft_seconds", time.perf_counter() - req.t_submit)
     cancelled = req.request_id in self._cancelled_ids  # raced during prefill
     finished = cancelled or first in req.eos_ids or slot.generated >= req.max_tokens
     slot.finished = finished
+    tracer.stage(req.request_id, "decode", {"first_token": int(first)})
     req.emit(req.request_id, [] if cancelled else [first], finished)
     if finished:
       self._cancelled_ids.discard(req.request_id)
@@ -587,6 +646,8 @@ class BatchedServer:
         continue
       to_free.append(p)
     self.allocator.free(to_free)
+    if slot.shared_pages or slot.pages:
+      metrics.inc("page_release_events_total")
     slot.shared_pages, slot.pages = [], []
 
   def _clear_row(self, row: int) -> None:
@@ -603,6 +664,8 @@ class BatchedServer:
     got = self.allocator.alloc(needed - have)
     if got is None:
       return False
+    metrics.inc("page_grow_events_total")
+    metrics.inc("page_grow_pages_total", len(got))
     self.block_tables[row, have : have + len(got)] = got
     slot.pages.extend(got)
     return True
@@ -617,6 +680,7 @@ class BatchedServer:
         # between decode chunks (no await while any row is active — keep the
         # pool stepping).
         await self._admit_pending()
+        self._update_gauges()
         if all(s is None for s in self.slots):
           if self._prefilling:
             # A chunked prefill is mid-flight with no resident decoders:
@@ -656,6 +720,7 @@ class BatchedServer:
           elif self.paged and not self._grow_pages(i, s):
             active[i] = False
             starved.add(i)
+            metrics.inc("scheduler_page_starved_total")
         finishing = [i for i, s in enumerate(self.slots) if s is not None and not active[i] and i not in starved]
         if starved and not active.any() and not finishing:
           # Every resident row is starved (none can run, and no finishing
@@ -663,6 +728,8 @@ class BatchedServer:
           # youngest so the others make progress.
           victim = min(starved, key=lambda i: self.slots[i].generated)
           s = self.slots[victim]
+          metrics.inc("scheduler_preemptions_total")
+          tracer.stage(s.req.request_id, "preempted", {"generated": s.generated})
           self._release_pages(s)
           self.slots[victim] = None
           self.block_tables[victim, :] = 0
@@ -685,7 +752,15 @@ class BatchedServer:
             )
           return np.asarray(toks)  # ONE readback for the whole pool chunk
 
+        t_chunk = time.perf_counter()
         rows = await asyncio.get_event_loop().run_in_executor(eng.executor, run_chunk)
+        chunk_dt = time.perf_counter() - t_chunk
+        if active.any():
+          # Per-chunk decode-path attribution: the dispatch table's
+          # real-world mix, observable at /metrics instead of only in
+          # offline bench JSON.
+          metrics.observe_hist("decode_chunk_seconds", chunk_dt)
+          metrics.inc("decode_chunks_total", labels={"path": self.decode_path})
 
         for i, slot in enumerate(self.slots):
           if slot is None:
@@ -715,6 +790,13 @@ class BatchedServer:
           slot.out_tokens.extend(emit)
           slot.pos += len(emit)
           slot.last_token = emit[-1] if emit else slot.last_token
+          if emit:
+            metrics.inc("decode_tokens_total", len(emit), labels={"path": self.decode_path})
+            # Inter-token latency: the chunk's wall-clock amortized over its
+            # tokens, one observation per token (weighting stays per-token).
+            per_tok = chunk_dt / len(emit)
+            for _ in emit:
+              metrics.observe_hist("itl_seconds", per_tok)
           req.emit(req.request_id, emit, done)
           if done:
             self._cancelled_ids.discard(req.request_id)

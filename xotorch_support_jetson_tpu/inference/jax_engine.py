@@ -33,6 +33,7 @@ import numpy as np
 
 from ..models.decoder import init_kv_cache, shard_forward
 from ..utils.helpers import DEBUG
+from ..utils.metrics import metrics
 from .engine import InferenceEngine
 from .shard import Shard
 from .state import InferenceState
@@ -812,6 +813,9 @@ class JaxShardedInferenceEngine(InferenceEngine):
     )
 
   def _infer_tensor_sync(self, request_id, shard, input_data, state):
+    import time as _time
+
+    t0 = _time.perf_counter()
     shard = getattr(self, "_effective_shard", shard)
     state = state or InferenceState()
     # In-flight replay after a peer loss (orchestration/node.py
@@ -878,6 +882,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     state.curr_pos = session.curr_pos
     out_np = np.asarray(out)
+    # Engine-step telemetry: the host fetch above makes the timing honest
+    # (dispatch alone would measure queueing, not compute).
+    metrics.observe_hist("prefill_seconds" if prefilling else "decode_step_seconds", _time.perf_counter() - t0)
+    metrics.set_gauge("engine_sessions", len(self.sessions))
     return out_np, state
 
   async def generate_chunk(self, request_id: str, shard: Shard, last_token: int, n_steps: int, temp: float = 0.6, top_k: int = 35) -> list[int]:
@@ -1248,6 +1256,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
   def end_request(self, request_id: str) -> None:
     self.sessions.pop(request_id, None)
+    metrics.set_gauge("engine_sessions", len(self.sessions))
 
   # ---------------------------------------------------------------- training
   # (implemented in train/trainer.py and bound here so `xot-tpu train` works;

@@ -102,6 +102,30 @@ def select_decode_path(batch: int, context: int, kv_quant: str = "", platform: s
   return "gather"
 
 
+def resolved_decode_path(batch: int, context: int, kv_quant: str = "", paged: bool = True, cfg=None, platform: str | None = None) -> str:
+  """The decode path a dispatch will ACTUALLY run — the attribution label
+  for per-chunk telemetry (utils/metrics.py ``decode_chunks_total{path=}``).
+
+  Mirrors ``models/decoder.py fused_paged_batch_decode``'s resolution of
+  ``use_kernel=None``: a non-paged layout is simply "dense"; inside an
+  already-paged program a "dense" table verdict degrades to "kernel" (the
+  layout is fixed), and an unsupported-kernel cfg (softcap/window attention)
+  pins "gather". Keeping this next to the table means the counters report
+  the path the compiled program really took, not the table's raw advice.
+  """
+  if not paged:
+    return "dense"
+  path = select_decode_path(batch, context, kv_quant, platform=platform)
+  if path == "gather":
+    return "gather"
+  if cfg is not None:
+    from ..ops.paged import paged_kernel_supported
+
+    if not paged_kernel_supported(cfg):
+      return "gather"
+  return "kernel"
+
+
 class PageAllocator:
   """Free-list + refcounted prefix cache over a fixed page pool."""
 

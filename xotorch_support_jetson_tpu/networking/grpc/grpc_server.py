@@ -64,8 +64,23 @@ class GRPCServer:
       self.server = None
 
   def _make_handler(self):
+    from ...utils.metrics import metrics
+
     def unary(fn, req_cls, resp_cls):
-      return grpc.unary_unary_rpc_method_handler(fn, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString)
+      method = fn.__name__
+
+      async def counted(request, context):
+        # Cluster data-plane visibility: per-method RPC counts (and failures)
+        # feed the same registry /metrics serves — a ring's forwarding load
+        # is observable without packet captures.
+        metrics.inc("grpc_rpcs_total", labels={"method": method})
+        try:
+          return await fn(request, context)
+        except BaseException:
+          metrics.inc("grpc_rpc_failures_total", labels={"method": method})
+          raise
+
+      return grpc.unary_unary_rpc_method_handler(counted, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString)
 
     handlers = {
       "SendPrompt": unary(self.SendPrompt, pb.PromptRequest, pb.Tensor),

@@ -109,6 +109,13 @@ class Node:
     # ring re-plans automatically (parallel/hbm_planner.ring_partition_fits).
     self._ring_budget_cache: tuple | None = None
 
+    # Per-request submit time (TTFT histogram for the plain serving path; the
+    # batch scheduler measures its own from submit-to-first-emit).
+    self._request_t0: dict[str, float] = {}
+    self._ttft_observed: set[str] = set()
+    # Cluster metrics pulls in flight: nonce -> [event, snapshots, expected].
+    self._metrics_waiters: dict[str, list] = {}
+
     self._on_token: AsyncCallbackSystem[str, str, list, bool] = AsyncCallbackSystem()
     self._on_opaque_status: AsyncCallbackSystem[str, str, str] = AsyncCallbackSystem()
     self._on_opaque_status.register("node_status").on_next(self.on_node_status)
@@ -199,6 +206,8 @@ class Node:
     start_time = time.perf_counter_ns()
     ctx = tracer.request_context(request_id)
     metrics.inc("requests_total")
+    self._request_t0.setdefault(request_id, time.perf_counter())
+    tracer.stage(request_id, "queued", {"node_id": self.id})
     asyncio.create_task(
       self.broadcast_opaque_status(
         request_id,
@@ -332,6 +341,8 @@ class Node:
       # is weight-bandwidth-bound, so B in-flight requests cost ≈ 1.
       return await self._batched_serve(base_shard, shard, prompt, request_id)
     self.outstanding_requests[request_id] = "processing"
+    tracer.stage(request_id, "admitted", {"node_id": self.id})
+    tracer.stage(request_id, "prefill_chunk", {"node_id": self.id})
     output, state = await self.inference_engine.infer_prompt(request_id, shard, prompt, inference_state)
     await self.process_inference_result(base_shard, output, request_id, state, shard=shard)
     return output
@@ -343,6 +354,10 @@ class Node:
     max_tokens, temp, top_k = self._request_limits(request_id)
     eos_ids = self._eos_token_ids(base_shard)
     self.buffered_token_output[request_id] = ([], False)
+    # The scheduler measures TTFT from its own submit time (also the bench
+    # path with no node); pre-claim the choke-point observation so the same
+    # request isn't counted twice.
+    self._ttft_observed.add(request_id)
 
     def emit(rid: str, new_tokens: list, finished: bool) -> None:
       buffered, _ = self.buffered_token_output.get(rid, ([], False))
@@ -407,6 +422,12 @@ class Node:
       tokens.append(token_int)
       tracer.handle_token(request_id)
       metrics.inc("tokens_generated_total")
+      if len(tokens) == 1:
+        # TTFT itself is observed at the token choke point
+        # (trigger_on_token_callbacks) so it also fires on the ORIGIN node of
+        # a multi-node ring, where sampling happens on a peer and tokens
+        # arrive via broadcast; here we only mark the sampling node's stage.
+        tracer.stage(request_id, "decode", {"first_token": token_int})
 
       is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state, request_id)
       self.buffered_token_output[request_id] = (tokens, is_finished)
@@ -566,9 +587,17 @@ class Node:
       remaining = max_tokens - start
       if remaining > 0:
         # generate_oneshot already trims at the first EOS.
+        t_chunk = time.perf_counter()
         emit = await engine.generate_oneshot(request_id, shard, last_token, remaining, eos_ids, temp, top_k)
-        for _ in emit:
-          tracer.handle_token(request_id)
+        chunk_dt = time.perf_counter() - t_chunk
+        metrics.observe_hist("decode_chunk_seconds", chunk_dt)
+        metrics.inc("decode_chunks_total", labels={"path": "dense"})
+        if emit:
+          metrics.inc("decode_tokens_total", len(emit), labels={"path": "dense"})
+          per_tok = chunk_dt / len(emit)
+          for _ in emit:
+            tracer.handle_token(request_id)
+            metrics.observe_hist("itl_seconds", per_tok)
         metrics.inc("tokens_generated_total", len(emit))
         tokens.extend(emit)
       self.buffered_token_output[request_id] = (tokens, True)
@@ -595,7 +624,11 @@ class Node:
       nxt = None
       if remaining > chunk:
         nxt = await engine.dispatch_chunk(request_id, shard, min(chunk, remaining - chunk), temp, top_k)
+      t_chunk = time.perf_counter()
       new_tokens = (await engine.read_chunk(pending))[:remaining]
+      chunk_dt = time.perf_counter() - t_chunk
+      metrics.observe_hist("decode_chunk_seconds", chunk_dt)
+      metrics.inc("decode_chunks_total", labels={"path": "dense"})
 
       emit: list[int] = []
       hit_eos = False
@@ -606,6 +639,11 @@ class Node:
         if t in eos_ids:
           hit_eos = True
           break
+      if emit:
+        metrics.inc("decode_tokens_total", len(emit), labels={"path": "dense"})
+        per_tok = chunk_dt / max(len(new_tokens), 1)
+        for _ in emit:
+          metrics.observe_hist("itl_seconds", per_tok)
       start = off + len(tokens)
       tokens.extend(emit)
       done = hit_eos or off + len(tokens) >= max_tokens
@@ -662,6 +700,8 @@ class Node:
   def _finish_request(self, request_id: str) -> None:
     self.outstanding_requests.pop(request_id, None)
     self.request_options.pop(request_id, None)
+    self._request_t0.pop(request_id, None)
+    self._ttft_observed.discard(request_id)
     self.cancelled_requests.discard(request_id)
     self._replay_attempts.pop(request_id, None)
     self._replay_lifetime.pop(request_id, None)
@@ -914,6 +954,63 @@ class Node:
     self._ring_budget_cache = (fingerprint, problems)
     return problems
 
+  # ------------------------------------------------------- cluster metrics
+
+  async def collect_cluster_metrics(self, timeout: float = 2.0) -> list[dict]:
+    """Pull every peer's metrics snapshot over the existing gRPC
+    opaque-status channel (no new RPC): broadcast a ``metrics_pull`` with a
+    nonce; each peer replies by broadcasting a ``metrics_snapshot`` carrying
+    its ``utils/metrics.py snapshot()``. Returns the collected snapshots
+    (possibly fewer than the peer count when some time out) — the API merges
+    them with the local registry for ``/metrics?scope=cluster``."""
+    if not self.peers:
+      return []
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._metrics_waiters[nonce] = waiter
+    try:
+      await self.broadcast_opaque_status(
+        "", json.dumps({"type": "metrics_pull", "node_id": self.id, "nonce": nonce})
+      )
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # merge whatever arrived
+      return list(waiter[1])
+    finally:
+      self._metrics_waiters.pop(nonce, None)
+
+  def _handle_metrics_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "metrics_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      reply = json.dumps({
+        "type": "metrics_snapshot",
+        "node_id": self.id,
+        "nonce": status_data.get("nonce", ""),
+        "snapshot": metrics.snapshot(),
+      })
+      # Reply ONLY to the requester: broadcasting the full registry to every
+      # peer would make one cluster scrape O(N²) snapshot deliveries.
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        async def send():
+          try:
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — scrape replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] metrics snapshot reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "metrics_snapshot":
+      waiter = self._metrics_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None and status_data.get("node_id") != self.id:
+        waiter[1].append(status_data.get("snapshot") or {})
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
   # -------------------------------------------------------------- topology
 
   async def update_peers(self, wait_for_peers: int = 0) -> bool:
@@ -1052,6 +1149,9 @@ class Node:
         rid = status_data.get("request_id", "")
         if rid:
           self._cancel_locally(rid)
+      elif status_type in ("metrics_pull", "metrics_snapshot"):
+        # Cluster-wide /metrics aggregation rides the same opaque channel.
+        self._handle_metrics_status(status_data)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001
@@ -1090,6 +1190,15 @@ class Node:
       self._emitted_counts[request_id] = max(emitted, start_pos + len(tokens))
     elif tokens:
       self._emitted_counts[request_id] = self._emitted_counts.get(request_id, 0) + len(tokens)
+    if tokens and request_id not in self._ttft_observed:
+      # First client-visible token for a request THIS node originated (t0 is
+      # only set by process_prompt): works for local sampling, the batched
+      # scheduler (which pre-claims the observation), and ring deployments
+      # where the first token arrives over a SendResult broadcast.
+      t0 = self._request_t0.get(request_id)
+      if t0 is not None:
+        self._ttft_observed.add(request_id)
+        metrics.observe_hist("ttft_seconds", time.perf_counter() - t0)
     self._on_token.trigger_all(request_id, tokens, is_finished)
     if is_finished:
       # Keep the high-water mark as a tombstone so a straggling zombie
@@ -1125,6 +1234,10 @@ class Node:
     def clear() -> None:
       self._emitted_counts.pop(request_id, None)
       self._pending_chunks.pop(request_id, None)
+      # TTFT bookkeeping rides the same horizon: an origin node that only
+      # forwards never reaches _finish_request for this id.
+      self._request_t0.pop(request_id, None)
+      self._ttft_observed.discard(request_id)
     try:
       asyncio.get_running_loop().call_later(RESPONSE_TIMEOUT_HORIZON_S, clear)
     except RuntimeError:  # no loop (sync callers in tests): clear later is moot
@@ -1170,6 +1283,9 @@ class Node:
       try:
         await asyncio.wait_for(peer.send_result(request_id, result, is_finished, start_pos=start_pos), timeout=15.0)
       except Exception:  # noqa: BLE001
+        # A lost result broadcast is what the gap-flush machinery papers
+        # over — count it so stream stalls are attributable from /metrics.
+        metrics.inc("peer_broadcast_failures_total", labels={"kind": "result"})
         if DEBUG >= 1:
           print(f"[node {self.id}] result broadcast to {peer.id()} failed")
 
@@ -1180,6 +1296,7 @@ class Node:
       try:
         await asyncio.wait_for(peer.send_opaque_status(request_id, status), timeout=15.0)
       except Exception:  # noqa: BLE001
+        metrics.inc("peer_broadcast_failures_total", labels={"kind": "status"})
         if DEBUG >= 1:
           print(f"[node {self.id}] status broadcast to {peer.id()} failed")
 

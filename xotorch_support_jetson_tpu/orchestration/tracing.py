@@ -6,7 +6,17 @@ This one is wired in: ``Node.process_prompt`` opens a request span,
 per-token-group spans (every 10 tokens) record decode cadence, and the W3C
 ``traceparent`` rides the opaque-status JSON so multi-node rings stitch into
 one trace. Self-contained (no otel dependency); export is an in-memory ring
-buffer + optional JSONL file (``XOT_TPU_TRACE_FILE``).
+buffer + optional JSONL file (``XOT_TPU_TRACE_FILE``) — file appends are
+BUFFERED under the lock and flushed outside it, so the token hot path never
+blocks on disk.
+
+Per-request STAGE TIMELINES (ISSUE 2): producers mark lifecycle stages
+(queued → admitted → prefill_chunk… → decode → detokenize) via ``stage()``;
+``timeline()`` serves the per-stage breakdown (the API's
+``/v1/requests/{id}/timeline``). Finished timelines outlive the request in a
+bounded LRU so a client can fetch the breakdown after the response.
+``XOT_TPU_SLOW_REQUEST_MS`` > 0 logs a structured JSON line with the stage
+attribution for any request slower than the threshold.
 """
 
 from __future__ import annotations
@@ -16,9 +26,11 @@ import os
 import secrets
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+MAX_TIMELINES = 256
 
 
 @dataclass
@@ -88,8 +100,11 @@ class Tracer:
   def __init__(self, max_spans: int = 4096) -> None:
     self.spans: deque[Span] = deque(maxlen=max_spans)
     self.contexts: dict[str, TraceContext] = {}
+    self.timelines: OrderedDict[str, dict] = OrderedDict()
     self._lock = threading.Lock()
     self._export_path = os.getenv("XOT_TPU_TRACE_FILE")
+    self._export_pending: list[dict] = []
+    self._export_lock = threading.Lock()  # serializes file writes only
 
   # -------------------------------------------------------------- contexts
 
@@ -106,8 +121,126 @@ class Tracer:
       return ctx
 
   def end_request(self, request_id: str) -> None:
+    """Close out a request: emit the trailing PARTIAL token group (tokens
+    past the last multiple of ``group_size`` were previously dropped),
+    finalize the stage timeline, and log the slow-request line if the
+    request overran ``XOT_TPU_SLOW_REQUEST_MS``."""
+    now = time.perf_counter_ns()
+    slow_line = None
     with self._lock:
-      self.contexts.pop(request_id, None)
+      ctx = self.contexts.pop(request_id, None)
+      if ctx is not None:
+        residual = ctx.token_count % ctx.group_size
+        if residual and ctx._group_start_ns is not None:
+          self._record_locked(Span(
+            trace_id=ctx.trace_id,
+            span_id=new_span_id(),
+            parent_id=ctx.request_span_id,
+            name="token_group",
+            start_ns=ctx._group_start_ns,
+            end_ns=now,
+            attributes={"n_tokens": residual, "total_tokens": ctx.token_count},
+          ))
+      tl = self.timelines.get(request_id)
+      if tl is not None and not tl.get("finished"):
+        tl["end_ns"] = now
+        tl["finished"] = True
+        if ctx is not None:
+          tl["tokens"] = ctx.token_count
+        threshold_ms = float(os.getenv("XOT_TPU_SLOW_REQUEST_MS", "0") or 0)
+        total_ms = (now - tl["start_ns"]) / 1e6
+        if threshold_ms > 0 and total_ms > threshold_ms:
+          slow_line = json.dumps({
+            "event": "slow_request",
+            "request_id": request_id,
+            "trace_id": tl.get("trace_id"),
+            "total_ms": round(total_ms, 3),
+            "threshold_ms": threshold_ms,
+            "tokens": tl.get("tokens", 0),
+            "stages": self._stage_summary_locked(tl, now),
+          })
+    self._flush_export()
+    if slow_line is not None:
+      print(slow_line)
+
+  # -------------------------------------------------------- stage timelines
+
+  def stage(self, request_id: str, stage: str, attributes: dict | None = None) -> None:
+    """Mark a request-lifecycle stage (queued/admitted/prefill_chunk/decode/
+    detokenize/…). Cheap: one dict append under the lock; repeated stages
+    (each prefill chunk) append their own events. Events after the request
+    finished (e.g. the API's detokenize following a blocking generation) are
+    still recorded — the timeline is an LRU entry, not live request state."""
+    now = time.perf_counter_ns()
+    with self._lock:
+      tl = self.timelines.get(request_id)
+      if tl is None:
+        ctx = self.contexts.get(request_id)
+        tl = self.timelines[request_id] = {
+          "request_id": request_id,
+          "trace_id": ctx.trace_id if ctx else None,
+          "start_ns": now,
+          "end_ns": None,
+          "finished": False,
+          "tokens": 0,
+          "events": [],
+        }
+        while len(self.timelines) > MAX_TIMELINES:
+          self.timelines.popitem(last=False)
+      elif tl.get("trace_id") is None:
+        ctx = self.contexts.get(request_id)
+        if ctx:
+          tl["trace_id"] = ctx.trace_id
+      tl["events"].append({"stage": stage, "t_ns": now, "attributes": dict(attributes or {})})
+      self.timelines.move_to_end(request_id)
+
+  def _stage_summary_locked(self, tl: dict, now_ns: int) -> list[dict]:
+    """Per-stage rollup: each event's duration runs to the next event (or
+    the timeline end); same-named events (chunked prefill) aggregate."""
+    events = tl["events"]
+    end_ns = tl["end_ns"] or now_ns
+    order: list[str] = []
+    agg: dict[str, dict] = {}
+    for i, ev in enumerate(events):
+      nxt = events[i + 1]["t_ns"] if i + 1 < len(events) else end_ns
+      entry = agg.get(ev["stage"])
+      if entry is None:
+        order.append(ev["stage"])
+        entry = agg[ev["stage"]] = {
+          "stage": ev["stage"],
+          "count": 0,
+          "first_at_ms": round((ev["t_ns"] - tl["start_ns"]) / 1e6, 3),
+          "duration_ms": 0.0,
+        }
+      entry["count"] += 1
+      entry["duration_ms"] = round(entry["duration_ms"] + max(nxt - ev["t_ns"], 0) / 1e6, 3)
+    return [agg[name] for name in order]
+
+  def timeline(self, request_id: str) -> dict | None:
+    """The request's stage breakdown, or None if unknown (expired/never
+    seen). Safe to call mid-flight: durations run to "now" until finished."""
+    now = time.perf_counter_ns()
+    with self._lock:
+      tl = self.timelines.get(request_id)
+      if tl is None:
+        return None
+      end_ns = tl["end_ns"] or now
+      return {
+        "request_id": request_id,
+        "trace_id": tl.get("trace_id"),
+        "finished": bool(tl.get("finished")),
+        "tokens": tl.get("tokens", 0),
+        "total_ms": round((end_ns - tl["start_ns"]) / 1e6, 3),
+        "stages": self._stage_summary_locked(tl, now),
+        "events": [
+          {
+            "stage": ev["stage"],
+            "at_ms": round((ev["t_ns"] - tl["start_ns"]) / 1e6, 3),
+            "attributes": ev["attributes"],
+          }
+          for ev in tl["events"]
+        ],
+      }
 
   # ----------------------------------------------------------------- spans
 
@@ -152,17 +285,36 @@ class Tracer:
         )
         ctx._group_start_ns = now
         self._record_locked(span)
+    self._flush_export()
 
   def _record(self, span: Span) -> None:
     with self._lock:
       self._record_locked(span)
+    self._flush_export()
 
   def _record_locked(self, span: Span) -> None:
+    # No I/O here: the caller may be on the token hot path with the lock
+    # held. File export is queued and flushed outside the lock.
     self.spans.append(span)
     if self._export_path:
+      self._export_pending.append(span.to_dict())
+
+  def _flush_export(self) -> None:
+    """Drain the queued span dicts to the JSONL file OUTSIDE the tracer
+    lock. A separate flush lock serializes the file writes themselves —
+    buffered writers flush at buffer boundaries, not line boundaries, so two
+    concurrent appenders could otherwise tear a line — while recorders keep
+    making progress under the main lock."""
+    if not self._export_path:
+      return
+    with self._export_lock:
+      with self._lock:
+        if not self._export_pending:
+          return
+        pending, self._export_pending = self._export_pending, []
       try:
         with open(self._export_path, "a") as f:
-          f.write(json.dumps(span.to_dict()) + "\n")
+          f.writelines(json.dumps(d) + "\n" for d in pending)
       except OSError:
         pass
 

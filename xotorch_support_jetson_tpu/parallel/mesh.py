@@ -73,6 +73,24 @@ def shard_map_compat(f, *, mesh, in_specs=None, out_specs=None, axis_names=froze
   return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma, auto=auto)
 
 
+def partial_manual_supported(plan: MeshPlan, manual: tuple[str, ...] = ("pp",)) -> bool:
+  """Capability probe: can this jax build run the partial-manual shard_map
+  programs ``plan`` needs (manual over ``manual`` axes, the rest GSPMD-auto)?
+
+  Newer jax (top-level ``jax.shard_map``) always can. jax 0.4.x only has
+  ``jax.experimental.shard_map``, whose partial-auto lowering routes the
+  manual region's collectives through PartitionId — XLA's SPMD partitioner
+  rejects that whenever any auto axis is >1 device (``shard_map_compat``
+  raises NotImplementedError at build time). That is exactly the pp×tp and
+  sp×tp serving meshes; tests use this probe to SKIP those parametrizations
+  on old builds with an explicit reason instead of erroring mid-compile.
+  """
+  if hasattr(jax, "shard_map"):
+    return True
+  manual_set = frozenset(manual)
+  return all(getattr(plan, a) == 1 for a in AXES if a not in manual_set)
+
+
 def build_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
   devices = devices if devices is not None else jax.devices()
   if len(devices) < plan.n_devices:

@@ -50,6 +50,23 @@ def apply_platform_override() -> None:
 
     jax.config.update("jax_platforms", platform)
 
+def multihost_cpu_collectives_supported() -> bool:
+  """Capability probe: can THIS jax build run cross-process collectives on
+  the CPU backend (what the multihost smoke's gradient all-reduce needs)?
+
+  Real accelerator backends do collectives natively. On CPU, cross-process
+  psum only works when jax routes CPU collectives through gloo — jax 0.4.x
+  has no ``jax_cpu_collectives_implementation`` config and its multiprocess
+  CPU psum fails with "Multiprocess computations aren't implemented on the
+  CPU backend". Tests skip (with this reason) instead of erroring there.
+  """
+  import jax
+
+  if jax.default_backend() != "cpu":
+    return True
+  return hasattr(jax.config, "jax_cpu_collectives_implementation")
+
+
 XOT_HOME = Path(os.getenv("XOT_TPU_HOME", Path.home() / ".cache" / "xot_tpu"))
 
 T = TypeVar("T")

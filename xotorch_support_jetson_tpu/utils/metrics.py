@@ -1,8 +1,20 @@
-"""Minimal metrics registry with Prometheus text exposition.
+"""Metrics registry with Prometheus text exposition — counters, gauges,
+latency summaries, and bucketed histograms.
 
 The reference pins prometheus-client and never imports it (SURVEY.md §5.5);
-here a dependency-free registry backs the API's ``/metrics`` endpoint:
-request counts, token throughput, per-request latency summaries.
+here a dependency-free registry backs the API's ``/metrics`` endpoint. The
+observability layer (ISSUE 2) records request latencies through HISTOGRAMS
+(``le``-bucket exposition + a ``quantile()`` helper) so p50/p95/p99 are
+answerable online, not just means: TTFT, inter-token latency, queue wait,
+prefill/decode chunk step time. Counters and gauges accept optional LABELS
+(one level, e.g. ``{"path": "kernel"}`` for decode-path attribution).
+
+Cluster scope: ``snapshot()`` serializes the whole registry to a JSON-safe
+dict; ``merge_snapshot()`` adds another node's snapshot into a (fresh)
+registry, so the API node can merge peer snapshots pulled over the gRPC
+opaque-status channel and render ``/metrics?scope=cluster`` (counters,
+histogram buckets, and summaries sum; gauges sum too — cluster occupancy /
+queue depth are additive quantities).
 """
 
 from __future__ import annotations
@@ -11,27 +23,124 @@ import threading
 import time
 from collections import defaultdict
 
+# Latency ladder in SECONDS: 1 ms .. 60 s (+Inf implicit). Dense at the low
+# end where decode cadence lives (an inter-token gap is ~5-50 ms), sparse at
+# the top where only stragglers land.
+DEFAULT_BUCKETS = (
+  0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _label_key(labels: dict | None) -> tuple:
+  return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+def _label_str(key: tuple) -> str:
+  if not key:
+    return ""
+  return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Histogram:
+  __slots__ = ("buckets", "counts", "sum", "count")
+
+  def __init__(self, buckets: tuple = DEFAULT_BUCKETS) -> None:
+    self.buckets = tuple(float(b) for b in buckets)
+    self.counts = [0] * (len(self.buckets) + 1)  # last slot = +Inf
+    self.sum = 0.0
+    self.count = 0
+
+  def observe(self, value: float) -> None:
+    value = float(value)
+    i = 0
+    for i, edge in enumerate(self.buckets):  # noqa: B007 — 16 edges; bisect buys nothing
+      if value <= edge:
+        break
+    else:
+      i = len(self.buckets)
+    self.counts[i] += 1
+    self.sum += value
+    self.count += 1
+
+  def quantile(self, q: float) -> float | None:
+    """Approximate quantile by linear interpolation inside the landing
+    bucket (the standard Prometheus ``histogram_quantile`` estimate).
+    Returns None when empty; values in the +Inf bucket clamp to the last
+    finite edge (the histogram cannot resolve beyond it)."""
+    if self.count == 0:
+      return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * self.count
+    cum = 0.0
+    for i, n in enumerate(self.counts):
+      prev_cum = cum
+      cum += n
+      if cum >= rank and n > 0:
+        if i >= len(self.buckets):
+          return self.buckets[-1]
+        lo = 0.0 if i == 0 else self.buckets[i - 1]
+        hi = self.buckets[i]
+        frac = (rank - prev_cum) / n
+        return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return self.buckets[-1]
+
 
 class Metrics:
   def __init__(self) -> None:
     self._lock = threading.Lock()
     self.counters: dict[str, float] = defaultdict(float)
     self.gauges: dict[str, float] = {}
+    # Labeled variants: name -> {label-key-tuple -> value}.
+    self._labeled_counters: dict[str, dict[tuple, float]] = defaultdict(lambda: defaultdict(float))
+    self._labeled_gauges: dict[str, dict[tuple, float]] = defaultdict(dict)
     self._latency_sum: dict[str, float] = defaultdict(float)
     self._latency_count: dict[str, int] = defaultdict(int)
+    self._hists: dict[str, _Histogram] = {}
 
-  def inc(self, name: str, value: float = 1.0) -> None:
+  def inc(self, name: str, value: float = 1.0, labels: dict | None = None) -> None:
     with self._lock:
-      self.counters[name] += value
+      if labels:
+        self._labeled_counters[name][_label_key(labels)] += value
+      else:
+        self.counters[name] += value
 
-  def set_gauge(self, name: str, value: float) -> None:
+  def set_gauge(self, name: str, value: float, labels: dict | None = None) -> None:
     with self._lock:
-      self.gauges[name] = value
+      if labels:
+        self._labeled_gauges[name][_label_key(labels)] = value
+      else:
+        self.gauges[name] = value
 
   def observe_latency(self, name: str, seconds: float) -> None:
     with self._lock:
       self._latency_sum[name] += seconds
       self._latency_count[name] += 1
+
+  def observe_hist(self, name: str, value: float, buckets: tuple = DEFAULT_BUCKETS) -> None:
+    """Record ``value`` into the named histogram (created on first use; the
+    bucket ladder is fixed at creation)."""
+    with self._lock:
+      hist = self._hists.get(name)
+      if hist is None:
+        hist = self._hists[name] = _Histogram(buckets)
+      hist.observe(value)
+
+  def quantile(self, name: str, q: float) -> float | None:
+    """Estimated q-quantile (0..1) of a histogram; None if absent/empty."""
+    with self._lock:
+      hist = self._hists.get(name)
+      return hist.quantile(q) if hist is not None else None
+
+  def hist_count(self, name: str) -> int:
+    with self._lock:
+      hist = self._hists.get(name)
+      return hist.count if hist is not None else 0
+
+  def counter_value(self, name: str, labels: dict | None = None) -> float:
+    with self._lock:
+      if labels:
+        return self._labeled_counters.get(name, {}).get(_label_key(labels), 0.0)
+      return self.counters.get(name, 0.0)
 
   def timer(self, name: str):
     metrics = self
@@ -47,20 +156,129 @@ class Metrics:
 
     return _Timer()
 
+  def hist_timer(self, name: str):
+    metrics = self
+
+    class _Timer:
+      def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+      def __exit__(self, *exc):
+        metrics.observe_hist(name, time.perf_counter() - self.t0)
+        return False
+
+    return _Timer()
+
+  # ---------------------------------------------------------------- render
+
   def render_prometheus(self) -> str:
     lines: list[str] = []
     with self._lock:
-      for name, value in sorted(self.counters.items()):
+      names = sorted(set(self.counters) | set(self._labeled_counters))
+      for name in names:
         lines.append(f"# TYPE xot_tpu_{name} counter")
-        lines.append(f"xot_tpu_{name} {value}")
-      for name, value in sorted(self.gauges.items()):
+        if name in self.counters:
+          lines.append(f"xot_tpu_{name} {self.counters[name]}")
+        for key, value in sorted(self._labeled_counters.get(name, {}).items()):
+          lines.append(f"xot_tpu_{name}{_label_str(key)} {value}")
+      names = sorted(set(self.gauges) | set(self._labeled_gauges))
+      for name in names:
         lines.append(f"# TYPE xot_tpu_{name} gauge")
-        lines.append(f"xot_tpu_{name} {value}")
+        if name in self.gauges:
+          lines.append(f"xot_tpu_{name} {self.gauges[name]}")
+        for key, value in sorted(self._labeled_gauges.get(name, {}).items()):
+          lines.append(f"xot_tpu_{name}{_label_str(key)} {value}")
       for name in sorted(self._latency_sum):
         lines.append(f"# TYPE xot_tpu_{name}_seconds summary")
         lines.append(f"xot_tpu_{name}_seconds_sum {self._latency_sum[name]}")
         lines.append(f"xot_tpu_{name}_seconds_count {self._latency_count[name]}")
+      for name in sorted(self._hists):
+        hist = self._hists[name]
+        lines.append(f"# TYPE xot_tpu_{name} histogram")
+        cum = 0
+        for edge, n in zip(hist.buckets, hist.counts):
+          cum += n
+          lines.append(f'xot_tpu_{name}_bucket{{le="{edge}"}} {cum}')
+        lines.append(f'xot_tpu_{name}_bucket{{le="+Inf"}} {hist.count}')
+        lines.append(f"xot_tpu_{name}_sum {hist.sum}")
+        lines.append(f"xot_tpu_{name}_count {hist.count}")
     return "\n".join(lines) + "\n"
+
+  # ------------------------------------------------------- cluster merging
+
+  def snapshot(self) -> dict:
+    """JSON-safe dump of the whole registry (the wire format peers ship over
+    the opaque-status channel for ``/metrics?scope=cluster``)."""
+    with self._lock:
+      return {
+        "counters": dict(self.counters),
+        "labeled_counters": {
+          name: [[list(map(list, key)), value] for key, value in series.items()]
+          for name, series in self._labeled_counters.items()
+        },
+        "gauges": dict(self.gauges),
+        "labeled_gauges": {
+          name: [[list(map(list, key)), value] for key, value in series.items()]
+          for name, series in self._labeled_gauges.items()
+        },
+        "summaries": {name: [self._latency_sum[name], self._latency_count[name]] for name in self._latency_sum},
+        "histograms": {
+          name: {"buckets": list(h.buckets), "counts": list(h.counts), "sum": h.sum}
+          for name, h in self._hists.items()
+        },
+      }
+
+  @staticmethod
+  def _merge_gauge(name: str, old: float | None, new: float) -> float:
+    # Ratio gauges (0..1, name suffix "_utilization") are NOT additive across
+    # nodes — summing two 0.9s would render 180% utilization. Merge them by
+    # MAX (the worst pool is the cluster-actionable number); everything else
+    # (occupancy, queue depth, page counts, sessions) sums.
+    if old is None:
+      return new
+    return max(old, new) if name.endswith("_utilization") else old + new
+
+  def merge_snapshot(self, snap: dict) -> None:
+    """Add another registry's ``snapshot()`` into this one. Counters,
+    summaries, and histogram buckets sum; gauges sum except ``*_utilization``
+    ratios, which merge by max; histograms with a DIFFERENT bucket ladder
+    merge sum/count only (their bucket shape is unknowable here)."""
+    with self._lock:
+      for name, value in (snap.get("counters") or {}).items():
+        self.counters[name] += float(value)
+      for name, series in (snap.get("labeled_counters") or {}).items():
+        for key, value in series:
+          self._labeled_counters[name][tuple(tuple(kv) for kv in key)] += float(value)
+      for name, value in (snap.get("gauges") or {}).items():
+        self.gauges[name] = self._merge_gauge(name, self.gauges.get(name), float(value))
+      for name, series in (snap.get("labeled_gauges") or {}).items():
+        for key, value in series:
+          k = tuple(tuple(kv) for kv in key)
+          self._labeled_gauges[name][k] = self._merge_gauge(name, self._labeled_gauges[name].get(k), float(value))
+      for name, (s, c) in (snap.get("summaries") or {}).items():
+        self._latency_sum[name] += float(s)
+        self._latency_count[name] += int(c)
+      for name, h in (snap.get("histograms") or {}).items():
+        buckets = tuple(float(b) for b in h.get("buckets", DEFAULT_BUCKETS))
+        hist = self._hists.get(name)
+        if hist is None:
+          hist = self._hists[name] = _Histogram(buckets)
+        counts = [int(c) for c in h.get("counts", [])]
+        if hist.buckets == buckets and len(counts) == len(hist.counts):
+          for i, c in enumerate(counts):
+            hist.counts[i] += c
+        else:  # incompatible ladder: fold everything into +Inf (sum/count stay exact)
+          hist.counts[-1] += sum(counts)
+        hist.sum += float(h.get("sum", 0.0))
+        hist.count += sum(counts)
+
+  @classmethod
+  def merged(cls, snapshots: list[dict]) -> "Metrics":
+    out = cls()
+    for snap in snapshots:
+      out.merge_snapshot(snap)
+    return out
 
 
 metrics = Metrics()
