@@ -266,7 +266,7 @@ def test_per_request_top_k_is_honored_per_row():
 
     for row in range(B):
       _, cache = prefill_into_slot(params, cfg, shard, jnp.ones((1, prompt_len), jnp.int32), cache, jnp.int32(row), jnp.int32(prompt_len))
-    toks, _, _ = fused_batch_decode(
+    toks, _, _, _ = fused_batch_decode(
       params, cfg, shard,
       jnp.full((B, 1), 7, jnp.int32), cache, jnp.full((B,), prompt_len, jnp.int32),
       jnp.ones((B,), bool), jnp.asarray(temps, jnp.float32), n_steps,

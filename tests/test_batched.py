@@ -62,7 +62,7 @@ def test_batched_rows_match_single_requests():
   positions = np.array([len(p) for p in prompts] + [0], np.int32)
   active = np.array([True, True, True, False])
   temps = np.zeros((n_slots,), np.float32)
-  toks, new_pos, cache = fused_batch_decode(
+  toks, _, new_pos, cache = fused_batch_decode(
     params, CFG, shard, jnp.asarray(tokens), cache, jnp.asarray(positions), jnp.asarray(active), jnp.asarray(temps), n_steps
   )
   toks = np.asarray(toks)
@@ -91,7 +91,7 @@ def test_batched_chunks_resume_correctly():
   active = jnp.asarray([False, True])
   temps = jnp.zeros((2,), jnp.float32)
   for _ in range(2):
-    toks, _, cache = fused_batch_decode(
+    toks, _, _, cache = fused_batch_decode(
       params, CFG, shard, jnp.asarray([[0], [tok]], jnp.int32), cache, jnp.asarray([0, pos], jnp.int32), active, temps, 3
     )
     row = [int(t) for t in np.asarray(toks)[1]]
@@ -268,7 +268,7 @@ def test_batched_decode_with_int8_params():
   pad[0, :S] = prompt
   last, pool = prefill_into_slot(qp, CFG, shard, jnp.asarray(pad), pool, jnp.int32(0), jnp.int32(S))
   got = [int(np.argmax(np.asarray(last)[0]))]
-  toks, _, pool = fused_batch_decode(
+  toks, _, _, pool = fused_batch_decode(
     qp, CFG, shard, jnp.asarray([[got[0]], [0]], jnp.int32), pool,
     jnp.asarray([S, 0], jnp.int32), jnp.asarray([True, False]), jnp.zeros((2,), jnp.float32), 5,
   )
@@ -293,7 +293,7 @@ def test_batched_decode_with_moe_model():
   pad[0, :S] = prompt
   last, pool = prefill_into_slot(params, moe_cfg, shard, jnp.asarray(pad), pool, jnp.int32(1), jnp.int32(S))
   got = [int(np.argmax(np.asarray(last)[0]))]
-  toks, _, pool = fused_batch_decode(
+  toks, _, _, pool = fused_batch_decode(
     params, moe_cfg, shard, jnp.asarray([[0], [got[0]], [0]], jnp.int32), pool,
     jnp.asarray([0, S, 0], jnp.int32), jnp.asarray([False, True, False]), jnp.zeros((3,), jnp.float32), 4,
   )

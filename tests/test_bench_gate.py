@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_headline, plausible_value
+from bench import gate_headline, gate_lookahead, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -65,6 +65,22 @@ def test_plausible_value_keeps_honest_record():
 def test_plausible_value_handles_missing_fields():
   assert plausible_value({}) is None
   assert plausible_value({"value": 100.0}) == 100.0
+
+
+def test_lookahead_gate_keeps_plausible_ratios():
+  """batch48_lookahead_vs_sync rides the same drift-gate pattern: overlap
+  can only hide the per-chunk host window, so honest ratios sit near 1."""
+  assert gate_lookahead(1.08) == 1.08
+  assert gate_lookahead(0.97) == 0.97
+  assert gate_lookahead(2.9) == 2.9
+
+
+def test_lookahead_gate_drops_artifacts():
+  # A 360x-style block_until_ready artifact on one side of the A/B cannot
+  # enter the tracked record as a "scheduling win" (or loss).
+  assert gate_lookahead(12.4) is None
+  assert gate_lookahead(0.05) is None
+  assert gate_lookahead(None) is None
 
 
 def test_committed_r02_artifact_is_filtered():

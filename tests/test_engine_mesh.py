@@ -108,7 +108,7 @@ def test_batched_decode_over_local_mesh_matches():
       pos = jnp.asarray([len(x) for x in prompts], jnp.int32)
       act = jnp.ones((2,), bool)
       temps = jnp.zeros((2,), jnp.float32)
-      toks, _, _ = fused_batch_decode(p, cfg, shard, tok, cache, pos, act, temps, 10)
+      toks, _, _, _ = fused_batch_decode(p, cfg, shard, tok, cache, pos, act, temps, 10)
       outs.append((firsts, np.asarray(toks)))
   assert outs[0][0] == outs[1][0]
   assert np.array_equal(outs[0][1], outs[1][1])

@@ -126,12 +126,12 @@ def test_paged_int8kv_matches_slot_int8kv():
   temps = jnp.zeros((B,), jnp.float32)
 
   cache = init_kv_cache(cfg, cfg.n_layers, B, ps * mp, quant="int8")
-  t_slot, _, _ = fused_batch_decode(params, cfg, shard, tok, cache, positions, active, temps, 10)
+  t_slot, _, _, _ = fused_batch_decode(params, cfg, shard, tok, cache, positions, active, temps, 10)
 
   pool = init_paged_pool(cfg, cfg.n_layers, 1 + B * mp, ps, quant="int8")
   assert pool["k"].dtype == jnp.int8 and "k_scale" in pool
   bt = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
-  t_paged, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, bt, positions, active, temps, 10, page_size=ps)
+  t_paged, _, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, bt, positions, active, temps, 10, page_size=ps)
   np.testing.assert_array_equal(np.asarray(t_slot), np.asarray(t_paged))
 
 

@@ -175,6 +175,28 @@ def test_snapshot_merge_cluster_semantics():
   assert 'xot_tpu_itl_seconds_bucket{le="+Inf"} 3' in text
 
 
+def test_weighted_histogram_observation():
+  """observe_hist(name, v, n=k): k identical observations in ONE lock
+  acquisition — the itl_seconds path records a whole decode chunk's tokens
+  this way (one call per chunk instead of a per-token Python loop)."""
+  m = Metrics()
+  m.observe_hist("itl_seconds", 0.02, n=5)
+  m.observe_hist("itl_seconds", 0.3)  # default n=1 unchanged
+  assert m.hist_count("itl_seconds") == 6
+  text = m.render_prometheus()
+  assert 'xot_tpu_itl_seconds_bucket{le="0.025"} 5' in text
+  assert 'xot_tpu_itl_seconds_bucket{le="+Inf"} 6' in text
+  assert abs(float(text.split("xot_tpu_itl_seconds_sum ")[1].split("\n")[0]) - 0.4) < 1e-9
+  # Weighted quantile: 5/6 of mass in (0.01, 0.025].
+  assert 0.01 < m.quantile("itl_seconds", 0.5) <= 0.025
+  # n <= 0 is a no-op, not a crash (defensive for emit-empty chunks).
+  m.observe_hist("itl_seconds", 1.0, n=0)
+  assert m.hist_count("itl_seconds") == 6
+  # Snapshot/merge round-trips weighted counts exactly.
+  merged = Metrics.merged([m.snapshot(), m.snapshot()])
+  assert merged.hist_count("itl_seconds") == 12
+
+
 # -------------------------------------------------- decode-path attribution
 
 
@@ -235,6 +257,7 @@ def test_scheduler_gauges_counters_and_histograms(monkeypatch):
     "qwait": gm.hist_count("queue_wait_seconds"),
     "itl": gm.hist_count("itl_seconds"),
     "chunk_t": gm.hist_count("decode_chunk_seconds"),
+    "gap": gm.hist_count("sched_host_gap_seconds"),
   }
   seen_occupancy = []
 
@@ -258,6 +281,9 @@ def test_scheduler_gauges_counters_and_histograms(monkeypatch):
   assert gm.hist_count("queue_wait_seconds") - before["qwait"] == 3
   assert gm.hist_count("itl_seconds") > before["itl"]
   assert gm.hist_count("decode_chunk_seconds") > before["chunk_t"]
+  # Dispatch-boundary host gap: chained lookahead dispatches record 0 by
+  # construction; sync-boundary dispatches record the real idle window.
+  assert gm.hist_count("sched_host_gap_seconds") > before["gap"]
   assert max(seen_occupancy) >= 1  # rows were visibly resident mid-run
   # Idle again: gauges settle back to an empty pool.
   assert gm.gauges["scheduler_batch_occupancy"] == 0
@@ -430,6 +456,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_queue_wait_seconds",
   "xot_tpu_prefill_chunk_seconds",
   "xot_tpu_decode_chunk_seconds",
+  "xot_tpu_sched_host_gap_seconds",
   "xot_tpu_prefill_seconds",
   "xot_tpu_decode_step_seconds",
 }

@@ -152,8 +152,8 @@ def test_paged_decode_matches_dense_decode():
   positions = jnp.asarray([len(p) for p in prompts], jnp.int32)
   active = jnp.asarray([True, True, False])
   temps = jnp.zeros((n_slots,), jnp.float32)
-  td, pd, _ = fused_batch_decode(params, CFG, shard, tok, dense, positions, active, temps, 12)
-  tp, pp, _ = fused_paged_batch_decode(params, CFG, shard, tok, pool, jnp.asarray(bt), positions, active, temps, 12, page_size=PS, use_kernel=False)
+  td, _, pd, _ = fused_batch_decode(params, CFG, shard, tok, dense, positions, active, temps, 12)
+  tp, _, pp, _ = fused_paged_batch_decode(params, CFG, shard, tok, pool, jnp.asarray(bt), positions, active, temps, 12, page_size=PS, use_kernel=False)
   td, tp = np.asarray(td), np.asarray(tp)
   assert np.array_equal(td[:2], tp[:2])
   assert np.array_equal(np.asarray(pd), np.asarray(pp))
@@ -211,8 +211,8 @@ def test_paged_int8kv_batched_decode_matches_dense(B):
   active = jnp.ones((B,), bool)
   temps = jnp.zeros((B,), jnp.float32)
   n_steps = PS + 3  # every row's decode crosses at least one page boundary
-  td, pd, _ = fused_batch_decode(params, CFG, shard, tok1, dense, positions, active, temps, n_steps)
-  tp, pq, _ = fused_paged_batch_decode(
+  td, _, pd, _ = fused_batch_decode(params, CFG, shard, tok1, dense, positions, active, temps, n_steps)
+  tp, _, pq, _ = fused_paged_batch_decode(
     params, CFG, shard, tok1, pool, jnp.asarray(bts), positions, active, temps, n_steps, page_size=PS, use_kernel=False
   )
   assert np.array_equal(np.asarray(td), np.asarray(tp))
@@ -493,8 +493,8 @@ def test_paged_decode_covers_engine_modes(flavor):
   positions = jnp.asarray([len(p) for p in prompts], jnp.int32)
   active = jnp.ones((n_slots,), bool)
   temps = jnp.zeros((n_slots,), jnp.float32)
-  td, _, _ = fused_batch_decode(params, cfg, shard, tok, dense, positions, active, temps, 8)
-  tp, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, jnp.asarray(bt), positions, active, temps, 8, page_size=PS, use_kernel=False)
+  td, _, _, _ = fused_batch_decode(params, cfg, shard, tok, dense, positions, active, temps, 8)
+  tp, _, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool, jnp.asarray(bt), positions, active, temps, 8, page_size=PS, use_kernel=False)
   assert np.array_equal(np.asarray(td), np.asarray(tp))
 
 

@@ -99,8 +99,8 @@ def test_pp_batch_decode_matches_single_device(flavor, plan):
   pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
   active = jnp.asarray([True, True, True, False])
   temps = jnp.zeros((4,), jnp.float32)
-  ref_toks, ref_pos, _ = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
-  pp_toks, pp_pos, _ = ppb.batch_decode(tok, cache_pp, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps)
+  ref_toks, _, ref_pos, _ = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+  pp_toks, _, pp_pos, _ = ppb.batch_decode(tok, cache_pp, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps)
   np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
   np.testing.assert_array_equal(np.asarray(pp_pos), np.asarray(ref_pos))
 
@@ -120,8 +120,8 @@ def test_pp_batch_decode_consecutive_chunks_stay_exact():
   temps = jnp.zeros((4,), jnp.float32)
   top_ks = jnp.full((4,), 35, jnp.int32)
   for _ in range(3):
-    ref_toks, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, 4)
-    pp_toks, pos_pp, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, top_ks, 4)
+    ref_toks, _, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, 4)
+    pp_toks, _, pos_pp, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, top_ks, 4)
     np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
     tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])
     pos = pos_ref
@@ -149,8 +149,8 @@ def test_pp_paged_batch_decode_matches_single_device(flavor):
   pos = jnp.asarray([len(p) for p in PROMPTS], jnp.int32)
   active = jnp.asarray([True, True, False, True])
   temps = jnp.zeros((4,), jnp.float32)
-  ref_toks, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
-  pp_toks, _, _ = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps, page_size=PS)
+  ref_toks, _, _, _ = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
+  pp_toks, _, _, _ = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, jnp.full((4,), 35, jnp.int32), n_steps, page_size=PS)
   np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
 
 
@@ -180,26 +180,26 @@ def test_pp_batch_dense_prefix_moe_matches_single_device(paged, mla):
     pool_pp, _, firsts_pp = _prefill_paged(params, cfg, shard, PROMPTS, ppb)
     assert firsts_pp == firsts_ref
     tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
-    ref_toks, _, pool_ref = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
-    pp_toks, _, pool_pp = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, *tok_args, page_size=PS)
+    ref_toks, _, _, pool_ref = fused_paged_batch_decode(params, cfg, shard, tok, pool_ref, bt, pos, active, temps, n_steps, page_size=PS, use_kernel=False)
+    pp_toks, _, _, pool_pp = ppb.paged_batch_decode(tok, pool_pp, bt, pos, active, temps, *tok_args, page_size=PS)
   else:
     cache_ref, firsts_ref = _prefill_dense(params, cfg, shard, PROMPTS)
     cache_pp, firsts_pp = _prefill_dense(params, cfg, shard, PROMPTS, ppb)
     assert firsts_pp == firsts_ref
     tok = jnp.asarray([[f] for f in firsts_ref], jnp.int32)
-    ref_toks, _, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
-    pp_toks, _, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, *tok_args)
+    ref_toks, _, _, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+    pp_toks, _, _, cache_pp = ppb.batch_decode(tok, cache_pp, pos, active, temps, *tok_args)
   np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
   # Second chunk: the prefix cache's decode-time writes (stage-owned slices)
   # must land where the next chunk reads them.
   tok2 = jnp.asarray(np.asarray(ref_toks)[:, -1:])
   pos2 = jnp.where(active, pos + n_steps, pos)
   if paged:
-    ref2, _, _ = fused_paged_batch_decode(params, cfg, shard, tok2, pool_ref, bt, pos2, active, temps, n_steps, page_size=PS, use_kernel=False)
-    pp2, _, _ = ppb.paged_batch_decode(tok2, pool_pp, bt, pos2, active, temps, *tok_args, page_size=PS)
+    ref2, _, _, _ = fused_paged_batch_decode(params, cfg, shard, tok2, pool_ref, bt, pos2, active, temps, n_steps, page_size=PS, use_kernel=False)
+    pp2, _, _, _ = ppb.paged_batch_decode(tok2, pool_pp, bt, pos2, active, temps, *tok_args, page_size=PS)
   else:
-    ref2, _, _ = fused_batch_decode(params, cfg, shard, tok2, cache_ref, pos2, active, temps, n_steps)
-    pp2, _, _ = ppb.batch_decode(tok2, cache_pp, pos2, active, temps, *tok_args)
+    ref2, _, _, _ = fused_batch_decode(params, cfg, shard, tok2, cache_ref, pos2, active, temps, n_steps)
+    pp2, _, _, _ = ppb.batch_decode(tok2, cache_pp, pos2, active, temps, *tok_args)
   np.testing.assert_array_equal(np.asarray(pp2), np.asarray(ref2))
 
 

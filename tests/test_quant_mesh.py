@@ -98,6 +98,6 @@ def test_int8_batched_mesh_serving_matches_single_device(quantized, mode):
   active = jnp.ones((B,), bool)
   temps = jnp.zeros((B,), jnp.float32)
   top_ks = jnp.full((B,), 35, jnp.int32)
-  ref_toks, _, _ = fused_batch_decode(qp, CFG, shard, tok, cache_ref, pos, active, temps, N_STEPS)
-  m_toks, _, _ = srv.batch_decode(tok, cache_m, pos, active, temps, top_ks, N_STEPS)
+  ref_toks, _, _, _ = fused_batch_decode(qp, CFG, shard, tok, cache_ref, pos, active, temps, N_STEPS)
+  m_toks, _, _, _ = srv.batch_decode(tok, cache_m, pos, active, temps, top_ks, N_STEPS)
   np.testing.assert_array_equal(np.asarray(m_toks), np.asarray(ref_toks))

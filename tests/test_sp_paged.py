@@ -98,10 +98,10 @@ def test_sp_paged_prefill_and_decode_match_single_device(cfg, plan):
   top_ks = jnp.full((B,), 35, jnp.int32)
   bt_j = jnp.asarray(bts)
   for _ in range(2):  # chained chunks: writes land where the next chunk reads
-    ref_toks, pos_ref, pool_ref = fused_paged_batch_decode(
+    ref_toks, _, pos_ref, pool_ref = fused_paged_batch_decode(
       params, cfg, shard, tok, pool_ref, bt_j, pos, active, temps, n_steps, page_size=PS
     )
-    sp_toks, pos_sp, pool_sp = spb.paged_batch_decode(tok, pool_sp, bt_j, pos, active, temps, top_ks, n_steps, page_size=PS)
+    sp_toks, _, pos_sp, pool_sp = spb.paged_batch_decode(tok, pool_sp, bt_j, pos, active, temps, top_ks, n_steps, page_size=PS)
     np.testing.assert_array_equal(np.asarray(sp_toks), np.asarray(ref_toks))
     np.testing.assert_array_equal(np.asarray(pos_sp), np.asarray(pos_ref))
     tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])

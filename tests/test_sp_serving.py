@@ -213,8 +213,8 @@ def test_sp_batched_decode_matches_single_device():
   temps = jnp.zeros((B,), jnp.float32)
   top_ks = jnp.full((B,), 35, jnp.int32)
   for _ in range(2):  # two chained chunks: writes land where the next reads
-    ref_toks, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
-    sp_toks, pos_sp, cache_sp = spb.batch_decode(tok, cache_sp, pos, active, temps, top_ks, n_steps)
+    ref_toks, _, pos_ref, cache_ref = fused_batch_decode(params, cfg, shard, tok, cache_ref, pos, active, temps, n_steps)
+    sp_toks, _, pos_sp, cache_sp = spb.batch_decode(tok, cache_sp, pos, active, temps, top_ks, n_steps)
     np.testing.assert_array_equal(np.asarray(sp_toks), np.asarray(ref_toks))
     np.testing.assert_array_equal(np.asarray(pos_sp), np.asarray(pos_ref))
     tok = jnp.asarray(np.asarray(ref_toks)[:, -1:])

@@ -3,7 +3,11 @@
 ``BatchedServer`` (batch_scheduler.py) drives five device operations: cache
 and page-pool creation, slot/page prefill, and the fused chunk decode. This
 module provides them behind one small interface so the SAME scheduler loop
-serves both layouts:
+serves both layouts. The decode ops share one contract across every backend:
+``(tokens [B, chunk], next_token [B, 1], positions [B], cache)`` — the
+``next_token`` handle stays ON DEVICE so the scheduler's one-chunk-lookahead
+pipeline can dispatch chunk N+1 from chunk N's outputs while chunk N's
+tokens stream back to the host:
 
 - ``DecoderBatchOps`` — the single-device path (models/decoder.py fused
   programs), used whenever the engine runs without a serving mesh.

@@ -23,7 +23,33 @@ shapes:
   per-row positions/temperature/active mask — one compiled program total;
 - admission happens between chunks: new requests claim free slots and
   prefill while other rows keep their state (their next chunk resumes from
-  host-tracked positions).
+  host-tracked positions);
+- the decode loop is a ONE-CHUNK-LOOKAHEAD pipeline (default; escape hatch
+  ``XOT_TPU_SCHED_LOOKAHEAD=0``): chunk N+1 dispatches immediately from
+  chunk N's *device-resident* chain token (the fused programs return the
+  next input token as a device handle — no host round trip), while chunk
+  N's token buffer streams back via ``copy_to_host_async`` and the host
+  does emit/EOS/stop/metrics bookkeeping concurrently. Correctness is by
+  DROP-ON-READ: a row that finishes (EOS, max_tokens, cancel) inside chunk
+  N was speculatively decoded one extra chunk — the host discards the
+  overrun tokens and releases the row at the N+1 settle; page growth runs
+  against dispatch-time positions, so a row always holds one extra chunk of
+  page headroom and the speculative chunk can never overflow a block table.
+  Membership changes (admission prefills, slot frees, preemption) happen
+  only at dispatch boundaries, and the pipeline DRAINS whenever a waiting
+  request could actually admit (a slot is free, or a chunked prefill is
+  mid-flight) so admissions (and TTFT) never wait behind a speculative
+  chunk — while a backlog with zero free slots keeps the pipeline chaining
+  at saturation. Greedy traffic is token-identical to the synchronous loop
+  by construction (same compiled programs, same sampling; only the
+  host/device schedule changes), and each SAMPLED request's stream is
+  identical too — the key-split order is one split per dispatched chunk on
+  the event-loop thread, and a speculative chunk's extra split happens only
+  AFTER every emitted token of the finishing request. The one honest caveat:
+  that extra split shifts the engine's key chain, so sampled requests
+  arriving AFTER an EOS-triggered speculative chunk draw different (equally
+  valid) subkeys than they would under ``XOT_TPU_SCHED_LOOKAHEAD=0`` — A/B
+  comparisons of sampled traffic are per-request, not cross-request.
 
 Enable with ``XOT_TPU_BATCHED=1`` (orchestration/node.py routes single-node
 full-shard prompts here). ``XOT_TPU_BATCH_SLOTS`` (default 4) and
@@ -39,7 +65,6 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -102,10 +127,42 @@ class _Slot:
   chain_keys: list = field(default_factory=list)
 
 
+@dataclass
+class _Plan:
+  """Dispatch-time snapshot for one decode chunk: who steps, who is
+  page-starved, and each row's dispatch position (confirmed position plus
+  the in-flight chunk's speculative advance under lookahead)."""
+
+  rows: list  # [(row, _Slot)] resident at dispatch
+  active: np.ndarray  # [B] bool
+  starved: set  # rows resident but skipped this chunk (page-starved)
+  positions: np.ndarray  # [B] int32 dispatch positions
+  deadlocked: bool = False  # every resident row starved, nothing finishing
+
+
+@dataclass
+class _Chunk:
+  """One dispatched decode chunk, possibly still executing on device.
+
+  Holds what the settle pass needs: the device token buffer (its host copy
+  already streaming back via ``copy_to_host_async``), the device-resident
+  chain token that seeds the NEXT dispatch (never read back), and the
+  dispatch-time plan so host bookkeeping runs against the state the compiled
+  program actually saw — not against state that moved while it flew."""
+
+  toks: object  # device [B, chunk] int32
+  next_tok: object  # device [B, 1] int32 — chunk N+1's input token handle
+  rows: list  # [(row, _Slot)] resident at dispatch
+  active: np.ndarray  # [B] bool — rows that stepped in this chunk
+  starved: frozenset
+  t_dispatch: float
+  chained: bool  # dispatched on top of an in-flight chunk (device never idled)
+
+
 class BatchedServer:
   """Owns the slot pool and the decode loop for one engine."""
 
-  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None):
+  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None, lookahead: bool | None = None):
     self.engine = engine
     # Device ops go through the engine's backend (inference/batch_ops.py):
     # single-device fused programs, or the pp-pipelined variants when the
@@ -158,6 +215,32 @@ class BatchedServer:
     self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
     self._admitting: set[str] = set()  # ids currently inside _admit
     self._loop_task: asyncio.Task | None = None
+    # One-chunk-lookahead pipelined decode (module docstring): dispatch chunk
+    # N+1 from chunk N's device-resident chain token while N's tokens stream
+    # back and the host post-processes. XOT_TPU_SCHED_LOOKAHEAD=0 restores
+    # the strictly synchronous tick (dispatch → readback → bookkeeping).
+    if lookahead is None:
+      lookahead = os.getenv("XOT_TPU_SCHED_LOOKAHEAD", "1") not in ("0", "false")
+    self.lookahead = bool(lookahead)
+    # Persistent per-row dispatch arrays, updated incrementally on admission
+    # / advance / release — the dispatch path no longer rebuilds them from a
+    # Python loop over every slot each tick.
+    self._h_tokens = np.zeros((self.n_slots, 1), dtype=np.int32)
+    self._h_positions = np.zeros((self.n_slots,), dtype=np.int32)
+    self._h_temps = np.zeros((self.n_slots,), dtype=np.float32)
+    self._h_top_ks = np.ones((self.n_slots,), dtype=np.int32)
+    self._h_generated = np.zeros((self.n_slots,), dtype=np.int64)
+    self._h_max_tokens = np.zeros((self.n_slots,), dtype=np.int64)
+    self._h_occupied = np.zeros((self.n_slots,), dtype=bool)
+    # Page availability as of the last admission pass: the lookahead drain
+    # gate retries parked requests only when this moves (_parked_admissible).
+    self._parked_avail_seen: int = -1
+    # Dispatch-boundary timing: when the last chunk's host readback landed
+    # (None until the first settle / after idle). Feeds decode_chunk_seconds
+    # (device time, ready-to-ready while the pipeline is full) and
+    # sched_host_gap_seconds (device-idle window a dispatch had to wait for
+    # host work — 0 by construction for chained lookahead dispatches).
+    self._t_last_ready: float | None = None
 
   # ------------------------------------------------------------- public API
 
@@ -210,6 +293,11 @@ class BatchedServer:
     queued = self._queued.get(request_id)
     if queued is not None and not queued.future.done():
       queued.max_tokens = 0  # admitted-then-finished immediately
+      # Poke the lookahead drain gate: a cancelled PARKED request must
+      # settle at the next boundary's admission pass, not wait for the next
+      # page-availability increase (which under saturation can be a whole
+      # resident generation away).
+      self._parked_avail_seen = -1
       return
     if request_id in self._admitting:
       self._cancelled_ids.add(request_id)
@@ -244,10 +332,10 @@ class BatchedServer:
 
       self.paged = select_decode_path(self.n_slots, self.max_seq, kv_quant) != "dense"
     if self.paged:
-      from .paging import PageAllocator
+      from .paging import PageAllocator, pages_to_cover
 
       ps = self.page_size
-      self.pages_per_row = (self.max_seq + ps - 1) // ps
+      self.pages_per_row = pages_to_cover(self.max_seq, ps)
       # Default pool size: the dense layout's HBM budget expressed in
       # PAGES, not its slot count. An int8-KV token costs hd code bytes +
       # 4 scale bytes per head per side vs 2·hd bf16 bytes, so the same
@@ -338,7 +426,9 @@ class BatchedServer:
       # through prefill to produce the last-position logits.
       shared_pages = self.allocator.lookup_prefix(chain_keys[: (S - 1) // ps])
       prefix_len = len(shared_pages) * ps
-      total = (S + 1 + ps - 1) // ps  # cover positions [0, S] (first generated token)
+      from .paging import pages_to_cover
+
+      total = pages_to_cover(S + 1, ps)  # cover positions [0, S] (first generated token)
       need = total - len(shared_pages)
       new_pages = None if self.allocator.n_available - need < reserve else self.allocator.alloc(need)
       if new_pages is None:
@@ -432,6 +522,10 @@ class BatchedServer:
       if r is not None:
         ready.append(r)
         taken.add(row)
+    if self.allocator is not None:
+      # Baseline for the lookahead drain gate: parked retries wait for the
+      # NEXT availability change instead of replaying this pass's verdict.
+      self._parked_avail_seen = self.allocator.n_available
     if ready:
       await self._dispatch(ready)
 
@@ -540,7 +634,9 @@ class BatchedServer:
       # The window must cover each row's PADDED write reach (the program
       # writes S_pad slots from prefix_len; pad garbage scatters to trash),
       # which the scatter-clamp grouping already bounds to max_seq.
-      need_pages = (max(int(r.prefix_len) for r in group) + S_pad + ps - 1) // ps
+      from .paging import pages_to_cover
+
+      need_pages = pages_to_cover(max(int(r.prefix_len) for r in group) + S_pad, ps)
       mp_used = 1
       while mp_used < need_pages:
         mp_used *= 2
@@ -555,10 +651,15 @@ class BatchedServer:
       # prefix 0, prompt_len 1.
       prompt_lens[K:] = 1
 
+      # Key split on the EVENT-LOOP thread, before the dispatch crosses to
+      # the executor: the worker thread never touches the engine's PRNG
+      # chain, so concurrent single-stream requests (and the lookahead
+      # pipeline) can't interleave splits (engine.split_key is locked too).
+      sub = eng.split_key()
+
       def run():
         from ..models.decoder import sample_rows
 
-        eng._key, sub = jax.random.split(eng._key)
         last, self.cache = self.ops.prefill_into_pages_many(
           jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size
         )
@@ -566,13 +667,13 @@ class BatchedServer:
 
     else:
       rows = np.asarray([r.row for r in group] + spare[: n_rows - K], dtype=np.int32)
+      sub = eng.split_key()  # loop-thread split; the executor only runs device work
 
       def run():
         # Prefill AND first-token sampling stay on the engine executor — the
-        # single thread that serializes all device work (and owns eng._key).
+        # single thread that serializes all device work.
         from ..models.decoder import sample_rows
 
-        eng._key, sub = jax.random.split(eng._key)
         last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
         return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
 
@@ -592,9 +693,13 @@ class BatchedServer:
         self._cancelled_ids.discard(r.req.request_id)
       return
     finally:
+      # Device idle from here until the next dispatch — refreshed on the
+      # failure path too, or a failed prefill's whole device time would leak
+      # into the next dispatch's sched_host_gap_seconds observation.
+      self._t_last_ready = time.perf_counter()
       for r in group:
         self._admitting.discard(r.req.request_id)
-    metrics.observe_hist("prefill_chunk_seconds", time.perf_counter() - t_dispatch)
+    metrics.observe_hist("prefill_chunk_seconds", self._t_last_ready - t_dispatch)
     metrics.inc("prefill_chunks_total")
     for i, r in enumerate(group):
       if r.chunk_end:  # intermediate chunk: advance and re-queue; no sample
@@ -624,6 +729,13 @@ class BatchedServer:
         req.future.set_result(slot.out_tokens)
       return
     self.slots[r.row] = slot
+    self._h_occupied[r.row] = True
+    self._h_tokens[r.row, 0] = first
+    self._h_positions[r.row] = slot.pos
+    self._h_temps[r.row] = req.temp
+    self._h_top_ks[r.row] = min(req.top_k, self.k_max)
+    self._h_generated[r.row] = slot.generated
+    self._h_max_tokens[r.row] = req.max_tokens
     if self.paged:
       self.block_tables[r.row, :] = 0
       n = len(slot.shared_pages) + len(slot.pages)
@@ -651,13 +763,29 @@ class BatchedServer:
     slot.shared_pages, slot.pages = [], []
 
   def _clear_row(self, row: int) -> None:
-    if self.paged:
+    """Reset a freed row's block-table entry and its persistent dispatch
+    arrays (the single release hook — results walk, preemption, teardown)."""
+    if self.paged and self.block_tables is not None:
       self.block_tables[row, :] = 0
+    self._h_occupied[row] = False
+    self._h_tokens[row, 0] = 0
+    self._h_positions[row] = 0
+    self._h_temps[row] = 0.0
+    self._h_top_ks[row] = 1
+    self._h_generated[row] = 0
+    self._h_max_tokens[row] = 0
 
-  def _grow_pages(self, row: int, slot: _Slot) -> bool:
-    """Ensure ``slot`` has pages covering its next decode chunk."""
-    ps = self.page_size
-    needed = (slot.pos + self.chunk - 1) // ps + 1
+  def _grow_pages(self, row: int, slot: _Slot, pos: int) -> bool:
+    """Ensure ``slot`` has pages covering the chunk dispatched at ``pos``.
+
+    ``pos`` is the DISPATCH-time position — under lookahead it already
+    includes the in-flight chunk's speculative advance, so growth reserves
+    one extra chunk of headroom ahead of the confirmed position and the
+    speculative chunk can never overflow the block table
+    (inference/paging.py ``pages_to_cover``)."""
+    from .paging import pages_to_cover
+
+    needed = pages_to_cover(pos + self.chunk, self.page_size)
     have = len(slot.shared_pages) + len(slot.pages)
     if needed <= have:
       return True
@@ -670,141 +798,283 @@ class BatchedServer:
     slot.pages.extend(got)
     return True
 
-  async def _run(self) -> None:
+  def _parked_admissible(self) -> bool:
+    """Should the pipeline drain for the parked (page-starved) set? True
+    when page availability CHANGED since the last admission pass looked.
+
+    Every event that can make a parked request admissible moves
+    ``n_available`` — a finishing row frees its tail pages, donated prompt
+    pages land in the evictable LRU, shared-prefix refs drop — while an
+    UNCHANGED allocator would just replay the pass that parked everyone
+    (recorded demands can go stale against the live prefix cache, so the
+    retry recomputes them rather than trusting them here). Only INCREASES
+    count: a decrease (a resident row growing into a page) cannot make a
+    parked demand coverable, so it just moves the baseline — without that,
+    every page-boundary crossing by a resident row would buy a futile
+    synchronous boundary. Cost model: one drain per release/donation event,
+    and steady page-bound saturation keeps the pipeline chaining."""
+    if not self._parked:
+      return False
+    if self.allocator is None:
+      return True
+    avail = self.allocator.n_available
+    if avail > self._parked_avail_seen:
+      return True
+    self._parked_avail_seen = avail  # shrunk: re-baseline, keep chaining
+    return False
+
+  def _plan_chunk(self, inflight: _Chunk | None) -> _Plan:
+    """Snapshot the next chunk's dispatch state: CONFIRMED slot state plus
+    the (single) in-flight chunk's speculative advance.
+
+    Mirrors the synchronous tick's per-row gating. Cancelled rows and rows
+    without cache room deactivate (they settle as empty finishes at this
+    chunk's boundary); page-starved rows skip the chunk but stay resident
+    (other rows' finishes free pages). Under lookahead only, a row whose
+    in-flight chunk deterministically reaches max_tokens is excluded
+    outright: an active row advances a full chunk unless EOS lands first,
+    and either way the IN-FLIGHT settle resolves it before this chunk's
+    settle runs — this chunk would only decode droppable overrun for it."""
+    spec = inflight.active if inflight is not None else None
+    positions = self._h_positions.copy()
+    generated = self._h_generated.copy()
+    if spec is not None:
+      positions[spec] += self.chunk
+      generated[spec] += self.chunk
+    active = self._h_occupied.copy()
+    starved: set[int] = set()
+    rows: list = []
+    finishing = 0
+    for i, s in enumerate(self.slots):
+      if s is None:
+        continue
+      rows.append((i, s))
+      if spec is not None and spec[i] and generated[i] >= self._h_max_tokens[i]:
+        active[i] = False  # finishes at the in-flight settle; drop-on-read covers the rest
+      elif s.cancelled or int(positions[i]) + self.chunk >= self.max_seq:
+        active[i] = False
+        finishing += 1
+      elif self.paged and not self._grow_pages(i, s, int(positions[i])):
+        active[i] = False
+        starved.add(i)  # counted at dispatch — a discarded plan is re-planned, not a second starvation
+    deadlocked = inflight is None and bool(starved) and not active.any() and finishing == 0
+    return _Plan(rows=rows, active=active, starved=starved, positions=positions, deadlocked=deadlocked)
+
+  def _preempt_starved(self, plan: _Plan) -> None:
+    """Every resident row is starved (none can run, and no finishing row is
+    about to free pages at the next settle): fail the youngest so the others
+    make progress."""
+    victim = min(plan.starved, key=lambda i: self.slots[i].generated)
+    s = self.slots[victim]
+    metrics.inc("scheduler_preemptions_total")
+    tracer.stage(s.req.request_id, "preempted", {"generated": s.generated})
+    self._release_pages(s)
+    self.slots[victim] = None
+    self._clear_row(victim)
+    if not s.req.future.done():
+      s.req.future.set_exception(ServerOverloadedError("page pool exhausted with no runnable rows"))
+
+  async def _dispatch_decode(self, plan: _Plan, inflight: _Chunk | None) -> _Chunk:
+    """Dispatch one decode chunk and return its in-flight record WITHOUT
+    waiting for results: the executor call only enqueues the compiled
+    program plus the async device→host copy — the device runs while the
+    host loops back to settle the previous chunk."""
     eng = self.engine
+    # Chained dispatch: the input token is the in-flight chunk's
+    # device-resident next-token handle (no host round trip); a sync
+    # dispatch (pipeline empty) uses the persistent host arrays. The key
+    # split happens HERE on the event-loop thread — the executor thread
+    # never touches the engine's PRNG chain.
+    tokens = inflight.next_tok if inflight is not None else self._h_tokens
+    positions, active = plan.positions, plan.active
+    temps, top_ks = self._h_temps, self._h_top_ks
+    sub = eng.split_key()
+    now = time.perf_counter()
+    if self._t_last_ready is not None:
+      # Device-idle window this dispatch had to wait for host work — 0 by
+      # construction when chained (the device already has this chunk's
+      # predecessor running and this one queues behind it).
+      metrics.observe_hist("sched_host_gap_seconds", 0.0 if inflight is not None else now - self._t_last_ready)
+
+    def run():
+      if self.paged:
+        toks, next_tok, _pos, self.cache = self.ops.paged_batch_decode(
+          jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
+          jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
+          k_max=self.k_max, page_size=self.page_size, key=sub,
+        )
+      else:
+        toks, next_tok, _pos, self.cache = self.ops.batch_decode(
+          jnp.asarray(tokens), self.cache, jnp.asarray(positions), jnp.asarray(active),
+          jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub,
+        )
+      try:
+        toks.copy_to_host_async()  # the readback overlaps the next chunk's compute
+      except AttributeError:  # backend without async copies
+        pass
+      return toks, next_tok
+
+    if plan.starved:
+      metrics.inc("scheduler_page_starved_total", len(plan.starved))
+    t_dispatch = time.perf_counter()
+    toks, next_tok = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    return _Chunk(
+      toks=toks, next_tok=next_tok, rows=plan.rows, active=plan.active,
+      starved=frozenset(plan.starved), t_dispatch=t_dispatch, chained=inflight is not None,
+    )
+
+  async def _settle(self, record: _Chunk) -> None:
+    """Read one chunk's tokens back and run the host bookkeeping the
+    synchronous loop did inline: emit, EOS/max_tokens/cancel finishes, page
+    release, metrics. Under lookahead this runs while the NEXT chunk
+    computes on device. Rows that already finished at an earlier settle
+    (while this chunk was speculatively in flight) are DROPPED-ON-READ:
+    their tokens in this buffer are overrun garbage and are never emitted;
+    their pages were released at the earlier settle and can only be
+    re-granted to dispatches that execute AFTER this chunk on the single
+    device stream, so the garbage writes are always overwritten or
+    positionally masked before anyone reads them."""
+    eng = self.engine
+    rows_host = await asyncio.get_event_loop().run_in_executor(eng.executor, lambda: np.asarray(record.toks))
+    t_ready = time.perf_counter()
+    # Device-time attribution: while the pipeline is full the device runs
+    # chunks back-to-back, so per-chunk device time is READY-TO-READY (==
+    # dispatch-to-dispatch in steady state); the first chunk after a
+    # boundary times dispatch-to-ready, exactly like the synchronous loop.
+    # Either way the host bookkeeping below is NOT serially attributed.
+    base = self._t_last_ready if (record.chained and self._t_last_ready is not None) else record.t_dispatch
+    chunk_dt = max(t_ready - base, 1e-9)
+    self._t_last_ready = t_ready
+    if record.active.any():
+      # Per-chunk decode-path attribution: the dispatch table's real-world
+      # mix, observable at /metrics instead of only in offline bench JSON.
+      metrics.observe_hist("decode_chunk_seconds", chunk_dt)
+      metrics.inc("decode_chunks_total", labels={"path": self.decode_path})
+
+    for i, slot in record.rows:
+      if slot.finished or self.slots[i] is not slot:
+        continue  # drop-on-read: overrun tokens of a row settled earlier
+      req = slot.req
+      if i in record.starved:  # skipped this chunk; retried at the next dispatch
+        continue
+      if not record.active[i]:  # cache exhausted or cancelled at dispatch
+        slot.finished = True
+        self._cancelled_ids.discard(req.request_id)
+        self._release_pages(slot)
+        req.emit(req.request_id, [], True)
+        if not req.future.done():
+          req.future.set_result(slot.out_tokens)
+        self.slots[i] = None
+        self._clear_row(i)
+        continue
+      emit: list[int] = []
+      done = False
+      for t in rows_host[i]:
+        t = int(t)
+        emit.append(t)
+        slot.generated += 1
+        if t in req.eos_ids or slot.generated >= req.max_tokens:
+          done = True
+          break
+      slot.out_tokens.extend(emit)
+      slot.pos += len(emit)
+      slot.last_token = emit[-1] if emit else slot.last_token
+      self._h_positions[i] = slot.pos
+      self._h_generated[i] = slot.generated
+      self._h_tokens[i, 0] = slot.last_token
+      if emit:
+        metrics.inc("decode_tokens_total", len(emit), labels={"path": self.decode_path})
+        # Inter-token latency: the chunk's wall-clock amortized over its
+        # tokens — ONE weighted observation (utils/metrics.py observe_hist
+        # n=k) instead of k lock round trips.
+        metrics.observe_hist("itl_seconds", chunk_dt / len(emit), n=len(emit))
+      req.emit(req.request_id, emit, done)
+      if done:
+        slot.finished = True
+        self._cancelled_ids.discard(req.request_id)
+        self._release_pages(slot)
+        if not req.future.done():
+          req.future.set_result(slot.out_tokens)
+        self.slots[i] = None
+        self._clear_row(i)
+    self._update_gauges()
+
+  async def _run(self) -> None:
     self._ensure_cache()
+    inflight: _Chunk | None = None
     try:
       while True:
-        # Admission: every admissible request — parked (page-starved) first,
-        # in arrival order, then the queue — prefills in ONE batched dispatch
-        # between decode chunks (no await while any row is active — keep the
-        # pool stepping).
-        await self._admit_pending()
-        self._update_gauges()
-        if all(s is None for s in self.slots):
-          if self._prefilling:
-            # A chunked prefill is mid-flight with no resident decoders:
-            # loop straight back to dispatch its next chunk.
+        if inflight is not None:
+          # Membership changes happen only at dispatch boundaries: DRAIN the
+          # pipeline whenever a waiting request could actually ADMIT —
+          # admissions must never queue behind a speculative chunk (the
+          # TTFT contract) — or when lookahead is off (the strictly
+          # synchronous tick: dispatch, settle, admit). A backlog with NO
+          # free slot cannot admit no matter how often we drain, so the
+          # pipeline keeps chaining at saturation (the regime the overlap
+          # targets); the settle after every dispatch still discovers
+          # finishes, so the first freed slot flips this gate at the very
+          # next boundary and the waiter admits one chunk later at most.
+          # Mid-chunked-prefill continuations always drain: their next
+          # prefill chunk must dispatch at the boundary regardless of slots.
+          # A PARKED (page-starved) waiter additionally needs its page
+          # demand to be coverable under the head-of-line reserve
+          # (_parked_admissible mirrors the admission pass exactly) — in
+          # the page-bound saturated regime the allocator stays below every
+          # admissible demand and the pipeline keeps chaining; the settle
+          # after each dispatch still releases finishing rows' pages, so
+          # the boundary where coverage first becomes possible flips this
+          # gate and the waiter admits then.
+          admissible = self._free_slot() is not None and (not self.queue.empty() or self._parked_admissible())
+          if not self.lookahead or self._prefilling or admissible:
+            await self._settle(inflight)
+            inflight = None
             continue
-          if self._parked:
-            # A ready batch that insta-finished (eos or max_tokens at its
-            # first token, a raced cancel, or a failed dispatch) can leave
-            # entries parked behind it with every slot free — their park was
-            # justified by ``others_active=ready`` pages that are now
-            # released. Retry immediately: with nothing in flight each one
-            # either admits or fails honestly as overloaded (every pass
-            # resolves at least one request, so this cannot spin).
+        else:
+          # Admission: every admissible request — parked (page-starved)
+          # first, in arrival order, then the queue — prefills in ONE
+          # batched dispatch between decode chunks.
+          await self._admit_pending()
+          self._update_gauges()
+          if all(s is None for s in self.slots):
+            if self._prefilling:
+              # A chunked prefill is mid-flight with no resident decoders:
+              # loop straight back to dispatch its next chunk.
+              continue
+            if self._parked:
+              # A ready batch that insta-finished (eos or max_tokens at its
+              # first token, a raced cancel, or a failed dispatch) can leave
+              # entries parked behind it with every slot free — their park
+              # was justified by ``others_active=ready`` pages that are now
+              # released. Retry immediately: with nothing in flight each one
+              # either admits or fails honestly as overloaded (every pass
+              # resolves at least one request, so this cannot spin).
+              continue
+            # Idle: block on the queue (the task persists — no exit/restart
+            # race). The woken request and anything else that queued while
+            # idle admit together in one batched dispatch.
+            self._t_last_ready = None  # idle-by-design is not a host gap
+            req = await self.queue.get()
+            await self._admit_pending(woken=req)
             continue
-          # Idle: block on the queue (the task persists — no exit/restart
-          # race). The woken request and anything else that queued while
-          # idle admit together in one batched dispatch.
-          req = await self.queue.get()
-          await self._admit_pending(woken=req)
+
+        plan = self._plan_chunk(inflight)
+        if inflight is not None and (not plan.rows or not plan.active.any()):
+          # Nothing would step — a membership change is imminent (every row
+          # finishing, starved, or already resolved by the in-flight
+          # settle): settle instead of spending a dead speculative chunk.
+          await self._settle(inflight)
+          inflight = None
           continue
-
-        active = np.array([s is not None for s in self.slots])
-        tokens = np.array([[s.last_token if s else 0] for s in self.slots], dtype=np.int32)
-        positions = np.array([s.pos if s else 0 for s in self.slots], dtype=np.int32)
-        temps = np.array([s.req.temp if s else 0.0 for s in self.slots], dtype=np.float32)
-        top_ks = np.array([s.req.top_k if s else 1 for s in self.slots], dtype=np.int32)
-        # Rows without cache room (or cancelled by their client) finish
-        # before the chunk; the results loop below frees them. In paged mode
-        # a row can also be page-STARVED: it skips this chunk but stays
-        # resident (other rows' finishes will free pages).
-        starved: set[int] = set()
-        for i, s in enumerate(self.slots):
-          if s is None:
-            continue
-          if s.cancelled or s.pos + self.chunk >= self.max_seq:
-            active[i] = False
-          elif self.paged and not self._grow_pages(i, s):
-            active[i] = False
-            starved.add(i)
-            metrics.inc("scheduler_page_starved_total")
-        finishing = [i for i, s in enumerate(self.slots) if s is not None and not active[i] and i not in starved]
-        if starved and not active.any() and not finishing:
-          # Every resident row is starved (none can run, and no finishing
-          # row is about to free pages in the results loop below): fail the
-          # youngest so the others make progress.
-          victim = min(starved, key=lambda i: self.slots[i].generated)
-          s = self.slots[victim]
-          metrics.inc("scheduler_preemptions_total")
-          tracer.stage(s.req.request_id, "preempted", {"generated": s.generated})
-          self._release_pages(s)
-          self.slots[victim] = None
-          self.block_tables[victim, :] = 0
-          if not s.req.future.done():
-            s.req.future.set_exception(ServerOverloadedError("page pool exhausted with no runnable rows"))
+        if plan.deadlocked:
+          self._preempt_starved(plan)
           continue
-
-        def run_chunk():
-          eng._key, sub = jax.random.split(eng._key)
-          if self.paged:
-            toks, _pos, self.cache = self.ops.paged_batch_decode(
-              jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
-              jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
-              k_max=self.k_max, page_size=self.page_size, key=sub,
-            )
-          else:
-            toks, _pos, self.cache = self.ops.batch_decode(
-              jnp.asarray(tokens), self.cache, jnp.asarray(positions), jnp.asarray(active),
-              jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub,
-            )
-          return np.asarray(toks)  # ONE readback for the whole pool chunk
-
-        t_chunk = time.perf_counter()
-        rows = await asyncio.get_event_loop().run_in_executor(eng.executor, run_chunk)
-        chunk_dt = time.perf_counter() - t_chunk
-        if active.any():
-          # Per-chunk decode-path attribution: the dispatch table's
-          # real-world mix, observable at /metrics instead of only in
-          # offline bench JSON.
-          metrics.observe_hist("decode_chunk_seconds", chunk_dt)
-          metrics.inc("decode_chunks_total", labels={"path": self.decode_path})
-
-        for i, slot in enumerate(self.slots):
-          if slot is None:
-            continue
-          req = slot.req
-          if i in starved:  # skipped this chunk; retry next tick
-            continue
-          if not active[i]:  # cache exhausted or cancelled
-            slot.finished = True
-            self._cancelled_ids.discard(req.request_id)
-            self._release_pages(slot)
-            req.emit(req.request_id, [], True)
-            if not req.future.done():
-              req.future.set_result(slot.out_tokens)
-            self.slots[i] = None
-            self._clear_row(i)
-            continue
-          emit: list[int] = []
-          done = False
-          for t in rows[i]:
-            t = int(t)
-            emit.append(t)
-            slot.generated += 1
-            if t in req.eos_ids or slot.generated >= req.max_tokens:
-              done = True
-              break
-          slot.out_tokens.extend(emit)
-          slot.pos += len(emit)
-          slot.last_token = emit[-1] if emit else slot.last_token
-          if emit:
-            metrics.inc("decode_tokens_total", len(emit), labels={"path": self.decode_path})
-            # Inter-token latency: the chunk's wall-clock amortized over its
-            # tokens, one observation per token (weighting stays per-token).
-            per_tok = chunk_dt / len(emit)
-            for _ in emit:
-              metrics.observe_hist("itl_seconds", per_tok)
-          req.emit(req.request_id, emit, done)
-          if done:
-            self._cancelled_ids.discard(req.request_id)
-            self._release_pages(slot)
-            if not req.future.done():
-              req.future.set_result(slot.out_tokens)
-            self.slots[i] = None
-            self._clear_row(i)
+        prev, inflight = inflight, await self._dispatch_decode(plan, inflight)
+        if prev is not None:
+          # Settle chunk N while chunk N+1 computes: the host readback of N
+          # (already streaming via copy_to_host_async) plus all bookkeeping
+          # overlaps device work instead of serializing in front of it.
+          await self._settle(prev)
     except asyncio.CancelledError:
       self._fail_all(RuntimeError("batched server shut down"))
       raise
@@ -823,6 +1093,8 @@ class BatchedServer:
       if slot is not None and not slot.req.future.done():
         slot.req.future.set_exception(exc)
       self.slots[i] = None
+      self._clear_row(i)  # the single release hook resets every dispatch array
+    self._t_last_ready = None
     while self._prefilling:
       r = self._prefilling.pop()
       if not r.req.future.done():

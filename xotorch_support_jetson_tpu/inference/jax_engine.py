@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from pathlib import Path
@@ -178,7 +179,27 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self.executor = ThreadPoolExecutor(max_workers=1)
     self._seed = seed
     self._key = None
+    # Guards the PRNG chain's read-split-write. Device work serializes on the
+    # one executor thread, but key SPLITS are pure host state: the batch
+    # scheduler splits on the event-loop thread before dispatch (so the
+    # lookahead pipeline never touches the chain from the worker thread),
+    # while single-stream paths split wherever their sync helper runs — the
+    # lock makes any interleaving of the two yield distinct subkeys.
+    self._key_lock = threading.Lock()
     self._shard_lock = asyncio.Lock()
+
+  def split_key(self):
+    """Split the engine PRNG chain and return a fresh subkey (thread-safe).
+
+    Every consumer of ``self._key`` must go through here — a bare
+    ``self._key, sub = jax.random.split(self._key)`` from two threads can
+    read the same chain state and hand two dispatches the SAME subkey
+    (identical samples for different requests)."""
+    with self._key_lock:
+      if self._key is None:
+        self._key = jax.random.PRNGKey(self._seed)
+      self._key, sub = jax.random.split(self._key)
+      return sub
 
   # ---------------------------------------------------------------- loading
 
@@ -716,9 +737,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       logits = logits[:, -1, :]
     if temp <= 0:
       return np.asarray(greedy(logits))
-    if self._key is None:
-      self._key = jax.random.PRNGKey(self._seed)
-    self._key, sub = jax.random.split(self._key)
+    sub = self.split_key()
     return np.asarray(sample_logits(logits, sub, temp=temp, top_k=top_k))
 
   async def infer_prompt(
@@ -998,7 +1017,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       if token is None:
         raise RuntimeError(f"no chained token for request {request_id}; pass first_token after prefill")
     start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
-    self._key, sub = jax.random.split(self._key)
+    sub = self.split_key()
     if self._pp is not None:
       toks, session.kv_cache = self._pp.fused_decode(token, session.kv_cache, start_pos, n_steps, temp=float(temp), top_k=int(top_k), key=sub)
     else:
@@ -1063,7 +1082,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     B = session.kv_cache["k"].shape[1]
     token = jnp.full((B, 1), int(first_token), dtype=jnp.int32)
     start_pos = jnp.full((B,), session.curr_pos, dtype=jnp.int32)
-    self._key, sub = jax.random.split(self._key)
+    sub = self.split_key()
     eos = tuple(sorted(int(e) for e in eos_ids))
     if self._pp is not None:
       buf, _n, session.kv_cache = self._pp.fused_generate(

@@ -126,6 +126,18 @@ def resolved_decode_path(batch: int, context: int, kv_quant: str = "", paged: bo
   return "kernel"
 
 
+def pages_to_cover(end_pos: int, page_size: int) -> int:
+  """Pages a row needs so every position in ``[0, end_pos)`` maps to an
+  allocated block-table entry.
+
+  The scheduler's growth check runs this against the row's DISPATCH-time
+  position — under the lookahead pipeline that position already includes the
+  in-flight chunk's speculative advance, so a row always holds one extra
+  chunk of page headroom and the speculative chunk can never overflow its
+  block table (batch_scheduler.py ``_grow_pages``)."""
+  return max((int(end_pos) + page_size - 1) // page_size, 0)
+
+
 class PageAllocator:
   """Free-list + refcounted prefix cache over a fixed page pool."""
 

@@ -1010,8 +1010,8 @@ def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cach
     pos = jnp.where(active, pos + 1, pos)  # ...and their position
     return (nxt[:, None], pos, new_cache, key), nxt
 
-  (_, pos, cache, _), toks = jax.lax.scan(body, (token, positions, cache, key), None, length=n_steps)
-  return jnp.moveaxis(toks, 0, 1), pos, cache
+  (next_tok, pos, cache, _), toks = jax.lax.scan(body, (token, positions, cache, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), next_tok, pos, cache
 
 
 def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, key=None):
@@ -1020,8 +1020,13 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
   token [B,1] int32 (each row's last token; inactive rows ignored),
   positions [B] int32, active [B] bool, temps [B] f32 (≤0 ⇒ greedy),
   top_k int or [B] int32 per-row (traced; clipped to the static ``k_max``).
-  Returns (tokens [B, n_steps], new positions [B], cache). Inactive rows do
-  not advance and their cache rows stay untouched at their position.
+  Returns (tokens [B, n_steps], next_token [B, 1], new positions [B], cache).
+  ``next_token`` is the scan carry after the last step — each active row's
+  final sampled token, inactive rows' held token — exactly the next chunk's
+  input, as a DEVICE value: the scheduler's lookahead pipeline chains chunk
+  N+1 from it without a host round trip (the host readback of ``tokens``
+  streams back concurrently). Inactive rows do not advance and their cache
+  rows stay untouched at their position.
   """
   if not (shard.is_first_layer and shard.is_last_layer):
     raise ValueError("fused_batch_decode requires a full-model shard")
@@ -1147,8 +1152,8 @@ def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token
     pos = jnp.where(active, pos + 1, pos)  # ...and their position
     return (nxt[:, None], pos, pool, key), nxt
 
-  (_, pos, pool, _), toks = jax.lax.scan(body, (token, positions, pool, key), None, length=n_steps)
-  return jnp.moveaxis(toks, 0, 1), pos, pool
+  (next_tok, pos, pool, _), toks = jax.lax.scan(body, (token, positions, pool, key), None, length=n_steps)
+  return jnp.moveaxis(toks, 0, 1), next_tok, pos, pool
 
 
 def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
@@ -1157,7 +1162,9 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   Same contract plus ``block_tables`` [B, mp] int32 — the host must have
   allocated pages covering [pos, pos + n_steps) for every active row before
   dispatch (inference/batch_scheduler.py does). Returns
-  (tokens [B, n_steps], positions [B], pool).
+  (tokens [B, n_steps], next_token [B, 1], positions [B], pool) —
+  ``next_token`` is the device-resident chain input for the following chunk
+  (see ``fused_batch_decode``).
 
   ``use_kernel=None`` resolves per shape through the dispatch table
   (inference/paging.py select_decode_path): the XLA gather stays the

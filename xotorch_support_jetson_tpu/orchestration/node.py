@@ -594,10 +594,11 @@ class Node:
         metrics.inc("decode_chunks_total", labels={"path": "dense"})
         if emit:
           metrics.inc("decode_tokens_total", len(emit), labels={"path": "dense"})
-          per_tok = chunk_dt / len(emit)
           for _ in emit:
             tracer.handle_token(request_id)
-            metrics.observe_hist("itl_seconds", per_tok)
+          # One weighted observation per response instead of a per-token
+          # metrics-lock round trip (utils/metrics.py observe_hist n=k).
+          metrics.observe_hist("itl_seconds", chunk_dt / len(emit), n=len(emit))
         metrics.inc("tokens_generated_total", len(emit))
         tokens.extend(emit)
       self.buffered_token_output[request_id] = (tokens, True)
@@ -641,9 +642,9 @@ class Node:
           break
       if emit:
         metrics.inc("decode_tokens_total", len(emit), labels={"path": "dense"})
-        per_tok = chunk_dt / max(len(new_tokens), 1)
-        for _ in emit:
-          metrics.observe_hist("itl_seconds", per_tok)
+        # One weighted observation per chunk (utils/metrics.py observe_hist
+        # n=k) — the per-token cost here was pure lock round trips.
+        metrics.observe_hist("itl_seconds", chunk_dt / max(len(new_tokens), 1), n=len(emit))
       start = off + len(tokens)
       tokens.extend(emit)
       done = hit_eos or off + len(tokens) >= max_tokens

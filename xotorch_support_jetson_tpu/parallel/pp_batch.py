@@ -355,7 +355,10 @@ class PPBatchedServing:
       )
       toks, cache = fn(stage_params, head, token, cache, positions, active, temps, top_ks, key)
       pos = jnp.where(active, positions + n_steps, positions)
-      return toks, pos, cache
+      # Device-resident chain token (same ops contract as the single-device
+      # fused programs): ``buf`` records hold semantics per tick, so the last
+      # column IS the next chunk's input for every row.
+      return toks, toks[:, -1:], pos, cache
 
     @partial(jax.jit, static_argnames=("n_steps", "k_max", "G", "page_size"), donate_argnums=(3,))
     def _paged_batch_decode(stage_params, head, token, pool, block_tables, positions, active, temps, top_ks, key, n_steps: int, k_max: int, G: int, page_size: int):
@@ -366,7 +369,7 @@ class PPBatchedServing:
       )
       toks, pool = fn(stage_params, head, token, pool, block_tables, positions, active, temps, top_ks, key)
       pos = jnp.where(active, positions + n_steps, positions)
-      return toks, pos, pool
+      return toks, toks[:, -1:], pos, pool
 
     self._prefill_slots_fn = _prefill_slots
     self._prefill_pages_fn = _prefill_pages
@@ -403,7 +406,9 @@ class PPBatchedServing:
     """``models.decoder.fused_batch_decode`` semantics over the pp pipeline.
 
     token [B,1], positions/active/temps/top_ks [B]; B must be a multiple of
-    pp. Returns (tokens [B, n_steps], new positions [B], cache).
+    pp. Returns (tokens [B, n_steps], next_token [B, 1], new positions [B],
+    cache) — ``next_token`` is the device-resident chain input for the
+    following chunk, like the single-device fused programs.
     """
     B = token.shape[0]
     if B % self.n_stages:

@@ -192,7 +192,11 @@ class SPBatchedServing:
         in_specs=(P(), P(), cache_inner, P(), P(), P(), P(), P()),
         out_specs=(P(), P(), cache_inner),
       )
-      return fn(params, token, cache, positions, active, temps, top_ks, key)
+      toks, pos, cache = fn(params, token, cache, positions, active, temps, top_ks, key)
+      # Device-resident chain token (shared batched-ops contract): the scan
+      # body holds inactive rows' tokens, so the last column is the next
+      # chunk's input for every row.
+      return toks, toks[:, -1:], pos, cache
 
     # ---- paged pool, page-slot axis striped over sp (module docstring)
 
@@ -294,7 +298,8 @@ class SPBatchedServing:
         in_specs=(P(), P(), pool_inner, P(), P(), P(), P(), P(), P()),
         out_specs=(P(), P(), pool_inner),
       )
-      return fn(params, token, pool, block_tables, positions, active, temps, top_ks, key)
+      toks, pos, pool = fn(params, token, pool, block_tables, positions, active, temps, top_ks, key)
+      return toks, toks[:, -1:], pos, pool
 
     self._prefill_slots_fn = _prefill_slots
     self._batch_decode_fn = _batch_decode

@@ -50,7 +50,13 @@ class _Histogram:
     self.sum = 0.0
     self.count = 0
 
-  def observe(self, value: float) -> None:
+  def observe(self, value: float, n: int = 1) -> None:
+    """Record ``n`` identical observations of ``value`` in one pass — the
+    weighted form exists for per-chunk amortized latencies (a decode chunk's
+    wall-clock spread over its k tokens is k observations of the same
+    value), where an observe-per-token loop would take k lock round trips."""
+    if n <= 0:
+      return
     value = float(value)
     i = 0
     for i, edge in enumerate(self.buckets):  # noqa: B007 — 16 edges; bisect buys nothing
@@ -58,9 +64,9 @@ class _Histogram:
         break
     else:
       i = len(self.buckets)
-    self.counts[i] += 1
-    self.sum += value
-    self.count += 1
+    self.counts[i] += n
+    self.sum += value * n
+    self.count += n
 
   def quantile(self, q: float) -> float | None:
     """Approximate quantile by linear interpolation inside the landing
@@ -116,14 +122,16 @@ class Metrics:
       self._latency_sum[name] += seconds
       self._latency_count[name] += 1
 
-  def observe_hist(self, name: str, value: float, buckets: tuple = DEFAULT_BUCKETS) -> None:
+  def observe_hist(self, name: str, value: float, buckets: tuple = DEFAULT_BUCKETS, n: int = 1) -> None:
     """Record ``value`` into the named histogram (created on first use; the
-    bucket ladder is fixed at creation)."""
+    bucket ladder is fixed at creation). ``n`` records n identical
+    observations under ONE lock acquisition — O(1) instead of O(n) lock
+    round trips for per-chunk amortized values like inter-token latency."""
     with self._lock:
       hist = self._hists.get(name)
       if hist is None:
         hist = self._hists[name] = _Histogram(buckets)
-      hist.observe(value)
+      hist.observe(value, n)
 
   def quantile(self, name: str, q: float) -> float | None:
     """Estimated q-quantile (0..1) of a histogram; None if absent/empty."""
