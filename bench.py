@@ -56,6 +56,124 @@ def gate_lookahead(ratio: float | None) -> float | None:
   return float(ratio) if 1.0 / 3.0 <= ratio <= 3.0 else None
 
 
+def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, where: dict | None = None) -> float | None:
+  """Quantile of a LABELED histogram family's growth between two registry
+  snapshots, aggregated across every label series (the per-peer-link RPC
+  histograms are ``{peer,method}``-labeled; the bench wants the p50 over the
+  whole ring, not one link). ``where`` keeps only series whose label set
+  contains those pairs (e.g. ``{"method": "SendResult"}``). Same
+  snapshot-delta isolation as the unlabeled ``_hist_delta_quantile``:
+  warm-up observations don't own the tail."""
+  want = set((str(k), str(v)) for k, v in (where or {}).items())
+
+  def summed(snap: dict) -> tuple[list | None, list | None]:
+    series = (snap.get("labeled_histograms") or {}).get(name) or []
+    buckets: list | None = None
+    counts: list | None = None
+    for key, h in series:
+      if want and not want <= {tuple(kv) for kv in key}:
+        continue
+      if buckets is None:
+        buckets = list(h["buckets"])
+        counts = [0] * len(h["counts"])
+      if list(h["buckets"]) != buckets or len(h["counts"]) != len(counts):
+        continue  # foreign ladder: can't aggregate bucket-wise, skip series
+      for i, c in enumerate(h["counts"]):
+        counts[i] += int(c)
+    return buckets, counts
+
+  buckets, after_counts = summed(after)
+  if buckets is None:
+    return None
+  b_before, before_counts = summed(before)
+  comparable = b_before == buckets and before_counts is not None
+  delta = [a - (before_counts[i] if comparable else 0) for i, a in enumerate(after_counts)]
+  from xotorch_support_jetson_tpu.utils.metrics import Metrics
+
+  m = Metrics.merged([{"histograms": {name: {"buckets": buckets, "counts": delta, "sum": 0.0}}}])
+  return m.quantile(name, q)
+
+
+def bench_cross_node_hops() -> tuple[float | None, float | None]:
+  """Two-node localhost gRPC ring (dummy engine): drive one request across
+  the ring and report (hop_serialize_ms_p50, hop_rpc_ms_p50) from the
+  per-hop histograms the data plane now records (ISSUE 4). Model compute is
+  deliberately trivial — what this measures is the serialization + gRPC
+  overhead per ring hop, the per-hop tax the cross-node attribution exists
+  to expose."""
+  import asyncio
+
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.networking.discovery import Discovery
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+  from xotorch_support_jetson_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+  from xotorch_support_jetson_tpu.topology.partitioning import (
+    RingMemoryWeightedPartitioningStrategy,
+    map_partitions_to_shards,
+  )
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as global_metrics
+
+  class _Static(Discovery):
+    def __init__(self, peers):
+      self._peers = peers
+
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+    async def discover_peers(self, wait_for_peers: int = 0):
+      return self._peers
+
+  caps = DeviceCapabilities(model="bench", chip="cpu", memory=1024, flops=DeviceFlops(1, 2, 4))
+
+  async def run() -> tuple[float | None, float | None]:
+    ports = [find_available_port("127.0.0.1") for _ in range(2)]
+    ids = ["bench-hop-0", "bench-hop-1"]
+    nodes = []
+    for i in range(2):
+      peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "bench", caps) for j in range(2) if j != i]
+      node = Node(ids[i], None, DummyInferenceEngine(), _Static(peers), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=64)
+      node.server = GRPCServer(node, "127.0.0.1", ports[i])
+      nodes.append(node)
+    await asyncio.gather(*(n.start() for n in nodes))
+    try:
+      for _ in range(100):
+        if all(
+          len(n.topology.nodes) == 2 and len(map_partitions_to_shards(n.partitioning_strategy.partition(n.topology), 8, "dummy")) == 2
+          for n in nodes
+        ):
+          break
+        await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+        await asyncio.sleep(0.05)
+      shard = build_base_shard("dummy", "DummyInferenceEngine")
+      done = asyncio.Event()
+      nodes[0].on_token.register("bench-hop").on_next(lambda rid, toks, fin: done.set() if fin else None)
+      before = global_metrics.snapshot()
+      await nodes[0].process_prompt(shard, "aaaa", "bench-hop-req")
+      await asyncio.wait_for(done.wait(), timeout=30)
+      after = global_metrics.snapshot()
+      ser = labeled_hist_delta_quantile(before, after, "peer_rpc_serialize_seconds", 0.50)
+      # LEAF hop only: a ring-forwarding SendTensor's client latency includes
+      # the whole awaited downstream generation (span-tree semantics), so its
+      # p50 tracks generation length, not the per-hop wire tax. SendResult
+      # never nests — serialize + wire + deliver is all it is.
+      rpc = labeled_hist_delta_quantile(before, after, "peer_rpc_seconds", 0.50, where={"method": "SendResult"})
+      return (
+        round(ser * 1e3, 3) if ser is not None else None,
+        round(rpc * 1e3, 3) if rpc is not None else None,
+      )
+    finally:
+      await asyncio.gather(*(n.stop() for n in nodes), return_exceptions=True)
+
+  return asyncio.run(run())
+
+
 def plausible_value(rec: dict) -> float | None:
   """Extract the trustworthy headline tok/s from a recorded BENCH_r*.json line.
 
@@ -627,6 +745,18 @@ def main() -> None:
       pp_batched_tok_s = round(Bpp * n_decode / (time.perf_counter() - t0), 2)
       del bcache2
 
+  # Cross-node hop overhead (ISSUE 4): p50 serialize cost and RPC latency
+  # per ring hop from the new per-peer-link histograms, measured over a real
+  # two-node localhost gRPC ring. Gated like the other multichip sections —
+  # null on single-node CPU rounds.
+  hop_serialize_ms_p50 = None
+  hop_rpc_ms_p50 = None
+  if on_accel and len(jax.devices()) >= 2:
+    try:
+      hop_serialize_ms_p50, hop_rpc_ms_p50 = bench_cross_node_hops()
+    except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+      pass
+
   # 8B-geometry int8 decode: the measurable v5e-1 stand-in for BASELINE
   # configs 2/3 (8B-class serving). bf16 8B (~16 GB) exceeds one v5e chip's
   # HBM, so weights are generated AND quantized leaf-by-leaf (the full bf16
@@ -856,6 +986,8 @@ def main() -> None:
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,
         "pp_batched_aggregate_tok_s": pp_batched_tok_s,
+        "hop_serialize_ms_p50": hop_serialize_ms_p50,
+        "hop_rpc_ms_p50": hop_rpc_ms_p50,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "ttft_ms_spread": round(ttft_spread_ms, 2),
         "ttft_vs_prev": ttft_vs_prev,

@@ -147,6 +147,97 @@ async def test_two_node_grpc_pipeline_generation():
 
 
 @pytest.mark.asyncio
+async def test_two_node_cluster_scope_timeline_with_skew():
+  """ISSUE 4 acceptance: a request crosses the real two-node gRPC ring while
+  node1's monotonic clock is synthetically skewed +50 ms; the HealthCheck
+  clock echo estimates the offset (correctly signed), and
+  ``GET /v1/requests/{id}/timeline?scope=cluster`` returns ONE merged
+  timeline whose hop entries carry compute/serialize/wire/deserialize
+  attribution and whose cross-node ordering is monotonic after offset
+  normalization — paired hops land within the RPC window, not 50 ms out."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.orchestration.clocksync import clock_sync
+  from xotorch_support_jetson_tpu.orchestration.tracing import set_test_skew
+
+  SKEW_MS = 50.0
+  nodes = await _make_cluster(2)
+  set_test_skew("node1", int(SKEW_MS * 1e6))
+  client = None
+  try:
+    # Fresh skewed estimates (the convergence loop above may have seeded
+    # pre-skew samples through the periodic clock-sync pass).
+    clock_sync.forget("node0")
+    clock_sync.forget("node1")
+    await nodes[0]._clock_sync_pass()
+    est = clock_sync.estimate("node1")
+    assert est is not None
+    assert SKEW_MS - 10 < est.offset_ns / 1e6 < SKEW_MS + 10  # correctly signed: node1 AHEAD
+
+    shard = build_base_shard("dummy", "DummyInferenceEngine")
+    done = asyncio.Event()
+    nodes[0].on_token.register("tl").on_next(lambda rid, toks, fin: done.set() if fin else None)
+    await nodes[0].process_prompt(shard, "aaaa", "req-cluster-tl")
+    await asyncio.wait_for(done.wait(), timeout=30)
+
+    api = ChatGPTAPI(nodes[0], "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+    client = TestClient(TestServer(api.app))
+    await client.start_server()
+
+    resp = await client.get("/v1/requests/req-cluster-tl/timeline", params={"scope": "cluster"})
+    assert resp.status == 200, await resp.text()
+    tl = await resp.json()
+    assert tl["scope"] == "cluster"
+    assert set(tl["nodes"]) == {"node0", "node1"}
+    assert 40 < tl["offsets"]["node1"]["offset_ms"] < 60
+
+    # Both nodes contributed events, labeled with their node id.
+    event_nodes = {e["node"] for e in tl["events"]}
+    assert {"node0", "node1"} <= event_nodes
+
+    # Both directions of the ring produced PAIRED hops (client + server
+    # sides matched by hop id) with the full attribution split.
+    paired = [h for h in tl["hops"] if h["from"] and h["to"] and h["recv_at_ms"] is not None]
+    assert any(h["from"] == "node0" and h["to"] == "node1" for h in paired)
+    assert any(h["from"] == "node1" and h["to"] == "node0" for h in paired)
+    for h in paired:
+      assert h["serialize_ms"] is not None and h["rpc_ms"] is not None, h
+      assert h["deserialize_ms"] is not None and h["handler_ms"] is not None, h
+      assert h["wire_ms"] is not None and h["compute_ms"] is not None, h
+      assert h["payload_bytes"] and h["payload_bytes"] > 0, h
+      # Monotonic after normalization: the server-side arrival sits inside
+      # the client's RPC window (± the estimate's error bound, itself ≪ the
+      # injected skew). Uncorrected, one ring direction would be ~50 ms out.
+      delta = h["recv_at_ms"] - h["at_ms"]
+      assert -15.0 < delta < SKEW_MS / 2, (h["from"], h["to"], h["method"], delta)
+
+    # The whole-event stream is ordered (merge sorts by normalized time) and
+    # the origin's queued mark comes first.
+    at = [e["at_ms"] for e in tl["events"]]
+    assert at == sorted(at)
+    assert tl["events"][0]["stage"] == "queued" and tl["events"][0]["node"] == "node0"
+
+    # Local scope still serves the single-node view with hop detail.
+    resp = await client.get("/v1/requests/req-cluster-tl/timeline")
+    assert resp.status == 200
+    local_tl = await resp.json()
+    assert local_tl["hops"] and "hop_agg" in local_tl
+
+    # Unknown request: 404 on cluster scope too.
+    resp = await client.get("/v1/requests/nope/timeline", params={"scope": "cluster"})
+    assert resp.status == 404
+  finally:
+    set_test_skew("node1", None)
+    clock_sync.forget("node0")
+    clock_sync.forget("node1")
+    if client is not None:
+      await client.close()
+    for node in nodes:
+      await node.stop()
+
+
+@pytest.mark.asyncio
 async def test_grpc_health_check_and_failure():
   nodes = await _make_cluster(2)
   try:

@@ -29,6 +29,47 @@ def test_traceparent_roundtrip():
   assert parse_traceparent(None) is None
 
 
+def test_parse_traceparent_hardened():
+  """Hardened parsing (ISSUE 4 satellite): any 4-dash-part string used to be
+  accepted — garbage ids were silently adopted as trace identity."""
+  good = f"00-{'a' * 32}-{'b' * 16}-01"
+  assert parse_traceparent(good) is not None
+  # Non-hex trace/span ids.
+  assert parse_traceparent(f"00-{'g' * 32}-{'b' * 16}-01") is None
+  assert parse_traceparent(f"00-{'a' * 32}-{'z' * 16}-01") is None
+  # Uppercase hex is invalid per W3C (ids are lowercase base16).
+  assert parse_traceparent(f"00-{'A' * 32}-{'b' * 16}-01") is None
+  # All-zero ids are explicitly invalid.
+  assert parse_traceparent(f"00-{'0' * 32}-{'b' * 16}-01") is None
+  assert parse_traceparent(f"00-{'a' * 32}-{'0' * 16}-01") is None
+  # Unknown/invalid version fields are rejected, not adopted.
+  assert parse_traceparent(f"ff-{'a' * 32}-{'b' * 16}-01") is None
+  assert parse_traceparent(f"01-{'a' * 32}-{'b' * 16}-01") is None
+  assert parse_traceparent(f"xx-{'a' * 32}-{'b' * 16}-01") is None
+  # Malformed flags / wrong lengths.
+  assert parse_traceparent(f"00-{'a' * 32}-{'b' * 16}-zz") is None
+  assert parse_traceparent(f"00-{'a' * 31}-{'b' * 16}-01") is None
+
+
+def test_tracer_contexts_bounded():
+  """A request cancelled/failed before end_request used to leave its
+  TraceContext in the dict forever; the LRU cap bounds it (ISSUE 4
+  satellite)."""
+  from xotorch_support_jetson_tpu.orchestration import tracing
+
+  t = Tracer()
+  for i in range(tracing.MAX_CONTEXTS + 50):
+    t.request_context(f"leak-{i}")  # never end_request'd
+  assert len(t.contexts) == tracing.MAX_CONTEXTS
+  assert "leak-0" not in t.contexts  # oldest evicted
+  assert f"leak-{tracing.MAX_CONTEXTS + 49}" in t.contexts
+  # Access refreshes recency: touching an old id keeps it past new inserts.
+  t.request_context("leak-100")
+  for i in range(200):
+    t.request_context(f"leak2-{i}")
+  assert "leak-100" in t.contexts
+
+
 def test_span_lifecycle_and_token_groups():
   tracer = Tracer()
   ctx = tracer.request_context("req1")
@@ -197,6 +238,36 @@ def test_weighted_histogram_observation():
   assert merged.hist_count("itl_seconds") == 12
 
 
+def test_labeled_histograms_render_snapshot_merge():
+  """Per-peer-link RPC latency lives in LABELED histogram series
+  (``peer_rpc_seconds{peer,method}``): render carries the label set next to
+  ``le``, snapshot/merge round-trip per series, and label-less queries
+  aggregate the family."""
+  m = Metrics()
+  m.observe_hist("peer_rpc_seconds", 0.02, labels={"peer": "n1", "method": "SendTensor"})
+  m.observe_hist("peer_rpc_seconds", 0.02, labels={"peer": "n1", "method": "SendTensor"})
+  m.observe_hist("peer_rpc_seconds", 0.3, labels={"peer": "n2", "method": "SendResult"})
+  assert m.hist_count("peer_rpc_seconds", labels={"peer": "n1", "method": "SendTensor"}) == 2
+  assert m.hist_count("peer_rpc_seconds") == 3  # label-less: whole family
+  q = m.quantile("peer_rpc_seconds", 0.5)  # aggregate: 2/3 of mass in (0.01, 0.025]
+  assert 0.01 < q <= 0.025
+  assert m.quantile("peer_rpc_seconds", 0.5, labels={"peer": "n2", "method": "SendResult"}) > 0.25
+  text = m.render_prometheus()
+  assert text.count("# TYPE xot_tpu_peer_rpc_seconds histogram") == 1
+  assert 'xot_tpu_peer_rpc_seconds_bucket{method="SendTensor",peer="n1",le="0.025"} 2' in text
+  assert 'xot_tpu_peer_rpc_seconds_bucket{method="SendResult",peer="n2",le="+Inf"} 1' in text
+  assert 'xot_tpu_peer_rpc_seconds_count{method="SendTensor",peer="n1"} 2' in text
+  snaps = [m.snapshot(), m.snapshot()]
+  json.dumps(snaps)  # wire-safe for the opaque-status channel
+  merged = Metrics.merged(snaps)
+  assert merged.hist_count("peer_rpc_seconds", labels={"peer": "n1", "method": "SendTensor"}) == 4
+  assert merged.hist_count("peer_rpc_seconds") == 6
+  # Unlabeled histograms keep their exact prior exposition shape.
+  m2 = Metrics()
+  m2.observe_hist("ttft_seconds", 0.02)
+  assert 'xot_tpu_ttft_seconds_bucket{le="0.025"} 1' in m2.render_prometheus()
+
+
 # -------------------------------------------------- decode-path attribution
 
 
@@ -353,6 +424,171 @@ def test_trace_file_export_buffered_outside_lock(tmp_path, monkeypatch):
   assert not t._export_pending  # everything flushed
 
 
+# ------------------------------------------------- clock-offset estimation
+
+
+def test_offset_sample_symmetric_rtt_exact():
+  """With a symmetric path the NTP midpoint recovers the true offset
+  exactly and rtt excludes server processing time."""
+  from xotorch_support_jetson_tpu.orchestration.clocksync import offset_sample
+
+  true_offset, one_way, proc = 1_450, 50, 100
+  t0 = 1_000
+  t1 = t0 + one_way + true_offset
+  t2 = t1 + proc
+  t3 = t2 - true_offset + one_way
+  off, rtt = offset_sample(t0, t1, t2, t3)
+  assert off == true_offset
+  assert rtt == 2 * one_way
+  # Negative offsets (peer clock BEHIND ours) come out correctly signed.
+  off2, _ = offset_sample(t0, t0 + one_way - 700, t0 + one_way - 700 + proc, t0 + 2 * one_way + proc)
+  assert off2 == -700
+
+
+def test_clock_sync_ewma_convergence_and_uncertainty():
+  from xotorch_support_jetson_tpu.orchestration.clocksync import ClockSync
+
+  cs = ClockSync()
+  true_offset, one_way = 5_000_000, 40_000  # 5 ms skew, 40 µs one-way
+  # First sample seeds the estimate exactly; uncertainty = rtt/2.
+  t0 = 0
+  est = cs.update("peer", t0, t0 + one_way + true_offset, t0 + one_way + true_offset, t0 + 2 * one_way)
+  assert est.offset_ns == true_offset
+  assert est.uncertainty_ns == one_way
+  # Noisy samples (±alternating asymmetry) converge around the true offset.
+  for i in range(60):
+    noise = 25_000 if i % 2 else -25_000
+    t0 = i * 1_000_000
+    t1 = t0 + one_way + noise + true_offset
+    t3 = t0 + 2 * one_way
+    est = cs.update("peer", t0, t1, t1, t3)
+  assert abs(est.offset_ns - true_offset) < 30_000  # within the noise band
+  assert est.samples == 61
+  assert cs.offset_ns("peer") == est.offset_ns
+  assert cs.offset_ns("never-seen") is None
+  assert cs.age_s("peer") is not None and cs.age_s("peer") < 5
+  cs.forget("peer")
+  assert cs.estimate("peer") is None
+
+
+# --------------------------------------------------------------- hop spans
+
+
+def test_record_hop_spans_and_timeline_attribution():
+  t = Tracer()
+  ctx = t.request_context("hop-req")
+  from xotorch_support_jetson_tpu.orchestration.tracing import node_now_ns
+
+  hid = t.record_hop(
+    "hop-req", side="client", method="SendTensor", peer="node-b", node="node-a",
+    t_start_ns=node_now_ns(), dur_ms=1.2,
+    attributes={"serialize_ms": 0.3, "rpc_ms": 0.9, "payload_bytes": 4096, "ok": True},
+  )
+  t.record_hop(
+    "hop-req", side="server", method="SendTensor", peer="ipv4:1.2.3.4", node="node-b",
+    t_start_ns=node_now_ns(), dur_ms=0.6, hop_id=hid,
+    attributes={"deserialize_ms": 0.2, "handler_ms": 0.6, "payload_bytes": 4096},
+  )
+  spans = t.recent_spans()
+  client = next(s for s in spans if s["name"] == "rpc.client.SendTensor")
+  server = next(s for s in spans if s["name"] == "rpc.server.SendTensor")
+  assert client["span_id"] == hid and client["trace_id"] == ctx.trace_id
+  assert server["parent_id"] == hid  # server hop parents to the client hop
+  assert client["attributes"]["serialize_ms"] == 0.3 and client["attributes"]["payload_bytes"] == 4096
+  assert server["attributes"]["handler_ms"] == 0.6
+  tl = t.timeline("hop-req")
+  assert [h["side"] for h in tl["hops"]] == ["client", "server"]
+  assert tl["hops"][0]["hop_id"] == hid and tl["hops"][1]["hop_id"] == hid
+  # Exact per-link aggregates ride alongside the capped detail.
+  agg = tl["hop_agg"]["client|node-a|node-b|SendTensor"]
+  assert agg["count"] == 1 and agg["rpc_ms_sum"] == 0.9 and agg["payload_bytes_sum"] == 4096
+
+
+def test_hop_detail_capped_aggregates_exact():
+  from xotorch_support_jetson_tpu.orchestration import tracing
+
+  t = Tracer()
+  t.request_context("hop-cap")
+  n = tracing.MAX_TIMELINE_HOPS + 20
+  for _ in range(n):
+    t.record_hop(
+      "hop-cap", side="client", method="SendResult", peer="p", node="n",
+      t_start_ns=tracing.node_now_ns(), dur_ms=0.1, attributes={"rpc_ms": 0.1},
+    )
+  tl = t.timeline("hop-cap")
+  assert len(tl["hops"]) == tracing.MAX_TIMELINE_HOPS
+  assert tl["hops_dropped"] == 20
+  assert tl["hop_agg"]["client|n|p|SendResult"]["count"] == n  # exact past the cap
+  # The span RING rides the same cap: per-token hop spans must not cycle the
+  # whole ring and bury request/pp/token-group spans.
+  ring = [s for s in t.recent_spans(n + 50) if s["name"] == "rpc.client.SendResult"]
+  assert len(ring) == tracing.MAX_TIMELINE_HOPS
+
+
+def test_merge_cluster_timeline_offset_normalization():
+  """Known injected skew: node B's clock runs 7 ms ahead. The merge must
+  subtract the estimated offset so B's events/hops land where they really
+  happened in A's clock domain — correctly signed, monotonic order."""
+  from xotorch_support_jetson_tpu.orchestration.tracing import (
+    merge_cluster_timeline, node_now_ns, set_test_skew,
+  )
+
+  set_test_skew("B", 7_000_000)
+  try:
+    t = Tracer()
+    t.request_context("merge-req")
+    t.stage("merge-req", "queued", node="A")
+    hid = t.record_hop(
+      "merge-req", side="client", method="SendTensor", peer="B", node="A",
+      t_start_ns=node_now_ns("A"), dur_ms=1.0,
+      attributes={"serialize_ms": 0.3, "rpc_ms": 0.7, "payload_bytes": 128},
+    )
+    t.record_hop(
+      "merge-req", side="server", method="SendTensor", peer="ipv4:x", node="B",
+      t_start_ns=node_now_ns("B"), dur_ms=0.5, hop_id=hid,
+      attributes={"deserialize_ms": 0.1, "handler_ms": 0.5, "payload_bytes": 128},
+    )
+    t.stage("merge-req", "decode", node="B")
+    t.end_request("merge-req")
+    exp = t.timeline_export("merge-req")
+
+    # WITHOUT the offset, B's entries sit ~7 ms in the future.
+    raw = merge_cluster_timeline("A", exp, [{"node_id": "B", "fragment": exp}], {})
+    raw_hop = raw["hops"][0]
+    assert raw_hop["recv_at_ms"] - raw_hop["at_ms"] > 5.0
+
+    # WITH the (exactly-known) offset the order is restored: send < recv
+    # within sub-ms slop, and B's decode follows A's queued by wall time.
+    merged = merge_cluster_timeline("A", exp, [{"node_id": "B", "fragment": exp}], {"B": {"offset_ns": 7_000_000}})
+    assert merged["nodes"] == ["A", "B"]
+    hop = merged["hops"][0]
+    assert hop["from"] == "A" and hop["to"] == "B" and hop["method"] == "SendTensor"
+    # Hop attribution splits: serialize / wire / deserialize / compute.
+    assert hop["serialize_ms"] == 0.3
+    assert hop["deserialize_ms"] == 0.1
+    assert hop["wire_ms"] == pytest.approx(0.7 - 0.5)
+    assert hop["compute_ms"] == pytest.approx(0.5 - 0.1)
+    assert abs(hop["recv_at_ms"] - hop["at_ms"]) < 2.0  # the 7 ms skew is gone
+    order = [(e["node"], e["stage"]) for e in merged["events"]]
+    assert order == [("A", "queued"), ("B", "decode")]
+    # Shared-tracer fragments (both "nodes" exported the same object) do
+    # not duplicate events, hops, or aggregate sums.
+    assert len(merged["events"]) == 2 and len(merged["hops"]) == 1
+    assert merged["hop_agg"]["client|A|B|SendTensor"]["count"] == 1
+    # Per-node stage rollups are present for both nodes.
+    assert set(merged["stages"]) == {"A", "B"}
+    # t=0 is the earliest normalized event anywhere; nothing goes negative.
+    assert merged["events"][0]["at_ms"] == 0.0
+    assert all(e["at_ms"] >= 0 for e in merged["events"])
+    # Off-origin merge (no local fragment — e.g. the query landed on a node
+    # that only saw the tail of the request): same guarantee.
+    remote_only = merge_cluster_timeline("C", None, [{"node_id": "B", "fragment": exp}], {"B": {"offset_ns": 7_000_000}})
+    assert min(e["at_ms"] for e in remote_only["events"]) == 0.0
+    assert remote_only["total_ms"] >= 0
+  finally:
+    set_test_skew("B", None)
+
+
 # ----------------------------------------------------------- timelines
 
 
@@ -439,6 +675,9 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_grpc_rpcs_total",
   "xot_tpu_grpc_rpc_failures_total",
   "xot_tpu_peer_broadcast_failures_total",
+  "xot_tpu_peer_rpc_bytes_sent_total",
+  "xot_tpu_peer_rpc_bytes_received_total",
+  "xot_tpu_peer_rpc_failures_total",
   # gauges
   "xot_tpu_scheduler_batch_occupancy",
   "xot_tpu_scheduler_queue_depth",
@@ -450,6 +689,8 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_page_pool_pages_cached",
   "xot_tpu_page_pool_utilization",
   "xot_tpu_engine_sessions",
+  "xot_tpu_peer_clock_offset_ms",
+  "xot_tpu_peer_clock_uncertainty_ms",
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -459,6 +700,11 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_sched_host_gap_seconds",
   "xot_tpu_prefill_seconds",
   "xot_tpu_decode_step_seconds",
+  # per-peer-link RPC attribution (ISSUE 4; labeled {peer,method} / {method})
+  "xot_tpu_peer_rpc_seconds",
+  "xot_tpu_peer_rpc_serialize_seconds",
+  "xot_tpu_grpc_handler_seconds",
+  "xot_tpu_grpc_deserialize_seconds",
 }
 
 
@@ -494,6 +740,16 @@ def test_metric_name_snapshot_after_serving():
   gm.observe_hist("prefill_seconds", 0.0)
   gm.observe_hist("decode_step_seconds", 0.0)
   gm.set_gauge("engine_sessions", 0)
+  link = {"peer": "peer-0", "method": "SendTensor"}
+  gm.inc("peer_rpc_bytes_sent_total", 0, labels=link)
+  gm.inc("peer_rpc_bytes_received_total", 0, labels=link)
+  gm.inc("peer_rpc_failures_total", 0, labels=link)
+  gm.observe_hist("peer_rpc_seconds", 0.0, labels=link)
+  gm.observe_hist("peer_rpc_serialize_seconds", 0.0, labels={"method": "SendTensor"})
+  gm.observe_hist("grpc_handler_seconds", 0.0, labels={"method": "SendTensor"})
+  gm.observe_hist("grpc_deserialize_seconds", 0.0, labels={"method": "SendTensor"})
+  gm.set_gauge("peer_clock_offset_ms", 0.0, labels={"peer": "peer-0"})
+  gm.set_gauge("peer_clock_uncertainty_ms", 0.0, labels={"peer": "peer-0"})
   text = gm.render_prometheus()
   families = set(re.findall(r"# TYPE (xot_tpu_[a-z0-9_]+) \w+", text))
   missing = EXPECTED_METRIC_NAMES - families
@@ -604,6 +860,34 @@ async def test_timeline_endpoint_and_metrics_scope():
     cluster_text = await resp.text()
     assert "xot_tpu_cluster_nodes_reporting 1" in cluster_text
     assert "xot_tpu_requests_total" in cluster_text
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_traces_endpoint_query_hardening():
+  """GET /v1/traces (ISSUE 4 satellite): non-integer n → 400 (used to crash
+  the handler into a 500); huge n clamps to the ring-buffer capacity."""
+  node, api, client = await _dummy_api()
+  try:
+    resp = await client.get("/v1/traces")
+    assert resp.status == 200
+    assert "spans" in await resp.json()
+
+    for bad in ("abc", "1.5", ""):
+      resp = await client.get("/v1/traces", params={"n": bad})
+      assert resp.status == 400, (bad, await resp.text())
+
+    resp = await client.get("/v1/traces", params={"n": "-3"})
+    assert resp.status == 400
+
+    from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+    resp = await client.get("/v1/traces", params={"n": str(10**9)})
+    assert resp.status == 200
+    spans = (await resp.json())["spans"]
+    assert len(spans) <= tracer.spans.maxlen
   finally:
     await client.close()
     await node.stop()

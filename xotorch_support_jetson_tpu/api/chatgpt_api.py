@@ -355,10 +355,30 @@ class ChatGPTAPI:
   async def handle_request_timeline(self, request):
     """GET /v1/requests/{id}/timeline — the request's stage breakdown
     (queued → admitted → prefill chunks → decode → detokenize) from the
-    tracer's bounded timeline LRU. 404 once the entry has aged out."""
+    tracer's bounded timeline LRU. 404 once the entry has aged out.
+
+    ``?scope=cluster`` (ISSUE 4): pull every peer's timeline fragment over
+    the gRPC opaque-status channel, normalize remote timestamps with the
+    NTP-style per-peer clock offsets, and merge into ONE hop-annotated
+    timeline — each hop split into serialize / wire / deserialize / compute,
+    so "which hop — compute, serialization, or wire?" is answerable for a
+    request that crossed the ring."""
     from ..orchestration.tracing import tracer
 
     request_id = request.match_info.get("request_id", "")
+    if request.query.get("scope") == "cluster":
+      fragments = []
+      try:
+        fragments = await self.node.collect_cluster_timeline(request_id)
+      except Exception:  # noqa: BLE001 — cluster pull degrades to local-only
+        if DEBUG >= 1:
+          import traceback
+
+          traceback.print_exc()
+      merged = self.node.merged_cluster_timeline(request_id, fragments)
+      if merged is None:
+        return web.json_response({"detail": f"no timeline for request {request_id}"}, status=404)
+      return web.json_response(merged)
     tl = tracer.timeline(request_id)
     if tl is None:
       return web.json_response({"detail": f"no timeline for request {request_id}"}, status=404)
@@ -443,9 +463,19 @@ class ChatGPTAPI:
     })
 
   async def handle_traces(self, request):
+    """GET /v1/traces?n=N — recent spans. Hardened (ISSUE 4 satellite): a
+    non-integer ``n`` is a 400, not a handler crash, and ``n`` clamps to the
+    span ring-buffer capacity (asking for a million spans returns the whole
+    buffer, it doesn't allocate for the ask)."""
     from ..orchestration.tracing import tracer
 
-    n = int(request.query.get("n", "100"))
+    try:
+      n = int(request.query.get("n", "100"))
+    except (TypeError, ValueError):
+      return web.json_response({"error": "'n' must be an integer"}, status=400)
+    if n < 0:
+      return web.json_response({"error": "'n' must be >= 0"}, status=400)
+    n = min(n, tracer.spans.maxlen or n)
     return web.json_response({"spans": tracer.recent_spans(n)})
 
   async def handle_quit(self, request):

@@ -4,25 +4,39 @@ Parity with reference ``networking/grpc/grpc_peer_handle.py`` (lazy connect
 w/ timeout :78-85, gzip compression :64, health check :87-100, tensor ser/de
 :117-136, example/loss :138-178). RPCs are built with ``channel.unary_unary``
 against the same method paths the server registers — no generated stubs.
+
+ISSUE 4 additions: every data-plane RPC (SendPrompt/SendTensor/SendResult)
+carries the W3C ``traceparent`` in gRPC metadata and records a client-side
+hop — serialize time, payload bytes, RPC latency — as a span + timeline hop
+entry (orchestration/tracing.py ``record_hop``) and into the per-peer-link
+metric families (``peer_rpc_seconds{peer,method}``, bytes out/in, failures).
+``health_check`` piggybacks a four-timestamp monotonic-clock echo (metadata
+``x-clock-*``) that feeds the NTP-style per-peer offset estimator
+(orchestration/clocksync.py) — the basis for normalizing remote timeline
+fragments into the local clock domain.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
+import time
 
 import grpc
 import numpy as np
 
 from ...inference.shard import Shard
 from ...inference.state import InferenceState
+from ...orchestration.clocksync import clock_sync
+from ...orchestration.tracing import format_traceparent, new_span_id, node_now_ns, tracer
 from ...topology.device_capabilities import DeviceCapabilities
 from ...topology.topology import Topology
 from ...utils.helpers import DEBUG
+from ...utils.metrics import metrics
 from ..peer_handle import PeerHandle
 from . import node_service_pb2 as pb
 from .grpc_server import CHANNEL_OPTIONS, SERVICE_NAME
 from .serialization import (
+  proto_payload_bytes,
   proto_to_tensor,
   proto_to_topology,
   shard_to_proto,
@@ -102,7 +116,20 @@ class GRPCPeerHandle(PeerHandle):
   async def health_check(self) -> bool:
     try:
       await self._ensure_connected()
-      response = await asyncio.wait_for(self._rpcs["HealthCheck"](pb.HealthCheckRequest()), timeout=HEALTH_TIMEOUT)
+      # Four-timestamp NTP echo piggybacked on the health RPC: t0/t3 are
+      # this node's monotonic clock around the call; the server answers with
+      # its own receive/send times (t1/t2) in trailing metadata. One sample
+      # per health check keeps the per-peer offset estimate fresh for free.
+      t0 = node_now_ns(self.origin_id)
+      call = self._rpcs["HealthCheck"](pb.HealthCheckRequest(), metadata=(("x-clock-t0", str(t0)),))
+      response = await asyncio.wait_for(call, timeout=HEALTH_TIMEOUT)
+      t3 = node_now_ns(self.origin_id)
+      try:
+        trailing = {k: v for k, v in (await call.trailing_metadata() or ())}
+        t1, t2 = int(trailing["x-clock-t1"]), int(trailing["x-clock-t2"])
+        clock_sync.update(self._id, t0, t1, t2, t3)
+      except (KeyError, ValueError, TypeError):
+        pass  # older peer without the echo: health result still stands
       return response.is_healthy
     except Exception:  # noqa: BLE001 — any failure means unhealthy
       if DEBUG >= 4:
@@ -113,28 +140,91 @@ class GRPCPeerHandle(PeerHandle):
 
   # -------------------------------------------------------------- data plane
 
+  async def _traced_call(self, method: str, request, request_id: str, serialize_s: float, t_start_ns: int | None = None, timeout: float | None = None):
+    """Run one data-plane RPC with hop telemetry: traceparent metadata out,
+    client-side span + timeline hop entry + per-peer-link metrics in. The
+    hop's span id rides the traceparent's parent-id field so the server's
+    hop entry parents to (and the cluster merge pairs with) this one.
+    ``t_start_ns`` is the caller's clock read from BEFORE it built the
+    request proto, so the hop window [start, start + serialize + rpc] ends
+    when the RPC actually completed."""
+    hop_id = new_span_id()
+    ids = tracer.trace_ids(request_id) if request_id else None
+    metadata = []
+    if ids is not None:
+      metadata.append(("traceparent", format_traceparent(ids[0], hop_id)))
+    if self.origin_id:
+      # Lets the server label its hop/aggregates with the sender's NODE id
+      # (context.peer() is an ephemeral transport address — useless for
+      # joining against the client side's per-link keys).
+      metadata.append(("x-origin-node", self.origin_id))
+    metadata = tuple(metadata) or None
+    bytes_out = proto_payload_bytes(request)
+    labels = {"peer": self._id, "method": method}
+    t_start = t_start_ns if t_start_ns is not None else node_now_ns(self.origin_id)
+    t0 = time.perf_counter()
+    ok = False
+    try:
+      call = self._rpcs[method](request, metadata=metadata)
+      response = await (asyncio.wait_for(call, timeout=timeout) if timeout is not None else call)
+      ok = True
+      return response
+    finally:
+      rpc_s = time.perf_counter() - t0
+      metrics.observe_hist("peer_rpc_seconds", rpc_s, labels=labels)
+      metrics.observe_hist("peer_rpc_serialize_seconds", serialize_s, labels={"method": method})
+      metrics.inc("peer_rpc_bytes_sent_total", bytes_out, labels=labels)
+      if ok:
+        metrics.inc("peer_rpc_bytes_received_total", proto_payload_bytes(response), labels=labels)
+      else:
+        metrics.inc("peer_rpc_failures_total", labels=labels)
+      if request_id:
+        tracer.record_hop(
+          request_id,
+          side="client",
+          method=method,
+          peer=self._id,
+          node=self.origin_id,
+          t_start_ns=t_start,
+          dur_ms=(serialize_s + rpc_s) * 1e3,
+          hop_id=hop_id,
+          trace_id=ids[0] if ids else None,
+          attributes={
+            "serialize_ms": round(serialize_s * 1e3, 3),
+            "rpc_ms": round(rpc_s * 1e3, 3),
+            "payload_bytes": bytes_out,
+            "ok": ok,
+          },
+        )
+
   async def send_prompt(self, shard: Shard, prompt: str, request_id: str, inference_state: InferenceState | None = None) -> None:
     await self._ensure_connected()
+    t_start = node_now_ns(self.origin_id)
+    t_ser = time.perf_counter()
     request = pb.PromptRequest(
       shard=shard_to_proto(shard),
       prompt=prompt,
       request_id=request_id,
       inference_state=state_to_proto(inference_state),
     )
-    await self._rpcs["SendPrompt"](request)
+    await self._traced_call("SendPrompt", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
 
   async def send_tensor(self, shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None) -> None:
     await self._ensure_connected()
+    t_start = node_now_ns(self.origin_id)
+    t_ser = time.perf_counter()
     request = pb.TensorRequest(
       shard=shard_to_proto(shard),
       tensor=tensor_to_proto(tensor),
       request_id=request_id,
       inference_state=state_to_proto(inference_state),
     )
-    await self._rpcs["SendTensor"](request)
+    await self._traced_call("SendTensor", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
 
   async def send_example(self, shard: Shard, example: np.ndarray, target: np.ndarray, length: np.ndarray, train: bool, request_id: str) -> tuple[float, np.ndarray | None]:
     await self._ensure_connected()
+    t_start = node_now_ns(self.origin_id)
+    t_ser = time.perf_counter()
     request = pb.ExampleRequest(
       shard=shard_to_proto(shard),
       example=tensor_to_proto(example),
@@ -143,7 +233,7 @@ class GRPCPeerHandle(PeerHandle):
       train=train,
       request_id=request_id,
     )
-    response = await self._rpcs["SendExample"](request)
+    response = await self._traced_call("SendExample", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
     grads = proto_to_tensor(response.grads) if response.HasField("grads") else None
     return response.loss, grads
 
@@ -153,6 +243,8 @@ class GRPCPeerHandle(PeerHandle):
 
   async def send_result(self, request_id: str, result, is_finished: bool, start_pos: int | None = None) -> None:
     await self._ensure_connected()
+    t_start = node_now_ns(self.origin_id)
+    t_ser = time.perf_counter()
     request = pb.SendResultRequest(request_id=request_id, is_finished=is_finished)
     if start_pos is not None:
       request.start_pos = int(start_pos)
@@ -160,11 +252,24 @@ class GRPCPeerHandle(PeerHandle):
       request.tensor.CopyFrom(tensor_to_proto(result))
     else:
       request.result.extend(int(r) for r in result)
-    await asyncio.wait_for(self._rpcs["SendResult"](request), timeout=15.0)
+    await self._traced_call("SendResult", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start, timeout=15.0)
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
     await self._ensure_connected()
-    await asyncio.wait_for(self._rpcs["SendOpaqueStatus"](pb.SendOpaqueStatusRequest(request_id=request_id, status=status)), timeout=15.0)
+    # Metrics-only telemetry (no timeline hop: status broadcasts are the
+    # control plane — metrics/timeline pulls ride THIS channel, and tracing
+    # them into timelines would recurse a pull into the thing it measures).
+    request = pb.SendOpaqueStatusRequest(request_id=request_id, status=status)
+    labels = {"peer": self._id, "method": "SendOpaqueStatus"}
+    t0 = time.perf_counter()
+    try:
+      await asyncio.wait_for(self._rpcs["SendOpaqueStatus"](request), timeout=15.0)
+    except BaseException:
+      metrics.inc("peer_rpc_failures_total", labels=labels)
+      raise
+    finally:
+      metrics.observe_hist("peer_rpc_seconds", time.perf_counter() - t0, labels=labels)
+      metrics.inc("peer_rpc_bytes_sent_total", proto_payload_bytes(request), labels=labels)
 
   async def collect_topology(self, visited: set[str], max_depth: int) -> Topology:
     await self._ensure_connected()

@@ -4,18 +4,30 @@ Parity with reference ``networking/grpc/grpc_server.py`` (channel options
 :29-46, RPC handlers :62-156). Methods are registered through
 ``grpc.method_handlers_generic_handler`` — functionally identical to
 protoc-generated servicers, without the grpcio-tools build dependency.
+
+ISSUE 4 additions: data-plane handlers (SendPrompt/SendTensor/SendResult)
+read the W3C ``traceparent`` from invocation metadata, join the originating
+trace, and record a server-side hop — deserialize time, handler time,
+payload bytes — parented to the client's hop span (the traceparent's
+parent-id field IS the client hop span id). Handler/deserialize latency
+also lands in ``grpc_handler_seconds{method}`` / ``grpc_deserialize_seconds
+{method}``. ``HealthCheck`` answers the clock echo: the client's ``x-clock
+-t0`` is bounced back with this node's monotonic receive/send times in
+trailing metadata (``x-clock-t1``/``-t2``) for NTP-style offset estimation.
 """
 
 from __future__ import annotations
 
-import json
+import time
 from concurrent import futures
 
 import grpc
 
+from ...orchestration.tracing import node_now_ns, parse_traceparent, tracer
 from ...utils.helpers import DEBUG
 from . import node_service_pb2 as pb
 from .serialization import (
+  proto_payload_bytes,
   proto_to_shard,
   proto_to_state,
   proto_to_tensor,
@@ -39,6 +51,16 @@ CHANNEL_OPTIONS = [
   ("grpc.tcp_nodelay", 1),
   ("grpc.optimization_target", "throughput"),
 ]
+
+
+def _meta_get(context, key: str) -> str | None:
+  try:
+    for k, v in context.invocation_metadata() or ():
+      if k == key:
+        return v
+  except Exception:  # noqa: BLE001 — metadata access must never break an RPC
+    pass
+  return None
 
 
 class GRPCServer:
@@ -70,15 +92,18 @@ class GRPCServer:
       method = fn.__name__
 
       async def counted(request, context):
-        # Cluster data-plane visibility: per-method RPC counts (and failures)
-        # feed the same registry /metrics serves — a ring's forwarding load
-        # is observable without packet captures.
+        # Cluster data-plane visibility: per-method RPC counts, failures,
+        # and handler latency feed the same registry /metrics serves — a
+        # ring's forwarding load is observable without packet captures.
         metrics.inc("grpc_rpcs_total", labels={"method": method})
+        t0 = time.perf_counter()
         try:
           return await fn(request, context)
         except BaseException:
           metrics.inc("grpc_rpc_failures_total", labels={"method": method})
           raise
+        finally:
+          metrics.observe_hist("grpc_handler_seconds", time.perf_counter() - t0, labels={"method": method})
 
       return grpc.unary_unary_rpc_method_handler(counted, request_deserializer=req_cls.FromString, response_serializer=resp_cls.SerializeToString)
 
@@ -94,19 +119,81 @@ class GRPCServer:
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
+  # ----------------------------------------------------------- hop recording
+
+  def _join_trace(self, request_id: str, context) -> str | None:
+    """Adopt the client's traceparent for this request (W3C propagation over
+    gRPC metadata, not just the opaque-status JSON) and return the client's
+    hop span id for parenting the server-side hop."""
+    header = _meta_get(context, "traceparent")
+    parsed = parse_traceparent(header)
+    if parsed and request_id:
+      tracer.request_context(request_id, header)
+    return parsed[1] if parsed else None
+
+  def _record_server_hop(self, request_id: str, method: str, context, *, t_start_ns: int, hop_id: str | None, deserialize_s: float, handler_s: float, payload_bytes: int) -> None:
+    from ...utils.metrics import metrics
+
+    metrics.observe_hist("grpc_deserialize_seconds", deserialize_s, labels={"method": method})
+    if not request_id:
+      return
+    ids = tracer.trace_ids(request_id)
+    # Sender's NODE id (x-origin-node metadata) when available: that's the
+    # key dashboards join against the client side's per-link aggregates;
+    # the ephemeral transport address is only the fallback.
+    peer = _meta_get(context, "x-origin-node") or (context.peer() if hasattr(context, "peer") else "")
+    tracer.record_hop(
+      request_id,
+      side="server",
+      method=method,
+      peer=peer,
+      node=self.node.id,
+      t_start_ns=t_start_ns,
+      dur_ms=handler_s * 1e3,
+      hop_id=hop_id,
+      trace_id=ids[0] if ids else None,
+      attributes={
+        "deserialize_ms": round(deserialize_s * 1e3, 3),
+        "handler_ms": round(handler_s * 1e3, 3),
+        "payload_bytes": payload_bytes,
+      },
+    )
+
   # ------------------------------------------------------------ RPC methods
 
   async def SendPrompt(self, request: pb.PromptRequest, context) -> pb.Tensor:
+    t_arrive = node_now_ns(self.node.id)
+    t0 = time.perf_counter()
+    hop_id = self._join_trace(request.request_id, context)
+    t_des = time.perf_counter()
     shard = proto_to_shard(request.shard)
     state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
-    result = await self.node.process_prompt(shard, request.prompt, request.request_id, state, wire_concrete=True)
+    des_s = time.perf_counter() - t_des
+    try:
+      result = await self.node.process_prompt(shard, request.prompt, request.request_id, state, wire_concrete=True)
+    finally:
+      self._record_server_hop(
+        request.request_id, "SendPrompt", context, t_start_ns=t_arrive, hop_id=hop_id,
+        deserialize_s=des_s, handler_s=time.perf_counter() - t0, payload_bytes=proto_payload_bytes(request),
+      )
     return tensor_to_proto(result)
 
   async def SendTensor(self, request: pb.TensorRequest, context) -> pb.Tensor:
+    t_arrive = node_now_ns(self.node.id)
+    t0 = time.perf_counter()
+    hop_id = self._join_trace(request.request_id, context)
+    t_des = time.perf_counter()
     shard = proto_to_shard(request.shard)
     tensor = proto_to_tensor(request.tensor)
     state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
-    result = await self.node.process_tensor(shard, tensor, request.request_id, state, wire_concrete=True)
+    des_s = time.perf_counter() - t_des
+    try:
+      result = await self.node.process_tensor(shard, tensor, request.request_id, state, wire_concrete=True)
+    finally:
+      self._record_server_hop(
+        request.request_id, "SendTensor", context, t_start_ns=t_arrive, hop_id=hop_id,
+        deserialize_s=des_s, handler_s=time.perf_counter() - t0, payload_bytes=proto_payload_bytes(request),
+      )
     return tensor_to_proto(result)
 
   async def SendExample(self, request: pb.ExampleRequest, context) -> pb.Loss:
@@ -130,12 +217,23 @@ class GRPCServer:
     return topology_to_proto(self.node.current_topology)
 
   async def SendResult(self, request: pb.SendResultRequest, context) -> pb.Empty:
+    t_arrive = node_now_ns(self.node.id)
+    t0 = time.perf_counter()
+    hop_id = self._join_trace(request.request_id, context)
+    t_des = time.perf_counter()
     tensor = proto_to_tensor(request.tensor) if request.HasField("tensor") else None
     result = tensor if tensor is not None else list(request.result)
+    des_s = time.perf_counter() - t_des
     # Through the node's dedup choke point: deliveries below the request's
     # high-water mark (a replayed span after failover) are dropped.
     start_pos = request.start_pos if request.HasField("start_pos") else None
-    self.node.handle_remote_result(request.request_id, result, request.is_finished, start_pos=start_pos)
+    try:
+      self.node.handle_remote_result(request.request_id, result, request.is_finished, start_pos=start_pos)
+    finally:
+      self._record_server_hop(
+        request.request_id, "SendResult", context, t_start_ns=t_arrive, hop_id=hop_id,
+        deserialize_s=des_s, handler_s=time.perf_counter() - t0, payload_bytes=proto_payload_bytes(request),
+      )
     return pb.Empty()
 
   async def SendOpaqueStatus(self, request: pb.SendOpaqueStatusRequest, context) -> pb.Empty:
@@ -143,4 +241,15 @@ class GRPCServer:
     return pb.Empty()
 
   async def HealthCheck(self, request: pb.HealthCheckRequest, context) -> pb.HealthCheckResponse:
+    # Clock echo for NTP-style offset estimation (clocksync.py): only when
+    # the caller sent its t0 — a bare health probe stays a bare probe.
+    if _meta_get(context, "x-clock-t0") is not None:
+      t1 = node_now_ns(self.node.id)
+      try:
+        context.set_trailing_metadata((
+          ("x-clock-t1", str(t1)),
+          ("x-clock-t2", str(node_now_ns(self.node.id))),
+        ))
+      except Exception:  # noqa: BLE001 — echo is best-effort
+        pass
     return pb.HealthCheckResponse(is_healthy=True)

@@ -20,6 +20,18 @@ from ...topology.topology import Topology
 from . import node_service_pb2 as pb
 
 
+def proto_payload_bytes(msg) -> int:
+  """Serialized size of a protobuf message — the wire-payload number the
+  per-hop telemetry records (``peer_rpc_bytes_*_total``, hop attributes).
+  ``ByteSize()`` is the pre-compression HTTP/2 DATA size; protobuf caches it
+  after the first call, so both the client (before send) and the server
+  (after deserialize) read it for free."""
+  try:
+    return int(msg.ByteSize())
+  except Exception:  # noqa: BLE001 — telemetry must never break the data plane
+    return 0
+
+
 def _np_dtype(name: str):
   if name == "bfloat16":
     import ml_dtypes

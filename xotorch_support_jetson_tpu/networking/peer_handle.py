@@ -18,6 +18,15 @@ from ..topology.topology import Topology
 
 
 class PeerHandle(ABC):
+  # The node id on whose behalf this handle sends (stamped by
+  # Node.update_peers). Discovery constructs handles without knowing the
+  # owning node, so this is a post-construction attribute; hop telemetry
+  # labels client-side spans with it and tolerates None.
+  origin_id: str | None = None
+
+  def set_origin(self, node_id: str) -> None:
+    self.origin_id = node_id
+
   @abstractmethod
   def id(self) -> str:
     ...

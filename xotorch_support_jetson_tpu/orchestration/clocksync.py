@@ -1,0 +1,120 @@
+"""NTP-style per-peer clock-offset estimation over the HealthCheck echo.
+
+Every timestamp the tracer records is node-local ``time.perf_counter_ns()``
+— a monotonic clock with an arbitrary per-process epoch, so spans from two
+nodes in the same trace are incomparable until the offset between the two
+clocks is known. The existing periodic ``HealthCheck`` RPC piggybacks a
+four-timestamp echo (client send t0, server receive t1, server send t2,
+client receive t3, all in the respective node's monotonic ns) and this
+module turns each echo into the classic NTP sample:
+
+    offset = ((t1 - t0) + (t2 - t3)) / 2      # peer_clock - local_clock
+    rtt    = (t3 - t0) - (t2 - t1)
+    uncertainty = rtt / 2                      # worst-case asymmetry bound
+
+Samples are EWMA-smoothed per peer (``XOT_TPU_CLOCK_EWMA_ALPHA``, default
+0.2) so one congested round trip doesn't yank the estimate; the smoothed
+uncertainty is reported alongside so consumers (the cluster-timeline merge)
+can tell a ±50 µs LAN estimate from a ±30 ms WAN one. Estimates feed the
+``xot_tpu_peer_clock_offset_ms`` / ``xot_tpu_peer_clock_uncertainty_ms``
+gauges (labeled ``{peer=...}``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+
+def ewma_alpha() -> float:
+  try:
+    return min(max(float(os.getenv("XOT_TPU_CLOCK_EWMA_ALPHA", "0.2")), 0.01), 1.0)
+  except ValueError:
+    return 0.2
+
+
+@dataclass
+class PeerClockEstimate:
+  """Smoothed offset of one peer's monotonic clock relative to ours."""
+
+  offset_ns: float  # peer_clock - local_clock (add to local to get peer time)
+  uncertainty_ns: float  # EWMA of rtt/2 — the asymmetric-path error bound
+  rtt_ns: float  # last sample's round-trip time
+  samples: int
+  updated_at: float  # local time.monotonic() of the last sample
+
+  def to_dict(self) -> dict:
+    return {
+      "offset_ms": round(self.offset_ns / 1e6, 6),
+      "uncertainty_ms": round(self.uncertainty_ns / 1e6, 6),
+      "rtt_ms": round(self.rtt_ns / 1e6, 6),
+      "samples": self.samples,
+    }
+
+
+def offset_sample(t0: int, t1: int, t2: int, t3: int) -> tuple[float, float]:
+  """One NTP sample from a four-timestamp echo → (offset_ns, rtt_ns).
+
+  With a symmetric path the midpoint estimate is exact; asymmetry is bounded
+  by rtt/2, which is what ``PeerClockEstimate.uncertainty_ns`` tracks."""
+  offset = ((t1 - t0) + (t2 - t3)) / 2.0
+  rtt = (t3 - t0) - (t2 - t1)
+  return offset, max(float(rtt), 0.0)
+
+
+class ClockSync:
+  def __init__(self) -> None:
+    self._lock = threading.Lock()
+    self._estimates: dict[str, PeerClockEstimate] = {}
+
+  def update(self, peer_id: str, t0: int, t1: int, t2: int, t3: int) -> PeerClockEstimate:
+    """Fold one HealthCheck echo into the peer's EWMA estimate."""
+    offset, rtt = offset_sample(t0, t1, t2, t3)
+    alpha = ewma_alpha()
+    with self._lock:
+      est = self._estimates.get(peer_id)
+      if est is None:
+        est = PeerClockEstimate(offset_ns=offset, uncertainty_ns=rtt / 2.0, rtt_ns=rtt, samples=1, updated_at=time.monotonic())
+      else:
+        est = PeerClockEstimate(
+          offset_ns=est.offset_ns + alpha * (offset - est.offset_ns),
+          uncertainty_ns=est.uncertainty_ns + alpha * (rtt / 2.0 - est.uncertainty_ns),
+          rtt_ns=rtt,
+          samples=est.samples + 1,
+          updated_at=time.monotonic(),
+        )
+      self._estimates[peer_id] = est
+    try:  # gauge export is best-effort; never let metrics break the data plane
+      from ..utils.metrics import metrics
+
+      metrics.set_gauge("peer_clock_offset_ms", est.offset_ns / 1e6, labels={"peer": peer_id})
+      metrics.set_gauge("peer_clock_uncertainty_ms", est.uncertainty_ns / 1e6, labels={"peer": peer_id})
+    except Exception:  # noqa: BLE001
+      pass
+    return est
+
+  def estimate(self, peer_id: str) -> PeerClockEstimate | None:
+    with self._lock:
+      return self._estimates.get(peer_id)
+
+  def offset_ns(self, peer_id: str) -> float | None:
+    est = self.estimate(peer_id)
+    return est.offset_ns if est is not None else None
+
+  def age_s(self, peer_id: str) -> float | None:
+    """Seconds since the peer's last sample, or None if never sampled."""
+    est = self.estimate(peer_id)
+    return time.monotonic() - est.updated_at if est is not None else None
+
+  def offsets(self) -> dict[str, PeerClockEstimate]:
+    with self._lock:
+      return dict(self._estimates)
+
+  def forget(self, peer_id: str) -> None:
+    with self._lock:
+      self._estimates.pop(peer_id, None)
+
+
+clock_sync = ClockSync()

@@ -37,7 +37,8 @@ from ..topology.topology import Topology
 from ..utils.helpers import DEBUG, AsyncCallbackSystem
 from ..utils.metrics import metrics
 from .. import registry
-from .tracing import tracer
+from .clocksync import clock_sync
+from .tracing import merge_cluster_timeline, tracer
 
 
 # How long per-request bookkeeping (cancel flags, dedup tombstones) outlives
@@ -115,6 +116,8 @@ class Node:
     self._ttft_observed: set[str] = set()
     # Cluster metrics pulls in flight: nonce -> [event, snapshots, expected].
     self._metrics_waiters: dict[str, list] = {}
+    # Cluster timeline pulls in flight: nonce -> [event, fragments, expected].
+    self._timeline_waiters: dict[str, list] = {}
 
     self._on_token: AsyncCallbackSystem[str, str, list, bool] = AsyncCallbackSystem()
     self._on_opaque_status: AsyncCallbackSystem[str, str, str] = AsyncCallbackSystem()
@@ -207,7 +210,7 @@ class Node:
     ctx = tracer.request_context(request_id)
     metrics.inc("requests_total")
     self._request_t0.setdefault(request_id, time.perf_counter())
-    tracer.stage(request_id, "queued", {"node_id": self.id})
+    tracer.stage(request_id, "queued", {"node_id": self.id}, node=self.id)
     asyncio.create_task(
       self.broadcast_opaque_status(
         request_id,
@@ -341,8 +344,8 @@ class Node:
       # is weight-bandwidth-bound, so B in-flight requests cost ≈ 1.
       return await self._batched_serve(base_shard, shard, prompt, request_id)
     self.outstanding_requests[request_id] = "processing"
-    tracer.stage(request_id, "admitted", {"node_id": self.id})
-    tracer.stage(request_id, "prefill_chunk", {"node_id": self.id})
+    tracer.stage(request_id, "admitted", {"node_id": self.id}, node=self.id)
+    tracer.stage(request_id, "prefill_chunk", {"node_id": self.id}, node=self.id)
     output, state = await self.inference_engine.infer_prompt(request_id, shard, prompt, inference_state)
     await self.process_inference_result(base_shard, output, request_id, state, shard=shard)
     return output
@@ -427,7 +430,7 @@ class Node:
         # (trigger_on_token_callbacks) so it also fires on the ORIGIN node of
         # a multi-node ring, where sampling happens on a peer and tokens
         # arrive via broadcast; here we only mark the sampling node's stage.
-        tracer.stage(request_id, "decode", {"first_token": token_int})
+        tracer.stage(request_id, "decode", {"first_token": token_int}, node=self.id)
 
       is_finished = self._check_finished(base_shard, token_int, len(tokens), inference_state, request_id)
       self.buffered_token_output[request_id] = (tokens, is_finished)
@@ -982,6 +985,91 @@ class Node:
     finally:
       self._metrics_waiters.pop(nonce, None)
 
+  # ------------------------------------------------------- cluster timelines
+
+  async def collect_cluster_timeline(self, request_id: str, timeout: float = 2.0) -> list[dict]:
+    """Pull every peer's timeline fragment for ``request_id`` over the
+    existing gRPC opaque-status channel (mirrors ``collect_cluster_metrics``:
+    broadcast a ``timeline_pull`` with a nonce; each peer replies with a
+    ``timeline_fragment`` carrying its ``tracer.timeline_export`` — or None
+    when it never saw the request, so the pull completes without waiting out
+    the timeout). Returns ``[{"node_id", "fragment"}, ...]``."""
+    if not self.peers:
+      return []
+    await self._seed_clock_offsets()
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._timeline_waiters[nonce] = waiter
+    try:
+      await self.broadcast_opaque_status(
+        "", json.dumps({"type": "timeline_pull", "node_id": self.id, "nonce": nonce, "request_id": request_id})
+      )
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # merge whatever arrived
+      return list(waiter[1])
+    finally:
+      self._timeline_waiters.pop(nonce, None)
+
+  async def _seed_clock_offsets(self, timeout: float = 2.0) -> None:
+    """Make sure every peer has a usable clock-offset estimate before a
+    cluster-timeline merge: peers without one (the periodic pass hasn't
+    reached them, or discovery never health-checks — static test setups) get
+    a burst of 3 echo samples to prime the EWMA. Bounded: the whole seeding
+    is capped at ``timeout`` and a peer that fails its first check is not
+    retried — a DEAD peer must not stall the observability endpoint exactly
+    when the cluster is degraded (its fragment just merges with offset 0)."""
+    fresh = [p for p in self.peers if clock_sync.estimate(p.id()) is None and hasattr(p, "health_check")]
+    if not fresh:
+      return
+
+    async def burst(peer) -> None:
+      for _ in range(3):
+        if not await peer.health_check():
+          return  # unreachable: don't burn the remaining samples on it
+
+    try:
+      await asyncio.wait_for(
+        asyncio.gather(*(burst(p) for p in fresh), return_exceptions=True), timeout=timeout
+      )
+    except asyncio.TimeoutError:
+      pass  # merge with whatever estimates landed
+
+  def merged_cluster_timeline(self, request_id: str, fragments: list[dict]) -> dict | None:
+    return merge_cluster_timeline(
+      self.id, tracer.timeline_export(request_id), fragments, clock_sync.offsets()
+    )
+
+  def _handle_timeline_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "timeline_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      reply = json.dumps({
+        "type": "timeline_fragment",
+        "node_id": self.id,
+        "nonce": status_data.get("nonce", ""),
+        "fragment": tracer.timeline_export(status_data.get("request_id", "")),
+      })
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        async def send():
+          try:
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — timeline replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] timeline fragment reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "timeline_fragment":
+      waiter = self._timeline_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None and status_data.get("node_id") != self.id:
+        waiter[1].append({"node_id": status_data.get("node_id"), "fragment": status_data.get("fragment")})
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
   def _handle_metrics_status(self, status_data: dict) -> None:
     kind = status_data.get("type")
     if kind == "metrics_pull":
@@ -1016,6 +1104,12 @@ class Node:
 
   async def update_peers(self, wait_for_peers: int = 0) -> bool:
     next_peers = await self.discovery.discover_peers(wait_for_peers)
+    for p in next_peers:
+      # Stamp whose behalf these handles send on: hop telemetry labels
+      # client-side spans with the ORIGIN node (discovery built the handles
+      # without knowing it).
+      if hasattr(p, "set_origin"):
+        p.set_origin(self.id)
     current_ids = {p.id() for p in self.peers}
     next_ids = {p.id() for p in next_peers}
     peers_added = [p for p in next_peers if p.id() not in current_ids]
@@ -1026,6 +1120,12 @@ class Node:
     peers_to_connect = peers_added + peers_updated
 
     async def disconnect_with_timeout(peer, timeout=5):
+      # A departing (or address-changed → likely restarted) peer's clock
+      # estimate is garbage for its next incarnation: perf_counter's epoch is
+      # per-process, so the true offset jumps arbitrarily on restart and the
+      # EWMA would converge from that huge error over dozens of samples.
+      # Forget now; the next health check re-seeds from scratch.
+      clock_sync.forget(peer.id())
       try:
         await asyncio.wait_for(peer.disconnect(), timeout)
         return True
@@ -1108,9 +1208,27 @@ class Node:
         await self.collect_topology(set())
         if did_change:
           self.select_best_inference_engine()
+        await self._clock_sync_pass()
       except Exception:  # noqa: BLE001
         if DEBUG >= 1:
           traceback.print_exc()
+
+  async def _clock_sync_pass(self) -> None:
+    """Keep per-peer clock-offset estimates fresh: health-check (the RPC
+    that carries the NTP echo) any peer whose estimate is missing or older
+    than ``XOT_TPU_CLOCKSYNC_INTERVAL_S`` (default 10 s). Discovery layers
+    that already health-check every poll feed the estimator for free; this
+    covers static/test topologies that never do."""
+    try:
+      interval = float(os.getenv("XOT_TPU_CLOCKSYNC_INTERVAL_S", "10"))
+    except ValueError:
+      interval = 10.0  # malformed knob must not kill the refresh loop
+    stale = [
+      p for p in self.peers
+      if hasattr(p, "health_check") and ((age := clock_sync.age_s(p.id())) is None or age > interval)
+    ]
+    if stale:
+      await asyncio.gather(*(p.health_check() for p in stale), return_exceptions=True)
 
   def select_best_inference_engine(self) -> None:
     """Hook for heterogeneous clusters; single-engine here (jax everywhere)."""
@@ -1153,6 +1271,9 @@ class Node:
       elif status_type in ("metrics_pull", "metrics_snapshot"):
         # Cluster-wide /metrics aggregation rides the same opaque channel.
         self._handle_metrics_status(status_data)
+      elif status_type in ("timeline_pull", "timeline_fragment"):
+        # Cluster-scope request timelines ride it too (same pull pattern).
+        self._handle_timeline_status(status_data)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001

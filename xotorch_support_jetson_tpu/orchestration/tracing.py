@@ -4,8 +4,9 @@ The reference ships a full OpenTelemetry tracer that nothing imports and no
 proto field carries (``orchestration/tracing.py`` — dead code, SURVEY.md §5.1).
 This one is wired in: ``Node.process_prompt`` opens a request span,
 per-token-group spans (every 10 tokens) record decode cadence, and the W3C
-``traceparent`` rides the opaque-status JSON so multi-node rings stitch into
-one trace. Self-contained (no otel dependency); export is an in-memory ring
+``traceparent`` rides both the opaque-status JSON and — since ISSUE 4 — the
+gRPC metadata of every data-plane RPC, so multi-node rings stitch into one
+trace. Self-contained (no otel dependency); export is an in-memory ring
 buffer + optional JSONL file (``XOT_TPU_TRACE_FILE``) — file appends are
 BUFFERED under the lock and flushed outside it, so the token hot path never
 blocks on disk.
@@ -17,6 +18,21 @@ Per-request STAGE TIMELINES (ISSUE 2): producers mark lifecycle stages
 bounded LRU so a client can fetch the breakdown after the response.
 ``XOT_TPU_SLOW_REQUEST_MS`` > 0 logs a structured JSON line with the stage
 attribution for any request slower than the threshold.
+
+CROSS-NODE ATTRIBUTION (ISSUE 4): data-plane RPCs record per-hop entries on
+both sides via ``record_hop()`` — client-side serialize/RPC latency/payload
+bytes, server-side deserialize/handler time — kept as spans in the ring
+buffer AND as a bounded per-request hop list (+ exact per-link aggregates)
+on the timeline. ``timeline_export()`` ships a node's raw-ns fragment over
+the opaque-status channel; ``merge_cluster_timeline()`` normalizes remote
+timestamps with the NTP-style per-peer clock offsets (clocksync.py) and
+merges the fragments into one hop-annotated cluster timeline that splits
+each hop into serialize / wire / deserialize / compute.
+
+All cross-node-comparable timestamps route through ``node_now_ns(node_id)``
+so tests can inject a synthetic per-node clock skew (``set_test_skew``) and
+verify the offset normalization end-to-end; with no skew registered it is a
+plain ``time.perf_counter_ns()``.
 """
 
 from __future__ import annotations
@@ -31,6 +47,41 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 MAX_TIMELINES = 256
+# Live TraceContexts are bounded the same way (satellite of ISSUE 4): a
+# request cancelled or failed before end_request used to leave its context in
+# the dict forever. LRU-evicting at this cap loses only token-group cadence
+# for requests that outlive 1024 newer ones — never correctness.
+MAX_CONTEXTS = 1024
+# Per-request hop DETAIL is capped (a 200-token ring decode crosses 400+
+# hops); the per-link aggregates keep exact totals past the cap.
+MAX_TIMELINE_HOPS = 256
+
+
+# ---------------------------------------------------------- test clock skew
+# Synthetic per-node monotonic-clock skew, injectable by tests ONLY: two
+# in-process nodes share one time.perf_counter_ns(), so verifying that the
+# cluster-timeline merge actually corrects a clock offset requires skewing
+# one "node's" clock at the record points. Empty dict (the default) keeps the
+# hot path at one falsy check.
+_test_skew_ns: dict[str, int] = {}
+
+
+def set_test_skew(node_id: str, skew_ns: int | None) -> None:
+  """Register (or clear, with None) a synthetic clock skew for ``node_id``.
+  Affects stage/hop timestamps and the HealthCheck clock echo — exactly the
+  cross-node-comparable reads — as if that node's monotonic clock ran ahead
+  by ``skew_ns``."""
+  if skew_ns is None:
+    _test_skew_ns.pop(node_id, None)
+  else:
+    _test_skew_ns[node_id] = int(skew_ns)
+
+
+def node_now_ns(node_id: str | None = None) -> int:
+  now = time.perf_counter_ns()
+  if _test_skew_ns and node_id in _test_skew_ns:
+    now += _test_skew_ns[node_id]
+  return now
 
 
 @dataclass
@@ -72,13 +123,35 @@ def format_traceparent(trace_id: str, span_id: str) -> str:
   return f"00-{trace_id}-{span_id}-01"
 
 
+_HEX_DIGITS = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str) -> bool:
+  return bool(s) and all(c in _HEX_DIGITS for c in s)
+
+
 def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+  """Strict W3C traceparent parsing (hardened, ISSUE 4 satellite): the old
+  parser accepted any 4-dash-part string of the right lengths, silently
+  adopting garbage trace/span ids from a corrupted or hostile header. Reject
+  non-(lowercase-)hex ids, all-zero ids, and any version other than ``00``
+  (including the explicitly-invalid ``ff``) — an unparseable header means
+  "start a fresh trace", never "join id 'deadbeef-oops'"."""
   if not header:
     return None
-  parts = header.split("-")
-  if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+  parts = header.strip().split("-")
+  if len(parts) != 4:
     return None
-  return parts[1], parts[2]
+  version, trace_id, span_id, flags = parts
+  if version != "00":
+    return None
+  if len(trace_id) != 32 or not _is_hex(trace_id) or trace_id == "0" * 32:
+    return None
+  if len(span_id) != 16 or not _is_hex(span_id) or span_id == "0" * 16:
+    return None
+  if len(flags) != 2 or not _is_hex(flags):
+    return None
+  return trace_id, span_id
 
 
 class TraceContext:
@@ -96,10 +169,33 @@ class TraceContext:
     return format_traceparent(self.trace_id, self.request_span_id or new_span_id())
 
 
+def stage_summary(events: list[dict], start_ns: int, end_ns: int) -> list[dict]:
+  """Per-stage rollup: each event's duration runs to the next event (or the
+  timeline end); same-named events (chunked prefill) aggregate. Works on any
+  raw-ns event list — the single-node timeline and the per-node sections of
+  the merged cluster timeline both use it."""
+  order: list[str] = []
+  agg: dict[str, dict] = {}
+  for i, ev in enumerate(events):
+    nxt = events[i + 1]["t_ns"] if i + 1 < len(events) else end_ns
+    entry = agg.get(ev["stage"])
+    if entry is None:
+      order.append(ev["stage"])
+      entry = agg[ev["stage"]] = {
+        "stage": ev["stage"],
+        "count": 0,
+        "first_at_ms": round((ev["t_ns"] - start_ns) / 1e6, 3),
+        "duration_ms": 0.0,
+      }
+    entry["count"] += 1
+    entry["duration_ms"] = round(entry["duration_ms"] + max(nxt - ev["t_ns"], 0) / 1e6, 3)
+  return [agg[name] for name in order]
+
+
 class Tracer:
   def __init__(self, max_spans: int = 4096) -> None:
     self.spans: deque[Span] = deque(maxlen=max_spans)
-    self.contexts: dict[str, TraceContext] = {}
+    self.contexts: OrderedDict[str, TraceContext] = OrderedDict()
     self.timelines: OrderedDict[str, dict] = OrderedDict()
     self._lock = threading.Lock()
     self._export_path = os.getenv("XOT_TPU_TRACE_FILE")
@@ -118,7 +214,18 @@ class Tracer:
         else:
           ctx = TraceContext(new_trace_id())
         self.contexts[request_id] = ctx
+        while len(self.contexts) > MAX_CONTEXTS:
+          self.contexts.popitem(last=False)
+      self.contexts.move_to_end(request_id)
       return ctx
+
+  def trace_ids(self, request_id: str) -> tuple[str, str | None] | None:
+    """(trace_id, request_span_id) for an EXISTING context — None rather
+    than creating one (hop recording for ids this node merely forwards must
+    not churn the context LRU)."""
+    with self._lock:
+      ctx = self.contexts.get(request_id)
+      return (ctx.trace_id, ctx.request_span_id or ctx.parent_id) if ctx else None
 
   def end_request(self, request_id: str) -> None:
     """Close out a request: emit the trailing PARTIAL token group (tokens
@@ -157,7 +264,11 @@ class Tracer:
             "total_ms": round(total_ms, 3),
             "threshold_ms": threshold_ms,
             "tokens": tl.get("tokens", 0),
-            "stages": self._stage_summary_locked(tl, now),
+            "stages": stage_summary(tl["events"], tl["start_ns"], tl["end_ns"] or now),
+            # Per-link hop attribution (exact aggregates, not the capped
+            # detail): which peer link ate the time is answerable from the
+            # log line alone.
+            "hops": dict(tl.get("hop_agg") or {}),
           })
     self._flush_export()
     if slow_line is not None:
@@ -165,56 +276,122 @@ class Tracer:
 
   # -------------------------------------------------------- stage timelines
 
-  def stage(self, request_id: str, stage: str, attributes: dict | None = None) -> None:
+  def stage(self, request_id: str, stage: str, attributes: dict | None = None, node: str | None = None) -> None:
     """Mark a request-lifecycle stage (queued/admitted/prefill_chunk/decode/
     detokenize/…). Cheap: one dict append under the lock; repeated stages
     (each prefill chunk) append their own events. Events after the request
     finished (e.g. the API's detokenize following a blocking generation) are
-    still recorded — the timeline is an LRU entry, not live request state."""
-    now = time.perf_counter_ns()
+    still recorded — the timeline is an LRU entry, not live request state.
+    ``node`` labels the event for cross-node merging and routes the
+    timestamp through the (test-skewable) per-node clock."""
+    now = node_now_ns(node)
     with self._lock:
-      tl = self.timelines.get(request_id)
-      if tl is None:
-        ctx = self.contexts.get(request_id)
-        tl = self.timelines[request_id] = {
-          "request_id": request_id,
-          "trace_id": ctx.trace_id if ctx else None,
-          "start_ns": now,
-          "end_ns": None,
-          "finished": False,
-          "tokens": 0,
-          "events": [],
-        }
-        while len(self.timelines) > MAX_TIMELINES:
-          self.timelines.popitem(last=False)
-      elif tl.get("trace_id") is None:
-        ctx = self.contexts.get(request_id)
-        if ctx:
-          tl["trace_id"] = ctx.trace_id
-      tl["events"].append({"stage": stage, "t_ns": now, "attributes": dict(attributes or {})})
+      tl = self._timeline_locked(request_id, now)
+      tl["events"].append({"stage": stage, "t_ns": now, "node": node, "attributes": dict(attributes or {})})
       self.timelines.move_to_end(request_id)
 
-  def _stage_summary_locked(self, tl: dict, now_ns: int) -> list[dict]:
-    """Per-stage rollup: each event's duration runs to the next event (or
-    the timeline end); same-named events (chunked prefill) aggregate."""
-    events = tl["events"]
-    end_ns = tl["end_ns"] or now_ns
-    order: list[str] = []
-    agg: dict[str, dict] = {}
-    for i, ev in enumerate(events):
-      nxt = events[i + 1]["t_ns"] if i + 1 < len(events) else end_ns
-      entry = agg.get(ev["stage"])
-      if entry is None:
-        order.append(ev["stage"])
-        entry = agg[ev["stage"]] = {
-          "stage": ev["stage"],
-          "count": 0,
-          "first_at_ms": round((ev["t_ns"] - tl["start_ns"]) / 1e6, 3),
-          "duration_ms": 0.0,
-        }
-      entry["count"] += 1
-      entry["duration_ms"] = round(entry["duration_ms"] + max(nxt - ev["t_ns"], 0) / 1e6, 3)
-    return [agg[name] for name in order]
+  def _timeline_locked(self, request_id: str, now: int) -> dict:
+    tl = self.timelines.get(request_id)
+    if tl is None:
+      ctx = self.contexts.get(request_id)
+      tl = self.timelines[request_id] = {
+        "request_id": request_id,
+        "trace_id": ctx.trace_id if ctx else None,
+        "start_ns": now,
+        "end_ns": None,
+        "finished": False,
+        "tokens": 0,
+        "events": [],
+        "hops": [],
+        "hops_dropped": 0,
+        "hop_agg": {},
+      }
+      while len(self.timelines) > MAX_TIMELINES:
+        self.timelines.popitem(last=False)
+    elif tl.get("trace_id") is None:
+      ctx = self.contexts.get(request_id)
+      if ctx:
+        tl["trace_id"] = ctx.trace_id
+    return tl
+
+  # ------------------------------------------------------------------ hops
+
+  def record_hop(
+    self,
+    request_id: str,
+    *,
+    side: str,  # "client" (sender) | "server" (receiver)
+    method: str,
+    peer: str,
+    node: str | None = None,
+    t_start_ns: int,
+    dur_ms: float,
+    hop_id: str | None = None,
+    trace_id: str | None = None,
+    attributes: dict | None = None,
+  ) -> str:
+    """Record one side of a data-plane RPC hop (ISSUE 4 tentpole).
+
+    Client side: ``hop_id`` is the client's span id (it rides the RPC's
+    traceparent metadata so the server parents to it); attributes carry
+    serialize_ms / rpc_ms / payload_bytes. Server side: a fresh span id with
+    ``parent_id=hop_id``; attributes carry deserialize_ms / handler_ms /
+    payload_bytes. Both land as spans in the ring buffer AND as timeline hop
+    entries — detail capped at MAX_TIMELINE_HOPS per request, per-link
+    aggregates exact. Returns the hop span id."""
+    attrs = dict(attributes or {})
+    with self._lock:
+      ctx = self.contexts.get(request_id) if request_id else None
+      tid = trace_id or (ctx.trace_id if ctx else new_trace_id())
+      if side == "client":
+        span_id = hop_id or new_span_id()
+        parent = ctx.request_span_id or ctx.parent_id if ctx else None
+      else:
+        span_id = new_span_id()
+        parent = hop_id
+      # The span-ring entry rides the SAME per-request cap as the timeline
+      # hop detail: a 200-token ring decode crosses 400+ hops per node, and
+      # uncapped hop spans would cycle the whole 4096-entry ring (burying
+      # request/pp/token-group spans) while flushing the JSONL export on the
+      # per-token data plane. Aggregates stay exact past the cap.
+      over_cap = False
+      if request_id:
+        tl = self._timeline_locked(request_id, t_start_ns)
+        over_cap = len(tl["hops"]) >= MAX_TIMELINE_HOPS
+      if not over_cap:
+        self._record_locked(Span(
+          trace_id=tid,
+          span_id=span_id,
+          parent_id=parent,
+          name=f"rpc.{side}.{method}",
+          start_ns=t_start_ns,
+          end_ns=t_start_ns + int(dur_ms * 1e6),
+          attributes={"peer": peer, "node": node, **attrs},
+        ))
+      if request_id:
+        if not over_cap:
+          tl["hops"].append({
+            "side": side,
+            "t_ns": t_start_ns,
+            "node": node,
+            "hop_id": span_id if side == "client" else hop_id,
+            "peer": peer,
+            "method": method,
+            "attributes": attrs,
+          })
+        else:
+          tl["hops_dropped"] += 1
+        key = f"{side}|{node or '-'}|{peer}|{method}"
+        agg = tl["hop_agg"].get(key)
+        if agg is None:
+          agg = tl["hop_agg"][key] = {"count": 0}
+        agg["count"] += 1
+        for k, v in attrs.items():
+          if isinstance(v, (int, float)) and (k.endswith("_ms") or k.endswith("_bytes")):
+            agg[f"{k}_sum"] = round(agg.get(f"{k}_sum", 0.0) + v, 3)
+        self.timelines.move_to_end(request_id)
+    self._flush_export()
+    return span_id
 
   def timeline(self, request_id: str) -> dict | None:
     """The request's stage breakdown, or None if unknown (expired/never
@@ -231,15 +408,52 @@ class Tracer:
         "finished": bool(tl.get("finished")),
         "tokens": tl.get("tokens", 0),
         "total_ms": round((end_ns - tl["start_ns"]) / 1e6, 3),
-        "stages": self._stage_summary_locked(tl, now),
+        "stages": stage_summary(tl["events"], tl["start_ns"], end_ns),
         "events": [
           {
             "stage": ev["stage"],
             "at_ms": round((ev["t_ns"] - tl["start_ns"]) / 1e6, 3),
+            "node": ev.get("node"),
             "attributes": ev["attributes"],
           }
           for ev in tl["events"]
         ],
+        "hops": [
+          {
+            "side": h["side"],
+            "at_ms": round((h["t_ns"] - tl["start_ns"]) / 1e6, 3),
+            "node": h.get("node"),
+            "hop_id": h.get("hop_id"),
+            "peer": h["peer"],
+            "method": h["method"],
+            "attributes": h["attributes"],
+          }
+          for h in tl.get("hops", [])
+        ],
+        "hops_dropped": tl.get("hops_dropped", 0),
+        "hop_agg": dict(tl.get("hop_agg") or {}),
+      }
+
+  def timeline_export(self, request_id: str) -> dict | None:
+    """Raw-ns fragment of this node's view of the request — the wire format
+    peers ship over the opaque-status channel for ``?scope=cluster``.
+    Timestamps stay in the LOCAL monotonic clock; the merging node
+    normalizes them with its per-peer offset estimates."""
+    with self._lock:
+      tl = self.timelines.get(request_id)
+      if tl is None:
+        return None
+      return {
+        "request_id": request_id,
+        "trace_id": tl.get("trace_id"),
+        "start_ns": tl["start_ns"],
+        "end_ns": tl["end_ns"],
+        "finished": bool(tl.get("finished")),
+        "tokens": tl.get("tokens", 0),
+        "events": [dict(ev) for ev in tl["events"]],
+        "hops": [dict(h) for h in tl.get("hops", [])],
+        "hops_dropped": tl.get("hops_dropped", 0),
+        "hop_agg": {k: dict(v) for k, v in (tl.get("hop_agg") or {}).items()},
       }
 
   # ----------------------------------------------------------------- spans
@@ -321,6 +535,205 @@ class Tracer:
   def recent_spans(self, n: int = 100) -> list[dict]:
     with self._lock:
       return [s.to_dict() for s in list(self.spans)[-n:]]
+
+
+# ------------------------------------------------- cluster timeline merging
+
+
+def _num(d: dict, key: str) -> float | None:
+  v = d.get(key)
+  return float(v) if isinstance(v, (int, float)) else None
+
+
+def merge_cluster_timeline(
+  local_node_id: str,
+  local: dict | None,
+  fragments: list[dict],
+  offsets: dict | None = None,
+) -> dict | None:
+  """Merge timeline fragments from the whole ring into ONE cluster-scope
+  timeline in the LOCAL node's clock domain.
+
+  ``fragments`` are ``{"node_id": ..., "fragment": timeline_export()|None}``
+  as returned by ``Node.collect_cluster_timeline``. ``offsets`` maps node_id
+  → ``PeerClockEstimate`` (or a dict with ``offset_ns``): a remote timestamp
+  ``t`` normalizes to ``t - offset_ns`` (the estimate is peer−local).
+
+  Events/hops whose ``node`` field is unset adopt their fragment's node id;
+  duplicates (the in-process shared-tracer case, where every "fragment" is
+  the same object) collapse by identity key — (stage, t_ns) for events,
+  (side, hop_id, method) for hops — keeping the first occurrence, which is
+  the local fragment's.
+
+  Each hop pairs its client and server entries by hop id and splits into
+  serialize (client, before the RPC), wire (client RPC latency − server
+  handler time: network + HTTP/2 framing + compression), deserialize
+  (server, proto → numpy), and compute (server handler − deserialize; on a
+  ring middle node this INCLUDES awaiting the downstream hops — span-tree
+  semantics, the nested hops are attributed on their own entries)."""
+  offsets = offsets or {}
+
+  def offset_ns(node_id: str) -> float:
+    if node_id == local_node_id:
+      return 0.0
+    est = offsets.get(node_id)
+    if est is None:
+      return 0.0
+    raw = est.get("offset_ns", 0.0) if isinstance(est, dict) else getattr(est, "offset_ns", 0.0)
+    return float(raw or 0.0)
+
+  frags: list[tuple[str, dict]] = []
+  if local is not None:
+    frags.append((local_node_id, local))
+  for entry in fragments:
+    frag = entry.get("fragment")
+    nid = entry.get("node_id")
+    if frag is not None and nid:
+      frags.append((nid, frag))
+  if not frags:
+    return None
+
+  starts = [frag["start_ns"] - offset_ns(nid) for nid, frag in frags]
+
+  events: list[dict] = []
+  seen_ev: set = set()
+  raw_hops: list[dict] = []
+  seen_hop: set = set()
+  node_events: dict[str, list[dict]] = {}
+  hop_agg: dict[str, dict] = {}
+  hops_dropped = 0
+  tokens = 0
+  finished = False
+  trace_id = None
+  end_norm = min(starts)
+  for nid, frag in frags:
+    off = offset_ns(nid)
+    trace_id = trace_id or frag.get("trace_id")
+    tokens = max(tokens, int(frag.get("tokens") or 0))
+    finished = finished or bool(frag.get("finished"))
+    hops_dropped += int(frag.get("hops_dropped") or 0)
+    if frag.get("end_ns"):
+      end_norm = max(end_norm, frag["end_ns"] - off)
+    for ev in frag.get("events", []):
+      key = (ev["stage"], ev["t_ns"])
+      if key in seen_ev:
+        continue
+      seen_ev.add(key)
+      node = ev.get("node") or nid
+      t_norm = ev["t_ns"] - (offset_ns(node) if node != nid else off)
+      end_norm = max(end_norm, t_norm)
+      events.append({
+        "stage": ev["stage"],
+        "node": node,
+        "t_norm_ns": t_norm,
+        "attributes": ev.get("attributes", {}),
+      })
+      node_events.setdefault(node, []).append({"stage": ev["stage"], "t_ns": t_norm})
+    for h in frag.get("hops", []):
+      # Anonymous hops (no traceparent reached the server — origin context
+      # LRU-evicted, or an older peer) get an identity key from their node +
+      # timestamp: still collapses shared-tracer duplicate fragments, never
+      # collapses DISTINCT hops of the same method.
+      key = (h["side"], h.get("hop_id") or (h.get("node"), h["t_ns"]), h["method"])
+      if key in seen_hop:
+        continue
+      seen_hop.add(key)
+      node = h.get("node") or nid
+      t_norm = h["t_ns"] - (offset_ns(node) if node != nid else off)
+      end_norm = max(end_norm, t_norm)
+      raw_hops.append({**h, "node": node, "t_norm_ns": t_norm})
+    for key, agg in (frag.get("hop_agg") or {}).items():
+      cur = hop_agg.get(key)
+      if cur is None:
+        hop_agg[key] = dict(agg)
+      elif cur != agg:
+        # Same link key from two fragments with DIFFERENT content: genuinely
+        # distinct contributions, sum them. Equal content is the shared-tracer
+        # duplicate-fragment case (the key embeds the recording node, so two
+        # real nodes never collide) — keep one copy.
+        for k, v in agg.items():
+          if isinstance(v, (int, float)):
+            cur[k] = round(cur.get(k, 0) + v, 3)
+
+  # Reference t=0: the earliest normalized time anyone recorded for the
+  # request — NOT the local fragment's start, which on a non-origin node is
+  # the SendPrompt arrival and would push the origin's queued/admitted
+  # stages to negative at_ms (and silently exclude them from total_ms).
+  all_t = [e["t_norm_ns"] for e in events] + [h["t_norm_ns"] for h in raw_hops]
+  ref_start = min(all_t) if all_t else min(starts)
+  end_norm = max(end_norm, ref_start)
+  for e in events:
+    e["at_ms"] = round((e.pop("t_norm_ns") - ref_start) / 1e6, 3)
+
+  # Pair client/server hop entries by hop id into annotated hop records.
+  by_id: dict[str, dict] = {}
+  unpaired = []
+  for h in raw_hops:
+    hid = h.get("hop_id")
+    if not hid:
+      unpaired.append(h)
+      continue
+    by_id.setdefault(hid, {})[h["side"]] = h
+  hops: list[dict] = []
+  for hid, sides in by_id.items():
+    c, s = sides.get("client"), sides.get("server")
+    ref = c or s
+    ca, sa = (c or {}).get("attributes", {}), (s or {}).get("attributes", {})
+    rpc_ms = _num(ca, "rpc_ms")
+    handler_ms = _num(sa, "handler_ms")
+    deserialize_ms = _num(sa, "deserialize_ms")
+    hop = {
+      "hop_id": hid,
+      "method": ref["method"],
+      "from": c["node"] if c else None,
+      "to": (s["node"] if s else None) or (c["peer"] if c else None),
+      "at_ms": round(((c or s)["t_norm_ns"] - ref_start) / 1e6, 3),
+      "recv_at_ms": round((s["t_norm_ns"] - ref_start) / 1e6, 3) if s else None,
+      "serialize_ms": _num(ca, "serialize_ms"),
+      "rpc_ms": rpc_ms,
+      "payload_bytes": _num(ca, "payload_bytes") or _num(sa, "payload_bytes"),
+      "handler_ms": handler_ms,
+      "deserialize_ms": deserialize_ms,
+      "wire_ms": round(max(rpc_ms - handler_ms, 0.0), 3) if rpc_ms is not None and handler_ms is not None else None,
+      "compute_ms": round(max(handler_ms - deserialize_ms, 0.0), 3) if handler_ms is not None and deserialize_ms is not None else None,
+    }
+    hops.append(hop)
+  for h in unpaired:
+    hops.append({
+      "hop_id": None,
+      "method": h["method"],
+      "from": h["node"] if h["side"] == "client" else None,
+      "to": h["peer"] if h["side"] == "client" else h["node"],
+      "at_ms": round((h["t_norm_ns"] - ref_start) / 1e6, 3),
+      "recv_at_ms": None,
+      **{k: _num(h.get("attributes", {}), k) for k in ("serialize_ms", "rpc_ms", "payload_bytes", "handler_ms", "deserialize_ms")},
+      "wire_ms": None,
+      "compute_ms": None,
+    })
+
+  events.sort(key=lambda e: e["at_ms"])
+  hops.sort(key=lambda h: h["at_ms"])
+  est_dicts = {}
+  for nid, est in offsets.items():
+    est_dicts[nid] = est.to_dict() if hasattr(est, "to_dict") else dict(est)
+  return {
+    "request_id": frags[0][1].get("request_id"),
+    "scope": "cluster",
+    "trace_id": trace_id,
+    "finished": finished,
+    "tokens": tokens,
+    "nodes": sorted({nid for nid, _ in frags}),
+    "offsets": est_dicts,
+    "total_ms": round((end_norm - ref_start) / 1e6, 3),
+    "events": events,
+    "hops": hops,
+    "hops_dropped": hops_dropped,
+    "hop_agg": hop_agg,
+    "stages": {
+      node: stage_summary(evs, ref_start, end_norm)
+      for node, evs in ((n, sorted(e, key=lambda x: x["t_ns"])) for n, e in node_events.items())
+    },
+  }
 
 
 tracer = Tracer()

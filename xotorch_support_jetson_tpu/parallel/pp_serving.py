@@ -394,27 +394,41 @@ class PPServing:
     self._fused_generate_fn = _fused_generate
 
   # ------------------------------------------------------------ entry points
+  # Each coarse entry records an op-level span (ISSUE 4: pp span marks in
+  # the trace ring) — wall-clock of the DISPATCH (jax returns futures;
+  # device time lives in the profiler), labeled with the pipeline geometry
+  # so a cluster trace shows where a ring node's local pp program sat.
+  # decode_step (the per-token ring hop path) stays unmarked: its spans
+  # would dominate the ring buffer at one per token.
 
   def prefill(self, x, cache, prompt_len):
     """x [B,S] tokens (first shard) | [B,S,D] hidden; prompt_len [B]."""
+    from ..orchestration.tracing import tracer
+
     B, S = x.shape[0], x.shape[1]
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    return self._prefill_fn(self.stage_params, self.head, x, positions, cache, prompt_len)
+    with tracer.start_span("pp.dispatch.prefill", attributes={"pp": self.n_stages, "batch": int(B), "seq": int(S)}):
+      return self._prefill_fn(self.stage_params, self.head, x, positions, cache, prompt_len)
 
   def decode_step(self, x, cache, pos):
     """x [B,1] token | [B,1,D] hidden; pos [B] absolute position."""
     return self._decode_fn(self.stage_params, self.head, x, pos.reshape(-1, 1), cache)
 
   def fused_decode(self, token, cache, start_pos, n_steps: int, temp: float = 0.0, top_k: int = 35, key=None):
+    from ..orchestration.tracing import tracer
+
     if not (self.is_first and self.is_last):
       raise ValueError("fused pp decode requires a full-model shard")
     if key is None:
       key = jax.random.PRNGKey(0)
     greedy = temp is None or float(temp) <= 0.0
     temp_arr = jnp.float32(1.0 if greedy else float(temp))
-    return self._fused_decode_fn(self.stage_params, self.head, token, cache, start_pos, temp_arr, key, int(n_steps), int(top_k), greedy)
+    with tracer.start_span("pp.dispatch.fused_decode", attributes={"pp": self.n_stages, "batch": int(token.shape[0]), "n_steps": int(n_steps)}):
+      return self._fused_decode_fn(self.stage_params, self.head, token, cache, start_pos, temp_arr, key, int(n_steps), int(top_k), greedy)
 
   def fused_generate(self, token, cache, start_pos, max_steps: int, eos_ids: tuple = (), temp: float = 0.0, top_k: int = 35, key=None, n_limit=None):
+    from ..orchestration.tracing import tracer
+
     if not (self.is_first and self.is_last):
       raise ValueError("fused pp generate requires a full-model shard")
     if key is None:
@@ -422,6 +436,7 @@ class PPServing:
     greedy = temp is None or float(temp) <= 0.0
     temp_arr = jnp.float32(1.0 if greedy else float(temp))
     limit = jnp.int32(max_steps if n_limit is None else n_limit)
-    return self._fused_generate_fn(
-      self.stage_params, self.head, token, cache, start_pos, temp_arr, key, limit, int(max_steps), tuple(eos_ids), int(top_k), greedy
-    )
+    with tracer.start_span("pp.dispatch.fused_generate", attributes={"pp": self.n_stages, "batch": int(token.shape[0]), "max_steps": int(max_steps)}):
+      return self._fused_generate_fn(
+        self.stage_params, self.head, token, cache, start_pos, temp_arr, key, limit, int(max_steps), tuple(eos_ids), int(top_k), greedy
+      )

@@ -6,8 +6,11 @@ here a dependency-free registry backs the API's ``/metrics`` endpoint. The
 observability layer (ISSUE 2) records request latencies through HISTOGRAMS
 (``le``-bucket exposition + a ``quantile()`` helper) so p50/p95/p99 are
 answerable online, not just means: TTFT, inter-token latency, queue wait,
-prefill/decode chunk step time. Counters and gauges accept optional LABELS
-(one level, e.g. ``{"path": "kernel"}`` for decode-path attribution).
+prefill/decode chunk step time. Counters, gauges, AND histograms accept
+optional LABELS (one level, e.g. ``{"path": "kernel"}`` for decode-path
+attribution, ``{"peer": ..., "method": ...}`` for per-link RPC latency);
+``quantile()``/``hist_count()`` without labels aggregate a purely-labeled
+family across all its series.
 
 Cluster scope: ``snapshot()`` serializes the whole registry to a JSON-safe
 dict; ``merge_snapshot()`` adds another node's snapshot into a (fresh)
@@ -102,6 +105,9 @@ class Metrics:
     self._latency_sum: dict[str, float] = defaultdict(float)
     self._latency_count: dict[str, int] = defaultdict(int)
     self._hists: dict[str, _Histogram] = {}
+    # Labeled histogram series (ISSUE 4: per-peer-link RPC latency,
+    # ``peer_rpc_seconds{peer,method}``): name -> {label-key-tuple -> hist}.
+    self._labeled_hists: dict[str, dict[tuple, _Histogram]] = defaultdict(dict)
 
   def inc(self, name: str, value: float = 1.0, labels: dict | None = None) -> None:
     with self._lock:
@@ -122,26 +128,58 @@ class Metrics:
       self._latency_sum[name] += seconds
       self._latency_count[name] += 1
 
-  def observe_hist(self, name: str, value: float, buckets: tuple = DEFAULT_BUCKETS, n: int = 1) -> None:
+  def observe_hist(self, name: str, value: float, buckets: tuple = DEFAULT_BUCKETS, n: int = 1, labels: dict | None = None) -> None:
     """Record ``value`` into the named histogram (created on first use; the
     bucket ladder is fixed at creation). ``n`` records n identical
     observations under ONE lock acquisition — O(1) instead of O(n) lock
-    round trips for per-chunk amortized values like inter-token latency."""
+    round trips for per-chunk amortized values like inter-token latency.
+    With ``labels`` the observation lands in that label-set's series (one
+    level, e.g. ``{"peer": ..., "method": ...}`` for per-link RPC latency)."""
     with self._lock:
-      hist = self._hists.get(name)
-      if hist is None:
-        hist = self._hists[name] = _Histogram(buckets)
+      if labels:
+        series = self._labeled_hists[name]
+        key = _label_key(labels)
+        hist = series.get(key)
+        if hist is None:
+          hist = series[key] = _Histogram(buckets)
+      else:
+        hist = self._hists.get(name)
+        if hist is None:
+          hist = self._hists[name] = _Histogram(buckets)
       hist.observe(value, n)
 
-  def quantile(self, name: str, q: float) -> float | None:
-    """Estimated q-quantile (0..1) of a histogram; None if absent/empty."""
+  def _hist_view_locked(self, name: str, labels: dict | None) -> _Histogram | None:
+    """The histogram to answer quantile/count queries from: a specific
+    labeled series, the unlabeled histogram, or — labels omitted on a
+    family that only has labeled series — an on-the-fly aggregate across
+    every series sharing the family's bucket ladder."""
+    if labels:
+      return self._labeled_hists.get(name, {}).get(_label_key(labels))
+    hist = self._hists.get(name)
+    series = self._labeled_hists.get(name)
+    if not series:
+      return hist
+    agg = _Histogram(hist.buckets if hist is not None else next(iter(series.values())).buckets)
+    for h in ([hist] if hist is not None else []) + list(series.values()):
+      if h.buckets != agg.buckets:
+        continue
+      for i, c in enumerate(h.counts):
+        agg.counts[i] += c
+      agg.sum += h.sum
+      agg.count += h.count
+    return agg
+
+  def quantile(self, name: str, q: float, labels: dict | None = None) -> float | None:
+    """Estimated q-quantile (0..1) of a histogram; None if absent/empty.
+    Without ``labels``, a purely-labeled family answers from the aggregate
+    across all its series."""
     with self._lock:
-      hist = self._hists.get(name)
+      hist = self._hist_view_locked(name, labels)
       return hist.quantile(q) if hist is not None else None
 
-  def hist_count(self, name: str) -> int:
+  def hist_count(self, name: str, labels: dict | None = None) -> int:
     with self._lock:
-      hist = self._hists.get(name)
+      hist = self._hist_view_locked(name, labels)
       return hist.count if hist is not None else 0
 
   def counter_value(self, name: str, labels: dict | None = None) -> float:
@@ -201,16 +239,24 @@ class Metrics:
         lines.append(f"# TYPE xot_tpu_{name}_seconds summary")
         lines.append(f"xot_tpu_{name}_seconds_sum {self._latency_sum[name]}")
         lines.append(f"xot_tpu_{name}_seconds_count {self._latency_count[name]}")
-      for name in sorted(self._hists):
-        hist = self._hists[name]
-        lines.append(f"# TYPE xot_tpu_{name} histogram")
+      def hist_lines(name: str, hist: _Histogram, key: tuple) -> None:
+        prefix = ",".join(f'{k}="{v}"' for k, v in key)
+        sep = "," if prefix else ""
+        suffix = "{" + prefix + "}" if prefix else ""
         cum = 0
         for edge, n in zip(hist.buckets, hist.counts):
           cum += n
-          lines.append(f'xot_tpu_{name}_bucket{{le="{edge}"}} {cum}')
-        lines.append(f'xot_tpu_{name}_bucket{{le="+Inf"}} {hist.count}')
-        lines.append(f"xot_tpu_{name}_sum {hist.sum}")
-        lines.append(f"xot_tpu_{name}_count {hist.count}")
+          lines.append(f'xot_tpu_{name}_bucket{{{prefix}{sep}le="{edge}"}} {cum}')
+        lines.append(f'xot_tpu_{name}_bucket{{{prefix}{sep}le="+Inf"}} {hist.count}')
+        lines.append(f"xot_tpu_{name}_sum{suffix} {hist.sum}")
+        lines.append(f"xot_tpu_{name}_count{suffix} {hist.count}")
+
+      for name in sorted(set(self._hists) | set(self._labeled_hists)):
+        lines.append(f"# TYPE xot_tpu_{name} histogram")
+        if name in self._hists:
+          hist_lines(name, self._hists[name], ())
+        for key, hist in sorted(self._labeled_hists.get(name, {}).items()):
+          hist_lines(name, hist, key)
     return "\n".join(lines) + "\n"
 
   # ------------------------------------------------------- cluster merging
@@ -234,6 +280,13 @@ class Metrics:
         "histograms": {
           name: {"buckets": list(h.buckets), "counts": list(h.counts), "sum": h.sum}
           for name, h in self._hists.items()
+        },
+        "labeled_histograms": {
+          name: [
+            [list(map(list, key)), {"buckets": list(h.buckets), "counts": list(h.counts), "sum": h.sum}]
+            for key, h in series.items()
+          ]
+          for name, series in self._labeled_hists.items()
         },
       }
 
@@ -267,11 +320,8 @@ class Metrics:
       for name, (s, c) in (snap.get("summaries") or {}).items():
         self._latency_sum[name] += float(s)
         self._latency_count[name] += int(c)
-      for name, h in (snap.get("histograms") or {}).items():
+      def merge_hist(hist: _Histogram, h: dict) -> None:
         buckets = tuple(float(b) for b in h.get("buckets", DEFAULT_BUCKETS))
-        hist = self._hists.get(name)
-        if hist is None:
-          hist = self._hists[name] = _Histogram(buckets)
         counts = [int(c) for c in h.get("counts", [])]
         if hist.buckets == buckets and len(counts) == len(hist.counts):
           for i, c in enumerate(counts):
@@ -280,6 +330,19 @@ class Metrics:
           hist.counts[-1] += sum(counts)
         hist.sum += float(h.get("sum", 0.0))
         hist.count += sum(counts)
+
+      for name, h in (snap.get("histograms") or {}).items():
+        hist = self._hists.get(name)
+        if hist is None:
+          hist = self._hists[name] = _Histogram(tuple(float(b) for b in h.get("buckets", DEFAULT_BUCKETS)))
+        merge_hist(hist, h)
+      for name, series in (snap.get("labeled_histograms") or {}).items():
+        for key, h in series:
+          k = tuple(tuple(kv) for kv in key)
+          hist = self._labeled_hists[name].get(k)
+          if hist is None:
+            hist = self._labeled_hists[name][k] = _Histogram(tuple(float(b) for b in h.get("buckets", DEFAULT_BUCKETS)))
+          merge_hist(hist, h)
 
   @classmethod
   def merged(cls, snapshots: list[dict]) -> "Metrics":
