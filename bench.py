@@ -56,6 +56,17 @@ def gate_lookahead(ratio: float | None) -> float | None:
   return float(ratio) if 1.0 / 3.0 <= ratio <= 3.0 else None
 
 
+def gate_overload(shed_rate: float | None) -> float | None:
+  """Sanity-gate the overload round's shed rate (same drift-gate pattern).
+  The round offers ~2x capacity, so a healthy QoS layer sheds SOME batch
+  work but nowhere near everything: a rate outside [0, 0.95] means the
+  round broke (scheduler wedged and shed the world, or the counter went
+  negative across a registry reset) — drop it rather than record it."""
+  if shed_rate is None:
+    return None
+  return float(shed_rate) if 0.0 <= shed_rate <= 0.95 else None
+
+
 def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, where: dict | None = None) -> float | None:
   """Quantile of a LABELED histogram family's growth between two registry
   snapshots, aggregated across every label series (the per-peer-link RPC
@@ -636,6 +647,82 @@ def main() -> None:
       else:
         os.environ[k] = v
 
+  # QoS overload round (ISSUE 5): offered load ≈ 2x capacity, mixed priority
+  # (half interactive, half batch, distinct tenants) against the QoS-enabled
+  # scheduler. Emits the shed rate (behind gate_overload) and per-class
+  # first-token p99s measured CLIENT-side — the numbers the acceptance
+  # criterion is judged on: interactive p99 must hold while batch sheds/
+  # degrades. Null on CPU rounds (tests/test_qos.py pins the behavior there).
+  overload_shed_rate = None
+  ttft_ms_p99_interactive_overload = None
+  ttft_ms_p99_batch_overload = None
+  ov_server = ov_eng = None
+  try:
+    if not on_accel:
+      raise RuntimeError("skip on cpu")
+    import asyncio
+
+    from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+    from xotorch_support_jetson_tpu.inference.engine import ServerOverloadedError
+    from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+
+    ov_eng = JaxShardedInferenceEngine(use_local_mesh=False)
+    ov_eng.load_test_model(shard, cfg, qp)
+    n_slots_ov = 16
+    offered = 2 * n_slots_ov  # ≈ 2x capacity: every slot claimed twice over
+    ov_server = BatchedServer(ov_eng, n_slots=n_slots_ov, chunk=8, max_queue=n_slots_ov, qos=True)
+    rng_ov = np.random.default_rng(23)
+    prompts_ov = [rng_ov.integers(1, cfg.vocab_size, (64,)).astype(np.int32) for _ in range(offered)]
+
+    async def overload_round():
+      waits = {"interactive": [], "batch": []}
+      shed = 0
+      firsts: dict[str, float] = {}
+
+      def emit(rid, toks, finished):
+        if toks and rid not in firsts:
+          firsts[rid] = time.perf_counter()
+
+      async def one(i: int, klass: str):
+        nonlocal shed
+        rid = f"ov-{klass}-{i}"
+        t0 = time.perf_counter()
+        try:
+          await ov_server.submit(
+            rid, prompts_ov[i], max_tokens=17, temp=0.0, top_k=35,
+            eos_ids=(), emit=emit, priority=klass, tenant=f"tenant-{klass}",
+          )
+          waits[klass].append((firsts[rid] - t0) * 1e3)
+        except ServerOverloadedError:
+          shed += 1
+
+      tasks = [asyncio.create_task(one(i, "batch")) for i in range(offered // 2)]
+      await asyncio.sleep(0.02)  # the batch backlog forms first — worst case
+      tasks += [asyncio.create_task(one(offered // 2 + i, "interactive")) for i in range(offered // 2)]
+      await asyncio.gather(*tasks)
+      return waits, shed
+
+    waits_ov, shed_ov = asyncio.run(overload_round())
+    overload_shed_rate = gate_overload(round(shed_ov / offered, 4))
+
+    def p99(xs):
+      # Nearest-rank p99: ceil(0.99 n) - 1. At this round's sample counts
+      # (16/class) that is the max — the worst TTFT must not silently drop
+      # out of the tracked record.
+      if not xs:
+        return None
+      idx = min(len(xs) - 1, max((len(xs) * 99 + 99) // 100 - 1, 0))
+      return round(sorted(xs)[idx], 2)
+
+    ttft_ms_p99_interactive_overload = p99(waits_ov["interactive"])
+    ttft_ms_p99_batch_overload = p99(waits_ov["batch"])
+  except Exception:  # noqa: BLE001 — optional section: keep the bench line printing
+    pass
+  finally:
+    if ov_server is not None:
+      ov_server.shutdown()
+    ov_server = ov_eng = None
+
   # Speculative decoding (XOT_TPU_SPEC_DECODE=int8, models/decoder.py
   # fused_speculative_generate): greedy int8 self-draft + bf16 target in one
   # while_loop. On these RANDOM weights logits are near-uniform, so the
@@ -1001,6 +1088,9 @@ def main() -> None:
         "sync48_aggregate_tok_s": sync48_aggregate_tok_s,
         "sched_host_gap_ms_p50": sched_host_gap_ms_p50,
         "sched_host_gap_sync_ms_p50": sched_host_gap_sync_ms_p50,
+        "overload_shed_rate": overload_shed_rate,
+        "ttft_ms_p99_interactive_overload": ttft_ms_p99_interactive_overload,
+        "ttft_ms_p99_batch_overload": ttft_ms_p99_batch_overload,
         "platform": platform,
         "device": str(jax.devices()[0]),
         "n_decode": n_decode,

@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_headline, gate_lookahead, plausible_value
+from bench import gate_headline, gate_lookahead, gate_overload, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -81,6 +81,23 @@ def test_lookahead_gate_drops_artifacts():
   assert gate_lookahead(12.4) is None
   assert gate_lookahead(0.05) is None
   assert gate_lookahead(None) is None
+
+
+def test_overload_gate_keeps_plausible_shed_rates():
+  """The QoS overload round's shed rate is a fraction of offered load: a
+  healthy 2x-overload run sheds some batch work, never (nearly) all of it."""
+  assert gate_overload(0.0) == 0.0
+  assert gate_overload(0.25) == 0.25
+  assert gate_overload(0.9) == 0.9
+
+
+def test_overload_gate_drops_artifacts():
+  # A wedged scheduler shedding the world (or a counter going negative
+  # across a registry reset) must not enter the tracked record.
+  assert gate_overload(1.0) is None
+  assert gate_overload(0.99) is None
+  assert gate_overload(-0.1) is None
+  assert gate_overload(None) is None
 
 
 def test_committed_r02_artifact_is_filtered():

@@ -674,6 +674,12 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_page_release_events_total",
   "xot_tpu_grpc_rpcs_total",
   "xot_tpu_grpc_rpc_failures_total",
+  # QoS subsystem (ISSUE 5; labeled {class} / {tenant} / {reason})
+  "xot_tpu_qos_submitted_total",
+  "xot_tpu_qos_shed_total",
+  "xot_tpu_qos_rejected_total",
+  "xot_tpu_qos_rate_limited_total",
+  "xot_tpu_qos_preemptions_total",
   "xot_tpu_peer_broadcast_failures_total",
   "xot_tpu_peer_rpc_bytes_sent_total",
   "xot_tpu_peer_rpc_bytes_received_total",
@@ -688,6 +694,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_page_pool_pages_free",
   "xot_tpu_page_pool_pages_cached",
   "xot_tpu_page_pool_utilization",
+  "xot_tpu_qos_queue_depth",
   "xot_tpu_engine_sessions",
   "xot_tpu_peer_clock_offset_ms",
   "xot_tpu_peer_clock_uncertainty_ms",
@@ -736,6 +743,12 @@ def test_metric_name_snapshot_after_serving():
     gm.inc(name, 0)
   gm.inc("grpc_rpcs_total", 0, labels={"method": "SendResult"})
   gm.inc("grpc_rpc_failures_total", 0, labels={"method": "SendResult"})
+  gm.inc("qos_submitted_total", 0, labels={"class": "standard"})
+  gm.inc("qos_shed_total", 0, labels={"reason": "deadline"})
+  gm.inc("qos_rejected_total", 0, labels={"class": "batch"})
+  gm.inc("qos_rate_limited_total", 0, labels={"tenant": "default"})
+  gm.inc("qos_preemptions_total", 0)
+  gm.set_gauge("qos_queue_depth", 0, labels={"class": "standard"})
   gm.inc("peer_broadcast_failures_total", 0, labels={"kind": "result"})
   gm.observe_hist("prefill_seconds", 0.0)
   gm.observe_hist("decode_step_seconds", 0.0)

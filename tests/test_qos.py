@@ -1,0 +1,825 @@
+"""QoS subsystem (inference/qos.py) wired through the batched scheduler,
+API, and gRPC ring.
+
+ISSUE 5 coverage: token-bucket refill math and per-tenant isolation (one
+noisy tenant cannot starve another), priority ordering and anti-starvation
+aging under a saturated queue, weighted-fair tenant selection, deadline-shed
+decisions against histogram fixtures, preempt-then-resume token identity vs
+the FIFO baseline (lookahead on and off), overload shedding with structured
+429s + Retry-After, the byte-identical FIFO escape hatch (XOT_TPU_QOS=0),
+and ring propagation of priority/tenant/deadline metadata over a real
+two-node gRPC cluster.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_batched import _single_row_reference
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.engine import ServerOverloadedError
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.qos import (
+  DeadlineUnmeetableError,
+  QosConfig,
+  QosPolicy,
+  QosQueue,
+  RateLimitedError,
+  TokenBucket,
+  normalize_priority,
+  qos_metadata,
+  qos_wire,
+)
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.utils.metrics import Metrics, metrics as gm
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+
+
+class FakeClock:
+  def __init__(self, t: float = 0.0) -> None:
+    self.t = t
+
+  def __call__(self) -> float:
+    return self.t
+
+  def advance(self, dt: float) -> None:
+    self.t += dt
+
+
+def _engine():
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  return engine, params, shard
+
+
+def _req(policy, priority="standard", tenant="default", deadline_ms=None, cost=1, rid="r"):
+  return SimpleNamespace(qos=policy.ticket(priority, tenant, deadline_ms, cost), request_id=rid)
+
+
+# ------------------------------------------------------------ token buckets
+
+
+def test_token_bucket_refill_math():
+  clock = FakeClock()
+  b = TokenBucket(2.0, 4.0, clock)  # 2 tokens/s, capacity 4
+  assert all(b.try_take(1.0) for _ in range(4))
+  assert not b.try_take(1.0)  # drained
+  assert b.retry_after_s(1.0) == pytest.approx(0.5)
+  clock.advance(0.5)
+  assert b.try_take(1.0)  # refilled exactly one token
+  assert not b.try_take(1.0)
+  clock.advance(10.0)  # refill clamps at capacity
+  assert all(b.try_take(1.0) for _ in range(4))
+  assert not b.try_take(1.0)
+  # give_back undoes a charge (the two-bucket admission must not double-bill
+  # a rejected request).
+  b.give_back(2.0)
+  assert b.try_take(2.0)
+  # An oversized charge clamps to the whole capacity instead of being
+  # permanently unadmittable.
+  clock.advance(10.0)
+  assert b.try_take(1e9)
+  assert not b.try_take(1.0)
+  # rate <= 0 = unlimited.
+  assert TokenBucket(0.0, 0.0, clock).try_take(1e12)
+  assert TokenBucket(0.0, 0.0, clock).retry_after_s(5) == 0.0
+
+
+def test_rate_limit_per_tenant_isolation():
+  """One tenant draining its budget cannot take a single token from another
+  tenant's bucket — the flood is contained to its own 429s."""
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(rps=2.0, burst_s=1.0), clock=clock)
+  policy.check_rate("noisy", 10)
+  policy.check_rate("noisy", 10)
+  with pytest.raises(RateLimitedError) as exc:
+    policy.check_rate("noisy", 10)
+  assert exc.value.retry_after_ms is not None and exc.value.retry_after_ms > 0
+  # The quiet tenant's budget is untouched by the noisy tenant's flood.
+  policy.check_rate("quiet", 10)
+  policy.check_rate("quiet", 10)
+  with pytest.raises(RateLimitedError):
+    policy.check_rate("quiet", 10)
+  # Token-rate bucket: refusal gives the request-bucket charge back (a
+  # request rejected by the token bucket must not also burn request budget).
+  clock2 = FakeClock()
+  p2 = QosPolicy(QosConfig(rps=2.0, tps=10.0, burst_s=1.0), clock=clock2)
+  p2.check_rate("t", 10)  # drains the token bucket; one request charge
+  assert p2.tenant("t").req_bucket.level == pytest.approx(1.0)
+  with pytest.raises(RateLimitedError):
+    p2.check_rate("t", 5)  # token-limited
+  assert p2.tenant("t").req_bucket.level == pytest.approx(1.0)  # refunded
+  clock2.advance(1.0)  # token bucket refills
+  p2.check_rate("t", 5)
+
+
+# --------------------------------------------------------------- fair queue
+
+
+def test_qos_queue_priority_order():
+  policy = QosPolicy(QosConfig(aging_s=10_000.0), clock=FakeClock())
+  q = QosQueue(policy)
+  b = _req(policy, "batch", rid="b")
+  s = _req(policy, "standard", rid="s")
+  i = _req(policy, "interactive", rid="i")
+  for r in (b, s, i):  # worst-case arrival order
+    q.put_nowait(r)
+  assert q.peek() is i
+  assert [q.get_nowait().request_id for _ in range(3)] == ["i", "s", "b"]
+  assert normalize_priority("INTERACTIVE") == "interactive"
+  assert normalize_priority("bogus") == "standard"
+  assert normalize_priority(None) == "standard"
+
+
+def test_qos_queue_aging_prevents_starvation():
+  """A batch request that has waited long enough outranks a fresh
+  interactive arrival: score = rank - wait/aging, so batch wins once its
+  extra wait exceeds 2 * aging_s."""
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(aging_s=1.0), clock=clock)
+  q = QosQueue(policy)
+  q.put_nowait(_req(policy, "batch", rid="old-batch"))
+  clock.advance(3.0)  # batch score: 2 - 3 = -1
+  q.put_nowait(_req(policy, "interactive", rid="fresh-i"))  # score 0
+  assert q.get_nowait().request_id == "old-batch"
+  assert q.get_nowait().request_id == "fresh-i"
+  # Fresh batch vs fresh interactive: strict priority still holds.
+  q.put_nowait(_req(policy, "batch", rid="b2"))
+  q.put_nowait(_req(policy, "interactive", rid="i2"))
+  assert q.get_nowait().request_id == "i2"
+
+
+def test_qos_queue_weighted_fair_across_tenants():
+  """Inside one class, a tenant flooding the queue cannot starve another:
+  start-time fair queueing alternates by virtual time, and weights shift the
+  share proportionally."""
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(aging_s=10_000.0), clock=clock)
+  q = QosQueue(policy)
+  for n in range(6):
+    q.put_nowait(_req(policy, "standard", tenant="noisy", cost=100, rid=f"n{n}"))
+  for n in range(2):
+    q.put_nowait(_req(policy, "standard", tenant="quiet", cost=100, rid=f"q{n}"))
+  order = [q.get_nowait().request_id for _ in range(8)]
+  # Both quiet requests served within the first four picks despite 6 noisy
+  # entries ahead of them in arrival order.
+  assert set(order[:4]) >= {"q0", "q1"}
+  assert order[4:] == ["n2", "n3", "n4", "n5"]
+
+  # Weight override: the heavy tenant gets ~2x the share of the light one.
+  policy2 = QosPolicy(QosConfig(aging_s=10_000.0, tenants={"heavy": {"weight": 2.0}}), clock=FakeClock())
+  q2 = QosQueue(policy2)
+  for n in range(6):
+    q2.put_nowait(_req(policy2, "standard", tenant="heavy", cost=100, rid=f"h{n}"))
+    q2.put_nowait(_req(policy2, "standard", tenant="light", cost=100, rid=f"l{n}"))
+  first6 = [q2.get_nowait().request_id for _ in range(6)]
+  assert sum(r.startswith("h") for r in first6) == 4  # 2:1 split
+
+
+def test_tenant_state_lru_bounded():
+  """The tenant key is client-controlled (x-tenant-id / Authorization
+  hash): rotating ids must not grow per-tenant state without bound."""
+  from xotorch_support_jetson_tpu.inference import qos as qos_mod
+
+  policy = QosPolicy(QosConfig(rps=1.0), clock=FakeClock())
+  for i in range(qos_mod.MAX_TENANTS + 50):
+    policy.tenant(f"t-{i}")
+  assert len(policy._tenants) == qos_mod.MAX_TENANTS
+  assert "t-0" not in policy._tenants  # oldest evicted
+  # Access refreshes recency.
+  policy.tenant("t-100")
+  for i in range(200):
+    policy.tenant(f"t2-{i}")
+  assert "t-100" in policy._tenants
+
+
+def test_refund_undoes_rate_charge():
+  """A request refused AFTER check_rate (queue full / deadline shed)
+  consumed no service: refund restores both buckets so the compliant retry
+  isn't double-penalized as rate_limited."""
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(rps=1.0, tps=100.0, burst_s=1.0), clock=clock)
+  policy.check_rate("t", 60)
+  with pytest.raises(RateLimitedError):
+    policy.check_rate("t", 10)  # request budget drained
+  policy.refund("t", 60)
+  policy.check_rate("t", 60)  # the refunded budget admits again
+
+
+def test_shed_lowest_never_sheds_resumed_requests():
+  """A preempted-and-resumed request already streamed tokens to its client:
+  the overload shed must skip it (a mid-stream 429 would break the resume
+  guarantee) and pick an un-started entry instead — or nothing."""
+  policy = QosPolicy(QosConfig(aging_s=10_000.0), clock=FakeClock())
+  q = QosQueue(policy)
+  resumed = _req(policy, "batch", rid="resumed")
+  resumed.carry_tokens = [5, 6, 7]  # streamed before preemption
+  resumed.qos.resumed = True
+  fresh = _req(policy, "batch", rid="fresh")
+  fresh.carry_tokens = []
+  q.put_nowait(resumed)
+  q.put_nowait(fresh)
+  assert q.shed_lowest(0).request_id == "fresh"  # youngest SHEDDABLE, not the resumed one
+  assert q.shed_lowest(0) is None  # only resumed work left: nothing to shed
+  assert q.qsize() == 1 and q.get_nowait().request_id == "resumed"
+
+
+def test_qos_queue_shed_lowest():
+  policy = QosPolicy(QosConfig(aging_s=10_000.0), clock=FakeClock())
+  q = QosQueue(policy)
+  q.put_nowait(_req(policy, "standard", rid="s0"))
+  q.put_nowait(_req(policy, "batch", rid="b0"))
+  q.put_nowait(_req(policy, "batch", rid="b1"))
+  # Victim for an interactive arrival: the YOUNGEST batch entry.
+  victim = q.shed_lowest(0)
+  assert victim.request_id == "b1"
+  # Victim for a standard arrival: still batch; for a batch arrival: none
+  # (equal priority is never shed).
+  assert q.shed_lowest(2) is None
+  assert q.shed_lowest(1).request_id == "b0"
+  # Only standard left; an interactive arrival can shed it.
+  assert q.shed_lowest(0).request_id == "s0"
+  assert q.shed_lowest(0) is None
+  assert q.qsize() == 0
+
+
+# ------------------------------------------------------- deadline admission
+
+
+def test_deadline_shed_decision_vs_histogram_fixtures():
+  m = Metrics()
+  policy = QosPolicy(QosConfig(), registry=m)
+  # Cold start: no histogram data → no estimate → never shed on a guess.
+  assert policy.estimate_completion_ms(queue_depth=5, n_slots=4, max_tokens=100) is None
+  assert policy.retry_after_ms(5, 4) == 1000.0  # floor without data
+  for _ in range(20):
+    m.observe_hist("ttft_seconds", 0.1)
+  for _ in range(100):
+    m.observe_hist("itl_seconds", 0.01)
+  est = policy.estimate_completion_ms(queue_depth=0, n_slots=4, max_tokens=50)
+  # ~ttft_p50 (+ 50 * itl_p50): in the hundreds of ms for these fixtures.
+  assert est is not None and 100.0 < est < 1500.0
+  est_deep = policy.estimate_completion_ms(queue_depth=8, n_slots=4, max_tokens=50)
+  assert est_deep > est  # queue drain scales the estimate
+  assert policy.should_shed(50.0, est)  # 50 ms deadline: unmeetable
+  assert not policy.should_shed(60_000.0, est)  # a minute: fine
+  # Margin scales the decision boundary.
+  strict = QosPolicy(QosConfig(shed_margin=100.0), registry=m)
+  assert strict.should_shed(est * 2, est)
+  assert policy.retry_after_ms(8, 4) > 0
+
+  # Expired-deadline detection (the queued-too-long shed).
+  clock = FakeClock()
+  p2 = QosPolicy(QosConfig(), clock=clock, registry=m)
+  t = p2.ticket("standard", "t", 100.0, 1)
+  assert not p2.deadline_expired(t)
+  clock.advance(0.2)  # 200 ms > 100 ms deadline
+  assert p2.deadline_expired(t)
+  assert not p2.deadline_expired(p2.ticket("standard", "t", None, 1))
+
+
+# ------------------------------------------------------ scheduler integration
+
+
+def test_queue_depth_ahead_is_class_aware():
+  """Deadline admission charges a request only for waiting work its class
+  would actually be served behind — an interactive deadline must not be
+  shed against a batch backlog it outranks."""
+  engine, _, _ = _engine()
+  policy = QosPolicy(QosConfig(aging_s=10_000.0), clock=FakeClock())
+  server = BatchedServer(engine, n_slots=2, chunk=2, qos=policy)
+  for cls, rid in (("interactive", "i0"), ("standard", "s0"), ("batch", "b0"), ("batch", "b1")):
+    server.queue.put_nowait(_req(policy, cls, rid=rid))
+  assert server._queue_depth_ahead(policy.ticket("interactive", "t", None, 1)) == 1
+  assert server._queue_depth_ahead(policy.ticket("standard", "t", None, 1)) == 2
+  assert server._queue_depth_ahead(policy.ticket("batch", "t", None, 1)) == 4
+  server.shutdown()
+
+
+def test_scheduler_rate_limited_tenant_isolation():
+  """A flooding tenant's submissions 429 while a second tenant's requests
+  admit untouched — bucket state is strictly per-tenant."""
+  engine, _, _ = _engine()
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(rps=1.0, burst_s=1.0), clock=clock)
+  server = BatchedServer(engine, n_slots=2, chunk=2, qos=policy)
+  before = gm.counter_value("qos_rate_limited_total", labels={"tenant": "noisy"})
+
+  async def run():
+    ok = await server.submit("n0", np.asarray([3, 25, 9], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, priority="standard", tenant="noisy")
+    assert len(ok) == 3
+    with pytest.raises(RateLimitedError) as exc:
+      await server.submit("n1", np.asarray([3, 25, 9], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, priority="standard", tenant="noisy")
+    assert exc.value.retry_after_ms > 0
+    # The second tenant admits despite the first one being over budget.
+    ok2 = await server.submit("c0", np.asarray([7, 1, 88], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, priority="standard", tenant="calm")
+    assert len(ok2) == 3
+
+  asyncio.run(run())
+  assert gm.counter_value("qos_rate_limited_total", labels={"tenant": "noisy"}) == before + 1
+  server.shutdown()
+
+
+def test_scheduler_deadline_shed_at_submit():
+  """A microscopic deadline is shed (at admission against the live
+  histograms, or at the slot boundary once it lapses) — never prefilled."""
+  engine, _, _ = _engine()
+  server = BatchedServer(engine, n_slots=2, chunk=2, qos=QosPolicy(QosConfig()))
+  before = gm.counter_sum("qos_shed_total")
+  before_fail = gm.counter_value("scheduler_admission_failures_total")
+
+  async def run():
+    with pytest.raises(DeadlineUnmeetableError):
+      await server.submit("dl", np.asarray([3, 25, 9], np.int32), max_tokens=50, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, deadline_ms=0.001)
+
+  asyncio.run(run())
+  assert gm.counter_sum("qos_shed_total") > before
+  # An intentional shed is a QoS outcome, not an admission FAILURE — the
+  # failure counter keeps isolating real errors.
+  assert gm.counter_value("scheduler_admission_failures_total") == before_fail
+  # The refusal is a TERMINAL timeline stage: /v1/requests/{id}/timeline
+  # explains why the request never ran, and the timeline is finished even
+  # though no end_request ever fired for it.
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+  tl = tracer.timeline("dl")
+  assert tl is not None and tl["finished"]
+  assert any(e["stage"] == "shed" for e in tl["events"])
+  server.shutdown()
+
+
+def test_priority_order_under_saturated_queue():
+  """One slot, three queued classes: dequeue order is interactive →
+  standard → batch regardless of arrival order (the resident request shares
+  the waiters' top class, so ordering — not preemption — is what's
+  measured)."""
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  finish_order = []
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "hold" and toks:
+        started.set()
+      if fin:
+        finish_order.append(rid)
+
+    hold = asyncio.create_task(server.submit("hold", np.asarray([3, 25, 9], np.int32), max_tokens=14, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    waiters = [
+      asyncio.create_task(server.submit("w-batch", np.asarray([9, 4], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch")),
+      asyncio.create_task(server.submit("w-std", np.asarray([9, 4], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="standard")),
+      asyncio.create_task(server.submit("w-int", np.asarray([9, 4], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive")),
+    ]
+    await asyncio.wait_for(asyncio.gather(hold, *waiters), timeout=60)
+
+  asyncio.run(run())
+  assert finish_order == ["hold", "w-int", "w-std", "w-batch"]
+  server.shutdown()
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_preempt_resume_token_identity(lookahead):
+  """An interactive arrival preempts the resident batch row; the batch
+  request RESUMES (prompt absorbs its generated tokens) and its final
+  stream is token-identical to the FIFO baseline — lookahead on and off."""
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, lookahead=lookahead, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  p_batch, p_int = [3, 25, 9], [7, 1, 88, 42, 5]
+  n_batch, n_int = 24, 4
+  solo_batch = _single_row_reference(params, shard, p_batch, n_batch - 1)
+  solo_int = _single_row_reference(params, shard, p_int, n_int - 1)
+  before = gm.counter_value("qos_preemptions_total")
+  streams: dict[str, list] = {}
+  finish_order = []
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      streams.setdefault(rid, []).extend(toks)
+      if rid == "bg" and len(streams["bg"]) >= 4:
+        started.set()
+      if fin:
+        finish_order.append(rid)
+
+    bg = asyncio.create_task(server.submit("bg", np.asarray(p_batch, np.int32), max_tokens=n_batch, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch", tenant="bulk"))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    out_int = await asyncio.wait_for(
+      server.submit("vip", np.asarray(p_int, np.int32), max_tokens=n_int, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive", tenant="人"),
+      timeout=60,
+    )
+    out_bg = await asyncio.wait_for(bg, timeout=60)
+    return out_int, out_bg
+
+  out_int, out_bg = asyncio.run(run())
+  assert gm.counter_value("qos_preemptions_total") > before  # it really preempted
+  assert out_int == solo_int
+  assert out_bg == solo_batch  # carry + resumed tokens == the FIFO stream
+  assert streams["bg"] == solo_batch  # emitted stream never duplicated a token
+  assert finish_order[0] == "vip"  # interactive finished first
+  assert all(s is None for s in server.slots)  # pool fully recovered
+  server.shutdown()
+
+
+def test_preempt_resume_restarts_aging():
+  """A long-resident batch row keeps an old t_enqueue; without restarting
+  it at resume, its aged score would beat the very interactive waiter that
+  preempted it and reclaim the freed slot every boundary (prefill-thrash
+  starvation). The resumed ticket's aging restarts; front-of-lane placement
+  still preserves its intra-lane order."""
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import _Request, _Slot
+
+  engine, _, _ = _engine()
+  clock = FakeClock()
+  policy = QosPolicy(QosConfig(aging_s=1.0), clock=clock)
+  server = BatchedServer(engine, n_slots=1, chunk=2, qos=policy)
+  server.paged = False  # no page pool needed to exercise the ticket math
+  req = _Request(
+    request_id="bg", tokens=np.asarray([3, 25, 9], np.int32), max_tokens=20, temp=0.0,
+    top_k=35, eos_ids=(), emit=lambda *_: None, qos=policy.ticket("batch", "t", None, 3),
+  )
+  clock.advance(100.0)  # resident for 100 "seconds": heavily aged ticket
+  slot = _Slot(req=req, pos=5, generated=2)
+  slot.out_tokens = [7, 8]
+  server.slots[0] = slot
+  server._preempt_resume(0)
+  assert req.qos.resumed and req.qos.t_enqueue == clock.t  # aging restarted
+  assert req.max_tokens == 18 and list(req.tokens[-2:]) == [7, 8]
+  # A fresh interactive arrival now out-scores the resumed batch row.
+  server.queue.put_nowait(_req(policy, "interactive", rid="vip"))
+  assert server.queue.get_nowait().request_id == "vip"
+  assert server.queue.get_nowait().request_id == "bg"
+  server.shutdown()
+
+
+def test_overload_shed_on_full_queue():
+  """Queue full + an interactive arrival: the youngest waiting batch
+  request is shed with a structured 429 (retry_after_ms set) and the
+  interactive request takes its place — overload costs the lowest class
+  first."""
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, max_queue=1, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  solo_vip = _single_row_reference(params, shard, [7, 1, 88], 3)
+  before = gm.counter_value("qos_shed_total", labels={"reason": "overload"})
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "hold" and toks:
+        started.set()
+
+    hold = asyncio.create_task(server.submit("hold", np.asarray([3, 25, 9], np.int32), max_tokens=80, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    victim = asyncio.create_task(server.submit("victim", np.asarray([9, 4], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch"))
+    for _ in range(1000):  # until the victim actually occupies the queue
+      if server.queue.qsize() >= 1:
+        break
+      await asyncio.sleep(0.002)
+    assert server.queue.qsize() == 1  # == max_queue: the pool is saturated
+    vip = asyncio.create_task(server.submit("vip", np.asarray([7, 1, 88], np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"))
+    with pytest.raises(ServerOverloadedError) as exc:
+      await asyncio.wait_for(victim, timeout=30)
+    assert getattr(exc.value, "retry_after_ms", None) is not None
+    assert (await asyncio.wait_for(vip, timeout=60)) == solo_vip
+    await asyncio.wait_for(hold, timeout=60)
+
+  asyncio.run(run())
+  assert gm.counter_value("qos_shed_total", labels={"reason": "overload"}) == before + 1
+  server.shutdown()
+
+
+def test_overload_2x_mix_interactive_beats_fifo():
+  """ISSUE 5 acceptance: under a ~2x overload mix, interactive p99
+  queue-wait under QoS stays below the FIFO baseline's, batch degrades
+  gracefully (completes or sheds, no starvation deadlock), and nothing
+  hangs."""
+  engine, _, _ = _engine()
+  prompt = np.asarray([3, 25, 9], np.int32)
+
+  def overload_round(qos):
+    server = BatchedServer(engine, n_slots=2, chunk=2, max_queue=32, qos=qos)
+    waits = {"interactive": [], "batch": []}
+    outcomes = {"done": 0, "shed": 0}
+
+    async def run():
+      firsts: dict[str, float] = {}
+
+      def emit(rid, toks, fin):
+        if toks and rid not in firsts:
+          firsts[rid] = time.perf_counter()
+
+      async def one(rid, cls):
+        t0 = time.perf_counter()
+        try:
+          out = await server.submit(rid, prompt, max_tokens=8, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority=cls, tenant=f"t-{cls}")
+          assert out
+          waits[cls].append(firsts[rid] - t0)
+          outcomes["done"] += 1
+        except ServerOverloadedError:
+          outcomes["shed"] += 1
+
+      tasks = [asyncio.create_task(one(f"b{i}", "batch")) for i in range(10)]
+      await asyncio.sleep(0.05)  # batch backlog forms first (worst case for interactive)
+      tasks += [asyncio.create_task(one(f"i{i}", "interactive")) for i in range(5)]
+      await asyncio.wait_for(asyncio.gather(*tasks), timeout=120)
+
+    asyncio.run(run())
+    server.shutdown()
+    return waits, outcomes
+
+  fifo_waits, fifo_out = overload_round(qos=False)
+  qos_waits, qos_out = overload_round(qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  assert fifo_out["done"] == 15 and qos_out["done"] + qos_out["shed"] == 15
+  assert len(qos_waits["interactive"]) == 5  # every interactive request completed
+  # p99 (here: max of 5) interactive first-token wait beats the FIFO run's.
+  assert max(qos_waits["interactive"]) < max(fifo_waits["interactive"])
+  # Batch work degraded gracefully: the round DRAINED (no deadlock) with
+  # every batch request either finished or shed with a typed 429.
+  assert len(qos_waits["batch"]) + qos_out["shed"] == 10
+
+
+def test_qos_disabled_byte_identical_fifo(monkeypatch):
+  """XOT_TPU_QOS=0: a plain asyncio.Queue, no QoS branches, priority args
+  ignored — and the served tokens match the QoS-on single-class run (same
+  compiled programs, same order)."""
+  engine, params, shard = _engine()
+  prompts = [[3, 25, 9], [7, 1, 88, 42, 5]]
+  expected = [_single_row_reference(params, shard, p, 4) for p in prompts]
+
+  def serve(server):
+    async def run():
+      return await asyncio.gather(*(
+        server.submit(f"r{i}", np.asarray(p, np.int32), max_tokens=5, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, priority="interactive" if i == 0 else "batch")
+        for i, p in enumerate(prompts)
+      ))
+    out = asyncio.run(run())
+    server.shutdown()
+    return out
+
+  monkeypatch.setenv("XOT_TPU_QOS", "0")
+  off = BatchedServer(engine, n_slots=2, chunk=2)
+  assert off.qos is None
+  assert type(off.queue) is asyncio.Queue  # the stock FIFO, not QosQueue
+  out_off = serve(off)
+
+  monkeypatch.setenv("XOT_TPU_QOS", "1")
+  on = BatchedServer(engine, n_slots=2, chunk=2)
+  assert on.qos is not None and isinstance(on.queue, QosQueue)
+  out_on = serve(on)
+  assert out_off == out_on == expected
+
+
+# ------------------------------------------------------------ wire registry
+
+
+def test_qos_wire_registry_and_metadata():
+  qos_wire.register("wire-1", priority="interactive", tenant="acme", deadline_ms=1500.0, node_id="origin")
+  md = dict(qos_metadata("wire-1"))
+  assert md["x-qos-priority"] == "interactive"
+  assert md["x-qos-tenant"] == "acme"
+  assert 1400.0 < float(md["x-qos-deadline-ms"]) <= 1500.0  # remaining budget, decayed
+  qos_wire.mark_seen("wire-1", "peer-node")
+  entry = qos_wire.get("wire-1")
+  assert entry["seen_by"] >= {"origin", "peer-node"}
+  assert qos_metadata("never-registered") == []
+  qos_wire.pop("wire-1")
+  assert qos_wire.get("wire-1") is None
+  # Bounded: old entries age out.
+  from xotorch_support_jetson_tpu.inference import qos as qos_mod
+
+  for i in range(qos_mod.MAX_WIRE_ENTRIES + 10):
+    qos_wire.register(f"wb-{i}", priority="batch")
+  assert qos_wire.get("wb-0") is None
+  assert qos_wire.get(f"wb-{qos_mod.MAX_WIRE_ENTRIES + 9}") is not None
+  for i in range(qos_mod.MAX_WIRE_ENTRIES + 10):
+    qos_wire.pop(f"wb-{i}")
+
+
+def test_qos_metadata_ships_remaining_deadline_budget():
+  """The deadline crossing the wire is the REMAINING budget — a hop must
+  not grant itself a fresh full SLO for time the origin already spent."""
+  import time as _time
+
+  qos_wire.register("decay-1", deadline_ms=50.0, node_id="origin")
+  md1 = dict(qos_metadata("decay-1"))
+  assert float(md1["x-qos-deadline-ms"]) <= 50.0
+  _time.sleep(0.06)  # outlive the 50 ms budget
+  md2 = dict(qos_metadata("decay-1"))
+  assert float(md2["x-qos-deadline-ms"]) == 0.0  # exhausted, never negative
+  qos_wire.pop("decay-1")
+
+
+def test_refusal_flood_does_not_evict_live_timelines():
+  """QoS refusals are one-event finished timelines; a flood of them must
+  evict each other, not the timelines of requests still decoding."""
+  from xotorch_support_jetson_tpu.orchestration import tracing
+
+  t = tracing.Tracer()
+  t.stage("live-req", "queued")
+  t.stage("live-req", "decode")  # unfinished: an in-flight request
+  for i in range(tracing.MAX_TIMELINES + 50):
+    t.stage(f"refused-{i}", "shed", terminal=True)
+  assert len(t.timelines) == tracing.MAX_TIMELINES  # still bounded
+  assert t.timeline("live-req") is not None  # survived the refusal flood
+
+
+@pytest.mark.asyncio
+async def test_qos_metadata_propagates_across_grpc_ring():
+  """ISSUE 5: priority/tenant/deadline cross a REAL two-node gRPC ring via
+  the x-qos-* metadata path (next to the traceparent) and are adopted by
+  the receiving node — not just carried in the opaque state."""
+  from tests.test_networking import _make_cluster
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+
+  nodes = await _make_cluster(2)
+  rid = "qos-ring-req"
+  try:
+    nodes[0].set_request_options(rid, priority="interactive", tenant="acme", deadline_ms=30_000.0)
+    assert qos_wire.get(rid)["seen_by"] == {"node0"}
+
+    shard = build_base_shard("dummy", "DummyInferenceEngine")
+    done = asyncio.Event()
+    nodes[0].on_token.register("qos-t").on_next(lambda r, toks, fin: done.set() if fin else None)
+    await nodes[0].process_prompt(shard, "aaaa", rid)
+    await asyncio.wait_for(done.wait(), timeout=30)
+
+    entry = qos_wire.get(rid)
+    assert entry is not None
+    assert "node1" in entry["seen_by"], entry  # adopted across the wire
+    assert entry["priority"] == "interactive"
+    assert entry["tenant"] == "acme"
+    # The wire ships the REMAINING budget (decayed since registration), so
+    # the adopted value is at most the original and still most of it.
+    assert 20_000.0 < entry["deadline_ms"] <= 30_000.0
+  finally:
+    qos_wire.pop(rid)
+    for node in nodes:
+      await node.stop()
+
+
+# --------------------------------------------------------------- API layer
+
+
+async def _dummy_api(**api_kwargs):
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from tests_support_stubs import NoDiscovery, StubServer
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  node = Node("qos-api-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=16)
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy", **api_kwargs)
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+@pytest.mark.asyncio
+async def test_api_structured_429_with_retry_after():
+  """ServerOverloadedError and its QoS subclasses map to a structured 429
+  body ({"error": {type, message, retry_after_ms}}) + Retry-After header."""
+  node, api, client = await _dummy_api(response_timeout=30)
+  try:
+    orig = node.process_prompt
+
+    async def rate_limited(*a, **k):
+      raise RateLimitedError("tenant 'x' over its request rate", retry_after_ms=2500.0)
+
+    node.process_prompt = rate_limited
+    resp = await client.post("/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}]})
+    assert resp.status == 429
+    err = (await resp.json())["error"]
+    assert err["type"] == "rate_limited"
+    assert err["retry_after_ms"] == 2500.0
+    assert resp.headers["Retry-After"] == "3"
+
+    async def plain_overload(*a, **k):
+      raise ServerOverloadedError("request queue full (64 waiting)")
+
+    node.process_prompt = plain_overload
+    resp = await client.post("/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}]})
+    assert resp.status == 429
+    err = (await resp.json())["error"]
+    assert err["type"] == "overloaded"
+    assert "Retry-After" not in resp.headers  # no estimate: no fabricated hint
+
+    async def shed(*a, **k):
+      raise DeadlineUnmeetableError("deadline 50 ms unmeetable (estimated 400 ms)", retry_after_ms=400.0)
+
+    node.process_prompt = shed
+    resp = await client.post("/v1/completions", json={"model": "dummy", "prompt": "x"})
+    assert resp.status == 429
+    err = (await resp.json())["error"]
+    assert err["type"] == "deadline_unmeetable" and resp.headers["Retry-After"] == "1"
+    node.process_prompt = orig
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_api_qos_field_parsing_and_validation():
+  node, api, client = await _dummy_api(response_timeout=30)
+  try:
+    # Malformed values are a 400, not a silently-dropped hint.
+    for bad in ({"priority": "urgent"}, {"deadline_ms": -5}, {"deadline_ms": "soon"}, {"deadline_ms": True}):
+      resp = await client.post("/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "x"}], **bad})
+      assert resp.status == 400, (bad, await resp.text())
+
+    # Body fields flow into the request's QoS identity (and the wire
+    # registry used for gRPC metadata).
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "priority": "interactive", "deadline_ms": 60000, "tenant": "acme"},
+    )
+    assert resp.status == 200, await resp.text()
+    rid = (await resp.json())["id"].removeprefix("chatcmpl-")
+    entry = qos_wire.get(rid)
+    assert entry["priority"] == "interactive" and entry["tenant"] == "acme" and entry["deadline_ms"] == 60000.0
+
+    # Header spellings work too, and the Authorization key hashes into a
+    # per-key tenant when none is named.
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}]},
+      headers={"x-priority": "batch", "x-deadline-ms": "45000", "authorization": "Bearer sk-secret"},
+    )
+    assert resp.status == 200, await resp.text()
+    rid = (await resp.json())["id"].removeprefix("chatcmpl-")
+    entry = qos_wire.get(rid)
+    assert entry["priority"] == "batch" and entry["deadline_ms"] == 45000.0
+    assert entry["tenant"].startswith("key-") and "sk-secret" not in entry["tenant"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_response_timeout_env_and_deadline_cap(monkeypatch):
+  """Satellite: XOT_TPU_RESPONSE_TIMEOUT_S replaces the hardcoded 900 s, an
+  explicit argument still wins, and a request deadline caps the per-request
+  timeout so an expired SLO can't hold a token queue open."""
+  monkeypatch.setenv("XOT_TPU_RESPONSE_TIMEOUT_S", "123.5")
+  node, api, client = await _dummy_api()
+  try:
+    assert api.response_timeout == 123.5
+    # The deadline is ABSOLUTE (anchored at request start): each wait gets
+    # only the remaining budget, so slow per-chunk progress cannot reset it.
+    api._request_deadlines["r-dl"] = asyncio.get_event_loop().time() + 2.0
+    assert 0.0 < api._timeout_for("r-dl") <= 2.0
+    api._request_deadlines["r-done"] = asyncio.get_event_loop().time() - 1.0
+    assert api._timeout_for("r-done") == 0.0  # budget exhausted: next wait times out
+    assert api._timeout_for("r-other") == 123.5
+    del api._request_deadlines["r-dl"], api._request_deadlines["r-done"]
+    # A deadlined request registers its cap and clears it on completion.
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "deadline_ms": 5000},
+    )
+    assert resp.status == 200
+    rid = (await resp.json())["id"].removeprefix("chatcmpl-")
+    assert rid not in api._request_deadlines  # popped in the handler's finally
+  finally:
+    await client.close()
+    await node.stop()
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+
+  def timeout_with_env(value):
+    # Malformed / non-positive env falls back to 900 rather than bricking
+    # the API (0 would make every wait_for raise instantly).
+    monkeypatch.setenv("XOT_TPU_RESPONSE_TIMEOUT_S", value)
+    api2 = ChatGPTAPI.__new__(ChatGPTAPI)
+    try:
+      ChatGPTAPI.__init__(api2, node, "DummyInferenceEngine")
+    except Exception:  # noqa: BLE001 — node is stopped; only the timeout matters
+      pass
+    return api2.response_timeout
+
+  assert timeout_with_env("not-a-number") == 900.0
+  assert timeout_with_env("0") == 900.0
+  assert timeout_with_env("-5") == 900.0
+
+
+def test_counter_sum_family():
+  m = Metrics()
+  m.inc("qos_shed_total", 2, labels={"reason": "deadline"})
+  m.inc("qos_shed_total", 3, labels={"reason": "overload"})
+  assert m.counter_sum("qos_shed_total") == 5.0
+  m.inc("plain_total", 4)
+  assert m.counter_sum("plain_total") == 4.0
+  assert m.counter_sum("absent_total") == 0.0

@@ -9,7 +9,10 @@ build w/ chat template + tools :131-150, finish_reason logic :383,430-436,
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
+import math
+import os
 import time
 import uuid
 from pathlib import Path
@@ -17,6 +20,7 @@ from pathlib import Path
 from aiohttp import web
 
 from .. import registry
+from ..inference.qos import PRIORITY_CLASSES
 from ..inference.shard import Shard
 from ..inference.tokenizers import resolve_tokenizer
 from ..utils.helpers import DEBUG, PrefixDict, AsyncCallbackSystem
@@ -213,6 +217,70 @@ def _align_logprobs(tokenizer, all_tokens: list, eos_set, text: str, prompt_len:
   return toks, offsets, keep
 
 
+def parse_qos_fields(data: dict, headers) -> tuple[str | None, str | None, float | None]:
+  """(priority, tenant, deadline_ms) from OpenAI-compatible extra body
+  fields (``priority``, ``deadline_ms``, ``tenant``) or headers
+  (``x-priority``, ``x-deadline-ms``, ``x-tenant-id``). A client that sets
+  neither gets all-None (the node's defaults apply). Tenant identity falls
+  back to a hash of the Authorization header (per-API-key buckets without
+  ever logging the key). Raises ``ValueError`` on malformed values — a typo
+  must be a 400, not a silently-dropped QoS hint.
+
+  TRUST MODEL: this API performs no authentication, so every tenant key —
+  explicit or Authorization-derived — is client-asserted. Per-tenant rate
+  limits and fairness are meaningful only behind a gateway that pins the
+  tenant identity (strips/sets ``x-tenant-id`` itself); an unauthenticated
+  client can rotate keys to dodge its bucket. The per-tenant state is
+  LRU-bounded (qos.py MAX_TENANTS) so key rotation cannot grow memory."""
+  priority = data.get("priority")
+  if priority is None:
+    priority = headers.get("x-priority")
+  if priority is not None:
+    priority = str(priority).lower()
+    if priority not in PRIORITY_CLASSES:
+      raise ValueError(f"'priority' must be one of {list(PRIORITY_CLASSES)}")
+  deadline = data.get("deadline_ms")
+  if deadline is None:
+    deadline = headers.get("x-deadline-ms")
+  if deadline is not None:
+    if isinstance(deadline, bool):
+      raise ValueError("'deadline_ms' must be a positive number")
+    try:
+      deadline = float(deadline)
+    except (TypeError, ValueError):
+      raise ValueError("'deadline_ms' must be a positive number") from None
+    if not deadline > 0:
+      raise ValueError("'deadline_ms' must be a positive number")
+  tenant = data.get("tenant")
+  if tenant is None:
+    tenant = headers.get("x-tenant-id")
+  if tenant is None:
+    auth = headers.get("authorization")
+    if auth:
+      tenant = "key-" + hashlib.sha256(auth.encode()).hexdigest()[:12]
+  if tenant is not None:
+    tenant = str(tenant)[:64]
+    if not tenant:
+      tenant = None
+  return priority, tenant, deadline
+
+
+def overloaded_response(e: Exception) -> web.Response:
+  """ServerOverloadedError (and its QoS subclasses) → structured 429: a JSON
+  body clients can back off on (``{"error": {"type", "message",
+  "retry_after_ms"}}``) plus a standard ``Retry-After`` header derived from
+  the measured drain rate. 503 stays reserved for genuine internal
+  failures (e.g. profiler unavailable) — overload is a client-retryable
+  condition, not a server fault."""
+  retry_ms = getattr(e, "retry_after_ms", None)
+  body = {"error": {"message": str(e), "type": getattr(e, "error_type", "overloaded")}}
+  headers = {}
+  if retry_ms is not None:
+    body["error"]["retry_after_ms"] = round(float(retry_ms), 1)
+    headers["Retry-After"] = str(max(1, math.ceil(float(retry_ms) / 1e3)))
+  return web.json_response(body, status=429, headers=headers)
+
+
 def completion_chunk(request_id: str, model: str, created: int, content: str | None, finish_reason: str | None) -> dict:
   delta = {} if content is None else {"role": "assistant", "content": content}
   return {
@@ -226,10 +294,25 @@ def completion_chunk(request_id: str, model: str, created: int, content: str | N
 
 
 class ChatGPTAPI:
-  def __init__(self, node, inference_engine_classname: str, response_timeout: float = 900.0, on_chat_completion_request=None, default_model: str | None = None, system_prompt: str | None = None):
+  def __init__(self, node, inference_engine_classname: str, response_timeout: float | None = None, on_chat_completion_request=None, default_model: str | None = None, system_prompt: str | None = None):
     self.node = node
     self.inference_engine_classname = inference_engine_classname
+    if response_timeout is None:
+      # Env-configurable (was a hardcoded 900 s): the deployment's SLO, not
+      # a code constant. Malformed or non-positive values fall back rather
+      # than crash (0 would make every wait_for raise instantly).
+      try:
+        response_timeout = float(os.getenv("XOT_TPU_RESPONSE_TIMEOUT_S", "900") or 900)
+      except ValueError:
+        response_timeout = 900.0
+      if response_timeout <= 0:
+        response_timeout = 900.0
     self.response_timeout = response_timeout
+    # Per-request ABSOLUTE deadlines (event-loop clock): a request carrying
+    # ``deadline_ms`` is budgeted end-to-end — every wait gets only the
+    # REMAINING budget, so a deadlined request can't hold a token queue
+    # open past its SLO by making per-chunk progress.
+    self._request_deadlines: dict[str, float] = {}
     self.on_chat_completion_request = on_chat_completion_request
     self.default_model = default_model or "llama-3.2-1b"
     self.system_prompt = system_prompt
@@ -592,6 +675,7 @@ class ChatGPTAPI:
     try:
       # Reuse the chat validation for the shared fields.
       base = parse_chat_request({**data, "messages": [{"role": "user", "content": prompt}], "logprobs": False, "top_logprobs": 0}, self.default_model)
+      qos_priority, qos_tenant, qos_deadline_ms = parse_qos_fields(data, request.headers)
     except ValueError as e:
       return web.json_response({"error": str(e)}, status=400)
     shard = registry.build_base_shard(base.model, self.inference_engine_classname)
@@ -601,8 +685,13 @@ class ChatGPTAPI:
     request_id = str(uuid.uuid4())
     created = int(time.time())
     self.token_queues[request_id] = asyncio.Queue()
+    if qos_deadline_ms is not None:
+      self._request_deadlines[request_id] = asyncio.get_event_loop().time() + min(self.response_timeout, qos_deadline_ms / 1e3)
     if hasattr(self.node, "set_request_options"):
-      self.node.set_request_options(request_id, stream=bool(base.stream), max_tokens=base.max_tokens, temperature=base.temperature)
+      self.node.set_request_options(
+        request_id, stream=bool(base.stream), max_tokens=base.max_tokens, temperature=base.temperature,
+        priority=qos_priority, tenant=qos_tenant, deadline_ms=qos_deadline_ms,
+      )
     prompt_ids = list(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else []
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
@@ -637,7 +726,7 @@ class ChatGPTAPI:
       try:
         await asyncio.wait_for(
           asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))),
-          timeout=self.response_timeout,
+          timeout=self._timeout_for(request_id),
         )
       except asyncio.TimeoutError:
         cancel = getattr(self.node, "cancel_request", None)
@@ -646,7 +735,7 @@ class ChatGPTAPI:
         raise
       all_tokens: list[int] = []
       while True:
-        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self._timeout_for(request_id))
         all_tokens.extend(tokens)
         if is_finished:
           break
@@ -686,7 +775,7 @@ class ChatGPTAPI:
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
-      return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
+      return overloaded_response(e)
     except RingBudgetError as e:
       # Ahead-of-time refusal (node.py): the current ring cannot hold the
       # model — nothing was downloaded or loaded.
@@ -699,6 +788,7 @@ class ChatGPTAPI:
       return web.json_response({"detail": f"Error processing prompt: {e}"}, status=500)
     finally:
       self.token_queues.pop(request_id, None)
+      self._request_deadlines.pop(request_id, None)
       getattr(self.node, "request_options", {}).pop(request_id, None)
 
   async def _stream_completions_response(self, request, base, request_id, tokenizer, created, gen_task):
@@ -1041,6 +1131,7 @@ class ChatGPTAPI:
       print(f"[api] chat completions request: {data}")
     try:
       chat_request = parse_chat_request(data, self.default_model)
+      qos_priority, qos_tenant, qos_deadline_ms = parse_qos_fields(data, request.headers)
     except ValueError as e:
       return web.json_response({"error": str(e)}, status=400)
 
@@ -1072,14 +1163,21 @@ class ChatGPTAPI:
 
     self.token_queues[request_id] = asyncio.Queue()
     created = int(time.time())
+    if qos_deadline_ms is not None:
+      self._request_deadlines[request_id] = asyncio.get_event_loop().time() + min(self.response_timeout, qos_deadline_ms / 1e3)
     if hasattr(self.node, "set_request_options"):
       # Serving hints: a non-streaming request lets the node generate the
       # whole response in one compiled program (single device round-trip).
+      # QoS identity (priority/tenant/deadline) rides along for the batched
+      # scheduler's admission/fairness policy and the gRPC metadata path.
       self.node.set_request_options(
         request_id,
         stream=bool(chat_request.stream),
         max_tokens=chat_request.max_tokens,
         temperature=chat_request.temperature,
+        priority=qos_priority,
+        tenant=qos_tenant,
+        deadline_ms=qos_deadline_ms,
       )
     initial_state = None
     if images:
@@ -1119,7 +1217,7 @@ class ChatGPTAPI:
       try:
         await asyncio.wait_for(
           asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
-          timeout=self.response_timeout,
+          timeout=self._timeout_for(request_id),
         )
       except asyncio.TimeoutError:
         # The shielded generation would otherwise keep decoding (and keep its
@@ -1135,7 +1233,9 @@ class ChatGPTAPI:
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
-      return web.json_response({"error": {"message": str(e), "type": "overloaded_error"}}, status=429)
+      # Overload / rate-limit / deadline-shed: structured 429 + Retry-After
+      # (the QoS subclasses carry retry_after_ms from the drain estimate).
+      return overloaded_response(e)
     except RingBudgetError as e:
       # Ahead-of-time refusal (node.py): the current ring cannot hold the
       # model — nothing was downloaded or loaded.
@@ -1148,6 +1248,7 @@ class ChatGPTAPI:
       return web.json_response({"detail": f"Error processing prompt: {e}"}, status=500)
     finally:
       self.token_queues.pop(request_id, None)
+      self._request_deadlines.pop(request_id, None)
       # On multi-node rings the finishing node cleans its own copy; the
       # API-attached node must drop its entry here or it leaks per request.
       getattr(self.node, "request_options", {}).pop(request_id, None)
@@ -1159,11 +1260,22 @@ class ChatGPTAPI:
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     return "stop" if last_token in eos_set else "length"
 
+  def _timeout_for(self, request_id: str) -> float:
+    """Effective timeout for one WAIT of this request: the configured
+    ``response_timeout``, capped by the REMAINING end-to-end budget when
+    the request carries a ``deadline_ms`` (anchored at request start — a
+    generation making slow per-chunk progress still times out at its SLO
+    instead of resetting the clock every chunk)."""
+    deadline = self._request_deadlines.get(request_id)
+    if deadline is None:
+      return self.response_timeout
+    return min(self.response_timeout, max(deadline - asyncio.get_event_loop().time(), 0.0))
+
   async def _next_tokens(self, request_id, gen_task):
     """Next (tokens, finished) from the queue; surfaces a generation failure
     promptly instead of waiting out the full response timeout."""
     queue = self.token_queues[request_id]
-    deadline = asyncio.get_event_loop().time() + self.response_timeout
+    deadline = asyncio.get_event_loop().time() + self._timeout_for(request_id)
     while True:
       remaining = deadline - asyncio.get_event_loop().time()
       if remaining <= 0:
@@ -1316,7 +1428,7 @@ class ChatGPTAPI:
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
     all_tokens: list[int] = []
     while True:
-      tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self.response_timeout)
+      tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self._timeout_for(request_id))
       all_tokens.extend(tokens)
       if is_finished:
         break

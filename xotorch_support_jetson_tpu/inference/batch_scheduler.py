@@ -51,6 +51,14 @@ shapes:
   valid) subkeys than they would under ``XOT_TPU_SCHED_LOOKAHEAD=0`` — A/B
   comparisons of sampled traffic are per-request, not cross-request.
 
+Admission runs through the QoS layer (inference/qos.py, ``XOT_TPU_QOS``,
+default on): priority classes with anti-starvation aging, weighted-fair
+tenant selection, per-tenant token-bucket rate limits, deadline-aware
+shedding, and an overload policy that sheds/preempts ``batch`` work before
+rejecting ``interactive`` requests — preempted rows re-enqueue and RESUME
+token-identically (their prompt absorbs the tokens generated so far).
+``XOT_TPU_QOS=0`` restores the plain FIFO ``asyncio.Queue`` byte-for-byte.
+
 Enable with ``XOT_TPU_BATCHED=1`` (orchestration/node.py routes single-node
 full-shard prompts here). ``XOT_TPU_BATCH_SLOTS`` (default 4) and
 ``XOT_TPU_BATCH_CHUNK`` (default 8) size the pool and the emission cadence.
@@ -72,6 +80,7 @@ from ..orchestration.tracing import tracer
 from ..utils.helpers import DEBUG
 from ..utils.metrics import metrics
 from .engine import PromptTooLongError, ServerOverloadedError
+from .qos import DeadlineUnmeetableError, QosPolicy, QosQueue, priority_rank, qos_enabled
 
 PREFILL_BUCKET = 128
 
@@ -92,6 +101,10 @@ class _Request:
   future: asyncio.Future = None
   page_demand: int = 0  # pages still needed at the last failed paged admission
   t_submit: float = 0.0  # perf_counter at submit (queue-wait / TTFT histograms)
+  qos: object = None  # QosTicket (inference/qos.py) when the QoS layer is on
+  # Tokens generated before a QoS preemption: the resumed incarnation's
+  # prompt absorbs them, and every finish path reports carry + new.
+  carry_tokens: list = field(default_factory=list)
 
 
 @dataclass
@@ -162,7 +175,7 @@ class _Chunk:
 class BatchedServer:
   """Owns the slot pool and the decode loop for one engine."""
 
-  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None, lookahead: bool | None = None):
+  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None, lookahead: bool | None = None, qos: "QosPolicy | bool | None" = None):
     self.engine = engine
     # Device ops go through the engine's backend (inference/batch_ops.py):
     # single-device fused programs, or the pp-pipelined variants when the
@@ -204,7 +217,21 @@ class BatchedServer:
     self.decode_path = "dense"  # resolved per pool config in _ensure_cache
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
-    self.queue: asyncio.Queue[_Request] = asyncio.Queue()
+    # QoS layer (inference/qos.py): priority classes + per-tenant fair
+    # queueing + rate limits + deadline shedding. ``qos=None`` resolves from
+    # the env (XOT_TPU_QOS, default on); ``qos=False`` forces it off; a
+    # QosPolicy instance is used as-is (tests inject clocks/configs). With
+    # QoS OFF the queue is a plain asyncio.Queue and every QoS branch below
+    # is guarded — behavior is byte-identical to the FIFO baseline.
+    if qos is None:
+      self.qos = QosPolicy.from_env() if qos_enabled() else None
+    elif qos is True:
+      self.qos = QosPolicy.from_env()
+    elif qos is False:
+      self.qos = None
+    else:
+      self.qos = qos
+    self.queue: asyncio.Queue[_Request] = QosQueue(self.qos) if self.qos is not None else asyncio.Queue()
     # Page-starved requests park HERE, ahead of the queue, and retry first
     # each tick — a large prompt must not lose its position to later-arriving
     # small requests that would otherwise consume every freed page (ADVICE
@@ -244,15 +271,35 @@ class BatchedServer:
 
   # ------------------------------------------------------------- public API
 
-  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit) -> list:
+  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit, priority: str = "standard", tenant: str = "default", deadline_ms: float | None = None) -> list:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
-    ``emit(request_id, new_tokens, finished)`` as chunks complete."""
+    ``emit(request_id, new_tokens, finished)`` as chunks complete.
+
+    ``priority`` / ``tenant`` / ``deadline_ms`` feed the QoS layer (rate
+    limiting, deadline shedding, fair selection); all three are ignored when
+    QoS is disabled."""
+    tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+    ticket = None
+    if self.qos is not None:
+      ticket = self._qos_admit(request_id, int(tokens.shape[0]), int(max_tokens), priority, tenant, deadline_ms)
     if self.queue.qsize() + len(self._parked) >= self.max_queue:
-      metrics.inc("scheduler_rejections_total")
-      raise ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
+      # Under QoS, overload sheds strictly-lower-priority WAITING work first
+      # (a batch request yields its queue spot to interactive traffic); only
+      # when nothing outranked waits does the new request get rejected.
+      if self.qos is None or not self._shed_for(ticket):
+        metrics.inc("scheduler_rejections_total")
+        err = ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
+        if self.qos is not None:
+          # No service was consumed: give the rate-bucket charges back, or
+          # the compliant Retry-After retry would fail again as rate_limited.
+          self.qos.refund(ticket.tenant, int(tokens.shape[0]))
+          err.retry_after_ms = self.qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots)
+          metrics.inc("qos_rejected_total", labels={"class": ticket.priority})
+          tracer.stage(request_id, "rejected", {"reason": "queue_full", "class": ticket.priority, "tenant": ticket.tenant, "retry_after_ms": round(err.retry_after_ms, 1)}, terminal=True)
+        raise err
     req = _Request(
       request_id=request_id,
-      tokens=np.asarray(tokens, dtype=np.int32).reshape(-1),
+      tokens=tokens,
       max_tokens=int(max_tokens),
       temp=float(temp),
       top_k=int(top_k),
@@ -260,6 +307,7 @@ class BatchedServer:
       emit=emit,
       future=asyncio.get_event_loop().create_future(),
       t_submit=time.perf_counter(),
+      qos=ticket,
     )
     self._queued[request_id] = req
     metrics.inc("scheduler_submitted_total")
@@ -269,6 +317,133 @@ class BatchedServer:
     if self._loop_task is None or self._loop_task.done():
       self._loop_task = asyncio.create_task(self._run())
     return await req.future
+
+  def _qos_admit(self, request_id: str, prompt_tokens: int, max_tokens: int, priority, tenant, deadline_ms):
+    """QoS admission pass (rate limits, deadline shedding) — runs BEFORE the
+    request touches the queue so refused work costs nothing downstream.
+    Returns the request's QosTicket or raises a 429-mapped error; refusals
+    land as terminal stages on the request timeline so
+    ``GET /v1/requests/{id}/timeline`` explains why it never ran."""
+    qos = self.qos
+    ticket = qos.ticket(priority, tenant, deadline_ms, prompt_tokens)
+    metrics.inc("qos_submitted_total", labels={"class": ticket.priority})
+    try:
+      qos.check_rate(ticket.tenant, prompt_tokens)
+    except ServerOverloadedError as e:
+      metrics.inc("qos_rate_limited_total", labels={"tenant": ticket.tenant})
+      tracer.stage(request_id, "rate_limited", {
+        "tenant": ticket.tenant, "class": ticket.priority,
+        "retry_after_ms": round(getattr(e, "retry_after_ms", 0.0) or 0.0, 1),
+      }, terminal=True)
+      raise
+    if ticket.deadline_ms is not None:
+      est = qos.estimate_completion_ms(
+        queue_depth=self._queue_depth_ahead(ticket), n_slots=self.n_slots, max_tokens=max_tokens,
+      )
+      if est is not None and qos.should_shed(ticket.deadline_ms, est):
+        qos.refund(ticket.tenant, prompt_tokens)  # shed before any service
+        metrics.inc("qos_shed_total", labels={"reason": "deadline"})
+        tracer.stage(request_id, "shed", {
+          "reason": "deadline", "class": ticket.priority, "tenant": ticket.tenant,
+          "estimated_ms": round(est, 1), "deadline_ms": ticket.deadline_ms,
+        }, terminal=True)
+        raise DeadlineUnmeetableError(
+          f"deadline {ticket.deadline_ms:.0f} ms unmeetable (estimated {est:.0f} ms to last token)",
+          retry_after_ms=qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots),
+        )
+    return ticket
+
+  def _queue_depth_ahead(self, ticket) -> int:
+    """Waiting work the QoS selection would actually serve at or before this
+    request's class: counting the whole queue would charge an interactive
+    deadline request for draining a batch backlog it outranks — shedding
+    exactly the traffic the QoS layer exists to protect. Parked (page-
+    starved) requests always count: they retry ahead of the queue."""
+    depths = self.queue.class_depths()
+    ahead = sum(n for cls, n in depths.items() if priority_rank(cls) <= ticket.rank)
+    return ahead + len(self._parked)
+
+  def _shed_for(self, ticket) -> bool:
+    """Overload policy: make queue room for ``ticket`` by shedding the
+    youngest strictly-lower-priority WAITING request (its client gets a
+    structured 429 with Retry-After). False when nothing outranked waits."""
+    victim = self.queue.shed_lowest(ticket.rank)
+    if victim is None:
+      return False
+    self._queued.pop(victim.request_id, None)
+    vt = victim.qos
+    if vt is not None:
+      # The victim consumed no service: one refusal, one charge.
+      self.qos.refund(vt.tenant, int(victim.tokens.shape[0]))
+    metrics.inc("qos_shed_total", labels={"reason": "overload"})
+    tracer.stage(victim.request_id, "shed", {
+      "reason": "overload", "class": vt.priority if vt else "standard",
+      "tenant": vt.tenant if vt else "default", "displaced_by": ticket.priority,
+    }, terminal=True)
+    err = ServerOverloadedError("shed under overload for higher-priority work")
+    err.retry_after_ms = self.qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots)
+    if not victim.future.done():
+      victim.future.set_exception(err)
+    return True
+
+  def _preempt_victim_for(self, req) -> int | None:
+    """Row of the resident slot a waiting ``req`` may preempt: the
+    lowest-priority resident strictly below the waiter's class, tie-broken
+    by most generated (the most over-budget row gives its slot back first).
+    None when preemption is off or nothing outranked is resident."""
+    if self.qos is None or not self.qos.cfg.preempt or req is None:
+      return None
+    ticket = getattr(req, "qos", None)
+    if ticket is None:
+      return None
+    best = None
+    for i, s in enumerate(self.slots):
+      if s is None or s.finished or s.cancelled:
+        continue
+      if s.pos + 1 >= self.max_seq:
+        # The row is at the context window: it finishes imminently (freeing
+        # the slot anyway), and its resume prompt could not re-admit.
+        continue
+      st = s.req.qos
+      srank = st.rank if st is not None else 1
+      if srank <= ticket.rank:
+        continue
+      key = (srank, s.generated)
+      if best is None or key > best[0]:
+        best = (key, i)
+    return best[1] if best is not None else None
+
+  def _preempt_resume(self, row: int) -> None:
+    """Preempt a resident row for higher-priority work and RE-ENQUEUE it
+    (park-style, not a failure): its pages release now, its prompt absorbs
+    the tokens generated so far, and the resumed prefill continues the
+    stream token-identically (greedy: same logits from the recomputed
+    cache). Runs only at a dispatch boundary with the pipeline drained, so
+    no in-flight chunk references the row."""
+    s = self.slots[row]
+    req = s.req
+    metrics.inc("qos_preemptions_total")
+    tracer.stage(req.request_id, "preempted", {"row": row, "generated": s.generated, "resume": True})
+    self._release_pages(s)
+    self.slots[row] = None
+    self._clear_row(row)
+    new_toks = s.out_tokens[len(req.carry_tokens):]
+    if new_toks:
+      req.tokens = np.concatenate([req.tokens, np.asarray(new_toks, np.int32)])
+    req.carry_tokens = list(s.out_tokens)
+    req.max_tokens -= s.generated
+    req.t_submit = 0.0  # queue-wait/TTFT were already observed at first admission
+    if req.qos is not None:
+      req.qos.resumed = True  # front of its lane; no second fair-queue charge
+      # Restart the ticket's AGING clock: the row already received service,
+      # and keeping the original t_enqueue would let a long-resident batch
+      # row out-score the very waiter that preempted it (score = rank -
+      # wait/aging) — it would reclaim the freed slot every boundary,
+      # re-running a full prefill each time while the interactive waiter
+      # starves. Front-of-lane placement preserves its intra-lane order.
+      req.qos.t_enqueue = self.qos.clock()
+    self._queued[req.request_id] = req
+    self.queue.put_nowait(req)
 
   def cancel(self, request_id: str) -> None:
     """Stop a request (client gone): its slot frees at the next chunk
@@ -378,6 +553,9 @@ class BatchedServer:
       metrics.set_gauge("page_pool_pages_free", self.allocator.n_free)
       metrics.set_gauge("page_pool_pages_cached", self.allocator.n_available - self.allocator.n_free)
       metrics.set_gauge("page_pool_utilization", round(1.0 - self.allocator.n_available / total, 6))
+    if self.qos is not None:
+      for cls, depth in self.queue.class_depths().items():
+        metrics.set_gauge("qos_queue_depth", depth, labels={"class": cls})
 
   def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
     # Mid-chunked-prefill rows are protected by ``taken``: _admit_pending
@@ -409,8 +587,29 @@ class BatchedServer:
         if not req.future.done():
           req.future.set_result([])
         return "done", None
+      if self.qos is not None and req.qos is not None and not req.carry_tokens and self.qos.deadline_expired(req.qos):
+        # The deadline lapsed while the request waited: shed it at the slot
+        # boundary instead of spending a prefill on a response its client
+        # has already given up on. A preempted-and-resumed request (carry
+        # tokens) is exempt — its client is already mid-stream, and a shed
+        # here would break the resume guarantee.
+        self.qos.refund(req.qos.tenant, int(req.tokens.shape[0]))  # never ran
+        metrics.inc("qos_shed_total", labels={"reason": "deadline"})
+        tracer.stage(req.request_id, "shed", {"reason": "deadline_expired", "class": req.qos.priority, "tenant": req.qos.tenant}, terminal=True)
+        raise DeadlineUnmeetableError(
+          f"deadline {req.qos.deadline_ms:.0f} ms expired while queued",
+          retry_after_ms=self.qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots),
+        )
       S = int(req.tokens.shape[0])
       if S + 1 >= self.max_seq:
+        if req.carry_tokens:
+          # A resumed row whose absorbed stream reached the context window:
+          # finish with what it already streamed (a "length" finish) — never
+          # a client-error 400 for a request that was validly admitted.
+          req.emit(req.request_id, [], True)
+          if not req.future.done():
+            req.future.set_result(list(req.carry_tokens))
+          return "done", None
         # A too-long prompt is a client error, not an empty completion.
         raise PromptTooLongError(f"prompt of {S} tokens exceeds the {self.max_seq}-token context window")
 
@@ -456,7 +655,11 @@ class BatchedServer:
         self.allocator.release(p)
       if not req.future.done():
         req.future.set_exception(e)
-      metrics.inc("scheduler_admission_failures_total")
+      if not isinstance(e, DeadlineUnmeetableError):
+        # Deadline sheds are intentional QoS outcomes (already counted in
+        # qos_shed_total); the failure counter must keep isolating real
+        # admission errors (too-long prompts, page-pool exhaustion).
+        metrics.inc("scheduler_admission_failures_total")
       self._cancelled_ids.discard(req.request_id)  # a raced cancel is moot now
       return "done", None
 
@@ -464,7 +667,11 @@ class BatchedServer:
     metrics.inc("scheduler_admissions_total")
     if req.t_submit:
       metrics.observe_hist("queue_wait_seconds", time.perf_counter() - req.t_submit)
-    tracer.stage(req.request_id, "admitted", {"row": row, "shared_pages": shared, "new_pages": fresh})
+    attrs = {"row": row, "shared_pages": shared, "new_pages": fresh}
+    if req.qos is not None:
+      attrs["class"] = req.qos.priority
+      attrs["tenant"] = req.qos.tenant
+    tracer.stage(req.request_id, "admitted", attrs)
 
   async def _admit_pending(self, woken: _Request | None = None) -> None:
     """Collect every admissible request — parked (page-starved) first, in
@@ -513,6 +720,14 @@ class BatchedServer:
       if r is not None:
         ready.append(r)
         taken.add(row)
+    if self.qos is not None and not self.queue.empty() and self._free_slot(taken) is None:
+      # Overload policy: a waiting request that outranks a resident row
+      # preempts it (the row re-enqueues and resumes token-identically)
+      # instead of queueing behind it — batch rows yield before interactive
+      # work is rejected. One victim per boundary bounds the churn.
+      victim = self._preempt_victim_for(self.queue.peek())
+      if victim is not None:
+        self._preempt_resume(victim)
     while (row := self._free_slot(taken)) is not None and not self.queue.empty():
       req = self.queue.get_nowait()
       status, r = self._prepare(req, row, reserve=reserve, others_active=bool(ready))
@@ -714,6 +929,10 @@ class BatchedServer:
       req=req, pos=int(req.tokens.shape[0]), generated=1, last_token=first,
       shared_pages=r.shared_pages, pages=list(r.new_pages), chain_keys=r.chain_keys,
     )
+    if req.carry_tokens:
+      # Resumed after a QoS preemption: the finish paths report carry + new
+      # (``generated``/``max_tokens`` already net out the carried span).
+      slot.out_tokens.extend(req.carry_tokens)
     slot.out_tokens.append(first)
     if req.t_submit:
       metrics.observe_hist("ttft_seconds", time.perf_counter() - req.t_submit)
@@ -1026,6 +1245,11 @@ class BatchedServer:
           # the boundary where coverage first becomes possible flips this
           # gate and the waiter admits then.
           admissible = self._free_slot() is not None and (not self.queue.empty() or self._parked_admissible())
+          if not admissible and self.qos is not None and self._free_slot() is None and not self.queue.empty() and self._preempt_victim_for(self.queue.peek()) is not None:
+            # A waiting request outranks a resident row: drain so the next
+            # boundary's admission pass can preempt-and-admit — interactive
+            # work must not chain behind a saturated batch pipeline.
+            admissible = True
           if not self.lookahead or self._prefilling or admissible:
             await self._settle(inflight)
             inflight = None

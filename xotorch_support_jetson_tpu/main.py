@@ -43,7 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--discovery-config-path", type=str, default=None)
   parser.add_argument("--wait-for-peers", type=int, default=0)
   parser.add_argument("--chatgpt-api-port", type=int, default=52415)
-  parser.add_argument("--chatgpt-api-response-timeout", type=int, default=900)
+  # None → the API resolves XOT_TPU_RESPONSE_TIMEOUT_S (default 900 s); an
+  # explicit flag still wins over the env.
+  parser.add_argument("--chatgpt-api-response-timeout", type=int, default=None)
   parser.add_argument("--max-generate-tokens", type=int, default=10000)
   parser.add_argument("--inference-engine", type=str, default="jax", choices=list(inference_engine_classes))
   parser.add_argument("--temp", "--default-temp", dest="temp", type=float, default=0.6)

@@ -13,7 +13,9 @@ metric families (``peer_rpc_seconds{peer,method}``, bytes out/in, failures).
 ``health_check`` piggybacks a four-timestamp monotonic-clock echo (metadata
 ``x-clock-*``) that feeds the NTP-style per-peer offset estimator
 (orchestration/clocksync.py) — the basis for normalizing remote timeline
-fragments into the local clock domain.
+fragments into the local clock domain. ISSUE 5: data-plane RPCs also carry
+the request's QoS identity (``x-qos-priority``/``-tenant``/``-deadline-ms``
+from the qos_wire registry) so the receiving node enforces the same policy.
 """
 
 from __future__ import annotations
@@ -24,6 +26,7 @@ import time
 import grpc
 import numpy as np
 
+from ...inference.qos import qos_metadata
 from ...inference.shard import Shard
 from ...inference.state import InferenceState
 from ...orchestration.clocksync import clock_sync
@@ -158,6 +161,11 @@ class GRPCPeerHandle(PeerHandle):
       # (context.peer() is an ephemeral transport address — useless for
       # joining against the client side's per-link keys).
       metadata.append(("x-origin-node", self.origin_id))
+    if request_id:
+      # QoS identity (priority/tenant/deadline) rides the same metadata path
+      # as the traceparent, so the receiving node enforces the same policy
+      # (inference/qos.py; grpc_server adopts via _adopt_qos).
+      metadata.extend(qos_metadata(request_id))
     metadata = tuple(metadata) or None
     bytes_out = proto_payload_bytes(request)
     labels = {"peer": self._id, "method": method}

@@ -14,6 +14,10 @@ also lands in ``grpc_handler_seconds{method}`` / ``grpc_deserialize_seconds
 {method}``. ``HealthCheck`` answers the clock echo: the client's ``x-clock
 -t0`` is bounced back with this node's monotonic receive/send times in
 trailing metadata (``x-clock-t1``/``-t2``) for NTP-style offset estimation.
+
+ISSUE 5: the same handlers adopt the sender's QoS identity from ``x-qos-*``
+metadata (``_adopt_qos``) so a non-head node enforces the same priority/
+tenant/deadline policy the origin's API attached.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from concurrent import futures
 
 import grpc
 
+from ...inference.qos import QOS_META_DEADLINE, QOS_META_PRIORITY, QOS_META_TENANT, qos_wire
 from ...orchestration.tracing import node_now_ns, parse_traceparent, tracer
 from ...utils.helpers import DEBUG
 from . import node_service_pb2 as pb
@@ -131,6 +136,36 @@ class GRPCServer:
       tracer.request_context(request_id, header)
     return parsed[1] if parsed else None
 
+  def _adopt_qos(self, request_id: str, context) -> None:
+    """Adopt the sender's QoS identity from ``x-qos-*`` metadata (the same
+    path the traceparent rides): registered in the request options so a
+    batched scheduler on THIS node enforces the same priority/tenant/
+    deadline policy the origin's API attached (inference/qos.py)."""
+    if not request_id:
+      return
+    opts = getattr(self.node, "request_options", {}).get(request_id)
+    if opts and ("priority" in opts or "tenant" in opts or "deadline_ms" in opts):
+      # Already adopted: SendTensor fires once per token per hop on a ring
+      # decode, and the identity cannot change mid-request — one adoption
+      # per request, not three locked registry writes per token.
+      return
+    priority = _meta_get(context, QOS_META_PRIORITY)
+    tenant = _meta_get(context, QOS_META_TENANT)
+    deadline_raw = _meta_get(context, QOS_META_DEADLINE)
+    if priority is None and tenant is None and deadline_raw is None:
+      return
+    deadline_ms = None
+    if deadline_raw is not None:
+      try:
+        deadline_ms = float(deadline_raw)
+      except (TypeError, ValueError):
+        deadline_ms = None  # a corrupt deadline must not break the RPC
+    try:
+      self.node.set_request_options(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms)
+    except Exception:  # noqa: BLE001 — QoS adoption must never fail a data RPC
+      pass
+    qos_wire.mark_seen(request_id, self.node.id, priority=priority, tenant=tenant, deadline_ms=deadline_ms)
+
   def _record_server_hop(self, request_id: str, method: str, context, *, t_start_ns: int, hop_id: str | None, deserialize_s: float, handler_s: float, payload_bytes: int) -> None:
     from ...utils.metrics import metrics
 
@@ -165,6 +200,7 @@ class GRPCServer:
     t_arrive = node_now_ns(self.node.id)
     t0 = time.perf_counter()
     hop_id = self._join_trace(request.request_id, context)
+    self._adopt_qos(request.request_id, context)
     t_des = time.perf_counter()
     shard = proto_to_shard(request.shard)
     state = proto_to_state(request.inference_state) if request.HasField("inference_state") else None
@@ -182,6 +218,7 @@ class GRPCServer:
     t_arrive = node_now_ns(self.node.id)
     t0 = time.perf_counter()
     hop_id = self._join_trace(request.request_id, context)
+    self._adopt_qos(request.request_id, context)
     t_des = time.perf_counter()
     shard = proto_to_shard(request.shard)
     tensor = proto_to_tensor(request.tensor)

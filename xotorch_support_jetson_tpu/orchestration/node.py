@@ -150,18 +150,26 @@ class Node:
 
   # --------------------------------------------------------------- serving
 
-  def set_request_options(self, request_id: str, *, stream: bool | None = None, max_tokens: int | None = None, temperature: float | None = None, top_k: int | None = None) -> None:
+  def set_request_options(self, request_id: str, *, stream: bool | None = None, max_tokens: int | None = None, temperature: float | None = None, top_k: int | None = None, priority: str | None = None, tenant: str | None = None, deadline_ms: float | None = None) -> None:
     """Per-request serving hints set by the API before ``process_prompt``.
 
     ``stream=False`` lets the fast decode path generate the entire response
     in one compiled program (single host round-trip) instead of streaming
     chunks; ``max_tokens``/``temperature``/``top_k`` override the node
-    defaults for this request only.
+    defaults for this request only. ``priority``/``tenant``/``deadline_ms``
+    feed the batched scheduler's QoS layer and are registered in the QoS
+    wire registry so data-plane RPCs carry them as ``x-qos-*`` metadata
+    (inference/qos.py) — a non-head node that runs the scheduler enforces
+    the same policy.
     """
     opts = self.request_options.setdefault(request_id, {})
-    for k, v in (("stream", stream), ("max_tokens", max_tokens), ("temperature", temperature), ("top_k", top_k)):
+    for k, v in (("stream", stream), ("max_tokens", max_tokens), ("temperature", temperature), ("top_k", top_k), ("priority", priority), ("tenant", tenant), ("deadline_ms", deadline_ms)):
       if v is not None:
         opts[k] = v
+    if priority is not None or tenant is not None or deadline_ms is not None:
+      from ..inference.qos import qos_wire
+
+      qos_wire.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, node_id=self.id)
 
   def _request_limits(self, request_id: str) -> tuple[int, float, int]:
     opts = self.request_options.get(request_id, {})
@@ -373,9 +381,12 @@ class Node:
       self.trigger_on_token_callbacks(rid, list(new_tokens), finished, start_pos=start)
       asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished, start_pos=start))
 
+    opts = self.request_options.get(request_id, {})
     try:
       await engine.get_batched_server().submit(
-        request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit
+        request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
+        priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
+        deadline_ms=opts.get("deadline_ms"),
       )
     finally:
       self._finish_request(request_id)
@@ -704,6 +715,9 @@ class Node:
   def _finish_request(self, request_id: str) -> None:
     self.outstanding_requests.pop(request_id, None)
     self.request_options.pop(request_id, None)
+    # The QoS wire registry entry is NOT popped here: late broadcasts may
+    # still reference it, and the registry is LRU-bounded (inference/qos.py
+    # MAX_WIRE_ENTRIES) so it cannot grow without bound.
     self._request_t0.pop(request_id, None)
     self._ttft_observed.discard(request_id)
     self.cancelled_requests.discard(request_id)

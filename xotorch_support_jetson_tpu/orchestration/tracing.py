@@ -276,18 +276,26 @@ class Tracer:
 
   # -------------------------------------------------------- stage timelines
 
-  def stage(self, request_id: str, stage: str, attributes: dict | None = None, node: str | None = None) -> None:
+  def stage(self, request_id: str, stage: str, attributes: dict | None = None, node: str | None = None, terminal: bool = False) -> None:
     """Mark a request-lifecycle stage (queued/admitted/prefill_chunk/decode/
     detokenize/…). Cheap: one dict append under the lock; repeated stages
     (each prefill chunk) append their own events. Events after the request
     finished (e.g. the API's detokenize following a blocking generation) are
     still recorded — the timeline is an LRU entry, not live request state.
     ``node`` labels the event for cross-node merging and routes the
-    timestamp through the (test-skewable) per-node clock."""
+    timestamp through the (test-skewable) per-node clock. ``terminal``
+    (ISSUE 5: shed / rate_limited / rejected refusals) finalizes the
+    timeline at this event, so a request the QoS layer refused BEFORE it
+    ever ran still serves a finished timeline explaining why — even on
+    paths where no ``end_request`` follows; a later ``end_request`` is a
+    no-op on the already-finished entry."""
     now = node_now_ns(node)
     with self._lock:
       tl = self._timeline_locked(request_id, now)
       tl["events"].append({"stage": stage, "t_ns": now, "node": node, "attributes": dict(attributes or {})})
+      if terminal and not tl.get("finished"):
+        tl["end_ns"] = now
+        tl["finished"] = True
       self.timelines.move_to_end(request_id)
 
   def _timeline_locked(self, request_id: str, now: int) -> dict:
@@ -307,7 +315,25 @@ class Tracer:
         "hop_agg": {},
       }
       while len(self.timelines) > MAX_TIMELINES:
-        self.timelines.popitem(last=False)
+        # Evict the oldest FINISHED timeline first: a QoS refusal flood
+        # (each refusal is a one-event finished timeline) must not evict
+        # live in-flight requests' timelines exactly during the overload
+        # they would explain. Protection is bounded at half the capacity —
+        # beyond that many unfinished entries (leaked/abandoned requests),
+        # plain oldest-first eviction resumes so zombies can't pin the LRU.
+        victim = None
+        unfinished = 0
+        for rid, entry in self.timelines.items():
+          if entry.get("finished"):
+            victim = rid
+            break
+          unfinished += 1
+          if unfinished > MAX_TIMELINES // 2:
+            break
+        if victim is None:
+          self.timelines.popitem(last=False)
+        else:
+          del self.timelines[victim]
     elif tl.get("trace_id") is None:
       ctx = self.contexts.get(request_id)
       if ctx:

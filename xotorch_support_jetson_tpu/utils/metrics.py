@@ -188,6 +188,12 @@ class Metrics:
         return self._labeled_counters.get(name, {}).get(_label_key(labels), 0.0)
       return self.counters.get(name, 0.0)
 
+  def counter_sum(self, name: str) -> float:
+    """Total across a counter family: the unlabeled value plus every labeled
+    series (e.g. ``qos_shed_total`` regardless of reason)."""
+    with self._lock:
+      return self.counters.get(name, 0.0) + sum(self._labeled_counters.get(name, {}).values())
+
   def timer(self, name: str):
     metrics = self
 
