@@ -67,6 +67,18 @@ def gate_overload(shed_rate: float | None) -> float | None:
   return float(shed_rate) if 0.0 <= shed_rate <= 0.95 else None
 
 
+def gate_kv_tier(value: float | None, lo: float = 0.01, hi: float = 1000.0) -> float | None:
+  """Sanity-gate the KV-tier round's numbers (same drift-gate pattern).
+  Spill/restore bandwidths outside [0.01, 1000] GB/s are timing artifacts
+  (an early block_until_ready return can report a PCIe copy at impossible
+  rates; a tunnel stall can report near-zero), and the recompute/restore
+  resume ratio rides the same gate with its own bounds — drop artifacts
+  rather than record them."""
+  if value is None:
+    return None
+  return float(value) if lo <= value <= hi else None
+
+
 def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, where: dict | None = None) -> float | None:
   """Quantile of a LABELED histogram family's growth between two registry
   snapshots, aggregated across every label series (the per-peer-link RPC
@@ -723,6 +735,138 @@ def main() -> None:
       ov_server.shutdown()
     ov_server = ov_eng = None
 
+  # KV tier round (ISSUE 6, behind gate_kv_tier): raw spill/restore copy
+  # bandwidth over the real paged pool, open multi-turn sessions held with
+  # the pool oversubscribed ~4x, and the preempt-resume recompute-vs-restore
+  # A/B from the request timelines. Null on CPU rounds (tests/test_kv_tier.py
+  # pins the behavior there).
+  kv_spill_gbps = None
+  kv_restore_gbps = None
+  open_sessions_per_node = None
+  preempt_resume_ms_recompute = None
+  preempt_resume_ms_restore = None
+  preempt_resume_ms_recompute_vs_restore = None
+  kv_eng = kv_server = None
+  kv_env = {}
+  try:
+    if not on_accel:
+      raise RuntimeError("skip on cpu")
+    import asyncio
+
+    from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+    from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+    from xotorch_support_jetson_tpu.inference.kv_tier import gather_pages, scatter_pages
+    from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+    from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+    # --- spill/restore bandwidth: 128 pages in one batched copy each way.
+    kv_ps, kv_n = 64, 128
+    kv_pool = init_paged_pool(cfg, shard.n_shard_layers, 2 * kv_n + 1, kv_ps)
+    kv_pages = list(range(1, kv_n + 1))
+    dev, nn = gather_pages(kv_pool, kv_pages)  # warm (compile + first copy)
+    host = {k: np.asarray(v)[:, :nn] for k, v in dev.items()}
+    page_bytes = sum(int(np.prod(a.shape[2:])) * a.shape[0] * a.dtype.itemsize for a in host.values())
+    t0 = time.perf_counter()
+    dev, nn = gather_pages(kv_pool, kv_pages)
+    host = {k: np.asarray(v)[:, :nn] for k, v in dev.items()}
+    kv_spill_gbps = gate_kv_tier(round(page_bytes * kv_n / (time.perf_counter() - t0) / 1e9, 3))
+    kv_pool = scatter_pages(kv_pool, kv_pages, host)  # warm
+    jax.block_until_ready(jax.tree_util.tree_leaves(kv_pool))
+    t0 = time.perf_counter()
+    kv_pool = scatter_pages(kv_pool, kv_pages, host)
+    jax.block_until_ready(jax.tree_util.tree_leaves(kv_pool))
+    kv_restore_gbps = gate_kv_tier(round(page_bytes * kv_n / (time.perf_counter() - t0) / 1e9, 3))
+    del kv_pool, dev, host
+
+    # --- open sessions with the pool oversubscribed ~4x: 48 two-turn chat
+    # sessions on an 8-slot server whose pool holds ~1/4 of their history.
+    n_sessions, n_slots_kv = 48, 8
+    kv_env = {"XOT_TPU_PAGE_SIZE": os.environ.get("XOT_TPU_PAGE_SIZE"), "XOT_TPU_BATCH_PAGES": os.environ.get("XOT_TPU_BATCH_PAGES"), "XOT_TPU_KV_TIER": os.environ.get("XOT_TPU_KV_TIER")}
+    os.environ["XOT_TPU_PAGE_SIZE"] = "64"
+    os.environ["XOT_TPU_BATCH_PAGES"] = "37"  # ~(48 sessions x 3 pages) / 4
+    os.environ.pop("XOT_TPU_KV_TIER", None)
+    kv_eng = JaxShardedInferenceEngine(use_local_mesh=False)
+    kv_eng.load_test_model(shard, cfg, qp)
+    kv_server = BatchedServer(kv_eng, n_slots=n_slots_kv, chunk=8, max_queue=2 * n_sessions, qos=False)
+    rng_kv = np.random.default_rng(31)
+
+    async def kv_sessions():
+      done = 0
+
+      async def one(i: int):
+        nonlocal done
+        prompt = rng_kv.integers(1, cfg.vocab_size, (128,)).astype(np.int32).tolist()
+        for turn in range(2):
+          out = await kv_server.submit(f"kv-{i}-{turn}", np.asarray(prompt, np.int32), max_tokens=16, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+          prompt = prompt + out + [int(rng_kv.integers(1, cfg.vocab_size))]
+        done += 1
+
+      await asyncio.gather(*(one(i) for i in range(n_sessions)), return_exceptions=True)
+      return done
+
+    open_sessions_per_node = asyncio.run(kv_sessions())
+    kv_server.shutdown()
+    kv_server = None
+
+    # --- preempt-resume A/B: resume gap (preempted -> next decode stage on
+    # the request timeline) with the tier restoring vs recomputing prefill.
+    def resume_gap_ms(tier_on: bool) -> float | None:
+      if tier_on:
+        os.environ.pop("XOT_TPU_KV_TIER", None)
+      else:
+        os.environ["XOT_TPU_KV_TIER"] = "0"
+      eng = JaxShardedInferenceEngine(use_local_mesh=False)
+      eng.load_test_model(shard, cfg, qp)
+      server = BatchedServer(eng, n_slots=1, chunk=8, qos=True)
+      rid = f"kv-pre-{tier_on}"
+      prompt = rng_kv.integers(1, cfg.vocab_size, (512,)).astype(np.int32)  # prefill worth skipping
+
+      async def drive():
+        started = asyncio.Event()
+        emitted = []
+
+        def emit(r, toks, fin):
+          if r == rid:
+            emitted.extend(toks)
+            if len(emitted) >= 8:
+              started.set()
+
+        bg = asyncio.create_task(server.submit(rid, prompt, max_tokens=64, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch"))
+        await asyncio.wait_for(started.wait(), timeout=120)
+        await server.submit("kv-vip", prompt[:64], max_tokens=8, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None, priority="interactive")
+        await asyncio.wait_for(bg, timeout=240)
+
+      try:
+        asyncio.run(drive())
+        tl = tracer.timeline(rid)
+        if tl is None:
+          return None
+        t_pre = next((e["at_ms"] for e in tl["events"] if e["stage"] == "preempted"), None)
+        if t_pre is None:
+          return None
+        t_dec = next((e["at_ms"] for e in tl["events"] if e["stage"] == "decode" and e["at_ms"] > t_pre), None)
+        return None if t_dec is None else round(t_dec - t_pre, 2)
+      finally:
+        server.shutdown()
+
+    preempt_resume_ms_restore = resume_gap_ms(True)
+    preempt_resume_ms_recompute = resume_gap_ms(False)
+    if preempt_resume_ms_restore and preempt_resume_ms_recompute:
+      preempt_resume_ms_recompute_vs_restore = gate_kv_tier(
+        round(preempt_resume_ms_recompute / preempt_resume_ms_restore, 4), lo=1.0 / 3.0, hi=100.0
+      )
+  except Exception:  # noqa: BLE001 — optional section: keep the bench line printing
+    pass
+  finally:
+    if kv_server is not None:
+      kv_server.shutdown()
+    kv_server = kv_eng = None
+    for k, v in kv_env.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+
   # Speculative decoding (XOT_TPU_SPEC_DECODE=int8, models/decoder.py
   # fused_speculative_generate): greedy int8 self-draft + bf16 target in one
   # while_loop. On these RANDOM weights logits are near-uniform, so the
@@ -1091,6 +1235,12 @@ def main() -> None:
         "overload_shed_rate": overload_shed_rate,
         "ttft_ms_p99_interactive_overload": ttft_ms_p99_interactive_overload,
         "ttft_ms_p99_batch_overload": ttft_ms_p99_batch_overload,
+        "kv_spill_gbps": kv_spill_gbps,
+        "kv_restore_gbps": kv_restore_gbps,
+        "open_sessions_per_node": open_sessions_per_node,
+        "preempt_resume_ms_recompute": preempt_resume_ms_recompute,
+        "preempt_resume_ms_restore": preempt_resume_ms_restore,
+        "preempt_resume_ms_recompute_vs_restore": preempt_resume_ms_recompute_vs_restore,
         "platform": platform,
         "device": str(jax.devices()[0]),
         "n_decode": n_decode,

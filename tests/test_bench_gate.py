@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_headline, gate_lookahead, gate_overload, plausible_value
+from bench import gate_headline, gate_kv_tier, gate_lookahead, gate_overload, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -89,6 +89,27 @@ def test_overload_gate_keeps_plausible_shed_rates():
   assert gate_overload(0.0) == 0.0
   assert gate_overload(0.25) == 0.25
   assert gate_overload(0.9) == 0.9
+
+
+def test_kv_tier_gate_keeps_plausible_values():
+  """ISSUE 6: spill/restore bandwidths inside [0.01, 1000] GB/s pass
+  through unchanged; the resume A/B ratio rides the same gate with its own
+  bounds."""
+  assert gate_kv_tier(1.5) == 1.5
+  assert gate_kv_tier(80.0) == 80.0
+  assert gate_kv_tier(0.01) == 0.01
+  assert gate_kv_tier(3.7, lo=1.0 / 3.0, hi=100.0) == 3.7
+
+
+def test_kv_tier_gate_drops_artifacts():
+  """A PCIe copy cannot run at terabytes/s (early block_until_ready return)
+  or at ~zero (tunnel stall) — both are timing artifacts, dropped rather
+  than recorded."""
+  assert gate_kv_tier(2000.0) is None
+  assert gate_kv_tier(0.0) is None
+  assert gate_kv_tier(-1.0) is None
+  assert gate_kv_tier(None) is None
+  assert gate_kv_tier(500.0, lo=1.0 / 3.0, hi=100.0) is None
 
 
 def test_overload_gate_drops_artifacts():

@@ -1,0 +1,641 @@
+"""KV memory hierarchy (inference/kv_tier.py) — ISSUE 6 coverage.
+
+Tentpole: host-RAM page tiering under the paged KV pool. Device-LRU
+evictions spill page copies host-side; admission restores host-resident
+chain runs into fresh device pages (copy-on-write: the host copies are
+retained); release paths donate GENERATED pages under extended chain keys,
+so QoS preempt-resume transfers KV instead of recomputing prefill, and
+idle multi-turn sessions park their history host-side between turns.
+
+Pinned here: incremental chain-key hashing equals the from-scratch scheme;
+PageAllocator invariants under admit/park/preempt/spill/restore churn; the
+tier manager's budget/LRU/pending-batch mechanics; ``XOT_TPU_KV_TIER=0``
+byte-identity with the single-tier scheduler; preempt-resume token identity
+through BOTH the device-cache and forced host-restore paths (lookahead on
+and off) against the FIFO solo baseline; > n_slots concurrent multi-turn
+sessions on one node with the pool oversubscribed; parked/unparked timeline
+stages; restore-failure fallback to recompute; and the cluster prefix
+registry round-tripping over a real two-node gRPC cluster.
+"""
+
+import asyncio
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_batched import _single_row_reference
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.kv_tier import KvTierManager, PrefixRegistry, prefix_registry
+from xotorch_support_jetson_tpu.inference.paging import PageAllocator
+from xotorch_support_jetson_tpu.inference.qos import QosConfig, QosPolicy
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+
+
+def _engine():
+  params, shard = full_model_params(KEY, CFG)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  return engine, params, shard
+
+
+# ---------------------------------------------------- chain-key hashing
+
+
+def test_chain_keys_extend_matches_from_scratch_scheme():
+  """Satellite: the incremental chain carries the running hash forward.
+  Pinned key-equal to the O(pages²) from-scratch scheme (rehash the whole
+  chain for every key i), and extension from any prefix equals the full
+  build — so a slot extending its prompt keys over generated tokens at
+  release produces exactly the keys a fresh admission will compute."""
+  ps = 4
+  toks = list(range(100, 123))  # 5 full pages + a partial tail
+
+  def from_scratch(tokens, page_size):
+    # The reference scheme: key i walks pages 0..i every time.
+    arr = np.asarray(tokens, dtype=np.int64)
+    keys = []
+    for i in range(len(arr) // page_size):
+      prev = b""
+      for j in range(i + 1):
+        prev = hashlib.blake2b(prev + arr[j * page_size : (j + 1) * page_size].tobytes(), digest_size=16).digest()
+      keys.append(prev)
+    return keys
+
+  full = PageAllocator.chain_keys(toks, ps)
+  assert full == from_scratch(toks, ps)
+  assert len(full) == len(toks) // ps
+  for cut in range(len(full) + 1):
+    assert PageAllocator.chain_keys_extend(full[:cut], toks, ps) == full
+  # Extending over a longer absorbed sequence only hashes the NEW pages and
+  # keeps the shared prefix keys identical (the donation/admission join).
+  longer = toks + list(range(7))
+  ext = PageAllocator.chain_keys_extend(full, longer, ps)
+  assert ext[: len(full)] == full
+  assert ext == PageAllocator.chain_keys(longer, ps)
+  # Same ids in any integer dtype hash identically (normalized to int64).
+  assert PageAllocator.chain_keys(np.asarray(toks, np.int32), ps) == full
+
+
+# ------------------------------------------------- allocator invariants
+
+
+def test_allocator_invariants_under_churn():
+  """Satellite: property-style churn over admit/release/donate/evict-spill/
+  restore-adopt sequences. After every operation: no page double-freed,
+  leaked, or in two states at once — free + cached + in-use always equals
+  the pool size — and the spill hook saw every evicted cached page exactly
+  once BEFORE it was reused."""
+  rng = np.random.default_rng(7)
+  ps = 4
+  alloc = PageAllocator(33, ps)  # 32 usable pages
+  spilled: list[tuple[bytes, int]] = []
+  alloc.spill_hook = lambda batch: spilled.extend(batch)
+
+  in_use: list[list[int]] = []  # private page sets held by fake requests
+  held_refs: list[list[int]] = []  # shared (refcounted) pages held
+  key_seq = 0
+
+  def check():
+    state = alloc.audit()
+    private = sum(len(p) for p in in_use)
+    assert state["free"] + state["cached"] + private == alloc.n_pages - 1
+    assert state["referenced"] <= state["cached"]
+    # Every key in this test is inserted under the cache exactly once, so
+    # the spill hook must deliver each (key, page) pair at most once across
+    # the whole run — a duplicate means a double-eviction/double-spill.
+    assert len(spilled) == len(set(spilled))
+
+  for step in range(600):
+    op = rng.integers(0, 5)
+    if op == 0:  # admit: alloc private pages (may evict-spill)
+      n = int(rng.integers(1, 5))
+      got = alloc.alloc(n)
+      if got is not None:
+        assert len(set(got)) == n
+        in_use.append(got)
+        held_refs.append([])
+    elif op == 1 and in_use:  # release: donate some pages, free the rest
+      i = int(rng.integers(0, len(in_use)))
+      pages, refs = in_use.pop(i), held_refs.pop(i)
+      for p in refs:
+        alloc.release(p)
+      to_free = []
+      for p in pages:
+        key_seq += 1
+        if rng.random() < 0.5 and alloc.insert_cached(f"k{key_seq}".encode(), p):
+          continue
+        to_free.append(p)
+      alloc.free(to_free)
+    elif op == 2:  # prefix lookup: take refs on cached pages
+      keys = [k for k, _ in spilled[-3:]] if rng.random() < 0.3 else []
+      got = alloc.lookup_prefix([k for k in keys if k in alloc._by_key][:2])
+      if in_use:
+        held_refs[int(rng.integers(0, len(held_refs)))].extend(got)
+      else:
+        for p in got:
+          alloc.release(p)
+    elif op == 3:  # restore-adopt: a host hit becomes a cached+referenced page
+      got = alloc.alloc(1)
+      if got is not None:
+        key_seq += 1
+        alloc.adopt_restored(f"r{key_seq}".encode(), got[0])
+        if in_use:
+          held_refs[int(rng.integers(0, len(held_refs)))].append(got[0])
+        else:
+          alloc.release(got[0])
+    elif op == 4 and in_use:  # preempt: release refs, free all private pages
+      i = int(rng.integers(0, len(in_use)))
+      pages, refs = in_use.pop(i), held_refs.pop(i)
+      for p in refs:
+        alloc.release(p)
+      alloc.free(pages)
+    check()
+
+  # Drain everything: the pool must account exactly, nothing leaked.
+  while in_use:
+    pages, refs = in_use.pop(), held_refs.pop()
+    for p in refs:
+      alloc.release(p)
+    alloc.free(pages)
+  state = alloc.audit()
+  assert state["free"] + state["cached"] == alloc.n_pages - 1
+  assert state["referenced"] == 0
+  # Every spill batch was delivered before its pages could be reused; keys
+  # seen by the hook were cache keys at eviction time.
+  assert all(isinstance(k, bytes) and isinstance(p, int) for k, p in spilled)
+
+
+# ------------------------------------------------- tier manager mechanics
+
+
+class _FakePool:
+  """Numpy-backed stand-in for the device pool: read/write callbacks with
+  the real contract, no jax involved."""
+
+  def __init__(self, n_pages: int, leafs=("k", "v")):
+    self.data = {name: rnginit(i, n_pages) for i, name in enumerate(leafs)}
+
+  def read(self, pages):
+    return {name: arr[:, pages] for name, arr in self.data.items()}, len(pages)
+
+  def write(self, pages, data):
+    for name, arr in self.data.items():
+      arr[:, pages] = data[name]
+
+
+def rnginit(seed, n_pages):
+  return np.random.default_rng(seed).standard_normal((2, n_pages, 3, 4, 5)).astype(np.float32)
+
+
+def test_tier_manager_spill_restore_cow_and_budget():
+  pool = _FakePool(16)
+  writes: list[tuple] = []
+
+  def write(pages, data):
+    writes.append((list(pages), data))
+    pool.write(pages, data)
+
+  page_bytes = sum(int(np.prod(a.shape[2:])) * a.shape[0] * a.dtype.itemsize for a in pool.data.values())
+  tier = KvTierManager(page_size=4, read_pages=pool.read, write_pages=write,
+                       budget_bytes=page_bytes * 3, max_inflight=1)
+  keys = [f"key{i}".encode() for i in range(5)]
+  golden = {k: {n: pool.data[n][:, i + 1].copy() for n in pool.data} for i, k in enumerate(keys)}
+
+  tier.spill([(keys[0], 1), (keys[1], 2)])
+  tier.spill([(keys[2], 3)])
+  assert tier.host_pages == 3 and tier.host_bytes == page_bytes * 3
+  assert tier.host_run(keys, 0, 5) == keys[:3]
+  assert tier.host_run(keys, 1, 2) == [keys[1]]
+  assert gm.gauges["kv_tier_host_pages"] == 3
+
+  # Restore into fresh pages; COW — the host entries are retained.
+  pool.data = {n: np.zeros_like(a) for n, a in pool.data.items()}  # "evicted" device side
+  tier.restore_into(keys[:2], [7, 8], request_id="r-restore")
+  assert writes and writes[-1][0] == [7, 8]
+  for i, k in enumerate(keys[:2]):
+    for n in golden[k]:
+      np.testing.assert_array_equal(pool.data[n][:, 7 + i], golden[k][n])
+  assert tier.host_has(keys[0]) and tier.host_pages == 3  # retained (COW)
+
+  # Budget: a 4th page evicts the host-LRU oldest (keys[2] was least
+  # recently touched — the restore LRU-bumped keys[0..1]).
+  tier.spill([(keys[3], 4)])
+  assert tier.host_pages == 3 and not tier.host_has(keys[2])
+  assert tier.host_has(keys[0]) and tier.host_has(keys[3])
+
+  # A restore of an evicted key raises; the scheduler treats that as "fall
+  # back to recompute".
+  with pytest.raises(KeyError):
+    tier.restore_into([keys[2]], [9])
+
+  # Timeline stage landed on the restoring request.
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+  tl = tracer.timeline("r-restore")
+  assert tl is not None and any(e["stage"] == "restored" for e in tl["events"])
+
+  tier.clear()
+  assert tier.host_pages == 0 and tier.host_bytes == 0
+
+
+def test_tier_manager_fifo_policy_and_pending_inflight():
+  """``XOT_TPU_KV_TIER_EVICT=fifo`` skips the LRU touch on restore;
+  ``max_inflight`` bounds pending async batches (older ones materialize)."""
+  pool = _FakePool(16)
+  page_bytes = sum(int(np.prod(a.shape[2:])) * a.shape[0] * a.dtype.itemsize for a in pool.data.values())
+  tier = KvTierManager(page_size=4, read_pages=pool.read, write_pages=pool.write,
+                       budget_bytes=page_bytes * 2, evict_policy="fifo", max_inflight=2)
+  keys = [f"f{i}".encode() for i in range(3)]
+  tier.spill([(keys[0], 1)])
+  tier.restore_into([keys[0]], [5])  # would LRU-bump under "lru"
+  tier.spill([(keys[1], 2)])
+  tier.spill([(keys[2], 3)])  # budget 2: evicts the FIFO-oldest = keys[0]
+  assert not tier.host_has(keys[0]) and tier.host_has(keys[1]) and tier.host_has(keys[2])
+  assert len(tier._pending) <= 2
+
+
+# --------------------------------------------- scheduler-level behaviors
+
+
+def _run(coro):
+  return asyncio.run(coro)
+
+
+def test_kv_tier_off_is_single_tier_pinned(monkeypatch):
+  """XOT_TPU_KV_TIER=0: no tier manager, no spill hook, donation limited to
+  PROMPT pages (generated pages free immediately) — and the stream is
+  byte-identical to the tier-on run (greedy decode: the tier only changes
+  where KV bytes live, never their values)."""
+  prompt, n = [3, 25, 9, 14, 7, 2, 81, 5, 6], 8
+
+  def serve(tier_on: bool):
+    if tier_on:
+      monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+    else:
+      monkeypatch.setenv("XOT_TPU_KV_TIER", "0")
+    monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+    engine, _, _ = _engine()
+    server = BatchedServer(engine, n_slots=2, chunk=2, qos=False)
+    out = _run(server.submit("t", np.asarray(prompt, np.int32), max_tokens=n, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None))
+    state = server.allocator.audit()
+    tier = server.tier
+    hook = server.allocator.spill_hook
+    server.shutdown()
+    return out, state, tier, hook
+
+  out_off, state_off, tier_off, hook_off = serve(False)
+  assert tier_off is None and hook_off is None
+  # Single-tier donation: exactly the prompt's full pages stay cached.
+  assert state_off["cached"] == len(prompt) // 4
+  out_on, state_on, tier_on, hook_on = serve(True)
+  assert tier_on is not None and hook_on is not None
+  assert out_on == out_off
+  # Tiered donation covers the generated full pages too: (S + n - 1) // ps.
+  assert state_on["cached"] == (len(prompt) + n - 1) // 4
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_preempt_resume_restore_token_identity(lookahead, monkeypatch):
+  """Acceptance: a preempted-then-resumed greedy stream with tiering ON
+  resumes by TRANSFER (its absorbed prompt hits the donated pages as a
+  prefix) and stays byte-identical to the FIFO solo baseline — which
+  test_qos.py separately pins equal to the recompute path — lookahead on
+  and off. The admission's reuse is asserted, not assumed."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")  # full pages exist at these lengths
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, lookahead=lookahead, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  p_batch, p_int = [3, 25, 9], [7, 1, 88, 42, 5]
+  n_batch, n_int = 24, 4
+  solo_batch = _single_row_reference(params, shard, p_batch, n_batch - 1)
+  solo_int = _single_row_reference(params, shard, p_int, n_int - 1)
+  before_pre = gm.counter_value("qos_preemptions_total")
+  before_hits = gm.counter_value("prefix_cache_hit_pages_total")
+  streams: dict[str, list] = {}
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      streams.setdefault(rid, []).extend(toks)
+      if rid == "bg" and len(streams["bg"]) >= 4:
+        started.set()
+
+    bg = asyncio.create_task(server.submit("bg", np.asarray(p_batch, np.int32), max_tokens=n_batch, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch"))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    out_int = await asyncio.wait_for(
+      server.submit("vip", np.asarray(p_int, np.int32), max_tokens=n_int, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"),
+      timeout=60,
+    )
+    return out_int, await asyncio.wait_for(bg, timeout=60)
+
+  out_int, out_bg = _run(run())
+  assert gm.counter_value("qos_preemptions_total") > before_pre
+  assert out_bg == solo_batch and streams["bg"] == solo_batch
+  assert out_int == solo_int
+  # The resume really reused donated pages (transfer, not recompute): the
+  # prefix-hit counter moved — the 3-token prompt alone can't fill a page,
+  # so the hits are the preempt donation's extended (generated-token) pages
+  # found device-cached at resume.
+  assert gm.counter_value("prefix_cache_hit_pages_total") > before_hits
+  assert all(s is None for s in server.slots)
+  server.allocator.audit()
+  server.shutdown()
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_preempt_resume_via_host_restore_identity(lookahead, monkeypatch):
+  """Acceptance (host path): the pool is sized so the preempting request's
+  own footprint EVICTS the victim's donated pages — they spill host-side,
+  and the resume restores them from the HOST tier. Stream identity against
+  the FIFO solo baseline still holds, and the restore counters prove the
+  path taken."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "6")  # 5 usable: vip's footprint alone
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, lookahead=lookahead, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  p_batch = [3, 25, 9]
+  p_int = [7, 1, 88, 42, 5, 11, 23, 4, 91, 33, 8, 17, 2]  # 13 tokens: 4 pages at admission, 5 by finish
+  n_batch, n_int = 10, 4
+  solo_batch = _single_row_reference(params, shard, p_batch, n_batch - 1)
+  solo_int = _single_row_reference(params, shard, p_int, n_int - 1)
+  before_pre = gm.counter_value("qos_preemptions_total")
+  before_spill = gm.counter_value("kv_tier_spilled_pages_total")
+  before_restore = gm.counter_value("kv_tier_restored_pages_total")
+  streams: dict[str, list] = {}
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      streams.setdefault(rid, []).extend(toks)
+      if rid == "bg" and len(streams["bg"]) >= 4:
+        started.set()
+
+    bg = asyncio.create_task(server.submit("bg", np.asarray(p_batch, np.int32), max_tokens=n_batch, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch"))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    out_int = await asyncio.wait_for(
+      server.submit("vip", np.asarray(p_int, np.int32), max_tokens=n_int, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"),
+      timeout=60,
+    )
+    return out_int, await asyncio.wait_for(bg, timeout=60)
+
+  out_int, out_bg = _run(run())
+  assert gm.counter_value("qos_preemptions_total") > before_pre
+  assert out_int == solo_int
+  assert out_bg == solo_batch and streams["bg"] == solo_batch
+  # The victim's donated pages were spilled by the vip's allocations and the
+  # resume restored at least one of them from HOST RAM.
+  assert gm.counter_value("kv_tier_spilled_pages_total") > before_spill
+  assert gm.counter_value("kv_tier_restored_pages_total") > before_restore
+  # Timeline surfacing: the resume carries a ``restored`` stage.
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+  tl = tracer.timeline("bg")
+  assert tl is not None and any(e["stage"] == "restored" for e in tl["events"])
+  assert all(s is None for s in server.slots)
+  server.allocator.audit()
+  server.shutdown()
+
+
+def test_open_sessions_exceed_slots_with_host_parking(monkeypatch):
+  """Acceptance: one node holds MORE concurrent multi-turn sessions than
+  n_slots by parking idle sessions' pages (device cache → host tier under
+  pressure) and restoring on the next turn. Every turn of every session is
+  token-identical to its solo greedy reference, the allocator invariant
+  stays green throughout, and the tier actually spilled and restored."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "13")  # 12 usable: ~2.5x oversubscribed
+  engine, params, shard = _engine()
+  n_slots, n_sessions, n_turns, per_turn = 2, 6, 3, 4
+  server = BatchedServer(engine, n_slots=n_slots, chunk=2, qos=False)
+  before_spill = gm.counter_value("kv_tier_spilled_pages_total")
+  before_restore = gm.counter_value("kv_tier_restored_pages_total")
+  peak_open = 0
+
+  async def session(s: int, results: list):
+    prompt = [10 + s, 40 + s, 70 + s]
+    for turn in range(n_turns):
+      rid = f"sess{s}-t{turn}"
+      out = await asyncio.wait_for(
+        server.submit(rid, np.asarray(prompt, np.int32), max_tokens=per_turn, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None),
+        timeout=120,
+      )
+      results.append((s, turn, list(prompt), out))
+      prompt = prompt + out + [5 + s + turn]  # next user turn
+      await asyncio.sleep(0.001 * s)  # idle between turns: pages park
+
+  async def run():
+    nonlocal peak_open
+    results: list = []
+    tasks = [asyncio.create_task(session(s, results)) for s in range(n_sessions)]
+    while any(not t.done() for t in tasks):
+      open_now = len({r.get_name() for r in tasks if not r.done()})
+      peak_open = max(peak_open, open_now)
+      if server.allocator is not None:  # created with the pool on first admit
+        server.allocator.audit()  # invariant green THROUGHOUT
+      await asyncio.sleep(0.01)
+    await asyncio.gather(*tasks)
+    return results
+
+  results = _run(run())
+  assert len(results) == n_sessions * n_turns
+  assert peak_open > n_slots  # more live sessions than slots, concurrently
+  for s, turn, prompt, out in results:
+    assert out == _single_row_reference(params, shard, prompt, per_turn - 1), (s, turn)
+  # The pool (12 pages) cannot hold 6 sessions' history (~5 pages each by
+  # turn 3): parking spilled host-side and later turns restored.
+  assert gm.counter_value("kv_tier_spilled_pages_total") > before_spill
+  assert gm.counter_value("kv_tier_restored_pages_total") > before_restore
+  server.allocator.audit()
+  server.shutdown()
+
+
+def test_parked_unparked_timeline_stages(monkeypatch):
+  """Satellite: a page-starved request's timeline carries ``parked`` and a
+  matching ``unparked`` with the measured wait, and the timeline's
+  top-level ``parked_ms`` explains the starvation span."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "6")  # 5 usable
+  engine, _, _ = _engine()
+  server = BatchedServer(engine, n_slots=2, chunk=2, qos=False)
+
+  async def run():
+    # hog: 13-token prompt -> 4 pages at admission, 5 in flight; starver
+    # can't get its 2 pages until hog finishes.
+    hog = asyncio.create_task(server.submit("hog", np.asarray(list(range(30, 43)), np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None))
+    await asyncio.sleep(0)
+    starver = asyncio.create_task(server.submit("starver", np.asarray([3, 25, 9, 14, 7], np.int32), max_tokens=3, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None))
+    await asyncio.wait_for(asyncio.gather(hog, starver), timeout=60)
+
+  _run(run())
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+  tl = tracer.timeline("starver")
+  assert tl is not None
+  stages = [e["stage"] for e in tl["events"]]
+  assert "parked" in stages and "unparked" in stages
+  assert stages.index("unparked") > stages.index("parked")
+  un = next(e for e in tl["events"] if e["stage"] == "unparked")
+  assert un["attributes"]["waited_ms"] > 0
+  assert tl["parked_ms"] > 0
+  server.shutdown()
+
+
+def test_restore_run_stops_at_device_cached_suffix(monkeypatch):
+  """Regression: pages evict in chain order, so a chain's SUFFIX can outlive
+  its evicted prefix in the device LRU while the whole chain is host-resident.
+  The restore run must stop at the first key still device-cached (adopting a
+  cached key would corrupt the key<->page maps); the admission still succeeds,
+  restores the evicted prefix from host, and streams token-identically."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, qos=False)
+  prompt = [3, 25, 9, 14, 7, 2, 81, 5, 6, 44, 12, 31, 19]  # 13 tokens: 3 full pages
+  solo = _single_row_reference(params, shard, prompt, 3)
+
+  async def run():
+    out1 = await server.submit("t1", np.asarray(prompt, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    assert out1 == solo
+    # Spill EVERY donated page host-side, then re-admit: the whole chain
+    # restores and is device-cached again (host copies retained, COW).
+    server.allocator._evict(len(server.allocator._lru))
+    out2 = await server.submit("t2", np.asarray(prompt, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    assert out2 == solo
+    # Evict only the LRU-oldest donated page — the chain's FIRST key — so
+    # the device holds the suffix while the host holds the whole chain.
+    server.allocator._evict(1)
+    before = gm.counter_value("kv_tier_restored_pages_total")
+    out3 = await server.submit("t3", np.asarray(prompt, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    assert out3 == solo  # would raise AssertionError without the run trim
+    assert gm.counter_value("kv_tier_restored_pages_total") > before
+
+  _run(run())
+  server.allocator.audit()
+  server.shutdown()
+
+
+def test_restore_failure_falls_back_to_recompute(monkeypatch):
+  """A failed device write on the restore path must cost only the missed
+  optimization: the pages stay private, prefill recomputes, and the stream
+  is still correct (carry/recompute is the pinned correctness fallback)."""
+  monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "6")
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=1, chunk=2, qos=False)
+  prompt = [3, 25, 9, 14, 7, 2, 81, 5]
+  solo = _single_row_reference(params, shard, prompt, 3)
+
+  async def run():
+    # Turn 1 caches the prompt pages; the follow-up turn would restore any
+    # host-spilled ones. Break the write path first.
+    out1 = await server.submit("a", np.asarray(prompt, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    assert out1 == solo
+    # Force every cached page host-side, then break restores.
+    server.allocator._evict(len(server.allocator._lru))
+
+    def broken_write(pages, data):
+      raise RuntimeError("injected restore failure")
+
+    monkeypatch.setattr(server.tier, "_write", broken_write)
+    p2 = prompt + out1 + [50]
+    out2 = await server.submit("b", np.asarray(p2, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None)
+    assert out2 == _single_row_reference(params, shard, p2, 3)
+
+  _run(run())
+  server.allocator.audit()
+  server.shutdown()
+
+
+# --------------------------------------------------- cluster prefix registry
+
+
+def test_prefix_registry_bounds_and_hints():
+  reg = PrefixRegistry(max_keys=4)
+  keys = [f"k{i}".encode() for i in range(6)]
+  reg.note(keys)
+  assert len(reg.local_hexes()) == 4  # bounded, most recent kept
+  assert reg.local_hexes()[0] == keys[-1].hex()  # most-recent-first
+  reg.update_remote("peer-a", [keys[0].hex(), "zz-not-hex", keys[1].hex()])
+  assert reg.locate(keys[0]) == ["peer-a"]
+  assert reg.locate(keys[5]) == []
+  # An advert REPLACES the previous one (snapshot semantics).
+  reg.update_remote("peer-a", [keys[2].hex()])
+  assert reg.locate(keys[0]) == [] and reg.locate(keys[2]) == ["peer-a"]
+  reg.forget_remote("peer-a")
+  assert reg.locate(keys[2]) == []
+  snap = reg.snapshot()
+  assert snap["local_keys"] == 4 and snap["remote"] == {}
+  reg.clear_local()
+  assert reg.local_hexes() == []
+
+
+@pytest.mark.asyncio
+async def test_prefix_registry_roundtrip_over_grpc_cluster():
+  """Acceptance: the cluster prefix registry round-trips over the REAL
+  two-node gRPC cluster — node1's advertised chain keys become visible to
+  node0's registry via prefix_pull/prefix_keys on the opaque-status
+  channel, and locate() resolves them to node1."""
+  from tests.test_networking import _make_cluster
+
+  nodes = await _make_cluster(2)
+  keys = [hashlib.blake2b(f"prefix-{i}".encode(), digest_size=16).digest() for i in range(3)]
+  try:
+    prefix_registry.clear()
+    prefix_registry.note(keys)  # both nodes share the process-global registry:
+    # node1's reply advertises these as ITS local keys, and node0's update
+    # lands them under remote["node1"] — the full wire round trip.
+    counts = await nodes[0].collect_cluster_prefixes(timeout=5.0)
+    assert counts.get("node1", 0) >= 3
+    for k in keys:
+      assert "node1" in prefix_registry.locate(k)
+    snap = prefix_registry.snapshot()
+    assert snap["remote"]["node1"] >= 3
+  finally:
+    prefix_registry.clear()
+    for node in nodes:
+      await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_kv_tier_api_endpoint():
+  """GET /v1/kv/tier surfaces the hierarchy: enabled flag, host occupancy,
+  spill/restore totals, and the prefix registry view."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from tests_support_stubs import NoDiscovery, StubServer
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  node = Node("kvtier-api-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/kv/tier")
+    assert resp.status == 200
+    body = await resp.json()
+    assert set(body) >= {"enabled", "host", "spilled_pages_total", "restored_pages_total", "prefix_registry"}
+    assert isinstance(body["prefix_registry"]["local_keys"], int)
+    # scope=cluster with no peers degrades gracefully.
+    resp = await client.get("/v1/kv/tier?scope=cluster")
+    assert resp.status == 200
+  finally:
+    await client.close()
+    await node.stop()

@@ -680,6 +680,13 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_qos_rejected_total",
   "xot_tpu_qos_rate_limited_total",
   "xot_tpu_qos_preemptions_total",
+  # KV memory hierarchy (ISSUE 6; registry hits labeled {scope})
+  "xot_tpu_kv_tier_spilled_pages_total",
+  "xot_tpu_kv_tier_spilled_bytes_total",
+  "xot_tpu_kv_tier_restored_pages_total",
+  "xot_tpu_kv_tier_restored_bytes_total",
+  "xot_tpu_kv_tier_host_evictions_total",
+  "xot_tpu_kv_prefix_registry_hits_total",
   "xot_tpu_peer_broadcast_failures_total",
   "xot_tpu_peer_rpc_bytes_sent_total",
   "xot_tpu_peer_rpc_bytes_received_total",
@@ -695,6 +702,9 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_page_pool_pages_cached",
   "xot_tpu_page_pool_utilization",
   "xot_tpu_qos_queue_depth",
+  "xot_tpu_kv_tier_host_pages",
+  "xot_tpu_kv_tier_host_bytes",
+  "xot_tpu_kv_tier_host_utilization",
   "xot_tpu_engine_sessions",
   "xot_tpu_peer_clock_offset_ms",
   "xot_tpu_peer_clock_uncertainty_ms",
@@ -705,6 +715,9 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_prefill_chunk_seconds",
   "xot_tpu_decode_chunk_seconds",
   "xot_tpu_sched_host_gap_seconds",
+  "xot_tpu_kv_tier_spill_seconds",
+  "xot_tpu_kv_tier_restore_seconds",
+  "xot_tpu_kv_tier_restore_pages_per_op",
   "xot_tpu_prefill_seconds",
   "xot_tpu_decode_step_seconds",
   # per-peer-link RPC attribution (ISSUE 4; labeled {peer,method} / {method})
@@ -739,8 +752,20 @@ def test_metric_name_snapshot_after_serving():
     "scheduler_rejections_total", "scheduler_parked_total",
     "scheduler_admission_failures_total", "scheduler_preemptions_total",
     "scheduler_page_starved_total", "prefix_cache_hit_pages_total",
+    "kv_tier_spilled_pages_total", "kv_tier_spilled_bytes_total",
+    "kv_tier_restored_pages_total", "kv_tier_restored_bytes_total",
+    "kv_tier_host_evictions_total",
   ):
     gm.inc(name, 0)
+  gm.inc("kv_prefix_registry_hits_total", 0, labels={"scope": "local"})
+  gm.set_gauge("kv_tier_host_pages", 0)
+  gm.set_gauge("kv_tier_host_bytes", 0)
+  gm.set_gauge("kv_tier_host_utilization", 0.0)
+  gm.observe_hist("kv_tier_spill_seconds", 0.0)
+  gm.observe_hist("kv_tier_restore_seconds", 0.0)
+  from xotorch_support_jetson_tpu.utils.metrics import SIZE_BUCKETS
+
+  gm.observe_hist("kv_tier_restore_pages_per_op", 0, buckets=SIZE_BUCKETS)
   gm.inc("grpc_rpcs_total", 0, labels={"method": "SendResult"})
   gm.inc("grpc_rpc_failures_total", 0, labels={"method": "SendResult"})
   gm.inc("qos_submitted_total", 0, labels={"class": "standard"})

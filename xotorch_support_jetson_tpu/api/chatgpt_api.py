@@ -346,6 +346,7 @@ class ChatGPTAPI:
     r.add_get("/metrics", self.handle_metrics)
     r.add_get("/v1/traces", self.handle_traces)
     r.add_get("/v1/requests/{request_id}/timeline", self.handle_request_timeline)
+    r.add_get("/v1/kv/tier", self.handle_kv_tier)
     r.add_post("/v1/profile", self.handle_profile)
     self._profiling = False  # one jax.profiler capture at a time
     r.add_get("/v1/topology", self.handle_get_topology)
@@ -466,6 +467,45 @@ class ChatGPTAPI:
     if tl is None:
       return web.json_response({"detail": f"no timeline for request {request_id}"}, status=404)
     return web.json_response(tl)
+
+  async def handle_kv_tier(self, request):
+    """GET /v1/kv/tier — the KV memory hierarchy's state (ISSUE 6): host
+    tier occupancy/budget, spill/restore totals, and the cluster prefix
+    registry (local advertised keys + each peer's advert size). This is how
+    session park/resume is surfaced: a parked multi-turn session's pages
+    show up as host-tier bytes here and as ``parked``/``unparked``/
+    ``spilled``/``restored`` stages on its request timelines.
+
+    ``?scope=cluster`` additionally refreshes the peer advertisements over
+    the gRPC opaque-status channel before reporting (best-effort: an
+    unreachable peer just keeps its last advert)."""
+    from ..inference.kv_tier import kv_tier_enabled, prefix_registry
+    from ..utils.metrics import metrics
+
+    if request.query.get("scope") == "cluster":
+      collect = getattr(self.node, "collect_cluster_prefixes", None)
+      if collect is not None:
+        try:
+          await collect()
+        except Exception:  # noqa: BLE001 — cluster refresh degrades to cached view
+          if DEBUG >= 1:
+            import traceback
+
+            traceback.print_exc()
+    tier = getattr(getattr(self.node.inference_engine, "_batched_server", None), "tier", None)
+    body = {
+      "enabled": kv_tier_enabled(),
+      "host": tier.stats() if tier is not None else {
+        # No live scheduler on this node (or tiering off): report the gauge
+        # view so the endpoint stays truthful instead of 404ing.
+        "host_pages": metrics.gauges.get("kv_tier_host_pages", 0),
+        "host_bytes": metrics.gauges.get("kv_tier_host_bytes", 0),
+      },
+      "spilled_pages_total": metrics.counter_value("kv_tier_spilled_pages_total"),
+      "restored_pages_total": metrics.counter_value("kv_tier_restored_pages_total"),
+      "prefix_registry": prefix_registry.snapshot(),
+    }
+    return web.json_response(body)
 
   async def handle_profile(self, request):
     """POST /v1/profile — on-demand jax.profiler capture to a directory.

@@ -16,6 +16,14 @@ tokens stream back to the host:
   are rounded UP to a multiple of pp so the rows split into equal groups.
 
 The engine picks one in ``JaxShardedInferenceEngine.batch_ops``.
+
+Since ISSUE 6 the contract also carries the KV memory hierarchy's page
+copies: ``read_pages`` starts a batched device→host gather of pool pages
+(async D2H — the host tier's spill path) and ``write_pages`` scatters host
+page data back into freshly allocated pages (the restore path). Both are
+generic over the pool's dict-of-leaves layout (inference/kv_tier.py
+``gather_pages``/``scatter_pages``), so the pp/sp placed pools inherit them
+— the page axis is global across every backend.
 """
 
 from __future__ import annotations
@@ -23,7 +31,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-class DecoderBatchOps:
+class _PageCopyMixin:
+  """Spill/restore page copies shared by every backend: the pool leaves all
+  keep the page axis at position 1 regardless of placement."""
+
+  def read_pages(self, pool, pages):
+    from .kv_tier import gather_pages
+
+    return gather_pages(pool, pages)
+
+  def write_pages(self, pool, pages, data):
+    from .kv_tier import scatter_pages
+
+    return scatter_pages(pool, pages, data)
+
+
+class DecoderBatchOps(_PageCopyMixin):
   """Single-device batched serving ops (the default)."""
 
   def __init__(self, engine):
@@ -80,7 +103,7 @@ class DecoderBatchOps:
     )
 
 
-class PPBatchOps:
+class PPBatchOps(_PageCopyMixin):
   """Batched serving over the pp pipeline (parallel/pp_batch.py)."""
 
   def __init__(self, engine, pp_batched):
@@ -118,7 +141,7 @@ class PPBatchOps:
     )
 
 
-class SPBatchOps:
+class SPBatchOps(_PageCopyMixin):
   """Batched serving over the sp x tp mesh (parallel/sp_batch.py): dense
   slot cache (sequence axis over sp) or the default paged pool (page-slot
   axis striped over sp — global page ids, host allocator unchanged)."""

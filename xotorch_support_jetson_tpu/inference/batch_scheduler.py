@@ -59,6 +59,20 @@ rejecting ``interactive`` requests — preempted rows re-enqueue and RESUME
 token-identically (their prompt absorbs the tokens generated so far).
 ``XOT_TPU_QOS=0`` restores the plain FIFO ``asyncio.Queue`` byte-for-byte.
 
+The page pool carries a KV MEMORY HIERARCHY (inference/kv_tier.py,
+``XOT_TPU_KV_TIER``, default on): pages evicted from the device prefix-cache
+LRU spill to a byte-budgeted host-RAM tier (batched gather +
+``copy_to_host_async``) instead of vanishing, and admission restores
+host-resident chain runs into fresh device pages — extending the device
+prefix hit without recomputing those tokens' prefill. Release paths donate a
+row's GENERATED pages too (under chain keys extended over the absorbed
+stream), so a preempted row's resume and an idle multi-turn session's next
+turn both find their whole history as a reusable prefix: preempt-resume
+becomes transfer-cost instead of recompute-cost, and parked sessions survive
+pool pressure host-side. ``XOT_TPU_KV_TIER=0`` restores the single-tier
+behavior byte-for-byte (``_Request.carry_tokens`` recompute stays the
+correctness fallback either way).
+
 Enable with ``XOT_TPU_BATCHED=1`` (orchestration/node.py routes single-node
 full-shard prompts here). ``XOT_TPU_BATCH_SLOTS`` (default 4) and
 ``XOT_TPU_BATCH_CHUNK`` (default 8) size the pool and the emission cadence.
@@ -105,6 +119,10 @@ class _Request:
   # Tokens generated before a QoS preemption: the resumed incarnation's
   # prompt absorbs them, and every finish path reports carry + new.
   carry_tokens: list = field(default_factory=list)
+  # perf_counter when the request first parked page-starved (0 = never):
+  # admission emits an ``unparked`` timeline stage with the waited span, so
+  # a timeline query explains page-starvation waits.
+  t_parked: float = 0.0
 
 
 @dataclass
@@ -214,6 +232,13 @@ class BatchedServer:
     self.allocator = None
     self.block_tables = None
     self.cache = None
+    # KV memory hierarchy (inference/kv_tier.py): host-RAM second tier under
+    # the page pool. Created with the pool in _ensure_cache (paged mode +
+    # XOT_TPU_KV_TIER, default on) and KEPT across cache rebuilds after a
+    # device failure — host entries are content-addressed copies, still
+    # valid against a fresh pool. Cleared at shutdown: a model swap changes
+    # the KV content behind the same token chains.
+    self.tier = None
     self.decode_path = "dense"  # resolved per pool config in _ensure_cache
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
@@ -423,8 +448,15 @@ class BatchedServer:
     s = self.slots[row]
     req = s.req
     metrics.inc("qos_preemptions_total")
-    tracer.stage(req.request_id, "preempted", {"row": row, "generated": s.generated, "resume": True})
-    self._release_pages(s)
+    # With the KV tier on, the victim's pages — prompt AND generated — are
+    # donated under extended chain keys: its resume finds the whole stream
+    # as a reusable prefix (device-cached now, host-spilled under pressure)
+    # and prefill recomputes only the last partial page. Resume becomes
+    # transfer-cost instead of recompute-cost; carry_tokens stays the
+    # fallback when every copy has been evicted.
+    keep_kv = self.tier is not None and self.qos.cfg.preempt_spill
+    tracer.stage(req.request_id, "preempted", {"row": row, "generated": s.generated, "resume": True, "kv": "tiered" if keep_kv else "recompute"})
+    self._release_pages(s, extend=keep_kv)
     self.slots[row] = None
     self._clear_row(row)
     new_toks = s.out_tokens[len(req.carry_tokens):]
@@ -485,8 +517,40 @@ class BatchedServer:
     task = self._loop_task
     self._loop_task = None
     self.cache = None
+    if self.tier is not None:
+      # A model swap invalidates the host tier's CONTENT (chain keys hash
+      # token ids, not weights — the same chain under a new model must not
+      # restore the old model's KV bytes).
+      self.tier.clear()
     if task is not None and not task.done():
       task.get_loop().call_soon_threadsafe(task.cancel)
+
+  # ------------------------------------------------------- kv tier plumbing
+
+  def _tier_read(self, pages: list[int]):
+    """Spill-side device read for the tier (batched gather + async D2H).
+    None when the pool is already torn down (shutdown racing an eviction) —
+    the tier degrades to plain eviction."""
+    if self.cache is None:
+      return None, 0
+    return self.ops.read_pages(self.cache, pages)
+
+  def _tier_write(self, pages: list[int], data: dict) -> None:
+    """Restore-side device write: scatter host page data into freshly
+    allocated pages. Donates the pool leaves — runs only at admission
+    boundaries with the pipeline drained, exactly like prefill."""
+    if self.cache is None:
+      raise RuntimeError("page pool torn down under a restore")
+    self.cache = self.ops.write_pages(self.cache, pages, data)
+
+  def _stage_spill(self, request_id: str) -> None:
+    """Attribute the tier's most recent eviction-spill burst to the request
+    whose allocation forced it (the D2H sits in THAT request's latency)."""
+    if self.tier is None:
+      return
+    last = self.tier.take_last_spill()
+    if last is not None:
+      tracer.stage(request_id, "spilled", last)
 
   # ---------------------------------------------------------------- loop
 
@@ -527,6 +591,14 @@ class BatchedServer:
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
       self.cache = self.ops.init_pool(n_pages, ps)
       metrics.set_gauge("page_pool_pages_total", n_pages - 1)  # page 0 = trash page
+      from .kv_tier import KvTierManager, kv_tier_enabled
+
+      if self.tier is None and kv_tier_enabled():
+        self.tier = KvTierManager.from_env(page_size=ps, read_pages=self._tier_read, write_pages=self._tier_write)
+      if self.tier is not None:
+        # Rewire onto the (possibly rebuilt) allocator: device evictions
+        # spill their pages host-side before the free list reuses them.
+        self.allocator.spill_hook = self.tier.spill
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
     # Decode-path attribution label for this pool's compiled chunk program:
@@ -581,6 +653,7 @@ class BatchedServer:
     dispatched."""
     self._queued.pop(req.request_id, None)
     shared_pages: list = []
+    new_pages: list | None = None
     try:
       if req.max_tokens <= 0:  # cancelled while queued (or degenerate request)
         req.emit(req.request_id, [], True)
@@ -639,20 +712,65 @@ class BatchedServer:
           # chunk boundary, keeping arrival order.
           req.page_demand = need
           self._queued[req.request_id] = req
+          if not req.t_parked:
+            req.t_parked = time.perf_counter()
           metrics.inc("scheduler_parked_total")
           tracer.stage(req.request_id, "parked", {"page_demand": need})
           return "park", None
         raise ServerOverloadedError(f"prompt of {S} tokens cannot fit the page pool even when idle")
+      self._stage_spill(req.request_id)  # evictions this alloc forced: D2H in THIS admission's latency
+      new_pages = list(new_pages)
+      if self.tier is not None and new_pages:
+        # Host-tier restore: extend the device prefix hit with the longest
+        # HOST-resident chain run — the leading fresh pages become restore
+        # targets (written + adopted as cached read-only prefix pages, COW:
+        # the host copies are retained) and prefill skips those tokens too.
+        # A failed restore is only a missed optimization: the pages stay
+        # private and prefill recomputes them (the correctness fallback).
+        run = self.tier.host_run(chain_keys, len(shared_pages), (S - 1) // ps)
+        # Pages evict in chain order, so a chain's SUFFIX can outlive its
+        # evicted prefix in the device LRU: stop the run at the first key
+        # still device-cached — adopt_restored requires the key be absent,
+        # and those tokens recompute through prefill (re-linking the chain
+        # for the next admission to hit whole).
+        for j, key in enumerate(run):
+          if self.allocator.is_cached(key):
+            run = run[:j]
+            break
+        if run:
+          dest = new_pages[: len(run)]
+          try:
+            self.tier.restore_into(run, dest, request_id=req.request_id)
+          except Exception:  # noqa: BLE001
+            pass
+          else:
+            for key, page in zip(run, dest):
+              self.allocator.adopt_restored(key, page)
+            shared_pages = shared_pages + dest
+            del new_pages[: len(run)]
+            prefix_len = len(shared_pages) * ps
+        from .kv_tier import prefix_registry
+
+        nxt = len(shared_pages)
+        if nxt < (S - 1) // ps and prefix_registry.locate(chain_keys[nxt]):
+          # Neither tier holds the next link locally, but a peer advertises
+          # it: the hit a prefix-affinity router would have exploited.
+          metrics.inc("kv_prefix_registry_hits_total", labels={"scope": "remote"})
       if shared_pages:
         metrics.inc("prefix_cache_hit_pages_total", len(shared_pages))
-      self._note_admitted(req, row, shared=len(shared_pages), fresh=need)
+      self._note_admitted(req, row, shared=len(shared_pages), fresh=len(new_pages))
       return "ready", _Ready(
         req=req, row=row, pad_to=0, prefix_len=prefix_len, shared_pages=shared_pages,
-        new_pages=list(new_pages), chain_keys=chain_keys,
+        new_pages=new_pages, chain_keys=chain_keys,
       )
     except Exception as e:  # noqa: BLE001
       for p in shared_pages:
         self.allocator.release(p)
+      if new_pages:
+        # Still-private fresh pages (adopted restore targets have already
+        # moved into shared_pages and released above): return them, or a
+        # failed admission would shrink the pool permanently.
+        self.allocator.free(new_pages)
       if not req.future.done():
         req.future.set_exception(e)
       if not isinstance(e, DeadlineUnmeetableError):
@@ -667,6 +785,12 @@ class BatchedServer:
     metrics.inc("scheduler_admissions_total")
     if req.t_submit:
       metrics.observe_hist("queue_wait_seconds", time.perf_counter() - req.t_submit)
+    if req.t_parked:
+      # The page-starvation wait ends here: the timeline pairs this with the
+      # first ``parked`` stage so /v1/requests/{id}/timeline answers "why
+      # was this request slow" with the measured starvation span.
+      tracer.stage(req.request_id, "unparked", {"waited_ms": round((time.perf_counter() - req.t_parked) * 1e3, 3)})
+      req.t_parked = 0.0
     attrs = {"row": row, "shared_pages": shared, "new_pages": fresh}
     if req.qos is not None:
       attrs["class"] = req.qos.priority
@@ -960,23 +1084,47 @@ class BatchedServer:
       n = len(slot.shared_pages) + len(slot.pages)
       self.block_tables[r.row, :n] = slot.shared_pages + slot.pages
 
-  def _release_pages(self, slot: _Slot) -> None:
+  def _release_pages(self, slot: _Slot, extend: bool | None = None) -> None:
     """Return a finished slot's pages: shared prefix refs drop; private FULL
     prompt pages are donated to the prefix cache; the rest (partial prompt
-    tail + generated positions) free immediately."""
+    tail + generated positions) free immediately.
+
+    Under the KV tier (``extend`` defaults to tier-enabled), the donation
+    also covers the row's GENERATED full pages: chain keys extend over the
+    absorbed stream (prompt ++ new tokens — O(new tokens), the running hash
+    carries forward), so a preempted row's resume and a multi-turn session's
+    next turn find the whole history as a reusable prefix, device-side now
+    and host-side after LRU pressure spills it."""
     if not self.paged:
       return
     for p in slot.shared_pages:
       self.allocator.release(p)
     n_shared = len(slot.shared_pages)
-    n_full_prompt = len(slot.chain_keys)  # == S // page_size
+    keys = slot.chain_keys
+    if extend is None:
+      extend = self.tier is not None
+    if extend and slot.pos // self.page_size > len(keys):
+      from .paging import PageAllocator
+
+      new_toks = slot.out_tokens[len(slot.req.carry_tokens):]
+      absorbed = np.concatenate([slot.req.tokens, np.asarray(new_toks, np.int64)]) if new_toks else slot.req.tokens
+      # Positions [0, pos) are exactly the written KV of absorbed[:pos]; only
+      # FULL pages (pos // page_size) are donatable.
+      keys = PageAllocator.chain_keys_extend(keys, absorbed[: (slot.pos // self.page_size) * self.page_size], self.page_size)
+    n_donatable = len(keys)
     to_free = []
+    donated = []
     for i, p in enumerate(slot.pages):
       logical = n_shared + i
-      if logical < n_full_prompt and self.allocator.insert_cached(slot.chain_keys[logical], p):
+      if logical < n_donatable and self.allocator.insert_cached(keys[logical], p):
+        donated.append(keys[logical])
         continue
       to_free.append(p)
     self.allocator.free(to_free)
+    if donated and self.tier is not None:
+      from .kv_tier import prefix_registry
+
+      prefix_registry.note(donated)  # cluster-visible: this node now holds these chains
     if slot.shared_pages or slot.pages:
       metrics.inc("page_release_events_total")
     slot.shared_pages, slot.pages = [], []
@@ -1011,6 +1159,7 @@ class BatchedServer:
     got = self.allocator.alloc(needed - have)
     if got is None:
       return False
+    self._stage_spill(slot.req.request_id)  # evictions this growth forced
     metrics.inc("page_grow_events_total")
     metrics.inc("page_grow_pages_total", len(got))
     self.block_tables[row, have : have + len(got)] = got

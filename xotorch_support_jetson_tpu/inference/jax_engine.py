@@ -1241,10 +1241,17 @@ class JaxShardedInferenceEngine(InferenceEngine):
     return self._batched_server
 
   def _drop_batched_server(self) -> None:
-    """Stop the old pool loop so its HBM cache actually frees (model swap)."""
+    """Stop the old pool loop so its HBM cache actually frees (model swap).
+    The KV tier's host store clears with it (server.shutdown) and the local
+    prefix advertisement is withdrawn: the same token chains will hold a
+    DIFFERENT model's KV bytes after the swap, so both the host entries and
+    the cluster-visible hints are stale."""
     server = getattr(self, "_batched_server", None)
     if server is not None:
       server.shutdown()
+      from .kv_tier import prefix_registry
+
+      prefix_registry.clear_local()
     self._batched_server = None
     self._batch_ops = None  # backend is model/mesh-specific
 

@@ -1,0 +1,471 @@
+"""KV memory hierarchy: a host-RAM second tier under the HBM page pool.
+
+The paged KV pool (inference/paging.py + ops/paged.py) lives entirely in
+accelerator memory, so CAPACITY — not compute — bounds admission: a page
+evicted from the prefix-cache LRU is simply gone, a QoS preemption throws
+away the victim's whole KV cache and recomputes prefill on resume, and an
+idle multi-turn session holds nothing between turns. The reference system's
+identity is a cluster of consumer devices with plenty of host RAM next to
+small accelerator memory (PAPER.md §1, §5); this module is the memory
+hierarchy that exploits it:
+
+- ``KvTierManager`` owns a byte-budgeted host-RAM store of page COPIES,
+  keyed by the prefix cache's content-addressed chain keys
+  (``PageAllocator.chain_keys``). Pages evicted from the device LRU SPILL
+  here (batched device gather + ``copy_to_host_async`` — the same
+  overlapped D2H path the lookahead pipeline uses) instead of vanishing;
+  admission RESTORES host-resident chain runs into freshly allocated
+  device pages, skipping both the HBM pressure and the prefill FLOPs for
+  those tokens.
+
+- Because a preempted row's KV (prompt + generated tokens) is exactly a
+  page-aligned prefix of its resumed incarnation's absorbed prompt, ONE
+  mechanism serves three workloads: (a) QoS preempt/park victims resume by
+  TRANSFER instead of recompute (``_Request.carry_tokens`` stays the
+  correctness fallback — a host miss just recomputes prefill), (b) idle
+  multi-turn sessions park their conversation pages host-side between
+  turns, turning "n_slots resident rows" into hundreds of open sessions
+  per node, and (c) the prefix cache gains a host-backed second tier.
+
+- Restores are COPY-ON-WRITE: restoring writes the host bytes into a fresh
+  device page which is adopted into the device prefix cache (read-only by
+  construction — decode writes land only in a request's private tail
+  pages); the host copy is RETAINED, so concurrent requests, later turns,
+  and future cross-node transfers can restore the same prefix again.
+
+- ``PrefixRegistry`` extends prefix visibility to CLUSTER scope: a bounded
+  registry of chain-key hexes this node holds (either tier), advertised
+  over the existing gRPC opaque-status channel (``prefix_pull`` /
+  ``prefix_keys``, the ``metrics_pull`` pattern), plus a bounded view of
+  every peer's advertisements. Advertised keys are HINTS for placement (a
+  router sends a request where its prefix already sits) — they are never
+  dereferenced blindly: restore happens only from this node's own host
+  tier, and a stale hint costs one recomputed prefill, never correctness.
+
+Everything rides ``XOT_TPU_KV_TIER`` (default on; ``0`` restores the
+byte-identical single-tier behavior, test-pinned like ``XOT_TPU_QOS=0``).
+Knobs: ``XOT_TPU_KV_TIER_HOST_MB`` (host-tier byte budget),
+``XOT_TPU_KV_TIER_EVICT`` (``lru``/``fifo`` host eviction),
+``XOT_TPU_KV_TIER_INFLIGHT`` (async D2H spill batches in flight before the
+oldest is forced to materialize).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ..utils.metrics import SIZE_BUCKETS, metrics
+
+MAX_REGISTRY_KEYS = 4096  # per scope (local, and per remote node)
+
+
+def kv_tier_enabled() -> bool:
+  return os.getenv("XOT_TPU_KV_TIER", "1") not in ("0", "false")
+
+
+def _bucket(n: int) -> int:
+  b = 1
+  while b < n:
+    b *= 2
+  return b
+
+
+# ------------------------------------------------------------- device copies
+#
+# Generic over the pool's dict-of-leaves layout ({"k","v"} and the int8-KV
+# {"k","v","k_scale","v_scale"} variant): every leaf is [L, P, ...] with the
+# page axis at 1. Gather/scatter are jitted per (leaf shape, page bucket) —
+# page counts round up to a power of two (padding indexes the trash page 0,
+# whose reads are garbage nobody consumes and whose writes are discarded by
+# design), so a handful of compiled programs covers every batch size.
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn():
+  import jax
+
+  @jax.jit
+  def gather(leaf, idx):
+    return leaf[:, idx]
+
+  return gather
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_fn():
+  import jax
+
+  @functools.partial(jax.jit, donate_argnums=(0,))
+  def scatter(leaf, idx, data):
+    return leaf.at[:, idx].set(data)
+
+  return scatter
+
+
+def gather_pages(pool: dict, pages: list[int]) -> tuple[dict, int]:
+  """Start a batched device→host read of ``pages`` from every pool leaf.
+
+  Returns ``({leaf: device_array [L, bucket, ...]}, n)`` with the async host
+  copy already in flight (``copy_to_host_async``) — materialize later with
+  ``np.asarray(arr)[:, :n]``. The gathered arrays are fresh buffers, so the
+  pool leaves stay donatable to the fused decode/prefill programs."""
+  import jax.numpy as jnp
+
+  n = len(pages)
+  idx = np.zeros((_bucket(n),), dtype=np.int32)
+  idx[:n] = pages
+  gather = _gather_fn()
+  out = {name: gather(leaf, jnp.asarray(idx)) for name, leaf in pool.items()}
+  for arr in out.values():
+    try:
+      arr.copy_to_host_async()
+    except AttributeError:  # backend without async copies
+      pass
+  return out, n
+
+
+def scatter_pages(pool: dict, pages: list[int], data: dict) -> dict:
+  """Write host page data back into ``pages`` of every pool leaf; returns the
+  new pool (leaves are donated — in-place where XLA allows). ``data`` maps
+  leaf name → ``[L, n, ...]`` host arrays in ``pages`` order."""
+  import jax.numpy as jnp
+
+  n = len(pages)
+  nb = _bucket(n)
+  idx = np.zeros((nb,), dtype=np.int32)  # pad writes land in the trash page 0
+  idx[:n] = pages
+  scatter = _scatter_fn()
+  out = {}
+  for name, leaf in pool.items():
+    d = np.asarray(data[name])
+    if nb != n:
+      pad = np.zeros((d.shape[0], nb - n) + d.shape[2:], dtype=d.dtype)
+      d = np.concatenate([d, pad], axis=1)
+    out[name] = scatter(leaf, jnp.asarray(idx), jnp.asarray(d))
+  return out
+
+
+# ---------------------------------------------------------------- host tier
+
+
+class _PendingBatch:
+  """One in-flight spill: device gather handles whose host copy is still
+  streaming. Materializes lazily (restore hit, inflight cap, or budget
+  pressure needing exact bytes) — the spill call itself never blocks on the
+  D2H."""
+
+  __slots__ = ("keys", "dev", "n")
+
+  def __init__(self, keys: list[bytes], dev: dict, n: int) -> None:
+    self.keys = keys
+    self.dev = dev
+    self.n = n
+
+
+class KvTierManager:
+  """Host-RAM page store + spill/restore engine for one BatchedServer.
+
+  ``read_pages(pages) -> (dev_arrays, n)`` and ``write_pages(pages, data)``
+  are injected by the scheduler (they close over the live pool and the
+  engine's batch-ops backend). All entry points are called from the
+  scheduler's event loop at dispatch boundaries, so device access is already
+  serialized; the lock only guards against concurrent API/stats readers."""
+
+  def __init__(self, *, page_size: int, read_pages, write_pages, budget_bytes: int,
+               evict_policy: str = "lru", max_inflight: int = 4, node_id: str | None = None) -> None:
+    self.page_size = page_size
+    self._read = read_pages
+    self._write = write_pages
+    self.budget_bytes = max(int(budget_bytes), 0)
+    self.evict_policy = evict_policy if evict_policy in ("lru", "fifo") else "lru"
+    self.max_inflight = max(int(max_inflight), 1)
+    self.node_id = node_id
+    self._entries: "OrderedDict[bytes, dict | _PendingBatch]" = OrderedDict()
+    self._pending: list[_PendingBatch] = []
+    self._bytes = 0
+    self._page_nbytes: int | None = None  # host bytes per page (all leaves)
+    self._lock = threading.Lock()
+    # Last spill burst, for timeline attribution by whoever's allocation
+    # forced it (take_last_spill()).
+    self._last_spill: dict | None = None
+    self._update_gauges()
+
+  @classmethod
+  def from_env(cls, *, page_size: int, read_pages, write_pages, node_id: str | None = None) -> "KvTierManager":
+    def _i(name: str, default: int) -> int:
+      try:
+        return int(os.getenv(name, "") or default)
+      except ValueError:
+        return default
+
+    return cls(
+      page_size=page_size,
+      read_pages=read_pages,
+      write_pages=write_pages,
+      budget_bytes=_i("XOT_TPU_KV_TIER_HOST_MB", 1024) * (1 << 20),
+      evict_policy=os.getenv("XOT_TPU_KV_TIER_EVICT", "lru"),
+      max_inflight=_i("XOT_TPU_KV_TIER_INFLIGHT", 4),
+      node_id=node_id,
+    )
+
+  # ------------------------------------------------------------------ spill
+
+  def spill(self, evicted: list[tuple[bytes, int]]) -> None:
+    """Device-LRU eviction hook (``PageAllocator.spill_hook``): copy the
+    evicted cached pages host-side BEFORE their device pages are reused.
+    The gather is enqueued on the device stream ahead of any later overwrite
+    of those pages, and the host copy streams asynchronously — the caller
+    never waits for the D2H."""
+    if not evicted:
+      return
+    t0 = time.perf_counter()
+    try:
+      dev, n = self._read([p for _, p in evicted])
+    except Exception:  # noqa: BLE001 — a failed spill degrades to plain eviction
+      return
+    if dev is None:
+      return
+    keys = [k for k, _ in evicted]
+    batch = _PendingBatch(keys, dev, n)
+    with self._lock:
+      if self._page_nbytes is None:
+        self._page_nbytes = sum(
+          int(np.prod(arr.shape[2:])) * arr.shape[0] * np.dtype(arr.dtype).itemsize for arr in dev.values()
+        )
+      for i, key in enumerate(keys):
+        old = self._entries.pop(key, None)
+        if isinstance(old, dict):
+          self._bytes -= old["nbytes"]
+        self._entries[key] = batch
+      self._pending.append(batch)
+      self._bytes += self._page_nbytes * len(keys)
+      self._enforce_budget_locked()
+      while len(self._pending) > self.max_inflight:
+        self._materialize_locked(self._pending[0])
+      dt = time.perf_counter() - t0
+      self._last_spill = {"pages": len(keys), "ms": round(dt * 1e3, 3)}
+    metrics.inc("kv_tier_spilled_pages_total", len(keys))
+    metrics.inc("kv_tier_spilled_bytes_total", self._page_nbytes * len(keys))
+    metrics.observe_hist("kv_tier_spill_seconds", dt)
+    prefix_registry.note(keys)
+    self._update_gauges()
+
+  def take_last_spill(self) -> dict | None:
+    """The most recent spill burst, consumed once — the allocation path that
+    forced the eviction attributes it to its request's timeline (the spill
+    IS part of that request's admission latency)."""
+    with self._lock:
+      s, self._last_spill = self._last_spill, None
+      return s
+
+  def _materialize_locked(self, batch: _PendingBatch) -> None:
+    """Force a pending batch's host copy to completion and split it into
+    per-key entries (copies, so evicting one key actually frees its bytes).
+    A key REPLACED by a newer spill while this batch was pending still
+    carries this batch's byte charge — settle it here (the one place that
+    knows the stale copy is truly gone)."""
+    if batch in self._pending:
+      self._pending.remove(batch)
+    host = {name: np.asarray(arr)[:, : batch.n] for name, arr in batch.dev.items()}
+    batch.dev = {}
+    for i, key in enumerate(batch.keys):
+      if self._entries.get(key) is not batch:
+        self._bytes -= self._page_nbytes  # replaced while pending: charge settles
+        continue
+      data = {name: np.ascontiguousarray(arr[:, i]) for name, arr in host.items()}
+      self._entries[key] = {"data": data, "nbytes": self._page_nbytes}
+
+  def _enforce_budget_locked(self) -> None:
+    while self._bytes > self.budget_bytes and self._entries:
+      key, entry = next(iter(self._entries.items()))
+      if isinstance(entry, _PendingBatch):
+        # Budget pressure is a forcing point: complete the copy so the
+        # eviction actually frees bytes (and the accounting stays exact).
+        self._materialize_locked(entry)
+        entry = self._entries.get(key)
+        if entry is None:
+          continue
+      self._entries.pop(key, None)
+      self._bytes -= entry["nbytes"]
+      metrics.inc("kv_tier_host_evictions_total")
+
+  # ---------------------------------------------------------------- restore
+
+  def host_run(self, chain_keys: list[bytes], start: int, limit: int) -> list[bytes]:
+    """Longest contiguous host-resident run of ``chain_keys[start:limit]`` —
+    the keys a restore can extend the device prefix hit with."""
+    run: list[bytes] = []
+    with self._lock:
+      for i in range(start, min(limit, len(chain_keys))):
+        if chain_keys[i] not in self._entries:
+          break
+        run.append(chain_keys[i])
+    return run
+
+  def restore_into(self, keys: list[bytes], pages: list[int], request_id: str | None = None) -> None:
+    """Write the host copies of ``keys`` into freshly allocated device
+    ``pages`` (one batched scatter). Copy-on-write: the host entries are
+    RETAINED and only LRU-touched — the device pages are new copies the
+    caller adopts into the device prefix cache. Raises on a failed device
+    write; the caller falls back to recomputing prefill (the pages are
+    still its to use as plain private pages)."""
+    t0 = time.perf_counter()
+    with self._lock:
+      for key in keys:
+        entry = self._entries.get(key)
+        if entry is None:
+          raise KeyError("host entry evicted under the restore")
+        if isinstance(entry, _PendingBatch):
+          self._materialize_locked(entry)
+      data = {}
+      leaves = self._entries[keys[0]]["data"].keys()
+      for name in leaves:
+        data[name] = np.stack([self._entries[k]["data"][name] for k in keys], axis=1)
+      if self.evict_policy == "lru":
+        for key in keys:
+          self._entries.move_to_end(key)
+      nbytes = sum(self._entries[k]["nbytes"] for k in keys)
+    self._write(pages, data)
+    dt = time.perf_counter() - t0
+    metrics.inc("kv_tier_restored_pages_total", len(keys))
+    metrics.inc("kv_tier_restored_bytes_total", nbytes)
+    metrics.inc("kv_prefix_registry_hits_total", len(keys), labels={"scope": "local"})
+    metrics.observe_hist("kv_tier_restore_seconds", dt)
+    metrics.observe_hist("kv_tier_restore_pages_per_op", len(keys), buckets=SIZE_BUCKETS)
+    if request_id:
+      from ..orchestration.tracing import tracer
+
+      tracer.stage(request_id, "restored", {"pages": len(keys), "bytes": nbytes, "ms": round(dt * 1e3, 3)})
+
+  # ------------------------------------------------------------------ admin
+
+  def host_has(self, key: bytes) -> bool:
+    with self._lock:
+      return key in self._entries
+
+  @property
+  def host_pages(self) -> int:
+    with self._lock:
+      return len(self._entries)
+
+  @property
+  def host_bytes(self) -> int:
+    with self._lock:
+      return self._bytes
+
+  def clear(self) -> None:
+    with self._lock:
+      self._entries.clear()
+      self._pending.clear()
+      self._bytes = 0
+    self._update_gauges()
+
+  def _update_gauges(self) -> None:
+    with self._lock:
+      pages, nbytes = len(self._entries), self._bytes
+    metrics.set_gauge("kv_tier_host_pages", pages)
+    metrics.set_gauge("kv_tier_host_bytes", nbytes)
+    metrics.set_gauge("kv_tier_host_utilization", round(nbytes / self.budget_bytes, 6) if self.budget_bytes else 0.0)
+
+  def stats(self) -> dict:
+    with self._lock:
+      return {
+        "host_pages": len(self._entries),
+        "host_bytes": self._bytes,
+        "budget_bytes": self.budget_bytes,
+        "page_nbytes": self._page_nbytes,
+        "pending_batches": len(self._pending),
+        "evict_policy": self.evict_policy,
+      }
+
+
+# ------------------------------------------------- cluster prefix registry
+
+
+class PrefixRegistry:
+  """Bounded, cluster-visible index of WHERE page-aligned prefixes sit.
+
+  Local side: chain keys resident on this node (device prefix cache or host
+  tier), noted as they appear, LRU-bounded at ``MAX_REGISTRY_KEYS``. Remote
+  side: the latest advertisement from each peer (replacing, not merging —
+  an advert is a snapshot of the peer's registry), each bounded the same
+  way. ``locate`` answers "which peers claim this prefix" for a router's
+  prefix-affinity placement.
+
+  TRUST: advertised keys are HINTS only. They are never dereferenced
+  blindly — a node restores exclusively from its OWN host tier, so a stale
+  or malicious advertisement can at worst misroute one request to a node
+  that recomputes the prefill it hoped to skip. Entries also go stale
+  benignly (eviction races the advert); the bounded LRU and
+  advert-replacement keep the registry from growing without limit."""
+
+  def __init__(self, max_keys: int = MAX_REGISTRY_KEYS) -> None:
+    self.max_keys = max_keys
+    self._local: "OrderedDict[bytes, None]" = OrderedDict()
+    self._remote: dict[str, "OrderedDict[bytes, None]"] = {}
+    self._lock = threading.Lock()
+
+  def note(self, keys) -> None:
+    """Record chain keys now resident locally (either tier)."""
+    with self._lock:
+      for key in keys:
+        self._local.pop(key, None)
+        self._local[key] = None
+      while len(self._local) > self.max_keys:
+        self._local.popitem(last=False)
+
+  def local_hexes(self, limit: int | None = None) -> list[str]:
+    """Most-recent-first hex digests for the wire (bounded reply size)."""
+    with self._lock:
+      keys = list(reversed(self._local))
+    if limit is not None:
+      keys = keys[:limit]
+    return [k.hex() for k in keys]
+
+  def update_remote(self, node_id: str, hexes) -> None:
+    """Replace ``node_id``'s advertisement (a snapshot, not a delta)."""
+    entries: "OrderedDict[bytes, None]" = OrderedDict()
+    for h in list(hexes)[: self.max_keys]:
+      try:
+        entries[bytes.fromhex(h)] = None
+      except (ValueError, TypeError):
+        continue  # a malformed advert key is dropped, not fatal
+    with self._lock:
+      self._remote[str(node_id)] = entries
+
+  def forget_remote(self, node_id: str) -> None:
+    with self._lock:
+      self._remote.pop(str(node_id), None)
+
+  def locate(self, key: bytes) -> list[str]:
+    """Peers advertising ``key`` (hints — see the class trust note)."""
+    with self._lock:
+      return [nid for nid, entries in self._remote.items() if key in entries]
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      return {
+        "local_keys": len(self._local),
+        "remote": {nid: len(entries) for nid, entries in self._remote.items()},
+      }
+
+  def clear_local(self) -> None:
+    """Drop this node's advertisement (model swap: the KV bytes behind the
+    same token chains changed — peers must stop routing for the old ones).
+    Remote views stay: peers may still serve their own models."""
+    with self._lock:
+      self._local.clear()
+
+  def clear(self) -> None:
+    with self._lock:
+      self._local.clear()
+      self._remote.clear()
+
+
+prefix_registry = PrefixRegistry()

@@ -19,11 +19,22 @@ operands. Three pieces:
   active readers sit in an LRU and are evicted when the free list runs dry.
 
 Chain keys are content-addressed: key i is a 128-bit blake2b digest of
-(key i-1, page i's token ids), so building all keys is O(prompt) and every
-dict op is O(1). (The first design used nested tuples of token ids for
-literal exactness, but hashing key i walks i pages — O(pages² · page_size)
-per admission at 32K contexts. At 128 bits a spurious collision needs ~2⁶⁴
-distinct pages; git-style content addressing, accepted as exact.)
+(key i-1, page i's token ids). The running hash carries FORWARD — both
+within one ``chain_keys`` call and across calls via ``chain_keys_extend``
+(a slot extending its prompt keys over generated tokens at release hashes
+only the NEW pages) — so building all keys is O(prompt) and extending is
+O(new tokens), where rehashing key i from scratch would walk i pages:
+O(pages² · page_size) per admission at 32K contexts. (The first design used
+nested tuples of token ids for literal exactness. At 128 bits a spurious
+collision needs ~2⁶⁴ distinct pages; git-style content addressing, accepted
+as exact — and pinned key-equal to the from-scratch scheme in
+tests/test_kv_tier.py.)
+
+The allocator also carries the KV memory hierarchy's device-side hooks
+(inference/kv_tier.py, ISSUE 6): ``spill_hook`` receives every batch of
+LRU-evicted cached pages BEFORE their device pages are reused (the host
+tier copies them out), and ``adopt_restored`` registers a freshly written
+restore page as a cached, refcounted prefix page.
 
 No reference counterpart (the reference's cache is dense per-request,
 ``SURVEY.md §5.7``); the design is the vLLM paged-KV idea rebuilt for static
@@ -149,6 +160,10 @@ class PageAllocator:
     self._by_key: dict[bytes, int] = {}  # chain key -> cached page
     self._key_of: dict[int, bytes] = {}  # cached page -> chain key
     self._lru: OrderedDict[int, None] = OrderedDict()  # refcount-0 cached pages
+    # KV tier spill hook (inference/kv_tier.py): called with the full batch
+    # of (chain_key, page) pairs an eviction run frees, BEFORE the pages
+    # return to the free list — the host tier's chance to copy them out.
+    self.spill_hook = None
 
   # ------------------------------------------------------------- allocation
 
@@ -163,11 +178,13 @@ class PageAllocator:
 
   def alloc(self, n: int) -> list[int] | None:
     """n fresh private pages, evicting idle cached pages if needed; None if
-    even eviction can't cover it (caller backpressures)."""
+    even eviction can't cover it (caller backpressures). Evictions run as
+    ONE batch so the spill hook's device gather + D2H is a single copy op,
+    not per-page round trips."""
     if n > self.n_available:
       return None
-    while len(self._free) < n:
-      self._evict_one()
+    if len(self._free) < n:
+      self._evict(n - len(self._free))
     return [self._free.pop() for _ in range(n)]
 
   def free(self, pages: list[int]) -> None:
@@ -176,22 +193,40 @@ class PageAllocator:
       assert p not in self._key_of, f"page {p} is cached; use release()"
       self._free.append(p)
 
-  def _evict_one(self) -> None:
-    page, _ = self._lru.popitem(last=False)
-    key = self._key_of.pop(page)
-    del self._by_key[key]
-    self._refs.pop(page, None)
-    self._free.append(page)
+  def _evict(self, n: int) -> None:
+    batch: list[tuple[bytes, int]] = []
+    for _ in range(n):
+      page, _ = self._lru.popitem(last=False)
+      key = self._key_of.pop(page)
+      del self._by_key[key]
+      self._refs.pop(page, None)
+      batch.append((key, page))
+    if self.spill_hook is not None:
+      # The hook's gather is enqueued on the device stream BEFORE any later
+      # dispatch can reuse these pages, so the host copy reads valid data.
+      self.spill_hook(batch)
+    self._free.extend(p for _, p in batch)
 
   # ----------------------------------------------------------- prefix cache
 
   @staticmethod
   def chain_keys(tokens, page_size: int) -> list[bytes]:
     """Cumulative content keys for each FULL page of ``tokens``."""
+    return PageAllocator.chain_keys_extend([], tokens, page_size)
+
+  @staticmethod
+  def chain_keys_extend(prev_keys: list[bytes], tokens, page_size: int) -> list[bytes]:
+    """Extend an existing chain-key list over a LONGER token sequence,
+    carrying the running hash forward from ``prev_keys[-1]`` — O(new
+    tokens), not O(sequence). ``prev_keys`` must be the chain for
+    ``tokens[: len(prev_keys) * page_size]`` (the caller's slot keys always
+    are: same prompt, new suffix). The release path uses this to donate a
+    finished/preempted row's GENERATED pages under content keys without
+    rehashing its whole absorbed prompt."""
     arr = np.asarray(tokens, dtype=np.int64)  # normalize dtype: same ids -> same bytes
-    keys: list[bytes] = []
-    prev = b""
-    for i in range(len(arr) // page_size):
+    keys = list(prev_keys)
+    prev = keys[-1] if keys else b""
+    for i in range(len(keys), len(arr) // page_size):
       prev = hashlib.blake2b(prev + arr[i * page_size : (i + 1) * page_size].tobytes(), digest_size=16).digest()
       keys.append(prev)
     return keys
@@ -226,3 +261,41 @@ class PageAllocator:
     self._key_of[page] = key
     self._lru[page] = None
     return True
+
+  def is_cached(self, key: bytes) -> bool:
+    """Whether ``key``'s page is device-cached (referenced or idle-LRU).
+    The host-restore path uses this to stop a restore run at the first key
+    still resident: a chain's suffix can outlive its evicted prefix in the
+    LRU, and ``adopt_restored`` requires the key to be absent."""
+    return key in self._by_key
+
+  def adopt_restored(self, key: bytes, page: int) -> None:
+    """Register a host-tier restore target as a CACHED page with one active
+    reader (the restoring request — it must ``release`` it exactly once,
+    like any ``lookup_prefix`` hit). The page was just allocated private and
+    written with the key's content, so concurrent requests sharing the
+    prefix dedup onto it immediately."""
+    assert key not in self._by_key, "restore raced an identical cached chain"
+    self._by_key[key] = page
+    self._key_of[page] = key
+    self._refs[page] = 1
+
+  def audit(self) -> dict:
+    """Internal-consistency check + accounting snapshot for the invariant
+    tests (ISSUE 6 satellite): every pool page is in EXACTLY one of {free,
+    cached-idle (LRU), cached-referenced, caller-held private}; the first
+    three are visible here, so ``free + cached == n_pages - 1 - in_use``
+    must hold for the caller's private count."""
+    free = set(self._free)
+    assert len(free) == len(self._free), "double-freed page on the free list"
+    cached = set(self._key_of)
+    assert not (free & cached), f"pages both free and cached: {sorted(free & cached)}"
+    lru = set(self._lru)
+    reffed = set(self._refs)
+    assert lru <= cached and reffed <= cached, "ref/LRU entry for a non-cached page"
+    assert not (lru & reffed), "cached page both idle and referenced"
+    assert lru | reffed == cached, "cached page neither idle nor referenced"
+    assert all(n > 0 for n in self._refs.values()), "non-positive refcount survived release"
+    assert len(self._by_key) == len(self._key_of), "key<->page maps diverged"
+    assert 0 not in free | cached, "trash page 0 escaped into the pool"
+    return {"free": len(free), "cached": len(cached), "lru": len(lru), "referenced": len(reffed)}

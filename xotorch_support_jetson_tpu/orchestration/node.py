@@ -27,6 +27,7 @@ import uuid
 import numpy as np
 
 from ..inference.engine import InferenceEngine
+from ..inference.kv_tier import prefix_registry
 from ..inference.shard import Shard
 from ..inference.state import InferenceState
 from ..networking.discovery import Discovery
@@ -118,6 +119,8 @@ class Node:
     self._metrics_waiters: dict[str, list] = {}
     # Cluster timeline pulls in flight: nonce -> [event, fragments, expected].
     self._timeline_waiters: dict[str, list] = {}
+    # Cluster prefix-registry pulls in flight: nonce -> [event, replies, expected].
+    self._prefix_waiters: dict[str, list] = {}
 
     self._on_token: AsyncCallbackSystem[str, str, list, bool] = AsyncCallbackSystem()
     self._on_opaque_status: AsyncCallbackSystem[str, str, str] = AsyncCallbackSystem()
@@ -1114,6 +1117,70 @@ class Node:
         if len(waiter[1]) >= waiter[2]:
           waiter[0].set()
 
+  # ------------------------------------------------- cluster prefix registry
+
+  async def collect_cluster_prefixes(self, timeout: float = 2.0) -> dict[str, int]:
+    """Refresh the cluster prefix-registry view over the opaque-status
+    channel (the ``metrics_pull`` pattern, ISSUE 6): broadcast a
+    ``prefix_pull`` with a nonce; each peer replies with a ``prefix_keys``
+    advertisement — the chain-key hexes its KV tiers currently hold. Replies
+    REPLACE that peer's entry in ``inference/kv_tier.py prefix_registry``
+    (an advert is a snapshot, not a delta), so a router — or
+    ``GET /v1/kv/tier`` — can see where a prefix already sits. Returns
+    ``{node_id: advertised key count}`` for the peers that answered.
+    Advertised keys are placement HINTS, never dereferenced blindly."""
+    if not self.peers:
+      return {}
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._prefix_waiters[nonce] = waiter
+    try:
+      await self.broadcast_opaque_status(
+        "", json.dumps({"type": "prefix_pull", "node_id": self.id, "nonce": nonce})
+      )
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # record whatever arrived
+      return {nid: n for nid, n in waiter[1]}
+    finally:
+      self._prefix_waiters.pop(nonce, None)
+
+  def _handle_prefix_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "prefix_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      reply = json.dumps({
+        "type": "prefix_keys",
+        "node_id": self.id,
+        "nonce": status_data.get("nonce", ""),
+        "keys": prefix_registry.local_hexes(),
+      })
+      # Reply only to the requester (same O(N²) argument as metrics_pull).
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        async def send():
+          try:
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — advert replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] prefix advert reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "prefix_keys":
+      sender = status_data.get("node_id")
+      if sender == self.id:
+        return
+      keys = status_data.get("keys") or []
+      prefix_registry.update_remote(sender, keys)
+      waiter = self._prefix_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None:
+        waiter[1].append((sender, len(keys)))
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
   # -------------------------------------------------------------- topology
 
   async def update_peers(self, wait_for_peers: int = 0) -> bool:
@@ -1140,6 +1207,9 @@ class Node:
       # EWMA would converge from that huge error over dozens of samples.
       # Forget now; the next health check re-seeds from scratch.
       clock_sync.forget(peer.id())
+      # Its prefix advertisement is equally stale (a restarted peer's pools
+      # start empty); keep the registry's hints honest.
+      prefix_registry.forget_remote(peer.id())
       try:
         await asyncio.wait_for(peer.disconnect(), timeout)
         return True
@@ -1288,6 +1358,9 @@ class Node:
       elif status_type in ("timeline_pull", "timeline_fragment"):
         # Cluster-scope request timelines ride it too (same pull pattern).
         self._handle_timeline_status(status_data)
+      elif status_type in ("prefix_pull", "prefix_keys"):
+        # Cluster prefix-registry adverts (ISSUE 6: KV memory hierarchy).
+        self._handle_prefix_status(status_data)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001

@@ -192,6 +192,26 @@ def stage_summary(events: list[dict], start_ns: int, end_ns: int) -> list[dict]:
   return [agg[name] for name in order]
 
 
+def parked_wait_ms(events: list[dict], end_ns: int) -> float:
+  """Total page-starvation wait: each ``parked`` span runs to the matching
+  ``unparked`` (the scheduler emits one per admission after a park), or to
+  ``end_ns`` for a request still parked / refused while parked. Repeated
+  ``parked`` events inside one starvation span (each failed retry re-marks)
+  collapse into that single span."""
+  total = 0
+  t_park: int | None = None
+  for ev in events:
+    if ev["stage"] == "parked":
+      if t_park is None:
+        t_park = ev["t_ns"]
+    elif ev["stage"] == "unparked" and t_park is not None:
+      total += max(ev["t_ns"] - t_park, 0)
+      t_park = None
+  if t_park is not None:
+    total += max(end_ns - t_park, 0)
+  return round(total / 1e6, 3)
+
+
 class Tracer:
   def __init__(self, max_spans: int = 4096) -> None:
     self.spans: deque[Span] = deque(maxlen=max_spans)
@@ -434,6 +454,11 @@ class Tracer:
         "finished": bool(tl.get("finished")),
         "tokens": tl.get("tokens", 0),
         "total_ms": round((end_ns - tl["start_ns"]) / 1e6, 3),
+        # Page-starvation wait (ISSUE 6 satellite): the summed parked →
+        # unparked span, top-level so "why was this request slow" is
+        # answerable without walking the event list. A request still parked
+        # at query time accrues to "now".
+        "parked_ms": parked_wait_ms(tl["events"], end_ns),
         "stages": stage_summary(tl["events"], tl["start_ns"], end_ns),
         "events": [
           {

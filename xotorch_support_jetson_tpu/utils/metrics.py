@@ -33,6 +33,11 @@ DEFAULT_BUCKETS = (
   0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# Count ladder (powers of two) for size-style histograms — e.g. pages moved
+# per spill/restore copy op (ISSUE 6): the batch-size distribution is what
+# drives the tiering concurrency knobs, and a latency ladder can't hold it.
+SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
 
 def _label_key(labels: dict | None) -> tuple:
   return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
