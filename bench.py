@@ -67,6 +67,19 @@ def gate_overload(shed_rate: float | None) -> float | None:
   return float(shed_rate) if 0.0 <= shed_rate <= 0.95 else None
 
 
+def gate_spec_batch(ratio: float | None) -> float | None:
+  """Sanity-gate the batched-spec/plain aggregate A/B ratio (same drift-gate
+  pattern as ``gate_lookahead``). Draft-then-verify multiplies tokens per
+  target weight pass by at most gamma+1 (= 5 at the benched depth) and the
+  acceptance-adaptive floor bounds the downside near parity, so honest
+  ratios live in roughly [0.5, 5]: outside [1/3, 8] one side of the
+  back-to-back A/B hit a timing artifact (early block_until_ready return,
+  tunnel stall) — drop it rather than record a fake speedup/regression."""
+  if ratio is None:
+    return None
+  return float(ratio) if 1.0 / 3.0 <= ratio <= 8.0 else None
+
+
 def gate_kv_tier(value: float | None, lo: float = 0.01, hi: float = 1000.0) -> float | None:
   """Sanity-gate the KV-tier round's numbers (same drift-gate pattern).
   Spill/restore bandwidths outside [0.01, 1000] GB/s are timing artifacts
@@ -996,6 +1009,14 @@ def main() -> None:
   spec_8b_draft1b_tok_s = None
   spec_8b_draft1b_acceptance = None
   spec_8b_draft1b_vs_plain8b = None
+  # Batched speculation round (ISSUE 7): null on CPU rounds — the behavior
+  # (token identity, adaptive gamma, accounting) is pinned by
+  # tests/test_spec_batch.py there; the v5e round records the measured A/B.
+  spec_batch8_aggregate_tok_s = None
+  plain_batch8_aggregate_tok_s = None
+  spec_batch8_vs_plain8 = None
+  spec_acceptance_rate = None
+  spec_gamma_p50 = None
   if on_accel:
     try:
       from xotorch_support_jetson_tpu.inference.shard import Shard
@@ -1097,6 +1118,88 @@ def main() -> None:
         spec_8b_draft1b_tok_s = round(s_tok, 2)
         spec_8b_draft1b_acceptance = round(s_acc, 3)
         spec_8b_draft1b_vs_plain8b = round(s_tok / int8_8b_tok_s, 3)
+
+        # BATCHED speculation round (ISSUE 7, behind gate_spec_batch): the
+        # same echo-8B-target/echo-1B-draft pair through the REAL batched
+        # scheduler at B=8 on the serving-default layout (paged + int8-KV),
+        # spec mode vs plain back-to-back — the acceptance criterion is
+        # spec aggregate ≥ plain aggregate on the measured round. Also
+        # records the measured acceptance rate (from the spec counters'
+        # delta) and the p50 of the per-row dispatched gammas.
+        sb_env = {k: os.environ.get(k) for k in ("XOT_TPU_PAGED", "XOT_TPU_KV_QUANT")}
+        try:
+          import asyncio as _asyncio
+
+          from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer as _BS
+          from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine as _Eng
+          from xotorch_support_jetson_tpu.utils.metrics import metrics as _gm
+
+          os.environ["XOT_TPU_PAGED"] = "1"
+          os.environ["XOT_TPU_KV_QUANT"] = "int8"
+          sb_eng = _Eng(use_local_mesh=False)
+          sb_eng.load_test_model(shard8, cfg8, echo8)
+          sb_eng._draft_params = draft1b  # cross-model 1B draft, injected
+          sb_eng._draft_cfg = cfg
+          sb_eng._draft_shard = shard
+          sb_rng = np.random.default_rng(13)
+          sb_prompts = {f"sb{i}": sb_rng.integers(1, cfg8.vocab_size, (64,)).astype(np.int32) for i in range(8)}
+          sb_gammas: list[int] = []
+
+          def _bench_spec_batch(tag: str, spec_on: bool):
+            srv = _BS(sb_eng, n_slots=8, chunk=8, spec_batch=spec_on)
+            if spec_on:
+              orig_sp = srv.ops.spec_paged_batch_decode
+
+              def spy(token, pool, cache_d, bt, pos, active, gammas, *a, **k):
+                sb_gammas.extend(int(g) for g in np.asarray(gammas) if int(g) > 0)
+                return orig_sp(token, pool, cache_d, bt, pos, active, gammas, *a, **k)
+
+              srv.ops.spec_paged_batch_decode = spy
+
+            async def rnd():
+              total = 0
+
+              def emit(rid, toks, finished):
+                nonlocal total
+                total += len(toks)
+
+              async def one():
+                await _asyncio.gather(*(
+                  srv.submit(f"{tag}{rid}", p, max_tokens=33, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+                  for rid, p in sb_prompts.items()
+                ))
+
+              await one()  # warm the admission + chunk programs
+              total = 0
+              t0 = time.perf_counter()
+              await one()
+              return total / (time.perf_counter() - t0)
+
+            tok_s = _asyncio.run(rnd())
+            srv.shutdown()
+            return round(tok_s, 2)
+
+          prop0 = _gm.counter_value("spec_proposed_tokens_total")
+          acc0 = _gm.counter_value("spec_accepted_tokens_total")
+          spec_batch8_aggregate_tok_s = _bench_spec_batch("s", True)
+          prop1 = _gm.counter_value("spec_proposed_tokens_total")
+          acc1 = _gm.counter_value("spec_accepted_tokens_total")
+          plain_batch8_aggregate_tok_s = _bench_spec_batch("p", False)
+          if prop1 > prop0:
+            spec_acceptance_rate = round((acc1 - acc0) / (prop1 - prop0), 4)
+          if sb_gammas:
+            spec_gamma_p50 = int(np.percentile(np.asarray(sb_gammas), 50))
+          if spec_batch8_aggregate_tok_s and plain_batch8_aggregate_tok_s:
+            spec_batch8_vs_plain8 = gate_spec_batch(round(spec_batch8_aggregate_tok_s / plain_batch8_aggregate_tok_s, 4))
+        except Exception:  # noqa: BLE001 — optional section
+          pass
+        finally:
+          sb_eng = None
+          for k, v in sb_env.items():
+            if v is None:
+              os.environ.pop(k, None)
+            else:
+              os.environ[k] = v
         del echo8, draft1b
       except Exception:  # noqa: BLE001 — optional section
         pass
@@ -1213,6 +1316,11 @@ def main() -> None:
         "spec_8b_draft1b_tok_s": spec_8b_draft1b_tok_s,
         "spec_8b_draft1b_acceptance": spec_8b_draft1b_acceptance,
         "spec_8b_draft1b_vs_plain8b": spec_8b_draft1b_vs_plain8b,
+        "spec_batch8_aggregate_tok_s": spec_batch8_aggregate_tok_s,
+        "plain_batch8_aggregate_tok_s": plain_batch8_aggregate_tok_s,
+        "spec_batch8_vs_plain8": spec_batch8_vs_plain8,
+        "spec_acceptance_rate": spec_acceptance_rate,
+        "spec_gamma_p50": spec_gamma_p50,
         "sd_unet_step_ms": sd_unet_step_ms,
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,

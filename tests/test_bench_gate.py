@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_headline, gate_kv_tier, gate_lookahead, gate_overload, plausible_value
+from bench import gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_spec_batch, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -119,6 +119,23 @@ def test_overload_gate_drops_artifacts():
   assert gate_overload(0.99) is None
   assert gate_overload(-0.1) is None
   assert gate_overload(None) is None
+
+
+def test_spec_batch_gate_keeps_plausible_ratios():
+  """ISSUE 7: the batched-spec/plain A/B ratio lives in ~[0.5, gamma+1] —
+  parity-ish at the adaptive floor, up to ~5x at full acceptance/gamma 4."""
+  assert gate_spec_batch(1.0) == 1.0
+  assert gate_spec_batch(0.6) == 0.6
+  assert gate_spec_batch(3.4) == 3.4
+  assert gate_spec_batch(7.9) == 7.9
+
+
+def test_spec_batch_gate_drops_artifacts():
+  # An early block_until_ready return on one side of the A/B must not enter
+  # the record as a 50x "speculation win" (or a near-zero collapse).
+  assert gate_spec_batch(50.0) is None
+  assert gate_spec_batch(0.05) is None
+  assert gate_spec_batch(None) is None
 
 
 def test_committed_r02_artifact_is_filtered():

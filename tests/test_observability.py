@@ -680,6 +680,9 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_qos_rejected_total",
   "xot_tpu_qos_rate_limited_total",
   "xot_tpu_qos_preemptions_total",
+  # Batched speculation (ISSUE 7; spec_gamma labeled {row})
+  "xot_tpu_spec_proposed_tokens_total",
+  "xot_tpu_spec_accepted_tokens_total",
   # KV memory hierarchy (ISSUE 6; registry hits labeled {scope})
   "xot_tpu_kv_tier_spilled_pages_total",
   "xot_tpu_kv_tier_spilled_bytes_total",
@@ -702,6 +705,10 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_page_pool_pages_cached",
   "xot_tpu_page_pool_utilization",
   "xot_tpu_qos_queue_depth",
+  "xot_tpu_spec_gamma",
+  "xot_tpu_kv_draft_bytes",
+  "xot_tpu_kv_draft_slots",
+  "xot_tpu_kv_draft_pages_equivalent",
   "xot_tpu_kv_tier_host_pages",
   "xot_tpu_kv_tier_host_bytes",
   "xot_tpu_kv_tier_host_utilization",
@@ -715,6 +722,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_prefill_chunk_seconds",
   "xot_tpu_decode_chunk_seconds",
   "xot_tpu_sched_host_gap_seconds",
+  "xot_tpu_spec_acceptance_ewma",
   "xot_tpu_kv_tier_spill_seconds",
   "xot_tpu_kv_tier_restore_seconds",
   "xot_tpu_kv_tier_restore_pages_per_op",
@@ -758,6 +766,15 @@ def test_metric_name_snapshot_after_serving():
   ):
     gm.inc(name, 0)
   gm.inc("kv_prefix_registry_hits_total", 0, labels={"scope": "local"})
+  gm.inc("spec_proposed_tokens_total", 0)
+  gm.inc("spec_accepted_tokens_total", 0)
+  gm.set_gauge("spec_gamma", 0, labels={"row": "0"})
+  gm.set_gauge("kv_draft_bytes", 0)
+  gm.set_gauge("kv_draft_slots", 0)
+  gm.set_gauge("kv_draft_pages_equivalent", 0)
+  from xotorch_support_jetson_tpu.utils.metrics import FRACTION_BUCKETS
+
+  gm.observe_hist("spec_acceptance_ewma", 0.0, buckets=FRACTION_BUCKETS)
   gm.set_gauge("kv_tier_host_pages", 0)
   gm.set_gauge("kv_tier_host_bytes", 0)
   gm.set_gauge("kv_tier_host_utilization", 0.0)

@@ -1,0 +1,369 @@
+"""Batched speculative decoding as a first-class scheduler mode (ISSUE 7,
+inference/batch_scheduler.py ``XOT_TPU_SPEC_BATCH``).
+
+The correctness contract: with speculation ON, greedy batched output is
+TOKEN-IDENTICAL to the plain batched program (which is itself pinned against
+solo greedy decode) — for any draft, on both cache layouts, with the
+lookahead pipeline on or off. Depth adapts PER ROW through the acceptance
+EWMA (inference/paging.py ``spec_adapt_gamma``): an adversarial draft
+collapses every row to gamma 0 and the scheduler falls back to the plain
+chunk program instead of dragging the batch; ``XOT_TPU_SPEC_BATCH=0``
+restores plain dispatches byte-for-byte.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_batched import _single_row_reference
+from tests.test_lookahead import _serve
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.models.quantize import quantize_params
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+from xotorch_support_jetson_tpu.utils.synthetic import peaked_echo_params
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128, tied_embedding=True)
+KEY = jax.random.PRNGKey(0)
+PROMPTS = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+
+
+def _echo_engine(cfg=CFG, key=KEY):
+  """Engine whose int8 self-draft ACCEPTS: the peaked echo model's draft
+  agrees with the target almost always, so accepted runs actually happen."""
+  params, shard = full_model_params(key, cfg, "m")
+  params = peaked_echo_params(params)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="int8")
+  engine.load_test_model(shard, cfg, params)
+  assert engine._draft_params is not None
+  return engine, params, shard
+
+
+def _random_engine(cfg=CFG, key=KEY):
+  params, shard = full_model_params(key, cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="int8")
+  engine.load_test_model(shard, cfg, params)
+  assert engine._draft_params is not None
+  return engine, params, shard
+
+
+def _spec_ab(engine, params, shard, prompts, n_gen, *, chunk=4, n_slots=4, cfg=CFG):
+  """Serve the same prompts with speculation ON and OFF, each with lookahead
+  ON and OFF; assert all four modes produce the identical, solo-reference
+  greedy streams."""
+  expected = [_single_row_reference(params, shard, p, n_gen - 1, cfg=cfg) for p in prompts]
+  outs = {}
+  for spec in (True, False):
+    for la in (True, False):
+      server = BatchedServer(engine, n_slots=n_slots, chunk=chunk, lookahead=la, spec_batch=spec)
+      outs[(spec, la)], streams = _serve(server, prompts, n_gen)
+      for o, s in zip(outs[(spec, la)], streams):
+        assert s == o  # emitted stream matches the resolved result
+      if spec:
+        assert server.spec, "speculation should have resolved ON"
+      server.shutdown()
+  for mode, out in outs.items():
+    assert out == expected, f"mode {mode} diverged from solo greedy: {out} != {expected}"
+  return expected
+
+
+def test_spec_batch_ab_paged_int8kv(monkeypatch):
+  """A/B at the serving default (paged pool, int8-KV pages): spec×lookahead
+  (4 modes) all token-identical to solo greedy — with a HIGH-acceptance
+  draft, so accepted multi-token runs really flow through the variable
+  advance."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _echo_engine()
+  before = gm.counter_value("spec_accepted_tokens_total")
+  _spec_ab(engine, params, shard, PROMPTS, 8)
+  # The echo draft really accepted: multi-token advances happened.
+  assert gm.counter_value("spec_accepted_tokens_total") > before
+
+
+def test_spec_batch_ab_paged_adversarial_draft(monkeypatch):
+  """Same A/B with a RANDOM model (its int8 self-draft rarely agrees):
+  identity must hold for any draft — acceptance only changes speed."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _random_engine()
+  _spec_ab(engine, params, shard, PROMPTS, 6)
+
+
+def test_spec_batch_ab_dense(monkeypatch):
+  """A/B on the dense slot layout: the spec program's verify pass runs
+  through the ordinary slot-cache forward."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  engine, params, shard = _echo_engine()
+  _spec_ab(engine, params, shard, PROMPTS, 8)
+
+
+def test_spec_batch_eos_mid_accepted_run(monkeypatch):
+  """EOS produced INSIDE an accepted run: the host cuts the emit at the EOS
+  token exactly like a plain chunk, the extra accepted tokens are dropped,
+  and the pool fully recovers."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _echo_engine()
+  solo = _single_row_reference(params, shard, [3, 25, 9], 12, cfg=CFG)
+  eos = solo[3]  # lands mid-chunk, inside the echo draft's accepted run
+  ref = solo[: solo.index(eos) + 1]
+
+  server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True, spec_batch=True)
+  outs, _ = _serve(server, [[3, 25, 9]], 40, eos_ids=(eos,))
+  assert outs[0] == ref and outs[0][-1] == eos
+  assert all(s is None for s in server.slots)
+  alloc = server.allocator
+  assert alloc.n_available == alloc.n_pages - 1  # every page recovered
+  server.shutdown()
+
+
+def test_spec_batch_gamma_collapses_and_falls_back_to_plain(monkeypatch):
+  """Adversarial (acceptance≈0) drafts drive every row's gamma to 0 through
+  the EWMA policy; once all rows sit at the floor the scheduler dispatches
+  the PLAIN program (the batch is no longer dragged through draft+verify
+  rounds), and the stream stays identical throughout the transition."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_SPEC_REPROBE", "1000")  # no re-probe inside this test
+  engine, params, shard = _random_engine(cfg=tiny_test_config(n_layers=2, max_seq_len=512, tied_embedding=True))
+  cfg = engine.cfg
+  # Make the draft truly adversarial (unrelated weights, ~zero agreement).
+  engine._draft_params = quantize_params(full_model_params(jax.random.PRNGKey(777), cfg, "m")[0])
+
+  server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True, spec_batch=True)
+  spec_gammas = []
+  orig = server.ops.spec_paged_batch_decode
+
+  def spy(token, pool, cache_d, bt, pos, active, gammas, *a, **k):
+    spec_gammas.append(np.asarray(gammas).copy())
+    return orig(token, pool, cache_d, bt, pos, active, gammas, *a, **k)
+
+  server.ops.spec_paged_batch_decode = spy
+  prompt = [3, 25, 9]
+  expected = _single_row_reference(params, shard, prompt, 79, cfg=cfg)
+  outs, _ = _serve(server, [prompt], 80)
+  assert outs[0] == expected
+  assert spec_gammas, "speculative chunks never dispatched"
+  # Depth walked down to the floor...
+  assert spec_gammas[0].max() == server.spec_gamma_max
+  assert spec_gammas[-1].max() <= 1
+  peaks = [int(g.max()) for g in spec_gammas]
+  assert all(a >= b for a, b in zip(peaks, peaks[1:])), f"gamma not monotone under 0 acceptance: {peaks}"
+  # ...and the scheduler then STOPPED dispatching spec chunks: the stream is
+  # 80 tokens ≈ 20 chunks, the spec spy saw only the pre-collapse prefix.
+  assert len(spec_gammas) <= 8, f"batch kept paying for a dead draft: {len(spec_gammas)} spec chunks"
+  server.shutdown()
+
+
+def test_spec_batch_env_off_is_plain_byte_for_byte(monkeypatch):
+  """XOT_TPU_SPEC_BATCH=0: the spec programs are never invoked, no draft
+  cache is built, pool sizing is untouched, and output equals the plain
+  server's."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_SPEC_BATCH", "0")
+  engine, params, shard = _echo_engine()
+  server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True)
+  called = []
+  server.ops.spec_paged_batch_decode = lambda *a, **k: called.append(1)
+  server.ops.spec_batch_decode = lambda *a, **k: called.append(1)
+  expected = [_single_row_reference(params, shard, p, 7, cfg=CFG) for p in PROMPTS[:2]]
+  outs, _ = _serve(server, PROMPTS[:2], 8)
+  assert outs == expected
+  assert not server.spec and server.draft_cache is None and not called
+  server.shutdown()
+
+  # And auto mode without a draft resolves OFF too (plain engines unchanged).
+  plain_params, plain_shard = full_model_params(KEY, CFG, "m")
+  plain_engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  plain_engine.load_test_model(plain_shard, CFG, plain_params)
+  monkeypatch.delenv("XOT_TPU_SPEC_BATCH", raising=False)
+  server2 = BatchedServer(plain_engine, n_slots=2, chunk=4)
+  server2._ensure_cache()
+  assert not server2.spec and server2.draft_cache is None
+  server2.shutdown()
+
+
+def test_spec_batch_sampled_rows_run_gamma_zero_same_stream(monkeypatch):
+  """Sampled (temp>0) rows always run gamma 0 inside spec chunks and draw
+  ONE sample per round — the same split-per-step schedule as the plain
+  program — so a seeded sampled stream is identical with speculation on or
+  off, even while a greedy row in the same batch speculates. (This is the
+  documented sampled-stream contract; resume of sampled streams keeps the
+  key-schedule caveat the QoS preempt-resume docs pin.)"""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _echo_engine()
+  outs = {}
+  for spec in (True, False):
+    engine._key = jax.random.PRNGKey(123)  # identical key schedules
+    server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True, spec_batch=spec)
+    streams: dict[str, list] = {}
+
+    async def run(server=server, streams=streams):
+      def emit(rid, toks, finished):
+        streams.setdefault(rid, []).extend(toks)
+
+      return await asyncio.gather(
+        server.submit("greedy", np.asarray([3, 25, 9], np.int32), max_tokens=8, temp=0.0, top_k=35, eos_ids=(), emit=emit),
+        server.submit("sampled", np.asarray([7, 1, 88], np.int32), max_tokens=8, temp=0.8, top_k=35, eos_ids=(), emit=emit),
+      )
+
+    outs[spec] = asyncio.run(run())
+    server.shutdown()
+  assert outs[True] == outs[False], f"sampled/greedy mix diverged: {outs[True]} != {outs[False]}"
+  assert len(outs[True][1]) == 8
+
+
+def test_spec_batch_preempt_resume_mid_speculation(monkeypatch):
+  """QoS preemption of a row that is mid-speculation: the boundary drains
+  the pipeline, the victim resumes token-identically (its prompt absorbs
+  the generated tokens), and the preemptor's stream is exact — speculation
+  state (gamma, EWMA) restarts fresh at re-admission."""
+  from xotorch_support_jetson_tpu.inference.qos import QosConfig, QosPolicy
+
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_KV_TIER", "0")  # preempt via carry_tokens recompute
+  engine, params, shard = _echo_engine()
+  qos = QosPolicy(QosConfig(preempt=True, aging_s=1e9))
+  server = BatchedServer(engine, n_slots=1, chunk=4, lookahead=True, qos=qos, spec_batch=True)
+  solo_long = _single_row_reference(params, shard, [3, 25, 9], 30, cfg=CFG)
+  solo_hi = _single_row_reference(params, shard, [7, 1, 88, 42, 5], 7, cfg=CFG)
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      if rid == "long" and toks:
+        started.set()
+
+    long_task = asyncio.create_task(
+      server.submit("long", np.asarray([3, 25, 9], np.int32), max_tokens=31, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch")
+    )
+    await asyncio.wait_for(started.wait(), timeout=30)
+    hi = await asyncio.wait_for(
+      server.submit("hi", np.asarray([7, 1, 88, 42, 5], np.int32), max_tokens=8, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"),
+      timeout=60,
+    )
+    return hi, await asyncio.wait_for(long_task, timeout=60)
+
+  before = gm.counter_value("qos_preemptions_total")
+  hi, long_out = asyncio.run(run())
+  assert gm.counter_value("qos_preemptions_total") > before, "no preemption happened"
+  assert hi == solo_hi
+  assert long_out == solo_long
+  server.shutdown()
+
+
+def test_spec_batch_draft_kv_accounting(monkeypatch):
+  """ISSUE 7 satellite: enabling speculation shrinks the DEFAULT page pool
+  by the draft cache's byte footprint (expressed in page equivalents) so
+  admission can't oversubscribe the same HBM budget, and the kv_draft_*
+  gauges expose it. An explicit XOT_TPU_BATCH_PAGES stays untouched."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _echo_engine()
+
+  server_off = BatchedServer(engine, n_slots=2, chunk=4, spec_batch=False)
+  server_off._ensure_cache()
+  pages_off = server_off.allocator.n_pages
+  server_off.shutdown()
+
+  server_on = BatchedServer(engine, n_slots=2, chunk=4, spec_batch=True)
+  server_on._ensure_cache()
+  pages_on = server_on.allocator.n_pages
+  assert server_on.spec and server_on.draft_cache is not None
+  assert pages_on < pages_off, f"draft KV never entered pool sizing ({pages_on} vs {pages_off})"
+  assert gm.gauges.get("kv_draft_bytes", 0) > 0
+  assert gm.gauges.get("kv_draft_slots") == 2
+  equiv = gm.gauges.get("kv_draft_pages_equivalent", 0)
+  assert pages_off - pages_on == min(equiv, pages_off - server_on.pages_per_row - 2) or pages_on >= server_on.pages_per_row + 2
+  server_on.shutdown()
+
+  monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "40")
+  server_pin = BatchedServer(engine, n_slots=2, chunk=4, spec_batch=True)
+  server_pin._ensure_cache()
+  assert server_pin.allocator.n_pages == 40  # operator pin wins
+  server_pin.shutdown()
+
+
+def test_spec_policy_table():
+  """The per-row depth policy (inference/paging.py): promote above 0.55,
+  hold through the hysteresis band, demote below 0.30 (interactive: 0.15),
+  floor at 0 — and the worst-advance/headroom math the scheduler plans by."""
+  from xotorch_support_jetson_tpu.inference.paging import ewma_update, spec_adapt_gamma, spec_worst_advance
+
+  assert spec_adapt_gamma(0.9, 2, 4) == 3  # promote
+  assert spec_adapt_gamma(0.9, 4, 4) == 4  # promote caps at gamma_max
+  assert spec_adapt_gamma(0.4, 3, 4) == 3  # hold (hysteresis band)
+  assert spec_adapt_gamma(0.2, 4, 4) == 2  # demote halves
+  assert spec_adapt_gamma(0.01, 1, 4) == 0  # floor: plain decode
+  assert spec_adapt_gamma(0.01, 0, 4) == 0  # stays on the floor (probe is the caller's)
+  assert spec_adapt_gamma(None, 3, 4) == 3  # no measurement yet: hold
+  # Interactive rows demote later: accepted runs cut their ITL directly.
+  assert spec_adapt_gamma(0.2, 4, 4, priority="interactive") == 4
+  assert spec_adapt_gamma(0.1, 4, 4, priority="interactive") == 2
+
+  assert spec_worst_advance(8, 4) == 40
+  assert spec_worst_advance(4, 1) == 8
+
+  assert ewma_update(None, 0.5) == 0.5
+  assert abs(ewma_update(0.5, 1.0, alpha=0.3) - 0.65) < 1e-9
+  assert ewma_update(0.5, 2.0) <= 1.0  # observations clamp to [0, 1]
+
+
+def test_spec_kv_cache_bytes_block_math():
+  """Draft-accounting block math: bf16 vs int8 per-token bytes match the
+  layout init_kv_cache/init_paged_pool actually allocate."""
+  import jax.numpy as jnp
+
+  from xotorch_support_jetson_tpu.inference.paging import kv_cache_bytes
+  from xotorch_support_jetson_tpu.models.decoder import init_kv_cache
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=64)
+  for quant in ("", "int8"):
+    cache = init_kv_cache(cfg, 2, 1, 64, quant=quant)
+    real = sum(int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize for v in cache.values())
+    assert kv_cache_bytes(cfg, 2, 64, quant) == real, quant
+
+
+def test_spec_batch_interactive_rows_start_deeper(monkeypatch):
+  """QoS interaction: interactive/standard rows open at full depth, batch
+  rows start shallow (they must earn depth through acceptance)."""
+  from xotorch_support_jetson_tpu.inference.qos import QosConfig, QosPolicy
+
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _echo_engine()
+  server = BatchedServer(engine, n_slots=4, chunk=4, lookahead=False, qos=QosPolicy(QosConfig()), spec_batch=True)
+  seen = {}
+  orig = server.ops.spec_paged_batch_decode
+
+  def spy(token, pool, cache_d, bt, pos, active, gammas, *a, **k):
+    g = np.asarray(gammas)
+    for i in range(g.shape[0]):
+      if g[i] > 0 and i not in seen:
+        seen[i] = int(g[i])
+    return orig(token, pool, cache_d, bt, pos, active, gammas, *a, **k)
+
+  server.ops.spec_paged_batch_decode = spy
+
+  async def run():
+    emit = lambda *_: None
+    await asyncio.gather(
+      server.submit("i", np.asarray([3, 25, 9], np.int32), max_tokens=6, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="interactive"),
+      server.submit("b", np.asarray([7, 1, 88], np.int32), max_tokens=6, temp=0.0, top_k=35, eos_ids=(), emit=emit, priority="batch"),
+    )
+
+  asyncio.run(run())
+  server.shutdown()
+  rows = sorted(seen.values(), reverse=True)
+  assert rows and rows[0] == server.spec_gamma_max  # interactive at full depth
+  assert min(seen.values()) == max(server.spec_gamma_max // 2, 1)  # batch shallow

@@ -47,13 +47,77 @@ class _PageCopyMixin:
 
 
 class DecoderBatchOps(_PageCopyMixin):
-  """Single-device batched serving ops (the default)."""
+  """Single-device batched serving ops (the default).
+
+  Since ISSUE 7 this is also the one backend that supports BATCHED
+  SPECULATIVE decoding: when the engine carries a draft
+  (``XOT_TPU_SPEC_DECODE=int8`` self-draft or ``XOT_TPU_SPEC_DRAFT`` cross
+  model), ``spec_batch_decode``/``spec_paged_batch_decode`` run the
+  draft-then-verify chunk (models/decoder.py) with the draft's own dense
+  slot cache created/prefilled through ``init_draft_cache`` /
+  ``prefill_draft_into_slots``. The pp/sp mesh backends report
+  ``spec_supported() == False`` — their pipelined programs have no draft
+  integration yet — and the scheduler falls back to plain chunks there."""
 
   def __init__(self, engine):
     self.engine = engine
 
   def round_slots(self, n: int) -> int:
     return n
+
+  # ------------------------------------------------- batched speculation
+
+  def spec_supported(self) -> bool:
+    return getattr(self.engine, "_draft_params", None) is not None
+
+  def draft_geometry(self):
+    """(cfg_d, shard_d) of the draft — the target's own for a self-draft."""
+    eng = self.engine
+    return (getattr(eng, "_draft_cfg", None) or eng.cfg), (getattr(eng, "_draft_shard", None) or eng._effective_shard)
+
+  def init_draft_cache(self, n_slots: int, max_seq: int):
+    from ..models.decoder import init_kv_cache
+
+    cfg_d, shard_d = self.draft_geometry()
+    # The draft cache stays in model dtype regardless of XOT_TPU_KV_QUANT:
+    # it is already small (the whole point of the draft), and quantizing it
+    # would put int8 rounding between the draft's proposals and the target's
+    # verification for no meaningful HBM win.
+    cache = init_kv_cache(cfg_d, shard_d.n_shard_layers, n_slots, max_seq, quant="")
+    place = getattr(self.engine, "_place_cache", None)
+    return place(cache, cfg=cfg_d) if place is not None else cache
+
+  def prefill_draft_into_slots(self, tokens, cache_d, rows, prompt_lens):
+    from ..models.decoder import prefill_into_slots
+
+    eng = self.engine
+    cfg_d, shard_d = self.draft_geometry()
+    _, cache_d = prefill_into_slots(
+      eng._draft_params, cfg_d, shard_d, tokens, cache_d, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32)
+    )
+    return cache_d
+
+  def spec_batch_decode(self, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key):
+    from ..models.decoder import fused_spec_batch_decode
+
+    eng = self.engine
+    cfg_d, shard_d = self.draft_geometry()
+    return fused_spec_batch_decode(
+      eng.params, eng.cfg, eng._effective_shard, eng._draft_params, cfg_d, shard_d,
+      token, cache, cache_d, positions, active, gammas, temps, n_rounds, gamma_max,
+      top_k=top_ks, k_max=k_max, key=key,
+    )
+
+  def spec_paged_batch_decode(self, token, pool, cache_d, block_tables, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, page_size: int, key):
+    from ..models.decoder import fused_spec_paged_batch_decode
+
+    eng = self.engine
+    cfg_d, shard_d = self.draft_geometry()
+    return fused_spec_paged_batch_decode(
+      eng.params, eng.cfg, eng._effective_shard, eng._draft_params, cfg_d, shard_d,
+      token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds, gamma_max,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+    )
 
   def init_cache(self, n_slots: int, max_seq: int):
     from ..models.decoder import init_kv_cache
@@ -110,6 +174,9 @@ class PPBatchOps(_PageCopyMixin):
     self.engine = engine
     self.pp = pp_batched
 
+  def spec_supported(self) -> bool:
+    return False  # no draft integration in the pipelined programs (yet)
+
   def round_slots(self, n: int) -> int:
     p = self.pp.n_stages
     return ((max(n, p) + p - 1) // p) * p
@@ -149,6 +216,9 @@ class SPBatchOps(_PageCopyMixin):
   def __init__(self, engine, sp_batched):
     self.engine = engine
     self.sp = sp_batched
+
+  def spec_supported(self) -> bool:
+    return False  # no draft integration over the sp mesh (yet)
 
   def round_slots(self, n: int) -> int:
     return n

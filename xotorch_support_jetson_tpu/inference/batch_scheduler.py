@@ -51,6 +51,34 @@ shapes:
   valid) subkeys than they would under ``XOT_TPU_SCHED_LOOKAHEAD=0`` — A/B
   comparisons of sampled traffic are per-request, not cross-request.
 
+Speculative decoding is a FIRST-CLASS SCHEDULER MODE (``XOT_TPU_SPEC_BATCH``,
+default auto — ISSUE 7): when the engine carries a draft model
+(``XOT_TPU_SPEC_DECODE=int8`` / ``XOT_TPU_SPEC_DRAFT``) and the backend
+supports it, each decode tick dispatches a draft-then-verify chunk
+(``models/decoder.py fused_spec_[paged_]batch_decode``): ``chunk`` rounds in
+which a batched draft proposes up to gamma tokens per row, ONE batched target
+forward verifies every row's window, and per-row accept/reject becomes a
+variable advance on the paged pool — rejected tails are garbage the next
+round's writes cover before any read (the same drop-on-read argument as the
+lookahead pipeline). Depth is adaptive PER ROW: an acceptance EWMA walks each
+row's gamma through the policy table (inference/paging.py
+``spec_adapt_gamma``; floor 0 = plain decode, so rows where the draft isn't
+paying stop proposing without dragging the batch), interactive-class rows
+demote later (accepted runs directly cut their ITL), and when every row sits
+at gamma 0 the scheduler dispatches the PLAIN chunk program (re-probing at
+gamma 1 every ``XOT_TPU_SPEC_REPROBE`` plain chunks). Page growth and the
+context-window gate run against the chunk's WORST-CASE advance
+(``spec_worst_advance`` — gamma-deep speculative headroom); within
+``spec_worst_advance`` tokens of the context window the batch falls back to
+plain chunks so the window-end cutoff keeps plain-mode chunk granularity.
+The draft's own dense slot cache rides next to the target pool (prefilled at
+admission), and its HBM bytes enter the pool-sizing block math so enabling
+speculation cannot oversubscribe admission (``kv_draft_*`` gauges). Greedy
+streams are token-identical to the plain program by construction; sampled
+rows always run gamma 0 and draw one sample per round (same key-split
+schedule as plain chunks). ``XOT_TPU_SPEC_BATCH=0`` restores the plain
+program byte-for-byte.
+
 Admission runs through the QoS layer (inference/qos.py, ``XOT_TPU_QOS``,
 default on): priority classes with anti-starvation aging, weighted-fair
 tenant selection, per-tenant token-bucket rate limits, deadline-aware
@@ -92,7 +120,7 @@ import numpy as np
 
 from ..orchestration.tracing import tracer
 from ..utils.helpers import DEBUG
-from ..utils.metrics import metrics
+from ..utils.metrics import FRACTION_BUCKETS, metrics
 from .engine import PromptTooLongError, ServerOverloadedError
 from .qos import DeadlineUnmeetableError, QosPolicy, QosQueue, priority_rank, qos_enabled
 
@@ -156,6 +184,10 @@ class _Slot:
   shared_pages: list = field(default_factory=list)
   pages: list = field(default_factory=list)
   chain_keys: list = field(default_factory=list)
+  # Batched speculation (ISSUE 7): this row's current draft depth and the
+  # acceptance EWMA that drives it (inference/paging.py spec_adapt_gamma).
+  spec_gamma: int = 0
+  spec_ewma: float | None = None
 
 
 @dataclass
@@ -169,6 +201,7 @@ class _Plan:
   starved: set  # rows resident but skipped this chunk (page-starved)
   positions: np.ndarray  # [B] int32 dispatch positions
   deadlocked: bool = False  # every resident row starved, nothing finishing
+  gmax: int = 0  # >0: dispatch the SPEC program at this depth cap (ISSUE 7)
 
 
 @dataclass
@@ -181,19 +214,30 @@ class _Chunk:
   dispatch-time plan so host bookkeeping runs against the state the compiled
   program actually saw — not against state that moved while it flew."""
 
-  toks: object  # device [B, chunk] int32
+  toks: object  # device [B, chunk] int32 ([B, rounds·(gamma_max+1)] for spec chunks)
   next_tok: object  # device [B, 1] int32 — chunk N+1's input token handle
   rows: list  # [(row, _Slot)] resident at dispatch
   active: np.ndarray  # [B] bool — rows that stepped in this chunk
   starved: frozenset
   t_dispatch: float
   chained: bool  # dispatched on top of an in-flight chunk (device never idled)
+  # Batched speculation (ISSUE 7): variable-advance chunks. ``worst`` is the
+  # chunk's worst-case per-row advance (== chunk for plain chunks) — what
+  # the NEXT plan must assume while this chunk flies; ``counts``/``pos_dev``
+  # are the device handles of the real per-row advance (settle reads counts;
+  # a chained spec dispatch consumes pos_dev without a host round trip).
+  spec: bool = False
+  worst: int = 0
+  rounds: int = 0
+  counts: object = None  # device [B] int32 — valid tokens per row
+  pos_dev: object = None  # device [B] int32 — post-chunk positions
+  gammas: np.ndarray | None = None  # [B] dispatched depths (metrics/EWMA)
 
 
 class BatchedServer:
   """Owns the slot pool and the decode loop for one engine."""
 
-  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None, lookahead: bool | None = None, qos: "QosPolicy | bool | None" = None):
+  def __init__(self, engine, n_slots: int | None = None, chunk: int | None = None, top_k: int | None = None, max_queue: int | None = None, lookahead: bool | None = None, qos: "QosPolicy | bool | None" = None, spec_batch: bool | None = None):
     self.engine = engine
     # Device ops go through the engine's backend (inference/batch_ops.py):
     # single-device fused programs, or the pp-pipelined variants when the
@@ -240,6 +284,19 @@ class BatchedServer:
     # the KV content behind the same token chains.
     self.tier = None
     self.decode_path = "dense"  # resolved per pool config in _ensure_cache
+    # Batched speculation (ISSUE 7, module docstring). ``spec_batch=None``
+    # resolves from XOT_TPU_SPEC_BATCH (default auto: on exactly when the
+    # engine carries a draft and the backend supports it); the final verdict
+    # lands in ``self.spec`` at cache-build time — the draft cache's HBM
+    # must enter the pool-sizing math before the pool exists.
+    self._spec_batch_arg = spec_batch
+    self.spec = False
+    self.draft_cache = None
+    self.spec_gamma_max = int(os.getenv("XOT_TPU_SPEC_BATCH_GAMMA", "0") or 0) or int(getattr(engine, "spec_gamma", 4))
+    # Plain chunks between gamma-1 re-probes once every row has collapsed to
+    # plain decode (0 disables re-probing).
+    self.spec_reprobe = int(os.getenv("XOT_TPU_SPEC_REPROBE", "32"))
+    self._spec_plain_chunks = 0
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     # QoS layer (inference/qos.py): priority classes + per-tenant fair
@@ -517,6 +574,7 @@ class BatchedServer:
     task = self._loop_task
     self._loop_task = None
     self.cache = None
+    self.draft_cache = None
     if self.tier is not None:
       # A model swap invalidates the host tier's CONTENT (chain keys hash
       # token ids, not weights — the same chain under a new model must not
@@ -570,6 +628,27 @@ class BatchedServer:
       from .paging import select_decode_path
 
       self.paged = select_decode_path(self.n_slots, self.max_seq, kv_quant) != "dense"
+    # Batched speculation verdict (module docstring): needs the resolved
+    # layout (the paged program excludes MLA) and must land BEFORE pool
+    # sizing so the draft cache's bytes can enter the page budget.
+    mode = os.getenv("XOT_TPU_SPEC_BATCH", "auto")
+    want = self._spec_batch_arg if self._spec_batch_arg is not None else mode not in ("0", "false")
+    self.spec = (
+      bool(want)
+      and getattr(self.ops, "spec_supported", lambda: False)()
+      and not (self.paged and eng.cfg.is_mla)
+    )
+    draft_pages_equiv = 0
+    if self.spec:
+      from .paging import kv_cache_bytes
+
+      cfg_d, shard_d = self.ops.draft_geometry()
+      draft_bytes = kv_cache_bytes(cfg_d, shard_d.n_shard_layers, self.n_slots * self.max_seq, "")
+      page_bytes = max(kv_cache_bytes(eng.cfg, eng._effective_shard.n_shard_layers, self.page_size, kv_quant), 1)
+      draft_pages_equiv = -(-draft_bytes // page_bytes)  # ceil
+      metrics.set_gauge("kv_draft_bytes", draft_bytes)
+      metrics.set_gauge("kv_draft_slots", self.n_slots)
+      metrics.set_gauge("kv_draft_pages_equivalent", draft_pages_equiv)
     if self.paged:
       from .paging import PageAllocator, pages_to_cover
 
@@ -586,6 +665,13 @@ class BatchedServer:
       if kv_quant:
         hd = max(eng.cfg.cache_k_dim, 1)
         per_dense = (2 * per_dense * hd) // (hd + 4)
+      if draft_pages_equiv:
+        # Draft-KV accounting (ISSUE 7): the draft cache rides in the SAME
+        # HBM budget, so its page-equivalent comes out of the default pool —
+        # enabling speculation cannot oversubscribe admission. Floored at
+        # one row's window so a tiny test budget still serves; an explicit
+        # XOT_TPU_BATCH_PAGES is the operator's own bookkeeping.
+        per_dense = max(per_dense - draft_pages_equiv, self.pages_per_row + 1)
       n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or per_dense + 1
       self.allocator = PageAllocator(n_pages, ps)
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
@@ -601,6 +687,8 @@ class BatchedServer:
         self.allocator.spill_hook = self.tier.spill
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
+    if self.spec:
+      self.draft_cache = self.ops.init_draft_cache(self.n_slots, self.max_seq)
     # Decode-path attribution label for this pool's compiled chunk program:
     # fixed per (layout, slots, window, quant) — the same resolution
     # fused_paged_batch_decode applies to use_kernel=None.
@@ -995,6 +1083,7 @@ class BatchedServer:
       # chain, so concurrent single-stream requests (and the lookahead
       # pipeline) can't interleave splits (engine.split_key is locked too).
       sub = eng.split_key()
+      draft_job = self._draft_prefill_job(group)
 
       def run():
         from ..models.decoder import sample_rows
@@ -1002,11 +1091,14 @@ class BatchedServer:
         last, self.cache = self.ops.prefill_into_pages_many(
           jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size
         )
+        if draft_job is not None:
+          draft_job()
         return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
 
     else:
       rows = np.asarray([r.row for r in group] + spare[: n_rows - K], dtype=np.int32)
       sub = eng.split_key()  # loop-thread split; the executor only runs device work
+      draft_job = self._draft_prefill_job(group)
 
       def run():
         # Prefill AND first-token sampling stay on the engine executor — the
@@ -1014,6 +1106,8 @@ class BatchedServer:
         from ..models.decoder import sample_rows
 
         last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
+        if draft_job is not None:
+          draft_job()
         return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
 
     # Stage marks go down BEFORE the dispatch so the timeline's
@@ -1047,6 +1141,34 @@ class BatchedServer:
         continue
       self._finish_admission(r, int(firsts[i]))
 
+  def _draft_prefill_job(self, group: list[_Ready]):
+    """Host-side prep of the draft prefill that rides the SAME executor
+    dispatch as the target prefill (ISSUE 7): final-chunk admissions prefill
+    their FULL prompt into the draft's dense slot cache in one padded
+    forward. The draft has no prefix cache — it recomputes reused-prefix
+    tokens too, which a ~4x-faster draft affords — and chunked long prompts
+    draft-prefill ONCE, at the final chunk, rather than per chunk. Greedy
+    identity never depends on this cache (verification is exact for any
+    draft state); it only sets the acceptance rate."""
+    if not self.spec or self.draft_cache is None:
+      return None
+    final = [r for r in group if not r.chunk_end and r.req.temp <= 0.0]
+    if not final:
+      return None
+    d_pad = min(_round_up(max(int(r.req.tokens.shape[0]) for r in final), PREFILL_BUCKET), self.max_seq)
+    dtok = np.zeros((len(final), d_pad), dtype=np.int32)
+    dlens = np.ones((len(final),), dtype=np.int32)
+    drows = np.asarray([r.row for r in final], dtype=np.int32)
+    for i, r in enumerate(final):
+      S = int(r.req.tokens.shape[0])
+      dtok[i, :S] = r.req.tokens
+      dlens[i] = S
+
+    def job():
+      self.draft_cache = self.ops.prefill_draft_into_slots(jnp.asarray(dtok), self.draft_cache, drows, dlens)
+
+    return job
+
   def _finish_admission(self, r: _Ready, first: int) -> None:
     req = r.req
     slot = _Slot(
@@ -1071,6 +1193,14 @@ class BatchedServer:
       if not req.future.done():
         req.future.set_result(slot.out_tokens)
       return
+    if self.spec and req.temp <= 0.0:
+      # Starting depth by QoS class (module docstring): interactive and
+      # standard rows open at full depth — an accepted run directly cuts
+      # their ITL — while batch-class rows start shallow and must EARN depth
+      # through the acceptance EWMA (they only care about throughput, where
+      # a mispredicting deep draft costs most). Sampled rows stay at 0.
+      cls = req.qos.priority if req.qos is not None else "standard"
+      slot.spec_gamma = max(self.spec_gamma_max // 2, 1) if cls == "batch" else self.spec_gamma_max
     self.slots[r.row] = slot
     self._h_occupied[r.row] = True
     self._h_tokens[r.row, 0] = first
@@ -1134,6 +1264,8 @@ class BatchedServer:
     arrays (the single release hook — results walk, preemption, teardown)."""
     if self.paged and self.block_tables is not None:
       self.block_tables[row, :] = 0
+    if self.spec:
+      metrics.set_gauge("spec_gamma", 0, labels={"row": str(row)})
     self._h_occupied[row] = False
     self._h_tokens[row, 0] = 0
     self._h_positions[row] = 0
@@ -1142,17 +1274,20 @@ class BatchedServer:
     self._h_generated[row] = 0
     self._h_max_tokens[row] = 0
 
-  def _grow_pages(self, row: int, slot: _Slot, pos: int) -> bool:
+  def _grow_pages(self, row: int, slot: _Slot, pos: int, headroom: int | None = None) -> bool:
     """Ensure ``slot`` has pages covering the chunk dispatched at ``pos``.
 
     ``pos`` is the DISPATCH-time position — under lookahead it already
     includes the in-flight chunk's speculative advance, so growth reserves
     one extra chunk of headroom ahead of the confirmed position and the
     speculative chunk can never overflow the block table
-    (inference/paging.py ``pages_to_cover``)."""
+    (inference/paging.py ``pages_to_cover``). ``headroom`` overrides the
+    plain chunk size for spec-batch dispatches: their worst-case advance is
+    ``spec_worst_advance(chunk, gamma_max)`` — gamma-deep speculative
+    headroom (ISSUE 7)."""
     from .paging import pages_to_cover
 
-    needed = pages_to_cover(pos + self.chunk, self.page_size)
+    needed = pages_to_cover(pos + (headroom if headroom is not None else self.chunk), self.page_size)
     have = len(slot.shared_pages) + len(slot.pages)
     if needed <= have:
       return True
@@ -1191,7 +1326,7 @@ class BatchedServer:
     self._parked_avail_seen = avail  # shrunk: re-baseline, keep chaining
     return False
 
-  def _plan_chunk(self, inflight: _Chunk | None) -> _Plan:
+  def _plan_chunk(self, inflight: _Chunk | None, gmax: int = 0) -> _Plan:
     """Snapshot the next chunk's dispatch state: CONFIRMED slot state plus
     the (single) in-flight chunk's speculative advance.
 
@@ -1202,13 +1337,24 @@ class BatchedServer:
     in-flight chunk deterministically reaches max_tokens is excluded
     outright: an active row advances a full chunk unless EOS lands first,
     and either way the IN-FLIGHT settle resolves it before this chunk's
-    settle runs — this chunk would only decode droppable overrun for it."""
+    settle runs — this chunk would only decode droppable overrun for it.
+
+    Spec-batch interplay (ISSUE 7): an in-flight SPEC chunk's advance is
+    variable, so the plan assumes its WORST case for positions/page-growth —
+    and skips the max_tokens exclusion entirely (worst-case ``generated``
+    could exclude a row that won't actually finish, which would truncate its
+    stream). ``gmax > 0`` means THIS dispatch will be a spec chunk: growth
+    reserves ``spec_worst_advance(chunk, gmax)`` tokens of page headroom."""
+    from .paging import spec_worst_advance
+
     spec = inflight.active if inflight is not None else None
+    headroom = spec_worst_advance(self.chunk, gmax) if gmax > 0 else self.chunk
     positions = self._h_positions.copy()
     generated = self._h_generated.copy()
     if spec is not None:
-      positions[spec] += self.chunk
-      generated[spec] += self.chunk
+      positions[spec] += inflight.worst
+      if not inflight.spec:
+        generated[spec] += inflight.worst
     active = self._h_occupied.copy()
     starved: set[int] = set()
     rows: list = []
@@ -1217,16 +1363,50 @@ class BatchedServer:
       if s is None:
         continue
       rows.append((i, s))
-      if spec is not None and spec[i] and generated[i] >= self._h_max_tokens[i]:
+      if spec is not None and not inflight.spec and spec[i] and generated[i] >= self._h_max_tokens[i]:
         active[i] = False  # finishes at the in-flight settle; drop-on-read covers the rest
       elif s.cancelled or int(positions[i]) + self.chunk >= self.max_seq:
         active[i] = False
         finishing += 1
-      elif self.paged and not self._grow_pages(i, s, int(positions[i])):
+      elif self.paged and not self._grow_pages(i, s, int(positions[i]), headroom):
         active[i] = False
         starved.add(i)  # counted at dispatch — a discarded plan is re-planned, not a second starvation
     deadlocked = inflight is None and bool(starved) and not active.any() and finishing == 0
-    return _Plan(rows=rows, active=active, starved=starved, positions=positions, deadlocked=deadlocked)
+    return _Plan(rows=rows, active=active, starved=starved, positions=positions, deadlocked=deadlocked, gmax=gmax)
+
+  def _spec_intent(self, inflight: _Chunk | None) -> int:
+    """gamma_max for the NEXT decode chunk; 0 ⇒ dispatch the plain program.
+
+    Plain wins when: speculation is off, no greedy row proposes (every
+    depth collapsed to 0 — the acceptance-EWMA floor), or any live row sits
+    within the chunk's worst-case advance of the context window (the plain
+    program's window-end cutoff keeps chunk granularity there — identity
+    over the band). When every depth is 0, one probe chunk at gamma 1 runs
+    every ``spec_reprobe`` plain chunks so a draft that STARTS paying again
+    (e.g. the stream left a pathological region) can re-earn its depth."""
+    if not self.spec or self.draft_cache is None:
+      return 0
+    from .paging import spec_worst_advance
+
+    live = [(i, s) for i, s in enumerate(self.slots) if s is not None and not s.finished and not s.cancelled]
+    greedy = [(i, s) for i, s in live if s.req.temp <= 0.0]
+    if not greedy:
+      return 0
+    gmax = max(s.spec_gamma for _, s in greedy)
+    if gmax == 0:
+      if self.spec_reprobe <= 0 or self._spec_plain_chunks < self.spec_reprobe:
+        return 0
+      for _, s in greedy:  # probe round: shallowest depth, every greedy row
+        s.spec_gamma = 1
+      self._spec_plain_chunks = 0
+      gmax = 1
+    worst = spec_worst_advance(self.chunk, gmax)
+    adv = inflight.worst if inflight is not None else 0
+    for i, s in live:
+      pos = int(self._h_positions[i]) + (adv if (inflight is not None and inflight.active[i]) else 0)
+      if pos + worst >= self.max_seq:
+        return 0  # near-window band: plain chunks carry the row to its end
+    return gmax
 
   def _preempt_starved(self, plan: _Plan) -> None:
     """Every resident row is starved (none can run, and no finishing row is
@@ -1246,8 +1426,19 @@ class BatchedServer:
     """Dispatch one decode chunk and return its in-flight record WITHOUT
     waiting for results: the executor call only enqueues the compiled
     program plus the async device→host copy — the device runs while the
-    host loops back to settle the previous chunk."""
+    host loops back to settle the previous chunk.
+
+    ``plan.gmax > 0`` dispatches the SPEC program (``chunk`` draft/verify
+    rounds, per-row depths from the slots, variable advance — ISSUE 7). A
+    chained spec dispatch consumes the in-flight chunk's device position
+    handle: the host cannot know a spec chunk's variable advance until its
+    settle, so the chain rides device-resident positions exactly like the
+    token."""
+    from .paging import spec_worst_advance
+
     eng = self.engine
+    gmax = plan.gmax
+    spec = gmax > 0
     # Chained dispatch: the input token is the in-flight chunk's
     # device-resident next-token handle (no host round trip); a sync
     # dispatch (pipeline empty) uses the persistent host arrays. The key
@@ -1255,7 +1446,19 @@ class BatchedServer:
     # never touches the engine's PRNG chain.
     tokens = inflight.next_tok if inflight is not None else self._h_tokens
     positions, active = plan.positions, plan.active
+    if spec and inflight is not None:
+      positions = inflight.pos_dev  # true device positions; plan's copy is worst-case
     temps, top_ks = self._h_temps, self._h_top_ks
+    gammas = None
+    if spec:
+      gammas = np.zeros((self.n_slots,), dtype=np.int32)
+      for i, s in plan.rows:
+        if plan.active[i] and s.req.temp <= 0.0:
+          gammas[i] = min(s.spec_gamma, gmax)
+      self._spec_plain_chunks = 0
+    elif self.spec:
+      self._spec_plain_chunks += 1
+    worst = spec_worst_advance(self.chunk, gmax) if spec else self.chunk
     sub = eng.split_key()
     now = time.perf_counter()
     if self._t_last_ready is not None:
@@ -1265,7 +1468,19 @@ class BatchedServer:
       metrics.observe_hist("sched_host_gap_seconds", 0.0 if inflight is not None else now - self._t_last_ready)
 
     def run():
-      if self.paged:
+      counts = pos_dev = None
+      if spec and self.paged:
+        toks, counts, next_tok, pos_dev, self.cache, self.draft_cache = self.ops.spec_paged_batch_decode(
+          jnp.asarray(tokens), self.cache, self.draft_cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
+          jnp.asarray(active), jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax,
+          k_max=self.k_max, page_size=self.page_size, key=sub,
+        )
+      elif spec:
+        toks, counts, next_tok, pos_dev, self.cache, self.draft_cache = self.ops.spec_batch_decode(
+          jnp.asarray(tokens), self.cache, self.draft_cache, jnp.asarray(positions), jnp.asarray(active),
+          jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax, k_max=self.k_max, key=sub,
+        )
+      elif self.paged:
         toks, next_tok, _pos, self.cache = self.ops.paged_batch_decode(
           jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
           jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
@@ -1278,18 +1493,43 @@ class BatchedServer:
         )
       try:
         toks.copy_to_host_async()  # the readback overlaps the next chunk's compute
+        if counts is not None:
+          counts.copy_to_host_async()
       except AttributeError:  # backend without async copies
         pass
-      return toks, next_tok
+      return toks, next_tok, counts, pos_dev
 
     if plan.starved:
       metrics.inc("scheduler_page_starved_total", len(plan.starved))
     t_dispatch = time.perf_counter()
-    toks, next_tok = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    toks, next_tok, counts, pos_dev = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     return _Chunk(
       toks=toks, next_tok=next_tok, rows=plan.rows, active=plan.active,
       starved=frozenset(plan.starved), t_dispatch=t_dispatch, chained=inflight is not None,
+      spec=spec, worst=worst, rounds=self.chunk if spec else 0, counts=counts, pos_dev=pos_dev, gammas=gammas,
     )
+
+  def _note_spec_settle(self, row: int, slot: _Slot, record: _Chunk, avail: int, emitted: int) -> None:
+    """Per-row spec-chunk bookkeeping at the settle: acceptance counters,
+    the EWMA → depth policy step, the per-row depth gauge, and the timeline
+    decode stage carrying the chunk's accepted-run total (ISSUE 7)."""
+    from .paging import ewma_update, spec_adapt_gamma
+
+    g = int(record.gammas[row]) if record.gammas is not None else 0
+    accepted = max(avail - record.rounds, 0)
+    metrics.inc("spec_accepted_tokens_total", accepted)
+    if g > 0:
+      metrics.inc("spec_proposed_tokens_total", record.rounds * g)
+      acc = accepted / float(record.rounds * g)
+      slot.spec_ewma = ewma_update(slot.spec_ewma, acc)
+      prio = slot.req.qos.priority if slot.req.qos is not None else "standard"
+      slot.spec_gamma = spec_adapt_gamma(slot.spec_ewma, g, self.spec_gamma_max, prio)
+      metrics.observe_hist("spec_acceptance_ewma", slot.spec_ewma, buckets=FRACTION_BUCKETS)
+    metrics.set_gauge("spec_gamma", slot.spec_gamma, labels={"row": str(row)})
+    tracer.stage(slot.req.request_id, "decode_chunk", {
+      "tokens": emitted, "accepted": accepted, "gamma": g, "rounds": record.rounds,
+      "ewma": round(slot.spec_ewma, 4) if slot.spec_ewma is not None else None,
+    })
 
   async def _settle(self, record: _Chunk) -> None:
     """Read one chunk's tokens back and run the host bookkeeping the
@@ -1301,9 +1541,19 @@ class BatchedServer:
     their pages were released at the earlier settle and can only be
     re-granted to dispatches that execute AFTER this chunk on the single
     device stream, so the garbage writes are always overwritten or
-    positionally masked before anyone reads them."""
+    positionally masked before anyone reads them.
+
+    Spec chunks (ISSUE 7) settle with a VARIABLE advance: the counts vector
+    says how many of each row's buffer slots are real; the emit walk below
+    is otherwise identical (EOS/max_tokens cut inside an accepted run the
+    same way they cut inside a plain chunk), and each row's measured
+    acceptance drives its EWMA → next-depth policy here, at the settle."""
     eng = self.engine
-    rows_host = await asyncio.get_event_loop().run_in_executor(eng.executor, lambda: np.asarray(record.toks))
+
+    def fetch():
+      return np.asarray(record.toks), (np.asarray(record.counts) if record.counts is not None else None)
+
+    rows_host, counts_host = await asyncio.get_event_loop().run_in_executor(eng.executor, fetch)
     t_ready = time.perf_counter()
     # Device-time attribution: while the pipeline is full the device runs
     # chunks back-to-back, so per-chunk device time is READY-TO-READY (==
@@ -1317,7 +1567,7 @@ class BatchedServer:
       # Per-chunk decode-path attribution: the dispatch table's real-world
       # mix, observable at /metrics instead of only in offline bench JSON.
       metrics.observe_hist("decode_chunk_seconds", chunk_dt)
-      metrics.inc("decode_chunks_total", labels={"path": self.decode_path})
+      metrics.inc("decode_chunks_total", labels={"path": "spec" if record.spec else self.decode_path})
 
     for i, slot in record.rows:
       if slot.finished or self.slots[i] is not slot:
@@ -1335,15 +1585,18 @@ class BatchedServer:
         self.slots[i] = None
         self._clear_row(i)
         continue
+      avail = int(counts_host[i]) if record.spec else rows_host.shape[1]
       emit: list[int] = []
       done = False
-      for t in rows_host[i]:
+      for t in rows_host[i][:avail]:
         t = int(t)
         emit.append(t)
         slot.generated += 1
         if t in req.eos_ids or slot.generated >= req.max_tokens:
           done = True
           break
+      if record.spec:
+        self._note_spec_settle(i, slot, record, avail, len(emit))
       slot.out_tokens.extend(emit)
       slot.pos += len(emit)
       slot.last_token = emit[-1] if emit else slot.last_token
@@ -1351,7 +1604,9 @@ class BatchedServer:
       self._h_generated[i] = slot.generated
       self._h_tokens[i, 0] = slot.last_token
       if emit:
-        metrics.inc("decode_tokens_total", len(emit), labels={"path": self.decode_path})
+        # Same path label as this chunk's decode_chunks_total increment, so
+        # the two per-path series stay ratio-able (tokens per chunk).
+        metrics.inc("decode_tokens_total", len(emit), labels={"path": "spec" if record.spec else self.decode_path})
         # Inter-token latency: the chunk's wall-clock amortized over its
         # tokens — ONE weighted observation (utils/metrics.py observe_hist
         # n=k) instead of k lock round trips.
@@ -1431,7 +1686,15 @@ class BatchedServer:
             await self._admit_pending(woken=req)
             continue
 
-        plan = self._plan_chunk(inflight)
+        gmax = self._spec_intent(inflight)
+        if inflight is not None and inflight.spec != (gmax > 0):
+          # Program-type switch (spec↔plain): a chained dispatch would need
+          # the other program's chain contract (device positions vs host
+          # plan) — settle the in-flight chunk and dispatch synchronously.
+          await self._settle(inflight)
+          inflight = None
+          continue
+        plan = self._plan_chunk(inflight, gmax)
         if inflight is not None and (not plan.rows or not plan.active.any()):
           # Nothing would step — a membership change is imminent (every row
           # finishing, starved, or already resolved by the in-flight
@@ -1458,7 +1721,9 @@ class BatchedServer:
         traceback.print_exc()
       # The fused calls donate the cache: after a mid-call failure the
       # buffers may be consumed — drop it so the next submit reallocates.
+      # The draft cache is donated by the spec programs the same way.
       self.cache = None
+      self.draft_cache = None
       self._fail_all(e)
 
   def _fail_all(self, exc: Exception) -> None:

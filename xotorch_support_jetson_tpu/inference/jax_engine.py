@@ -159,6 +159,17 @@ class JaxShardedInferenceEngine(InferenceEngine):
     # output is token-identical to plain greedy.
     self.spec_decode = spec_decode if spec_decode is not None else (os.getenv("XOT_TPU_SPEC_DECODE") or None)
     self.spec_gamma = int(os.getenv("XOT_TPU_SPEC_GAMMA", "4"))
+    # Acceptance-adaptive depth (ISSUE 7): the LIVE gamma starts at
+    # spec_gamma and walks the policy table (inference/paging.py
+    # spec_adapt_gamma) on every measured chunk/oneshot acceptance — floor 0
+    # means the solo spec path hands the stream to plain decode instead of
+    # losing to it (the 149-vs-212 tok/s inversion becomes a fallback), and
+    # a gamma-1 probe runs every XOT_TPU_SPEC_REPROBE plain dispatches so a
+    # draft that starts paying again re-earns its depth.
+    self._spec_ewma = None
+    self._spec_gamma_live = self.spec_gamma
+    self._spec_plain_streak = 0
+    self._spec_reprobe = int(os.getenv("XOT_TPU_SPEC_REPROBE", "64"))
     self._draft_params = None
     # Cross-model draft (XOT_TPU_SPEC_DRAFT=<registry-id-or-dir>): a second,
     # SMALLER model drafts for the target. None ⇒ int8 self-draft (same cfg).
@@ -287,6 +298,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._draft_params = None
     self._draft_cfg = None
     self._draft_shard = None
+    # A new draft is a new acceptance distribution: reset the adaptive state.
+    self._spec_ewma = None
+    self._spec_gamma_live = self.spec_gamma
+    self._spec_plain_streak = 0
     eff = getattr(self, "_effective_shard", None)
     if self.spec_decode != "int8" or eff is None or not (eff.is_first_layer and eff.is_last_layer) or self.params is None:
       return
@@ -940,7 +955,33 @@ class JaxShardedInferenceEngine(InferenceEngine):
       and session.curr_pos == session.prompt_len  # fresh after prefill
     )
 
-  def _dispatch_spec_chunk_sync(self, request_id, shard, n_steps, first_token, steps: int):
+  def _spec_gamma_for_dispatch(self) -> int:
+    """The adaptive solo-path depth for the NEXT spec dispatch: the live
+    gamma, or a gamma-1 probe once the plain streak earns one, else 0
+    (= take the plain path; XOT_TPU_SPEC_DECODE must never decode slower
+    than plain — the acceptance-EWMA floor, ISSUE 7)."""
+    g = self._spec_gamma_live
+    if g > 0:
+      return g
+    if self._spec_reprobe > 0 and self._spec_plain_streak >= self._spec_reprobe:
+      return 1
+    return 0
+
+  def _note_spec_acceptance(self, emitted: int, rounds: int, gamma: int) -> None:
+    """Fold one spec call's measured acceptance into the engine EWMA and
+    re-run the depth policy (inference/paging.py)."""
+    from .paging import ewma_update, spec_adapt_gamma
+    from ..utils.metrics import FRACTION_BUCKETS
+
+    if rounds <= 0 or gamma <= 0:
+      return
+    acc = (emitted / rounds - 1.0) / gamma
+    self._spec_ewma = ewma_update(self._spec_ewma, acc)
+    self._spec_gamma_live = spec_adapt_gamma(self._spec_ewma, gamma, self.spec_gamma)
+    self._spec_plain_streak = 0
+    metrics.observe_hist("spec_acceptance_ewma", self._spec_ewma, buckets=FRACTION_BUCKETS)
+
+  def _dispatch_spec_chunk_sync(self, request_id, shard, n_steps, first_token, steps: int, gamma: int):
     """One streaming speculative chunk (models/decoder.py
     fused_speculative_chunk). The seed token and position ride the DEVICE
     chain, so the node's pipelined dispatch (enqueue N+1 before reading N)
@@ -957,10 +998,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     else:
       token = session.spec_seed_dev
       pos = session.spec_pos_dev
-    worst = steps + self.spec_gamma + 1
+    worst = steps + gamma + 1
     packed, seed, new_pos, session.kv_cache, session.draft_cache = fused_speculative_chunk(
       self.params, self.cfg, shard, self._draft_params, token, session.kv_cache, session.draft_cache,
-      pos, steps, gamma=self.spec_gamma, n_limit=min(n_steps, steps),
+      pos, steps, gamma=gamma, n_limit=min(n_steps, steps),
       cfg_d=self._draft_cfg, shard_d=self._draft_shard,
     )
     session.spec_seed_dev = seed
@@ -974,20 +1015,25 @@ class JaxShardedInferenceEngine(InferenceEngine):
       packed.copy_to_host_async()
     except AttributeError:  # backend without async copies
       pass
-    return ("spec", request_id, worst, packed)
+    return ("spec", request_id, worst, gamma, packed)
 
   def _dispatch_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
     shard = getattr(self, "_effective_shard", shard)
     session = self.sessions[request_id]
     if self._pp is None and self._spec_chunk_eligible(session, temp, first_token):
-      G = self.spec_gamma
+      G = self._spec_gamma_for_dispatch()
       steps = min(1 << (max(n_steps, 1) - 1).bit_length(), 256)  # bucketed compile size
       # Conservative room bound: confirmed position + every unread chunk's
       # own worst case + this chunk's worst case. Before the chain starts
       # the confirmed position is simply curr_pos.
       base = session.spec_known_pos if session.spec_seed_dev is not None else session.curr_pos
-      if base + session.spec_inflight_slots + (steps + G + 1) + 1 <= session.max_seq:
-        return self._dispatch_spec_chunk_sync(request_id, shard, n_steps, first_token, steps)
+      if G > 0 and base + session.spec_inflight_slots + (steps + G + 1) + 1 <= session.max_seq:
+        return self._dispatch_spec_chunk_sync(request_id, shard, n_steps, first_token, steps, G)
+      if G == 0:
+        # Adaptive floor: the draft isn't paying — this dispatch takes the
+        # plain path (never slower than plain decode), and the streak counts
+        # toward the next gamma-1 probe.
+        self._spec_plain_streak += 1
       if session.spec_seed_dev is not None:
         # Near the cache end: sync the exact chain position once and hand the
         # stream to the plain path, which trims precisely at max_seq. Stale
@@ -1062,6 +1108,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     room = session.max_seq - session.curr_pos
     if room <= 0:
       return []
+    spec_gamma = self._spec_gamma_for_dispatch() if self._draft_params is not None else 0
     if (
       self._draft_params is not None
       and (temp is None or float(temp) <= 0.0)
@@ -1071,9 +1118,13 @@ class JaxShardedInferenceEngine(InferenceEngine):
       # Spec rounds need gamma+1 slots of headroom; near the cache end the
       # plain path can still emit the final tokens — use it so a
       # context-limited response is never cut gamma+1 tokens short.
-      and max_steps <= room - self.spec_gamma - 1
+      and max_steps <= room - spec_gamma - 1
     ):
-      return self._generate_speculative_sync(request_id, shard, first_token, max_steps, eos_ids)
+      if spec_gamma > 0:
+        return self._generate_speculative_sync(request_id, shard, first_token, max_steps, eos_ids, spec_gamma)
+      # Acceptance-EWMA floor (ISSUE 7): the draft isn't paying — plain
+      # decode, counting toward the next gamma-1 probe.
+      self._spec_plain_streak += 1
     # Bucket the COMPILED step count (power-of-two, capped by cache room) so
     # varying max_tokens requests reuse a handful of compiled programs; the
     # actual step cap travels as a traced scalar, so no extra steps run.
@@ -1124,25 +1175,27 @@ class JaxShardedInferenceEngine(InferenceEngine):
     lens = jnp.full((B,), S, dtype=jnp.int32)
     _, session.draft_cache = _prefill(self._draft_params, cfg_d, shard_d, jnp.asarray(x_in), self._place_cache(cache, cfg=cfg_d), lens)
 
-  def _generate_speculative_sync(self, request_id, shard, first_token, max_steps, eos_ids):
+  def _generate_speculative_sync(self, request_id, shard, first_token, max_steps, eos_ids, gamma: int | None = None):
     """Greedy speculative oneshot: int8 self-draft + bf16 target fused in one
     while_loop program (models/decoder.py fused_speculative_generate).
     Output is exactly the plain-greedy tokens; only the speed differs."""
     from ..models.decoder import fused_speculative_generate
 
+    gamma = self.spec_gamma if gamma is None else gamma
     session = self.sessions[request_id]
     room = session.max_seq - session.curr_pos
-    limit = min(max_steps, room - self.spec_gamma - 1)  # caller guarantees > 0
-    steps = min(1 << (limit - 1).bit_length(), room - self.spec_gamma - 1)
+    limit = min(max_steps, room - gamma - 1)  # caller guarantees > 0
+    steps = min(1 << (limit - 1).bit_length(), room - gamma - 1)
     self._ensure_draft_cache(session, shard)
     token = jnp.full((1, 1), int(first_token), dtype=jnp.int32)
     eos = tuple(sorted(int(e) for e in eos_ids))
-    buf, n, _rounds, session.kv_cache, session.draft_cache = fused_speculative_generate(
+    buf, n, rounds, session.kv_cache, session.draft_cache = fused_speculative_generate(
       self.params, self.cfg, shard, self._draft_params, self._draft_cfg or self.cfg, self._draft_shard or shard,
       token, session.kv_cache, session.draft_cache, session.curr_pos,
-      steps, gamma=self.spec_gamma, eos_ids=eos, n_limit=limit,
+      steps, gamma=gamma, eos_ids=eos, n_limit=limit,
     )
     row = np.asarray(buf)
+    self._note_spec_acceptance(int(n), int(rounds), gamma)
     n = min(int(n), limit)
     if eos:
       hits = np.nonzero(np.isin(row[:n], eos))[0]
@@ -1159,20 +1212,23 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
     def read():
       if isinstance(handle, tuple) and handle[0] == "spec":
-        # Packed speculative chunk: [m, tokens...] in one fetch. Confirm the
-        # chain position host-side (the room bound tightens back up) — but
-        # ONLY while the chain is still active: after the near-cache-end
-        # handoff curr_pos is already exact (it includes this chunk), and a
-        # stale update would desync it from the device.
-        _, request_id, worst, packed = handle
+        # Packed speculative chunk: [m, rounds, tokens...] in one fetch.
+        # Confirm the chain position host-side (the room bound tightens back
+        # up) — but ONLY while the chain is still active: after the
+        # near-cache-end handoff curr_pos is already exact (it includes this
+        # chunk), and a stale update would desync it from the device. The
+        # round count feeds the acceptance EWMA that adapts the NEXT chunk's
+        # gamma (ISSUE 7).
+        _, request_id, worst, gamma, packed = handle
         row = np.asarray(packed)
         m = int(row[0])
+        self._note_spec_acceptance(m, int(row[1]), gamma)
         session = self.sessions.get(request_id)
         if session is not None and session.spec_seed_dev is not None:
           session.spec_known_pos += m
           session.spec_inflight_slots = max(session.spec_inflight_slots - worst, 0)
           session.curr_pos = session.spec_known_pos
-        return [int(t) for t in row[1 : 1 + m]]
+        return [int(t) for t in row[2 : 2 + m]]
       return [int(t) for t in np.asarray(handle)[0]]
 
     return await asyncio.get_event_loop().run_in_executor(self.executor, read)

@@ -137,6 +137,82 @@ def resolved_decode_path(batch: int, context: int, kv_quant: str = "", paged: bo
   return "kernel"
 
 
+# ------------------------------------------- per-row speculation policy
+#
+# The same dispatch-table philosophy as _DECODE_PATH_TABLE, extended to a
+# PER-ROW policy (ISSUE 7): which speculation depth wins is a function of the
+# measured acceptance, so neither "always speculate" nor "never" is
+# hardwired — each batch row carries an acceptance EWMA and its gamma walks
+# this table every chunk. Provenance for the thresholds: with an ~4x-faster
+# draft (the 8B/1B pair) a round costs ≈ gamma/4 + 1 target-equivalents and
+# yields 1 + acc·gamma tokens, so break-even acceptance sits near 0.25-0.35
+# across gamma 1-4; the solo-path inversion the ISSUE cites (149 vs 212
+# tok/s) was measured at 0.64 acceptance with the ~1.6x self-draft — hence
+# demote below ~0.30 and deepen only above ~0.55, with hysteresis between.
+# Interactive-class rows use a LOWER demote bar: an accepted run directly
+# cuts their inter-token latency, so speculation stays worth keeping even
+# when throughput-neutral (the QoS interaction ISSUE 7 names).
+#
+# Rows are (min_ewma, action); first row whose bound covers the EWMA wins.
+_SPEC_GAMMA_TABLE = (
+  (0.55, "promote"),  # draft paying well: deepen by 1 toward gamma_max
+  (0.30, "hold"),  # marginal: keep the current depth (hysteresis band)
+  (0.0, "demote"),  # not paying: halve toward the floor
+)
+_SPEC_DEMOTE_FLOOR = {"interactive": 0.15}  # class-specific demote override
+
+
+def spec_adapt_gamma(ewma: float | None, gamma: int, gamma_max: int, priority: str = "standard") -> int:
+  """Next chunk's speculation depth for one row, from its acceptance EWMA.
+
+  Floor 0 = plain decode: the row stops proposing entirely (its window
+  degenerates to one target token per round) instead of dragging the batch.
+  Re-promotion from 0 is the CALLER's probe (the scheduler re-probes idle
+  rows at gamma 1 every ``XOT_TPU_SPEC_REPROBE`` plain chunks) — the policy
+  itself never resurrects a depth it has no fresh measurement for."""
+  if ewma is None or gamma <= 0:
+    return max(min(gamma, gamma_max), 0)
+  demote_bar = _SPEC_DEMOTE_FLOOR.get(priority, _SPEC_GAMMA_TABLE[1][0])
+  for bound, action in _SPEC_GAMMA_TABLE:
+    if ewma >= bound:
+      if action == "promote":
+        return min(gamma + 1, gamma_max)
+      if action == "hold" or (action == "demote" and ewma >= demote_bar):
+        return min(gamma, gamma_max)
+      return gamma // 2
+  return gamma // 2
+
+
+def spec_worst_advance(n_rounds: int, gamma_max: int) -> int:
+  """Worst-case tokens one spec chunk advances a row: every round fully
+  accepted. The scheduler's page growth and context-window band gate both
+  run against this (gamma-deep speculative headroom, the analogue of the
+  lookahead pipeline's one-extra-chunk reservation)."""
+  return int(n_rounds) * (int(gamma_max) + 1)
+
+
+def ewma_update(prev: float | None, obs: float, alpha: float = 0.3) -> float:
+  """One acceptance-EWMA step (first observation seeds the average)."""
+  obs = min(max(float(obs), 0.0), 1.0)
+  return obs if prev is None else (1.0 - alpha) * float(prev) + alpha * obs
+
+
+def kv_cache_bytes(cfg, n_layers: int, n_tokens: int, quant: str = "") -> int:
+  """HBM bytes of ``n_tokens`` cached positions under ``quant`` — the block
+  math shared by the scheduler's pool sizing and the draft-cache accounting
+  (ISSUE 7: enabling speculation must not oversubscribe admission)."""
+  import jax.numpy as jnp
+
+  heads = cfg.cache_kv_heads
+  per_side = cfg.cache_k_dim + cfg.cache_v_dim
+  if quant:
+    # int8 codes (1 byte/element) + one f32 scale per (token, head) per side.
+    per_token = heads * (per_side + 2 * 4)
+  else:
+    per_token = heads * per_side * jnp.dtype(cfg.dtype).itemsize
+  return int(n_layers) * int(n_tokens) * int(per_token)
+
+
 def pages_to_cover(end_pos: int, page_size: int) -> int:
   """Pages a row needs so every position in ``[0, end_pos)`` maps to an
   allocated block-table entry.

@@ -866,13 +866,15 @@ def fused_speculative_generate(
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
 def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_limit, steps: int, gamma: int, eos_ids: tuple, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard):
-  buf, n, _rounds, cache_t, cache_d = _fused_spec_generate_impl(
+  buf, n, rounds, cache_t, cache_d = _fused_spec_generate_impl(
     params_t, params_d, cfg, cfg_d, shard, shard_d, cache_t, cache_d, token, pos, steps, gamma, eos_ids, n_limit
   )
   m = jnp.minimum(n, n_limit)
-  # [m, tokens...] in ONE array: the host learns the count and the tokens in
-  # a single fetch (a separate scalar fetch costs a full tunnel RTT).
-  packed = jnp.concatenate([m[None], buf])
+  # [m, rounds, tokens...] in ONE array: the host learns the count, the round
+  # count (the acceptance-EWMA gamma policy needs it — ISSUE 7) and the
+  # tokens in a single fetch (a separate scalar fetch costs a full tunnel
+  # RTT).
+  packed = jnp.concatenate([m[None], rounds[None], buf])
   # The chain stays ON DEVICE: seed = last emitted token, pos advances by m —
   # the next chunk can dispatch before this one is ever read back.
   seed = jnp.where(m > 0, buf[jnp.maximum(m - 1, 0)], token[0, 0]).reshape(1, 1)
@@ -884,8 +886,8 @@ def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, 
 
   Same math as ``fused_speculative_generate`` (greedy, exact vs plain greedy
   for any draft) bounded to ``steps`` emitted tokens. Returns
-  (packed [1+steps+gamma+1] int32 = [m, tokens...], seed [1,1], new_pos [],
-  cache_t, cache_d) — seed/new_pos are lazy device values, so the engine can
+  (packed [2+steps+gamma+1] int32 = [m, rounds, tokens...], seed [1,1],
+  new_pos [], cache_t, cache_d) — seed/new_pos are lazy device values, so the engine can
   dispatch chunk N+1 from chunk N's outputs with no host round-trip, and the
   node's pipelined chunk loop works unchanged (jax_engine
   ``_dispatch_chunk_sync``). EOS inside the chunk shortens ``m`` via the
@@ -1190,6 +1192,253 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   return _fused_paged_batch_decode_impl(
     params, cfg, shard, token, pool, jnp.asarray(block_tables, jnp.int32), positions, active.astype(jnp.bool_),
     jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
+  )
+
+
+# ------------------------------------------- batched speculative serving
+# (inference/batch_scheduler.py, XOT_TPU_SPEC_BATCH — ISSUE 7): draft-then-
+# verify INSIDE the batched decode chunk. One chunk is ``n_rounds`` rounds;
+# each round the draft proposes up to gamma_max tokens per row (sequential
+# batched small-model steps against its own dense cache), the target scores
+# every row's whole (gamma_max+1)-token window in ONE parallel forward, and
+# each row advances by its own accepted-run length + 1 — a variable advance
+# the paged pool absorbs exactly like the lookahead pipeline's drop-on-read:
+# rejected tail positions hold garbage KV that the next round's window
+# rewrites before anything reads it (same argument as
+# fused_speculative_generate's free rollback).
+#
+# Per-row depth ``gammas`` [B] is TRACED: a row at gamma 0 degenerates to
+# plain decode inside the same program (its window contributes exactly one
+# target token per round), which is how the scheduler's acceptance-EWMA
+# policy (inference/paging.py spec_adapt_gamma) lets rows where the draft
+# isn't paying fall back WITHOUT dragging the batch onto a different compiled
+# program. Greedy rows emit exactly the target's greedy trajectory for ANY
+# draft; sampled (temp>0) rows always run gamma 0 and draw ONE sample per
+# round from the verify logits' first position — with n_rounds equal to the
+# plain chunk size their key-split schedule matches the plain program's
+# one-split-per-step exactly.
+
+
+def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int):
+  """One decoder layer for a multi-token VERIFY window against the page pool.
+
+  positions [B, W] are each row's own absolute window positions (rows are at
+  different depths). Writes all W tokens' KV through the block tables, then
+  attends via the gather reference path — the Pallas paged kernel is
+  one-query-per-row; a multi-query verify kernel is future work (the verify
+  reads each row's whole context once per round either way, exactly like a
+  decode step). MLA is unsupported here (the scheduler keeps MLA models on
+  the plain chunk program in paged mode)."""
+  B, W, D = h.shape
+  x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
+  from ..ops.paged import paged_gqa_attention_ref, write_token_kv
+
+  q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+  lengths = positions[:, -1] + 1  # valid KV slots incl. the window's writes
+  if "k_scale" in pool_l:  # int8 KV pages — per-token scales, same values a
+    # one-token-at-a-time write would produce (quantize_kv is per-(token, head))
+    from .quantize import quantize_kv
+
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    pool_l = dict(pool_l)
+    for j in range(W):  # W is small (gamma_max+1) and static
+      pos_j = positions[:, j]
+      pool_l["k"] = write_token_kv(pool_l["k"], kq[:, j], block_tables, pos_j, page_size)
+      pool_l["k_scale"] = write_token_kv(pool_l["k_scale"], ks[:, j], block_tables, pos_j, page_size)
+      pool_l["v"] = write_token_kv(pool_l["v"], vq[:, j], block_tables, pos_j, page_size)
+      pool_l["v_scale"] = write_token_kv(pool_l["v_scale"], vs[:, j], block_tables, pos_j, page_size)
+    attn = paged_gqa_attention_ref(
+      q, pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
+      k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"],
+      q_positions=positions, **_attn_opts(cfg, p.get("is_sliding")),
+    )
+  else:
+    k_pool, v_pool = pool_l["k"], pool_l["v"]
+    for j in range(W):
+      pos_j = positions[:, j]
+      k_pool = write_token_kv(k_pool, k[:, j], block_tables, pos_j, page_size)
+      v_pool = write_token_kv(v_pool, v[:, j], block_tables, pos_j, page_size)
+    attn = paged_gqa_attention_ref(
+      q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size,
+      q_positions=positions, **_attn_opts(cfg, p.get("is_sliding")),
+    )
+    pool_l = {"k": k_pool, "v": v_pool}
+  attn_out = _mm(attn.reshape(B, W, -1), p, "wo", cfg.quant_compute)
+  if "post_attn_norm" in p:  # gemma2
+    attn_out = rms_norm(attn_out, p["post_attn_norm"], cfg.norm_eps)
+  h = h + attn_out
+  h, _ = _mlp_block(h, p, cfg)
+  return h, pool_l
+
+
+def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int):
+  """W-token forward for every row against the page pool — the batched
+  speculative VERIFY pass. tokens/positions [B, W] → (logits [B, W, V],
+  updated pool). Full shard only."""
+  if cfg.is_mla:
+    raise ValueError("paged_window_forward does not support MLA models")
+  h = embed_tokens(params, cfg, tokens)
+  inv_freq = rope_inv_freq(cfg)
+  stacks = [params[name] for name in ("layers", "moe_layers") if name in params]
+  parts = []
+  off = 0
+  for stack in stacks:
+    L = next(iter(stack.values())).shape[0]
+
+    def body(carry, per_layer):
+      h = carry
+      lp, pool_l = per_layer
+      h, pool_l = _paged_window_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size)
+      return h, pool_l
+
+    h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
+    parts.append(new_sub)
+    off += L
+  new_pool = parts[0] if len(parts) == 1 else {key: jnp.concatenate([p[key] for p in parts], axis=0) for key in parts[0]}
+  return head_logits(params, cfg, h), new_pool
+
+
+def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, token, carry_t, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key):
+  """The shared draft→verify→accept round loop of both batched spec programs.
+
+  ``verify(window [B,W], wpos [B,W], carry_t)`` runs the target over each
+  row's window and returns (logits [B,W,V], carry_t) — the dense impl closes
+  over the slot cache, the paged impl over (pool, block tables). Returns
+  (buf [B, n_rounds·W], counts [B], next_tok [B,1], next_pos [B], carry_t,
+  cache_d): row i's first counts[i] buffer slots are its emitted tokens, in
+  order; slots past counts[i] are overwritten leftovers the host drops."""
+  B = token.shape[0]
+  G = gamma_max
+  W = G + 1
+  widx = jnp.arange(W, dtype=jnp.int32)
+  buf0 = jnp.zeros((B, n_rounds * W), dtype=jnp.int32)
+
+  def body(carry, _):
+    tok, pos, carry_t, cache_d, buf, counts, key = carry
+
+    # 1) Draft proposes G tokens per row, greedily (batched sequential steps
+    #    — the same single-token program shape as plain decode, small model).
+    def dstep(c, _):
+      t, p, cd = c
+      logits, cd = shard_forward(params_d, cfg_d, shard_d, t, p[:, None], cd)
+      nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+      return (nxt[:, None], p + 1, cd), nxt
+
+    (_, _, cache_d), d = jax.lax.scan(dstep, (tok, pos, cache_d), None, length=G)
+    d = jnp.moveaxis(d, 0, 1)  # [B, G]
+
+    # 2) Target verifies every row's window [tok, d_1..d_G] in ONE forward.
+    window = jnp.concatenate([tok, d], axis=1)  # [B, W]
+    wpos = pos[:, None] + widx[None, :]
+    logits_t, carry_t = verify(window, wpos, carry_t)
+    t_greedy = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [B, W]
+    # One key split per ROUND — with n_rounds == the plain chunk size this is
+    # the plain program's exact split-per-step schedule, so sampled rows draw
+    # identical subkeys under either program.
+    nxt0, key = _next_token_batched(logits_t[:, 0, :], key, temps, top_ks, k_max)
+
+    # 3) Per-row greedy acceptance, capped at the row's own traced gamma;
+    #    sampled rows accept nothing (their draft run is scaffolding only).
+    matches = (d == t_greedy[:, :G]).astype(jnp.int32) * (widx[None, :G] < gammas[:, None]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [B]
+    n_acc = jnp.where(temps > 0, 0, n_acc)
+    corr = jnp.take_along_axis(t_greedy, n_acc[:, None], axis=1)[:, 0]  # target's own next token
+    corr = jnp.where(temps > 0, nxt0, corr)
+    d_pad = jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    emitted = jnp.where(widx[None, :] < n_acc[:, None], d_pad, corr[:, None])  # [B, W]
+    # Per-row append at each row's own offset; slots past k_adv hold the
+    # correction token and are overwritten by the next round's append.
+    buf = jax.vmap(lambda b, e, o: jax.lax.dynamic_update_slice(b, e, (o,)))(buf, emitted, counts)
+
+    # 4) Draft catch-up: the window through the draft so its cache covers
+    #    every accepted position (the sequential proposal never writes the
+    #    last proposed token's KV — see _fused_spec_generate_impl).
+    _, cache_d = shard_forward(params_d, cfg_d, shard_d, window, wpos, cache_d)
+
+    k_adv = jnp.where(active, n_acc + 1, 0)  # inactive rows hold token & position
+    new_tok = jnp.where(active, corr, tok[:, 0])[:, None]
+    return (new_tok, pos + k_adv, carry_t, cache_d, buf, counts + k_adv, key), None
+
+  counts0 = jnp.zeros((B,), dtype=jnp.int32)
+  (next_tok, next_pos, carry_t, cache_d, buf, counts, _), _ = jax.lax.scan(
+    body, (token, positions, carry_t, cache_d, buf0, counts0, key), None, length=n_rounds
+  )
+  return buf, counts, next_tok, next_pos, carry_t, cache_d
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max"), donate_argnums=(2, 3))
+def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
+  def verify(window, wpos, cache):
+    return shard_forward(params, cfg, shard, window, wpos, cache)
+
+  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size"), donate_argnums=(2, 3))
+def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int):
+  # Inactive rows' window writes must not land on pages another row may now
+  # own: pin their tables to the trash page once (tables are chunk-constant).
+  bt = jnp.where(active[:, None], block_tables, 0)
+
+  def verify(window, wpos, pool):
+    return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size)
+
+  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, pool, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
+
+
+def _spec_batch_args(shard: Shard, token, active, gammas, temps, top_k, k_max: int, key):
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("batched speculative decode requires a full-model shard")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+  B = token.shape[0]
+  top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+  return (
+    jnp.asarray(token), jnp.asarray(active).astype(jnp.bool_), jnp.asarray(gammas, jnp.int32),
+    jnp.asarray(temps, jnp.float32), top_ks, key,
+  )
+
+
+def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, cache, cache_d, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, key=None):
+  """``fused_batch_decode`` with draft-then-verify rounds (dense slot cache).
+
+  token [B,1] / positions [B] / active [B] / temps [B] as in
+  ``fused_batch_decode``; ``gammas`` [B] int32 is each row's traced
+  speculation depth (0 ⇒ plain decode for that row), clamped to the static
+  ``gamma_max``; ``cache_d`` is the draft's OWN dense slot cache (same slot
+  indexing, prefilled by the scheduler at admission). Returns
+  (tokens [B, n_rounds·(gamma_max+1)], counts [B], next_token [B,1],
+  next_positions [B], cache, cache_d) — counts[i] of row i's buffer slots
+  are valid; next_token/next_positions are DEVICE handles so the scheduler's
+  lookahead pipeline chains chunk N+1 without knowing chunk N's variable
+  advance host-side.
+  """
+  token, active, gammas, temps, top_ks, key = _spec_batch_args(shard, token, active, gammas, temps, top_k, k_max, key)
+  return _fused_spec_batch_decode_impl(
+    params, params_d, cache, cache_d, token, positions, active, jnp.minimum(gammas, gamma_max), temps, top_ks, key,
+    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max),
+  )
+
+
+def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, key=None):
+  """``fused_spec_batch_decode`` against the page pool.
+
+  Same contract plus ``block_tables`` [B, mp]: the host must have allocated
+  pages covering every row's WORST-CASE advance
+  ``n_rounds·(gamma_max+1)`` before dispatch
+  (inference/paging.py ``spec_worst_advance`` — the gamma-deep analogue of
+  the lookahead pipeline's one-extra-chunk headroom). The verify pass runs
+  the gather reference attention (multi-query); the draft keeps its dense
+  slot cache.
+  """
+  if cfg.is_mla:
+    raise ValueError("fused_spec_paged_batch_decode does not support MLA models (use the dense layout)")
+  token, active, gammas, temps, top_ks, key = _spec_batch_args(shard, token, active, gammas, temps, top_k, k_max, key)
+  return _fused_spec_paged_batch_decode_impl(
+    params, params_d, pool, cache_d, token, jnp.asarray(block_tables, jnp.int32), positions, active,
+    jnp.minimum(gammas, gamma_max), temps, top_ks, key,
+    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size),
   )
 
 

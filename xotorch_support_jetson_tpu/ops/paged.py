@@ -120,16 +120,20 @@ def scatter_row_pages(pool_part: jnp.ndarray, t: jnp.ndarray, target: jnp.ndarra
   return pool_part.at[:, target].set(pages.astype(pool_part.dtype))
 
 
-def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, k_scale_pool_l=None, v_scale_pool_l=None, **attn_opts) -> jnp.ndarray:
-  """Reference paged decode attention via gather (q [B, 1, Hq, hd]).
-  ``attn_opts`` forward gemma2's scale/softcap/sliding-window
-  (models/decoder.py _attn_opts). With scale pools (int8 KV), the gathered
-  codes stay the einsum operand and the scales gather alongside — the page
-  gather itself moves int8 bytes."""
+def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_size: int, k_scale_pool_l=None, v_scale_pool_l=None, q_positions=None, **attn_opts) -> jnp.ndarray:
+  """Reference paged decode attention via gather (q [B, Sq, Hq, hd]; Sq is 1
+  on the decode path). ``attn_opts`` forward gemma2's
+  scale/softcap/sliding-window (models/decoder.py _attn_opts). With scale
+  pools (int8 KV), the gathered codes stay the einsum operand and the scales
+  gather alongside — the page gather itself moves int8 bytes.
+  ``q_positions`` [B, Sq] overrides the single-query default — the batched
+  speculative VERIFY window (models/decoder.py paged_window_forward) passes
+  each row's own window positions."""
   k = gather_pages(k_pool_l, block_tables)
   v = gather_pages(v_pool_l, block_tables)
   kv_positions = jnp.arange(k.shape[1], dtype=jnp.int32)
-  q_positions = (lengths - 1)[:, None]  # current token's position
+  if q_positions is None:
+    q_positions = (lengths - 1)[:, None]  # current token's position
   if k_scale_pool_l is not None:
     attn_opts = dict(attn_opts, k_scale=gather_pages(k_scale_pool_l, block_tables), v_scale=gather_pages(v_scale_pool_l, block_tables))
   return gqa_attention(q, k, v, q_positions, kv_positions, **attn_opts)

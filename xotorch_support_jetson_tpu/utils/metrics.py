@@ -38,6 +38,12 @@ DEFAULT_BUCKETS = (
 # drives the tiering concurrency knobs, and a latency ladder can't hold it.
 SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 
+# Unit-interval ladder for fraction-valued histograms — e.g. the per-row
+# speculative acceptance EWMA (ISSUE 7): dense through the 0.15-0.55 band
+# where the gamma policy's thresholds live, so the exposition shows WHERE
+# rows sit relative to the demote/promote bars, not just a mean.
+FRACTION_BUCKETS = (0.05, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5, 0.55, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0)
+
 
 def _label_key(labels: dict | None) -> tuple:
   return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))

@@ -17,6 +17,71 @@ models sits between the floor and this ceiling).
 from __future__ import annotations
 
 
+def spec_agreement_bitmap(params_t, cfg_t, shard_t, params_d, cfg_d, shard_d, prompt, trajectory) -> list[bool]:
+  """Per-step draft/target argmax agreement along a greedy ``trajectory``.
+
+  BUILD-VARIANCE CAPABILITY PROBE (ISSUE 7): speculative acceptance counts
+  exactly one event — "does the draft's greedy argmax at this position equal
+  the target's next trajectory token" — and that event rides THIS build's
+  numerics (int8 rounding × the backend's reduction order). The probe runs
+  the draft teacher-forced along the target's own greedy output, one
+  single-token step at a time (the same program shape the speculative
+  proposal loop uses), and returns the agreement bit per step. Tests derive
+  their acceptance expectation from this measured bitmap
+  (``simulate_spec_acceptance``) instead of asserting against a
+  hand-loosened constant that silently absorbs real regressions.
+
+  ``trajectory[i]`` is the target's greedy token at position
+  ``len(prompt) + i``; bit i says whether the draft, fed
+  ``prompt ++ trajectory[:i]``, proposes ``trajectory[i]``... shifted one:
+  fed up to and including trajectory[i-1], proposes trajectory[i].
+  """
+  import jax.numpy as jnp
+  import numpy as np
+
+  from ..models.decoder import init_kv_cache, shard_forward
+
+  prompt = np.asarray(prompt, dtype=np.int32).reshape(1, -1)
+  S = prompt.shape[1]
+  cache_d = init_kv_cache(cfg_d, shard_d.n_shard_layers, 1, cfg_d.max_seq_len)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  logits, cache_d = shard_forward(params_d, cfg_d, shard_d, jnp.asarray(prompt), positions, cache_d)
+  proposal = int(np.argmax(np.asarray(logits)[0, S - 1]))
+  bits: list[bool] = []
+  for i, tok in enumerate(trajectory):
+    bits.append(proposal == int(tok))
+    # Teacher-force the TRUE trajectory token (not the proposal): after a
+    # disagreement the speculative loop's correction re-syncs the draft to
+    # the target's stream, which is exactly this.
+    step = jnp.asarray([[int(tok)]], dtype=jnp.int32)
+    logits, cache_d = shard_forward(params_d, cfg_d, shard_d, step, jnp.full((1, 1), S + i, jnp.int32), cache_d)
+    proposal = int(np.argmax(np.asarray(logits)[0, 0]))
+  return bits
+
+
+def simulate_spec_acceptance(bits: list[bool], gamma: int, max_steps: int) -> float:
+  """The acceptance rate the greedy speculative loop ACHIEVES on a given
+  agreement bitmap — a deterministic replay of its accept rule: each round
+  takes the run of consecutive agreements from the current position (capped
+  at gamma) plus the correction token. Paired with
+  ``spec_agreement_bitmap`` this turns the echo-acceptance test's threshold
+  into a measured expectation for the running build."""
+  if gamma <= 0:
+    return 0.0  # plain decode proposes nothing — acceptance is undefined-as-zero
+  n = rounds = 0
+  while n < max_steps:
+    # A round's accepted run is capped by gamma and by the bitmap we have —
+    # NOT by max_steps: the real while_loop's final round emits its full
+    # run past the limit too (the caller trims). Probe with a bitmap at
+    # least max_steps + gamma long for an exact replay.
+    run = 0
+    while run < gamma and n + run < len(bits) and bits[n + run]:
+      run += 1
+    n += run + 1
+    rounds += 1
+  return (n / rounds - 1.0) / gamma if rounds else 0.0
+
+
 def peaked_echo_params(params: dict, damp: float = 0.05) -> dict:
   """A peaked-logit variant of ``params``: residual-stream writes scaled by
   ``damp``. Returns a shallow-copied tree (untouched leaves shared).
