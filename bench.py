@@ -92,6 +92,18 @@ def gate_kv_tier(value: float | None, lo: float = 0.01, hi: float = 1000.0) -> f
   return float(value) if lo <= value <= hi else None
 
 
+def gate_failover(recovery_ms: float | None, lo: float = 1.0, hi: float = 120000.0) -> float | None:
+  """Sanity-gate the failover round's recovery latency (same drift-gate
+  pattern). Recovery = kill-to-next-client-visible-token on the localhost
+  two-node ring: the replay delay + one re-prefill, so honest values live
+  in tens-of-ms to tens-of-seconds. Outside [1 ms, 120 s] the round broke
+  (a token raced the kill, or the stream wedged until an outer timeout) —
+  drop it rather than record it."""
+  if recovery_ms is None:
+    return None
+  return float(recovery_ms) if lo <= recovery_ms <= hi else None
+
+
 def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, where: dict | None = None) -> float | None:
   """Quantile of a LABELED histogram family's growth between two registry
   snapshots, aggregated across every label series (the per-peer-link RPC
@@ -208,6 +220,111 @@ def bench_cross_node_hops() -> tuple[float | None, float | None]:
       await asyncio.gather(*(n.stop() for n in nodes), return_exceptions=True)
 
   return asyncio.run(run())
+
+
+def bench_failover_recovery(n_drills: int = 3) -> tuple[float | None, int | None]:
+  """Kill-mid-decode failover drill on the localhost two-node gRPC ring
+  (ISSUE 8): per drill, stream one request across the ring, simulate the
+  peer's death with the deterministic fault injector at the first
+  client-visible token, and measure kill-to-next-token (the elastic replay's
+  client-visible recovery window). Returns (failover_recovery_ms_p50,
+  requests_lost) — a lost request is one that never reaches a finish event
+  within the drill bound (the exact hang ROADMAP item 4 forbids)."""
+  import asyncio
+
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.networking.discovery import Discovery
+  from xotorch_support_jetson_tpu.networking.faults import chaos
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+  from xotorch_support_jetson_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+  from xotorch_support_jetson_tpu.topology.partitioning import (
+    RingMemoryWeightedPartitioningStrategy,
+    map_partitions_to_shards,
+  )
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  class _Static(Discovery):
+    def __init__(self, peers):
+      self._peers = peers
+
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+    async def discover_peers(self, wait_for_peers: int = 0):
+      return self._peers
+
+  caps = DeviceCapabilities(model="bench", chip="cpu", memory=1024, flops=DeviceFlops(1, 2, 4))
+  old_delay = os.environ.get("XOT_TPU_RETRY_DELAY_S")
+  os.environ["XOT_TPU_RETRY_DELAY_S"] = "0.2"  # drill cadence, not the 3 s prod default
+
+  async def drill(k: int) -> tuple[float | None, bool]:
+    ports = [find_available_port("127.0.0.1") for _ in range(2)]
+    ids = [f"bench-fo{k}-0", f"bench-fo{k}-1"]
+    nodes = []
+    for i in range(2):
+      peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "bench", caps) for j in range(2) if j != i]
+      node = Node(ids[i], None, DummyInferenceEngine(), _Static(peers), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=64)
+      node.server = GRPCServer(node, "127.0.0.1", ports[i])
+      nodes.append(node)
+    await asyncio.gather(*(n.start() for n in nodes))
+    try:
+      for _ in range(100):
+        if all(
+          len(n.topology.nodes) == 2 and len(map_partitions_to_shards(n.partitioning_strategy.partition(n.topology), 8, "dummy")) == 2
+          for n in nodes
+        ):
+          break
+        await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+        await asyncio.sleep(0.05)
+      shard = build_base_shard("dummy", "DummyInferenceEngine")
+      done = asyncio.Event()
+      t_kill: list[float] = []
+      t_recover: list[float] = []
+
+      def on_tok(rid, toks, fin):
+        now = time.perf_counter()
+        if toks and not t_kill:
+          chaos.kill(ids[1])  # peer dies at the first client-visible token
+          t_kill.append(now)
+        elif toks and t_kill and not t_recover:
+          t_recover.append(now)
+        if fin:
+          done.set()
+
+      nodes[0].on_token.register("bench-fo").on_next(on_tok)
+      asyncio.ensure_future(nodes[0].process_prompt(shard, "aaaa", f"bench-fo-req{k}"))
+      lost = False
+      try:
+        await asyncio.wait_for(done.wait(), timeout=60)
+      except asyncio.TimeoutError:
+        lost = True
+      rec_ms = (t_recover[0] - t_kill[0]) * 1e3 if t_kill and t_recover else None
+      return rec_ms, lost
+    finally:
+      chaos.revive(ids[1])
+      await asyncio.gather(*(n.stop() for n in nodes), return_exceptions=True)
+
+  try:
+    recoveries: list[float] = []
+    lost_total = 0
+    for k in range(n_drills):
+      rec_ms, lost = asyncio.run(drill(k))
+      if rec_ms is not None:
+        recoveries.append(rec_ms)
+      lost_total += int(lost)
+    p50 = float(np.percentile(np.asarray(recoveries), 50)) if recoveries else None
+    return gate_failover(round(p50, 1) if p50 is not None else None), lost_total
+  finally:
+    if old_delay is None:
+      os.environ.pop("XOT_TPU_RETRY_DELAY_S", None)
+    else:
+      os.environ["XOT_TPU_RETRY_DELAY_S"] = old_delay
 
 
 def plausible_value(rec: dict) -> float | None:
@@ -1001,6 +1118,19 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
       pass
 
+  # Failover round (ISSUE 8, behind gate_failover): kill-mid-decode on the
+  # localhost two-node ring via the deterministic fault injector — emits the
+  # client-visible recovery window p50 and the hard invariant requests_lost
+  # (must be 0: every in-flight request completes or errors, never hangs).
+  # Gated like the other multichip sections — null on single-node CPU rounds.
+  failover_recovery_ms_p50 = None
+  requests_lost = None
+  if on_accel and len(jax.devices()) >= 2:
+    try:
+      failover_recovery_ms_p50, requests_lost = bench_failover_recovery()
+    except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+      pass
+
   # 8B-geometry int8 decode: the measurable v5e-1 stand-in for BASELINE
   # configs 2/3 (8B-class serving). bf16 8B (~16 GB) exceeds one v5e chip's
   # HBM, so weights are generated AND quantized leaf-by-leaf (the full bf16
@@ -1327,6 +1457,8 @@ def main() -> None:
         "pp_batched_aggregate_tok_s": pp_batched_tok_s,
         "hop_serialize_ms_p50": hop_serialize_ms_p50,
         "hop_rpc_ms_p50": hop_rpc_ms_p50,
+        "failover_recovery_ms_p50": failover_recovery_ms_p50,
+        "requests_lost": requests_lost,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "ttft_ms_spread": round(ttft_spread_ms, 2),
         "ttft_vs_prev": ttft_vs_prev,

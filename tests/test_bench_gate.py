@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_spec_batch, plausible_value
+from bench import gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_spec_batch, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -89,6 +89,22 @@ def test_overload_gate_keeps_plausible_shed_rates():
   assert gate_overload(0.0) == 0.0
   assert gate_overload(0.25) == 0.25
   assert gate_overload(0.9) == 0.9
+
+
+def test_failover_gate_keeps_plausible_recoveries():
+  """ISSUE 8: kill-to-next-token recovery on the localhost drill is the
+  replay delay plus one re-prefill — tens of ms to tens of seconds."""
+  assert gate_failover(250.0) == 250.0
+  assert gate_failover(3200.5) == 3200.5
+  assert gate_failover(1.0) == 1.0
+
+
+def test_failover_gate_drops_artifacts():
+  """Sub-millisecond recovery means a token raced the kill; beyond 120 s the
+  stream wedged into an outer timeout — both dropped, not recorded."""
+  assert gate_failover(0.2) is None
+  assert gate_failover(500000.0) is None
+  assert gate_failover(None) is None
 
 
 def test_kv_tier_gate_keeps_plausible_values():

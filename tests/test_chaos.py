@@ -1,0 +1,571 @@
+"""Chaos suite (ISSUE 8): deterministic fault injection against the REAL
+two-node gRPC cluster — kill mid-decode, partition, injected delay, typed
+server errors, graceful drain with live migration, and the stall watchdog.
+CI-runnable port of scripts/failover_drill.sh (dummy/tiny engines, no
+checkpoint, sub-second fault schedules); the shell drill stays as the
+real-checkpoint smoke.
+
+Every cluster test asserts the hard invariant from ROADMAP item 4: an
+in-flight request under an injected fault either completes token-identically
+to the fault-free run or returns a structured retryable error — never hangs.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference.dummy_engine import DUMMY_EOS
+from xotorch_support_jetson_tpu.networking.faults import FaultInjector, FaultRule, chaos, parse_rules
+from xotorch_support_jetson_tpu.networking.retry import (
+  breakers,
+  effective_timeout,
+  peer_health,
+  retry_budget,
+  rpc_retries,
+  rpc_timeout,
+)
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+from tests.test_networking import _make_cluster
+
+# The fault-free two-node run's pinned token stream (test_networking pins it
+# too): dummy decode counts up from 5 to the dummy EOS.
+FAULT_FREE_TOKENS = list(range(5, DUMMY_EOS + 1))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+  """Chaos/breaker/damping state is process-global (one injector serves the
+  whole in-process cluster) — every test starts and ends clean. Replay
+  cadence is test-speed."""
+  monkeypatch.setenv("XOT_TPU_RETRY_DELAY_S", "0.05")
+  chaos.clear()
+  breakers.reset()
+  peer_health.reset()
+  yield
+  chaos.clear()
+  breakers.reset()
+  peer_health.reset()
+
+
+async def _drive_ring_request(nodes, request_id: str, on_tokens=None, timeout: float = 45):
+  """Submit one streaming request on node0 and collect the deduped client
+  transcript until the finish event."""
+  from xotorch_support_jetson_tpu.registry import build_base_shard
+
+  shard = build_base_shard("dummy", "DummyInferenceEngine")
+  done = asyncio.Event()
+  collected: list[int] = []
+
+  def on_tok(rid, tokens, finished):
+    if rid != request_id:
+      return
+    collected.extend(tokens)
+    if on_tokens is not None:
+      on_tokens(collected)
+    if finished:
+      done.set()
+
+  nodes[0].on_token.register(f"chaos-{request_id}").on_next(on_tok)
+  asyncio.ensure_future(nodes[0].process_prompt(shard, "aaaa", request_id))
+  await asyncio.wait_for(done.wait(), timeout=timeout)
+  return collected
+
+
+# ------------------------------------------------------------- injector unit
+
+
+def test_chaos_env_grammar_and_schedule():
+  rules = parse_rules(
+    "peer=node1 method=SendTensor kind=delay delay_ms=5 jitter_ms=2 after=1 times=2;"
+    "peer=node* kind=error code=internal; kind=partition peer=nodeX"
+  )
+  assert [r.kind for r in rules] == ["delay", "error", "partition"]
+  assert rules[0].after == 1 and rules[0].times == 2 and rules[0].delay_ms == 5.0
+  with pytest.raises(ValueError):
+    parse_rules("kind=nonsense")
+  with pytest.raises(ValueError):
+    parse_rules("peer=node1 frobnicate")
+
+  async def run():
+    inj = FaultInjector([FaultRule(peer="n1", method="SendTensor", kind="drop", after=1, times=2)], seed=7)
+    # Call 1 skipped (after=1); calls 2-3 fire; call 4+ exhausted (times=2).
+    await inj.apply("client", "n1", "SendTensor")
+    for _ in range(2):
+      with pytest.raises(ConnectionError):
+        await inj.apply("client", "n1", "SendTensor")
+    await inj.apply("client", "n1", "SendTensor")
+    assert inj.applied == 2
+    # Kill semantics: every direction involving the node is dark.
+    inj.kill("n2")
+    with pytest.raises(ConnectionError):
+      await inj.apply("client", "n2", "HealthCheck")
+    with pytest.raises(ConnectionError):
+      await inj.apply("client", "n0", "SendResult", origin="n2")
+    with pytest.raises(ConnectionError):
+      await inj.apply("server", "n2", "SendTensor")
+    inj.revive("n2")
+    await inj.apply("client", "n2", "HealthCheck")
+    # Partition severs BOTH directions of the named node's links.
+    inj2 = FaultInjector([FaultRule(peer="n1", kind="partition")])
+    with pytest.raises(ConnectionError):
+      await inj2.apply("client", "n1", "SendTensor")
+    with pytest.raises(ConnectionError):
+      await inj2.apply("client", "n0", "SendResult", origin="n1")
+    await inj2.apply("client", "n0", "SendResult", origin="n2")
+
+  asyncio.run(run())
+
+
+def test_chaos_unset_is_inert(monkeypatch):
+  """XOT_TPU_CHAOS unset ⇒ the injector is INERT (the call sites gate on
+  ``enabled``, so the healthy RPC path is byte-identical to pre-chaos)."""
+  monkeypatch.delenv("XOT_TPU_CHAOS", raising=False)
+  inj = FaultInjector.from_env()
+  assert inj.enabled is False and inj.rules == []
+  assert chaos.enabled is False  # the module singleton too (fixture cleared it)
+
+
+@pytest.mark.asyncio
+async def test_chaos_off_cluster_run_is_fault_free(monkeypatch):
+  """With chaos off, apply() is never called on the RPC path (pinned by a
+  poisoned apply) and a real two-node generation is the fault-free stream."""
+
+  async def poisoned(*a, **k):
+    raise AssertionError("chaos.apply reached with injection disabled")
+
+  monkeypatch.setattr(chaos, "apply", poisoned)
+  nodes = await _make_cluster(2)
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-off")
+    assert collected == FAULT_FREE_TOKENS
+  finally:
+    for n in nodes:
+      await n.stop()
+
+
+# ------------------------------------------------------- retry policy units
+
+
+def test_timeout_policy_table_defaults_and_env(monkeypatch):
+  # Historical defaults preserved exactly.
+  assert rpc_timeout("SendResult") == 15.0
+  assert rpc_timeout("SendOpaqueStatus") == 15.0
+  assert rpc_timeout("CollectTopology") == 5.0
+  assert rpc_timeout("Connect") == 10.0
+  assert rpc_timeout("HealthCheck") == 5.0
+  assert rpc_timeout("SendTensor") is None  # unbounded: nested ring semantics
+  # Per-method override wins; the global knob only CAPS finite defaults —
+  # it can tighten CollectTopology but never silently raise HealthCheck.
+  monkeypatch.setenv("XOT_TPU_RPC_TIMEOUT_SENDRESULT_S", "3.5")
+  assert rpc_timeout("SendResult") == 3.5
+  monkeypatch.setenv("XOT_TPU_RPC_TIMEOUT_S", "2")
+  assert rpc_timeout("CollectTopology") == 2.0
+  monkeypatch.setenv("XOT_TPU_RPC_TIMEOUT_S", "60")
+  assert rpc_timeout("HealthCheck") == 5.0
+  assert rpc_timeout("SendTensor") is None
+  # Retry eligibility: only the idempotent methods.
+  assert rpc_retries("SendResult") == 2
+  assert rpc_retries("SendTensor") == 0
+  assert rpc_retries("SendPrompt") == 0
+
+
+def test_effective_timeout_capped_by_remaining_deadline():
+  from xotorch_support_jetson_tpu.inference.qos import qos_wire
+
+  rid = "deadline-cap-req"
+  qos_wire.register(rid, deadline_ms=2000.0, node_id="n0")
+  try:
+    # Forward-path methods become deadline-bounded for a deadlined request.
+    t = effective_timeout("SendTensor", rid)
+    assert t is not None and 0.05 <= t <= 2.0
+    # Out-of-budget requests fail fast at the floor, not the policy timeout.
+    qos_wire.register("spent-req", deadline_ms=0.001, node_id="n0")
+    assert effective_timeout("SendTensor", "spent-req") == 0.05
+    # Delivery/control RPCs are EXEMPT: finished tokens (SendResult) and
+    # cancels (SendOpaqueStatus) must still deliver after the budget is
+    # gone — clamping them would discard completed work / leak the remote
+    # batch slot the cancel frees.
+    assert effective_timeout("SendResult", rid) == 15.0
+    assert effective_timeout("SendOpaqueStatus", "spent-req") == 15.0
+  finally:
+    qos_wire.pop(rid)
+    qos_wire.pop("spent-req")
+  assert effective_timeout("SendTensor", "no-deadline") is None
+  assert effective_timeout("SendResult", "no-deadline") == 15.0
+
+
+def test_retry_budget_bounds_per_request(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_RPC_RETRY_BUDGET", "2")
+  rid = "budget-req"
+  assert retry_budget.take(rid) and retry_budget.take(rid)
+  assert not retry_budget.take(rid)
+  retry_budget.forget(rid)
+  assert retry_budget.take(rid)
+  retry_budget.forget(rid)
+  assert retry_budget.take("")  # id-less control calls are uncapped
+
+
+def test_circuit_breaker_lifecycle(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_CB_FAILS", "3")
+  monkeypatch.setenv("XOT_TPU_CB_OPEN_S", "0.1")
+  b = breakers.get("cb-peer", "addr:1")
+  assert b.allow() and not breakers.is_open("cb-peer")
+  for _ in range(2):
+    b.record_failure()
+  assert b.allow()  # under threshold: still closed
+  b.record_failure()
+  assert breakers.is_open("cb-peer") and not b.allow()  # open: fail fast
+  assert gm._labeled_gauges["peer_circuit_state"][(("peer", "cb-peer"),)] == 2
+  time.sleep(0.12)
+  assert b.allow()  # open window lapsed: half-open probe allowed
+  assert gm._labeled_gauges["peer_circuit_state"][(("peer", "cb-peer"),)] == 1
+  b.record_failure()  # failed probe re-opens immediately
+  assert not b.allow()
+  time.sleep(0.12)
+  assert b.allow()
+  b.record_success()  # successful probe closes
+  assert not breakers.is_open("cb-peer") and b.allow()
+  assert gm._labeled_gauges["peer_circuit_state"][(("peer", "cb-peer"),)] == 0
+
+
+def test_peer_health_flap_damping(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_HEALTH_FAILS", "3")
+  for _ in range(2):
+    peer_health.record("flappy", False)
+  assert not peer_health.is_dead("flappy")  # two flaps: still alive
+  peer_health.record("flappy", True)
+  assert peer_health.consecutive_failures("flappy") == 0  # success resets
+  for _ in range(3):
+    peer_health.record("flappy", False)
+  assert peer_health.is_dead("flappy")
+  peer_health.forget("flappy")
+  assert not peer_health.is_dead("flappy")
+
+
+# --------------------------------------------------------- cluster fault runs
+
+
+@pytest.mark.asyncio
+async def test_kill_mid_decode_replays_token_identically():
+  """ISSUE 8 acceptance: simulated node kill at the first client-visible
+  token — the killed node's server goes down AND the injector darkens every
+  link it touches (its zombie in-process tasks cannot reach the survivor,
+  exactly like a SIGKILL). The survivor's failed forward triggers the
+  elastic replay and the client transcript is exactly the fault-free run."""
+  nodes = await _make_cluster(2)
+  killed = []
+
+  def maybe_kill(collected):
+    if not killed and collected:
+      killed.append(True)
+      chaos.kill("node1")
+      asyncio.ensure_future(nodes[1].server.stop())
+
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-kill", on_tokens=maybe_kill)
+    assert killed, "generation finished before the kill fired"
+    assert collected == FAULT_FREE_TOKENS  # token-identical: no dup, no gap
+    assert gm.counter_value("requests_replayed_total") >= 1
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_full_partition_recovers_token_identically():
+  """100% drop partition installed before submit: the head's very first
+  forward fails, the replay path evicts the unreachable peer and the
+  request completes locally — token-identical, zero hangs."""
+  nodes = await _make_cluster(2)
+  chaos.install(FaultRule(peer="node1", kind="partition"))
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-partition")
+    assert collected == FAULT_FREE_TOKENS
+    assert chaos.applied >= 1
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_injected_delay_is_transparent():
+  """The delay fault class (CI-scaled stand-in for the 5 s schedule): ring
+  hops are slowed, nothing times out (SendTensor is unbounded by policy),
+  and the stream is token-identical."""
+  nodes = await _make_cluster(2)
+  chaos.install(FaultRule(peer="node1", method="SendTensor", kind="delay", delay_ms=40, jitter_ms=10, times=6))
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-delay")
+    assert collected == FAULT_FREE_TOKENS
+    assert chaos.applied >= 1  # the schedule actually fired
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_typed_server_error_mid_decode_replays():
+  """A typed server-side error (gRPC ``internal``) on the 3rd mid-ring
+  SendTensor: the sender's forward raises, the replay re-prefills the
+  carried history over the still-healthy ring, and the transcript is
+  exactly the fault-free stream (high-water dedup)."""
+  nodes = await _make_cluster(2)
+  chaos.install(FaultRule(peer="node1", method="SendTensor", side="server", kind="error", code="internal", after=2, times=1))
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-server-error")
+    assert collected == FAULT_FREE_TOKENS
+    assert chaos.applied == 1
+    assert gm.counter_value("requests_replayed_total") >= 1
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_transient_broadcast_failure_retried_at_rpc_layer():
+  """SendResult is idempotent (absolute-position dedup), so the unified
+  retry policy recovers a transiently failing token broadcast INSIDE the
+  RPC layer: the stream stays complete and rpc_retries_total moves."""
+  nodes = await _make_cluster(2)
+  # The receiving server (whichever node mirrors the sampler's broadcasts)
+  # rejects the first two inbound SendResults; the sender retries them.
+  chaos.install(FaultRule(peer="node*", method="SendResult", side="server", kind="error", code="unavailable", times=2))
+  before = gm.counter_value("rpc_retries_total", labels={"method": "SendResult"})
+  try:
+    collected = await _drive_ring_request(nodes, "chaos-retry")
+    assert collected == FAULT_FREE_TOKENS
+    assert gm.counter_value("rpc_retries_total", labels={"method": "SendResult"}) > before
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_draining_peer_leaves_partition_map():
+  """node_draining over the real opaque-status channel: the peer drops out
+  of the receiver's partition map (no new work routes there) while the
+  handle stays connected for in-flight traffic."""
+  nodes = await _make_cluster(2)
+  try:
+    assert set(nodes[1].topology.nodes) == {"node0", "node1"}
+    await nodes[0].announce_shutdown()
+    for _ in range(50):
+      await nodes[1].collect_topology(set())
+      if set(nodes[1].topology.nodes) == {"node1"}:
+        break
+      await asyncio.sleep(0.05)
+    assert set(nodes[1].topology.nodes) == {"node1"}
+    assert nodes[1].peers and nodes[1].peers[0].id() == "node0"  # handle kept
+    # The drainer's own survivor map excludes itself.
+    _topo, parts = nodes[0]._surviving_partitions()
+    assert parts is not None and [p.node_id for p in parts] == ["node1"]
+  finally:
+    for n in nodes:
+      await n.stop()
+
+
+# ------------------------------------------------------------ stall watchdog
+
+
+@pytest.mark.asyncio
+async def test_stall_watchdog_returns_structured_retryable_503(monkeypatch):
+  """No token progress past XOT_TPU_STALL_S with an open-circuit hop ⇒ a
+  structured RETRYABLE 503 carrying the tokens generated so far, within 2x
+  the stall bound — never a hang until the response timeout."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from tests_support_stubs import NoDiscovery, StubServer
+
+  monkeypatch.setenv("XOT_TPU_STALL_S", "0.4")
+  monkeypatch.setenv("XOT_TPU_CB_FAILS", "2")
+  stall_bound_s = 0.4
+
+  node = Node(
+    "stall-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=50,
+  )
+  await node.start()
+
+  class _DeadPeer:
+    def id(self):
+      return "dead-peer"
+
+  node.peers = [_DeadPeer()]
+  # The hop's circuit is open (recent consecutive failures).
+  b = breakers.get("dead-peer", "127.0.0.1:1")
+  b.record_failure()
+  b.record_failure()
+  assert breakers.is_open("dead-peer")
+
+  async def hung_process_prompt(shard, prompt, request_id=None, inference_state=None, **kw):
+    # Two tokens reach the client, then the upstream goes silent forever.
+    node.trigger_on_token_callbacks(request_id, [5, 6], False, start_pos=0)
+    await asyncio.Event().wait()
+
+  monkeypatch.setattr(node, "process_prompt", hung_process_prompt)
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  stalled_before = gm.counter_value("requests_stalled_total")
+  try:
+    t0 = time.perf_counter()
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False},
+    )
+    elapsed = time.perf_counter() - t0
+    assert resp.status == 503
+    body = await resp.json()
+    assert body["error"]["type"] == "upstream_stalled"
+    assert body["error"]["retryable"] is True
+    assert body["error"]["tokens"] == [5, 6]  # resume payload: generated so far
+    assert resp.headers.get("Retry-After")
+    # Detection inside 2x the stall bound (plus scheduling slack).
+    assert elapsed < 2 * stall_bound_s + 1.0, f"stall detected too late: {elapsed:.2f}s"
+    assert gm.counter_value("requests_stalled_total") > stalled_before
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_stall_watchdog_never_fires_on_healthy_hops(monkeypatch):
+  """A healthy-but-slow generation must NOT trip the watchdog: with no
+  dead/open-circuit hop the request runs to completion."""
+  from tests.test_api import _make_api
+
+  monkeypatch.setenv("XOT_TPU_STALL_S", "0.05")  # far below the request time
+  node, api, client = await _make_api()
+  try:
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "aaaa"}], "stream": False},
+    )
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["choices"][0]["message"]["content"]
+  finally:
+    await client.close()
+    await node.stop()
+
+
+# ------------------------------------------------------ graceful drain e2e
+
+
+@pytest.mark.asyncio
+async def test_graceful_drain_migrates_live_batched_request(monkeypatch):
+  """Acceptance: graceful drain migrates ≥1 LIVE batched request via
+  carry_tokens over the real gRPC path, and the stream finishes
+  token-identically on the surviving node (solo greedy reference)."""
+  import jax
+
+  from xotorch_support_jetson_tpu.inference.engine import NodeDrainingError
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import (
+    RingMemoryWeightedPartitioningStrategy,
+  )
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+  from tests.test_batched import CFG, KEY, _single_row_reference
+  from tests.test_networking import CAPS, StaticDiscovery
+
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  monkeypatch.setenv("XOT_TPU_BATCH_CHUNK", "2")  # many dispatch boundaries
+
+  class _Tok:
+    eos_token_id = None  # pure max_tokens finishes: the reference needs no EOS model
+
+    def encode(self, prompt):
+      return [3, 25, 9]
+
+    def decode(self, toks):
+      return " ".join(map(str, toks))
+
+  params, shard = full_model_params(KEY, CFG, "m")
+  n_tokens = 60
+  expected = _single_row_reference(params, shard, [3, 25, 9], n_tokens - 1)
+
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  ids = ["drain0", "drain1"]
+  nodes = []
+  for i in range(2):
+    engine = JaxShardedInferenceEngine(use_local_mesh=False)
+    engine.load_test_model(shard, CFG, params, tokenizer=_Tok())
+    peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "test", CAPS) for j in range(2) if j != i]
+    node = Node(
+      ids[i], None, engine, StaticDiscovery(peers), None,
+      RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=200, default_sample_temp=0.0,
+    )
+    node.server = GRPCServer(node, "127.0.0.1", ports[i])
+    nodes.append(node)
+  await asyncio.gather(*(n.start() for n in nodes))
+  try:
+    for _ in range(100):
+      if all(len(n.topology.nodes) == 2 for n in nodes):
+        break
+      await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+      await asyncio.sleep(0.05)
+
+    rid = "drain-req"
+    nodes[0].set_request_options(rid, max_tokens=n_tokens, temperature=0.0)
+    collected: list[int] = []
+    first_tokens = asyncio.Event()
+    done = asyncio.Event()
+
+    def on_tok(r, toks, fin):
+      if r != rid:
+        return
+      collected.extend(toks)
+      if collected:
+        first_tokens.set()
+      if fin:
+        done.set()
+
+    nodes[0].on_token.register("drain-test").on_next(on_tok)
+    migrations_before = gm.counter_value("drain_migrations_total")
+    recovered_before = gm.counter_value("requests_recovered_total")
+    sendtensor_before = gm.counter_value("grpc_rpcs_total", labels={"method": "SendTensor"})
+
+    serve = asyncio.ensure_future(nodes[0]._batched_serve(shard, shard, "prompt", rid))
+    await asyncio.wait_for(first_tokens.wait(), timeout=60)
+    await asyncio.wait_for(nodes[0].graceful_drain(drain_s=30), timeout=40)
+    await asyncio.wait_for(serve, timeout=60)
+    await asyncio.wait_for(done.wait(), timeout=30)
+
+    # Token-identical to the solo greedy reference: the pre-drain batched
+    # span plus the survivor's continuation splice exactly.
+    assert collected == expected
+    assert gm.counter_value("drain_migrations_total") == migrations_before + 1
+    assert gm.counter_value("requests_recovered_total") >= recovered_before + 1
+    # The continuation really ran on the survivor over the gRPC path.
+    assert gm.counter_value("grpc_rpcs_total", labels={"method": "SendTensor"}) > sendtensor_before
+    # The drained scheduler refuses new work with the typed error.
+    server = nodes[0].inference_engine.get_batched_server()
+    with pytest.raises(NodeDrainingError):
+      await server.submit(
+        "late-req", np.asarray([3, 25, 9], np.int32), max_tokens=4, temp=0.0,
+        top_k=35, eos_ids=(), emit=lambda *_: None,
+      )
+    # The timeline records the drain/migrated stages.
+    from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+    tl = tracer.timeline_export(rid)
+    stages = {e.get("stage") for e in (tl or {}).get("events", [])}
+    assert "drain" in stages and "migrated" in stages
+  finally:
+    for n in nodes:
+      await n.stop()

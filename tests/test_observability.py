@@ -694,6 +694,11 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_peer_rpc_bytes_sent_total",
   "xot_tpu_peer_rpc_bytes_received_total",
   "xot_tpu_peer_rpc_failures_total",
+  # Fault tolerance (ISSUE 8; retries labeled {method})
+  "xot_tpu_rpc_retries_total",
+  "xot_tpu_drain_migrations_total",
+  "xot_tpu_requests_recovered_total",
+  "xot_tpu_requests_stalled_total",
   # gauges
   "xot_tpu_scheduler_batch_occupancy",
   "xot_tpu_scheduler_queue_depth",
@@ -715,6 +720,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_engine_sessions",
   "xot_tpu_peer_clock_offset_ms",
   "xot_tpu_peer_clock_uncertainty_ms",
+  "xot_tpu_peer_circuit_state",
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -805,6 +811,11 @@ def test_metric_name_snapshot_after_serving():
   gm.observe_hist("grpc_deserialize_seconds", 0.0, labels={"method": "SendTensor"})
   gm.set_gauge("peer_clock_offset_ms", 0.0, labels={"peer": "peer-0"})
   gm.set_gauge("peer_clock_uncertainty_ms", 0.0, labels={"peer": "peer-0"})
+  gm.inc("rpc_retries_total", 0, labels={"method": "SendResult"})
+  gm.inc("drain_migrations_total", 0)
+  gm.inc("requests_recovered_total", 0)
+  gm.inc("requests_stalled_total", 0)
+  gm.set_gauge("peer_circuit_state", 0, labels={"peer": "peer-0"})
   text = gm.render_prometheus()
   families = set(re.findall(r"# TYPE (xot_tpu_[a-z0-9_]+) \w+", text))
   missing = EXPECTED_METRIC_NAMES - families

@@ -20,10 +20,12 @@ from pathlib import Path
 from aiohttp import web
 
 from .. import registry
+from ..inference.engine import RequestStalledError
 from ..inference.qos import PRIORITY_CLASSES
 from ..inference.shard import Shard
 from ..inference.tokenizers import resolve_tokenizer
 from ..utils.helpers import DEBUG, PrefixDict, AsyncCallbackSystem
+from ..utils.metrics import metrics
 
 
 class Message:
@@ -281,6 +283,24 @@ def overloaded_response(e: Exception) -> web.Response:
   return web.json_response(body, status=429, headers=headers)
 
 
+def stalled_response(e: Exception) -> web.Response:
+  """RequestStalledError → structured, RETRYABLE 503 (the stall watchdog's
+  contract, ISSUE 8): same typed-error shape as the QoS 429s, plus the
+  tokens generated so far so a client or router can re-submit with resume
+  semantics (``carry_tokens``-style continuation) instead of starting over.
+  503 — the server is at fault (a dead/open-circuit hop), unlike the
+  client-retryable overload 429s."""
+  body = {
+    "error": {
+      "message": str(e),
+      "type": getattr(e, "error_type", "upstream_stalled"),
+      "retryable": True,
+      "tokens": [int(t) for t in (getattr(e, "tokens", None) or [])],
+    }
+  }
+  return web.json_response(body, status=503, headers={"Retry-After": "1"})
+
+
 def completion_chunk(request_id: str, model: str, created: int, content: str | None, finish_reason: str | None) -> dict:
   delta = {} if content is None else {"role": "assistant", "content": content}
   return {
@@ -313,6 +333,11 @@ class ChatGPTAPI:
     # REMAINING budget, so a deadlined request can't hold a token queue
     # open past its SLO by making per-chunk progress.
     self._request_deadlines: dict[str, float] = {}
+    # Stall watchdog (ISSUE 8): event-loop time of each request's last token
+    # progress. No progress for XOT_TPU_STALL_S while an upstream hop is
+    # dead or open-circuit ⇒ structured retryable 503 instead of waiting
+    # out the full response timeout.
+    self._last_progress: dict[str, float] = {}
     self.on_chat_completion_request = on_chat_completion_request
     self.default_model = default_model or "llama-3.2-1b"
     self.system_prompt = system_prompt
@@ -725,6 +750,7 @@ class ChatGPTAPI:
     request_id = str(uuid.uuid4())
     created = int(time.time())
     self.token_queues[request_id] = asyncio.Queue()
+    self._last_progress[request_id] = asyncio.get_event_loop().time()  # stall clock starts now
     if qos_deadline_ms is not None:
       self._request_deadlines[request_id] = asyncio.get_event_loop().time() + min(self.response_timeout, qos_deadline_ms / 1e3)
     if hasattr(self.node, "set_request_options"):
@@ -764,21 +790,13 @@ class ChatGPTAPI:
           except Exception:  # noqa: BLE001
             pass
       try:
-        await asyncio.wait_for(
-          asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id))),
-          timeout=self._timeout_for(request_id),
-        )
-      except asyncio.TimeoutError:
+        await self._await_generation(request_id, asyncio.create_task(self.node.process_prompt(shard, prompt, request_id)))
+      except (asyncio.TimeoutError, RequestStalledError):
         cancel = getattr(self.node, "cancel_request", None)
         if cancel is not None:
           cancel(request_id)
         raise
-      all_tokens: list[int] = []
-      while True:
-        tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self._timeout_for(request_id))
-        all_tokens.extend(tokens)
-        if is_finished:
-          break
+      all_tokens = await self._collect_all_tokens(request_id)
       text = tokenizer.decode([t for t in all_tokens if t not in eos_set])
       finish_reason = self._finish_reason(tokenizer, all_tokens[-1] if all_tokens else -1, True, False)
       stop_cut = False
@@ -812,6 +830,11 @@ class ChatGPTAPI:
       return web.json_response(completion_body(text, finish_reason, logprobs_obj, len(all_tokens)))
     except asyncio.TimeoutError:
       return web.json_response({"detail": "Response generation timed out"}, status=408)
+    except RequestStalledError as e:
+      cancel = getattr(self.node, "cancel_request", None)
+      if cancel is not None:
+        cancel(request_id)
+      return stalled_response(e)
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
@@ -829,6 +852,7 @@ class ChatGPTAPI:
     finally:
       self.token_queues.pop(request_id, None)
       self._request_deadlines.pop(request_id, None)
+      self._last_progress.pop(request_id, None)
       getattr(self.node, "request_options", {}).pop(request_id, None)
 
   async def _stream_completions_response(self, request, base, request_id, tokenizer, created, gen_task):
@@ -1160,7 +1184,114 @@ class ChatGPTAPI:
   async def handle_tokens(self, request_id: str, tokens: list[int], is_finished: bool) -> None:
     queue = self.token_queues.get(request_id)
     if queue is not None:
+      if tokens or is_finished:
+        self._last_progress[request_id] = asyncio.get_event_loop().time()
       await queue.put((tokens, is_finished))
+
+  # --------------------------------------------------- stall watchdog (ISSUE 8)
+
+  @staticmethod
+  def _stall_after_s() -> float:
+    """XOT_TPU_STALL_S (default 120 s; <= 0 disables). Read per check so
+    operators (and tests) can retune a live server."""
+    try:
+      return float(os.getenv("XOT_TPU_STALL_S", "120") or 120)
+    except ValueError:
+      return 120.0
+
+  def _stall_poll_s(self) -> float:
+    """Wait-slice so detection lands within the 2x-stall-bound contract:
+    at most stall/4 (floored at 50 ms), capped at the historical 1 s poll."""
+    stall = self._stall_after_s()
+    if stall <= 0:
+      return 1.0
+    return min(1.0, max(stall / 4.0, 0.05))
+
+  def _upstream_faulty(self) -> bool:
+    """Is any serving hop dead or open-circuit — or was a peer lost
+    UNPLANNED recently? A healthy-but-slow model must never trip the
+    watchdog; only a faulted upstream does. The predicate is node-scope,
+    not per-request-path: on a ring every peer IS on the serving path, and
+    the one conservative consequence — a request starving >stall_s while
+    the cluster carries a genuinely faulted peer gets a RETRYABLE 503
+    instead of more waiting — is an acceptable trade for never missing a
+    real post-eviction stall. The sticky loss mark matters
+    because the damped eviction forgets the dead peer's breaker/health
+    state: a stall detected after eviction would otherwise look healthy
+    and hang to the full response timeout. The loss window is bounded
+    (2x the stall bound, >= 300 s: eviction takes ~15-30 s and the stall
+    itself >= XOT_TPU_STALL_S, so the mark is always still warm when a
+    loss-caused stall fires) — a long-ago loss must not convert every
+    later slow request into a 503."""
+    from ..networking.retry import breakers, peer_health
+
+    loss_ts = getattr(self.node, "last_peer_loss_ts", None)
+    if loss_ts is not None and time.monotonic() - loss_ts < max(self._stall_after_s() * 2, 300.0):
+      return True
+    for p in getattr(self.node, "peers", None) or []:
+      try:
+        pid = p.id()
+      except Exception:  # noqa: BLE001 — a broken handle is itself a faulty hop
+        return True
+      if breakers.is_open(pid) or peer_health.is_dead(pid):
+        return True
+    return False
+
+  def _check_stall(self, request_id: str) -> None:
+    """Raise ``RequestStalledError`` (carrying every token the client has
+    not yet been handed) when the request made no progress for the stall
+    bound AND an upstream hop is faulted."""
+    stall = self._stall_after_s()
+    if stall <= 0:
+      return
+    now = asyncio.get_event_loop().time()
+    last = self._last_progress.get(request_id)
+    if last is None or now - last <= stall or not self._upstream_faulty():
+      return
+    pending: list[int] = []
+    queue = self.token_queues.get(request_id)
+    if queue is not None:
+      while not queue.empty():  # undelivered chunks ride the 503 body
+        toks, _fin = queue.get_nowait()
+        pending.extend(toks)
+    from ..orchestration.tracing import tracer
+
+    metrics.inc("requests_stalled_total")
+    tracer.stage(request_id, "stalled", {"stall_s": stall}, terminal=True)
+    raise RequestStalledError(
+      f"no token progress for {stall:.0f}s with a dead or open-circuit upstream hop",
+      tokens=pending,
+    )
+
+  async def _collect_all_tokens(self, request_id: str) -> list[int]:
+    """Drain the request's token queue to the finish event (the blocking
+    handlers' shared loop). A stall mid-drain re-raises with every token
+    the client never got spliced into the 503's resume payload."""
+    all_tokens: list[int] = []
+    try:
+      while True:
+        tokens, is_finished = await self._next_tokens(request_id, None)
+        all_tokens.extend(tokens)
+        if is_finished:
+          return all_tokens
+    except RequestStalledError as e:
+      e.tokens = all_tokens + e.tokens  # everything the client never got
+      raise
+
+  async def _await_generation(self, request_id: str, task) -> None:
+    """Await a (shielded) generation task under the response timeout AND
+    the stall watchdog: the blocking path's equivalent of ``_next_tokens``'
+    poll loop — without it a ring stall would hang until the full response
+    timeout, exactly the failure mode ROADMAP item 4 forbids."""
+    deadline = asyncio.get_event_loop().time() + self._timeout_for(request_id)
+    while True:
+      remaining = deadline - asyncio.get_event_loop().time()
+      if remaining <= 0:
+        raise asyncio.TimeoutError
+      try:
+        return await asyncio.wait_for(asyncio.shield(task), timeout=min(self._stall_poll_s(), remaining))
+      except asyncio.TimeoutError:
+        self._check_stall(request_id)
 
   async def handle_post_chat_completions(self, request):
     try:
@@ -1202,6 +1333,7 @@ class ChatGPTAPI:
         pass
 
     self.token_queues[request_id] = asyncio.Queue()
+    self._last_progress[request_id] = asyncio.get_event_loop().time()  # stall clock starts now
     created = int(time.time())
     if qos_deadline_ms is not None:
       self._request_deadlines[request_id] = asyncio.get_event_loop().time() + min(self.response_timeout, qos_deadline_ms / 1e3)
@@ -1255,13 +1387,12 @@ class ChatGPTAPI:
           except Exception:  # noqa: BLE001 — surfaced via the stream already
             pass
       try:
-        await asyncio.wait_for(
-          asyncio.shield(asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))),
-          timeout=self._timeout_for(request_id),
+        await self._await_generation(
+          request_id, asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))
         )
-      except asyncio.TimeoutError:
+      except (asyncio.TimeoutError, RequestStalledError):
         # The shielded generation would otherwise keep decoding (and keep its
-        # batch slot) until max_tokens after the client got its 408.
+        # batch slot) until max_tokens after the client got its 408/503.
         cancel = getattr(self.node, "cancel_request", None)
         if cancel is not None:
           cancel(request_id)
@@ -1270,6 +1401,11 @@ class ChatGPTAPI:
       return await self._blocking_response(chat_request, request_id, tokenizer, created, prompt_tokens, shard=shard, prompt_ids=prompt_ids)
     except asyncio.TimeoutError:
       return web.json_response({"detail": "Response generation timed out"}, status=408)
+    except RequestStalledError as e:
+      # Stall watchdog (ISSUE 8): structured retryable 503 carrying the
+      # tokens generated so far — the client can re-submit with resume
+      # semantics instead of replaying the whole generation.
+      return stalled_response(e)
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
@@ -1289,6 +1425,7 @@ class ChatGPTAPI:
     finally:
       self.token_queues.pop(request_id, None)
       self._request_deadlines.pop(request_id, None)
+      self._last_progress.pop(request_id, None)
       # On multi-node rings the finishing node cleans its own copy; the
       # API-attached node must drop its entry here or it leaks per request.
       getattr(self.node, "request_options", {}).pop(request_id, None)
@@ -1321,10 +1458,11 @@ class ChatGPTAPI:
       if remaining <= 0:
         raise asyncio.TimeoutError
       try:
-        return await asyncio.wait_for(queue.get(), timeout=min(1.0, remaining))
+        return await asyncio.wait_for(queue.get(), timeout=min(self._stall_poll_s(), remaining))
       except asyncio.TimeoutError:
         if gen_task is not None and gen_task.done() and gen_task.exception() is not None:
           raise gen_task.exception()
+        self._check_stall(request_id)
 
   async def _run_sse_stream(self, request, request_id, tokenizer, stops, gen_task, make_delta_chunk, make_finish_chunk, make_trailer_chunk=None):
     """The one SSE token loop both endpoints share: incremental
@@ -1402,12 +1540,22 @@ class ChatGPTAPI:
       # cleanly instead of returning a fresh json_response the client would
       # never parse.
       detail = "Response generation timed out" if isinstance(e, asyncio.TimeoutError) else f"Error processing prompt: {e}"
-      if DEBUG >= 1 and not isinstance(e, asyncio.TimeoutError):
+      err_obj: dict = {"message": detail}
+      if isinstance(e, RequestStalledError):
+        # Stall watchdog mid-stream: the same typed retryable contract as
+        # the 503, in-band. ``tokens`` = everything already streamed plus
+        # anything the watchdog drained, so a router can resume exactly.
+        err_obj.update({
+          "type": getattr(e, "error_type", "upstream_stalled"),
+          "retryable": True,
+          "tokens": [int(t) for t in all_tokens + (getattr(e, "tokens", None) or [])],
+        })
+      if DEBUG >= 1 and not isinstance(e, (asyncio.TimeoutError, RequestStalledError)):
         import traceback
 
         traceback.print_exc()
       try:
-        await response.write(f"data: {json.dumps({'error': {'message': detail}})}\n\n".encode())
+        await response.write(f"data: {json.dumps({'error': err_obj})}\n\n".encode())
       except ConnectionResetError:
         return response  # client already gone
     await response.write(b"data: [DONE]\n\n")
@@ -1466,12 +1614,7 @@ class ChatGPTAPI:
   async def _blocking_response(self, chat_request, request_id, tokenizer, created, prompt_tokens: int = 0, shard=None, prompt_ids=None):
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
-    all_tokens: list[int] = []
-    while True:
-      tokens, is_finished = await asyncio.wait_for(self.token_queues[request_id].get(), timeout=self._timeout_for(request_id))
-      all_tokens.extend(tokens)
-      if is_finished:
-        break
+    all_tokens = await self._collect_all_tokens(request_id)
     # Generation already completed (the handler awaits process_prompt before
     # calling here), so stop strings are a single post-hoc scan + truncation.
     from ..orchestration.tracing import tracer

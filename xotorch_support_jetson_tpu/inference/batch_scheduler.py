@@ -121,7 +121,7 @@ import numpy as np
 from ..orchestration.tracing import tracer
 from ..utils.helpers import DEBUG
 from ..utils.metrics import FRACTION_BUCKETS, metrics
-from .engine import PromptTooLongError, ServerOverloadedError
+from .engine import NodeDrainingError, PromptTooLongError, RequestMigratedError, ServerOverloadedError
 from .qos import DeadlineUnmeetableError, QosPolicy, QosQueue, priority_rank, qos_enabled
 
 PREFILL_BUCKET = 128
@@ -350,6 +350,15 @@ class BatchedServer:
     # sched_host_gap_seconds (device-idle window a dispatch had to wait for
     # host work — 0 by construction for chained lookahead dispatches).
     self._t_last_ready: float | None = None
+    # Graceful drain (ISSUE 8): once draining, submit() refuses new work
+    # (typed "draining" 429) and the loop's next dispatch boundary offers
+    # every resident row to the migration callback exactly once; rows the
+    # callback declines (or attempted past the drain deadline) re-enqueue
+    # and finish locally via the carry_tokens resume machinery.
+    self.draining = False
+    self._migrate_cb = None
+    self._drain_deadline = 0.0
+    self._drain_attempted: set[str] = set()
 
   # ------------------------------------------------------------- public API
 
@@ -360,6 +369,12 @@ class BatchedServer:
     ``priority`` / ``tenant`` / ``deadline_ms`` feed the QoS layer (rate
     limiting, deadline shedding, fair selection); all three are ignored when
     QoS is disabled."""
+    if self.draining:
+      # No new work on a draining scheduler — a structured, retryable
+      # refusal (the peers already stopped routing here; this covers local
+      # API races inside the announcement window).
+      metrics.inc("scheduler_rejections_total")
+      raise NodeDrainingError("node is draining (graceful shutdown announced)")
     tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
     ticket = None
     if self.qos is not None:
@@ -495,24 +510,17 @@ class BatchedServer:
         best = (key, i)
     return best[1] if best is not None else None
 
-  def _preempt_resume(self, row: int) -> None:
-    """Preempt a resident row for higher-priority work and RE-ENQUEUE it
-    (park-style, not a failure): its pages release now, its prompt absorbs
-    the tokens generated so far, and the resumed prefill continues the
-    stream token-identically (greedy: same logits from the recomputed
-    cache). Runs only at a dispatch boundary with the pipeline drained, so
-    no in-flight chunk references the row."""
+  def _extract_row(self, row: int, *, keep_kv: bool) -> "_Request":
+    """Pull a resident row out of the pool for a carry_tokens resume
+    (preemption or drain migration): its pages release now — donated under
+    extended chain keys when ``keep_kv`` so the resume is transfer-cost —
+    its prompt absorbs the tokens generated so far, and ``carry_tokens``
+    carries the emitted span. The token-absorption/budget bookkeeping here
+    is what makes every resume token-identical; both callers run only at a
+    dispatch boundary with the pipeline drained, so no in-flight chunk
+    references the row."""
     s = self.slots[row]
     req = s.req
-    metrics.inc("qos_preemptions_total")
-    # With the KV tier on, the victim's pages — prompt AND generated — are
-    # donated under extended chain keys: its resume finds the whole stream
-    # as a reusable prefix (device-cached now, host-spilled under pressure)
-    # and prefill recomputes only the last partial page. Resume becomes
-    # transfer-cost instead of recompute-cost; carry_tokens stays the
-    # fallback when every copy has been evicted.
-    keep_kv = self.tier is not None and self.qos.cfg.preempt_spill
-    tracer.stage(req.request_id, "preempted", {"row": row, "generated": s.generated, "resume": True, "kv": "tiered" if keep_kv else "recompute"})
     self._release_pages(s, extend=keep_kv)
     self.slots[row] = None
     self._clear_row(row)
@@ -522,17 +530,40 @@ class BatchedServer:
     req.carry_tokens = list(s.out_tokens)
     req.max_tokens -= s.generated
     req.t_submit = 0.0  # queue-wait/TTFT were already observed at first admission
+    return req
+
+  def _requeue_resumed(self, req: "_Request") -> None:
+    """Re-enqueue an extracted row for a LOCAL resume, front of its lane
+    (it already paid its fair-queue charge at first admission)."""
     if req.qos is not None:
       req.qos.resumed = True  # front of its lane; no second fair-queue charge
-      # Restart the ticket's AGING clock: the row already received service,
-      # and keeping the original t_enqueue would let a long-resident batch
-      # row out-score the very waiter that preempted it (score = rank -
-      # wait/aging) — it would reclaim the freed slot every boundary,
-      # re-running a full prefill each time while the interactive waiter
-      # starves. Front-of-lane placement preserves its intra-lane order.
-      req.qos.t_enqueue = self.qos.clock()
+      if self.qos is not None:
+        # Restart the ticket's AGING clock: the row already received
+        # service, and keeping the original t_enqueue would let a
+        # long-resident batch row out-score the very waiter that preempted
+        # it (score = rank - wait/aging) — it would reclaim the freed slot
+        # every boundary, re-running a full prefill each time while the
+        # interactive waiter starves. Front-of-lane placement preserves its
+        # intra-lane order.
+        req.qos.t_enqueue = self.qos.clock()
     self._queued[req.request_id] = req
     self.queue.put_nowait(req)
+
+  def _preempt_resume(self, row: int) -> None:
+    """Preempt a resident row for higher-priority work and RE-ENQUEUE it
+    (park-style, not a failure): the resumed prefill continues the stream
+    token-identically (greedy: same logits from the recomputed cache)."""
+    s = self.slots[row]
+    metrics.inc("qos_preemptions_total")
+    # With the KV tier on, the victim's pages — prompt AND generated — are
+    # donated under extended chain keys: its resume finds the whole stream
+    # as a reusable prefix (device-cached now, host-spilled under pressure)
+    # and prefill recomputes only the last partial page. Resume becomes
+    # transfer-cost instead of recompute-cost; carry_tokens stays the
+    # fallback when every copy has been evicted.
+    keep_kv = self.tier is not None and self.qos.cfg.preempt_spill
+    tracer.stage(s.req.request_id, "preempted", {"row": row, "generated": s.generated, "resume": True, "kv": "tiered" if keep_kv else "recompute"})
+    self._requeue_resumed(self._extract_row(row, keep_kv=keep_kv))
 
   def cancel(self, request_id: str) -> None:
     """Stop a request (client gone): its slot frees at the next chunk
@@ -565,6 +596,83 @@ class BatchedServer:
       return
     if request_id in self._admitting:
       self._cancelled_ids.add(request_id)
+
+  def begin_drain(self, migrate=None, deadline_s: float = 20.0) -> None:
+    """Enter graceful drain (ISSUE 8): stop admitting NEW work and, at the
+    next dispatch boundary, offer each resident row to ``migrate`` — an
+    async callback ``(req) -> bool`` that ships the row's ``carry_tokens``
+    resume to a surviving peer (orchestration/node.py
+    ``_migrate_batched_row``). Rows declined (no survivor, RPC failure, or
+    past ``deadline_s``) re-enqueue and finish locally."""
+    self.draining = True
+    self._migrate_cb = migrate
+    self._drain_deadline = time.perf_counter() + max(float(deadline_s), 0.0)
+    self._parked_avail_seen = -1  # poke the lookahead drain gate
+
+  def busy(self) -> bool:
+    """Any work still resident, queued, parked, or mid-prefill? (The drain
+    wait in ``Node.graceful_drain`` polls this.)"""
+    return (
+      any(s is not None for s in self.slots)
+      or not self.queue.empty()
+      or bool(self._parked)
+      or bool(self._prefilling)
+    )
+
+  def _drain_pending(self) -> bool:
+    return (
+      self.draining
+      and self._migrate_cb is not None
+      and time.perf_counter() < self._drain_deadline
+      and any(
+        s is not None and not s.finished and not s.cancelled and s.req.request_id not in self._drain_attempted
+        for s in self.slots
+      )
+    )
+
+  async def _drain_migrate(self) -> None:
+    """Offer every live resident row to the migration callback, once each.
+    Runs only at a dispatch boundary with the pipeline drained (exactly the
+    preemption contract), so no in-flight chunk references an extracted
+    row. Extraction mirrors ``_preempt_resume``: pages release (donated
+    under extended chain keys when the KV tier is on), the prompt absorbs
+    the generated stream, and ``carry_tokens`` carries the emitted span —
+    so whether the row ships out or re-enqueues locally, its continuation
+    is token-identical."""
+    for row, s in enumerate(list(self.slots)):
+      if s is None or s.finished or s.cancelled:
+        continue
+      if s.req.request_id in self._drain_attempted or time.perf_counter() >= self._drain_deadline:
+        continue
+      self._drain_attempted.add(s.req.request_id)
+      tracer.stage(s.req.request_id, "drain", {"row": row, "generated": s.generated})
+      keep_kv = self.tier is not None and (self.qos is None or self.qos.cfg.preempt_spill)
+      req = self._extract_row(row, keep_kv=keep_kv)
+      # The migration RPC (send_tensor) resolves only when the SURVIVOR
+      # finishes the whole continuation (ring span-tree semantics), so it
+      # must not block this loop — remaining rows keep decoding while the
+      # shipped row runs remotely. The extracted row is already safe to
+      # hand off: no in-flight chunk references it.
+      task = asyncio.ensure_future(self._migrate_cb(req))
+      task.add_done_callback(lambda t, req=req: self._settle_migration(t, req))
+    self._update_gauges()
+
+  def _settle_migration(self, task, req: _Request) -> None:
+    migrated = False
+    if not task.cancelled():  # a cancelled migration (teardown) resumes locally too
+      try:
+        migrated = bool(task.result())
+      except Exception:  # noqa: BLE001 — a failed migration finishes locally
+        migrated = False
+    if migrated:
+      if not req.future.done():
+        req.future.set_exception(RequestMigratedError(req.request_id))
+      return
+    if req.future.done():
+      return  # torn down while the migration was in flight
+    # No survivor took it: resume locally (carry_tokens recompute).
+    self._requeue_resumed(req)
+    self._parked_avail_seen = -1  # poke the lookahead drain gate
 
   def shutdown(self) -> None:
     """Stop the decode loop and drop the pooled cache (model unload/reload).
@@ -1654,11 +1762,16 @@ class BatchedServer:
             # boundary's admission pass can preempt-and-admit — interactive
             # work must not chain behind a saturated batch pipeline.
             admissible = True
-          if not self.lookahead or self._prefilling or admissible:
+          if not self.lookahead or self._prefilling or admissible or self._drain_pending():
             await self._settle(inflight)
             inflight = None
             continue
         else:
+          if self._drain_pending():
+            # Graceful drain: the pipeline is drained (no in-flight chunk),
+            # so resident rows can be extracted and offered for migration
+            # exactly like a preemption boundary.
+            await self._drain_migrate()
           # Admission: every admissible request — parked (page-starved)
           # first, in arrival order, then the queue — prefills in ONE
           # batched dispatch between decode chunks.

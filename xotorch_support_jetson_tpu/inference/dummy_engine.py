@@ -56,9 +56,23 @@ class DummyInferenceEngine(InferenceEngine):
   ) -> tuple[np.ndarray, InferenceState]:
     state = inference_state or InferenceState()
     x = np.asarray(input_data)
-    if state.tokens is None and x.ndim == 2 and np.issubdtype(x.dtype, np.integer):
-      state.tokens = x.astype(np.int32)
-      state.prompt_len = x.shape[1]
+    if x.ndim == 2 and np.issubdtype(x.dtype, np.integer):
+      if state.curr_pos == 0:
+        # Prefill (original prompt OR a replayed token history): the wire
+        # history is the input; the ORIGINAL prompt length survives replays
+        # via setdefault — node._check_finished and the absolute-position
+        # dedup both count generated tokens from it.
+        state.tokens = x.astype(np.int32)
+        state.prompt_len = x.shape[1]
+        state.extras.setdefault("orig_prompt_len", int(x.shape[1]))
+      elif state.tokens is not None:
+        # Decode step at the ring head: append the freshly sampled token to
+        # the wire history, exactly like the real engine
+        # (jax_engine._infer_tensor_sync) — the elastic replay
+        # (orchestration/node.py _retry_request) re-prefills this history,
+        # so an engine that drops it turns a mid-decode failover into a
+        # value-shifted stream (caught by tests/test_chaos.py).
+        state.tokens = np.concatenate([state.tokens, x[:, -1:].astype(np.int32)], axis=1)
     output = (x.astype(np.float32) + 1.0) if shard.is_last_layer else x.astype(np.float32)
     state.curr_pos += x.shape[1] if x.ndim >= 2 else 1
     return output, state

@@ -37,6 +37,38 @@ class ServerOverloadedError(RuntimeError):
   """Request admission queue is full; the API answers 429."""
 
 
+class NodeDrainingError(ServerOverloadedError):
+  """This node announced shutdown and accepts no new work; the API answers a
+  structured 429 (type ``draining``) — the client should retry elsewhere."""
+
+  error_type = "draining"
+
+
+class RequestStalledError(RuntimeError):
+  """The stall watchdog fired: no token progress for ``XOT_TPU_STALL_S``
+  while an upstream hop is dead or open-circuit. The API answers a
+  structured, RETRYABLE 503 (type ``upstream_stalled``) carrying the tokens
+  generated so far, so a client or router can re-submit with resume
+  semantics instead of waiting out the full response timeout."""
+
+  error_type = "upstream_stalled"
+
+  def __init__(self, message: str, tokens: list | None = None) -> None:
+    super().__init__(message)
+    self.tokens: list = list(tokens or [])
+
+
+class RequestMigratedError(Exception):
+  """Internal scheduler→node signal: a draining scheduler shipped this
+  request to a surviving peer (``carry_tokens`` resume over gRPC). The
+  node-side serving path catches it and waits for the remote finish — it
+  never reaches a client."""
+
+  def __init__(self, request_id: str) -> None:
+    super().__init__(f"request {request_id} migrated to a surviving peer")
+    self.request_id = request_id
+
+
 class InferenceEngine(ABC):
   """A model-executing backend bound to one shard at a time.
 

@@ -572,6 +572,21 @@ class QosWire:
   def mark_seen(self, request_id: str, node_id: str, *, priority=None, tenant=None, deadline_ms=None) -> None:
     self.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, node_id=node_id)
 
+  def remaining_deadline_ms(self, request_id: str) -> float | None:
+    """The request's REMAINING end-to-end budget in ms (None when it
+    carries no deadline, 0 when spent). The single source of the decay
+    math: both the wire metadata (``qos_metadata``) and the RPC timeout cap
+    (networking/retry.py) read this, so the budget a downstream node is
+    told and the budget the sender's own timeouts enforce cannot skew."""
+    entry = self.get(request_id)
+    if not entry or entry.get("deadline_ms") is None:
+      return None
+    remaining = float(entry["deadline_ms"])
+    t0 = entry.get("t_register")
+    if t0 is not None:
+      remaining -= (time.monotonic() - t0) * 1e3
+    return max(remaining, 0.0)
+
   def pop(self, request_id: str) -> None:
     with self._lock:
       self._entries.pop(request_id, None)
@@ -594,10 +609,7 @@ def qos_metadata(request_id: str) -> list[tuple[str, str]]:
     out.append((QOS_META_PRIORITY, str(entry["priority"])))
   if entry.get("tenant"):
     out.append((QOS_META_TENANT, str(entry["tenant"])))
-  if entry.get("deadline_ms") is not None:
-    remaining = float(entry["deadline_ms"])
-    t0 = entry.get("t_register")
-    if t0 is not None:
-      remaining = max(remaining - (time.monotonic() - t0) * 1e3, 0.0)
+  remaining = qos_wire.remaining_deadline_ms(request_id)
+  if remaining is not None:
     out.append((QOS_META_DEADLINE, str(round(remaining, 3))))
   return out

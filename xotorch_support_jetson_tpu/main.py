@@ -326,8 +326,13 @@ async def async_main(args) -> None:
 
   loop = asyncio.get_event_loop()
   stop_event = asyncio.Event()
+  force_event = asyncio.Event()  # second signal: skip the graceful drain
 
   def shutdown():
+    if stop_event.is_set():
+      # Second SIGINT/SIGTERM: the operator wants out NOW — abort the
+      # drain wait and fall through to the hard stop.
+      force_event.set()
     stop_event.set()
 
   for sig in (signal.SIGINT, signal.SIGTERM):
@@ -366,6 +371,17 @@ async def async_main(args) -> None:
     else:
       runner = await api.run(port=args.chatgpt_api_port)
       await stop_event.wait()
+      # Graceful drain (ISSUE 8): announce shutdown so peers stop routing
+      # new work here, migrate resident batched rows to a surviving peer
+      # (carry_tokens resume), and wait out in-flight streams up to
+      # XOT_TPU_DRAIN_S. A second signal (force_event) aborts the wait.
+      try:
+        await node.graceful_drain(force=force_event)
+      except Exception:  # noqa: BLE001 — drain is best-effort; stop regardless
+        if DEBUG >= 1:
+          import traceback
+
+          traceback.print_exc()
       await runner.cleanup()
   finally:
     await node.stop()

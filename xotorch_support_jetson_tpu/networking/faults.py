@@ -1,0 +1,203 @@
+"""Deterministic fault injection for the cluster data/control plane.
+
+The reference system's core scenario is devices joining and leaving a p2p
+ring ad hoc, yet nothing in the serving stack could *exercise* a failure
+without a real process kill (scripts/failover_drill.sh). This module is the
+seeded, schedule-driven injector both RPC choke points consult:
+
+- ``GRPCPeerHandle`` applies ``side="client"`` faults before every outgoing
+  RPC (peer = the TARGET node id, origin = the sending node id);
+- ``grpc_server`` applies ``side="server"`` faults before every handler
+  (peer = the SERVING node id, origin = the ``x-origin-node`` metadata).
+
+Fault kinds:
+
+- ``drop`` / ``partition`` — the call fails with ``ChaosInjectedError``
+  (the client sees exactly what a severed link produces: an errored RPC).
+  ``partition`` is ``drop`` with both sides and every method matched by
+  default — a 100% loss cut between the rule's peer and everyone else.
+- ``delay`` — the call proceeds after ``delay_ms`` plus a seeded jitter in
+  ``[0, jitter_ms)`` (the ONLY nondeterminism, and it comes from the
+  injector's own ``random.Random(seed)``).
+- ``error`` — the call fails with a typed error (``code=`` names the gRPC
+  status the server surfaces, default ``unavailable``).
+- ``kill`` — simulated node death: every call *to*, *from*, or *served by*
+  that node fails until ``revive()``.
+
+Scheduling is per-rule and deterministic: ``after=N`` skips the first N
+matching calls, ``times=M`` fires at most M times — so "kill node1 after
+the 3rd SendTensor" is an exact, replayable schedule.
+
+Configuration: ``XOT_TPU_CHAOS`` holds ``;``-separated rules of
+whitespace/comma-separated ``key=value`` fields, e.g.::
+
+    XOT_TPU_CHAOS="peer=node1 method=SendTensor kind=delay delay_ms=200; peer=node1 kind=kill after=5"
+
+plus the programmatic registry (``chaos.install`` / ``chaos.kill`` /
+``chaos.clear``) tests use. ``peer``/``method`` are fnmatch patterns.
+
+With ``XOT_TPU_CHAOS`` unset the injector is INERT and byte-identical to
+not existing (test-pinned): ``chaos.enabled`` is False and both call sites
+gate on it, so the healthy path gains no awaits, no allocation, no lock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+from dataclasses import dataclass, field
+from fnmatch import fnmatch
+
+FAULT_KINDS = ("drop", "delay", "error", "partition", "kill")
+
+
+class ChaosInjectedError(ConnectionError):
+  """An injected fault. Carries the gRPC-status-style ``code`` so server-side
+  injection can surface the exact typed error a real failure would."""
+
+  def __init__(self, message: str, code: str = "unavailable") -> None:
+    super().__init__(message)
+    self.code = code
+
+
+@dataclass
+class FaultRule:
+  """One (peer, method) fault rule with a deterministic schedule."""
+
+  peer: str = "*"  # target node id pattern (client side) / serving node id (server side)
+  method: str = "*"  # RPC method pattern (SendTensor, HealthCheck, Connect, ...)
+  side: str = "*"  # client | server | *
+  kind: str = "drop"
+  delay_ms: float = 0.0
+  jitter_ms: float = 0.0
+  code: str = "unavailable"
+  after: int = 0  # skip the first N matching calls
+  times: int = 0  # fire at most N times (0 = unlimited)
+  seen: int = field(default=0, compare=False)
+  fired: int = field(default=0, compare=False)
+
+  def matches(self, side: str, peer: str, method: str, origin: str = "") -> bool:
+    if self.side not in ("*", side):
+      return False
+    # A partition severs the named node's links in BOTH directions
+    # regardless of method — the rule matches as target OR as origin.
+    if self.kind == "partition":
+      return fnmatch(peer, self.peer) or (bool(origin) and fnmatch(origin, self.peer))
+    return fnmatch(peer, self.peer) and fnmatch(method, self.method)
+
+
+def parse_rules(spec: str) -> list[FaultRule]:
+  """Parse the ``XOT_TPU_CHAOS`` grammar. Malformed fields raise ValueError —
+  a typo'd chaos schedule must fail loudly, not silently test nothing."""
+  rules: list[FaultRule] = []
+  for clause in spec.split(";"):
+    clause = clause.strip()
+    if not clause:
+      continue
+    fields: dict[str, str] = {}
+    for tok in clause.replace(",", " ").split():
+      if "=" not in tok:
+        raise ValueError(f"chaos rule field {tok!r} is not key=value (in {clause!r})")
+      k, v = tok.split("=", 1)
+      fields[k.strip()] = v.strip()
+    kind = fields.pop("kind", "drop")
+    if kind not in FAULT_KINDS:
+      raise ValueError(f"unknown chaos kind {kind!r} (one of {FAULT_KINDS})")
+    side = fields.pop("side", "*")
+    if side not in ("*", "client", "server"):
+      raise ValueError(f"chaos rule side must be client|server|* (got {side!r})")
+    rule = FaultRule(kind=kind, side=side)
+    for k, v in fields.items():
+      if k in ("peer", "method", "code"):
+        setattr(rule, k, v)
+      elif k in ("delay_ms", "jitter_ms"):
+        setattr(rule, k, float(v))
+      elif k in ("after", "times"):
+        setattr(rule, k, int(v))
+      else:
+        raise ValueError(f"unknown chaos rule field {k!r} (in {clause!r})")
+    rules.append(rule)
+  return rules
+
+
+class FaultInjector:
+  """Registry + evaluator. One process-wide instance (``chaos``) serves every
+  in-process node, so a two-node test cluster shares one schedule."""
+
+  def __init__(self, rules: list[FaultRule] | None = None, seed: int = 0) -> None:
+    self.rules: list[FaultRule] = list(rules or [])
+    self._killed: set[str] = set()
+    self.rng = random.Random(seed)
+    self.applied = 0  # total faults fired (tests assert the schedule ran)
+    for r in self.rules:
+      if r.kind == "kill" and r.after == 0 and "*" not in r.peer:
+        # An unscheduled kill rule is an immediate kill; scheduled kills
+        # (after=N) stay rules and move the peer into the killed set on fire.
+        self._killed.add(r.peer)
+
+  @property
+  def enabled(self) -> bool:
+    return bool(self.rules or self._killed)
+
+  @classmethod
+  def from_env(cls) -> "FaultInjector":
+    spec = os.getenv("XOT_TPU_CHAOS", "")
+    seed = int(os.getenv("XOT_TPU_CHAOS_SEED", "0") or 0)
+    return cls(parse_rules(spec) if spec else [], seed=seed)
+
+  # --------------------------------------------------------------- registry
+
+  def install(self, rule: FaultRule) -> FaultRule:
+    self.rules.append(rule)
+    return rule
+
+  def kill(self, node_id: str) -> None:
+    """Simulated node death: everything to/from/served-by ``node_id`` fails."""
+    self._killed.add(node_id)
+
+  def revive(self, node_id: str) -> None:
+    self._killed.discard(node_id)
+
+  def clear(self) -> None:
+    self.rules.clear()
+    self._killed.clear()
+    self.applied = 0
+
+  # -------------------------------------------------------------- evaluation
+
+  def _dead(self, side: str, peer: str, origin: str | None) -> bool:
+    if not self._killed:
+      return False
+    # A killed node neither answers (target/serving side) nor speaks
+    # (origin side) — both directions of every link it touches are dark.
+    return peer in self._killed or (origin is not None and origin in self._killed)
+
+  async def apply(self, side: str, peer: str, method: str, origin: str | None = None) -> None:
+    """Evaluate the schedule for one call; raises or delays per the first
+    firing rule. No-op (no award of counters) when nothing matches."""
+    if self._dead(side, peer, origin or ""):
+      self.applied += 1
+      raise ChaosInjectedError(f"chaos: node killed ({side} {method} peer={peer})")
+    for rule in self.rules:
+      if not rule.matches(side, peer, method, origin or ""):
+        continue
+      rule.seen += 1
+      if rule.seen <= rule.after:
+        continue
+      if rule.times and rule.fired >= rule.times:
+        continue
+      rule.fired += 1
+      self.applied += 1
+      if rule.kind == "kill":
+        self._killed.add(peer)
+        raise ChaosInjectedError(f"chaos: killed {peer} ({side} {method})")
+      if rule.kind == "delay":
+        await asyncio.sleep((rule.delay_ms + rule.jitter_ms * self.rng.random()) / 1e3)
+        continue  # delayed calls still proceed (and later rules may stack)
+      if rule.kind == "error":
+        raise ChaosInjectedError(f"chaos: injected {rule.code} ({side} {method} peer={peer})", code=rule.code)
+      raise ChaosInjectedError(f"chaos: dropped ({side} {method} peer={peer})")
+
+
+chaos = FaultInjector.from_env()

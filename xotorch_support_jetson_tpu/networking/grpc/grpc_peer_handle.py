@@ -21,6 +21,7 @@ from the qos_wire registry) so the receiving node enforces the same policy.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 
 import grpc
@@ -35,7 +36,18 @@ from ...topology.device_capabilities import DeviceCapabilities
 from ...topology.topology import Topology
 from ...utils.helpers import DEBUG
 from ...utils.metrics import metrics
+from ..faults import chaos
 from ..peer_handle import PeerHandle
+from ..retry import (
+  PeerCircuitOpenError,
+  backoff_s,
+  breakers,
+  effective_timeout,
+  peer_health,
+  retry_budget,
+  rpc_retries,
+  rpc_timeout,
+)
 from . import node_service_pb2 as pb
 from .grpc_server import CHANNEL_OPTIONS, SERVICE_NAME
 from .serialization import (
@@ -47,8 +59,28 @@ from .serialization import (
   tensor_to_proto,
 )
 
+# Historical defaults, kept as monkeypatchable module globals. Call sites go
+# through ``_env_timeout``: an XOT_TPU_RPC_TIMEOUT_* env override (read at
+# CALL time — live retunes work, unlike an import-frozen constant) wins over
+# the module global.
 CONNECT_TIMEOUT = 10.0
 HEALTH_TIMEOUT = 5.0
+
+
+def _env_timeout(method: str, fallback: float | None) -> float | None:
+  if os.getenv(f"XOT_TPU_RPC_TIMEOUT_{method.upper()}_S") is not None or os.getenv("XOT_TPU_RPC_TIMEOUT_S") is not None:
+    return rpc_timeout(method)
+  return fallback
+
+
+def _is_transport_failure(e: Exception) -> bool:
+  """Does this RPC failure say anything about the PEER's health? gRPC maps
+  an unhandled exception in the remote handler to status UNKNOWN — the peer
+  answered, its application refused. Everything else (UNAVAILABLE, deadline,
+  connection-level errors, injected faults) is the transport/peer."""
+  if isinstance(e, grpc.aio.AioRpcError):
+    return e.code() != grpc.StatusCode.UNKNOWN
+  return True
 
 
 class GRPCPeerHandle(PeerHandle):
@@ -98,7 +130,7 @@ class GRPCPeerHandle(PeerHandle):
           "HealthCheck": (pb.HealthCheckRequest, pb.HealthCheckResponse),
         }.items()
       }
-    await asyncio.wait_for(self.channel.channel_ready(), timeout=CONNECT_TIMEOUT)
+    await asyncio.wait_for(self.channel.channel_ready(), timeout=_env_timeout("Connect", CONNECT_TIMEOUT))
 
   async def is_connected(self) -> bool:
     return self.channel is not None and self.channel.get_state() == grpc.ChannelConnectivity.READY
@@ -109,23 +141,40 @@ class GRPCPeerHandle(PeerHandle):
     self.channel = None
     self._rpcs = {}
 
-  async def _ensure_connected(self) -> None:
+  def _breaker(self):
+    return breakers.get(self._id, self.address)
+
+  async def _ensure_connected(self, probe: bool = False) -> None:
+    if not probe and not self._breaker().allow():
+      # Fail fast on an open circuit: no connect timeout burned on a peer
+      # that just failed N consecutive calls. HealthCheck (probe=True)
+      # bypasses the gate — it IS the probe that closes the circuit.
+      raise PeerCircuitOpenError(f"circuit open for peer {self._id} ({self.address})")
     if not await self.is_connected():
       try:
-        await asyncio.wait_for(self.connect(), timeout=CONNECT_TIMEOUT)
+        await asyncio.wait_for(self.connect(), timeout=_env_timeout("Connect", CONNECT_TIMEOUT))
       except asyncio.TimeoutError:
+        if not probe:
+          # The probe path's own finally records exactly once (health_check)
+          # — recording here too would double-count connect failures and
+          # halve the effective XOT_TPU_CB_FAILS threshold.
+          self._breaker().record_failure()
         raise TimeoutError(f"connect to {self.address} timed out") from None
 
   async def health_check(self) -> bool:
+    ok = False
+    cancelled = False
     try:
-      await self._ensure_connected()
+      await self._ensure_connected(probe=True)
+      if chaos.enabled:
+        await chaos.apply("client", self._id, "HealthCheck", origin=self.origin_id)
       # Four-timestamp NTP echo piggybacked on the health RPC: t0/t3 are
       # this node's monotonic clock around the call; the server answers with
       # its own receive/send times (t1/t2) in trailing metadata. One sample
       # per health check keeps the per-peer offset estimate fresh for free.
       t0 = node_now_ns(self.origin_id)
       call = self._rpcs["HealthCheck"](pb.HealthCheckRequest(), metadata=(("x-clock-t0", str(t0)),))
-      response = await asyncio.wait_for(call, timeout=HEALTH_TIMEOUT)
+      response = await asyncio.wait_for(call, timeout=_env_timeout("HealthCheck", HEALTH_TIMEOUT))
       t3 = node_now_ns(self.origin_id)
       try:
         trailing = {k: v for k, v in (await call.trailing_metadata() or ())}
@@ -133,24 +182,99 @@ class GRPCPeerHandle(PeerHandle):
         clock_sync.update(self._id, t0, t1, t2, t3)
       except (KeyError, ValueError, TypeError):
         pass  # older peer without the echo: health result still stands
-      return response.is_healthy
+      ok = bool(response.is_healthy)
+      return ok
+    except asyncio.CancelledError:
+      # Caller teardown (discovery stop, an outer wait_for expiring) says
+      # nothing about the peer — recording it as a failure would let a few
+      # cancelled probes mark a LIVE peer dead and open its breaker.
+      cancelled = True
+      raise
     except Exception:  # noqa: BLE001 — any failure means unhealthy
       if DEBUG >= 4:
         import traceback
 
         traceback.print_exc()
       return False
+    finally:
+      # The ONE choke point every discovery layer's health probe goes
+      # through: flap damping (networking/retry.py peer_health — a peer is
+      # dead only after K consecutive failures) and the circuit breaker
+      # (success closes / half-open probes succeed → closed) both feed here.
+      if not cancelled:
+        peer_health.record(self._id, ok)
+        if ok:
+          self._breaker().record_success()
+        else:
+          self._breaker().record_failure()
+
+  async def _invoke(self, method: str, request, *, metadata=None, request_id: str = ""):
+    """The one RPC execution path: circuit-breaker gate, fault injection,
+    policy timeout (capped by the request's remaining deadline budget), and
+    bounded retry with jittered backoff for the idempotent methods
+    (networking/retry.py). Raises ``PeerCircuitOpenError`` without touching
+    the wire when the peer's circuit is open."""
+    breaker = self._breaker()
+    if not breaker.allow():
+      raise PeerCircuitOpenError(f"circuit open for peer {self._id} ({self.address})")
+    policy_timeout = rpc_timeout(method)
+    retries = rpc_retries(method)
+    attempt = 0
+    while True:
+      # Recomputed PER ATTEMPT: a deadlined request's retries must see the
+      # budget that remains NOW, not the value frozen before the first try
+      # — otherwise backoff + stale timeouts overrun the SLO the cap
+      # exists to protect.
+      timeout = effective_timeout(method, request_id)
+      # A timeout at a DEADLINE-capped bound (tighter than the method's own
+      # policy timeout) means the REQUEST ran out of budget, not that the
+      # peer is unhealthy — charging it to the breaker would let one
+      # tenant's too-tight deadlines open the circuit of a perfectly
+      # healthy peer and cascade into replay churn + watchdog 503s for
+      # everyone else.
+      deadline_capped = timeout is not None and (policy_timeout is None or timeout < policy_timeout)
+      try:
+        if chaos.enabled:
+          await chaos.apply("client", self._id, method, origin=self.origin_id)
+        call = self._rpcs[method](request, metadata=metadata)
+        response = await (asyncio.wait_for(call, timeout=timeout) if timeout is not None else call)
+      except asyncio.CancelledError:
+        raise  # caller teardown is not a peer failure
+      except Exception as e:
+        if deadline_capped and isinstance(e, asyncio.TimeoutError):
+          raise  # out of request budget: fail fast, peer stays innocent
+        if _is_transport_failure(e):
+          # Application-level refusals (a remote handler raising — overload
+          # sheds, validation errors — surface as status UNKNOWN) mean the
+          # peer is alive and talking: charging them would let sustained
+          # overload on a healthy peer open its circuit and convert
+          # rejections into a full partition.
+          breaker.record_failure()
+        if attempt >= retries or not retry_budget.take(request_id):
+          raise
+        attempt += 1
+        metrics.inc("rpc_retries_total", labels={"method": method})
+        await asyncio.sleep(backoff_s(attempt))
+        if not breaker.allow():
+          # The circuit opened mid-call (this call's own failures, or a
+          # concurrent one's): stop hammering the corpse — fail fast like
+          # every new call would.
+          raise PeerCircuitOpenError(f"circuit open for peer {self._id} ({self.address})")
+        continue
+      breaker.record_success()
+      return response
 
   # -------------------------------------------------------------- data plane
 
-  async def _traced_call(self, method: str, request, request_id: str, serialize_s: float, t_start_ns: int | None = None, timeout: float | None = None):
+  async def _traced_call(self, method: str, request, request_id: str, serialize_s: float, t_start_ns: int | None = None):
     """Run one data-plane RPC with hop telemetry: traceparent metadata out,
     client-side span + timeline hop entry + per-peer-link metrics in. The
     hop's span id rides the traceparent's parent-id field so the server's
     hop entry parents to (and the cluster merge pairs with) this one.
     ``t_start_ns`` is the caller's clock read from BEFORE it built the
     request proto, so the hop window [start, start + serialize + rpc] ends
-    when the RPC actually completed."""
+    when the RPC actually completed. Execution (timeout policy, circuit
+    breaker, retries, fault injection) is ``_invoke``'s."""
     hop_id = new_span_id()
     ids = tracer.trace_ids(request_id) if request_id else None
     metadata = []
@@ -173,8 +297,7 @@ class GRPCPeerHandle(PeerHandle):
     t0 = time.perf_counter()
     ok = False
     try:
-      call = self._rpcs[method](request, metadata=metadata)
-      response = await (asyncio.wait_for(call, timeout=timeout) if timeout is not None else call)
+      response = await self._invoke(method, request, metadata=metadata, request_id=request_id)
       ok = True
       return response
     finally:
@@ -247,7 +370,7 @@ class GRPCPeerHandle(PeerHandle):
 
   async def send_loss(self, loss: float, grads: np.ndarray | None = None) -> None:
     await self._ensure_connected()
-    await self._rpcs["SendLoss"](pb.Loss(loss=loss, grads=tensor_to_proto(grads)))
+    await self._invoke("SendLoss", pb.Loss(loss=loss, grads=tensor_to_proto(grads)))
 
   async def send_result(self, request_id: str, result, is_finished: bool, start_pos: int | None = None) -> None:
     await self._ensure_connected()
@@ -260,7 +383,7 @@ class GRPCPeerHandle(PeerHandle):
       request.tensor.CopyFrom(tensor_to_proto(result))
     else:
       request.result.extend(int(r) for r in result)
-    await self._traced_call("SendResult", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start, timeout=15.0)
+    await self._traced_call("SendResult", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
     await self._ensure_connected()
@@ -271,7 +394,7 @@ class GRPCPeerHandle(PeerHandle):
     labels = {"peer": self._id, "method": "SendOpaqueStatus"}
     t0 = time.perf_counter()
     try:
-      await asyncio.wait_for(self._rpcs["SendOpaqueStatus"](request), timeout=15.0)
+      await self._invoke("SendOpaqueStatus", request, request_id=request_id)
     except BaseException:
       metrics.inc("peer_rpc_failures_total", labels=labels)
       raise
@@ -282,5 +405,5 @@ class GRPCPeerHandle(PeerHandle):
   async def collect_topology(self, visited: set[str], max_depth: int) -> Topology:
     await self._ensure_connected()
     request = pb.CollectTopologyRequest(visited=sorted(visited), max_depth=max_depth)
-    response = await asyncio.wait_for(self._rpcs["CollectTopology"](request), timeout=5.0)
+    response = await self._invoke("CollectTopology", request)
     return proto_to_topology(response)

@@ -30,6 +30,7 @@ import grpc
 from ...inference.qos import QOS_META_DEADLINE, QOS_META_PRIORITY, QOS_META_TENANT, qos_wire
 from ...orchestration.tracing import node_now_ns, parse_traceparent, tracer
 from ...utils.helpers import DEBUG
+from ..faults import ChaosInjectedError, chaos
 from . import node_service_pb2 as pb
 from .serialization import (
   proto_payload_bytes,
@@ -101,6 +102,18 @@ class GRPCServer:
         # and handler latency feed the same registry /metrics serves — a
         # ring's forwarding load is observable without packet captures.
         metrics.inc("grpc_rpcs_total", labels={"method": method})
+        if chaos.enabled:
+          # Server-side fault injection (networking/faults.py): peer = the
+          # SERVING node id (so "kill node1" darkens node1's handlers),
+          # origin = the sender. Injected errors surface as the typed gRPC
+          # status a real failure would — the client's retry/breaker/replay
+          # machinery cannot tell the difference, which is the point.
+          try:
+            await chaos.apply("server", self.node.id, method, origin=_meta_get(context, "x-origin-node"))
+          except ChaosInjectedError as e:
+            metrics.inc("grpc_rpc_failures_total", labels={"method": method})
+            code = getattr(grpc.StatusCode, str(e.code).upper(), grpc.StatusCode.UNAVAILABLE)
+            await context.abort(code, str(e))
         t0 = time.perf_counter()
         try:
           return await fn(request, context)

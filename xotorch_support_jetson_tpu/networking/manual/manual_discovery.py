@@ -15,6 +15,7 @@ from ...topology.device_capabilities import DeviceCapabilities
 from ...utils.helpers import DEBUG_DISCOVERY
 from ..discovery import Discovery
 from ..peer_handle import PeerHandle
+from ..retry import peer_health
 from .network_topology_config import NetworkTopology, peer_device_capabilities
 
 
@@ -98,5 +99,10 @@ class ManualDiscovery(Discovery):
           await handle.disconnect()
         except Exception:  # noqa: BLE001
           pass
-      elif not await self.known_peers[peer_id].health_check():
-        self.known_peers.pop(peer_id, None)
+      else:
+        # Flap damping (networking/retry.py): drop a configured peer only
+        # after XOT_TPU_HEALTH_FAILS consecutive failed checks, not one.
+        await self.known_peers[peer_id].health_check()
+        if peer_health.is_dead(peer_id):
+          peer_health.forget(peer_id)  # the next adoption probes fresh
+          self.known_peers.pop(peer_id, None)

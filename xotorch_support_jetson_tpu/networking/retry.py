@@ -1,0 +1,317 @@
+"""Unified RPC retry/timeout policy and per-peer circuit breakers.
+
+Before this module the peer handle's timeouts were scattered hardcoded
+constants (SendResult 15 s, SendOpaqueStatus 15 s, CollectTopology 5 s,
+module-level CONNECT_TIMEOUT/HEALTH_TIMEOUT) and every failure was handled
+ad hoc at its call site. This is the one policy surface:
+
+- TIMEOUT TABLE: per-method defaults (exactly the historical values),
+  overridable per method via ``XOT_TPU_RPC_TIMEOUT_<METHOD>_S`` and — for
+  the finitely-bounded methods only — globally via ``XOT_TPU_RPC_TIMEOUT_S``.
+  ``SendPrompt``/``SendTensor``/``SendExample`` stay unbounded by default:
+  on a ring, their client latency tracks the whole awaited downstream
+  generation (span-tree semantics), so a global cap would sever healthy
+  long generations.
+- DEADLINE CAP: a request carrying a QoS deadline (the wire already ships
+  the remaining budget — inference/qos.py) caps every one of its RPC
+  timeouts at that remaining budget, so a doomed request fails fast instead
+  of burning its SLO waiting out a dead peer.
+- RETRY POLICY: exponential backoff with full jitter for the IDEMPOTENT
+  methods (SendResult — deduped by absolute position; SendOpaqueStatus —
+  nonce'd pulls / idempotent control messages; CollectTopology — pure
+  read). The data plane (SendPrompt/SendTensor/SendExample) never retries
+  at the RPC layer: the node-level replay (orchestration/node.py
+  ``_retry_request``) owns its recovery, with dedup semantics a blind RPC
+  retry cannot provide. Every retry is charged to a per-request budget
+  (``XOT_TPU_RPC_RETRY_BUDGET``) so one request cannot grind a link.
+- CIRCUIT BREAKERS, one per (peer id, address): ``closed`` → normal;
+  ``XOT_TPU_CB_FAILS`` consecutive failures → ``open`` (every call fails
+  fast with ``PeerCircuitOpenError`` — no connect timeout burned on a
+  corpse); after ``XOT_TPU_CB_OPEN_S`` the breaker goes ``half_open`` and
+  lets traffic probe — in practice the existing HealthCheck, which bypasses
+  the breaker gate (it IS the probe) and whose success closes the circuit.
+  State is exported as ``peer_circuit_state{peer}`` (0 closed, 1 half-open,
+  2 open).
+- HEALTH FLAP DAMPING: ``peer_health`` counts CONSECUTIVE HealthCheck
+  failures per peer; discovery declares a peer dead only at
+  ``XOT_TPU_HEALTH_FAILS`` (default 3) in a row, so one 5 s stall cannot
+  trigger eviction/replay. A single success resets the count (and
+  closes/half-opens the breaker via the normal success path).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+
+from ..utils.metrics import metrics
+
+# Historical per-method defaults, preserved exactly. None = unbounded.
+METHOD_TIMEOUT_DEFAULTS: dict[str, float | None] = {
+  "Connect": 10.0,
+  "HealthCheck": 5.0,
+  "SendPrompt": None,
+  "SendTensor": None,
+  "SendExample": None,
+  "SendLoss": None,
+  "SendResult": 15.0,
+  "SendOpaqueStatus": 15.0,
+  "CollectTopology": 5.0,
+}
+
+# RPC-layer retry eligibility: only methods whose duplicate delivery is
+# harmless (deduped, nonce'd, or pure reads). The data plane's recovery is
+# the node-level replay with its epoch/high-water dedup machinery.
+RETRYABLE_METHODS = frozenset({"SendResult", "SendOpaqueStatus", "CollectTopology"})
+
+_OPEN, _HALF_OPEN, _CLOSED = 2, 1, 0
+
+
+def _env_f(name: str, default: float) -> float:
+  try:
+    return float(os.getenv(name, "") or default)
+  except ValueError:
+    return default
+
+
+def rpc_timeout(method: str) -> float | None:
+  """Effective timeout for ``method`` from the policy table: the per-method
+  env override wins outright; the global ``XOT_TPU_RPC_TIMEOUT_S`` CAPS the
+  finite defaults (a blanket knob must never silently RAISE HealthCheck/
+  CollectTopology and slow dead-peer detection — raising a specific method
+  is what the per-method override is for) and never touches the unbounded
+  data-plane methods; else the historical default."""
+  default = METHOD_TIMEOUT_DEFAULTS.get(method)
+  per = os.getenv(f"XOT_TPU_RPC_TIMEOUT_{method.upper()}_S")
+  if per is not None:
+    try:
+      v = float(per)
+      return v if v > 0 else None
+    except ValueError:
+      pass
+  if default is not None:
+    return min(default, _env_f("XOT_TPU_RPC_TIMEOUT_S", default))
+  return default
+
+
+def deadline_remaining_s(request_id: str) -> float | None:
+  """Remaining end-to-end QoS budget for ``request_id`` in seconds (None
+  when the request carries no deadline). Delegates to the wire registry's
+  single decay-math source (inference/qos.py ``remaining_deadline_ms``) so
+  the budget shipped downstream and the timeout cap enforced here agree."""
+  if not request_id:
+    return None
+  from ..inference.qos import qos_wire
+
+  remaining_ms = qos_wire.remaining_deadline_ms(request_id)
+  return None if remaining_ms is None else remaining_ms / 1e3
+
+
+# Only the FORWARD path — the RPCs that spend compute on the request — is
+# deadline-capped. Delivery and control RPCs (SendResult carrying finished
+# tokens back to the origin, SendOpaqueStatus carrying cancels) must still
+# deliver after the budget is gone: clamping them to the floor would discard
+# completed work or leak the remote batch slot the cancel exists to free.
+DEADLINE_CAPPED_METHODS = frozenset({"SendPrompt", "SendTensor", "SendExample"})
+
+
+def effective_timeout(method: str, request_id: str = "") -> float | None:
+  """Policy timeout; for forward-path methods, capped by the request's
+  remaining deadline budget. A request already out of budget gets a 50 ms
+  floor — enough to carry the wire frame, short enough that the doomed call
+  fails now, not at the policy timeout."""
+  t = rpc_timeout(method)
+  if method not in DEADLINE_CAPPED_METHODS:
+    return t
+  rem = deadline_remaining_s(request_id)
+  if rem is not None:
+    t = rem if t is None else min(t, rem)
+    t = max(t, 0.05)
+  return t
+
+
+def rpc_retries(method: str) -> int:
+  if method not in RETRYABLE_METHODS:
+    return 0
+  try:
+    return max(int(os.getenv("XOT_TPU_RPC_RETRIES", "2") or 2), 0)
+  except ValueError:
+    return 2
+
+
+def backoff_s(attempt: int, rng: random.Random | None = None) -> float:
+  """Full-jitter exponential backoff for retry ``attempt`` (1-based):
+  uniform in (0, min(base * 2^(attempt-1), cap)]."""
+  base = _env_f("XOT_TPU_RPC_RETRY_BASE_S", 0.05)
+  cap = _env_f("XOT_TPU_RPC_RETRY_MAX_S", 2.0)
+  span = min(base * (2 ** max(attempt - 1, 0)), cap)
+  r = (rng or _rng).random()
+  return span * max(r, 0.01)
+
+
+_rng = random.Random()
+
+
+class RetryBudget:
+  """Per-request retry allowance across all methods (LRU-bounded — the key
+  is request-scoped but a request that never finishes must age out)."""
+
+  MAX_ENTRIES = 4096
+
+  def __init__(self) -> None:
+    self._spent: "OrderedDict[str, int]" = OrderedDict()
+    self._lock = threading.Lock()
+
+  def take(self, request_id: str) -> bool:
+    """Charge one retry; False when the request's budget is exhausted.
+    Requests without an id (control broadcasts) are uncapped — their
+    per-call attempt count is the only bound."""
+    if not request_id:
+      return True
+    limit = int(_env_f("XOT_TPU_RPC_RETRY_BUDGET", 8))
+    with self._lock:
+      spent = self._spent.get(request_id, 0)
+      if spent >= limit:
+        return False
+      self._spent[request_id] = spent + 1
+      self._spent.move_to_end(request_id)
+      while len(self._spent) > self.MAX_ENTRIES:
+        self._spent.popitem(last=False)
+    return True
+
+  def forget(self, request_id: str) -> None:
+    with self._lock:
+      self._spent.pop(request_id, None)
+
+
+retry_budget = RetryBudget()
+
+
+class PeerCircuitOpenError(ConnectionError):
+  """Fail-fast refusal: the peer's circuit is open (recent consecutive
+  failures); the call was never attempted."""
+
+
+class CircuitBreaker:
+  def __init__(self, peer_id: str) -> None:
+    self.peer_id = peer_id
+    self.state = _CLOSED
+    self.failures = 0
+    self.opened_at = 0.0
+    self._lock = threading.Lock()
+
+  def _set_state(self, state: int) -> None:
+    self.state = state
+    metrics.set_gauge("peer_circuit_state", state, labels={"peer": self.peer_id})
+
+  def allow(self) -> bool:
+    """May a non-probe call proceed? Open circuits fail fast until the open
+    window lapses, then go half-open and let traffic through to probe."""
+    with self._lock:
+      if self.state != _OPEN:
+        return True
+      if time.monotonic() - self.opened_at >= _env_f("XOT_TPU_CB_OPEN_S", 10.0):
+        self._set_state(_HALF_OPEN)
+        return True
+      return False
+
+  def record_success(self) -> None:
+    with self._lock:
+      self.failures = 0
+      if self.state != _CLOSED:
+        self._set_state(_CLOSED)
+
+  def record_failure(self) -> None:
+    with self._lock:
+      self.failures += 1
+      threshold = max(int(_env_f("XOT_TPU_CB_FAILS", 5)), 1)
+      # A half-open probe failing re-opens immediately (fresh window).
+      if self.state == _HALF_OPEN or self.failures >= threshold:
+        self.opened_at = time.monotonic()
+        if self.state != _OPEN:
+          self._set_state(_OPEN)
+
+  @property
+  def is_open(self) -> bool:
+    return self.state == _OPEN
+
+
+class BreakerRegistry:
+  """Breakers keyed by (peer id, address): a restarted peer at a new address
+  starts with a fresh (closed) circuit; the same corpse keeps its open one."""
+
+  def __init__(self) -> None:
+    self._by_key: dict[tuple[str, str], CircuitBreaker] = {}
+    self._lock = threading.Lock()
+
+  def get(self, peer_id: str, address: str = "") -> CircuitBreaker:
+    key = (peer_id, address)
+    with self._lock:
+      b = self._by_key.get(key)
+      if b is None:
+        b = self._by_key[key] = CircuitBreaker(peer_id)
+      return b
+
+  def is_open(self, peer_id: str) -> bool:
+    with self._lock:
+      return any(b.is_open for (pid, _), b in self._by_key.items() if pid == peer_id)
+
+  def state(self, peer_id: str) -> int:
+    with self._lock:
+      states = [b.state for (pid, _), b in self._by_key.items() if pid == peer_id]
+    return max(states) if states else _CLOSED
+
+  def forget(self, peer_id: str) -> None:
+    with self._lock:
+      for key in [k for k in self._by_key if k[0] == peer_id]:
+        del self._by_key[key]
+    metrics.set_gauge("peer_circuit_state", _CLOSED, labels={"peer": peer_id})
+
+  def reset(self) -> None:
+    with self._lock:
+      self._by_key.clear()
+
+
+breakers = BreakerRegistry()
+
+
+class PeerHealth:
+  """Consecutive-HealthCheck-failure counter per peer (flap damping).
+  Recorded at the single choke point every discovery layer already calls —
+  ``GRPCPeerHandle.health_check`` — so the sweep logic just consults it."""
+
+  def __init__(self) -> None:
+    self._consecutive: dict[str, int] = {}
+    self._lock = threading.Lock()
+
+  def record(self, peer_id: str, ok: bool) -> None:
+    with self._lock:
+      if ok:
+        self._consecutive.pop(peer_id, None)
+      else:
+        self._consecutive[peer_id] = self._consecutive.get(peer_id, 0) + 1
+
+  def consecutive_failures(self, peer_id: str) -> int:
+    with self._lock:
+      return self._consecutive.get(peer_id, 0)
+
+  def is_dead(self, peer_id: str) -> bool:
+    """Dead = XOT_TPU_HEALTH_FAILS consecutive failures (default 3). A peer
+    with no recorded failures is healthy — stale-beacon eviction is a
+    separate, unchanged condition."""
+    k = max(int(_env_f("XOT_TPU_HEALTH_FAILS", 3)), 1)
+    return self.consecutive_failures(peer_id) >= k
+
+  def forget(self, peer_id: str) -> None:
+    with self._lock:
+      self._consecutive.pop(peer_id, None)
+
+  def reset(self) -> None:
+    with self._lock:
+      self._consecutive.clear()
+
+
+peer_health = PeerHealth()

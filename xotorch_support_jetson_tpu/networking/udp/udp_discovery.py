@@ -20,6 +20,7 @@ from ...topology.device_capabilities import DeviceCapabilities, UNKNOWN_DEVICE_C
 from ...utils.helpers import DEBUG_DISCOVERY, get_all_ip_addresses_and_interfaces, get_interface_priority_and_type
 from ..discovery import Discovery
 from ..peer_handle import PeerHandle
+from ..retry import peer_health
 
 
 class ListenProtocol(asyncio.DatagramProtocol):
@@ -202,13 +203,23 @@ class UDPDiscovery(Discovery):
         dead: list[str] = []
         for peer_id, (handle, connected_at, last_seen, *_rest) in list(self.known_peers.items()):
           stale = now - last_seen > self.discovery_timeout
-          if stale or not await handle.health_check():
+          # Flap damping (networking/retry.py): one failed health check
+          # (e.g. a 5 s GC stall on the peer) must NOT trigger eviction —
+          # and with it replay/repartition churn. The handle's health_check
+          # records the outcome centrally; eviction needs
+          # XOT_TPU_HEALTH_FAILS consecutive failures. The stale-beacon
+          # timeout short-circuits (same as before this layer existed): a
+          # stale peer is usually a dead one, and probing it would block
+          # the sweep for the full connect timeout per corpse.
+          if stale or (not await handle.health_check() and peer_health.is_dead(peer_id)):
             dead.append(peer_id)
         for peer_id in dead:
           entry = self.known_peers.pop(peer_id, None)
           if entry is not None:
             if DEBUG_DISCOVERY >= 1:
               print(f"[udp] evicting peer {peer_id}")
+            # Reset the damping state: a re-adopted incarnation starts fresh.
+            peer_health.forget(peer_id)
             try:
               await entry[0].disconnect()
             except Exception:  # noqa: BLE001

@@ -26,12 +26,13 @@ import uuid
 
 import numpy as np
 
-from ..inference.engine import InferenceEngine
+from ..inference.engine import InferenceEngine, RequestMigratedError
 from ..inference.kv_tier import prefix_registry
 from ..inference.shard import Shard
 from ..inference.state import InferenceState
 from ..networking.discovery import Discovery
 from ..networking.peer_handle import PeerHandle
+from ..networking.retry import breakers, peer_health
 from ..topology.device_capabilities import UNKNOWN_DEVICE_CAPABILITIES, device_capabilities
 from ..topology.partitioning import PartitioningStrategy, map_partitions_to_shards
 from ..topology.topology import Topology
@@ -51,6 +52,12 @@ RESPONSE_TIMEOUT_HORIZON_S = 900.0
 # stream force-flushes in position order: one LOST broadcast RPC then costs a
 # visible gap after a short stall instead of hanging the client forever.
 GAP_FLUSH_S = 5.0
+
+# How long a peer's "node_draining" announcement keeps it out of partition
+# maps before expiring: covers the drain window with margin, and bounds the
+# blast radius of a node that announced drain but then kept running (e.g. a
+# cancelled shutdown, or a restart reusing the id before re-announcing).
+DRAINING_TTL_S = 180.0
 
 
 class Node:
@@ -122,6 +129,27 @@ class Node:
     # Cluster prefix-registry pulls in flight: nonce -> [event, replies, expected].
     self._prefix_waiters: dict[str, list] = {}
 
+    # Fault-tolerance state (ISSUE 8). ``draining`` marks THIS node as
+    # shutting down (no new work; resident batched rows migrate);
+    # ``_draining_peers`` maps announced-draining peer ids to their expiry
+    # (they drop out of partition maps so no new work lands on them);
+    # ``_migrated`` holds per-request finish events for rows shipped to a
+    # surviving peer; ``_recovering`` tracks requests that entered replay or
+    # migration, counted as recovered when they still finish;
+    # ``_batched_shards`` remembers each batched request's base shard so a
+    # drain can re-route it.
+    self.draining = False
+    self._draining_peers: dict[str, float] = {}
+    self._migrated: dict[str, asyncio.Event] = {}
+    self._recovering: set[str] = set()
+    self._batched_shards: dict[str, Shard] = {}
+    # Monotonic time of the last peer LOSS (eviction of a removed peer).
+    # The stall watchdog's fault predicate needs this to stay truthful
+    # AFTER eviction: the damped eviction also forgets the dead peer's
+    # breaker/health state, so without a sticky loss mark a stall detected
+    # post-eviction would look "healthy" and hang to the response timeout.
+    self.last_peer_loss_ts: float | None = None
+
     self._on_token: AsyncCallbackSystem[str, str, list, bool] = AsyncCallbackSystem()
     self._on_opaque_status: AsyncCallbackSystem[str, str, str] = AsyncCallbackSystem()
     self._on_opaque_status.register("node_status").on_next(self.on_node_status)
@@ -150,6 +178,132 @@ class Node:
         pass
     await self.discovery.stop()
     await self.server.stop()
+
+  # ------------------------------------------------- graceful drain (ISSUE 8)
+
+  async def announce_shutdown(self) -> None:
+    """Tell every peer this node is draining: they drop it from partition
+    maps (no new work placed here) while keeping the peer handle alive for
+    in-flight traffic and migration RPCs."""
+    self.draining = True
+    await self.broadcast_opaque_status(
+      "", json.dumps({"type": "node_draining", "node_id": self.id})
+    )
+
+  async def graceful_drain(self, drain_s: float | None = None, force: asyncio.Event | None = None) -> None:
+    """SIGTERM path (main.py): stop taking new work, migrate the batched
+    scheduler's resident rows to a surviving peer via ``carry_tokens``
+    resume, and wait — up to the drain deadline — for outstanding work
+    (local rows that could not migrate finish locally; migrated streams
+    relay their remote tokens through this node's API). ``force`` (a second
+    signal) aborts the wait immediately. Does NOT stop the node: the
+    caller's shutdown sequence owns that."""
+    if drain_s is None:
+      try:
+        drain_s = float(os.getenv("XOT_TPU_DRAIN_S", "20") or 20)
+      except ValueError:
+        drain_s = 20.0
+    server = getattr(self.inference_engine, "_batched_server", None)
+    if server is not None and hasattr(server, "begin_drain"):
+      # Flag first (synchronous), THEN announce: the scheduler stops
+      # admitting in the same event-loop turn, so no row can slip in
+      # between the announcement and the drain gate. Migration is offered
+      # only when a survivor exists RIGHT NOW — on a single-node deployment
+      # extracting every row just to re-enqueue it locally would force a
+      # pointless full re-prefill per in-flight request.
+      _topo, parts = self._surviving_partitions()
+      server.begin_drain(self._migrate_batched_row if parts else None, deadline_s=drain_s)
+    await self.announce_shutdown()
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + drain_s
+    while loop.time() < deadline and not (force is not None and force.is_set()):
+      busy = bool(self.outstanding_requests) or bool(self._migrated)
+      if server is not None and hasattr(server, "busy"):
+        busy = busy or server.busy()
+      if not busy:
+        break
+      await asyncio.sleep(0.1)
+
+  def _surviving_partitions(self):
+    """Partition map over the topology EXCLUDING this (draining) node and
+    any peer that announced its own drain — where migrated work may land."""
+    topo = Topology()
+    for nid, caps in self.topology.nodes.items():
+      if nid == self.id or self._peer_draining(nid):
+        continue
+      topo.update_node(nid, caps)
+    if not topo.nodes:
+      return None, None
+    return topo, self.partitioning_strategy.partition(topo)
+
+  async def _migrate_batched_row(self, req) -> bool:
+    """Scheduler drain callback: re-submit one extracted batched row to a
+    surviving peer as a ``carry_tokens`` resume over the existing gRPC path
+    (``req.tokens`` is prompt ++ generated; the wire history keeps budget
+    and absolute stream positions exact, so the receiver's continuation is
+    token-identical and the origin's high-water dedup splices it seamlessly).
+    Returns False (the row finishes locally) when no survivor is reachable."""
+    request_id = req.request_id
+    base_shard = self._batched_shards.get(request_id)
+    if base_shard is None:
+      return False
+    _topo, partitions = self._surviving_partitions()
+    if not partitions:
+      return False
+    target_id = partitions[0].node_id  # the survivors' layer-0 owner
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is None:
+      return False
+    next_shard = map_partitions_to_shards(partitions, base_shard.n_layers, base_shard.model_id)[0]
+    tokens = np.asarray(req.tokens, dtype=np.int32).reshape(1, -1)
+    # The ORIGINAL prompt length keeps the receiver's max_tokens budget and
+    # absolute positions exact (req.tokens already absorbed the generated
+    # stream; carry_tokens is exactly that generated span).
+    orig_len = int(tokens.shape[1]) - len(req.carry_tokens)
+    epoch = self._seen_epochs.get(request_id, 0) + 1
+    self._seen_epochs[request_id] = epoch
+    state = InferenceState(
+      tokens=tokens.copy(), prompt_len=int(tokens.shape[1]),
+      extras={"replay_epoch": epoch, "orig_prompt_len": orig_len},
+    )
+    # Register the finish waiter BEFORE the forward: the remote finish
+    # broadcast must not race the registration.
+    self._migrated[request_id] = asyncio.Event()
+    self._recovering.add(request_id)
+    try:
+      await peer.send_tensor(next_shard, tokens, request_id, self._stash_options(request_id, state))
+    except asyncio.TimeoutError:
+      # The wait expired (a deadline-capped SendTensor) but the wire may
+      # have DELIVERED — the survivor could already be generating. Treating
+      # this as not-delivered would re-run the row locally: two generators
+      # racing the client stream (at-least-once; sampled streams corrupt).
+      # Prefer at-most-once: consider it shipped — if it was truly lost,
+      # the stall watchdog converts the silence into a structured
+      # retryable 503 instead of a corrupted transcript.
+      if DEBUG >= 1:
+        print(f"[node {self.id}] drain migration of {request_id}: send timed out after delivery window; assuming shipped")
+    except Exception:  # noqa: BLE001 — survivor unreachable: finish locally
+      self._migrated.pop(request_id, None)
+      self._recovering.discard(request_id)
+      if DEBUG >= 1:
+        print(f"[node {self.id}] drain migration of {request_id} to {target_id} failed")
+      return False
+    metrics.inc("drain_migrations_total")
+    tracer.stage(request_id, "migrated", {
+      "to": target_id, "carried_tokens": len(req.carry_tokens), "prompt_len": orig_len,
+    }, node=self.id)
+    if DEBUG >= 1:
+      print(f"[node {self.id}] migrated {request_id} to {target_id} ({len(req.carry_tokens)} tokens carried)")
+    return True
+
+  def _peer_draining(self, node_id: str) -> bool:
+    expiry = self._draining_peers.get(node_id)
+    if expiry is None:
+      return False
+    if time.monotonic() > expiry:
+      del self._draining_peers[node_id]
+      return False
+    return True
 
   # --------------------------------------------------------------- serving
 
@@ -385,14 +539,34 @@ class Node:
       asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished, start_pos=start))
 
     opts = self.request_options.get(request_id, {})
+    self._batched_shards[request_id] = base_shard
     try:
       await engine.get_batched_server().submit(
         request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
         priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
         deadline_ms=opts.get("deadline_ms"),
       )
+    except RequestMigratedError:
+      # A draining scheduler shipped the row to a surviving peer (graceful
+      # drain): the stream continues from there over the normal SendResult
+      # broadcast path (absolute positions pick up exactly where the local
+      # rows left off). Hold this handler open until the remote finish so
+      # the API's generation task lifecycle stays truthful.
+      await self._await_migrated(request_id)
     finally:
+      self._batched_shards.pop(request_id, None)
       self._finish_request(request_id)
+
+  async def _await_migrated(self, request_id: str) -> None:
+    event = self._migrated.get(request_id)
+    if event is None:
+      return
+    try:
+      await asyncio.wait_for(event.wait(), timeout=RESPONSE_TIMEOUT_HORIZON_S)
+    except asyncio.TimeoutError:
+      pass  # the API's own response timeout already fired long before this
+    finally:
+      self._migrated.pop(request_id, None)
 
   async def process_tensor(self, base_shard: Shard, tensor: np.ndarray, request_id: str, inference_state: InferenceState | None = None, wire_concrete: bool = False):
     # Sender-authoritative routing: forward_tensor ships the CONCRETE layer
@@ -541,6 +715,9 @@ class Node:
       return
     self._replay_attempts[request_id] = attempt + 1
     self._replay_lifetime[request_id] = lifetime + 1
+    # Entered recovery: counted as recovered iff it still reaches a finish
+    # event (requests_recovered_total — trigger_on_token_callbacks).
+    self._recovering.add(request_id)
     # Held through sleep + forward so concurrent reports no-op; try/finally
     # because a CancelledError (our caller is often a gRPC handler whose peer
     # can drop mid-replay) must not leave the id stuck in the gate.
@@ -713,6 +890,7 @@ class Node:
     loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self.cancelled_requests.discard, request_id)
     loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self._completion_offset.pop, request_id, None)
     loop.call_later(RESPONSE_TIMEOUT_HORIZON_S, self._seen_epochs.pop, request_id, None)
+    self._recovering.discard(request_id)  # a cancelled request never recovers
     self._expire_dedup_state(request_id)
 
   def _finish_request(self, request_id: str) -> None:
@@ -727,6 +905,11 @@ class Node:
     self._replay_attempts.pop(request_id, None)
     self._replay_lifetime.pop(request_id, None)
     self._replay_pending.discard(request_id)
+    # The recovered counter fires at the finish EVENT (trigger callbacks),
+    # which precedes this cleanup on every finishing path — discarding here
+    # only reaps ids whose request died without one (failed replay budget,
+    # teardown), which must not accumulate forever.
+    self._recovering.discard(request_id)
     self._expire_dedup_state(request_id)  # tombstoned against zombie broadcasts, not popped
     self._completion_offset.pop(request_id, None)
     self._seen_epochs.pop(request_id, None)
@@ -1210,6 +1393,13 @@ class Node:
       # Its prefix advertisement is equally stale (a restarted peer's pools
       # start empty); keep the registry's hints honest.
       prefix_registry.forget_remote(peer.id())
+      # Same for the fault-tolerance state: a departed peer's circuit and
+      # flap-damping counters describe the OLD incarnation — the next one
+      # (possibly at a new address) starts closed/healthy. Consistent with
+      # the clock-offset forget: all three happen at the damped eviction
+      # point, never on a single flapped health check.
+      breakers.forget(peer.id())
+      peer_health.forget(peer.id())
       try:
         await asyncio.wait_for(peer.disconnect(), timeout)
         return True
@@ -1231,6 +1421,16 @@ class Node:
       *(disconnect_with_timeout(p) for p in peers_to_disconnect),
       *(connect_with_timeout(p) for p in peers_to_connect),
     )
+    for p in peers_added:
+      # A newly (re)discovered peer is by definition serving again: clear
+      # any stale drain announcement from its previous incarnation.
+      self._draining_peers.pop(p.id(), None)
+    if any(not self._peer_draining(p.id()) for p in peers_removed):
+      # Sticky loss mark for the stall watchdog (see __init__): the dead
+      # peer's breaker/health state was just forgotten with its handles.
+      # Only UNPLANNED losses count — a peer that announced its drain left
+      # gracefully and must not put the watchdog on a hair trigger.
+      self.last_peer_loss_ts = time.monotonic()
     self.peers = peers_unchanged + peers_to_connect
     return bool(peers_added or peers_removed or peers_updated)
 
@@ -1271,6 +1471,13 @@ class Node:
     # next successful collect once it's actually back.
     for dead in unreachable:
       next_topology.nodes.pop(dead, None)
+    # Draining peers drop out of the partition map the same way (no new
+    # work lands on them) — their handles stay connected for in-flight
+    # traffic and drain migrations. A peer's merged view may still carry
+    # them as hearsay, so the removal runs after all merges, like eviction.
+    for nid in list(self._draining_peers):
+      if self._peer_draining(nid):
+        next_topology.nodes.pop(nid, None)
     next_topology.active_node_id = self.topology.active_node_id or self.id
     self.topology = next_topology
     if self.topology_viz:
@@ -1352,6 +1559,14 @@ class Node:
         rid = status_data.get("request_id", "")
         if rid:
           self._cancel_locally(rid)
+      elif status_type == "node_draining":
+        # A peer announced graceful shutdown: keep its handle (in-flight
+        # traffic and migrations still flow) but drop it from partition
+        # maps so no NEW work routes there. TTL-bounded: a node that
+        # announced but kept running re-enters the map after expiry.
+        nid = status_data.get("node_id")
+        if nid and nid != self.id:
+          self._draining_peers[nid] = time.monotonic() + DRAINING_TTL_S
       elif status_type in ("metrics_pull", "metrics_snapshot"):
         # Cluster-wide /metrics aggregation rides the same opaque channel.
         self._handle_metrics_status(status_data)
@@ -1410,6 +1625,14 @@ class Node:
         metrics.observe_hist("ttft_seconds", time.perf_counter() - t0)
     self._on_token.trigger_all(request_id, tokens, is_finished)
     if is_finished:
+      # A migrated row's remote finish releases its origin-side waiter; a
+      # replayed/migrated request that still finished counts as recovered.
+      event = self._migrated.get(request_id)
+      if event is not None:
+        event.set()
+      if request_id in self._recovering:
+        self._recovering.discard(request_id)
+        metrics.inc("requests_recovered_total")
       # Keep the high-water mark as a tombstone so a straggling zombie
       # broadcast can't reset it and re-deliver the stream; it expires on
       # the response-timeout horizon (origin nodes never run
