@@ -67,6 +67,17 @@ def gate_overload(shed_rate: float | None) -> float | None:
   return float(shed_rate) if 0.0 <= shed_rate <= 0.95 else None
 
 
+def gate_slo(fraction: float | None) -> float | None:
+  """Sanity-gate the overload round's SLO fractions (ISSUE 9: interactive
+  availability attainment and the goodput ratio — same drift-gate pattern).
+  Both are ratios of counter deltas from the same round, so honest values
+  live in [0, 1] exactly; outside means the delta went negative across a
+  registry reset or the round broke — drop it rather than record it."""
+  if fraction is None:
+    return None
+  return float(fraction) if 0.0 <= fraction <= 1.0 else None
+
+
 def gate_spec_batch(ratio: float | None) -> float | None:
   """Sanity-gate the batched-spec/plain aggregate A/B ratio (same drift-gate
   pattern as ``gate_lookahead``). Draft-then-verify multiplies tokens per
@@ -109,36 +120,29 @@ def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, 
   snapshots, aggregated across every label series (the per-peer-link RPC
   histograms are ``{peer,method}``-labeled; the bench wants the p50 over the
   whole ring, not one link). ``where`` keeps only series whose label set
-  contains those pairs (e.g. ``{"method": "SendResult"}``). Same
-  snapshot-delta isolation as the unlabeled ``_hist_delta_quantile``:
+  contains those pairs (e.g. ``{"method": "SendResult"}``). Delta math is
+  the shared ``utils/metrics.py snapshot_delta`` (ISSUE 9 satellite) — same
+  measured-round isolation as the unlabeled ``_hist_delta_quantile``:
   warm-up observations don't own the tail."""
+  from xotorch_support_jetson_tpu.utils.metrics import Metrics, snapshot_delta
+
   want = set((str(k), str(v)) for k, v in (where or {}).items())
-
-  def summed(snap: dict) -> tuple[list | None, list | None]:
-    series = (snap.get("labeled_histograms") or {}).get(name) or []
-    buckets: list | None = None
-    counts: list | None = None
-    for key, h in series:
-      if want and not want <= {tuple(kv) for kv in key}:
-        continue
-      if buckets is None:
-        buckets = list(h["buckets"])
-        counts = [0] * len(h["counts"])
-      if list(h["buckets"]) != buckets or len(h["counts"]) != len(counts):
-        continue  # foreign ladder: can't aggregate bucket-wise, skip series
-      for i, c in enumerate(h["counts"]):
-        counts[i] += int(c)
-    return buckets, counts
-
-  buckets, after_counts = summed(after)
+  series = (snapshot_delta(before, after).get("labeled_histograms") or {}).get(name) or []
+  buckets: list | None = None
+  counts: list | None = None
+  for key, h in series:
+    if want and not want <= {tuple(kv) for kv in key}:
+      continue
+    if buckets is None:
+      buckets = list(h["buckets"])
+      counts = [0] * len(h["counts"])
+    if list(h["buckets"]) != buckets or len(h["counts"]) != len(counts):
+      continue  # foreign ladder: can't aggregate bucket-wise, skip series
+    for i, c in enumerate(h["counts"]):
+      counts[i] += int(c)
   if buckets is None:
     return None
-  b_before, before_counts = summed(before)
-  comparable = b_before == buckets and before_counts is not None
-  delta = [a - (before_counts[i] if comparable else 0) for i, a in enumerate(after_counts)]
-  from xotorch_support_jetson_tpu.utils.metrics import Metrics
-
-  m = Metrics.merged([{"histograms": {name: {"buckets": buckets, "counts": delta, "sum": 0.0}}}])
+  m = Metrics.merged([{"histograms": {name: {"buckets": buckets, "counts": counts, "sum": 0.0}}}])
   return m.quantile(name, q)
 
 
@@ -635,15 +639,14 @@ def main() -> None:
     """Quantile of a histogram's growth BETWEEN two registry snapshots —
     isolates the measured round from warm-up observations (the scheduler
     records TTFT/ITL into the global registry on every round, and the warm
-    round's compile time would otherwise own the tail)."""
-    ha = (after.get("histograms") or {}).get(name)
-    if ha is None:
-      return None
-    hb = (before.get("histograms") or {}).get(name)
-    delta_counts = [int(a) - (int(hb["counts"][i]) if hb else 0) for i, a in enumerate(ha["counts"])]
-    from xotorch_support_jetson_tpu.utils.metrics import Metrics
+    round's compile time would otherwise own the tail). Delta math is the
+    shared ``utils/metrics.py snapshot_delta`` (ISSUE 9 satellite)."""
+    from xotorch_support_jetson_tpu.utils.metrics import Metrics, snapshot_delta
 
-    m = Metrics.merged([{"histograms": {name: {"buckets": ha["buckets"], "counts": delta_counts, "sum": 0.0}}}])
+    delta = snapshot_delta(before, after)
+    if name not in (delta.get("histograms") or {}):
+      return None
+    m = Metrics.merged([delta])
     return m.quantile(name, q)
 
   server = eng = None
@@ -722,7 +725,18 @@ def main() -> None:
   sched_host_gap_sync_ms_p50 = None
   lookahead48_aggregate_tok_s = None
   sync48_aggregate_tok_s = None
-  la_env = {"XOT_TPU_PAGED": os.environ.get("XOT_TPU_PAGED"), "XOT_TPU_KV_QUANT": os.environ.get("XOT_TPU_KV_QUANT")}
+  # Flight-recorder overhead (ISSUE 9): the same B=48 round with the
+  # recorder off (XOT_TPU_FLIGHTREC=0) pins that the hot path is unaffected
+  # — the recorder only sees state transitions (~2 events/request), so the
+  # on/off ratio must sit at ~1.0; events_per_sec documents the actual
+  # recording rate at the knee.
+  flightrec_events_per_sec = None
+  flightrec_overhead_ratio = None
+  la_env = {
+    "XOT_TPU_PAGED": os.environ.get("XOT_TPU_PAGED"),
+    "XOT_TPU_KV_QUANT": os.environ.get("XOT_TPU_KV_QUANT"),
+    "XOT_TPU_FLIGHTREC": os.environ.get("XOT_TPU_FLIGHTREC"),
+  }
   eng48 = server48 = None
   try:
     if not on_accel:  # A/B token-identity is pinned by tests/test_lookahead.py on CPU
@@ -763,20 +777,31 @@ def main() -> None:
         await one_round()  # warm the 48-row admission + chunk programs
         total = 0
         before = global_metrics.snapshot()
+        seq0 = _frec.last_seq()
         t0 = time.perf_counter()
         await one_round()
-        return total / (time.perf_counter() - t0), before, global_metrics.snapshot()
+        dt = time.perf_counter() - t0
+        return total / dt, before, global_metrics.snapshot(), (_frec.last_seq() - seq0) / dt
 
-      tok_s, before, after = asyncio.run(bench_round())
+      tok_s, before, after, ev_s = asyncio.run(bench_round())
       gap = _hist_delta_quantile(before, after, "sched_host_gap_seconds", 0.50)
       server48.shutdown()
       server48 = None
-      return round(tok_s, 2), (round(gap * 1e3, 3) if gap is not None else None)
+      return round(tok_s, 2), (round(gap * 1e3, 3) if gap is not None else None), round(ev_s, 2)
 
-    lookahead48_aggregate_tok_s, sched_host_gap_ms_p50 = _bench_sched("la", True)
-    sync48_aggregate_tok_s, sched_host_gap_sync_ms_p50 = _bench_sched("sy", False)
+    from xotorch_support_jetson_tpu.orchestration.flightrec import flightrec as _frec
+
+    lookahead48_aggregate_tok_s, sched_host_gap_ms_p50, flightrec_events_per_sec = _bench_sched("la", True)
+    sync48_aggregate_tok_s, sched_host_gap_sync_ms_p50, _ = _bench_sched("sy", False)
     if lookahead48_aggregate_tok_s and sync48_aggregate_tok_s:
       batch48_lookahead_vs_sync = gate_lookahead(round(lookahead48_aggregate_tok_s / sync48_aggregate_tok_s, 4))
+    # Recorder-off control run (same config as the lookahead run). The
+    # caller's XOT_TPU_FLIGHTREC is restored by the la_env finally below,
+    # raise or not.
+    os.environ["XOT_TPU_FLIGHTREC"] = "0"
+    frec_off_tok_s, _, _ = _bench_sched("fr", True)
+    if lookahead48_aggregate_tok_s and frec_off_tok_s:
+      flightrec_overhead_ratio = gate_lookahead(round(lookahead48_aggregate_tok_s / frec_off_tok_s, 4))
   except Exception:  # noqa: BLE001 — optional section: keep the bench line printing
     pass
   finally:
@@ -798,6 +823,8 @@ def main() -> None:
   overload_shed_rate = None
   ttft_ms_p99_interactive_overload = None
   ttft_ms_p99_batch_overload = None
+  slo_attainment_interactive = None
+  goodput_ratio = None
   ov_server = ov_eng = None
   try:
     if not on_accel:
@@ -807,6 +834,7 @@ def main() -> None:
     from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
     from xotorch_support_jetson_tpu.inference.engine import ServerOverloadedError
     from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+    from xotorch_support_jetson_tpu.utils.metrics import metrics as global_metrics, snapshot_delta as _snap_delta
 
     ov_eng = JaxShardedInferenceEngine(use_local_mesh=False)
     ov_eng.load_test_model(shard, cfg, qp)
@@ -844,8 +872,24 @@ def main() -> None:
       await asyncio.gather(*tasks)
       return waits, shed
 
+    ov_before = global_metrics.snapshot()
     waits_ov, shed_ov = asyncio.run(overload_round())
     overload_shed_rate = gate_overload(round(shed_ov / offered, 4))
+    # SLO/goodput read of the same round (ISSUE 9): the engine's own window
+    # math over the round's snapshot delta — interactive attainment under
+    # 2x overload (the router's per-replica health signal) and the
+    # goodput-to-delivered token ratio across all classes.
+    from xotorch_support_jetson_tpu.orchestration import slo as _slo
+
+    ov_delta = _snap_delta(ov_before, global_metrics.snapshot())
+    att_num = _slo.counter_family(ov_delta, "slo_requests_good_total", {"class": "interactive"})
+    att_den = att_num + _slo.counter_family(ov_delta, "slo_requests_bad_total", {"class": "interactive"})
+    if att_den > 0:
+      slo_attainment_interactive = gate_slo(round(att_num / att_den, 4))
+    tok_total = _slo.counter_family(ov_delta, "slo_tokens_total")
+    tok_good = _slo.counter_family(ov_delta, "slo_good_tokens_total")
+    if tok_total > 0:
+      goodput_ratio = gate_slo(round(tok_good / tok_total, 4))
 
     def p99(xs):
       # Nearest-rank p99: ceil(0.99 n) - 1. At this round's sample counts
@@ -1475,6 +1519,10 @@ def main() -> None:
         "overload_shed_rate": overload_shed_rate,
         "ttft_ms_p99_interactive_overload": ttft_ms_p99_interactive_overload,
         "ttft_ms_p99_batch_overload": ttft_ms_p99_batch_overload,
+        "slo_attainment_interactive": slo_attainment_interactive,
+        "goodput_ratio": goodput_ratio,
+        "flightrec_events_per_sec": flightrec_events_per_sec,
+        "flightrec_overhead_ratio": flightrec_overhead_ratio,
         "kv_spill_gbps": kv_spill_gbps,
         "kv_restore_gbps": kv_restore_gbps,
         "open_sessions_per_node": open_sessions_per_node,

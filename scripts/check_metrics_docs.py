@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Metric-exposition drift gate (ISSUE 9 satellite).
+
+Every metric family registered in the serving code MUST appear in both
+contracts that document it:
+
+  1. ``tests/test_observability.py EXPECTED_METRIC_NAMES`` — the frozen
+     exposition snapshot dashboards/alerts pin against;
+  2. the README's Observability metric tables — the operator-facing docs.
+
+The two drifted apart silently twice across PRs 5-8 (a family landed in
+code and the snapshot but not the README, and vice versa); this script
+makes the drift a tier-1 failure (tests/test_metrics_docs.py runs it).
+
+Scanning is lexical on purpose: registrations are string literals at their
+call sites (``metrics.inc("name")`` / ``set_gauge`` / ``observe_hist`` /
+``observe_latency``), so a regex over the package source finds exactly the
+families the process can emit without importing (or executing) anything.
+``observe_latency``/``timer`` families render with a ``_seconds`` suffix;
+the rest render verbatim under the ``xot_tpu_`` prefix.
+
+Exit status: 0 clean, 1 with a report of every missing entry.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "xotorch_support_jetson_tpu"
+README = REPO / "README.md"
+SNAPSHOT = REPO / "tests" / "test_observability.py"
+
+# metrics.inc("x") / gm.set_gauge('y') / metrics.observe_hist("z", ...
+_REG_RE = re.compile(
+  r"""\.(?P<kind>inc|set_gauge|observe_hist|observe_latency|hist_timer|timer)\(\s*(?P<q>["'])(?P<name>[a-z0-9_]+)(?P=q)"""
+)
+# The conditional-name form: observe_hist("a" if flag else "b", ...) — the
+# main regex sees "a"; this one collects the else-branch literal.
+_REG_ELSE_RE = re.compile(
+  r"""\.(?:inc|set_gauge|observe_hist|observe_latency)\(\s*["'][a-z0-9_]+["']\s+if\s+[^,]+?\s+else\s+(?P<q>["'])(?P<name>[a-z0-9_]+)(?P=q)"""
+)
+
+
+def registered_families(package: Path = PACKAGE) -> set[str]:
+  """Every metric family the package source can emit, in exposition form
+  (``xot_tpu_*``)."""
+  out: set[str] = set()
+  for path in sorted(package.rglob("*.py")):
+    if path.name == "metrics.py":
+      continue  # the registry's own internals re-pass caller-supplied names
+    text = path.read_text()
+    for m in _REG_RE.finditer(text):
+      name = m.group("name")
+      if m.group("kind") in ("observe_latency", "timer"):
+        name += "_seconds"
+      out.add(f"xot_tpu_{name}")
+    for m in _REG_ELSE_RE.finditer(text):
+      out.add(f"xot_tpu_{m.group('name')}")
+  return out
+
+
+def expected_names(snapshot: Path = SNAPSHOT) -> set[str]:
+  """EXPECTED_METRIC_NAMES parsed lexically from the test module (importing
+  it would require the test environment; the set is a literal)."""
+  text = snapshot.read_text()
+  m = re.search(r"EXPECTED_METRIC_NAMES\s*=\s*\{(.*?)\n\}", text, re.DOTALL)
+  if not m:
+    raise SystemExit(f"could not find EXPECTED_METRIC_NAMES in {snapshot}")
+  return set(re.findall(r'"(xot_tpu_[a-z0-9_]+)"', m.group(1)))
+
+
+def readme_names(readme: Path = README) -> set[str]:
+  """Full metric names in the README, with the doc's slash-shorthand
+  expanded: ``xot_tpu_page_pool_pages_total / `_free` / `_cached```
+  documents three families — a ``_x_y`` continuation replaces the last
+  len(segments) segments of the most recent full name on the line."""
+  out: set[str] = set()
+  token_re = re.compile(r"(xot_tpu_[a-z0-9_]+)|(?<![a-z0-9_])(_[a-z0-9_]+)")
+  for line in readme.read_text().splitlines():
+    base: str | None = None
+    for m in token_re.finditer(line):
+      if m.group(1):
+        base = m.group(1)
+        out.add(base)
+      elif base is not None:
+        suffix_segs = m.group(2).lstrip("_").split("_")
+        base_segs = base.split("_")
+        if len(suffix_segs) < len(base_segs) - 2:  # keep at least xot_tpu_
+          base = "_".join(base_segs[: len(base_segs) - len(suffix_segs)] + suffix_segs)
+          out.add(base)
+  return out
+
+
+def check() -> list[str]:
+  """Returns a list of human-readable problems (empty = clean)."""
+  registered = registered_families()
+  expected = expected_names()
+  readme = readme_names()
+  problems: list[str] = []
+  missing_snapshot = sorted(registered - expected)
+  if missing_snapshot:
+    problems.append(
+      "registered in code but missing from tests/test_observability.py "
+      f"EXPECTED_METRIC_NAMES: {missing_snapshot}"
+    )
+  missing_readme = sorted(registered - readme)
+  if missing_readme:
+    problems.append(f"registered in code but missing from the README metric docs: {missing_readme}")
+  # The reverse direction: a frozen name no code path can emit any more is
+  # a silent rename — the exact drift this gate exists to catch.
+  stale = sorted(expected - registered)
+  if stale:
+    problems.append(
+      "in EXPECTED_METRIC_NAMES but no longer registered anywhere in the "
+      f"package source (renamed or removed?): {stale}"
+    )
+  return problems
+
+
+def main() -> int:
+  problems = check()
+  if problems:
+    print("check_metrics_docs: FAIL")
+    for p in problems:
+      print(f"  - {p}")
+    return 1
+  print(f"check_metrics_docs: OK ({len(registered_families())} families, snapshot and README agree)")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -18,6 +18,9 @@ if "xla_force_host_platform_device_count" not in _flags:
   os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("XOT_TPU_UUID", "test-node-id")
 os.environ.setdefault("HF_HUB_OFFLINE", "1")  # no egress in CI; fail fast
+# Incident auto-captures (ISSUE 9: stall watchdog / anomaly watchers inside
+# cluster tests) must never write into the real $XOT_HOME from CI.
+os.environ.setdefault("XOT_TPU_BUNDLE_DIR", "/tmp/xot-test-bundles")
 
 # The axon TPU plugin in this image overrides JAX_PLATFORMS at import time;
 # the config API still wins, so force the CPU backend explicitly.

@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_spec_batch, plausible_value
+from bench import gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_slo, gate_spec_batch, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -89,6 +89,18 @@ def test_overload_gate_keeps_plausible_shed_rates():
   assert gate_overload(0.0) == 0.0
   assert gate_overload(0.25) == 0.25
   assert gate_overload(0.9) == 0.9
+
+
+def test_slo_gate_keeps_fractions_and_drops_artifacts():
+  """ISSUE 9: attainment and goodput ratio are counter-delta fractions —
+  [0, 1] exactly (1.0 is a legitimately perfect round and must survive the
+  gate); outside means the delta went negative across a registry reset."""
+  assert gate_slo(0.0) == 0.0
+  assert gate_slo(0.97) == 0.97
+  assert gate_slo(1.0) == 1.0
+  assert gate_slo(1.2) is None
+  assert gate_slo(-0.1) is None
+  assert gate_slo(None) is None
 
 
 def test_failover_gate_keeps_plausible_recoveries():

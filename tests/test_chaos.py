@@ -418,6 +418,11 @@ async def test_stall_watchdog_returns_structured_retryable_503(monkeypatch):
   client = TestClient(TestServer(api.app))
   await client.start_server()
   stalled_before = gm.counter_value("requests_stalled_total")
+  # ISSUE 9: the stall trigger auto-captures a rate-limited incident bundle.
+  from xotorch_support_jetson_tpu.orchestration.flightrec import bundles
+
+  bundles.reset()
+  bundle_before = gm.counter_value("incident_bundles_total", labels={"trigger": "stall"})
   try:
     t0 = time.perf_counter()
     resp = await client.post(
@@ -434,6 +439,9 @@ async def test_stall_watchdog_returns_structured_retryable_503(monkeypatch):
     # Detection inside 2x the stall bound (plus scheduling slack).
     assert elapsed < 2 * stall_bound_s + 1.0, f"stall detected too late: {elapsed:.2f}s"
     assert gm.counter_value("requests_stalled_total") > stalled_before
+    # The watchdog asked for an incident bundle at trigger time (the write
+    # itself is async + rate-limited; the charge is synchronous).
+    assert gm.counter_value("incident_bundles_total", labels={"trigger": "stall"}) == bundle_before + 1
   finally:
     await client.close()
     await node.stop()

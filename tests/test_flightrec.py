@@ -1,0 +1,350 @@
+"""Flight recorder, anomaly watchers, and incident bundles (ISSUE 9).
+
+Covers: the wide-event ring (bounds, seq, causal-order query filters), the
+off switch (XOT_TPU_FLIGHTREC=0 records NOTHING — the byte-identical-off
+contract), the tracer stage choke-point bridge, breaker/health transition
+hooks, every anomaly rule with its cooldown, local bundle assembly, the
+auto-capture rate limit, and the /v1/events + /v1/debug/bundle endpoints.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from xotorch_support_jetson_tpu.networking.retry import CircuitBreaker, PeerHealth, breakers, peer_health
+from xotorch_support_jetson_tpu.orchestration.flightrec import (
+  AnomalyWatchers,
+  BundleManager,
+  FlightRecorder,
+  assemble_local_bundle,
+  bundles,
+  flightrec,
+)
+from xotorch_support_jetson_tpu.orchestration.tracing import Tracer, tracer
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+  flightrec.clear()
+  bundles.reset()
+  breakers.reset()
+  peer_health.reset()
+  yield
+  flightrec.clear()
+  bundles.reset()
+  breakers.reset()
+  peer_health.reset()
+
+
+# ------------------------------------------------------------------- the ring
+
+
+def test_ring_bounds_seq_and_query_filters():
+  rec = FlightRecorder(capacity=64)
+  for i in range(80):
+    rec.record("admitted" if i % 2 == 0 else "shed", request_id=f"r{i}", peer=f"p{i % 3}")
+  assert len(rec) == 64  # bounded: oldest 16 rotated out
+  assert rec.last_seq() == 80
+  events = rec.recent(1000)
+  assert [e["seq"] for e in events] == list(range(17, 81))  # causal order, oldest-first
+  # Type filter.
+  sheds = rec.query(types={"shed"}, limit=1000)
+  assert sheds and all(e["type"] == "shed" for e in sheds)
+  # Request filter.
+  assert [e["request_id"] for e in rec.query(request_id="r50")] == ["r50"]
+  # Peer filter + newest-N cap keeps the TAIL.
+  p0 = rec.query(peer="p0", limit=3)
+  assert len(p0) == 3 and p0[-1]["seq"] == 79  # i=78 is p0; seq = i+1
+  # min_seq filter.
+  assert all(e["seq"] >= 75 for e in rec.query(min_seq=75, limit=1000))
+  # since_s: everything is fresh, a 0-second window excludes all.
+  assert rec.query(since_s=0.0) == []
+  assert len(rec.query(since_s=3600.0, limit=1000)) == 64
+
+
+def test_disabled_records_nothing(monkeypatch):
+  """XOT_TPU_FLIGHTREC=0: record() returns before touching the ring — the
+  repo's byte-identical-off pattern."""
+  monkeypatch.setenv("XOT_TPU_FLIGHTREC", "0")
+  rec = FlightRecorder(capacity=16)
+  assert rec.enabled is False
+  assert rec.record("admitted", request_id="r1") is None
+  assert len(rec) == 0
+  monkeypatch.delenv("XOT_TPU_FLIGHTREC")
+  assert rec.enabled is True
+  assert rec.record("admitted", request_id="r1") is not None
+
+
+def test_events_count_into_metrics():
+  before = gm.counter_value("flightrec_events_total", labels={"type": "parked"})
+  flightrec.record("parked", request_id="r-m")
+  assert gm.counter_value("flightrec_events_total", labels={"type": "parked"}) == before + 1
+
+
+# ------------------------------------------------------- tracer stage bridge
+
+
+def test_stage_choke_point_forwards_consequential_stages():
+  t = Tracer()
+  seq0 = flightrec.last_seq()
+  t.stage("r-b", "queued")  # traffic, not a transition: NOT recorded
+  t.stage("r-b", "admitted", {"class": "interactive"})
+  t.stage("r-b", "preempted", {"row": 1})
+  t.stage("r-b", "shed", {"reason": "overload", "class": "batch"}, terminal=True)
+  evs = flightrec.query(request_id="r-b", min_seq=seq0 + 1, limit=100)
+  assert [e["type"] for e in evs] == ["admitted", "preempted", "shed"]
+  assert evs[2]["cause"] == "overload"
+  # The terminal refusal fed SLO availability via the same hook.
+  assert gm.counter_value("slo_requests_bad_total", labels={"class": "batch", "reason": "shed"}) >= 1
+
+
+def test_end_request_records_complete_event():
+  t = Tracer()
+  t.stage("r-c", "queued")
+  seq0 = flightrec.last_seq()
+  t.end_request("r-c")
+  evs = flightrec.query(request_id="r-c", min_seq=seq0 + 1)
+  assert [e["type"] for e in evs] == ["complete"]
+  assert t.timeline("r-c")["terminal"] == "complete"
+  # A second end_request must not double-classify.
+  t.end_request("r-c")
+  assert len(flightrec.query(request_id="r-c", types={"complete"}, limit=10)) == 1
+
+
+def test_terminal_first_writer_wins():
+  t = Tracer()
+  t.stage("r-t", "queued")
+  t.stage("r-t", "shed", {"reason": "deadline", "class": "standard"}, terminal=True)
+  t.end_request("r-t")  # later end_request is a no-op on the classification
+  tl = t.timeline("r-t")
+  assert tl["terminal"] == "shed" and tl["finished"]
+  assert flightrec.query(request_id="r-t", types={"complete"}) == []
+
+
+# ------------------------------------------------- breaker / health hooks
+
+
+def test_breaker_transitions_recorded(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_CB_FAILS", "2")
+  monkeypatch.setenv("XOT_TPU_CB_OPEN_S", "0.01")
+  b = CircuitBreaker("peer-x")
+  b.record_failure()
+  b.record_failure()  # -> open
+  time.sleep(0.02)
+  assert b.allow()  # -> half_open
+  b.record_success()  # -> closed
+  types = [e["type"] for e in flightrec.query(peer="peer-x", limit=10)]
+  assert types == ["breaker_open", "breaker_half_open", "breaker_close"]
+
+
+def test_health_damping_death_and_recovery_recorded(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_HEALTH_FAILS", "3")
+  h = PeerHealth()
+  for _ in range(5):
+    h.record("peer-y", ok=False)
+  h.record("peer-y", ok=True)
+  evs = flightrec.query(peer="peer-y", limit=10)
+  # Exactly the crossings — never one event per probe.
+  assert [e["type"] for e in evs] == ["peer_dead", "peer_recovered"]
+  assert evs[0]["attributes"]["consecutive_failures"] == 3
+
+
+# ----------------------------------------------------------- anomaly watchers
+
+
+def _no_bundle(monkeypatch):
+  """Watcher tests must not write bundles to disk."""
+  monkeypatch.setattr(bundles, "auto_capture", lambda *a, **k: False)
+
+
+def test_breaker_flap_rule_and_cooldown(monkeypatch):
+  _no_bundle(monkeypatch)
+  w = AnomalyWatchers()
+  for _ in range(3):
+    flightrec.record("breaker_open", peer="flappy")
+  fired = w.check({}, 1.0)
+  assert [e["cause"] for e in fired] == ["breaker_flap"]
+  assert fired[0]["attributes"]["peer"] == "flappy"
+  # Cooldown: an immediate re-check stays quiet even though the condition holds.
+  assert w.check({}, 1.0) == []
+
+
+def test_spec_collapse_and_thrash_rules(monkeypatch):
+  _no_bundle(monkeypatch)
+  w = AnomalyWatchers()
+  delta = {
+    "counters": {
+      "spec_proposed_tokens_total": 1000.0,
+      "spec_accepted_tokens_total": 50.0,  # 5% acceptance — collapse
+      "page_grow_events_total": 400.0,
+      "page_release_events_total": 400.0,  # 800 events over 2 s = thrash
+    }
+  }
+  fired = w.check(delta, 2.0)
+  assert sorted(e["cause"] for e in fired) == ["page_pool_thrash", "spec_acceptance_collapse"]
+  rates = {e["cause"]: e["attributes"] for e in fired}
+  assert rates["spec_acceptance_collapse"]["rate"] == 0.05
+  assert rates["page_pool_thrash"]["events_per_s"] == 400.0
+
+
+def test_burn_rate_rule_reads_slo_report(monkeypatch):
+  _no_bundle(monkeypatch)
+  w = AnomalyWatchers()
+  report = {"windows_s": [300, 3600], "classes": {"interactive": {"windows": {
+    # The slow window still burns (an old outage) but must NOT re-alert —
+    # only the fast window's burn fires the rule.
+    "300": {"ttft": {"burn_rate": 14.2}, "itl": {"burn_rate": None}, "availability": {"burn_rate": 1.0}},
+    "3600": {"ttft": {"burn_rate": 99.0}, "itl": {"burn_rate": None}, "availability": {"burn_rate": 50.0}},
+  }}}}
+  fired = w.check({}, 1.0, report=report)
+  assert [e["cause"] for e in fired] == ["burn_rate"]
+  assert fired[0]["attributes"]["burn_rate"] == 14.2  # the FAST window's, not 99
+  assert fired[0]["attributes"]["objective"] == "ttft"
+  assert fired[0]["attributes"]["window_s"] == "300"
+
+
+def test_clock_jump_rule(monkeypatch):
+  _no_bundle(monkeypatch)
+  w = AnomalyWatchers()
+  d1 = {"labeled_gauges": {"peer_clock_offset_ms": [[[["peer", "n1"]], 2.0]]}}
+  d2 = {"labeled_gauges": {"peer_clock_offset_ms": [[[["peer", "n1"]], 250.0]]}}
+  assert w.check(d1, 1.0) == []  # first sighting establishes the baseline
+  fired = w.check(d2, 1.0)
+  assert [e["cause"] for e in fired] == ["clock_jump"]
+  assert fired[0]["attributes"]["jump_ms"] == 248.0
+
+
+def test_watchers_disabled_with_recorder_off(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_FLIGHTREC", "0")
+  w = AnomalyWatchers()
+  for _ in range(5):
+    flightrec.record("breaker_open", peer="flappy")  # no-ops anyway
+  assert w.check({}, 1.0) == []
+
+
+# ----------------------------------------------------------- incident bundles
+
+
+def test_local_bundle_sections():
+  flightrec.record("admitted", request_id="r-bu")
+  tracer.stage("r-bu-live", "queued")  # an in-flight timeline to capture
+  b = assemble_local_bundle(None, reason="unit")
+  assert b["reason"] == "unit"
+  for section in ("metrics", "events", "breakers", "peer_health", "clock_offsets", "chaos", "slo", "inflight_timelines", "config"):
+    assert section in b, section
+  assert any(e["type"] == "admitted" and e["request_id"] == "r-bu" for e in b["events"])
+  assert any(tl["request_id"] == "r-bu-live" for tl in b["inflight_timelines"])
+  assert "env_sha" in b["config"]
+  json.dumps(b)  # the whole artifact must be JSON-safe (it rides the wire)
+
+
+def test_bundle_rate_limit_and_disk_write(tmp_path, monkeypatch):
+  monkeypatch.setenv("XOT_TPU_BUNDLE_DIR", str(tmp_path))
+  monkeypatch.setenv("XOT_TPU_BUNDLE_MIN_INTERVAL_S", "3600")
+  mgr = BundleManager()
+
+  async def run():
+    assert mgr.auto_capture("stall") is True
+    # Inside the rate-limit window: refused, no second capture.
+    assert mgr.auto_capture("stall") is False
+    await asyncio.sleep(0.05)  # let the capture task write
+
+  asyncio.run(run())
+  files = list(tmp_path.glob("bundle-*-stall.json"))
+  assert len(files) == 1
+  saved = json.loads(files[0].read_text())
+  assert saved["reason"] == "stall"
+  # The capture itself landed in the ring.
+  assert any(e["type"] == "bundle_captured" and e["cause"] == "stall" for e in flightrec.recent(50))
+
+
+def test_auto_capture_disabled_with_recorder_off(tmp_path, monkeypatch):
+  monkeypatch.setenv("XOT_TPU_BUNDLE_DIR", str(tmp_path))
+  monkeypatch.setenv("XOT_TPU_FLIGHTREC", "0")
+  mgr = BundleManager()
+  assert mgr.auto_capture("stall") is False
+  assert list(tmp_path.glob("*.json")) == []
+
+
+# ------------------------------------------------------------- API endpoints
+
+
+async def _make_api():
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from tests_support_stubs import NoDiscovery, StubServer
+
+  node = Node(
+    "ev-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=50,
+  )
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, client
+
+
+@pytest.mark.asyncio
+async def test_events_endpoint_filters_and_hardening():
+  node, client = await _make_api()
+  try:
+    flightrec.record("admitted", request_id="r-api")
+    flightrec.record("shed", request_id="r-api", cause="overload")
+    flightrec.record("breaker_open", peer="p9")
+    resp = await client.get("/v1/events")
+    data = await resp.json()
+    assert resp.status == 200 and data["enabled"] is True
+    types = [e["type"] for e in data["events"]]
+    assert "admitted" in types and "breaker_open" in types
+    resp = await client.get("/v1/events?type=shed,breaker_open&n=10")
+    data = await resp.json()
+    assert {e["type"] for e in data["events"]} <= {"shed", "breaker_open"}
+    resp = await client.get("/v1/events?request_id=r-api")
+    data = await resp.json()
+    assert all(e["request_id"] == "r-api" for e in data["events"])
+    resp = await client.get("/v1/events?n=nope")
+    assert resp.status == 400
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_events_endpoint_disabled(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_FLIGHTREC", "0")
+  node, client = await _make_api()
+  try:
+    resp = await client.get("/v1/events")
+    data = await resp.json()
+    assert resp.status == 200 and data["enabled"] is False
+  finally:
+    await client.close()
+    await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_debug_bundle_endpoint_local_and_saved(tmp_path, monkeypatch):
+  monkeypatch.setenv("XOT_TPU_BUNDLE_DIR", str(tmp_path))
+  node, client = await _make_api()
+  try:
+    flightrec.record("stalled", request_id="r-inc")
+    resp = await client.post("/v1/debug/bundle", json={"scope": "local", "reason": "drill", "save": True})
+    data = await resp.json()
+    assert resp.status == 200
+    assert data["reason"] == "drill" and data["node_id"] == "ev-node"
+    assert any(e["type"] == "stalled" for e in data["events"])
+    assert data["saved_to"] and list(tmp_path.glob("bundle-*-drill.json"))
+    # Cluster scope with no peers: one part, nothing unreachable, no hang.
+    resp = await client.post("/v1/debug/bundle", json={"reason": "drill2"})
+    data = await resp.json()
+    assert data["scope"] == "cluster" and data["nodes_reporting"] == 1 and data["nodes_unreachable"] == []
+  finally:
+    await client.close()
+    await node.stop()

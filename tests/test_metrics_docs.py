@@ -1,0 +1,35 @@
+"""Tier-1 wiring for scripts/check_metrics_docs.py (ISSUE 9 satellite).
+
+Every metric family registered in the package source must appear in BOTH
+documentation contracts — tests/test_observability.py EXPECTED_METRIC_NAMES
+and the README metric docs — and every frozen name must still be
+registered. The three drifted apart silently twice across PRs 5-8; this
+makes the drift a test failure with the script's full report as the
+message."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_metric_families_match_docs():
+  sys.path.insert(0, str(REPO / "scripts"))
+  try:
+    import check_metrics_docs
+  finally:
+    sys.path.pop(0)
+  problems = check_metrics_docs.check()
+  assert not problems, "metric exposition drifted from its docs:\n" + "\n".join(f"  - {p}" for p in problems)
+
+
+def test_checker_cli_exit_status():
+  """The script is also a standalone CI gate — pin the exit-status contract
+  (0 clean with a summary line; the check itself is pinned above)."""
+  proc = subprocess.run(
+    [sys.executable, str(REPO / "scripts" / "check_metrics_docs.py")],
+    capture_output=True, text=True, timeout=60,
+  )
+  assert proc.returncode == 0, proc.stdout + proc.stderr
+  assert "check_metrics_docs: OK" in proc.stdout

@@ -699,6 +699,14 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_drain_migrations_total",
   "xot_tpu_requests_recovered_total",
   "xot_tpu_requests_stalled_total",
+  # SLO engine + flight recorder (ISSUE 9)
+  "xot_tpu_slo_requests_good_total",  # {class}
+  "xot_tpu_slo_requests_bad_total",  # {class,reason}
+  "xot_tpu_slo_tokens_total",  # {class,tenant}
+  "xot_tpu_slo_good_tokens_total",  # {class,tenant}
+  "xot_tpu_flightrec_events_total",  # {type}
+  "xot_tpu_anomalies_total",  # {rule}
+  "xot_tpu_incident_bundles_total",  # {trigger}
   # gauges
   "xot_tpu_scheduler_batch_occupancy",
   "xot_tpu_scheduler_queue_depth",
@@ -721,9 +729,15 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_peer_clock_offset_ms",
   "xot_tpu_peer_clock_uncertainty_ms",
   "xot_tpu_peer_circuit_state",
+  "xot_tpu_cluster_nodes_reporting",
+  "xot_tpu_slo_burn_rate",  # {class,window}
+  "xot_tpu_slo_attainment",  # {class}
+  "xot_tpu_goodput_tok_s",  # {class}
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
+  "xot_tpu_qos_ttft_seconds",  # {class} (ISSUE 9 — the SLO engine's windows)
+  "xot_tpu_qos_itl_seconds",  # {class}
   "xot_tpu_queue_wait_seconds",
   "xot_tpu_prefill_chunk_seconds",
   "xot_tpu_decode_chunk_seconds",
@@ -816,6 +830,22 @@ def test_metric_name_snapshot_after_serving():
   gm.inc("requests_recovered_total", 0)
   gm.inc("requests_stalled_total", 0)
   gm.set_gauge("peer_circuit_state", 0, labels={"peer": "peer-0"})
+  # SLO engine + flight recorder (ISSUE 9): families emitted by the SLO
+  # accounting hooks / tick and the recorder — materialized at zero when the
+  # drive above ran with the engines quiet.
+  gm.inc("slo_requests_good_total", 0, labels={"class": "standard"})
+  gm.inc("slo_requests_bad_total", 0, labels={"class": "standard", "reason": "shed"})
+  gm.inc("slo_tokens_total", 0, labels={"class": "standard", "tenant": "default"})
+  gm.inc("slo_good_tokens_total", 0, labels={"class": "standard", "tenant": "default"})
+  gm.inc("flightrec_events_total", 0, labels={"type": "admitted"})
+  gm.inc("anomalies_total", 0, labels={"rule": "burn_rate"})
+  gm.inc("incident_bundles_total", 0, labels={"trigger": "stall"})
+  gm.set_gauge("cluster_nodes_reporting", 1)
+  gm.set_gauge("slo_burn_rate", 0.0, labels={"class": "standard", "window": "300s"})
+  gm.set_gauge("slo_attainment", 1.0, labels={"class": "standard"})
+  gm.set_gauge("goodput_tok_s", 0.0, labels={"class": "standard"})
+  gm.observe_hist("qos_ttft_seconds", 0.0, labels={"class": "standard"})
+  gm.observe_hist("qos_itl_seconds", 0.0, labels={"class": "standard"})
   text = gm.render_prometheus()
   families = set(re.findall(r"# TYPE (xot_tpu_[a-z0-9_]+) \w+", text))
   missing = EXPECTED_METRIC_NAMES - families

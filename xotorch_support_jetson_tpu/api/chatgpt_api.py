@@ -372,6 +372,9 @@ class ChatGPTAPI:
     r.add_get("/v1/traces", self.handle_traces)
     r.add_get("/v1/requests/{request_id}/timeline", self.handle_request_timeline)
     r.add_get("/v1/kv/tier", self.handle_kv_tier)
+    r.add_get("/v1/slo", self.handle_slo)
+    r.add_get("/v1/events", self.handle_events)
+    r.add_post("/v1/debug/bundle", self.handle_debug_bundle)
     r.add_post("/v1/profile", self.handle_profile)
     self._profiling = False  # one jax.profiler capture at a time
     r.add_get("/v1/topology", self.handle_get_topology)
@@ -532,6 +535,104 @@ class ChatGPTAPI:
     }
     return web.json_response(body)
 
+  async def handle_slo(self, request):
+    """GET /v1/slo — the SLO engine's report (ISSUE 9): per-class objectives,
+    multi-window burn rates, availability, and goodput, every rate carried
+    with its raw numerator/denominator. ``?scope=cluster`` pulls each peer's
+    report over the gRPC opaque-status channel (``slo_pull``, the
+    ``metrics_pull`` pattern) and merges by summing the raw counts — the
+    cluster burn is exact, never an average of averages. 200 with
+    ``{"enabled": false}`` when ``XOT_TPU_SLO=0``."""
+    from ..orchestration.slo import slo_enabled, slo_engine
+
+    if not slo_enabled():
+      return web.json_response({"enabled": False, "detail": "SLO engine disabled (XOT_TPU_SLO=0)"})
+    loop = asyncio.get_event_loop()
+    if request.query.get("scope") == "cluster":
+      peer_reports = []
+      collect = getattr(self.node, "collect_cluster_slo", None)
+      if collect is not None:
+        try:
+          peer_reports = await collect()
+        except Exception:  # noqa: BLE001 — cluster pull degrades to local
+          if DEBUG >= 1:
+            import traceback
+
+            traceback.print_exc()
+      # Tick/report/merge deep-copy the registry — off the event loop (the
+      # loop rides along so a watcher-triggered bundle capture can still
+      # schedule on it).
+      merged = await loop.run_in_executor(None, self.node.merged_cluster_slo, peer_reports, loop)
+      return web.json_response(merged)
+
+    def local_report():
+      slo_engine.maybe_tick(node=self.node, loop=loop)
+      return slo_engine.report(node_id=getattr(self.node, "id", None))
+
+    return web.json_response(await loop.run_in_executor(None, local_report))
+
+  async def handle_events(self, request):
+    """GET /v1/events — query the flight recorder's wide-event ring
+    (ISSUE 9). Filters: ``?type=a,b`` (comma-separated event types),
+    ``?request_id=``, ``?peer=``, ``?since_s=`` (wall-clock age),
+    ``?min_seq=``, ``?n=`` (newest N matches, default 256, clamped to the
+    ring capacity). Events return oldest-first — causal order."""
+    from ..orchestration.flightrec import flightrec
+
+    if not flightrec.enabled:
+      return web.json_response({"enabled": False, "detail": "flight recorder disabled (XOT_TPU_FLIGHTREC=0)"})
+    types = None
+    if request.query.get("type"):
+      types = {t.strip() for t in request.query["type"].split(",") if t.strip()}
+    try:
+      n = int(request.query.get("n", "256"))
+      since_s = float(request.query["since_s"]) if "since_s" in request.query else None
+      min_seq = int(request.query["min_seq"]) if "min_seq" in request.query else None
+      if n < 0 or (since_s is not None and since_s < 0):
+        raise ValueError
+    except (TypeError, ValueError):
+      return web.json_response({"error": "'n'/'min_seq' must be integers, 'since_s' a non-negative number"}, status=400)
+    events = flightrec.query(
+      types=types,
+      request_id=request.query.get("request_id"),
+      peer=request.query.get("peer"),
+      since_s=since_s,
+      min_seq=min_seq,
+      limit=min(n, flightrec.capacity),
+    )
+    return web.json_response({"enabled": True, "capacity": flightrec.capacity, "last_seq": flightrec.last_seq(), "events": events})
+
+  async def handle_debug_bundle(self, request):
+    """POST /v1/debug/bundle — one-call incident bundle (ISSUE 9): metric
+    snapshots, recent flight events, breaker/health/clock state, active
+    chaos schedule, in-flight timelines, and a config/env fingerprint from
+    EVERY reachable peer (opaque-status pull; dead peers annotated, never
+    waited out). Body (all optional): ``{"scope": "cluster"|"local",
+    "reason": str, "save": bool}`` — ``save`` also writes the artifact to
+    the bundle directory and returns its path."""
+    from ..orchestration.flightrec import assemble_local_bundle, bundles
+
+    try:
+      data = await request.json()
+    except Exception:  # noqa: BLE001 — empty body is fine
+      data = {}
+    reason = str(data.get("reason") or "manual")[:128]
+    scope = str(data.get("scope") or "cluster")
+    if scope == "cluster" and hasattr(self.node, "collect_cluster_bundle"):
+      bundle = await self.node.collect_cluster_bundle(reason=reason)
+    else:
+      bundle = await asyncio.get_event_loop().run_in_executor(
+        None, lambda: assemble_local_bundle(self.node, reason=reason)
+      )
+    metrics.inc("incident_bundles_total", labels={"trigger": "api"})
+    if data.get("save"):
+      path = bundles.write(bundle, reason)
+      bundle["saved_to"] = path
+    from ..orchestration.flightrec import flightrec
+
+    flightrec.record("bundle_captured", cause=reason, attributes={"via": "api", "path": bundle.get("saved_to")})
+    return web.json_response(bundle)
+
   async def handle_profile(self, request):
     """POST /v1/profile — on-demand jax.profiler capture to a directory.
 
@@ -577,6 +678,9 @@ class ChatGPTAPI:
       jax_profiler.start_trace(out_dir)
     except Exception as e:  # noqa: BLE001 — profiler unavailable: no-op, not a crash
       return web.json_response({"detail": f"profiler unavailable: {e}"}, status=503)
+    from ..orchestration.flightrec import flightrec
+
+    flightrec.record("profile_capture", attributes={"dir": out_dir, "duration_ms": duration_ms, "steps": steps})
     self._profiling = True
     t0 = time.perf_counter()
     steps_seen = 0
@@ -1186,6 +1290,20 @@ class ChatGPTAPI:
     if queue is not None:
       if tokens or is_finished:
         self._last_progress[request_id] = asyncio.get_event_loop().time()
+      if is_finished:
+        # Availability GOOD event (ISSUE 9), exactly once per client
+        # request at the one layer EVERY serving path streams through
+        # (batched scheduler, plain path, ring) — finish events arrive
+        # once (the node's dedup tombstones duplicates). A request whose
+        # timeline already claimed a refusal terminal was counted bad.
+        from ..orchestration.slo import note_good, slo_enabled
+        from ..orchestration.tracing import TERMINAL_STAGES, tracer as _tracer
+
+        if slo_enabled() and _tracer.terminal_of(request_id) not in TERMINAL_STAGES:
+          from ..inference.qos import qos_wire
+
+          wire = qos_wire.get(request_id) or {}
+          note_good(wire.get("priority") or "standard")
       await queue.put((tokens, is_finished))
 
   # --------------------------------------------------- stall watchdog (ISSUE 8)
@@ -1254,10 +1372,20 @@ class ChatGPTAPI:
       while not queue.empty():  # undelivered chunks ride the 503 body
         toks, _fin = queue.get_nowait()
         pending.extend(toks)
+    from ..inference.qos import qos_wire
+    from ..orchestration.flightrec import bundles
     from ..orchestration.tracing import tracer
 
     metrics.inc("requests_stalled_total")
-    tracer.stage(request_id, "stalled", {"stall_s": stall}, terminal=True)
+    wire = qos_wire.get(request_id) or {}
+    tracer.stage(request_id, "stalled", {
+      "stall_s": stall, "class": wire.get("priority") or "standard",
+    }, terminal=True)
+    # Auto-capture (ISSUE 9): the stall fires exactly when the failure's
+    # context is freshest — grab a rate-limited incident bundle (cluster
+    # scope, dead peers annotated) so the post-mortem starts from data,
+    # not reconstruction. Scheduled as a task; never delays the 503.
+    bundles.auto_capture("stall", node=self.node)
     raise RequestStalledError(
       f"no token progress for {stall:.0f}s with a dead or open-circuit upstream hop",
       tokens=pending,

@@ -118,7 +118,8 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
-from ..orchestration.tracing import tracer
+from ..orchestration import slo
+from ..orchestration.tracing import TERMINAL_STAGES, tracer
 from ..utils.helpers import DEBUG
 from ..utils.metrics import FRACTION_BUCKETS, metrics
 from .engine import NodeDrainingError, PromptTooLongError, RequestMigratedError, ServerOverloadedError
@@ -151,6 +152,10 @@ class _Request:
   # admission emits an ``unparked`` timeline stage with the waited span, so
   # a timeline query explains page-starvation waits.
   t_parked: float = 0.0
+  # Measured TTFT of the FIRST incarnation (ISSUE 9): survives a QoS
+  # preempt-resume (the resumed incarnation zeroes t_submit), so goodput's
+  # within-SLO check judges the latency the client actually saw.
+  slo_ttft_s: float | None = None
 
 
 @dataclass
@@ -188,6 +193,10 @@ class _Slot:
   # acceptance EWMA that drives it (inference/paging.py spec_adapt_gamma).
   spec_gamma: int = 0
   spec_ewma: float | None = None
+  # perf_counter at the first emitted token (ISSUE 9): with the finish time
+  # it yields the request's realized mean inter-token latency for goodput's
+  # within-SLO check.
+  t_first: float = 0.0
 
 
 @dataclass
@@ -374,6 +383,7 @@ class BatchedServer:
       # refusal (the peers already stopped routing here; this covers local
       # API races inside the announcement window).
       metrics.inc("scheduler_rejections_total")
+      slo.note_bad(str(priority or "standard"), "rejected")
       raise NodeDrainingError("node is draining (graceful shutdown announced)")
     tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
     ticket = None
@@ -385,6 +395,10 @@ class BatchedServer:
       # when nothing outranked waits does the new request get rejected.
       if self.qos is None or not self._shed_for(ticket):
         metrics.inc("scheduler_rejections_total")
+        if self.qos is None:
+          # The QoS path's terminal `rejected` stage feeds availability via
+          # the tracer bridge; the FIFO path has no stage — count it here.
+          slo.note_bad("standard", "rejected")
         err = ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
         if self.qos is not None:
           # No service was consumed: give the rate-bucket charges back, or
@@ -1288,16 +1302,26 @@ class BatchedServer:
       # (``generated``/``max_tokens`` already net out the carried span).
       slot.out_tokens.extend(req.carry_tokens)
     slot.out_tokens.append(first)
+    slot.t_first = time.perf_counter()
     if req.t_submit:
-      metrics.observe_hist("ttft_seconds", time.perf_counter() - req.t_submit)
+      ttft = slot.t_first - req.t_submit
+      metrics.observe_hist("ttft_seconds", ttft)
+      req.slo_ttft_s = ttft
+      # Per-class TTFT (ISSUE 9): the SLO engine's burn-rate windows need
+      # the class dimension the unlabeled histogram can't carry; a separate
+      # family keeps the existing exposition and bench deltas untouched.
+      slo.observe_ttft(self._slo_class(req), ttft)
     cancelled = req.request_id in self._cancelled_ids  # raced during prefill
     finished = cancelled or first in req.eos_ids or slot.generated >= req.max_tokens
     slot.finished = finished
     tracer.stage(req.request_id, "decode", {"first_token": int(first)})
     req.emit(req.request_id, [] if cancelled else [first], finished)
+    if not cancelled:
+      slo.note_tokens(self._slo_class(req), self._slo_tenant(req), 1)
     if finished:
       self._cancelled_ids.discard(req.request_id)
       self._release_pages(slot)
+      self._slo_note_complete(slot)
       if not req.future.done():
         req.future.set_result(slot.out_tokens)
       return
@@ -1321,6 +1345,44 @@ class BatchedServer:
       self.block_tables[r.row, :] = 0
       n = len(slot.shared_pages) + len(slot.pages)
       self.block_tables[r.row, :n] = slot.shared_pages + slot.pages
+
+  @staticmethod
+  def _slo_class(req: _Request) -> str:
+    return req.qos.priority if req.qos is not None else "standard"
+
+  @staticmethod
+  def _slo_tenant(req: _Request) -> str:
+    return req.qos.tenant if req.qos is not None else "default"
+
+  def _slo_note_complete(self, slot: _Slot) -> None:
+    """Goodput accounting at the completion choke points (ISSUE 9): a
+    finished request's tokens count as goodput only when BOTH realized
+    latencies met the class objectives. ``slo_ttft_s`` survives
+    preempt-resume, so the judged TTFT is the one the client saw.
+    (Availability's GOOD event is counted once per client request at the
+    API token choke point — the layer every serving path streams through —
+    not here: the scheduler is one serving mode of several.)"""
+    if not slo.slo_enabled():
+      return
+    req = slot.req
+    cls, tenant = self._slo_class(req), self._slo_tenant(req)
+    if tracer.terminal_of(req.request_id) in TERMINAL_STAGES:
+      # A refusal terminal (e.g. the API stall watchdog's 'stalled')
+      # already counted this request bad; a later local recovery finishing
+      # the row must not put its tokens in goodput — the client's stream
+      # ended in the 503.
+      return
+    n = len(slot.out_tokens)
+    # Realized mean ITL over THIS incarnation's tokens only: t_first is the
+    # resumed incarnation's first token, so dividing by the carried span
+    # would bias a preempt-resumed request's ITL low by exactly the carry
+    # factor and overstate goodput on preemption-heavy overload.
+    n_new = n - len(req.carry_tokens)
+    itl_s = None
+    if slot.t_first and n_new > 1:
+      itl_s = max(time.perf_counter() - slot.t_first, 0.0) / (n_new - 1)
+    if slo.within_slo(cls, req.slo_ttft_s, itl_s):
+      slo.note_good_tokens(cls, tenant, n)
 
   def _release_pages(self, slot: _Slot, extend: bool | None = None) -> None:
     """Return a finished slot's pages: shared prefix refs drop; private FULL
@@ -1687,6 +1749,7 @@ class BatchedServer:
         slot.finished = True
         self._cancelled_ids.discard(req.request_id)
         self._release_pages(slot)
+        self._slo_note_complete(slot)
         req.emit(req.request_id, [], True)
         if not req.future.done():
           req.future.set_result(slot.out_tokens)
@@ -1719,11 +1782,17 @@ class BatchedServer:
         # tokens — ONE weighted observation (utils/metrics.py observe_hist
         # n=k) instead of k lock round trips.
         metrics.observe_hist("itl_seconds", chunk_dt / len(emit), n=len(emit))
+        # Per-class ITL + the goodput denominator (ISSUE 9): same weighted
+        # observation, one extra lock acquisition per chunk; no-ops with
+        # XOT_TPU_SLO=0.
+        slo.observe_itl(self._slo_class(req), chunk_dt / len(emit), n=len(emit))
+        slo.note_tokens(self._slo_class(req), self._slo_tenant(req), len(emit))
       req.emit(req.request_id, emit, done)
       if done:
         slot.finished = True
         self._cancelled_ids.discard(req.request_id)
         self._release_pages(slot)
+        self._slo_note_complete(slot)
         if not req.future.done():
           req.future.set_result(slot.out_tokens)
         self.slots[i] = None

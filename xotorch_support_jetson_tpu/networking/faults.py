@@ -164,6 +164,23 @@ class FaultInjector:
     self._killed.clear()
     self.applied = 0
 
+  def snapshot(self) -> dict:
+    """JSON-safe view of the active schedule for incident bundles (ISSUE 9):
+    a post-mortem must distinguish an injected fault from a real one."""
+    return {
+      "enabled": self.enabled,
+      "applied": self.applied,
+      "killed": sorted(self._killed),
+      "rules": [
+        {
+          "peer": r.peer, "method": r.method, "side": r.side, "kind": r.kind,
+          "delay_ms": r.delay_ms, "jitter_ms": r.jitter_ms, "code": r.code,
+          "after": r.after, "times": r.times, "seen": r.seen, "fired": r.fired,
+        }
+        for r in self.rules
+      ],
+    }
+
   # -------------------------------------------------------------- evaluation
 
   def _dead(self, side: str, peer: str, origin: str | None) -> bool:

@@ -47,6 +47,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from ..utils.helpers import env_float
 from ..utils.metrics import metrics
 
 # Historical per-method defaults, preserved exactly. None = unbounded.
@@ -70,13 +71,6 @@ RETRYABLE_METHODS = frozenset({"SendResult", "SendOpaqueStatus", "CollectTopolog
 _OPEN, _HALF_OPEN, _CLOSED = 2, 1, 0
 
 
-def _env_f(name: str, default: float) -> float:
-  try:
-    return float(os.getenv(name, "") or default)
-  except ValueError:
-    return default
-
-
 def rpc_timeout(method: str) -> float | None:
   """Effective timeout for ``method`` from the policy table: the per-method
   env override wins outright; the global ``XOT_TPU_RPC_TIMEOUT_S`` CAPS the
@@ -93,7 +87,7 @@ def rpc_timeout(method: str) -> float | None:
     except ValueError:
       pass
   if default is not None:
-    return min(default, _env_f("XOT_TPU_RPC_TIMEOUT_S", default))
+    return min(default, env_float("XOT_TPU_RPC_TIMEOUT_S", default))
   return default
 
 
@@ -145,8 +139,8 @@ def rpc_retries(method: str) -> int:
 def backoff_s(attempt: int, rng: random.Random | None = None) -> float:
   """Full-jitter exponential backoff for retry ``attempt`` (1-based):
   uniform in (0, min(base * 2^(attempt-1), cap)]."""
-  base = _env_f("XOT_TPU_RPC_RETRY_BASE_S", 0.05)
-  cap = _env_f("XOT_TPU_RPC_RETRY_MAX_S", 2.0)
+  base = env_float("XOT_TPU_RPC_RETRY_BASE_S", 0.05)
+  cap = env_float("XOT_TPU_RPC_RETRY_MAX_S", 2.0)
   span = min(base * (2 ** max(attempt - 1, 0)), cap)
   r = (rng or _rng).random()
   return span * max(r, 0.01)
@@ -171,7 +165,7 @@ class RetryBudget:
     per-call attempt count is the only bound."""
     if not request_id:
       return True
-    limit = int(_env_f("XOT_TPU_RPC_RETRY_BUDGET", 8))
+    limit = int(env_float("XOT_TPU_RPC_RETRY_BUDGET", 8))
     with self._lock:
       spent = self._spent.get(request_id, 0)
       if spent >= limit:
@@ -204,8 +198,20 @@ class CircuitBreaker:
     self._lock = threading.Lock()
 
   def _set_state(self, state: int) -> None:
+    prev = self.state
     self.state = state
     metrics.set_gauge("peer_circuit_state", state, labels={"peer": self.peer_id})
+    if state != prev:
+      # Flight-recorder hook (ISSUE 9): breaker transitions are exactly the
+      # "what happened to that link, in order" events a post-mortem wants —
+      # and the input to the watchers' breaker-flap rule. record() is a
+      # no-op when the recorder is off.
+      from ..orchestration.flightrec import flightrec
+
+      flightrec.record(
+        {_OPEN: "breaker_open", _HALF_OPEN: "breaker_half_open", _CLOSED: "breaker_close"}[state],
+        peer=self.peer_id, attributes={"failures": self.failures},
+      )
 
   def allow(self) -> bool:
     """May a non-probe call proceed? Open circuits fail fast until the open
@@ -213,7 +219,7 @@ class CircuitBreaker:
     with self._lock:
       if self.state != _OPEN:
         return True
-      if time.monotonic() - self.opened_at >= _env_f("XOT_TPU_CB_OPEN_S", 10.0):
+      if time.monotonic() - self.opened_at >= env_float("XOT_TPU_CB_OPEN_S", 10.0):
         self._set_state(_HALF_OPEN)
         return True
       return False
@@ -227,7 +233,7 @@ class CircuitBreaker:
   def record_failure(self) -> None:
     with self._lock:
       self.failures += 1
-      threshold = max(int(_env_f("XOT_TPU_CB_FAILS", 5)), 1)
+      threshold = max(int(env_float("XOT_TPU_CB_FAILS", 5)), 1)
       # A half-open probe failing re-opens immediately (fresh window).
       if self.state == _HALF_OPEN or self.failures >= threshold:
         self.opened_at = time.monotonic()
@@ -264,6 +270,15 @@ class BreakerRegistry:
       states = [b.state for (pid, _), b in self._by_key.items() if pid == peer_id]
     return max(states) if states else _CLOSED
 
+  def snapshot(self) -> dict:
+    """JSON-safe breaker states for incident bundles (ISSUE 9):
+    ``{"peer@address": {"state": 0|1|2, "failures": n}}``."""
+    with self._lock:
+      return {
+        f"{pid}@{addr}" if addr else pid: {"state": b.state, "failures": b.failures}
+        for (pid, addr), b in self._by_key.items()
+      }
+
   def forget(self, peer_id: str) -> None:
     with self._lock:
       for key in [k for k in self._by_key if k[0] == peer_id]:
@@ -288,21 +303,39 @@ class PeerHealth:
     self._lock = threading.Lock()
 
   def record(self, peer_id: str, ok: bool) -> None:
+    crossed = None
+    k = max(int(env_float("XOT_TPU_HEALTH_FAILS", 3)), 1)
     with self._lock:
+      prev = self._consecutive.get(peer_id, 0)
       if ok:
         self._consecutive.pop(peer_id, None)
+        if prev >= k:
+          crossed = "peer_recovered"
       else:
-        self._consecutive[peer_id] = self._consecutive.get(peer_id, 0) + 1
+        self._consecutive[peer_id] = prev + 1
+        if prev + 1 == k:
+          crossed = "peer_dead"
+    if crossed is not None:
+      # Health-damping death/recovery is a consequential transition, not a
+      # per-probe signal: record exactly the crossing (ISSUE 9).
+      from ..orchestration.flightrec import flightrec
+
+      flightrec.record(crossed, peer=peer_id, attributes={"consecutive_failures": 0 if ok else prev + 1})
 
   def consecutive_failures(self, peer_id: str) -> int:
     with self._lock:
       return self._consecutive.get(peer_id, 0)
 
+  def snapshot(self) -> dict:
+    """JSON-safe consecutive-failure counts for incident bundles."""
+    with self._lock:
+      return dict(self._consecutive)
+
   def is_dead(self, peer_id: str) -> bool:
     """Dead = XOT_TPU_HEALTH_FAILS consecutive failures (default 3). A peer
     with no recorded failures is healthy — stale-beacon eviction is a
     separate, unchanged condition."""
-    k = max(int(_env_f("XOT_TPU_HEALTH_FAILS", 3)), 1)
+    k = max(int(env_float("XOT_TPU_HEALTH_FAILS", 3)), 1)
     return self.consecutive_failures(peer_id) >= k
 
   def forget(self, peer_id: str) -> None:

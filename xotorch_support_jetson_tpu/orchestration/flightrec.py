@@ -1,0 +1,482 @@
+"""Wide-event flight recorder, anomaly watchers, and incident bundles.
+
+PR 8 made cluster failures survivable (fault injection, breakers, drain and
+live migration, the stall watchdog) but left their forensics scattered:
+"what exactly happened at 03:12, in order, across the ring" required
+stitching per-node logs by hand. This module is the interpretation layer's
+memory (ISSUE 9):
+
+- **Flight recorder** (``flightrec``): a bounded ring of structured WIDE
+  events — one per consequential state transition, never per token. Events
+  arrive from hooks at choke points that already exist: the tracer's stage
+  choke point forwards the consequential stages (admit / shed / reject /
+  rate-limit / preempt / park / unpark / spill / restore / drain / migrate /
+  stall — ``orchestration/tracing.py``), the retry layer records breaker
+  open/half-open/close and health-damping death (``networking/retry.py``),
+  and the node records topology join/leave and replay (``node.py``). Each
+  event carries ``{seq, t_wall, t_mono_ns, type, request_id, peer, node,
+  cause, attributes}`` and is queryable at ``GET /v1/events`` with
+  time/type/request/peer filters.
+
+- **Anomaly watchers** (``AnomalyWatchers``): rule-based detectors run on
+  the SLO engine's tick over the tick's metric delta and the recent event
+  window — breaker flap, spec-acceptance collapse, page-pool thrash,
+  burn-rate over threshold, clock-offset jump. Each firing emits a
+  synthetic ``anomaly`` event (rate-limited per rule) and asks the bundle
+  manager for an auto-capture, so post-mortems start from data.
+
+- **Incident bundles** (``bundles``): one JSON artifact — metrics snapshot,
+  recent flight events, breaker/health/clock state, active chaos schedule,
+  in-flight timelines, config/env fingerprint — assembled locally by
+  ``assemble_local_bundle`` and cluster-wide by the node's
+  ``collect_cluster_bundle`` (opaque-status pull, dead peers annotated,
+  never stalling the call). ``POST /v1/debug/bundle`` serves it on demand;
+  the stall watchdog and the watchers auto-capture to
+  ``$XOT_HOME/bundles/`` behind a global rate limit
+  (``XOT_TPU_BUNDLE_MIN_INTERVAL_S``).
+
+``XOT_TPU_FLIGHTREC=0`` disables recording entirely (``record()`` returns
+before touching the ring — the repo's established byte-identical-off
+pattern; test-pinned). The ring is memory-bounded
+(``XOT_TPU_FLIGHTREC_CAP``, default 4096 events) and recording is one lock
+plus one deque append — cheap enough for state transitions, which is the
+only cadence that feeds it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from ..utils.helpers import env_float
+from ..utils.metrics import metrics
+from .slo import counter_family
+
+DEFAULT_CAP = 4096
+
+# The documented event vocabulary (open set — new hooks may add types, but
+# these are the ones the README schema table and the watchers know about).
+EVENT_TYPES = (
+  # request lifecycle transitions (forwarded from the tracer stage choke point)
+  "admitted", "shed", "rejected", "rate_limited", "preempted", "parked", "unparked",
+  "spilled", "restored", "drain", "migrated", "stalled", "complete",
+  # fault-tolerance plane (networking/retry.py)
+  "breaker_open", "breaker_half_open", "breaker_close", "peer_dead", "peer_recovered",
+  # topology / recovery (orchestration/node.py)
+  "topology_join", "topology_leave", "drain_announced", "replay",
+  # observability plane
+  "profile_capture", "anomaly", "bundle_captured",
+)
+
+
+def flightrec_enabled() -> bool:
+  return os.getenv("XOT_TPU_FLIGHTREC", "1") not in ("0", "false")
+
+
+class FlightRecorder:
+  """Bounded ring of wide events. Thread-safe; one lock per record/query."""
+
+  def __init__(self, capacity: int | None = None) -> None:
+    if capacity is None:
+      try:
+        capacity = int(os.getenv("XOT_TPU_FLIGHTREC_CAP", str(DEFAULT_CAP)) or DEFAULT_CAP)
+      except ValueError:
+        capacity = DEFAULT_CAP
+    self._ring: deque[dict] = deque(maxlen=max(capacity, 16))
+    self._lock = threading.Lock()
+    self._seq = 0
+
+  @property
+  def enabled(self) -> bool:
+    return flightrec_enabled()
+
+  @property
+  def capacity(self) -> int:
+    return self._ring.maxlen or 0
+
+  def record(
+    self,
+    type: str,  # noqa: A002 — the wide-event field name
+    request_id: str | None = None,
+    peer: str | None = None,
+    node: str | None = None,
+    cause: str | None = None,
+    attributes: dict | None = None,
+  ) -> dict | None:
+    """Append one wide event; returns it (None when the recorder is off).
+    ``attributes`` must be JSON-safe — events ride the opaque-status channel
+    inside bundles."""
+    if not flightrec_enabled():
+      return None
+    ev = {
+      "seq": 0,  # assigned under the lock
+      "t_wall": time.time(),
+      "t_mono_ns": time.perf_counter_ns(),
+      "type": str(type),
+      "request_id": request_id,
+      "peer": peer,
+      "node": node,
+      "cause": cause,
+      "attributes": dict(attributes or {}),
+    }
+    with self._lock:
+      self._seq += 1
+      ev["seq"] = self._seq
+      self._ring.append(ev)
+    metrics.inc("flightrec_events_total", labels={"type": str(type)})
+    return ev
+
+  def query(
+    self,
+    types: set | list | None = None,
+    request_id: str | None = None,
+    peer: str | None = None,
+    since_s: float | None = None,
+    min_seq: int | None = None,
+    limit: int = 256,
+  ) -> list[dict]:
+    """Matching events, oldest-first (causal order), capped at the NEWEST
+    ``limit`` matches — an incident query wants the recent tail, not the
+    ring's ancient head. ``since_s`` filters on wall-clock age."""
+    limit = int(limit)
+    if limit <= 0:
+      return []  # (a bare negative slice bound would return EVERYTHING)
+    tset = {str(t) for t in types} if types else None
+    cutoff = time.time() - since_s if since_s is not None else None
+    with self._lock:
+      events = list(self._ring)
+    out = []
+    for ev in events:
+      if tset is not None and ev["type"] not in tset:
+        continue
+      if request_id is not None and ev["request_id"] != request_id:
+        continue
+      if peer is not None and ev["peer"] != peer:
+        continue
+      if cutoff is not None and ev["t_wall"] < cutoff:
+        continue
+      if min_seq is not None and ev["seq"] < min_seq:
+        continue
+      out.append(dict(ev))
+    return out[-limit:]
+
+  def recent(self, n: int = 256) -> list[dict]:
+    if int(n) <= 0:
+      return []
+    with self._lock:
+      return [dict(ev) for ev in list(self._ring)[-int(n):]]
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._ring)
+
+  def last_seq(self) -> int:
+    with self._lock:
+      return self._seq
+
+  def clear(self) -> None:
+    with self._lock:
+      self._ring.clear()
+
+
+flightrec = FlightRecorder()
+
+
+# ------------------------------------------------------------ anomaly watchers
+
+
+class AnomalyWatchers:
+  """Rule-based detectors over (tick delta, recent events, SLO report).
+
+  Each firing emits one synthetic ``anomaly`` flight event (cause = rule
+  name) and requests a rate-limited auto-bundle. Per-rule cooldown
+  (``XOT_TPU_ANOMALY_COOLDOWN_S``, default 60 s) keeps a sustained
+  condition from flooding the ring — the bundle manager's own rate limit
+  additionally bounds disk captures."""
+
+  RULES = ("breaker_flap", "spec_acceptance_collapse", "page_pool_thrash", "burn_rate", "clock_jump")
+
+  def __init__(self) -> None:
+    self._last_fired: dict[str, float] = {}
+    self._last_offsets: dict[str, float] = {}
+
+  def _cooled(self, rule: str, now: float) -> bool:
+    cooldown = env_float("XOT_TPU_ANOMALY_COOLDOWN_S", 60.0)
+    last = self._last_fired.get(rule)
+    return last is None or now - last >= cooldown
+
+  def _fire(self, rule: str, now: float, node=None, loop=None, **attrs) -> dict | None:
+    self._last_fired[rule] = now
+    metrics.inc("anomalies_total", labels={"rule": rule})
+    ev = flightrec.record("anomaly", cause=rule, attributes=attrs)
+    bundles.auto_capture(f"anomaly:{rule}", node=node, loop=loop)
+    return ev
+
+  def check(self, delta: dict, elapsed_s: float, report: dict | None = None, node=None, loop=None) -> list[dict]:
+    """Run every rule once; returns the anomaly events fired. ``delta`` is
+    the tick's ``snapshot_delta``; ``report`` the SLO engine's fresh local
+    report (burn-rate rule); ``node`` rides to auto-capture for cluster
+    context."""
+    if not flightrec_enabled():
+      return []
+    now = time.time()
+    fired: list[dict] = []
+
+    # Breaker flap: >= N open transitions on one peer within the window —
+    # a link that oscillates instead of staying down (retry pressure, a
+    # half-dead host) reads very differently from a clean kill.
+    if self._cooled("breaker_flap", now):
+      window_s = env_float("XOT_TPU_ANOMALY_FLAP_WINDOW_S", 60.0)
+      flap_n = int(env_float("XOT_TPU_ANOMALY_FLAP_N", 3))
+      opens: dict[str, int] = {}
+      for ev in flightrec.query(types={"breaker_open"}, since_s=window_s, limit=flightrec.capacity):
+        if ev.get("peer"):
+          opens[ev["peer"]] = opens.get(ev["peer"], 0) + 1
+      flappy = {p: n for p, n in opens.items() if n >= flap_n}
+      if flappy:
+        peer, n = max(flappy.items(), key=lambda kv: kv[1])
+        ev = self._fire("breaker_flap", now, node=node, loop=loop, peer=peer, opens=n, window_s=window_s)
+        if ev:
+          fired.append(ev)
+
+    # Spec-acceptance collapse: the draft is proposing plenty but almost
+    # nothing survives verification — speculation is burning compute.
+    if self._cooled("spec_acceptance_collapse", now):
+      proposed = counter_family(delta, "spec_proposed_tokens_total")
+      accepted = counter_family(delta, "spec_accepted_tokens_total")
+      min_proposed = env_float("XOT_TPU_ANOMALY_SPEC_MIN_PROPOSED", 256.0)
+      floor = env_float("XOT_TPU_ANOMALY_SPEC_ACCEPT_FLOOR", 0.15)
+      if proposed >= min_proposed and accepted / proposed < floor:
+        ev = self._fire(
+          "spec_acceptance_collapse", now, node=node, loop=loop,
+          proposed=int(proposed), accepted=int(accepted), rate=round(accepted / proposed, 4),
+        )
+        if ev:
+          fired.append(ev)
+
+    # Page-pool thrash: grow/release events churning far above the admission
+    # rate — the pool is cycling pages instead of holding working sets.
+    if self._cooled("page_pool_thrash", now) and elapsed_s > 0:
+      churn = (
+        counter_family(delta, "page_grow_events_total")
+        + counter_family(delta, "page_release_events_total")
+      ) / elapsed_s
+      if churn >= env_float("XOT_TPU_ANOMALY_THRASH_EVENTS_PER_S", 50.0):
+        ev = self._fire("page_pool_thrash", now, node=node, loop=loop, events_per_s=round(churn, 2))
+        if ev:
+          fired.append(ev)
+
+    # Burn rate: any class's FAST-window burn over the alert threshold —
+    # the error budget is draining faster than the SLO can absorb. Only the
+    # fast window fires (the documented semantics): a long window keeps the
+    # memory of an outage for its whole span, and re-alerting every
+    # cooldown for an hour after recovery is noise, not signal.
+    if report and self._cooled("burn_rate", now):
+      threshold = env_float("XOT_TPU_SLO_BURN_ALERT", 10.0)
+      fast = str(min((int(w) for w in report.get("windows_s") or []), default=0))
+      worst = None
+      for cls, entry in (report.get("classes") or {}).items():
+        for window, w in (entry.get("windows") or {}).items():
+          if window != fast:
+            continue
+          for objective in ("ttft", "itl", "availability"):
+            burn = (w.get(objective) or {}).get("burn_rate")
+            if burn is not None and burn >= threshold and (worst is None or burn > worst[3]):
+              worst = (cls, window, objective, burn)
+      if worst is not None:
+        ev = self._fire(
+          "burn_rate", now, node=node, loop=loop,
+          **{"class": worst[0], "window_s": worst[1], "objective": worst[2], "burn_rate": round(worst[3], 3)},
+        )
+        if ev:
+          fired.append(ev)
+
+    # Clock-offset jump: a peer's estimate moved by more than the threshold
+    # between ticks — a restarted peer, NTP step, or VM migration; merged
+    # cluster timelines spanning the jump are suspect.
+    if self._cooled("clock_jump", now):
+      jump_ms = env_float("XOT_TPU_ANOMALY_CLOCK_JUMP_MS", 100.0)
+      offsets: dict[str, float] = {}
+      for key, value in (delta.get("labeled_gauges") or {}).get("peer_clock_offset_ms", []):
+        labels = dict(tuple(kv) for kv in key)
+        if "peer" in labels:
+          offsets[labels["peer"]] = float(value)
+      worst_jump = None
+      for peer, off in offsets.items():
+        prev = self._last_offsets.get(peer)
+        if prev is not None and abs(off - prev) >= jump_ms and (worst_jump is None or abs(off - prev) > worst_jump[1]):
+          worst_jump = (peer, abs(off - prev))
+      self._last_offsets = offsets
+      if worst_jump is not None:
+        ev = self._fire("clock_jump", now, node=node, loop=loop, peer=worst_jump[0], jump_ms=round(worst_jump[1], 3))
+        if ev:
+          fired.append(ev)
+
+    return fired
+
+
+# ------------------------------------------------------------ incident bundles
+
+
+def config_fingerprint() -> dict:
+  """The node's effective configuration: every XOT_TPU_* env knob plus the
+  runtime versions that change behavior. Secrets never live in this
+  namespace (the knobs are schedules, sizes, and switches)."""
+  env = {k: v for k, v in os.environ.items() if k.startswith("XOT_TPU_") or k in ("JAX_PLATFORMS",)}
+  versions: dict[str, str] = {}
+  try:
+    import jax
+
+    versions["jax"] = jax.__version__
+  except Exception:  # noqa: BLE001 — bundle assembly must never fail on imports
+    pass
+  try:
+    import numpy
+
+    versions["numpy"] = numpy.__version__
+  except Exception:  # noqa: BLE001
+    pass
+  import hashlib
+
+  digest = hashlib.sha256(json.dumps(env, sort_keys=True).encode()).hexdigest()[:16]
+  return {"env": env, "versions": versions, "env_sha": digest}
+
+
+def assemble_local_bundle(node=None, reason: str = "manual", events_limit: int = 512) -> dict:
+  """One node's share of an incident bundle — everything JSON-safe so it
+  rides the opaque-status channel for cluster assembly. Every section is
+  best-effort: a broken subsystem yields an ``error`` note, never a failed
+  bundle (the bundle exists precisely because something is broken)."""
+  from ..networking.faults import chaos
+  from ..networking.retry import breakers, peer_health
+  from .clocksync import clock_sync
+  from .slo import slo_enabled, slo_engine
+  from .tracing import tracer
+
+  bundle: dict = {
+    "node_id": getattr(node, "id", None),
+    "reason": reason,
+    "captured_at": time.time(),
+    "flightrec_enabled": flightrec_enabled(),
+    "config": config_fingerprint(),
+  }
+
+  def section(name, fn):
+    try:
+      bundle[name] = fn()
+    except Exception as e:  # noqa: BLE001 — degrade per-section, never whole-bundle
+      bundle[name] = {"error": repr(e)}
+
+  section("metrics", metrics.snapshot)
+  section("events", lambda: flightrec.recent(events_limit))
+  section("breakers", breakers.snapshot)
+  section("peer_health", peer_health.snapshot)
+  section("clock_offsets", lambda: {pid: est.to_dict() for pid, est in clock_sync.offsets().items()})
+  section("chaos", chaos.snapshot)
+  section("slo", lambda: slo_engine.report() if slo_enabled() else {"enabled": False})
+  section("inflight_timelines", lambda: tracer.inflight_timelines(16))
+  if node is not None:
+    section("peers", lambda: [p.id() for p in getattr(node, "peers", [])])
+    section("draining", lambda: bool(getattr(node, "draining", False)))
+    section("draining_peers", lambda: sorted(getattr(node, "_draining_peers", {})))
+    section("outstanding_requests", lambda: len(getattr(node, "outstanding_requests", {})))
+  return bundle
+
+
+class BundleManager:
+  """Auto-capture gate + disk writer. One global rate limit
+  (``XOT_TPU_BUNDLE_MIN_INTERVAL_S``, default 60 s): the triggers fire
+  exactly when the system is unhealthy, which is exactly when an unbounded
+  capture loop would make it worse."""
+
+  def __init__(self) -> None:
+    self._lock = threading.Lock()
+    self._last_capture = 0.0
+    self.last_path: str | None = None
+
+  @staticmethod
+  def min_interval_s() -> float:
+    return env_float("XOT_TPU_BUNDLE_MIN_INTERVAL_S", 60.0)
+
+  def _take_slot(self) -> bool:
+    now = time.monotonic()
+    with self._lock:
+      if now - self._last_capture < self.min_interval_s():
+        return False
+      self._last_capture = now
+      return True
+
+  def reset(self) -> None:
+    with self._lock:
+      self._last_capture = 0.0
+      self.last_path = None
+
+  def bundles_dir(self):
+    from pathlib import Path
+
+    from ..utils.helpers import XOT_HOME
+
+    d = Path(os.getenv("XOT_TPU_BUNDLE_DIR") or (XOT_HOME / "bundles"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+  def write(self, bundle: dict, reason: str) -> str | None:
+    try:
+      safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
+      path = self.bundles_dir() / f"bundle-{int(time.time() * 1000)}-{safe}.json"
+      with open(path, "w") as f:
+        json.dump(bundle, f)
+      self.last_path = str(path)
+      return str(path)
+    except OSError:
+      return None
+
+  def auto_capture(self, reason: str, node=None, loop=None) -> bool:
+    """Trigger-time capture (stall watchdog, anomaly watchers): rate-limited,
+    written to disk off the caller's path. Returns True when a capture was
+    scheduled. Cluster context is best-effort with a short timeout — a dead
+    peer must not stall the trigger path (it is frequently the trigger).
+    ``loop`` lets a caller running OFF the event loop (the node dispatches
+    the periodic SLO tick to an executor thread so the registry snapshot
+    never stalls RPC handling) still schedule the cluster capture on it."""
+    if not flightrec_enabled():
+      return False
+    if not self._take_slot():
+      return False
+    metrics.inc("incident_bundles_total", labels={"trigger": reason})
+
+    async def capture() -> None:
+      try:
+        if node is not None and getattr(node, "peers", None):
+          bundle = await node.collect_cluster_bundle(reason=reason, timeout=2.0)
+        else:
+          bundle = assemble_local_bundle(node, reason=reason)
+        path = self.write(bundle, reason)
+        flightrec.record("bundle_captured", cause=reason, attributes={"path": path, "auto": True})
+      except Exception:  # noqa: BLE001 — auto-capture must never take down serving
+        pass
+
+    import asyncio
+
+    try:
+      running = asyncio.get_running_loop()
+    except RuntimeError:
+      running = None
+    if running is not None:
+      running.create_task(capture())
+    elif loop is not None:
+      asyncio.run_coroutine_threadsafe(capture(), loop)
+    else:
+      # No event loop anywhere (sync caller in tests/teardown): capture
+      # locally, inline.
+      bundle = assemble_local_bundle(node, reason=reason)
+      path = self.write(bundle, reason)
+      flightrec.record("bundle_captured", cause=reason, attributes={"path": path, "auto": True})
+    return True
+
+
+bundles = BundleManager()
+watchers = AnomalyWatchers()

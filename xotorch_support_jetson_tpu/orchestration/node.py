@@ -40,6 +40,8 @@ from ..utils.helpers import DEBUG, AsyncCallbackSystem
 from ..utils.metrics import metrics
 from .. import registry
 from .clocksync import clock_sync
+from .flightrec import assemble_local_bundle, flightrec
+from .slo import merge_slo_reports, slo_enabled, slo_engine
 from .tracing import merge_cluster_timeline, tracer
 
 
@@ -128,6 +130,10 @@ class Node:
     self._timeline_waiters: dict[str, list] = {}
     # Cluster prefix-registry pulls in flight: nonce -> [event, replies, expected].
     self._prefix_waiters: dict[str, list] = {}
+    # Cluster SLO-report pulls in flight: nonce -> [event, reports, expected].
+    self._slo_waiters: dict[str, list] = {}
+    # Cluster incident-bundle pulls in flight: nonce -> [event, parts, expected].
+    self._bundle_waiters: dict[str, list] = {}
 
     # Fault-tolerance state (ISSUE 8). ``draining`` marks THIS node as
     # shutting down (no new work; resident batched rows migrate);
@@ -703,6 +709,20 @@ class Node:
     # fails every hop must still terminate with a finish event.
     lifetime = self._replay_lifetime.get(request_id, 0)
     if state is None or state.tokens is None or attempt >= retries or lifetime >= 4 * retries:
+      # Terminal ``error`` classification (ISSUE 9): the replay budget is
+      # spent and the request is being failed — the one genuinely-errored
+      # terminal the goodput/availability denominators must see. Recorded
+      # BEFORE _finish_request so the stage claims the terminal slot (a
+      # finished timeline no longer accepts one). The class rides along so
+      # an outage that only kills interactive traffic burns the
+      # interactive budget, not 'standard'.
+      from ..inference.qos import qos_wire
+
+      wire = qos_wire.get(request_id) or {}
+      tracer.stage(request_id, "error", {
+        "reason": "replay_budget_exhausted", "attempts": attempt,
+        "class": wire.get("priority") or "standard",
+      }, node=self.id, terminal=True)
       self._finish_request(request_id)
       print(f"[node {self.id}] request {request_id} failed after {attempt} replay attempts")
       self.buffered_token_output.setdefault(request_id, ([], False))
@@ -725,6 +745,7 @@ class Node:
     if DEBUG >= 1:
       print(f"[node {self.id}] replaying {request_id} (attempt {attempt + 1}) after peer loss")
     metrics.inc("requests_replayed_total")
+    flightrec.record("replay", request_id=request_id, node=self.id, attributes={"attempt": attempt + 1})
     retry_state: InferenceState | None = None
     try:
       # Let discovery evict the dead peer and the topology re-derive.
@@ -1364,6 +1385,163 @@ class Node:
         if len(waiter[1]) >= waiter[2]:
           waiter[0].set()
 
+  # ----------------------------------------------- cluster SLO reports (ISSUE 9)
+
+  async def collect_cluster_slo(self, timeout: float = 2.0) -> list[dict]:
+    """Pull every peer's SLO report over the opaque-status channel (the
+    ``metrics_pull`` pattern): broadcast an ``slo_pull`` with a nonce; each
+    peer ticks its engine and replies with ``slo_report`` carrying the raw
+    numerators/denominators, so the API can merge them EXACTLY
+    (orchestration/slo.py ``merge_slo_reports``) for ``/v1/slo?scope=cluster``.
+    The broadcast runs as a background task: a dead peer's send attempt must
+    not stall the endpoint past ``timeout`` (its report is simply absent)."""
+    if not self.peers:
+      return []
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._slo_waiters[nonce] = waiter
+    bcast = asyncio.create_task(self.broadcast_opaque_status(
+      "", json.dumps({"type": "slo_pull", "node_id": self.id, "nonce": nonce})
+    ))
+    try:
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # merge whatever arrived
+      return list(waiter[1])
+    finally:
+      self._slo_waiters.pop(nonce, None)
+      bcast.cancel()
+
+  def _handle_slo_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "slo_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      # Reply only to the requester (same O(N²) argument as metrics_pull).
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        nonce = status_data.get("nonce", "")
+
+        async def send():
+          # Tick + report deep-copy the whole registry — off the event
+          # loop, same argument as the periodic tick dispatch.
+          loop = asyncio.get_event_loop()
+
+          def build() -> str:
+            slo_engine.maybe_tick(node=self, loop=loop)  # fresh window ring
+            return json.dumps({
+              "type": "slo_report",
+              "node_id": self.id,
+              "nonce": nonce,
+              "report": slo_engine.report(node_id=self.id),
+            })
+
+          try:
+            reply = await loop.run_in_executor(None, build)
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — SLO replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] slo report reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "slo_report":
+      waiter = self._slo_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None and status_data.get("node_id") != self.id:
+        waiter[1].append(status_data.get("report") or {})
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
+  def merged_cluster_slo(self, peer_reports: list[dict], loop=None) -> dict:
+    slo_engine.maybe_tick(node=self, loop=loop)
+    return merge_slo_reports([slo_engine.report(node_id=self.id)] + peer_reports)
+
+  # ---------------------------------------------- incident bundles (ISSUE 9)
+
+  async def collect_cluster_bundle(self, reason: str = "manual", timeout: float = 3.0) -> dict:
+    """Assemble ONE incident bundle from every reachable peer plus this node
+    (``orchestration/flightrec.py assemble_local_bundle`` per node, pulled
+    over the opaque-status channel). Peers that did not answer within
+    ``timeout`` are ANNOTATED — ``{"node_id": ..., "unreachable": true}`` —
+    never waited out: the call is bounded by construction (the broadcast is
+    a background task, the waiter is a timed event), because the likeliest
+    trigger is exactly a dead peer. Local assembly runs in an executor —
+    the registry deep-copy must not stall the event loop's RPC handling."""
+    local = await asyncio.get_event_loop().run_in_executor(
+      None, lambda: assemble_local_bundle(self, reason=reason)
+    )
+    parts: list[dict] = []
+    if self.peers:
+      nonce = uuid.uuid4().hex
+      event = asyncio.Event()
+      waiter = [event, [], len(self.peers)]
+      self._bundle_waiters[nonce] = waiter
+      bcast = asyncio.create_task(self.broadcast_opaque_status(
+        "", json.dumps({"type": "bundle_pull", "node_id": self.id, "nonce": nonce, "reason": reason})
+      ))
+      try:
+        try:
+          await asyncio.wait_for(event.wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+          pass  # annotate the silent peers below
+        parts = list(waiter[1])
+      finally:
+        self._bundle_waiters.pop(nonce, None)
+        bcast.cancel()
+    answered = {p.get("node_id") for p in parts}
+    missing = [
+      {"node_id": pid, "unreachable": True, "breaker_open": breakers.is_open(pid), "health_dead": peer_health.is_dead(pid)}
+      for p in self.peers if (pid := p.id()) not in answered
+    ]
+    return {
+      "scope": "cluster",
+      "reason": reason,
+      "captured_at": time.time(),
+      "origin": self.id,
+      "nodes_reporting": 1 + len(parts),
+      "nodes_unreachable": missing,
+      "parts": [local] + parts + missing,
+    }
+
+  def _handle_bundle_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "bundle_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        nonce = status_data.get("nonce", "")
+        reason = str(status_data.get("reason") or "cluster")
+
+        async def send():
+          # Bundle assembly deep-copies the registry + events + timelines
+          # and JSON-serializes it — off the event loop: the pull arrives
+          # exactly when the cluster is unhealthy and RPC handling matters
+          # most.
+          def build() -> str:
+            return json.dumps({
+              "type": "bundle_part",
+              "node_id": self.id,
+              "nonce": nonce,
+              "part": assemble_local_bundle(self, reason=reason),
+            })
+
+          try:
+            reply = await asyncio.get_event_loop().run_in_executor(None, build)
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — bundle replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] bundle part reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "bundle_part":
+      waiter = self._bundle_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None and status_data.get("node_id") != self.id:
+        waiter[1].append(status_data.get("part") or {"node_id": status_data.get("node_id")})
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
   # -------------------------------------------------------------- topology
 
   async def update_peers(self, wait_for_peers: int = 0) -> bool:
@@ -1431,6 +1609,16 @@ class Node:
       # Only UNPLANNED losses count — a peer that announced its drain left
       # gracefully and must not put the watchdog on a hair trigger.
       self.last_peer_loss_ts = time.monotonic()
+    # Topology transitions are flight-recorder events (ISSUE 9): joins and
+    # leaves — with leave cause drain vs loss — are the ring context every
+    # incident reconstruction starts from.
+    for p in peers_added:
+      flightrec.record("topology_join", peer=p.id(), node=self.id)
+    for p in peers_removed:
+      flightrec.record(
+        "topology_leave", peer=p.id(), node=self.id,
+        cause="drain" if self._peer_draining(p.id()) else "loss",
+      )
     self.peers = peers_unchanged + peers_to_connect
     return bool(peers_added or peers_removed or peers_updated)
 
@@ -1500,6 +1688,17 @@ class Node:
         if did_change:
           self.select_best_inference_engine()
         await self._clock_sync_pass()
+        if slo_enabled():
+          # SLO windows stay fresh without a dedicated timer (the engine
+          # self-gates to its tick interval); the anomaly watchers run on
+          # each tick with this node for cluster-context auto-bundles.
+          # Dispatched to an executor thread: the tick deep-copies the
+          # whole registry and computes every window report — tens of ms
+          # on a busy node, which must not stall the event loop's RPC
+          # handling (the loop rides along so watcher-triggered bundle
+          # captures still schedule on it).
+          loop = asyncio.get_event_loop()
+          await loop.run_in_executor(None, lambda: slo_engine.maybe_tick(node=self, loop=loop))
       except Exception:  # noqa: BLE001
         if DEBUG >= 1:
           traceback.print_exc()
@@ -1566,6 +1765,8 @@ class Node:
         # announced but kept running re-enters the map after expiry.
         nid = status_data.get("node_id")
         if nid and nid != self.id:
+          if nid not in self._draining_peers:
+            flightrec.record("drain_announced", peer=nid, node=self.id)
           self._draining_peers[nid] = time.monotonic() + DRAINING_TTL_S
       elif status_type in ("metrics_pull", "metrics_snapshot"):
         # Cluster-wide /metrics aggregation rides the same opaque channel.
@@ -1576,6 +1777,12 @@ class Node:
       elif status_type in ("prefix_pull", "prefix_keys"):
         # Cluster prefix-registry adverts (ISSUE 6: KV memory hierarchy).
         self._handle_prefix_status(status_data)
+      elif status_type in ("slo_pull", "slo_report"):
+        # Cluster SLO reports ride the same pull pattern (ISSUE 9).
+        self._handle_slo_status(status_data)
+      elif status_type in ("bundle_pull", "bundle_part"):
+        # Incident-bundle assembly (ISSUE 9).
+        self._handle_bundle_status(status_data)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001

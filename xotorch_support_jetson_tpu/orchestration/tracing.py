@@ -56,6 +56,24 @@ MAX_CONTEXTS = 1024
 # hops); the per-link aggregates keep exact totals past the cap.
 MAX_TIMELINE_HOPS = 256
 
+# Terminal classification (ISSUE 9): every request must reach EXACTLY ONE of
+# these — the refusal stages set it at their terminal stage() call, and
+# end_request classifies everything else "complete". First writer wins, so a
+# later end_request on a shed request is a no-op — the goodput and
+# availability denominators depend on this being airtight (test-pinned by
+# the terminal-invariant suite).
+TERMINAL_STAGES = frozenset({"shed", "rejected", "rate_limited", "stalled", "error"})
+
+# Stages that are consequential state transitions — forwarded to the flight
+# recorder (orchestration/flightrec.py) from this single choke point instead
+# of a hook per call site. Deliberately EXCLUDES the per-chunk cadence
+# (queued / prefill_chunk / decode / decode_chunk / detokenize): the
+# recorder holds transitions, not traffic.
+FLIGHT_STAGES = frozenset({
+  "admitted", "shed", "rejected", "rate_limited", "preempted", "parked", "unparked",
+  "spilled", "restored", "drain", "migrated", "stalled", "error",
+})
+
 
 # ---------------------------------------------------------- test clock skew
 # Synthetic per-node monotonic-clock skew, injectable by tests ONLY: two
@@ -254,6 +272,7 @@ class Tracer:
     request overran ``XOT_TPU_SLOW_REQUEST_MS``."""
     now = time.perf_counter_ns()
     slow_line = None
+    completed = False
     with self._lock:
       ctx = self.contexts.pop(request_id, None)
       if ctx is not None:
@@ -274,6 +293,12 @@ class Tracer:
         tl["finished"] = True
         if ctx is not None:
           tl["tokens"] = ctx.token_count
+        # Terminal classification: a request that finished without a refusal
+        # stage completed normally. First writer wins (a shed request's later
+        # end_request must not relabel it).
+        if tl.get("terminal") is None:
+          tl["terminal"] = "complete"
+          completed = True
         threshold_ms = float(os.getenv("XOT_TPU_SLOW_REQUEST_MS", "0") or 0)
         total_ms = (now - tl["start_ns"]) / 1e6
         if threshold_ms > 0 and total_ms > threshold_ms:
@@ -291,6 +316,10 @@ class Tracer:
             "hops": dict(tl.get("hop_agg") or {}),
           })
     self._flush_export()
+    if completed:
+      from .flightrec import flightrec
+
+      flightrec.record("complete", request_id=request_id)
     if slow_line is not None:
       print(slow_line)
 
@@ -308,15 +337,37 @@ class Tracer:
     timeline at this event, so a request the QoS layer refused BEFORE it
     ever ran still serves a finished timeline explaining why — even on
     paths where no ``end_request`` follows; a later ``end_request`` is a
-    no-op on the already-finished entry."""
+    no-op on the already-finished entry.
+
+    This is also the flight recorder's request-lifecycle choke point
+    (ISSUE 9): consequential stages (``FLIGHT_STAGES``) forward as wide
+    events, and terminal refusal stages feed the SLO engine's availability
+    accounting — one hook here instead of one per call site."""
     now = node_now_ns(node)
+    claimed = False
     with self._lock:
       tl = self._timeline_locked(request_id, now)
       tl["events"].append({"stage": stage, "t_ns": now, "node": node, "attributes": dict(attributes or {})})
       if terminal and not tl.get("finished"):
         tl["end_ns"] = now
         tl["finished"] = True
+        if tl.get("terminal") is None and stage in TERMINAL_STAGES:
+          tl["terminal"] = stage
+          claimed = True
       self.timelines.move_to_end(request_id)
+    if stage in FLIGHT_STAGES:
+      from .flightrec import flightrec
+
+      flightrec.record(stage, request_id=request_id, node=node,
+                       cause=(attributes or {}).get("reason"), attributes=attributes)
+      if claimed:
+        # Availability accounting rides the terminal CLAIM, not the stage
+        # call: a second terminal on the same request (a stall raced by a
+        # later replay-budget 'error') must not double-count one request
+        # as two bad events.
+        from .slo import note_bad
+
+        note_bad((attributes or {}).get("class") or "standard", stage)
 
   def _timeline_locked(self, request_id: str, now: int) -> dict:
     tl = self.timelines.get(request_id)
@@ -328,6 +379,7 @@ class Tracer:
         "start_ns": now,
         "end_ns": None,
         "finished": False,
+        "terminal": None,
         "tokens": 0,
         "events": [],
         "hops": [],
@@ -452,6 +504,7 @@ class Tracer:
         "request_id": request_id,
         "trace_id": tl.get("trace_id"),
         "finished": bool(tl.get("finished")),
+        "terminal": tl.get("terminal"),
         "tokens": tl.get("tokens", 0),
         "total_ms": round((end_ns - tl["start_ns"]) / 1e6, 3),
         # Page-starvation wait (ISSUE 6 satellite): the summed parked →
@@ -500,12 +553,31 @@ class Tracer:
         "start_ns": tl["start_ns"],
         "end_ns": tl["end_ns"],
         "finished": bool(tl.get("finished")),
+        "terminal": tl.get("terminal"),
         "tokens": tl.get("tokens", 0),
         "events": [dict(ev) for ev in tl["events"]],
         "hops": [dict(h) for h in tl.get("hops", [])],
         "hops_dropped": tl.get("hops_dropped", 0),
         "hop_agg": {k: dict(v) for k, v in (tl.get("hop_agg") or {}).items()},
       }
+
+  def terminal_of(self, request_id: str) -> str | None:
+    """The request's claimed terminal classification, or None. Lets the
+    scheduler's completion accounting skip a request a refusal terminal
+    already counted bad (a stalled-then-locally-recovered request must be
+    ONE availability event, not one bad plus one good)."""
+    with self._lock:
+      tl = self.timelines.get(request_id)
+      return tl.get("terminal") if tl else None
+
+  def inflight_timelines(self, max_n: int = 16) -> list[dict]:
+    """Raw-ns exports of the newest UNFINISHED timelines — what an incident
+    bundle (ISSUE 9) captures as "requests in flight at trigger time". The
+    post-mortem question is always about the requests that were mid-stream
+    when things went wrong, not the finished history."""
+    with self._lock:
+      ids = [rid for rid, tl in reversed(self.timelines.items()) if not tl.get("finished")][:max_n]
+    return [te for rid in ids if (te := self.timeline_export(rid)) is not None]
 
   # ----------------------------------------------------------------- spans
 

@@ -35,6 +35,16 @@ def env_flag(name: str, default: bool = False) -> bool:
   return val.strip().lower() not in ("", "0", "false", "no", "off")
 
 
+def env_float(name: str, default: float) -> float:
+  """Float env var: unset, empty, or malformed → default (a typo'd knob
+  degrades to the shipped behavior, never crashes a policy read). The one
+  shared parser behind the retry/SLO/anomaly knobs."""
+  try:
+    return float(os.getenv(name, "") or default)
+  except ValueError:
+    return default
+
+
 def apply_platform_override() -> None:
   """Honor XOT_TPU_PLATFORM / JAX_PLATFORMS as the device override, parity
   with the reference's TORCH_DEVICE knob (sharded_inference_engine.py:58-65).

@@ -369,4 +369,60 @@ class Metrics:
     return out
 
 
+def snapshot_delta(prev: dict, cur: dict) -> dict:
+  """Growth between two ``snapshot()`` dicts, in snapshot shape — the ONE
+  audited delta implementation (ISSUE 9 satellite: the SLO engine's rolling
+  windows and bench's measured-round isolation previously each did their own
+  ad-hoc dict math). Semantics:
+
+  - counters / labeled counters / summaries: ``cur - prev`` floored at 0 (a
+    series that shrank — restarted registry — yields its current value via
+    the floor, never a negative rate);
+  - histograms: per-bucket count deltas when the ladders match, else ``cur``
+    as-is (an incompatible prev can't be subtracted);
+  - gauges: ``cur``'s value verbatim (gauges are levels, not totals).
+
+  The result feeds ``Metrics.merged([delta])`` for quantile-of-the-delta
+  queries, or plain dict reads for rate math."""
+  prev = prev or {}
+  cur = cur or {}
+
+  def c_delta(p: float | None, c: float) -> float:
+    return max(float(c) - float(p or 0.0), 0.0)
+
+  def h_delta(ph: dict | None, ch: dict) -> dict:
+    cb = list(ch.get("buckets", []))
+    cc = [int(x) for x in ch.get("counts", [])]
+    if ph and list(ph.get("buckets", [])) == cb and len(ph.get("counts", [])) == len(cc):
+      pc = [int(x) for x in ph["counts"]]
+      return {
+        "buckets": cb,
+        "counts": [max(a - b, 0) for a, b in zip(cc, pc)],
+        "sum": c_delta(ph.get("sum", 0.0), ch.get("sum", 0.0)),
+      }
+    return {"buckets": cb, "counts": cc, "sum": float(ch.get("sum", 0.0))}
+
+  prev_lc = {name: {tuple(map(tuple, k)): v for k, v in series} for name, series in (prev.get("labeled_counters") or {}).items()}
+  prev_lh = {name: {tuple(map(tuple, k)): h for k, h in series} for name, series in (prev.get("labeled_histograms") or {}).items()}
+  prev_summ = prev.get("summaries") or {}
+  return {
+    "counters": {name: c_delta((prev.get("counters") or {}).get(name), v) for name, v in (cur.get("counters") or {}).items()},
+    "labeled_counters": {
+      name: [[list(map(list, tuple(map(tuple, k)))), c_delta(prev_lc.get(name, {}).get(tuple(map(tuple, k))), v)] for k, v in series]
+      for name, series in (cur.get("labeled_counters") or {}).items()
+    },
+    "gauges": dict(cur.get("gauges") or {}),
+    "labeled_gauges": {name: [[list(map(list, k)), v] for k, v in series] for name, series in (cur.get("labeled_gauges") or {}).items()},
+    "summaries": {
+      name: [c_delta((prev_summ.get(name) or [0, 0])[0], s), int(c_delta((prev_summ.get(name) or [0, 0])[1], c))]
+      for name, (s, c) in (cur.get("summaries") or {}).items()
+    },
+    "histograms": {name: h_delta((prev.get("histograms") or {}).get(name), h) for name, h in (cur.get("histograms") or {}).items()},
+    "labeled_histograms": {
+      name: [[list(map(list, tuple(map(tuple, k)))), h_delta(prev_lh.get(name, {}).get(tuple(map(tuple, k))), h)] for k, h in series]
+      for name, series in (cur.get("labeled_histograms") or {}).items()
+    },
+  }
+
+
 metrics = Metrics()
