@@ -103,6 +103,16 @@ def gate_kv_tier(value: float | None, lo: float = 0.01, hi: float = 1000.0) -> f
   return float(value) if lo <= value <= hi else None
 
 
+def gate_disagg(value: float | None, lo: float = 0.001, hi: float = 10000.0) -> float | None:
+  """Drift gate for the disagg round's numbers (ISSUE 10): TTFT/ITL-ratio/
+  GB-s values outside a generous plausibility band are timing artifacts (a
+  stalled fixture or a block_until_ready tunnel fluke), not results — emit
+  null rather than poison the tracked record. Same band-check as
+  ``gate_kv_tier``, kept as a named gate so each field's bounds are pinned
+  independently in test_bench_gate."""
+  return gate_kv_tier(value, lo=lo, hi=hi)
+
+
 def gate_failover(recovery_ms: float | None, lo: float = 1.0, hi: float = 120000.0) -> float | None:
   """Sanity-gate the failover round's recovery latency (same drift-gate
   pattern). Recovery = kill-to-next-client-visible-token on the localhost
@@ -329,6 +339,170 @@ def bench_failover_recovery(n_drills: int = 3) -> tuple[float | None, int | None
       os.environ.pop("XOT_TPU_RETRY_DELAY_S", None)
     else:
       os.environ["XOT_TPU_RETRY_DELAY_S"] = old_delay
+
+
+def bench_disagg(n_burst: int = 4, n_resident_tokens: int = 96, n_burst_tokens: int = 8) -> tuple[float | None, float | None, float | None]:
+  """Disaggregated prefill/decode round (ISSUE 10) on the localhost two-node
+  gRPC ring with a tiny-but-real jax model: a RESIDENT decode stream runs
+  while a chunked-prefill BURST arrives — the exact interference the
+  colocated scheduler cannot avoid. Phase A (colocated, single node): the
+  burst's prefill chunks interleave with the resident stream's decode
+  chunks. Phase B (disagg: prefill node + decode node): prefill runs on
+  node 0, decode on node 1, KV pages stream between them.
+
+  Returns (disagg_ttft_ms_p50, disagg_vs_colocated_itl_p50, kv_stream_gbps):
+  burst TTFT p50 under disagg, the resident stream's mid-burst ITL p50
+  ratio disagg/colocated (≤1 ⇒ the decode node is undisturbed), and the
+  measured KV-page transfer rate from the ``kv_stream`` timeline stages."""
+  import asyncio
+
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+  from xotorch_support_jetson_tpu.networking.discovery import Discovery
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+  from xotorch_support_jetson_tpu.topology.device_capabilities import DeviceCapabilities, DeviceFlops
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  class _Static(Discovery):
+    def __init__(self, peers):
+      self._peers = peers
+
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+    async def discover_peers(self, wait_for_peers: int = 0):
+      return self._peers
+
+  prompt = [(i % 250) + 2 for i in range(96)]
+
+  class _Tok:
+    eos_token_id = None
+
+    def encode(self, p):
+      return list(prompt)
+
+    def decode(self, toks):
+      return " ".join(map(str, toks))
+
+  caps = DeviceCapabilities(model="bench", chip="cpu", memory=1024, flops=DeviceFlops(1, 2, 4))
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512)
+  params, shard = full_model_params(jax.random.PRNGKey(0), cfg, "m")
+  overrides = {
+    "XOT_TPU_DISAGG": "1", "XOT_TPU_PAGE_SIZE": "16", "XOT_TPU_PREFILL_CHUNK": "32",
+    "XOT_TPU_BATCH_CHUNK": "4", "XOT_TPU_BATCH_SLOTS": "6",
+  }
+  saved = {k: os.environ.get(k) for k in overrides}
+  os.environ.update(overrides)
+
+  async def phase(tag: str, disagg: bool) -> tuple[float | None, float | None, float | None]:
+    n_nodes = 2 if disagg else 1
+    ports = [find_available_port("127.0.0.1") for _ in range(n_nodes)]
+    ids = [f"bench-dis-{tag}{i}" for i in range(n_nodes)]
+    nodes = []
+    for i in range(n_nodes):
+      engine = JaxShardedInferenceEngine(use_local_mesh=False)
+      engine.load_test_model(shard, cfg, params, tokenizer=_Tok())
+      peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "bench", caps) for j in range(n_nodes) if j != i]
+      node = Node(ids[i], None, engine, _Static(peers), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=512, default_sample_temp=0.0)
+      node.server = GRPCServer(node, "127.0.0.1", ports[i])
+      node.disagg_role = ("prefill" if i == 0 else "decode") if disagg else "both"
+      nodes.append(node)
+    await asyncio.gather(*(n.start() for n in nodes))
+    try:
+      for _ in range(100):
+        if all(len(n.topology.nodes) == n_nodes for n in nodes):
+          break
+        await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+        await asyncio.sleep(0.05)
+
+      arrivals: dict[str, list[float]] = {}
+      done: dict[str, asyncio.Event] = {}
+
+      def on_tok(rid, toks, fin):
+        if toks:
+          arrivals.setdefault(rid, []).extend([time.perf_counter()] * len(toks))
+        if fin and rid in done:
+          done[rid].set()
+
+      nodes[0].on_token.register(f"bench-dis-{tag}").on_next(on_tok)
+
+      def start_req(rid: str, max_tokens: int):
+        nodes[0].set_request_options(rid, max_tokens=max_tokens, temperature=0.0)
+        done[rid] = asyncio.Event()
+        return asyncio.ensure_future(nodes[0]._batched_serve(shard, shard, "p", rid))
+
+      resident = f"res-{tag}"
+      t_res = start_req(resident, n_resident_tokens)
+      while not arrivals.get(resident):
+        await asyncio.sleep(0.005)
+      t_burst_start = time.perf_counter()
+      burst_ids = [f"burst-{tag}{k}" for k in range(n_burst)]
+      submits = {}
+      tasks = []
+      for rid in burst_ids:
+        submits[rid] = time.perf_counter()
+        tasks.append(start_req(rid, n_burst_tokens))
+      await asyncio.wait_for(asyncio.gather(*(done[r].wait() for r in burst_ids)), timeout=300)
+      t_burst_end = time.perf_counter()
+      await asyncio.wait_for(done[resident].wait(), timeout=300)
+      await asyncio.wait_for(asyncio.gather(t_res, *tasks), timeout=300)
+
+      # Resident ITL over the burst window only — the contended span.
+      # Tokens arrive in delivery chunks (several share one timestamp), so
+      # the honest per-token figure is each inter-chunk gap amortized over
+      # the tokens that gap produced — p50 over those, weighted by tokens.
+      ts = [t for t in arrivals.get(resident, []) if t_burst_start <= t <= t_burst_end]
+      uniq, counts = (np.unique(np.asarray(ts), return_counts=True)) if ts else (np.asarray([]), np.asarray([]))
+      per_tok = []
+      for j in range(1, uniq.size):
+        per_tok.extend([(uniq[j] - uniq[j - 1]) / counts[j] * 1e3] * int(counts[j]))
+      itl_p50 = float(np.percentile(np.asarray(per_tok), 50)) if per_tok else None
+      ttfts = [
+        (arrivals[r][0] - submits[r]) * 1e3 for r in burst_ids if arrivals.get(r)
+      ]
+      ttft_p50 = float(np.percentile(np.asarray(ttfts), 50)) if ttfts else None
+      gbps = None
+      if disagg:
+        bytes_total = 0
+        ms_total = 0.0
+        for rid in [resident, *burst_ids]:
+          tl = tracer.timeline_export(rid) or {}
+          for e in tl.get("events", []):
+            if e.get("stage") == "kv_stream":
+              bytes_total += int(e["attributes"].get("bytes", 0))
+              ms_total += float(e["attributes"].get("ms", 0.0))
+        if bytes_total and ms_total:
+          gbps = bytes_total / (ms_total / 1e3) / 1e9
+      if os.getenv('XOT_BENCH_DEBUG'):
+        print('phase', tag, 'res_arrivals', len(arrivals.get(resident, [])), 'in_window', len(ts), 'itl', itl_p50, 'ttft', ttft_p50, 'burst_span', round(t_burst_end - t_burst_start, 3))
+      return itl_p50, ttft_p50, gbps
+    finally:
+      for n in nodes:
+        await n.stop()
+
+  try:
+    colo_itl, _colo_ttft, _ = asyncio.run(phase("c", False))
+    dis_itl, dis_ttft, gbps = asyncio.run(phase("d", True))
+  finally:
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+  ratio = round(dis_itl / colo_itl, 4) if (dis_itl and colo_itl) else None
+  return (
+    gate_disagg(round(dis_ttft, 2) if dis_ttft is not None else None, lo=0.01, hi=600000.0),
+    gate_disagg(ratio, lo=0.001, hi=1000.0),
+    gate_disagg(round(gbps, 4) if gbps is not None else None, lo=1e-6, hi=10000.0),
+  )
 
 
 def plausible_value(rec: dict) -> float | None:
@@ -1175,6 +1349,20 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
       pass
 
+  # Disaggregated prefill/decode round (ISSUE 10, behind gate_disagg):
+  # chunked-prefill burst + resident decode on the localhost two-node ring,
+  # disagg vs colocated. Null on CPU rounds like the other cluster benches —
+  # the behavior (token identity, fallback, adoption) is pinned by
+  # tests/test_disagg.py there; the accel round records the measured numbers.
+  disagg_ttft_ms_p50 = None
+  disagg_vs_colocated_itl_p50 = None
+  kv_stream_gbps = None
+  if on_accel:
+    try:
+      disagg_ttft_ms_p50, disagg_vs_colocated_itl_p50, kv_stream_gbps = bench_disagg()
+    except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+      pass
+
   # 8B-geometry int8 decode: the measurable v5e-1 stand-in for BASELINE
   # configs 2/3 (8B-class serving). bf16 8B (~16 GB) exceeds one v5e chip's
   # HBM, so weights are generated AND quantized leaf-by-leaf (the full bf16
@@ -1503,6 +1691,9 @@ def main() -> None:
         "hop_rpc_ms_p50": hop_rpc_ms_p50,
         "failover_recovery_ms_p50": failover_recovery_ms_p50,
         "requests_lost": requests_lost,
+        "disagg_ttft_ms_p50": disagg_ttft_ms_p50,
+        "disagg_vs_colocated_itl_p50": disagg_vs_colocated_itl_p50,
+        "kv_stream_gbps": kv_stream_gbps,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "ttft_ms_spread": round(ttft_spread_ms, 2),
         "ttft_vs_prev": ttft_vs_prev,

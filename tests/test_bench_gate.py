@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_slo, gate_spec_batch, plausible_value
+from bench import gate_disagg, gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_slo, gate_spec_batch, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -176,3 +176,21 @@ def test_committed_r02_artifact_is_filtered():
     rec = rec["parsed"]
   v = plausible_value(rec)
   assert v is not None and v < 1000.0, "poisoned r02 headline leaked through the filter"
+
+
+def test_disagg_gate_keeps_plausible_values():
+  """ISSUE 10: the disagg round's emitted numbers (burst TTFT ms, resident
+  ITL ratio disagg/colocated, KV-transfer GB/s) ride the same drift-gate
+  pattern as gate_kv_tier — generous plausibility bands, custom per field."""
+  assert gate_disagg(114.5, lo=0.01, hi=600000.0) == 114.5
+  assert gate_disagg(0.85, lo=0.001, hi=1000.0) == 0.85
+  assert gate_disagg(1.2, lo=0.001, hi=1000.0) == 1.2  # >1 is reportable, not an artifact
+  assert gate_disagg(3.5, lo=1e-6, hi=10000.0) == 3.5
+
+
+def test_disagg_gate_drops_artifacts():
+  assert gate_disagg(None) is None
+  assert gate_disagg(0.0) is None  # a zero latency/rate is a broken fixture
+  assert gate_disagg(-2.0, lo=0.001, hi=1000.0) is None
+  assert gate_disagg(1e9, lo=0.01, hi=600000.0) is None
+  assert gate_disagg(2000.0, lo=0.001, hi=1000.0) is None

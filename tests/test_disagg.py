@@ -1,0 +1,416 @@
+"""Disaggregated prefill/decode suite (ISSUE 10).
+
+Covers every acceptance point: the serialization raw-bytes fast path's
+shape/dtype pin, the placement policy, the wire-adopt → restore cycle, the
+``XOT_TPU_DISAGG=0`` byte-identity pin, and the REAL two-node gRPC fixture —
+a request prefilled on node A and decoded on node B streams token-identical
+to the single-node baseline (lookahead on AND off), and a decode target
+killed mid-transfer falls back to a local resume with no hang.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from xotorch_support_jetson_tpu.inference import sched_admission
+from xotorch_support_jetson_tpu.networking.faults import FaultRule, chaos
+from xotorch_support_jetson_tpu.networking.grpc import kv_stream_pb2 as pbkv
+from xotorch_support_jetson_tpu.networking.grpc.serialization import (
+  kv_pages_to_proto,
+  proto_payload_bytes,
+  proto_to_kv_pages,
+  proto_to_tensor,
+  tensor_to_proto,
+)
+from xotorch_support_jetson_tpu.networking.retry import breakers, peer_health
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+PROMPT = [3, 25, 9, 7, 1, 88, 42, 5, 100, 11, 60]  # 11 tokens: 2 full pages at ps=4
+
+
+@pytest.fixture(autouse=True)
+def _clean_cluster_state(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_RETRY_DELAY_S", "0.05")
+  chaos.clear()
+  breakers.reset()
+  peer_health.reset()
+  yield
+  chaos.clear()
+  breakers.reset()
+  peer_health.reset()
+
+
+# ------------------------------------------------- serialization fast path
+
+
+def test_tensor_roundtrip_pins_shape_dtype_and_zero_copy_receive():
+  """The raw-bytes fast path (ISSUE 10 satellite): contiguous int8/uint8
+  arrays round-trip with shape/dtype exact, non-contiguous views serialize
+  correctly WITHOUT the historical ascontiguousarray pre-copy, and the
+  receive side is a zero-copy read-only view over the message buffer."""
+  for dtype in (np.int8, np.uint8, np.int32, np.float32):
+    a = np.arange(24, dtype=dtype).reshape(2, 3, 4)
+    out = proto_to_tensor(tensor_to_proto(a))
+    assert out.shape == a.shape and out.dtype == a.dtype
+    assert np.array_equal(out, a)
+    # Zero-copy receive: a frombuffer view, not an owning copy.
+    assert out.base is not None and not out.flags.writeable
+  # Non-contiguous view: tobytes() emits C-order bytes in one pass.
+  base = np.arange(64, dtype=np.int8).reshape(8, 8)
+  view = base[::2, 1::3]
+  assert not view.flags.c_contiguous
+  out = proto_to_tensor(tensor_to_proto(view))
+  assert np.array_equal(out, np.ascontiguousarray(view))
+  # bf16 survives end-to-end (the historical contract).
+  import ml_dtypes
+
+  b = np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 3)
+  out = proto_to_tensor(tensor_to_proto(b))
+  assert out.dtype == b.dtype and np.array_equal(out.astype(np.float32), b.astype(np.float32))
+
+
+def test_kv_page_batch_roundtrip_and_payload_accounting():
+  """The KV-page stream message: leaves round-trip exactly (int8 codes are
+  1 byte/element on the wire) and the batch is counted by
+  ``proto_payload_bytes`` like every data-plane message."""
+  keys = [b"\x01" * 16, b"\x02" * 16]
+  leaves = {
+    "k": np.arange(2 * 2 * 4 * 3, dtype=np.int8).reshape(2, 2, 4, 3),
+    "k_scale": np.linspace(0, 1, 2 * 2 * 4, dtype=np.float32).reshape(2, 2, 4),
+  }
+  msg = kv_pages_to_proto("req-1", keys, leaves, page_size=4, seq=3, last=True, origin="nodeA")
+  wire = msg.SerializeToString()
+  back = pbkv.KvPageBatch.FromString(wire)
+  assert back.request_id == "req-1" and back.seq == 3 and back.last and back.origin == "nodeA"
+  out_keys, out_leaves = proto_to_kv_pages(back)
+  assert out_keys == keys
+  for name, arr in leaves.items():
+    assert out_leaves[name].dtype == arr.dtype and out_leaves[name].shape == arr.shape
+    assert np.array_equal(out_leaves[name], arr)
+  payload = proto_payload_bytes(msg)
+  raw = sum(a.nbytes for a in leaves.values())
+  assert payload >= raw  # the int8 codes dominate and ride uninflated
+  assert payload < raw + 1024  # framing overhead only — no base64-style blowup
+
+
+# ----------------------------------------------------------- placement policy
+
+
+def test_choose_decode_node_prefers_dedicated_role_then_free_pages():
+  stats = {
+    "d1": {"role": "decode", "free_pages": 10, "queue_depth": 3},
+    "d2": {"role": "decode", "free_pages": 40, "queue_depth": 5},
+    "b1": {"role": "both", "free_pages": 500, "queue_depth": 0},
+    "p1": {"role": "prefill", "free_pages": 900, "queue_depth": 0},
+  }
+  # Dedicated decode nodes outrank 'both'; free pages orders within the tier.
+  assert sched_admission.choose_decode_node(stats, self_id="me", self_role="prefill") == "d2"
+  # A 'both' node only hands off to DEDICATED decode peers (no ping-pong).
+  only_both = {"b1": {"role": "both", "free_pages": 500}, "b2": {"role": "both", "free_pages": 900}}
+  assert sched_admission.choose_decode_node(only_both, self_id="b1", self_role="both") is None
+  # A prefill node may fall back to a 'both' peer.
+  assert sched_admission.choose_decode_node(only_both, self_id="me", self_role="prefill") == "b2"
+  # Queue depth breaks free-page ties; self and prefill-only peers never match.
+  tie = {
+    "d1": {"role": "decode", "free_pages": 10, "queue_depth": 9},
+    "d2": {"role": "decode", "free_pages": 10, "queue_depth": 1},
+  }
+  assert sched_admission.choose_decode_node(tie, self_id="d9", self_role="both") == "d2"
+  # Unknown capacity (no advertised free_pages) ranks LAST within the tier:
+  # a peer with a real pool must never lose to one that may not have one —
+  # but an unknown-capacity peer still wins as the only candidate.
+  unknown = {"d1": {"role": "decode"}, "d2": {"role": "decode", "free_pages": 3, "queue_depth": 9}}
+  assert sched_admission.choose_decode_node(unknown, self_id="me", self_role="prefill") == "d2"
+  assert sched_admission.choose_decode_node({"d1": {"role": "decode"}}, self_id="me", self_role="both") == "d1"
+  assert sched_admission.choose_decode_node({}, self_id="me") is None
+
+
+def test_choose_prefill_node_orders_by_queue_drain_estimate():
+  stats = {
+    "p1": {"role": "prefill", "est_drain_ms": 900.0, "queue_depth": 1},
+    "p2": {"role": "prefill", "est_drain_ms": 30.0, "queue_depth": 8},
+    "b1": {"role": "both", "est_drain_ms": 1.0, "queue_depth": 0},
+    "d1": {"role": "decode", "est_drain_ms": 0.0, "queue_depth": 0},
+  }
+  # Dedicated prefill nodes outrank 'both'; the drain estimate orders them.
+  assert sched_admission.choose_prefill_node(stats, self_id="me") == "p2"
+  # Decode-only peers are never prefill targets.
+  assert sched_admission.choose_prefill_node({"d1": {"role": "decode"}}, self_id="me") is None
+  # Without estimates, queue depth orders (scaled as a pseudo-estimate).
+  cold = {"p1": {"role": "prefill", "queue_depth": 5}, "p2": {"role": "prefill", "queue_depth": 1}}
+  assert sched_admission.choose_prefill_node(cold, self_id="me") == "p2"
+
+
+def test_role_and_disagg_env_defaults(monkeypatch):
+  monkeypatch.delenv("XOT_TPU_ROLE", raising=False)
+  monkeypatch.delenv("XOT_TPU_DISAGG", raising=False)
+  assert sched_admission.node_role() == "both"
+  assert not sched_admission.disagg_enabled()  # unset = colocated, byte-identical
+  monkeypatch.setenv("XOT_TPU_ROLE", "PREFILL ")
+  assert sched_admission.node_role() == "prefill"
+  monkeypatch.setenv("XOT_TPU_ROLE", "nonsense")
+  assert sched_admission.node_role() == "both"  # unrecognized degrades safely
+  monkeypatch.setenv("XOT_TPU_DISAGG", "0")
+  assert not sched_admission.disagg_enabled()
+  monkeypatch.setenv("XOT_TPU_DISAGG", "1")
+  assert sched_admission.disagg_enabled()
+
+
+# ---------------------------------------------------------- wire adoption unit
+
+
+def test_adopt_wire_geometry_guard_and_budget(monkeypatch):
+  """adopt_wire stores per-page host entries in the restore layout, refuses
+  foreign geometry (mixing layouts would poison later restores), and the
+  byte budget still evicts."""
+  from xotorch_support_jetson_tpu.inference.kv_tier import KvTierManager
+
+  tier = KvTierManager(page_size=4, read_pages=lambda p: (None, 0), write_pages=lambda p, d: None, budget_bytes=1 << 20)
+  keys = [bytes([i]) * 16 for i in range(3)]
+  leaves = {"k": np.arange(2 * 3 * 4, dtype=np.int8).reshape(2, 3, 4)}
+  assert tier.adopt_wire(keys, leaves) == 3
+  assert tier.host_pages == 3 and all(tier.host_has(k) for k in keys)
+  per_page = 2 * 4  # [L=2, ps-dim 4] int8
+  assert tier.host_bytes == 3 * per_page
+  # Restore layout: host_run finds the contiguous run.
+  assert tier.host_run(keys, 0, 3) == keys
+  # Foreign geometry refused, store untouched.
+  assert tier.adopt_wire([b"\xaa" * 16], {"k": np.zeros((2, 1, 9), np.int8)}) == 0
+  assert tier.host_pages == 3
+  # Budget pressure evicts oldest entries (adopted pages are plain entries).
+  small = KvTierManager(page_size=4, read_pages=lambda p: (None, 0), write_pages=lambda p, d: None, budget_bytes=2 * per_page)
+  assert small.adopt_wire(keys, leaves) == 3
+  assert small.host_pages == 2 and small.host_bytes <= 2 * per_page
+
+
+# ------------------------------------------------------ DISAGG=0 identity pin
+
+
+def test_disagg_off_never_consults_placement(monkeypatch):
+  """XOT_TPU_DISAGG unset/0 is byte-identical to the colocated scheduler:
+  the placement policy is never consulted, no request carries a disagg
+  target, and the stream matches the solo greedy reference."""
+  import jax
+
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from tests.test_batched import CFG, KEY, _single_row_reference
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  monkeypatch.delenv("XOT_TPU_DISAGG", raising=False)
+
+  def poisoned(*a, **k):  # noqa: ANN001
+    raise AssertionError("placement consulted with XOT_TPU_DISAGG off")
+
+  monkeypatch.setattr(sched_admission, "choose_decode_node", poisoned)
+  monkeypatch.setattr(sched_admission, "choose_prefill_node", poisoned)
+
+  params, shard = full_model_params(KEY, CFG, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  n = 8
+  expected = _single_row_reference(params, shard, PROMPT, n - 1)
+  server = engine.get_batched_server()
+  try:
+    got = asyncio.run(server.submit(
+      "off-req", np.asarray(PROMPT, np.int32), max_tokens=n, temp=0.0, top_k=35, eos_ids=(), emit=lambda *_: None,
+    ))
+    assert got == expected
+    assert all(s is None for s in server.slots)
+  finally:
+    server.shutdown()
+
+
+# ------------------------------------------------------- two-node gRPC fixture
+
+
+async def _make_disagg_cluster(monkeypatch, ids, ports):
+  """Two full-model jax nodes on a localhost gRPC ring: node 0 = prefill,
+  node 1 = decode (roles overridden per node — both share the process env)."""
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from tests.test_batched import CFG, KEY
+  from tests.test_networking import CAPS, StaticDiscovery
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+  class _Tok:
+    eos_token_id = None
+
+    def encode(self, prompt):
+      return list(PROMPT)
+
+    def decode(self, toks):
+      return " ".join(map(str, toks))
+
+  params, shard = full_model_params(KEY, CFG, "m")
+  nodes = []
+  for i in range(2):
+    engine = JaxShardedInferenceEngine(use_local_mesh=False)
+    engine.load_test_model(shard, CFG, params, tokenizer=_Tok())
+    peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "test", CAPS) for j in range(2) if j != i]
+    node = Node(
+      ids[i], None, engine, StaticDiscovery(peers), None,
+      RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=200, default_sample_temp=0.0,
+    )
+    node.server = GRPCServer(node, "127.0.0.1", ports[i])
+    node.disagg_role = "prefill" if i == 0 else "decode"
+    nodes.append(node)
+  await asyncio.gather(*(n.start() for n in nodes))
+  for _ in range(100):
+    if all(len(n.topology.nodes) == 2 for n in nodes):
+      break
+    await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+    await asyncio.sleep(0.05)
+  return nodes, params, shard
+
+
+def _disagg_env(monkeypatch, lookahead: bool):
+  monkeypatch.setenv("XOT_TPU_DISAGG", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")  # 11-token prompt → 2 full pages
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "8")  # 2 chunks: transfer overlaps prefill
+  monkeypatch.setenv("XOT_TPU_BATCH_CHUNK", "2")
+  monkeypatch.setenv("XOT_TPU_SCHED_LOOKAHEAD", "1" if lookahead else "0")
+
+
+async def _drive_disagg_request(nodes, shard, rid, n_tokens, timeout=90):
+  collected: list[int] = []
+  done = asyncio.Event()
+
+  def on_tok(r, toks, fin):
+    if r != rid:
+      return
+    collected.extend(toks)
+    if fin:
+      done.set()
+
+  nodes[0].set_request_options(rid, max_tokens=n_tokens, temperature=0.0)
+  nodes[0].on_token.register(f"disagg-{rid}").on_next(on_tok)
+  serve = asyncio.ensure_future(nodes[0]._batched_serve(shard, shard, "prompt", rid))
+  await asyncio.wait_for(done.wait(), timeout=timeout)
+  await asyncio.wait_for(serve, timeout=timeout)
+  return collected
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("lookahead", [True, False], ids=["lookahead", "sync"])
+async def test_two_node_disagg_stream_token_identical(monkeypatch, lookahead):
+  """Acceptance (ISSUE 10): a request prefilled on node A and decoded on
+  node B streams token-identical to the single-node colocated baseline;
+  the KV pages crossed the wire and B's admission restore-adopted them."""
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+  from tests.test_batched import _single_row_reference
+
+  _disagg_env(monkeypatch, lookahead)
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  ids = [f"dis{'la' if lookahead else 'sy'}0", f"dis{'la' if lookahead else 'sy'}1"]
+  nodes, params, shard = await _make_disagg_cluster(monkeypatch, ids, ports)
+  try:
+    n_tokens = 12
+    expected = _single_row_reference(params, shard, PROMPT, n_tokens - 1)
+    streamed_before = gm.counter_value("kv_stream_pages_total")
+    adopted_before = gm.counter_value("kv_stream_adopted_pages_total")
+    handoffs_before = gm.counter_value("disagg_handoffs_total")
+    restored_before = gm.counter_value("kv_tier_restored_pages_total")
+
+    rid = f"disagg-req-{ids[0]}"
+    collected = await _drive_disagg_request(nodes, shard, rid, n_tokens)
+
+    assert collected == expected
+    # The handoff really happened and the pages really crossed the wire.
+    assert gm.counter_value("disagg_handoffs_total") == handoffs_before + 1
+    assert gm.counter_value("kv_stream_pages_total") >= streamed_before + 2
+    assert gm.counter_value("kv_stream_adopted_pages_total") >= adopted_before + 2
+    # B's admission extended its prefix hit from the adopted pages instead
+    # of recomputing the full prefill.
+    assert gm.counter_value("kv_tier_restored_pages_total") >= restored_before + 2
+    # The decode node's scheduler (not A's) ran the decode chunks.
+    srv_b = nodes[1].inference_engine.get_batched_server()
+    assert all(s is None for s in srv_b.slots)  # finished clean
+    # Timeline carries the disagg stages on the prefill node.
+    from xotorch_support_jetson_tpu.orchestration.tracing import tracer
+
+    tl = tracer.timeline_export(rid) or {}
+    stages = {e.get("stage") for e in tl.get("events", [])}
+    assert "disagg_handoff" in stages and "kv_stream" in stages
+  finally:
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_decode_target_killed_mid_transfer_falls_back_locally(monkeypatch):
+  """Acceptance (ISSUE 10): the decode target dies after the first KV batch
+  but before the handoff — the prefill node resumes locally via
+  carry_tokens, the stream finishes token-identical, and nothing hangs.
+  A dead decode target must never strand a prefilled context."""
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+  from tests.test_batched import _single_row_reference
+
+  _disagg_env(monkeypatch, True)
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  ids = ["diskill0", "diskill1"]
+  nodes, params, shard = await _make_disagg_cluster(monkeypatch, ids, ports)
+  try:
+    # Prime the placement cache while the target is still healthy, THEN
+    # darken it: later KV batches and the handoff SendTensor both fail.
+    await nodes[0].collect_disagg_stats(timeout=2.0)
+    assert ids[1] in nodes[0]._disagg_stats
+    chaos.install(FaultRule(peer=ids[1], method="SendKvPages", kind="error", after=1))
+    chaos.install(FaultRule(peer=ids[1], method="SendTensor", kind="error"))
+
+    n_tokens = 10
+    expected = _single_row_reference(params, shard, PROMPT, n_tokens - 1)
+    admissions_before = gm.counter_value("scheduler_admissions_total")
+    rid = "disagg-kill-req"
+    collected = await _drive_disagg_request(nodes, shard, rid, n_tokens, timeout=90)
+
+    assert collected == expected
+    # The fallback re-admitted the extracted row locally (initial admission
+    # + carry_tokens resume), and A's pool fully recovered.
+    assert gm.counter_value("scheduler_admissions_total") >= admissions_before + 2
+    srv_a = nodes[0].inference_engine.get_batched_server()
+    assert all(s is None for s in srv_a.slots)
+    assert not srv_a.busy()
+  finally:
+    chaos.clear()
+    for n in nodes:
+      await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_disagg_api_endpoint(monkeypatch):
+  """GET /v1/disagg surfaces the disaggregation state: role, enabled flag,
+  the cached peer adverts placement reads, and the transfer totals."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from tests_support_stubs import NoDiscovery, StubServer
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  monkeypatch.setenv("XOT_TPU_DISAGG", "1")
+  monkeypatch.setenv("XOT_TPU_ROLE", "prefill")
+  node = Node("disagg-api-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/disagg")
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["enabled"] is True and body["role"] == "prefill"
+    assert set(body) >= {"local", "peers", "handoffs_total", "kv_stream_pages_total", "kv_stream_bytes_total", "kv_stream_adopted_pages_total"}
+    assert body["local"]["role"] == "prefill"
+    # The role gauge landed at node start: 1 = prefill.
+    assert gm.gauges.get("node_role") == 1
+    # scope=cluster with no peers degrades gracefully.
+    resp = await client.get("/v1/disagg?scope=cluster")
+    assert resp.status == 200
+  finally:
+    await client.close()
+    await node.stop()

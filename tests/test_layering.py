@@ -1,0 +1,58 @@
+"""Tier-1 wiring for scripts/check_layering.py (ISSUE 10 satellite).
+
+The scheduler split is admission/placement (inference/sched_admission.py)
+vs device execution (inference/batch_scheduler.py); the split stays real
+only while the admission layer never imports the execution layer (or the
+networking transport). Wired next to tests/test_metrics_docs.py — same
+lexical-gate pattern, AST-based matcher."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _checker():
+  sys.path.insert(0, str(REPO / "scripts"))
+  try:
+    import check_layering
+  finally:
+    sys.path.pop(0)
+  return check_layering
+
+
+def test_admission_layer_never_imports_execution_layer():
+  problems = _checker().check()
+  assert not problems, "layering drifted:\n" + "\n".join(f"  - {p}" for p in problems)
+
+
+def test_checker_catches_a_planted_reverse_import(tmp_path):
+  """The gate actually bites: a copy of the admission module with a
+  function-local, aliased, relative import of the execution module fails."""
+  check_layering = _checker()
+  src = (REPO / "xotorch_support_jetson_tpu" / "inference" / "sched_admission.py").read_text()
+  planted = src + (
+    "\n\ndef _smuggle():\n"
+    "  from .batch_scheduler import BatchedServer as _B\n"
+    "  return _B\n"
+  )
+  pkg = tmp_path / "xotorch_support_jetson_tpu" / "inference"
+  pkg.mkdir(parents=True)
+  (pkg / "sched_admission.py").write_text(planted)
+  old_repo = check_layering.REPO
+  try:
+    check_layering.REPO = tmp_path
+    problems = [p for p in check_layering.check() if "batch_scheduler" in p]
+    assert problems, "planted reverse import was not detected"
+  finally:
+    check_layering.REPO = old_repo
+
+
+def test_checker_cli_exit_status():
+  proc = subprocess.run(
+    [sys.executable, str(REPO / "scripts" / "check_layering.py")],
+    capture_output=True, text=True, timeout=60,
+  )
+  assert proc.returncode == 0, proc.stdout + proc.stderr
+  assert "check_layering: OK" in proc.stdout

@@ -699,6 +699,11 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_drain_migrations_total",
   "xot_tpu_requests_recovered_total",
   "xot_tpu_requests_stalled_total",
+  # Disaggregated prefill/decode (ISSUE 10)
+  "xot_tpu_kv_stream_pages_total",
+  "xot_tpu_kv_stream_bytes_total",
+  "xot_tpu_kv_stream_adopted_pages_total",
+  "xot_tpu_disagg_handoffs_total",
   # SLO engine + flight recorder (ISSUE 9)
   "xot_tpu_slo_requests_good_total",  # {class}
   "xot_tpu_slo_requests_bad_total",  # {class,reason}
@@ -733,6 +738,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_slo_burn_rate",  # {class,window}
   "xot_tpu_slo_attainment",  # {class}
   "xot_tpu_goodput_tok_s",  # {class}
+  "xot_tpu_node_role",  # 0=both 1=prefill 2=decode (ISSUE 10)
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -746,6 +752,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_kv_tier_spill_seconds",
   "xot_tpu_kv_tier_restore_seconds",
   "xot_tpu_kv_tier_restore_pages_per_op",
+  "xot_tpu_kv_stream_seconds",  # {peer} (ISSUE 10 — disagg KV-page transfer)
   "xot_tpu_prefill_seconds",
   "xot_tpu_decode_step_seconds",
   # per-peer-link RPC attribution (ISSUE 4; labeled {peer,method} / {method})
@@ -841,6 +848,14 @@ def test_metric_name_snapshot_after_serving():
   gm.inc("anomalies_total", 0, labels={"rule": "burn_rate"})
   gm.inc("incident_bundles_total", 0, labels={"trigger": "stall"})
   gm.set_gauge("cluster_nodes_reporting", 1)
+  # Disaggregated prefill/decode (ISSUE 10): emitted by the node's KV
+  # stream / handoff path and the decode-side adopt — off in this drive.
+  gm.inc("kv_stream_pages_total", 0)
+  gm.inc("kv_stream_bytes_total", 0)
+  gm.inc("kv_stream_adopted_pages_total", 0)
+  gm.inc("disagg_handoffs_total", 0)
+  gm.observe_hist("kv_stream_seconds", 0.0, labels={"peer": "peer-0"})
+  gm.set_gauge("node_role", 0)
   gm.set_gauge("slo_burn_rate", 0.0, labels={"class": "standard", "window": "300s"})
   gm.set_gauge("slo_attainment", 1.0, labels={"class": "standard"})
   gm.set_gauge("goodput_tok_s", 0.0, labels={"class": "standard"})

@@ -372,6 +372,7 @@ class ChatGPTAPI:
     r.add_get("/v1/traces", self.handle_traces)
     r.add_get("/v1/requests/{request_id}/timeline", self.handle_request_timeline)
     r.add_get("/v1/kv/tier", self.handle_kv_tier)
+    r.add_get("/v1/disagg", self.handle_disagg)
     r.add_get("/v1/slo", self.handle_slo)
     r.add_get("/v1/events", self.handle_events)
     r.add_post("/v1/debug/bundle", self.handle_debug_bundle)
@@ -532,6 +533,38 @@ class ChatGPTAPI:
       "spilled_pages_total": metrics.counter_value("kv_tier_spilled_pages_total"),
       "restored_pages_total": metrics.counter_value("kv_tier_restored_pages_total"),
       "prefix_registry": prefix_registry.snapshot(),
+    }
+    return web.json_response(body)
+
+  async def handle_disagg(self, request):
+    """GET /v1/disagg — disaggregated-serving state (ISSUE 10): this node's
+    role, whether disagg is enabled, the cached peer role/capacity adverts
+    the placement policy reads, and the transfer/handoff totals.
+
+    ``?scope=cluster`` refreshes the peer adverts over the gRPC
+    opaque-status channel first (best-effort, like ``/v1/kv/tier``)."""
+    from ..inference import sched_admission
+    from ..utils.metrics import metrics
+
+    if request.query.get("scope") == "cluster":
+      collect = getattr(self.node, "collect_disagg_stats", None)
+      if collect is not None:
+        try:
+          await collect()
+        except Exception:  # noqa: BLE001 — refresh degrades to the cached view
+          if DEBUG >= 1:
+            import traceback
+
+            traceback.print_exc()
+    body = {
+      "enabled": sched_admission.disagg_enabled(),
+      "role": getattr(self.node, "disagg_role", sched_admission.node_role()),
+      "local": self.node._disagg_local_stats() if hasattr(self.node, "_disagg_local_stats") else {},
+      "peers": dict(getattr(self.node, "_disagg_stats", {})),
+      "handoffs_total": metrics.counter_value("disagg_handoffs_total"),
+      "kv_stream_pages_total": metrics.counter_value("kv_stream_pages_total"),
+      "kv_stream_bytes_total": metrics.counter_value("kv_stream_bytes_total"),
+      "kv_stream_adopted_pages_total": metrics.counter_value("kv_stream_adopted_pages_total"),
     }
     return web.json_response(body)
 

@@ -101,6 +101,29 @@ pool pressure host-side. ``XOT_TPU_KV_TIER=0`` restores the single-tier
 behavior byte-for-byte (``_Request.carry_tokens`` recompute stays the
 correctness fallback either way).
 
+This module is the DEVICE-EXECUTION half of the scheduler (ISSUE 10 split):
+the slot pool, the paged cache, dispatch/settle, and the lookahead pipeline.
+Everything that happens BEFORE a request touches the device — the queue, the
+QoS refusal ladder, parking, and the disaggregation placement policy — lives
+in ``inference/sched_admission.py`` (``AdmissionControl``), which never
+imports this module (``scripts/check_layering.py`` enforces the direction).
+
+DISAGGREGATED PREFILL/DECODE (ISSUE 10, ``XOT_TPU_DISAGG=1`` +
+``XOT_TPU_ROLE``): a request placed for remote decode (``_Request.
+disagg_target``) prefills here as usual — chunked, into the paged pool —
+while each completed chunk's full int8-KV pages stream to the decode node
+over the gRPC tensor path (``kv_stream`` hook; the transfer overlaps the
+remaining prefill chunks). After the final chunk samples the first token,
+the row is EXTRACTED exactly like a drain migration (pages donated under
+extended chain keys, prompt absorbs the token, ``carry_tokens`` carries the
+emitted span) and handed to the decode node (``kv_handoff`` hook →
+orchestration/node.py), whose admission finds the streamed pages in its
+host tier and restore-adopts them — prefill there recomputes only the last
+partial page. A dead decode target falls back to the local
+``carry_tokens`` resume via the same ``_settle_migration`` path drain uses:
+a prefilled context is never stranded. ``XOT_TPU_DISAGG=0`` (and unset) is
+byte-identical to the colocated scheduler (test-pinned).
+
 Enable with ``XOT_TPU_BATCHED=1`` (orchestration/node.py routes single-node
 full-shard prompts here). ``XOT_TPU_BATCH_SLOTS`` (default 4) and
 ``XOT_TPU_BATCH_CHUNK`` (default 8) size the pool and the emission cadence.
@@ -111,9 +134,7 @@ from __future__ import annotations
 import asyncio
 import os
 import time
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np
@@ -122,40 +143,17 @@ from ..orchestration import slo
 from ..orchestration.tracing import TERMINAL_STAGES, tracer
 from ..utils.helpers import DEBUG
 from ..utils.metrics import FRACTION_BUCKETS, metrics
-from .engine import NodeDrainingError, PromptTooLongError, RequestMigratedError, ServerOverloadedError
-from .qos import DeadlineUnmeetableError, QosPolicy, QosQueue, priority_rank, qos_enabled
+from .engine import PromptTooLongError, RequestMigratedError, ServerOverloadedError
+from .qos import DeadlineUnmeetableError
+from .sched_admission import AdmissionControl, _Request
+
+__all__ = ["BatchedServer", "_Request"]
 
 PREFILL_BUCKET = 128
 
 
 def _round_up(n: int, multiple: int) -> int:
   return ((n + multiple - 1) // multiple) * multiple
-
-
-@dataclass
-class _Request:
-  request_id: str
-  tokens: np.ndarray  # [S] int32 prompt tokens
-  max_tokens: int
-  temp: float
-  top_k: int
-  eos_ids: tuple
-  emit: Callable[[str, list, bool], None]  # (request_id, new_tokens, finished)
-  future: asyncio.Future = None
-  page_demand: int = 0  # pages still needed at the last failed paged admission
-  t_submit: float = 0.0  # perf_counter at submit (queue-wait / TTFT histograms)
-  qos: object = None  # QosTicket (inference/qos.py) when the QoS layer is on
-  # Tokens generated before a QoS preemption: the resumed incarnation's
-  # prompt absorbs them, and every finish path reports carry + new.
-  carry_tokens: list = field(default_factory=list)
-  # perf_counter when the request first parked page-starved (0 = never):
-  # admission emits an ``unparked`` timeline stage with the waited span, so
-  # a timeline query explains page-starvation waits.
-  t_parked: float = 0.0
-  # Measured TTFT of the FIRST incarnation (ISSUE 9): survives a QoS
-  # preempt-resume (the resumed incarnation zeroes t_submit), so goodput's
-  # within-SLO check judges the latency the client actually saw.
-  slo_ttft_s: float | None = None
 
 
 @dataclass
@@ -259,9 +257,15 @@ class BatchedServer:
     # ``k_max`` is static in the compiled program. Requests asking for more
     # than k_max candidates are clipped.
     self.k_max = top_k or int(os.getenv("XOT_TPU_BATCH_TOP_K_MAX", "64"))
-    # Admission backpressure: beyond this many queued requests, submit fails
-    # fast (the API maps it to 429) instead of growing the queue unboundedly.
-    self.max_queue = max_queue if max_queue is not None else int(os.getenv("XOT_TPU_BATCH_MAX_QUEUE", "64"))
+    # Admission & placement layer (inference/sched_admission.py, ISSUE 10
+    # split): owns the queue, the QoS refusal ladder, parking, and the
+    # disagg placement policy. This execution layer drains it at dispatch
+    # boundaries; the reverse import direction is lint-forbidden.
+    self.admission = AdmissionControl(
+      n_slots=self.n_slots,
+      max_queue=max_queue if max_queue is not None else int(os.getenv("XOT_TPU_BATCH_MAX_QUEUE", "64")),
+      qos=qos,
+    )
     # Paged KV cache (default): positions map onto fixed-size pages through
     # per-row block tables (ops/paged.py), so HBM is bounded by aggregate
     # context — XOT_TPU_BATCH_PAGES sizes the pool (default: the dense
@@ -308,31 +312,15 @@ class BatchedServer:
     self._spec_plain_chunks = 0
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
-    # QoS layer (inference/qos.py): priority classes + per-tenant fair
-    # queueing + rate limits + deadline shedding. ``qos=None`` resolves from
-    # the env (XOT_TPU_QOS, default on); ``qos=False`` forces it off; a
-    # QosPolicy instance is used as-is (tests inject clocks/configs). With
-    # QoS OFF the queue is a plain asyncio.Queue and every QoS branch below
-    # is guarded — behavior is byte-identical to the FIFO baseline.
-    if qos is None:
-      self.qos = QosPolicy.from_env() if qos_enabled() else None
-    elif qos is True:
-      self.qos = QosPolicy.from_env()
-    elif qos is False:
-      self.qos = None
-    else:
-      self.qos = qos
-    self.queue: asyncio.Queue[_Request] = QosQueue(self.qos) if self.qos is not None else asyncio.Queue()
-    # Page-starved requests park HERE, ahead of the queue, and retry first
-    # each tick — a large prompt must not lose its position to later-arriving
-    # small requests that would otherwise consume every freed page (ADVICE
-    # r2 fairness/liveness finding). While the head parked request's page
-    # demand is unmet, newer admissions may only use the surplus beyond it.
-    self._parked: deque[_Request] = deque()
-    self._queued: dict[str, _Request] = {}  # request_id → queued request (cancel lookup)
-    self._cancelled_ids: set[str] = set()  # cancels racing mid-admission
-    self._admitting: set[str] = set()  # ids currently inside _admit
     self._loop_task: asyncio.Task | None = None
+    # Disaggregated serving hooks (ISSUE 10), injected by the node layer:
+    # ``kv_stream(request_id, target, keys, dev_leaves, n)`` schedules a
+    # background KV-page transfer of one completed prefill chunk's pages;
+    # ``kv_handoff(req, final_kv) -> awaitable[bool]`` flushes the last
+    # pages and re-submits the extracted row to the decode node. Both None
+    # (and every disagg branch dead) unless the node wired them.
+    self.kv_stream = None
+    self.kv_handoff = None
     # One-chunk-lookahead pipelined decode (module docstring): dispatch chunk
     # N+1 from chunk N's device-resident chain token while N's tokens stream
     # back and the host post-processes. XOT_TPU_SCHED_LOOKAHEAD=0 restores
@@ -369,45 +357,66 @@ class BatchedServer:
     self._drain_deadline = 0.0
     self._drain_attempted: set[str] = set()
 
+  # --------------------------------------------- admission-layer delegation
+  #
+  # The queue-side state lives in the admission layer (ISSUE 10 split);
+  # these views keep the execution code — and a decade of tests poking
+  # ``server._parked`` — reading the same live objects.
+
+  @property
+  def qos(self):
+    return self.admission.qos
+
+  @property
+  def queue(self):
+    return self.admission.queue
+
+  @property
+  def max_queue(self) -> int:
+    return self.admission.max_queue
+
+  @max_queue.setter
+  def max_queue(self, v: int) -> None:
+    self.admission.max_queue = v
+
+  @property
+  def _parked(self):
+    return self.admission.parked
+
+  @property
+  def _queued(self):
+    return self.admission.queued
+
+  @property
+  def _cancelled_ids(self):
+    return self.admission.cancelled_ids
+
+  @property
+  def _admitting(self):
+    return self.admission.admitting
+
+  def _queue_depth_ahead(self, ticket) -> int:
+    return self.admission.queue_depth_ahead(ticket)
+
   # ------------------------------------------------------------- public API
 
-  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit, priority: str = "standard", tenant: str = "default", deadline_ms: float | None = None) -> list:
+  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit, priority: str = "standard", tenant: str = "default", deadline_ms: float | None = None, carry: list | None = None, disagg_target: str | None = None) -> list:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
     ``emit(request_id, new_tokens, finished)`` as chunks complete.
 
     ``priority`` / ``tenant`` / ``deadline_ms`` feed the QoS layer (rate
     limiting, deadline shedding, fair selection); all three are ignored when
-    QoS is disabled."""
-    if self.draining:
-      # No new work on a draining scheduler — a structured, retryable
-      # refusal (the peers already stopped routing here; this covers local
-      # API races inside the announcement window).
-      metrics.inc("scheduler_rejections_total")
-      slo.note_bad(str(priority or "standard"), "rejected")
-      raise NodeDrainingError("node is draining (graceful shutdown announced)")
+    QoS is disabled. ``carry`` (ISSUE 10) marks a WIRE-CARRIED resume: the
+    trailing ``len(carry)`` tokens of ``tokens`` were already streamed to
+    the client by another node (the prefill node's first token), so emit
+    skips them, ``max_tokens`` is the REMAINING budget, and no queue-wait/
+    TTFT is re-observed here. ``disagg_target`` marks the request for
+    remote decode after its local prefill (placement decided by the node —
+    inference/sched_admission.py)."""
     tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
-    ticket = None
-    if self.qos is not None:
-      ticket = self._qos_admit(request_id, int(tokens.shape[0]), int(max_tokens), priority, tenant, deadline_ms)
-    if self.queue.qsize() + len(self._parked) >= self.max_queue:
-      # Under QoS, overload sheds strictly-lower-priority WAITING work first
-      # (a batch request yields its queue spot to interactive traffic); only
-      # when nothing outranked waits does the new request get rejected.
-      if self.qos is None or not self._shed_for(ticket):
-        metrics.inc("scheduler_rejections_total")
-        if self.qos is None:
-          # The QoS path's terminal `rejected` stage feeds availability via
-          # the tracer bridge; the FIFO path has no stage — count it here.
-          slo.note_bad("standard", "rejected")
-        err = ServerOverloadedError(f"request queue full ({self.max_queue} waiting)")
-        if self.qos is not None:
-          # No service was consumed: give the rate-bucket charges back, or
-          # the compliant Retry-After retry would fail again as rate_limited.
-          self.qos.refund(ticket.tenant, int(tokens.shape[0]))
-          err.retry_after_ms = self.qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots)
-          metrics.inc("qos_rejected_total", labels={"class": ticket.priority})
-          tracer.stage(request_id, "rejected", {"reason": "queue_full", "class": ticket.priority, "tenant": ticket.tenant, "retry_after_ms": round(err.retry_after_ms, 1)}, terminal=True)
-        raise err
+    ticket = self.admission.admit(
+      request_id, int(tokens.shape[0]), int(max_tokens), priority, tenant, deadline_ms, draining=self.draining,
+    )
     req = _Request(
       request_id=request_id,
       tokens=tokens,
@@ -417,85 +426,17 @@ class BatchedServer:
       eos_ids=tuple(int(e) for e in eos_ids),
       emit=emit,
       future=asyncio.get_event_loop().create_future(),
-      t_submit=time.perf_counter(),
+      t_submit=0.0 if carry else time.perf_counter(),
       qos=ticket,
+      disagg_target=disagg_target,
     )
-    self._queued[request_id] = req
-    metrics.inc("scheduler_submitted_total")
-    tracer.stage(request_id, "queued", {"queue_depth": self.queue.qsize() + len(self._parked)})
-    await self.queue.put(req)
+    if carry:
+      req.carry_tokens = list(carry)
+    await self.admission.enqueue(req)
     self._update_gauges()
     if self._loop_task is None or self._loop_task.done():
       self._loop_task = asyncio.create_task(self._run())
     return await req.future
-
-  def _qos_admit(self, request_id: str, prompt_tokens: int, max_tokens: int, priority, tenant, deadline_ms):
-    """QoS admission pass (rate limits, deadline shedding) — runs BEFORE the
-    request touches the queue so refused work costs nothing downstream.
-    Returns the request's QosTicket or raises a 429-mapped error; refusals
-    land as terminal stages on the request timeline so
-    ``GET /v1/requests/{id}/timeline`` explains why it never ran."""
-    qos = self.qos
-    ticket = qos.ticket(priority, tenant, deadline_ms, prompt_tokens)
-    metrics.inc("qos_submitted_total", labels={"class": ticket.priority})
-    try:
-      qos.check_rate(ticket.tenant, prompt_tokens)
-    except ServerOverloadedError as e:
-      metrics.inc("qos_rate_limited_total", labels={"tenant": ticket.tenant})
-      tracer.stage(request_id, "rate_limited", {
-        "tenant": ticket.tenant, "class": ticket.priority,
-        "retry_after_ms": round(getattr(e, "retry_after_ms", 0.0) or 0.0, 1),
-      }, terminal=True)
-      raise
-    if ticket.deadline_ms is not None:
-      est = qos.estimate_completion_ms(
-        queue_depth=self._queue_depth_ahead(ticket), n_slots=self.n_slots, max_tokens=max_tokens,
-      )
-      if est is not None and qos.should_shed(ticket.deadline_ms, est):
-        qos.refund(ticket.tenant, prompt_tokens)  # shed before any service
-        metrics.inc("qos_shed_total", labels={"reason": "deadline"})
-        tracer.stage(request_id, "shed", {
-          "reason": "deadline", "class": ticket.priority, "tenant": ticket.tenant,
-          "estimated_ms": round(est, 1), "deadline_ms": ticket.deadline_ms,
-        }, terminal=True)
-        raise DeadlineUnmeetableError(
-          f"deadline {ticket.deadline_ms:.0f} ms unmeetable (estimated {est:.0f} ms to last token)",
-          retry_after_ms=qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots),
-        )
-    return ticket
-
-  def _queue_depth_ahead(self, ticket) -> int:
-    """Waiting work the QoS selection would actually serve at or before this
-    request's class: counting the whole queue would charge an interactive
-    deadline request for draining a batch backlog it outranks — shedding
-    exactly the traffic the QoS layer exists to protect. Parked (page-
-    starved) requests always count: they retry ahead of the queue."""
-    depths = self.queue.class_depths()
-    ahead = sum(n for cls, n in depths.items() if priority_rank(cls) <= ticket.rank)
-    return ahead + len(self._parked)
-
-  def _shed_for(self, ticket) -> bool:
-    """Overload policy: make queue room for ``ticket`` by shedding the
-    youngest strictly-lower-priority WAITING request (its client gets a
-    structured 429 with Retry-After). False when nothing outranked waits."""
-    victim = self.queue.shed_lowest(ticket.rank)
-    if victim is None:
-      return False
-    self._queued.pop(victim.request_id, None)
-    vt = victim.qos
-    if vt is not None:
-      # The victim consumed no service: one refusal, one charge.
-      self.qos.refund(vt.tenant, int(victim.tokens.shape[0]))
-    metrics.inc("qos_shed_total", labels={"reason": "overload"})
-    tracer.stage(victim.request_id, "shed", {
-      "reason": "overload", "class": vt.priority if vt else "standard",
-      "tenant": vt.tenant if vt else "default", "displaced_by": ticket.priority,
-    }, terminal=True)
-    err = ServerOverloadedError("shed under overload for higher-priority work")
-    err.retry_after_ms = self.qos.retry_after_ms(self.queue.qsize() + len(self._parked), self.n_slots)
-    if not victim.future.done():
-      victim.future.set_exception(err)
-    return True
 
   def _preempt_victim_for(self, req) -> int | None:
     """Row of the resident slot a waiting ``req`` may preempt: the
@@ -547,21 +488,9 @@ class BatchedServer:
     return req
 
   def _requeue_resumed(self, req: "_Request") -> None:
-    """Re-enqueue an extracted row for a LOCAL resume, front of its lane
-    (it already paid its fair-queue charge at first admission)."""
-    if req.qos is not None:
-      req.qos.resumed = True  # front of its lane; no second fair-queue charge
-      if self.qos is not None:
-        # Restart the ticket's AGING clock: the row already received
-        # service, and keeping the original t_enqueue would let a
-        # long-resident batch row out-score the very waiter that preempted
-        # it (score = rank - wait/aging) — it would reclaim the freed slot
-        # every boundary, re-running a full prefill each time while the
-        # interactive waiter starves. Front-of-lane placement preserves its
-        # intra-lane order.
-        req.qos.t_enqueue = self.qos.clock()
-    self._queued[req.request_id] = req
-    self.queue.put_nowait(req)
+    """Re-enqueue an extracted row for a LOCAL resume (the policy —
+    front-of-lane, aging restart — lives in the admission layer)."""
+    self.admission.requeue_resumed(req)
 
   def _preempt_resume(self, row: int) -> None:
     """Preempt a resident row for higher-priority work and RE-ENQUEUE it
@@ -684,7 +613,11 @@ class BatchedServer:
       return
     if req.future.done():
       return  # torn down while the migration was in flight
-    # No survivor took it: resume locally (carry_tokens recompute).
+    # No survivor took it: resume locally (carry_tokens recompute). A failed
+    # DISAGG handoff pins the request local for good — re-placing it at the
+    # resume's admission would retry the dead decode target once per
+    # generated token (ISSUE 10 failure semantics: fall back, don't flap).
+    req.disagg_target = None
     self._requeue_resumed(req)
     self._parked_avail_seen = -1  # poke the lookahead drain gate
 
@@ -1259,6 +1192,12 @@ class BatchedServer:
     for i, r in enumerate(group):
       if r.chunk_end:  # intermediate chunk: advance and re-queue; no sample
         r.prefix_len = r.chunk_end
+        if r.req.disagg_target and self.kv_stream is not None and self.paged:
+          # Disagg overlap (ISSUE 10): the chunk just written is final —
+          # stream its full pages to the decode node NOW, while the
+          # remaining prefill chunks still run, so the decode node's first
+          # token never waits for the whole context to cross the wire.
+          self._disagg_stream_chunk(r)
         self._prefilling.append(r)
         continue
       self._finish_admission(r, int(firsts[i]))
@@ -1345,6 +1284,97 @@ class BatchedServer:
       self.block_tables[r.row, :] = 0
       n = len(slot.shared_pages) + len(slot.pages)
       self.block_tables[r.row, :n] = slot.shared_pages + slot.pages
+    if req.disagg_target and self.kv_handoff is not None and self.paged:
+      # Disaggregated decode (ISSUE 10): prefill is done and the first
+      # token is sampled — hand the row to its decode node instead of
+      # decoding here. Runs at an admission boundary (pipeline drained), so
+      # extraction is exactly the drain-migration contract.
+      self._disagg_handoff(r.row)
+
+  # ------------------------------------------------- disaggregation (ISSUE 10)
+
+  def _disagg_read_pages(self, keys: list, pages: list):
+    """Start a batched device→host read of full KV pages for the wire (the
+    tier-spill gather path: fresh buffers, async D2H already in flight).
+    Returns ``(keys, dev_leaves, n)`` or None on any failure — the stream
+    is best-effort; a missed batch just means the decode node recomputes
+    those tokens' prefill (the correctness fallback)."""
+    if not keys or self.cache is None:
+      return None
+    try:
+      dev, n = self.ops.read_pages(self.cache, pages)
+    except Exception:  # noqa: BLE001 — transfer is an optimization, never a failure
+      if DEBUG >= 1:
+        import traceback
+
+        print("[sched] disagg page read failed; decode node will recompute")
+        traceback.print_exc()
+      return None
+    if dev is None:
+      return None
+    return list(keys), dev, n
+
+  def _disagg_stream_chunk(self, r: _Ready) -> None:
+    """Ship the full pages a completed (non-final) prefill chunk produced —
+    called between chunks, so the transfer overlaps the rest of prefill."""
+    full = min(r.prefix_len // self.page_size, len(r.chain_keys))
+    if full <= r.req.kv_streamed:
+      return
+    batch = self._disagg_read_pages(
+      r.chain_keys[r.req.kv_streamed:full], (r.shared_pages + r.new_pages)[r.req.kv_streamed:full],
+    )
+    if batch is None:
+      return
+    r.req.kv_streamed = full
+    self.kv_stream(r.req.request_id, r.req.disagg_target, *batch)
+
+  def _disagg_handoff(self, row: int) -> None:
+    """Extract a freshly prefilled row and dispatch it to its decode node:
+    read the not-yet-streamed full pages (the final flush rides WITH the
+    handoff so adoption always precedes the decode node's admission),
+    extract via the drain-migration mechanics (pages donated under chain
+    keys — the local fallback resume stays transfer-cost), and resolve the
+    handoff like a migration: success ⇒ the submit future gets
+    ``RequestMigratedError`` and the stream continues from the decode node;
+    failure ⇒ the row re-enqueues locally and a prefilled context is never
+    stranded (ISSUE 10 failure semantics)."""
+    s = self.slots[row]
+    req = s.req
+    full = min(s.pos // self.page_size, len(s.chain_keys))
+    final_kv = None
+    if full > req.kv_streamed:
+      final_kv = self._disagg_read_pages(
+        s.chain_keys[req.kv_streamed:full], (s.shared_pages + s.pages)[req.kv_streamed:full],
+      )
+      if final_kv is not None:
+        req.kv_streamed = full
+    tracer.stage(req.request_id, "disagg_handoff", {
+      "row": row, "target": req.disagg_target, "pages_streamed": req.kv_streamed,
+    })
+    ex = self._extract_row(row, keep_kv=self.tier is not None)
+    task = asyncio.ensure_future(self.kv_handoff(ex, final_kv))
+    task.add_done_callback(lambda t, ex=ex: self._settle_migration(t, ex))
+    self._update_gauges()
+
+  def adopt_kv_wire(self, keys: list, leaves: dict) -> int:
+    """Decode-node receive side (ISSUE 10): adopt streamed KV pages into
+    the host tier — the existing restore path then extends admission's
+    device prefix hit with them, COW semantics and all. The tier is created
+    lazily (pages can arrive before this node's first request builds the
+    pool); a non-paged or tier-disabled scheduler adopts nothing (the
+    handoff still lands and prefill recomputes — correctness never depends
+    on the transfer)."""
+    if not self.paged:
+      return 0
+    if self.tier is None:
+      from .kv_tier import KvTierManager, kv_tier_enabled
+
+      if not kv_tier_enabled():
+        return 0
+      self.tier = KvTierManager.from_env(page_size=self.page_size, read_pages=self._tier_read, write_pages=self._tier_write)
+      if self.allocator is not None:
+        self.allocator.spill_hook = self.tier.spill
+    return self.tier.adopt_wire(keys, leaves)
 
   @staticmethod
   def _slo_class(req: _Request) -> str:
@@ -1919,12 +1949,4 @@ class BatchedServer:
       r = self._prefilling.pop()
       if not r.req.future.done():
         r.req.future.set_exception(exc)
-    self._queued.clear()
-    while self._parked:
-      req = self._parked.popleft()
-      if not req.future.done():
-        req.future.set_exception(exc)
-    while not self.queue.empty():
-      req = self.queue.get_nowait()
-      if not req.future.done():
-        req.future.set_exception(exc)
+    self.admission.fail_queued(exc)

@@ -343,6 +343,56 @@ class KvTierManager:
 
       tracer.stage(request_id, "restored", {"pages": len(keys), "bytes": nbytes, "ms": round(dt * 1e3, 3)})
 
+  # ------------------------------------------------------- wire adoption
+  #
+  # Disaggregated prefill/decode (ISSUE 10): the decode node's receive side
+  # IS this host tier — streamed KV pages land here as ordinary host
+  # entries, and the existing restore path (host_run → restore_into →
+  # adopt_restored) extends admission's device prefix hit with them. TRUST:
+  # pages arrive over the same data plane that already ships raw activation
+  # tensors between ring peers; a corrupt or mismatched-geometry page can
+  # at worst fail the restore scatter, which falls back to recomputing
+  # prefill (the correctness fallback) — it can never corrupt the pool
+  # accounting.
+
+  def adopt_wire(self, keys: list[bytes], leaves: dict) -> int:
+    """Adopt streamed pages: ``leaves`` maps pool-leaf name → host array
+    ``[L, n, ...]`` stacked in ``keys`` order (the ``restore_into`` layout,
+    exactly what ``serialization.proto_to_kv_pages`` parses). Returns the
+    number of pages adopted; 0 on a geometry mismatch with pages this tier
+    already holds (mixing layouts would poison later restores)."""
+    if not keys or not leaves:
+      return 0
+    n = min(len(keys), min(int(arr.shape[1]) for arr in leaves.values()))
+    if n <= 0:
+      return 0
+    per_page = sum(
+      int(np.prod(arr.shape[2:], dtype=np.int64)) * int(arr.shape[0]) * np.dtype(arr.dtype).itemsize
+      for arr in leaves.values()
+    )
+    with self._lock:
+      if self._page_nbytes is None:
+        self._page_nbytes = per_page
+      elif per_page != self._page_nbytes:
+        return 0  # foreign geometry: refuse, don't poison the store
+      for i in range(n):
+        key = keys[i]
+        old = self._entries.pop(key, None)
+        if isinstance(old, dict):
+          self._bytes -= old["nbytes"]
+        elif old is not None:
+          # Replacing a still-pending spill batch entry: its byte charge
+          # settles when the batch materializes (_materialize_locked).
+          pass
+        data = {name: np.ascontiguousarray(arr[:, i]) for name, arr in leaves.items()}
+        self._entries[key] = {"data": data, "nbytes": per_page}
+        self._bytes += per_page
+      self._enforce_budget_locked()
+    metrics.inc("kv_stream_adopted_pages_total", n)
+    prefix_registry.note(keys[:n])
+    self._update_gauges()
+    return n
+
   # ------------------------------------------------------------------ admin
 
   def host_has(self, key: bytes) -> bool:

@@ -192,7 +192,16 @@ def build_components(args):
       data = json.loads(status)
       if data.get("type") == "node_status" and data.get("status") == "start_process_prompt":
         base_shard = Shard.from_dict(data.get("base_shard", {}))
-        current = node.get_current_shard(base_shard)
+        from .inference import sched_admission
+
+        if sched_admission.disagg_enabled() and os.environ.get("XOT_TPU_BATCHED", "0") == "1":
+          # Disaggregated serving (ISSUE 10): every node holds the FULL
+          # model — warming the ring PARTITION here would load a partial
+          # shard that the first decode handoff immediately swaps out
+          # (dropping the batched server and the adopted KV pages with it).
+          current = Shard(base_shard.model_id, 0, base_shard.n_layers - 1, base_shard.n_layers)
+        else:
+          current = node.get_current_shard(base_shard)
         asyncio.create_task(engine.ensure_shard(current))
     except Exception:  # noqa: BLE001
       pass

@@ -48,9 +48,11 @@ from ..retry import (
   rpc_retries,
   rpc_timeout,
 )
+from . import kv_stream_pb2 as pbkv
 from . import node_service_pb2 as pb
 from .grpc_server import CHANNEL_OPTIONS, SERVICE_NAME
 from .serialization import (
+  kv_pages_to_proto,
   proto_payload_bytes,
   proto_to_tensor,
   proto_to_topology,
@@ -127,6 +129,7 @@ class GRPCPeerHandle(PeerHandle):
           "CollectTopology": (pb.CollectTopologyRequest, pb.Topology),
           "SendResult": (pb.SendResultRequest, pb.Empty),
           "SendOpaqueStatus": (pb.SendOpaqueStatusRequest, pb.Empty),
+          "SendKvPages": (pbkv.KvPageBatch, pbkv.KvPageAck),
           "HealthCheck": (pb.HealthCheckRequest, pb.HealthCheckResponse),
         }.items()
       }
@@ -384,6 +387,23 @@ class GRPCPeerHandle(PeerHandle):
     else:
       request.result.extend(int(r) for r in result)
     await self._traced_call("SendResult", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
+
+  async def send_kv_pages(self, request_id: str, chain_keys: list, leaves: dict, *, page_size: int, seq: int, last: bool) -> int:
+    """Stream one batch of int8-KV pages to this peer (disaggregated
+    prefill/decode, ISSUE 10). ``leaves`` maps pool-leaf name → host array
+    ``[L, n, ...]`` in ``chain_keys`` order; the batch rides the raw-bytes
+    fast path (1 byte/element for int8 codes), carries the traceparent +
+    QoS metadata like every data-plane RPC, and records a client-side
+    ``SendKvPages`` hop span. Returns the number of pages the peer adopted
+    (0 on refusal — the stream is best-effort by contract)."""
+    await self._ensure_connected()
+    t_start = node_now_ns(self.origin_id)
+    t_ser = time.perf_counter()
+    request = kv_pages_to_proto(
+      request_id, chain_keys, leaves, page_size=page_size, seq=seq, last=last, origin=self.origin_id or "",
+    )
+    response = await self._traced_call("SendKvPages", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
+    return int(response.adopted) if response.ok else 0
 
   async def send_opaque_status(self, request_id: str, status: str) -> None:
     await self._ensure_connected()

@@ -31,9 +31,11 @@ from ...inference.qos import QOS_META_DEADLINE, QOS_META_PRIORITY, QOS_META_TENA
 from ...orchestration.tracing import node_now_ns, parse_traceparent, tracer
 from ...utils.helpers import DEBUG
 from ..faults import ChaosInjectedError, chaos
+from . import kv_stream_pb2 as pbkv
 from . import node_service_pb2 as pb
 from .serialization import (
   proto_payload_bytes,
+  proto_to_kv_pages,
   proto_to_shard,
   proto_to_state,
   proto_to_tensor,
@@ -133,6 +135,7 @@ class GRPCServer:
       "CollectTopology": unary(self.CollectTopology, pb.CollectTopologyRequest, pb.Topology),
       "SendResult": unary(self.SendResult, pb.SendResultRequest, pb.Empty),
       "SendOpaqueStatus": unary(self.SendOpaqueStatus, pb.SendOpaqueStatusRequest, pb.Empty),
+      "SendKvPages": unary(self.SendKvPages, pbkv.KvPageBatch, pbkv.KvPageAck),
       "HealthCheck": unary(self.HealthCheck, pb.HealthCheckRequest, pb.HealthCheckResponse),
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
@@ -289,6 +292,39 @@ class GRPCServer:
   async def SendOpaqueStatus(self, request: pb.SendOpaqueStatusRequest, context) -> pb.Empty:
     self.node.on_opaque_status.trigger_all(request.request_id, request.status)
     return pb.Empty()
+
+  async def SendKvPages(self, request: "pbkv.KvPageBatch", context) -> "pbkv.KvPageAck":
+    """Disagg KV-page stream receive side (ISSUE 10): parse the batch
+    (zero-copy leaf views) and adopt the pages into the local scheduler's
+    host tier. Refusals are an honest ``ok=False`` ack, never an exception —
+    the sender's stream is best-effort and its decode handoff must not
+    inherit a transfer failure."""
+    t_arrive = node_now_ns(self.node.id)
+    t0 = time.perf_counter()
+    hop_id = self._join_trace(request.request_id, context)
+    self._adopt_qos(request.request_id, context)
+    t_des = time.perf_counter()
+    try:
+      keys, leaves = proto_to_kv_pages(request)
+    except Exception as e:  # noqa: BLE001 — malformed batch: refuse, don't 500
+      return pbkv.KvPageAck(ok=False, adopted=0, error=f"malformed kv batch: {e!r}")
+    des_s = time.perf_counter() - t_des
+    adopted = 0
+    err = ""
+    try:
+      adopted = int(self.node.handle_kv_pages(request.request_id, keys, leaves, page_size=int(request.page_size)))
+    except Exception as e:  # noqa: BLE001
+      err = repr(e)
+    finally:
+      if DEBUG >= 1 and (err or adopted < len(keys)):
+        # Adoption refusals are legal (best-effort stream) but must be
+        # diagnosable — a silent 0 here cost a debugging session once.
+        print(f"[grpc] SendKvPages {request.request_id}: adopted {adopted}/{len(keys)}{' err=' + err if err else ''}")
+      self._record_server_hop(
+        request.request_id, "SendKvPages", context, t_start_ns=t_arrive, hop_id=hop_id,
+        deserialize_s=des_s, handler_s=time.perf_counter() - t0, payload_bytes=proto_payload_bytes(request),
+      )
+    return pbkv.KvPageAck(ok=not err and adopted > 0, adopted=adopted, error=err)
 
   async def HealthCheck(self, request: pb.HealthCheckRequest, context) -> pb.HealthCheckResponse:
     # Clock echo for NTP-style offset estimation (clocksync.py): only when

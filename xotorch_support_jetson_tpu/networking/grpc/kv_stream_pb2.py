@@ -1,0 +1,107 @@
+"""Protobuf bindings for the disaggregated-serving KV-page stream (ISSUE 10).
+
+The container has no ``protoc``, so the file descriptor is built
+programmatically at import time (``descriptor_pb2`` + ``message_factory``)
+instead of from a checked-in serialized blob — byte-compatible with what
+``protoc`` would emit for the schema below, and registered in the default
+descriptor pool exactly once per process.
+
+Schema (proto3, package ``xot_tpu``):
+
+    message KvPageLeaf {
+      string name  = 1;  // pool leaf ("k", "v", "k_scale", ...)
+      bytes  data  = 2;  // raw C-order bytes of the [L, n_pages, ...] stack
+      string dtype = 3;  // numpy dtype string ("int8", "float32", ...)
+      repeated int32 shape = 4;  // full stacked shape incl. the page axis
+    }
+    message KvPageBatch {
+      string request_id = 1;
+      repeated string chain_keys = 2;  // hex digests, page order
+      int32  page_size  = 3;
+      int32  seq        = 4;   // batch ordinal within the request's stream
+      bool   last       = 5;   // final batch before the decode handoff
+      repeated KvPageLeaf leaves = 6;
+      string origin     = 7;   // sending node id
+    }
+    message KvPageAck {
+      bool   ok      = 1;
+      int32  adopted = 2;  // pages adopted into the receiver's host tier
+      string error   = 3;
+    }
+
+One ``KvPageBatch`` carries a bounded run of int8-KV pages (1 byte/element
+codes + f32 scales) for one request; the raw-bytes leaves ride the same
+zero-extra-copy path as ``serialization.tensor_to_proto`` and the batch is
+counted by ``serialization.proto_payload_bytes`` like every other data-plane
+message.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_FILE = "xot_tpu_kv_stream.proto"
+
+
+def _build_file() -> descriptor_pb2.FileDescriptorProto:
+  fdp = descriptor_pb2.FileDescriptorProto()
+  fdp.name = _FILE
+  fdp.package = "xot_tpu"
+  fdp.syntax = "proto3"
+
+  leaf = fdp.message_type.add()
+  leaf.name = "KvPageLeaf"
+  for num, (fname, ftype, label) in enumerate(
+    [
+      ("name", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL),
+      ("data", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL),
+      ("dtype", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL),
+      ("shape", descriptor_pb2.FieldDescriptorProto.TYPE_INT32, descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED),
+    ],
+    start=1,
+  ):
+    f = leaf.field.add()
+    f.name, f.number, f.type, f.label = fname, num, ftype, label
+
+  batch = fdp.message_type.add()
+  batch.name = "KvPageBatch"
+  specs = [
+    ("request_id", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+    ("chain_keys", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED, ""),
+    ("page_size", descriptor_pb2.FieldDescriptorProto.TYPE_INT32, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+    ("seq", descriptor_pb2.FieldDescriptorProto.TYPE_INT32, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+    ("last", descriptor_pb2.FieldDescriptorProto.TYPE_BOOL, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+    ("leaves", descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED, ".xot_tpu.KvPageLeaf"),
+    ("origin", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+  ]
+  for num, (fname, ftype, label, tname) in enumerate(specs, start=1):
+    f = batch.field.add()
+    f.name, f.number, f.type, f.label = fname, num, ftype, label
+    if tname:
+      f.type_name = tname
+
+  ack = fdp.message_type.add()
+  ack.name = "KvPageAck"
+  for num, (fname, ftype) in enumerate(
+    [
+      ("ok", descriptor_pb2.FieldDescriptorProto.TYPE_BOOL),
+      ("adopted", descriptor_pb2.FieldDescriptorProto.TYPE_INT32),
+      ("error", descriptor_pb2.FieldDescriptorProto.TYPE_STRING),
+    ],
+    start=1,
+  ):
+    f = ack.field.add()
+    f.name, f.number, f.type = fname, num, ftype
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+  return fdp
+
+
+_pool = descriptor_pool.Default()
+try:
+  _fd = _pool.Add(_build_file())
+except Exception:  # noqa: BLE001 — already registered (re-import under a fresh module object)
+  _fd = _pool.FindFileByName(_FILE)
+
+KvPageLeaf = message_factory.GetMessageClass(_fd.message_types_by_name["KvPageLeaf"])
+KvPageBatch = message_factory.GetMessageClass(_fd.message_types_by_name["KvPageBatch"])
+KvPageAck = message_factory.GetMessageClass(_fd.message_types_by_name["KvPageAck"])

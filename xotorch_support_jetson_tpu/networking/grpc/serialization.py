@@ -5,6 +5,21 @@ reference wire format (``grpc_peer_handle.py:117-136``) but preserving dtype
 end-to-end (the reference upcast bf16→f32 on the hot path,
 ``sharded_inference_engine.py:352,366`` — here bf16 stays 2 bytes/elem via
 ml_dtypes).
+
+RAW-BYTES FAST PATH (ISSUE 10): ``tensor_to_proto`` historically ran
+``np.ascontiguousarray`` before ``tobytes()`` — for a non-contiguous host
+view that is TWO full host copies (compact, then serialize), and
+``tobytes()`` alone already emits C-order bytes for any layout in one pass.
+The pre-copy is gone; contiguous int8/uint8 arrays (every streamed KV page —
+1 byte/element) serialize with exactly one host copy, and
+``proto_to_tensor`` stays a zero-copy ``frombuffer`` view over the message
+buffer (read-only by construction; consumers that mutate copy explicitly).
+Shape/dtype round-trip is pinned by tests/test_disagg.py.
+
+The disagg KV-page stream message (``kv_stream_pb2.KvPageBatch``) is built/
+parsed here too (``kv_pages_to_proto`` / ``proto_to_kv_pages``) so the whole
+wire format lives in one module, and its payload is counted by
+``proto_payload_bytes`` like every other data-plane message.
 """
 
 from __future__ import annotations
@@ -17,6 +32,7 @@ from ...inference.shard import Shard
 from ...inference.state import InferenceState
 from ...topology.device_capabilities import DeviceCapabilities, DeviceFlops
 from ...topology.topology import Topology
+from . import kv_stream_pb2 as pbkv
 from . import node_service_pb2 as pb
 
 
@@ -25,7 +41,8 @@ def proto_payload_bytes(msg) -> int:
   per-hop telemetry records (``peer_rpc_bytes_*_total``, hop attributes).
   ``ByteSize()`` is the pre-compression HTTP/2 DATA size; protobuf caches it
   after the first call, so both the client (before send) and the server
-  (after deserialize) read it for free."""
+  (after deserialize) read it for free. The KV-page stream's ``KvPageBatch``
+  (ISSUE 10) is counted through here like every other message."""
   try:
     return int(msg.ByteSize())
   except Exception:  # noqa: BLE001 — telemetry must never break the data plane
@@ -43,14 +60,55 @@ def _np_dtype(name: str):
 def tensor_to_proto(arr: np.ndarray | None) -> pb.Tensor:
   if arr is None:
     return pb.Tensor()
-  arr = np.ascontiguousarray(arr)
+  if not isinstance(arr, np.ndarray):
+    arr = np.asarray(arr)  # device arrays: the one necessary D2H materialization
+  # No ascontiguousarray pre-copy: tobytes() emits C-order bytes for ANY
+  # layout in a single pass, so contiguous arrays (the KV-page hot path:
+  # int8/uint8, 1 byte/element) serialize with exactly one host copy and
+  # non-contiguous views no longer pay a second compaction copy first.
   return pb.Tensor(tensor_data=arr.tobytes(), shape=list(arr.shape), dtype=str(arr.dtype))
 
 
 def proto_to_tensor(t: pb.Tensor) -> np.ndarray | None:
   if not t.dtype:
     return None
+  # Zero-copy: a read-only frombuffer view over the message's own buffer —
+  # shape/dtype restored exactly (pinned by test); consumers needing a
+  # writable array copy explicitly.
   return np.frombuffer(t.tensor_data, dtype=_np_dtype(t.dtype)).reshape(tuple(t.shape))
+
+
+# ----------------------------------------------------- KV-page stream (ISSUE 10)
+
+
+def kv_pages_to_proto(request_id: str, chain_keys: list[bytes], leaves: dict, *, page_size: int, seq: int, last: bool, origin: str = "") -> "pbkv.KvPageBatch":
+  """Build one KV-page stream batch: ``leaves`` maps pool-leaf name →
+  host array ``[L, n_pages, ...]`` stacked in ``chain_keys`` order (the
+  exact layout ``kv_tier.restore_into`` scatters). Leaf bytes ride the
+  raw-bytes fast path — int8 codes are 1 byte/element on the wire."""
+  msg = pbkv.KvPageBatch(
+    request_id=request_id,
+    chain_keys=[k.hex() for k in chain_keys],
+    page_size=int(page_size),
+    seq=int(seq),
+    last=bool(last),
+    origin=origin,
+  )
+  for name, arr in leaves.items():
+    a = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
+    msg.leaves.append(pbkv.KvPageLeaf(name=name, data=a.tobytes(), dtype=str(a.dtype), shape=list(a.shape)))
+  return msg
+
+
+def proto_to_kv_pages(msg: "pbkv.KvPageBatch") -> tuple[list[bytes], dict]:
+  """Parse a KV-page batch back to ``(chain_keys, {leaf: [L, n, ...]})``.
+  Leaf arrays are zero-copy read-only views over the message buffer — the
+  host-tier adopt copies per page anyway (it must own the bytes)."""
+  keys = [bytes.fromhex(h) for h in msg.chain_keys]
+  leaves = {}
+  for leaf in msg.leaves:
+    leaves[leaf.name] = np.frombuffer(leaf.data, dtype=_np_dtype(leaf.dtype)).reshape(tuple(leaf.shape))
+  return keys, leaves
 
 
 def shard_to_proto(shard: Shard) -> pb.Shard:

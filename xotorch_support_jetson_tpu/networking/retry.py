@@ -60,6 +60,7 @@ METHOD_TIMEOUT_DEFAULTS: dict[str, float | None] = {
   "SendLoss": None,
   "SendResult": 15.0,
   "SendOpaqueStatus": 15.0,
+  "SendKvPages": 15.0,  # disagg KV-page stream (ISSUE 10): bounded payload, best-effort
   "CollectTopology": 5.0,
 }
 

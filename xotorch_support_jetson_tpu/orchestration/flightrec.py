@@ -62,7 +62,7 @@ DEFAULT_CAP = 4096
 EVENT_TYPES = (
   # request lifecycle transitions (forwarded from the tracer stage choke point)
   "admitted", "shed", "rejected", "rate_limited", "preempted", "parked", "unparked",
-  "spilled", "restored", "drain", "migrated", "stalled", "complete",
+  "spilled", "restored", "drain", "migrated", "disagg_handoff", "stalled", "complete",
   # fault-tolerance plane (networking/retry.py)
   "breaker_open", "breaker_half_open", "breaker_close", "peer_dead", "peer_recovered",
   # topology / recovery (orchestration/node.py)

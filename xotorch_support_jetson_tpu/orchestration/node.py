@@ -26,6 +26,7 @@ import uuid
 
 import numpy as np
 
+from ..inference import sched_admission
 from ..inference.engine import InferenceEngine, RequestMigratedError
 from ..inference.kv_tier import prefix_registry
 from ..inference.shard import Shard
@@ -149,6 +150,23 @@ class Node:
     self._migrated: dict[str, asyncio.Event] = {}
     self._recovering: set[str] = set()
     self._batched_shards: dict[str, Shard] = {}
+    # Disaggregated prefill/decode (ISSUE 10). ``_disagg_stats`` caches each
+    # peer's latest role/capacity advert (``disagg_pull``/``disagg_stats``
+    # over the opaque-status channel — the metrics_pull pattern) for the
+    # placement policy; ``_disagg_waiters`` holds pulls in flight;
+    # ``_kv_stream_tasks`` tracks per-request mid-prefill KV-page transfer
+    # tasks so the decode handoff can flush them (adoption must precede the
+    # decode node's admission); ``_kv_stream_seq`` numbers a request's
+    # batches for the receive side's telemetry.
+    # This node's role, initialized from XOT_TPU_ROLE (tests — and a future
+    # control plane — may override per node: two in-process nodes share the
+    # env).
+    self.disagg_role = sched_admission.node_role()
+    self._disagg_stats: dict[str, dict] = {}
+    self._disagg_stats_ts: float = 0.0
+    self._disagg_waiters: dict[str, list] = {}
+    self._kv_stream_tasks: dict[str, list] = {}
+    self._kv_stream_seq: dict[str, int] = {}
     # Monotonic time of the last peer LOSS (eviction of a removed peer).
     # The stall watchdog's fault predicate needs this to stay truthful
     # AFTER eviction: the damped eviction also forgets the dead peer's
@@ -167,6 +185,9 @@ class Node:
 
   async def start(self, wait_for_peers: int = 0) -> None:
     self.device_capabilities = await device_capabilities()
+    # Role gauge (ISSUE 10): 0 = both (colocated), 1 = prefill, 2 = decode —
+    # dashboards see the disaggregation topology without scraping env vars.
+    metrics.set_gauge("node_role", {"both": 0, "prefill": 1, "decode": 2}.get(self.disagg_role, 0))
     await self.server.start()
     await self.discovery.start()
     await self.update_peers(wait_for_peers)
@@ -310,6 +331,298 @@ class Node:
       del self._draining_peers[node_id]
       return False
     return True
+
+  # ------------------------------------- disaggregated prefill/decode (ISSUE 10)
+
+  def _disagg_local_stats(self) -> dict:
+    """This node's role/capacity advert for the placement policy: free
+    pages + queue depth place decode work; the QoS deadline estimator's
+    queue-drain number places prefill work (inference/sched_admission.py)."""
+    st: dict = {"node_id": self.id, "role": self.disagg_role, "draining": bool(self.draining)}
+    server = getattr(self.inference_engine, "_batched_server", None)
+    if server is not None:
+      alloc = getattr(server, "allocator", None)
+      if alloc is not None:
+        st["free_pages"] = int(alloc.n_available)
+      st["queue_depth"] = int(server.queue.qsize() + len(server._parked))
+      st["slots_free"] = sum(1 for s in server.slots if s is None)
+      if server.qos is not None:
+        est = server.qos.estimate_completion_ms(queue_depth=st["queue_depth"], n_slots=server.n_slots, max_tokens=1)
+        if est is not None:
+          st["est_drain_ms"] = round(float(est), 1)
+    return st
+
+  async def collect_disagg_stats(self, timeout: float = 1.0) -> dict[str, dict]:
+    """Refresh the peer role/capacity cache over the opaque-status channel
+    (the ``metrics_pull`` pattern: broadcast ``disagg_pull``, peers reply
+    ``disagg_stats``). The broadcast is a background task — a dead peer
+    must not stall placement past ``timeout`` (its stale advert ages out of
+    the cache instead)."""
+    if not self.peers:
+      return {}
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._disagg_waiters[nonce] = waiter
+    bcast = asyncio.create_task(self.broadcast_opaque_status(
+      "", json.dumps({"type": "disagg_pull", "node_id": self.id, "nonce": nonce})
+    ))
+    try:
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # place with whatever adverts arrived
+      self._disagg_stats_ts = time.monotonic()
+      return dict(self._disagg_stats)
+    finally:
+      self._disagg_waiters.pop(nonce, None)
+      bcast.cancel()
+
+  async def _disagg_stats_fresh(self, max_age_s: float = 5.0, timeout: float = 1.0) -> dict[str, dict]:
+    if self._disagg_stats and time.monotonic() - self._disagg_stats_ts <= max_age_s:
+      return dict(self._disagg_stats)
+    return await self.collect_disagg_stats(timeout=timeout)
+
+  def _handle_disagg_status(self, status_data: dict) -> None:
+    kind = status_data.get("type")
+    if kind == "disagg_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      reply = json.dumps({
+        "type": "disagg_stats",
+        "node_id": self.id,
+        "nonce": status_data.get("nonce", ""),
+        "stats": self._disagg_local_stats(),
+      })
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        async def send():
+          try:
+            await peer.send_opaque_status("", reply)
+          except Exception:  # noqa: BLE001 — adverts are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] disagg stats reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "disagg_stats":
+      sender = status_data.get("node_id")
+      if sender == self.id:
+        return
+      st = status_data.get("stats") or {}
+      self._disagg_stats[str(sender)] = st
+      waiter = self._disagg_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None:
+        waiter[1].append((sender, st))
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
+  async def _disagg_decode_target(self) -> str | None:
+    """Where this request decodes after its local prefill (None = here)."""
+    role = self.disagg_role
+    if role == "decode":
+      return None  # a decode node never hands decode work away
+    stats = await self._disagg_stats_fresh()
+    # A crashed peer's last advert lingers in the cache (often looking BEST
+    # — it was idle when it died): placement only considers peers that still
+    # hold a live handle and aren't draining. Departed peers' adverts are
+    # also evicted at the damped-eviction point (update_peers).
+    peer_ids = {p.id() for p in self.peers}
+    live = {
+      nid: st for nid, st in stats.items()
+      if nid in peer_ids and not st.get("draining") and not self._peer_draining(nid)
+    }
+    return sched_admission.choose_decode_node(live, self_id=self.id, self_role=role)
+
+  def _wire_disagg_hooks(self, server) -> None:
+    """Inject the node-layer transfer callbacks into the scheduler (the
+    execution layer never imports networking): ``kv_stream`` ships one
+    completed prefill chunk's pages in the background; ``kv_handoff``
+    flushes the stream and re-submits the extracted row to its decode
+    node."""
+    if getattr(server, "kv_handoff", None) is None:
+      server.kv_stream = self._disagg_kv_stream
+      server.kv_handoff = self._disagg_handoff_cb
+
+  def _disagg_kv_stream(self, request_id: str, target_id: str, keys: list, dev: dict, n: int) -> None:
+    """Scheduler hook: schedule one KV-page batch transfer in the
+    background (the device gather's async D2H is already in flight) so the
+    transfer overlaps the remaining prefill chunks."""
+    task = asyncio.ensure_future(self._disagg_send_kv(request_id, target_id, keys, dev, n, last=False))
+    self._kv_stream_tasks.setdefault(request_id, []).append(task)
+
+  async def _disagg_send_kv(self, request_id: str, target_id: str, keys: list, dev: dict, n: int, *, last: bool) -> int:
+    """Materialize one gathered page batch host-side and stream it to the
+    decode node in bounded ``KvPageBatch`` messages. Best-effort by
+    contract: any failure just means the decode node recomputes those
+    tokens' prefill."""
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if peer is None or not hasattr(peer, "send_kv_pages"):
+      return 0
+    server = getattr(self.inference_engine, "_batched_server", None)
+    page_size = getattr(server, "page_size", 0) or 0
+    loop = asyncio.get_event_loop()
+    t0 = time.perf_counter()
+    # np.asarray blocks until the async D2H lands — off the event loop.
+    leaves = await loop.run_in_executor(None, lambda: {name: np.asarray(arr)[:, :n] for name, arr in dev.items()})
+    try:
+      cap = max(int(os.getenv("XOT_TPU_KV_STREAM_PAGES", "32") or 32), 1)
+    except ValueError:
+      cap = 32
+    adopted = 0
+    nbytes = 0
+    try:
+      for i in range(0, len(keys), cap):
+        sub_keys = keys[i : i + cap]
+        sub = {name: arr[:, i : i + cap] for name, arr in leaves.items()}
+        nbytes += sum(a.nbytes for a in sub.values())
+        seq = self._kv_stream_seq.get(request_id, 0)
+        self._kv_stream_seq[request_id] = seq + 1
+        adopted += await peer.send_kv_pages(
+          request_id, sub_keys, sub, page_size=page_size, seq=seq, last=last and i + cap >= len(keys),
+        )
+    except Exception:  # noqa: BLE001 — transfer is an optimization, never a failure
+      if DEBUG >= 1:
+        print(f"[node {self.id}] kv stream for {request_id} to {target_id} failed mid-transfer")
+      return adopted
+    finally:
+      dt = time.perf_counter() - t0
+      if keys:
+        metrics.inc("kv_stream_pages_total", len(keys))
+        metrics.inc("kv_stream_bytes_total", nbytes)
+        metrics.observe_hist("kv_stream_seconds", dt, labels={"peer": target_id})
+        tracer.stage(request_id, "kv_stream", {
+          "peer": target_id, "pages": len(keys), "bytes": nbytes,
+          "ms": round(dt * 1e3, 3), "adopted": adopted, "last": last,
+        }, node=self.id)
+    return adopted
+
+  async def _disagg_handoff_cb(self, req, final_kv) -> bool:
+    """Scheduler handoff hook: flush the request's in-flight page batches
+    (adoption must land before the decode node's admission restores), ship
+    the final batch, then re-submit the extracted row to its decode node.
+    False ⇒ the scheduler resumes the row locally — a dead decode target
+    never strands a prefilled context."""
+    request_id, target_id = req.request_id, req.disagg_target
+    for t in self._kv_stream_tasks.pop(request_id, []):
+      try:
+        await t
+      except Exception:  # noqa: BLE001 — stream batches are best-effort
+        pass
+    if final_kv is not None:
+      keys, dev, n = final_kv
+      await self._disagg_send_kv(request_id, target_id, keys, dev, n, last=True)
+    return await self._disagg_dispatch(req, target_id)
+
+  async def _disagg_dispatch(self, req, target_id: str) -> bool:
+    """Hand the extracted row to its decode node over the existing gRPC
+    tensor path — the drain-migration wire contract (``replay_epoch`` +
+    ``orig_prompt_len`` keep budget and absolute stream positions exact)
+    plus a ``disagg_decode`` marker that routes it into the decode node's
+    BATCHED scheduler (process_tensor). Returns False on any dispatch
+    failure: the row finishes locally via the carry_tokens resume."""
+    request_id = req.request_id
+    base_shard = self._batched_shards.get(request_id)
+    peer = next((p for p in self.peers if p.id() == target_id), None)
+    if base_shard is None or peer is None or self._peer_draining(target_id):
+      return False
+    full = Shard(base_shard.model_id, 0, base_shard.n_layers - 1, base_shard.n_layers)
+    tokens = np.asarray(req.tokens, dtype=np.int32).reshape(1, -1)
+    orig_len = int(tokens.shape[1]) - len(req.carry_tokens)
+    epoch = self._seen_epochs.get(request_id, 0) + 1
+    self._seen_epochs[request_id] = epoch
+    state = InferenceState(
+      tokens=tokens.copy(), prompt_len=int(tokens.shape[1]),
+      extras={
+        "replay_epoch": epoch, "orig_prompt_len": orig_len,
+        "disagg_decode": {"remaining": int(req.max_tokens), "carried": len(req.carry_tokens)},
+      },
+    )
+    # Register the finish waiter BEFORE the forward: the remote finish
+    # broadcast must not race the registration.
+    self._migrated[request_id] = asyncio.Event()
+    self._recovering.add(request_id)
+    try:
+      await peer.send_tensor(full, tokens, request_id, self._stash_options(request_id, state))
+    except asyncio.TimeoutError:
+      # The wait expired but the wire may have DELIVERED (the decode node
+      # could already be streaming). Prefer at-most-once — same argument as
+      # the drain migration: a truly lost handoff becomes the stall
+      # watchdog's structured retryable 503, never two generators racing
+      # the client stream.
+      if DEBUG >= 1:
+        print(f"[node {self.id}] disagg handoff of {request_id}: send timed out after delivery window; assuming shipped")
+    except Exception:  # noqa: BLE001 — decode target unreachable: finish locally
+      self._migrated.pop(request_id, None)
+      self._recovering.discard(request_id)
+      if DEBUG >= 1:
+        print(f"[node {self.id}] disagg handoff of {request_id} to {target_id} failed; resuming locally")
+      return False
+    metrics.inc("disagg_handoffs_total")
+    if DEBUG >= 1:
+      print(f"[node {self.id}] disagg handoff: {request_id} decodes on {target_id} ({req.kv_streamed} pages streamed)")
+    return True
+
+  def handle_kv_pages(self, request_id: str, keys: list, leaves: dict, *, page_size: int) -> int:
+    """gRPC receive side: adopt streamed KV pages into the batched
+    scheduler's host tier (the restore-adopt path then serves them to the
+    handoff's admission as an extended prefix hit)."""
+    engine = self.inference_engine
+    if not hasattr(engine, "get_batched_server"):
+      return 0
+    # No supports_batched() gate here: adoption is host-RAM only and pages
+    # arrive while the engine may still hold (or be loading) a different
+    # shard — the batched-capability verdict belongs to the decode handoff
+    # itself, which loads the full model. (A model swap still clears the
+    # tier, so pages adopted before the swap are just a recomputed prefill.)
+    server = engine.get_batched_server()
+    if page_size and getattr(server, "page_size", None) not in (None, page_size):
+      return 0  # mismatched page geometry: refuse, the sender falls back
+    return int(server.adopt_kv_wire(keys, leaves))
+
+  async def _serve_disagg_decode(self, base_shard: Shard, shard: Shard, tensor: np.ndarray, request_id: str, state: InferenceState) -> None:
+    """Decode-node side of a disagg handoff (ISSUE 10): submit the carried
+    token history into THIS node's batched scheduler as a wire-carried
+    resume. Admission finds the streamed pages in the host tier and
+    restore-adopts them, so prefill here recomputes only the last partial
+    page; emitted tokens broadcast with ABSOLUTE stream positions so the
+    origin's high-water dedup splices the continuation exactly after the
+    prefill node's first token."""
+    engine = self.inference_engine
+    tokens = np.asarray(tensor, dtype=np.int32).reshape(-1)
+    extras = state.extras if state is not None else {}
+    orig_len = int(extras.get("orig_prompt_len", tokens.shape[0]))
+    carried = [int(t) for t in tokens[orig_len:]]
+    info = extras.get("disagg_decode") or {}
+    remaining = int(info.get("remaining", 0))
+    if remaining <= 0:
+      max_tokens, _, _ = self._request_limits(request_id)
+      remaining = max(max_tokens - len(carried), 1)
+    _, temp, top_k = self._request_limits(request_id)
+    eos_ids = self._eos_token_ids(base_shard)
+    self.buffered_token_output[request_id] = ([], False)
+    self._ttft_observed.add(request_id)  # TTFT was the prefill node's observation
+    offset = len(carried)
+
+    def emit(rid: str, new_tokens: list, finished: bool) -> None:
+      buffered, _ = self.buffered_token_output.get(rid, ([], False))
+      start = offset + len(buffered)
+      buffered.extend(new_tokens)
+      self.buffered_token_output[rid] = (buffered, finished)
+      for _ in new_tokens:
+        tracer.handle_token(rid)
+      metrics.inc("tokens_generated_total", len(new_tokens))
+      self.trigger_on_token_callbacks(rid, list(new_tokens), finished, start_pos=start)
+      asyncio.create_task(self.broadcast_result(rid, list(new_tokens), finished, start_pos=start))
+
+    opts = self.request_options.get(request_id, {})
+    try:
+      await engine.get_batched_server().submit(
+        request_id, tokens, max_tokens=remaining, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
+        priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
+        deadline_ms=opts.get("deadline_ms"), carry=carried,
+      )
+    finally:
+      self._finish_request(request_id)
 
   # --------------------------------------------------------------- serving
 
@@ -477,6 +790,30 @@ class Node:
 
         raise RingBudgetError("ring cannot hold the model: " + "; ".join(problems))
     self._adopt_options(request_id, inference_state, shard)
+    if (
+      sched_admission.disagg_enabled()
+      and os.getenv("XOT_TPU_BATCHED", "0") == "1"
+      and hasattr(self.inference_engine, "get_batched_server")
+      and getattr(self.inference_engine, "supports_batched", lambda: True)()
+      and not (inference_state and inference_state.extras.get("images"))
+    ):
+      # Disaggregated serving (ISSUE 10): every node holds the FULL model
+      # and the ring is a replica set routed by ROLE, not a layer split —
+      # a decode-role node forwards fresh prompts to the least-loaded
+      # prefill node (queue-drain estimate); prefill/both nodes serve the
+      # prefill locally and the scheduler streams the KV to the placed
+      # decode node. Wire-forwarded prompts (wire_concrete) are the
+      # sender's placement decision — serve them here.
+      full = Shard(base_shard.model_id, 0, base_shard.n_layers - 1, base_shard.n_layers)
+      if not wire_concrete and self.disagg_role == "decode" and self.peers:
+        stats = await self._disagg_stats_fresh()
+        target_id = sched_admission.choose_prefill_node(stats, self_id=self.id)
+        peer = next((p for p in self.peers if p.id() == target_id), None) if target_id else None
+        if peer is not None and not self._peer_draining(target_id):
+          await peer.send_prompt(full, prompt, request_id, self._stash_options(request_id, inference_state))
+          return None
+        # No prefill peer reachable: degrade to serving colocated here.
+      return await self._batched_serve(full, full, prompt, request_id)
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0,
       # retrying once over a refreshed topology if the head just left.
@@ -546,21 +883,32 @@ class Node:
 
     opts = self.request_options.get(request_id, {})
     self._batched_shards[request_id] = base_shard
+    server = engine.get_batched_server()
+    disagg_target = None
+    if sched_admission.disagg_enabled() and self.peers and not self.draining:
+      # Placement (ISSUE 10): decode node by free pages + class queue depth
+      # from the peers' role/capacity adverts. None ⇒ serve colocated.
+      disagg_target = await self._disagg_decode_target()
+      self._wire_disagg_hooks(server)
     try:
-      await engine.get_batched_server().submit(
+      await server.submit(
         request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
         priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
-        deadline_ms=opts.get("deadline_ms"),
+        deadline_ms=opts.get("deadline_ms"), disagg_target=disagg_target,
       )
     except RequestMigratedError:
       # A draining scheduler shipped the row to a surviving peer (graceful
-      # drain): the stream continues from there over the normal SendResult
-      # broadcast path (absolute positions pick up exactly where the local
-      # rows left off). Hold this handler open until the remote finish so
-      # the API's generation task lifecycle stays truthful.
+      # drain), or a disagg placement handed it to its decode node: the
+      # stream continues from there over the normal SendResult broadcast
+      # path (absolute positions pick up exactly where the local rows left
+      # off). Hold this handler open until the remote finish so the API's
+      # generation task lifecycle stays truthful.
       await self._await_migrated(request_id)
     finally:
       self._batched_shards.pop(request_id, None)
+      for t in self._kv_stream_tasks.pop(request_id, []):
+        t.cancel()  # stream batches for a settled request are moot
+      self._kv_stream_seq.pop(request_id, None)
       self._finish_request(request_id)
 
   async def _await_migrated(self, request_id: str) -> None:
@@ -584,6 +932,22 @@ class Node:
     # self-forwards; plain callers resolve against the local view.
     shard = base_shard if wire_concrete else self.get_current_shard(base_shard)
     self._adopt_options(request_id, inference_state, shard)
+    if (
+      inference_state is not None
+      and inference_state.extras.get("disagg_decode")
+      and shard.is_first_layer
+      and shard.is_last_layer
+      and hasattr(self.inference_engine, "get_batched_server")
+      and getattr(self.inference_engine, "supports_batched", lambda: True)()
+    ):
+      # Disagg decode handoff (ISSUE 10): route the carried history into
+      # THIS node's batched scheduler. Exceptions propagate (unlike the
+      # plain path below): the sender's handoff task must see the typed
+      # failure and resume the row locally — a swallowed error here would
+      # read as "shipped" and strand the stream until the stall watchdog.
+      self.outstanding_requests[request_id] = "processing"
+      await self._serve_disagg_decode(base_shard, shard, tensor, request_id, inference_state)
+      return None
     try:
       self.outstanding_requests[request_id] = "processing"
       output, state = await self.inference_engine.infer_tensor(request_id, shard, tensor, inference_state)
@@ -1578,6 +1942,10 @@ class Node:
       # point, never on a single flapped health check.
       breakers.forget(peer.id())
       peer_health.forget(peer.id())
+      # Its disagg role/capacity advert is stale the same way (a restarted
+      # peer's pools start empty; a crashed one must stop attracting
+      # placement): forget with the rest of the per-peer state.
+      self._disagg_stats.pop(peer.id(), None)
       try:
         await asyncio.wait_for(peer.disconnect(), timeout)
         return True
@@ -1688,6 +2056,13 @@ class Node:
         if did_change:
           self.select_best_inference_engine()
         await self._clock_sync_pass()
+        if sched_admission.disagg_enabled() and self.peers:
+          # Keep the placement cache warm so the submit path almost never
+          # blocks on a pull (it still pulls on a cold/stale cache). Fire
+          # and forget: one unresponsive peer keeps the waiter from
+          # completing early, and its 1 s timeout must not stall the shared
+          # periodic loop (clock sync + SLO tick run right after this).
+          asyncio.create_task(self.collect_disagg_stats(timeout=1.0))
         if slo_enabled():
           # SLO windows stay fresh without a dedicated timer (the engine
           # self-gates to its tick interval); the anomaly watchers run on
@@ -1780,6 +2155,9 @@ class Node:
       elif status_type in ("slo_pull", "slo_report"):
         # Cluster SLO reports ride the same pull pattern (ISSUE 9).
         self._handle_slo_status(status_data)
+      elif status_type in ("disagg_pull", "disagg_stats"):
+        # Disagg role/capacity adverts for placement (ISSUE 10).
+        self._handle_disagg_status(status_data)
       elif status_type in ("bundle_pull", "bundle_part"):
         # Incident-bundle assembly (ISSUE 9).
         self._handle_bundle_status(status_data)

@@ -71,7 +71,7 @@ TERMINAL_STAGES = frozenset({"shed", "rejected", "rate_limited", "stalled", "err
 # recorder holds transitions, not traffic.
 FLIGHT_STAGES = frozenset({
   "admitted", "shed", "rejected", "rate_limited", "preempted", "parked", "unparked",
-  "spilled", "restored", "drain", "migrated", "stalled", "error",
+  "spilled", "restored", "drain", "migrated", "stalled", "error", "disagg_handoff",
 })
 
 
