@@ -124,6 +124,11 @@ def _count_prefills(server):
 
   wrap("prefill_into_slots")
   wrap("prefill_into_pages_many")
+  # Fused sampling epilogue (ISSUE 11): the default admission path now
+  # dispatches the prefill+sample programs — same batched-prefill semantics,
+  # counted identically.
+  wrap("prefill_into_slots_sampled")
+  wrap("prefill_into_pages_many_sampled")
 
   def poisoned(*a, **k):
     raise AssertionError("scheduler used a single-row prefill entry point")
@@ -335,17 +340,23 @@ def test_chunked_prefill_interleaves_decode(monkeypatch):
   events = []  # ordered ("prefill", n_rows) / ("decode",) trace
 
   orig_prefill = server.ops.prefill_into_pages_many
+  orig_prefill_sampled = server.ops.prefill_into_pages_many_sampled
   orig_decode = server.ops.paged_batch_decode
 
   def rec_prefill(tokens, *a, **k):
     events.append(("prefill", int(np.asarray(tokens).shape[0])))
     return orig_prefill(tokens, *a, **k)
 
+  def rec_prefill_sampled(tokens, *a, **k):
+    events.append(("prefill", int(np.asarray(tokens).shape[0])))
+    return orig_prefill_sampled(tokens, *a, **k)
+
   def rec_decode(*a, **k):
     events.append(("decode",))
     return orig_decode(*a, **k)
 
   server.ops.prefill_into_pages_many = rec_prefill
+  server.ops.prefill_into_pages_many_sampled = rec_prefill_sampled
   server.ops.paged_batch_decode = rec_decode
 
   long_prompt = [(7 * i) % 120 + 1 for i in range(400)]  # 4 chunks of 128

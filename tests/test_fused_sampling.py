@@ -1,0 +1,142 @@
+"""Fused sampling epilogue (ISSUE 11): prefill + first-token sampling in ONE
+device dispatch.
+
+Contract: with ``XOT_TPU_FUSED_SAMPLING`` on (the default), the batched
+scheduler's admissions run the fused prefill programs
+(``prefill_into_{slots,pages_many}_sampled``) and never dispatch the
+separate ``sample_rows`` epilogue — one device dispatch fewer per prefill
+group (dispatch-count spy) — while the emitted streams stay TOKEN-IDENTICAL
+to the unfused two-dispatch path, for greedy and seeded-sampled traffic,
+lookahead on AND off (same ``_next_token_batched`` math on the same key).
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+import xotorch_support_jetson_tpu.models.decoder as decoder_mod
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+PROMPTS = [[3, 25, 9], [7, 1, 88, 42, 5], [100], [9, 9, 9, 1]]
+
+
+def _engine(params, shard, seed=0):
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(shard, CFG, params)
+  engine._key = jax.random.PRNGKey(seed)  # identical key schedules across A/B runs
+  return engine
+
+
+def _serve(server, prompts, n_gen, temp=0.0):
+  streams: dict[str, list] = {}
+
+  async def run():
+    def emit(rid, toks, finished):
+      streams.setdefault(rid, []).extend(toks)
+
+    return await asyncio.gather(
+      *(
+        server.submit(f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=temp, top_k=35, eos_ids=(), emit=emit)
+        for i, p in enumerate(prompts)
+      )
+    )
+
+  outs = asyncio.run(run())
+  return outs, [streams[f"r{i}"] for i in range(len(prompts))]
+
+
+class _DispatchSpy:
+  """Counts the scheduler's per-admission device dispatches: prefill-program
+  calls (fused or not) and separate sample_rows epilogue calls."""
+
+  def __init__(self, server, monkeypatch):
+    self.prefills = 0
+    self.samples = 0
+    ops = server.ops
+    for name in ("prefill_into_slots", "prefill_into_pages_many", "prefill_into_slots_sampled", "prefill_into_pages_many_sampled"):
+      if not hasattr(ops, name):
+        continue
+      orig = getattr(ops, name)
+
+      def counted(*a, _orig=orig, **kw):
+        self.prefills += 1
+        return _orig(*a, **kw)
+
+      monkeypatch.setattr(ops, name, counted)
+    orig_sample = decoder_mod.sample_rows
+
+    def counted_sample(*a, **kw):
+      self.samples += 1
+      return orig_sample(*a, **kw)
+
+    monkeypatch.setattr(decoder_mod, "sample_rows", counted_sample)
+
+
+@pytest.mark.parametrize("paged", [True, False])
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_fused_sampling_identity_and_dispatch_count(monkeypatch, paged, lookahead):
+  """Greedy A/B: fused == unfused token-for-token on both layouts, both
+  scheduler modes; the spy proves the fused run made ZERO sample_rows
+  dispatches (one fewer device dispatch per prefill group) while the
+  unfused run made one per group."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1" if paged else "0")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  params, shard = full_model_params(KEY, CFG)
+  n_gen = 6
+  outs = {}
+  for fused in (True, False):
+    monkeypatch.setenv("XOT_TPU_FUSED_SAMPLING", "1" if fused else "0")
+    server = BatchedServer(_engine(params, shard), n_slots=4, chunk=2, lookahead=lookahead)
+    assert server.fused_sampling is fused
+    spy = _DispatchSpy(server, monkeypatch)
+    outs[fused], streams = _serve(server, PROMPTS, n_gen)
+    for o, s in zip(outs[fused], streams):
+      assert s == o
+    assert spy.prefills >= 1
+    if fused:
+      assert spy.samples == 0, "fused mode must never dispatch the separate sampling epilogue"
+    else:
+      assert spy.samples >= 1, "unfused mode samples in a second dispatch per group"
+      assert spy.samples <= spy.prefills
+    server.shutdown()
+  assert outs[True] == outs[False], f"fused sampling diverged: {outs[True]} != {outs[False]}"
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_fused_sampling_seeded_sampled_identity(monkeypatch, lookahead):
+  """Seeded SAMPLED traffic (temp > 0): the fused program consumes the same
+  event-loop key split as the unfused sample_rows call, so re-seeding the
+  engine gives byte-identical sampled streams either way."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  params, shard = full_model_params(KEY, CFG)
+  outs = {}
+  for fused in (True, False):
+    monkeypatch.setenv("XOT_TPU_FUSED_SAMPLING", "1" if fused else "0")
+    server = BatchedServer(_engine(params, shard, seed=123), n_slots=2, chunk=2, lookahead=lookahead)
+    outs[fused], _ = _serve(server, [[5, 17, 2, 99]], 9, temp=0.8)
+    server.shutdown()
+  assert len(outs[True][0]) == 9
+  assert outs[True] == outs[False], f"seeded sampled A/B diverged: {outs}"
+
+
+def test_fused_sampling_unsupported_backend_falls_back(monkeypatch):
+  """A backend without the fused programs (pp/sp report
+  fused_sampling_supported() == False) keeps the two-dispatch path even
+  with the env knob on."""
+  params, shard = full_model_params(KEY, CFG)
+  engine = _engine(params, shard)
+  monkeypatch.setenv("XOT_TPU_FUSED_SAMPLING", "1")
+  monkeypatch.setattr(type(engine.batch_ops), "fused_sampling_supported", lambda self: False)
+  server = BatchedServer(engine, n_slots=2, chunk=2)
+  assert server.fused_sampling is False
+  outs, _ = _serve(server, [[3, 25, 9]], 3)
+  assert len(outs[0]) == 3
+  server.shutdown()

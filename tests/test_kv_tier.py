@@ -351,14 +351,19 @@ def test_preempt_resume_restore_token_identity(lookahead, monkeypatch):
   server.shutdown()
 
 
-@pytest.mark.parametrize("lookahead", [True, False])
-def test_preempt_resume_via_host_restore_identity(lookahead, monkeypatch):
+@pytest.mark.parametrize("lookahead,kv_quant", [(True, ""), (False, ""), (True, "int4")])
+def test_preempt_resume_via_host_restore_identity(lookahead, kv_quant, monkeypatch):
   """Acceptance (host path): the pool is sized so the preempting request's
   own footprint EVICTS the victim's donated pages — they spill host-side,
   and the resume restores them from the HOST tier. Stream identity against
   the FIFO solo baseline still holds, and the restore counters prove the
-  path taken."""
+  path taken. The ``int4`` point (ISSUE 11) drives the same
+  spill→evict→restore cycle over PACKED pages: the tier moves half the
+  bytes per page and the restored stream stays byte-identical to the
+  never-spilled int4 run (the solo baseline runs the same quant mode)."""
   monkeypatch.delenv("XOT_TPU_KV_TIER", raising=False)
+  if kv_quant:
+    monkeypatch.setenv("XOT_TPU_KV_QUANT", kv_quant)
   monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
   monkeypatch.setenv("XOT_TPU_BATCH_PAGES", "6")  # 5 usable: vip's footprint alone
   engine, params, shard = _engine()
@@ -639,3 +644,102 @@ async def test_kv_tier_api_endpoint():
   finally:
     await client.close()
     await node.stop()
+
+
+# ------------------------------------- quant-mode round trips (ISSUE 11)
+
+
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_tier_round_trip_byte_identical_both_quant_modes(quant):
+  """Spill → device eviction (pages zeroed) → host restore → wire adopt on a
+  SECOND tier, over a REAL jax page pool in both quant modes: every
+  restored leaf is byte-identical to the never-spilled pages, the int4
+  pool's code leaves are packed (half the bytes), and the adopt guard
+  refuses a mismatched quant tag before the byte-geometry guard can be
+  seeded with a foreign layout."""
+  import jax.numpy as jnp
+
+  from xotorch_support_jetson_tpu.inference.kv_tier import gather_pages, scatter_pages
+  from xotorch_support_jetson_tpu.networking.grpc.serialization import (
+    kv_pages_to_proto,
+    proto_to_kv_pages,
+    quant_from_wire,
+  )
+  from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+
+  rng = np.random.default_rng(61)
+  ps, P = 8, 9
+  box = {"pool": init_paged_pool(CFG, 2, P, ps, quant=quant)}
+  assert box["pool"]["k"].dtype == jnp.int8
+  kd = CFG.cache_k_dim // (2 if quant == "int4" else 1)
+  assert box["pool"]["k"].shape[-1] == kd
+  # Fill the real pool with arbitrary code/scale bytes.
+  filled = {}
+  for name, leaf in box["pool"].items():
+    if name.endswith("_scale"):
+      filled[name] = jnp.asarray(rng.uniform(0.005, 0.05, size=leaf.shape), jnp.float32)
+    else:
+      filled[name] = jnp.asarray(rng.integers(-128, 128, size=leaf.shape), jnp.int8)
+  box["pool"] = filled
+  golden = {name: np.asarray(leaf)[:, [2, 3, 5]].copy() for name, leaf in box["pool"].items()}
+
+  def read(pages):
+    return {name: leaf[:, np.asarray(pages)] for name, leaf in box["pool"].items()}, len(pages)
+
+  def write(pages, data):
+    box["pool"] = scatter_pages(box["pool"], pages, data)
+
+  tier = KvTierManager(page_size=ps, read_pages=read, write_pages=write, budget_bytes=1 << 24)
+  tier.kv_quant = quant
+  keys = [f"rt-{quant}-{i}".encode() for i in range(3)]
+  tier.spill(list(zip(keys, [2, 3, 5])))
+  # Device "reuses" the evicted pages: zero them out.
+  box["pool"] = {name: leaf.at[:, [2, 3, 5]].set(0) for name, leaf in box["pool"].items()}
+  # Restore into fresh pages — byte-identical to the never-spilled copies.
+  tier.restore_into(keys, [6, 7, 8])
+  for name in golden:
+    np.testing.assert_array_equal(np.asarray(box["pool"][name])[:, [6, 7, 8]], golden[name], err_msg=f"{quant}/{name}")
+
+  # Wire adopt on a second tier: serialize -> parse -> adopt -> restore.
+  dev, n = read([6, 7, 8])
+  leaves = {name: np.asarray(arr)[:, :n] for name, arr in dev.items()}
+  msg = kv_pages_to_proto("rt", keys, leaves, page_size=ps, seq=0, last=True, quant=quant)
+  keys2, leaves2 = proto_to_kv_pages(msg)
+  assert keys2 == keys
+  box2 = {"pool": {name: jnp.zeros_like(leaf) for name, leaf in box["pool"].items()}}
+
+  def write2(pages, data):
+    box2["pool"] = scatter_pages(box2["pool"], pages, data)
+
+  tier2 = KvTierManager(page_size=ps, read_pages=read, write_pages=write2, budget_bytes=1 << 24)
+  tier2.kv_quant = quant
+  # Mismatched tag refused up front (int8<->int4 cross); untagged accepted.
+  other = "int8" if quant == "int4" else "int4"
+  assert tier2.adopt_wire(keys2, leaves2, quant=other) == 0
+  assert tier2.adopt_wire(keys2, leaves2, quant=quant_from_wire(msg.quant)) == 3
+  tier2.restore_into(keys2, [1, 2, 3])
+  for name in golden:
+    np.testing.assert_array_equal(np.asarray(box2["pool"][name])[:, [1, 2, 3]], golden[name], err_msg=f"wire {quant}/{name}")
+
+
+def test_kv_page_wire_payload_halves_under_int4():
+  """Pinned via proto payload accounting (ISSUE 11 criterion): the SAME
+  pages under int4 ship ~half the int8 payload bytes (codes halve; the f32
+  scales are unchanged, so the exact ratio is (hd/2 + 4)/(hd + 4))."""
+  from xotorch_support_jetson_tpu.networking.grpc.serialization import kv_pages_to_proto, proto_payload_bytes
+  from xotorch_support_jetson_tpu.ops.paged import init_paged_pool
+
+  cfg = tiny_test_config(dim=512, n_heads=8, n_kv_heads=8)  # hd=64, the production geometry
+  ps, P, n = 16, 5, 3
+  keys = [f"pb{i}".encode() for i in range(n)]
+  sizes = {}
+  for quant in ("int8", "int4"):
+    pool = init_paged_pool(cfg, 2, P, ps, quant=quant)
+    leaves = {name: np.asarray(leaf[:, 1 : 1 + n]) for name, leaf in pool.items()}
+    msg = kv_pages_to_proto("pb", keys, leaves, page_size=ps, seq=0, last=True, quant=quant)
+    assert msg.quant == quant
+    sizes[quant] = proto_payload_bytes(msg)
+  hd = cfg.head_dim
+  expect = (hd / 2 + 4) / (hd + 4)  # 0.53 at hd=64
+  assert sizes["int4"] < 0.60 * sizes["int8"]
+  assert abs(sizes["int4"] / sizes["int8"] - expect) < 0.05

@@ -739,6 +739,8 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_slo_attainment",  # {class}
   "xot_tpu_goodput_tok_s",  # {class}
   "xot_tpu_node_role",  # 0=both 1=prefill 2=decode (ISSUE 10)
+  "xot_tpu_paged_kernel_tile",  # shape-aware page-tile verdict for this pool (ISSUE 11)
+  "xot_tpu_kv_quant_bits",  # 16=bf16 8=int8 4=int4 (ISSUE 11)
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -790,6 +792,11 @@ def test_metric_name_snapshot_after_serving():
     "kv_tier_spilled_pages_total", "kv_tier_spilled_bytes_total",
     "kv_tier_restored_pages_total", "kv_tier_restored_bytes_total",
     "kv_tier_host_evictions_total",
+    # Event-driven pool counters: a short solo drive may finish inside its
+    # initial allocation and never grow (module-order dependent — earlier
+    # test modules usually materialize these into the process-global
+    # registry, but the pin must hold in isolation too).
+    "page_grow_events_total", "page_grow_pages_total", "page_release_events_total",
   ):
     gm.inc(name, 0)
   gm.inc("kv_prefix_registry_hits_total", 0, labels={"scope": "local"})

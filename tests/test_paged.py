@@ -94,21 +94,35 @@ def test_paged_kernel_int8kv_dequant_matches_gather_reference():
 
 def test_decode_path_dispatch_table(monkeypatch):
   """Representative (batch, context, quant) points hit the measured winners;
-  the env override forces either in-program path."""
+  the env override forces either in-program path. Retuned in round 15
+  (ISSUE 11): with in-kernel dequant + the shape-aware page tile, QUANTIZED
+  pages dispatch the kernel at every batched shape — the r2 gather win only
+  survives for near-solo rows and small-batch bf16."""
   from xotorch_support_jetson_tpu.inference.paging import select_decode_path
 
   monkeypatch.delenv("XOT_TPU_PAGED_KERNEL", raising=False)
-  # Small-batch serving shapes: the fused XLA gather (round-2 measurement).
+  # Small-batch bf16 serving shapes: the fused XLA gather (round-2
+  # measurement, re-held in the round-15 retune for unquantized pages).
   assert select_decode_path(16, 1024, "", platform="tpu") == "gather"
-  assert select_decode_path(8, 4096, "int8", platform="tpu") == "gather"
+  # Near-solo rows can't fill the kernel grid regardless of quant mode.
+  assert select_decode_path(4, 4096, "int8", platform="tpu") == "gather"
+  assert select_decode_path(2, 1024, "int4", platform="tpu") == "gather"
   # Past the B=16 knee with bf16 KV: dense slots (round-5 knee study).
   assert select_decode_path(48, 1024, "", platform="tpu") == "dense"
-  # Past the knee with int8-KV pages: the kernel (in-kernel dequant).
-  assert select_decode_path(48, 1024, "int8", platform="tpu") == "kernel"
-  assert select_decode_path(32, 4096, "int8", platform="tpu") == "kernel"
+  # Quantized pages at EVERY batched shape: the kernel (ISSUE 11 criterion —
+  # B in {16, 48, 96} under int8-KV and int4-KV).
+  for quant in ("int8", "int4"):
+    for b in (16, 48, 96):
+      for ctx in (1024, 4096, 32768):
+        assert select_decode_path(b, ctx, quant, platform="tpu") == "kernel", (b, ctx, quant)
+  assert select_decode_path(8, 4096, "int8", platform="tpu") == "kernel"  # r15 retune: was gather
   # Long contexts: the kernel's clamped-DMA design target, any quant.
   assert select_decode_path(8, 32768, "", platform="tpu") == "kernel"
   assert select_decode_path(16, 8192, "int8", platform="tpu") == "kernel"
+  # int4 has no dense layout: no (batch, ctx) point may ever say "dense".
+  for b in (1, 16, 48, 96, 256):
+    for ctx in (1024, 4096, 32768):
+      assert select_decode_path(b, ctx, "int4", platform="tpu") != "dense"
   # Non-TPU platforms always take the gather reference.
   assert select_decode_path(48, 32768, "int8", platform="cpu") == "gather"
   # Env forcing keeps the old opt-in/off behaviors.

@@ -45,6 +45,13 @@ class _PageCopyMixin:
 
     return scatter_pages(pool, pages, data)
 
+  def fused_sampling_supported(self) -> bool:
+    """Whether this backend has the fused prefill+sampling programs
+    (ISSUE 11). Default False: the pp/sp mesh backends still prefill and
+    sample in two dispatches (their placed programs have no sampling
+    epilogue yet) — the scheduler falls back to ``sample_rows``."""
+    return False
+
 
 class DecoderBatchOps(_PageCopyMixin):
   """Single-device batched serving ops (the default).
@@ -146,6 +153,33 @@ class DecoderBatchOps(_PageCopyMixin):
     return prefill_into_pages_many(
       eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_rows, jnp.int32),
       jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
+    )
+
+  # ------------------------------------------- fused sampling epilogue
+  # (ISSUE 11): prefill + first-token sampling in ONE dispatch. Only this
+  # single-device backend has the fused programs; pp/sp report
+  # fused_sampling_supported() == False and keep the two-dispatch path.
+
+  def fused_sampling_supported(self) -> bool:
+    return True
+
+  def prefill_into_slots_sampled(self, tokens, cache, rows, prompt_lens, temps, top_ks, k_max: int, key):
+    from ..models.decoder import prefill_into_slots_sampled
+
+    eng = self.engine
+    return prefill_into_slots_sampled(
+      eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.asarray(rows, jnp.int32),
+      jnp.asarray(prompt_lens, jnp.int32), jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max),
+    )
+
+  def prefill_into_pages_many_sampled(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, k_max: int, key):
+    from ..models.decoder import prefill_into_pages_many_sampled
+
+    eng = self.engine
+    return prefill_into_pages_many_sampled(
+      eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_rows, jnp.int32),
+      jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
+      jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max),
     )
 
   def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):

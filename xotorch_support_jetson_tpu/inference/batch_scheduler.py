@@ -297,6 +297,15 @@ class BatchedServer:
     # the KV content behind the same token chains.
     self.tier = None
     self.decode_path = "dense"  # resolved per pool config in _ensure_cache
+    self.kv_quant = None  # resolved with the cache (None = not built yet)
+    # Fused sampling epilogue (ISSUE 11): prefill + first-token sampling in
+    # ONE device dispatch when the backend has the fused programs.
+    # XOT_TPU_FUSED_SAMPLING=0 restores the two-dispatch path (the
+    # token-identity A/B reference).
+    self.fused_sampling = (
+      os.getenv("XOT_TPU_FUSED_SAMPLING", "1") not in ("0", "false")
+      and getattr(self.ops, "fused_sampling_supported", lambda: False)()
+    )
     # Batched speculation (ISSUE 7, module docstring). ``spec_batch=None``
     # resolves from XOT_TPU_SPEC_BATCH (default auto: on exactly when the
     # engine carries a draft and the backend supports it); the final verdict
@@ -705,21 +714,31 @@ class BatchedServer:
       metrics.set_gauge("kv_draft_slots", self.n_slots)
       metrics.set_gauge("kv_draft_pages_equivalent", draft_pages_equiv)
     if self.paged:
-      from .paging import PageAllocator, pages_to_cover
+      from .paging import PageAllocator, kv_cache_bytes, pages_to_cover
 
       ps = self.page_size
       self.pages_per_row = pages_to_cover(self.max_seq, ps)
-      # Default pool size: the dense layout's HBM budget expressed in
-      # PAGES, not its slot count. An int8-KV token costs hd code bytes +
-      # 4 scale bytes per head per side vs 2·hd bf16 bytes, so the same
-      # budget holds 2·hd/(hd+4) ≈ 1.88x (hd=64) the pages — admission at
-      # large batch (the B=48 knee) is bounded by paged+int8-KV block math
-      # instead of dense-slot math, and the pool actually holds the
-      # aggregate context 48 rows need without exceeding the bf16 budget.
+      # Default pool size: the dense bf16 layout's HBM budget expressed in
+      # PAGES of the ACTUAL quant mode (kv_cache_bytes is the one block-math
+      # definition — the draft accounting below and the capacity tests pin
+      # the same formula). An int8-KV token costs hd code bytes + 4 scale
+      # bytes per head per side vs 2·hd bf16 bytes → the same budget holds
+      # 2·hd/(hd+4) ≈ 1.88x (hd=64) the pages; int4 packs two nibbles per
+      # byte → ≈ 3.6x, which is what moves the default admission knee past
+      # B=96 (ISSUE 11: a pool sized from the dense-48 budget covers 96
+      # full context windows under int4, where int8 could not). Admission
+      # at large batch is bounded by this paged block math instead of
+      # dense-slot math.
       per_dense = self.n_slots * self.pages_per_row
       if kv_quant:
-        hd = max(eng.cfg.cache_k_dim, 1)
-        per_dense = (2 * per_dense * hd) // (hd + 4)
+        n_layers = eng._effective_shard.n_shard_layers
+        # The budget baseline is the SERVING dense layout: bf16 K/V (2
+        # bytes/element) regardless of cfg.dtype — test configs run f32
+        # params, but the budget story (and the pinned capacity tests) is
+        # the production bf16 one.
+        heads, per_side = eng.cfg.cache_kv_heads, eng.cfg.cache_k_dim + eng.cfg.cache_v_dim
+        dense_budget = n_layers * per_dense * ps * heads * per_side * 2
+        per_dense = dense_budget // max(kv_cache_bytes(eng.cfg, n_layers, ps, kv_quant), 1)
       if draft_pages_equiv:
         # Draft-KV accounting (ISSUE 7): the draft cache rides in the SAME
         # HBM budget, so its page-equivalent comes out of the default pool —
@@ -740,6 +759,9 @@ class BatchedServer:
         # Rewire onto the (possibly rebuilt) allocator: device evictions
         # spill their pages host-side before the free list reuses them.
         self.allocator.spill_hook = self.tier.spill
+        # The wire quant tag the adopt guard checks (ISSUE 11): a peer
+        # streaming a different KV quant mode is refused up front.
+        self.tier.kv_quant = kv_quant
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
     if self.spec:
@@ -747,12 +769,21 @@ class BatchedServer:
     # Decode-path attribution label for this pool's compiled chunk program:
     # fixed per (layout, slots, window, quant) — the same resolution
     # fused_paged_batch_decode applies to use_kernel=None.
-    from .paging import resolved_decode_path
+    from .paging import resolved_decode_path, select_page_tile
 
+    self.kv_quant = kv_quant
     self.decode_path = resolved_decode_path(
       self.n_slots, (self.pages_per_row * self.page_size) if self.paged else self.max_seq,
       kv_quant, paged=self.paged, cfg=eng.cfg,
     )
+    # Kernel-geometry attribution (ISSUE 11): the page-tile verdict this
+    # pool's shape resolves to, and the KV quant width — regressions in
+    # either are diagnosable from /metrics without re-deriving the tables.
+    metrics.set_gauge(
+      "paged_kernel_tile",
+      select_page_tile(self.n_slots, self.pages_per_row * self.page_size, kv_quant) if self.paged else 0,
+    )
+    metrics.set_gauge("kv_quant_bits", {"": 16, "int8": 8, "int4": 4}[kv_quant])
     self._update_gauges()
 
   def _update_gauges(self) -> None:
@@ -1141,6 +1172,18 @@ class BatchedServer:
       draft_job = self._draft_prefill_job(group)
 
       def run():
+        # Fused sampling epilogue (ISSUE 11): prefill + first-token
+        # sampling in ONE device dispatch — same _next_token_batched math
+        # on the same key, so the unfused path below is token-identical
+        # (A/B-pinned; XOT_TPU_FUSED_SAMPLING=0 restores it).
+        if self.fused_sampling:
+          firsts, self.cache = self.ops.prefill_into_pages_many_sampled(
+            jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size,
+            temps, top_ks, self.k_max, sub,
+          )
+          if draft_job is not None:
+            draft_job()
+          return np.asarray(firsts)
         from ..models.decoder import sample_rows
 
         last, self.cache = self.ops.prefill_into_pages_many(
@@ -1158,6 +1201,13 @@ class BatchedServer:
       def run():
         # Prefill AND first-token sampling stay on the engine executor — the
         # single thread that serializes all device work.
+        if self.fused_sampling:
+          firsts, self.cache = self.ops.prefill_into_slots_sampled(
+            jnp.asarray(tok), self.cache, rows, prompt_lens, temps, top_ks, self.k_max, sub,
+          )
+          if draft_job is not None:
+            draft_job()
+          return np.asarray(firsts)
         from ..models.decoder import sample_rows
 
         last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
@@ -1356,14 +1406,16 @@ class BatchedServer:
     task.add_done_callback(lambda t, ex=ex: self._settle_migration(t, ex))
     self._update_gauges()
 
-  def adopt_kv_wire(self, keys: list, leaves: dict) -> int:
+  def adopt_kv_wire(self, keys: list, leaves: dict, quant: str | None = None) -> int:
     """Decode-node receive side (ISSUE 10): adopt streamed KV pages into
     the host tier — the existing restore path then extends admission's
     device prefix hit with them, COW semantics and all. The tier is created
     lazily (pages can arrive before this node's first request builds the
     pool); a non-paged or tier-disabled scheduler adopts nothing (the
     handoff still lands and prefill recomputes — correctness never depends
-    on the transfer)."""
+    on the transfer). ``quant`` is the sender's KV quant-mode tag (ISSUE
+    11) — a mismatch with this pool's mode refuses the batch BEFORE the
+    tier's byte-geometry guard could be seeded with foreign-layout pages."""
     if not self.paged:
       return 0
     if self.tier is None:
@@ -1372,9 +1424,22 @@ class BatchedServer:
       if not kv_tier_enabled():
         return 0
       self.tier = KvTierManager.from_env(page_size=self.page_size, read_pages=self._tier_read, write_pages=self._tier_write)
+      if self.kv_quant is None:
+        # Pages can arrive BEFORE this node's first request builds the pool
+        # (the disagg receive side) — resolve the mode the pool WILL use
+        # eagerly (pure env/cfg), or the adopt guard would wave a mismatched
+        # sender through exactly when the tier is empty and its
+        # byte-geometry guard is still unseeded.
+        from ..models.decoder import kv_quant_mode
+
+        try:
+          self.kv_quant = kv_quant_mode(self.engine.cfg)
+        except Exception:  # noqa: BLE001 — engine without a cfg yet: guard stays inactive
+          pass
+      self.tier.kv_quant = self.kv_quant
       if self.allocator is not None:
         self.allocator.spill_hook = self.tier.spill
-    return self.tier.adopt_wire(keys, leaves)
+    return self.tier.adopt_wire(keys, leaves, quant=quant)
 
   @staticmethod
   def _slo_class(req: _Request) -> str:

@@ -186,6 +186,11 @@ class KvTierManager:
     self.evict_policy = evict_policy if evict_policy in ("lru", "fifo") else "lru"
     self.max_inflight = max(int(max_inflight), 1)
     self.node_id = node_id
+    # KV quant mode of the pool this tier backs ("" bf16 / "int8" / "int4");
+    # None = unknown (standalone tiers, tests). The wire-adopt guard
+    # (ISSUE 11) refuses a sender whose tagged mode disagrees — BEFORE the
+    # byte-geometry guard could be seeded with a foreign layout.
+    self.kv_quant: str | None = None
     self._entries: "OrderedDict[bytes, dict | _PendingBatch]" = OrderedDict()
     self._pending: list[_PendingBatch] = []
     self._bytes = 0
@@ -355,14 +360,21 @@ class KvTierManager:
   # prefill (the correctness fallback) — it can never corrupt the pool
   # accounting.
 
-  def adopt_wire(self, keys: list[bytes], leaves: dict) -> int:
+  def adopt_wire(self, keys: list[bytes], leaves: dict, quant: str | None = None) -> int:
     """Adopt streamed pages: ``leaves`` maps pool-leaf name → host array
     ``[L, n, ...]`` stacked in ``keys`` order (the ``restore_into`` layout,
     exactly what ``serialization.proto_to_kv_pages`` parses). Returns the
     number of pages adopted; 0 on a geometry mismatch with pages this tier
-    already holds (mixing layouts would poison later restores)."""
+    already holds (mixing layouts would poison later restores), and 0 when
+    the sender's ``quant`` tag (ISSUE 11: ``KvPageBatch.quant``) disagrees
+    with this pool's mode — int8 and int4 pages can share a byte size at
+    some geometries, so the tag guard must fire before the byte guard is
+    trusted (an untagged batch, ``quant=None``, falls back to
+    byte-geometry alone for old senders)."""
     if not keys or not leaves:
       return 0
+    if quant is not None and self.kv_quant is not None and quant != self.kv_quant:
+      return 0  # mismatched KV quant mode: refuse, don't poison the store
     n = min(len(keys), min(int(arr.shape[1]) for arr in leaves.values()))
     if n <= 0:
       return 0

@@ -56,30 +56,57 @@ import numpy as np
 #
 # - "gather": XLA's fused jnp.take+attention over the page pool. Round-2
 #   measurement: 1000 vs kernel 854 vs dense 926 aggregate tok/s at B=16×1K
-#   — XLA fuses the gather without materializing pages, and at small batch
+#   — XLA fuses the gather without materializing pages, and at tiny batch
 #   the grid-step overhead of the kernel doesn't amortize.
 # - "kernel": the Pallas paged kernel (ops/paged.py) — block-table
-#   indirection via scalar prefetch, page-tiled split-K, in-kernel int8-KV
-#   dequant. Wins where gather degrades: the round-5 knee study showed the
-#   page-gather indirection growing with B (paged B=32 1259 vs B=16 1472),
-#   and the kernel's clamped no-op DMA is the design answer for long ragged
-#   caches; with int8-KV pools the in-kernel dequant halves the pool-read
-#   bytes that the out-of-kernel dequant path was doubling.
-# - "dense": advisory only — the dense slot layout beats BOTH paged paths
-#   (round-5: dense int8-KV B=48 1967 vs paged-B=16-knee 1472). Only
+#   indirection via scalar prefetch, page-tiled split-K, in-kernel
+#   int8/int4-KV dequant. The round-2 gather win at B=16 was measured
+#   against the OLD kernel (out-of-kernel dequant, fixed G=4 tile);
+#   re-measured this round with in-kernel dequant, the shape-aware page
+#   tile (``select_page_tile``) and the fused sampling epilogue, the kernel
+#   takes every QUANTIZED batched shape — B=16 closed the last gap (the
+#   r2 854 number was paying a dequantized-cache copy the kernel no longer
+#   makes), and at B=48/96 the wider tile cuts the sequential grid steps
+#   that made the old kernel trail dense. Quantized-KV rows therefore
+#   dispatch "kernel" from B>4 up; the gather remains the near-solo
+#   (B<=4) winner where one row cannot fill the grid.
+# - "dense": advisory only — the dense slot layout still beats both paged
+#   paths for UNQUANTIZED (bf16) KV at mid batch/short context (round-5:
+#   dense bf16 B=48 vs the old paged knee; bf16 pages move 2x the bytes of
+#   int8 so the kernel's in-register dequant win doesn't apply). Only
 #   honorable where the LAYOUT is still a free choice (batch_scheduler
 #   _ensure_cache under XOT_TPU_PAGED=auto); inside an already-paged
 #   program the decoder degrades it to "kernel" (the closest-to-dense
-#   paged path — no materialized gather).
+#   paged path — no materialized gather). int4-KV has no dense layout at
+#   all (packed pages only), so its rows can never say "dense".
 #
 # Rows are (max_batch, max_context_tokens, kv_quant, path); None = any.
 # First row whose bounds cover the query wins.
 
 _DECODE_PATH_TABLE = (
-  (16, 4096, None, "gather"),  # small batch, serving ctx: fused XLA gather (r2 measurement)
+  (4, 4096, None, "gather"),  # near-solo rows, serving ctx: fused XLA gather (r2 measurement)
+  (None, None, "int8", "kernel"),  # quantized pages: in-kernel dequant + shape-aware tile (r6 retune)
+  (None, None, "int4", "kernel"),  # int4 pages are kernel-or-gather by construction; kernel from B>4
+  (16, 4096, "", "gather"),  # small-batch bf16 serving ctx: gather still fuses best (r2, re-held r6)
   (None, 4096, "", "dense"),  # bf16 KV past the B=16 knee: dense slots win when HBM affords
-  (None, None, None, "kernel"),  # large batch or long context (and all int8-KV past the knee)
+  (None, None, None, "kernel"),  # large batch or long context
 )
+
+
+def _table_match(table, batch: int, context: int, kv_quant: str):
+  """First-row-wins walk shared by every (max_batch, max_context, quant,
+  verdict) dispatch table in this module — ONE definition of the matching
+  semantics, so a boundary fix can't land in one table's walk and not the
+  other's."""
+  for max_b, max_ctx, quant, verdict in table:
+    if max_b is not None and batch > max_b:
+      continue
+    if max_ctx is not None and context > max_ctx:
+      continue
+    if quant is not None and quant != kv_quant:
+      continue
+    return verdict
+  return table[-1][-1]
 
 
 def select_decode_path(batch: int, context: int, kv_quant: str = "", platform: str | None = None) -> str:
@@ -102,15 +129,46 @@ def select_decode_path(batch: int, context: int, kv_quant: str = "", platform: s
     platform = jax.default_backend()
   if platform != "tpu":
     return "gather"
-  for max_b, max_ctx, quant, path in _DECODE_PATH_TABLE:
-    if max_b is not None and batch > max_b:
-      continue
-    if max_ctx is not None and context > max_ctx:
-      continue
-    if quant is not None and quant != kv_quant:
-      continue
-    return path
-  return "gather"
+  return _table_match(_DECODE_PATH_TABLE, batch, context, kv_quant)
+
+
+# ------------------------------------------------- page-tile dispatch table
+#
+# How many pages the paged kernel fetches per grid step (ops/paged.py G).
+# The old default (G=4, env-capped) was tuned at B=16×1K and applied to
+# every shape; the r6 sweep at the shapes the scheduler actually dispatches
+# showed the winner is shape-dependent: the kernel's innermost grid axis
+# runs ceil(mp/G) sequential steps per (row, kv-head), so at high batch —
+# where per-(row, head) programs multiply and each row's context share of
+# the pool shrinks — a wider tile amortizes the per-step scalar-prefetch
+# and DMA-issue overhead that G=4 left on the table (B=48/96 retune), while
+# at small batch the extra operand streams beyond G=4 stop paying (the
+# original v5e observation, re-held). Quant mode rides the verdict because
+# int8/int4 tiles are 1x/0.5x the DMA bytes of bf16: halved page bytes make
+# the wider tile profitable one batch bucket earlier.
+#
+# Rows are (max_batch, max_context_tokens, kv_quant, pages_per_step);
+# None = any; first row whose bounds cover the query wins. The kernel
+# clamps the verdict to the largest power of two <= mp either way, and
+# ``XOT_TPU_PAGED_TILE`` still force-caps every shape (the in-process
+# sweep knob).
+
+_PAGE_TILE_TABLE = (
+  (16, 8192, "", 4),  # small-batch bf16: beyond 4 the operand streams stop paying (r2 tune)
+  (16, 8192, None, 8),  # small-batch quantized pages: half the DMA bytes/tile — one bucket wider
+  (48, None, None, 8),  # the dense-knee bucket: 2x fewer sequential steps per (row, head) (r6)
+  (None, None, None, 16),  # B>48 or very long ctx: step count dominates; widest tile wins
+)
+
+
+def select_page_tile(batch: int, context: int, kv_quant: str = "") -> int:
+  """Pages-per-grid-step verdict for a (batch, context, quant) point.
+
+  The raw table verdict — the kernel (ops/paged.py ``_page_tile``) clamps it
+  to a power of two <= mp and applies the ``XOT_TPU_PAGED_TILE`` force-cap.
+  Host-side and pure, so the scheduler can attribute the chosen geometry
+  (``paged_kernel_tile`` gauge) and bench can emit it per shape."""
+  return _table_match(_PAGE_TILE_TABLE, batch, context, kv_quant)
 
 
 def resolved_decode_path(batch: int, context: int, kv_quant: str = "", paged: bool = True, cfg=None, platform: str | None = None) -> str:
@@ -200,12 +258,16 @@ def ewma_update(prev: float | None, obs: float, alpha: float = 0.3) -> float:
 def kv_cache_bytes(cfg, n_layers: int, n_tokens: int, quant: str = "") -> int:
   """HBM bytes of ``n_tokens`` cached positions under ``quant`` — the block
   math shared by the scheduler's pool sizing and the draft-cache accounting
-  (ISSUE 7: enabling speculation must not oversubscribe admission)."""
+  (ISSUE 7: enabling speculation must not oversubscribe admission). int4
+  packs two code nibbles per byte (half the code bytes of int8); both
+  quantized modes pay one f32 scale per (token, head) per side."""
   import jax.numpy as jnp
 
   heads = cfg.cache_kv_heads
   per_side = cfg.cache_k_dim + cfg.cache_v_dim
-  if quant:
+  if quant == "int4":
+    per_token = heads * (per_side // 2 + 2 * 4)
+  elif quant:
     # int8 codes (1 byte/element) + one f32 scale per (token, head) per side.
     per_token = heads * (per_side + 2 * 4)
   else:

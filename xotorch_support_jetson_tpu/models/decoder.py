@@ -63,14 +63,28 @@ def _mm(x: jnp.ndarray, p: Params, name: str, compute: str = "") -> jnp.ndarray:
 
 def kv_quant_mode(cfg: ModelConfig, quant: str | None = None) -> str:
   """Resolve the KV-cache quantization mode: explicit arg wins, else the
-  ``XOT_TPU_KV_QUANT`` env ("" or "int8"). MLA (deepseek) caches the latent —
-  already 9-71× smaller than per-head K/V — and reconstructs BOTH k and v
-  from it, so quantization there is all risk and little bandwidth; it stays
-  in model dtype."""
+  ``XOT_TPU_KV_QUANT`` env ("", "int8" or "int4"). MLA (deepseek) caches the
+  latent — already 9-71× smaller than per-head K/V — and reconstructs BOTH k
+  and v from it, so quantization there is all risk and little bandwidth; it
+  stays in model dtype. "int4" (ISSUE 11) packs two code nibbles per byte
+  along the head dim (models/quantize.py quantize_kv_int4): token-exact vs
+  its OWN quantized reference, halving cache/page/host-tier/wire bytes
+  again vs int8."""
   mode = os.getenv("XOT_TPU_KV_QUANT", "") if quant is None else quant
-  if mode not in ("", "int8"):
-    raise ValueError(f"XOT_TPU_KV_QUANT supports '' or 'int8'; got {mode!r}")
+  if mode not in ("", "int8", "int4"):
+    raise ValueError(f"XOT_TPU_KV_QUANT supports '', 'int8' or 'int4'; got {mode!r}")
   return "" if cfg.is_mla else mode
+
+
+def pool_kv_quant(pool: Params, cfg: ModelConfig) -> str:
+  """KV quant mode a cache/pool dict ENCODES ("", "int8", "int4") — the
+  one place the halved-code-axis detection idiom lives for whole-pool
+  callers (the fused program wrappers resolving dispatch verdicts; the
+  per-layer steps detect against their activation widths instead, since a
+  scanned layer slice has no cfg-relative geometry)."""
+  if "k_scale" not in pool:
+    return ""
+  return "int4" if jnp.shape(pool["k"])[-1] * 2 == cfg.cache_k_dim else "int8"
 
 
 def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: int, dtype=None, quant: str | None = None) -> Params:
@@ -85,11 +99,21 @@ def init_kv_cache(cfg: ModelConfig, n_shard_layers: int, batch: int, max_seq: in
   see kv_quant_mode) stores int8 codes plus per-(token, head) f32 scale
   leaves ``k_scale``/``v_scale`` shaped [..., 1] — same rank and axis
   semantics as the codes, so slot/page/sp plumbing is layout-blind to them.
+  ``quant="int4"`` packs two code nibbles per byte along the head dim (the
+  code leaves carry a HALVED trailing axis; detection downstream compares
+  it against the config's cache dims, the qdot idiom) with the same scale
+  leaves.
   """
   dtype = dtype or cfg.dtype
-  k_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_k_dim)
-  v_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, cfg.cache_v_dim)
-  if kv_quant_mode(cfg, quant):
+  mode = kv_quant_mode(cfg, quant)
+  kd, vd = cfg.cache_k_dim, cfg.cache_v_dim
+  if mode == "int4":
+    if kd % 2 or vd % 2:
+      raise ValueError(f"int4 KV needs even cache dims; got k={kd} v={vd}")
+    kd, vd = kd // 2, vd // 2
+  k_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, kd)
+  v_shape = (n_shard_layers, batch, max_seq, cfg.cache_kv_heads, vd)
+  if mode:
     scale_shape = k_shape[:-1] + (1,)
     return {
       "k": jnp.zeros(k_shape, dtype=jnp.int8),
@@ -442,27 +466,33 @@ def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: Mod
       start = positions[:, 0]
       from ..ops.pallas_attention import flash_attention_prefill, flash_decode_attention, flash_decode_supported, flash_supported
 
-      if "k_scale" in kv:  # int8 KV (models/quantize.py quantize_kv)
-        from .quantize import quantize_kv
+      if "k_scale" in kv:  # int8/int4 KV (models/quantize.py quantize_kv[_int4])
+        from .quantize import quantize_kv, quantize_kv_int4, unpack_int4_kv
 
-        kq, ks = quantize_kv(k)
-        vq, vs = quantize_kv(v)
+        packed = kv["k"].shape[-1] * 2 == k.shape[-1]  # int4: halved code axis
+        quant_fn = quantize_kv_int4 if packed else quantize_kv
+        kq, ks = quant_fn(k)
+        vq, vs = quant_fn(v)
         kv = {
           "k": _write_cache(kv["k"], kq, start),
           "k_scale": _write_cache(kv["k_scale"], ks, start),
           "v": _write_cache(kv["v"], vq, start),
           "v_scale": _write_cache(kv["v_scale"], vs, start),
         }
-        if cfg.plain_attention and S > 1 and flash_supported(q.shape, kv["k"].shape[1]):
+        if cfg.plain_attention and S > 1 and not packed and flash_supported(q.shape, kv["k"].shape[1]):
           # Prefill: int8 codes + scales stream straight through the flash
           # kernel (per-block in-register dequant) — no materialized bf16
-          # cache copy, 1 byte/element HBM traffic.
+          # cache copy, 1 byte/element HBM traffic. (int4 takes the einsum
+          # path below — the flash kernel has no nibble unpack.)
           attn = flash_attention_prefill(q, kv["k"], kv["v"], q_offset=positions[:, 0], k_scale=kv["k_scale"], v_scale=kv["v_scale"])
         else:
-          # Decode reads the cache as int8 CODES — the convert fuses into
-          # the einsum, so the HBM-bound cache read moves half the bytes.
+          # Decode reads the cache as quantized CODES — the convert (and the
+          # int4 nibble unpack) fuses into the einsum, so the HBM-bound cache
+          # read moves the quantized bytes only.
+          k_codes = unpack_int4_kv(kv["k"]) if packed else kv["k"]
+          v_codes = unpack_int4_kv(kv["v"]) if packed else kv["v"]
           attn = gqa_attention(
-            q, kv["k"], kv["v"], positions, kv_positions, k_scale=kv["k_scale"], v_scale=kv["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
+            q, k_codes, v_codes, positions, kv_positions, k_scale=kv["k_scale"], v_scale=kv["v_scale"], **_attn_opts(cfg, p.get("is_sliding"))
           )
       else:
         kv = {"k": _write_cache(kv["k"], k, start), "v": _write_cache(kv["v"], v, start)}
@@ -985,9 +1015,48 @@ def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool
 def sample_rows(logits, key, temps, top_ks, k_max: int):
   """First-token sampling for a batched admission: per-row temp/top_k over
   [K, V] logits in one device call (K host-side _sample_sync round-trips
-  would pay K tunnel RTTs — the thing batched admission exists to avoid)."""
+  would pay K tunnel RTTs — the thing batched admission exists to avoid).
+
+  The UNFUSED epilogue: a second device dispatch after the prefill program.
+  The fused variants below (``prefill_into_slots_sampled`` /
+  ``prefill_into_pages_many_sampled``) run the IDENTICAL
+  ``_next_token_batched`` on the in-program logits with the same key, so
+  the sampled tokens match token-for-token — kept as the
+  ``XOT_TPU_FUSED_SAMPLING=0`` A/B reference and for backends without the
+  fused programs (pp/sp)."""
   tok, _ = _next_token_batched(logits, key, temps, top_ks, k_max)
   return tok
+
+
+# ------------------------------------------------ fused sampling epilogue
+# (ISSUE 11): the batched admission path historically ran TWO device
+# dispatches per prefill group — the prefill program, then ``sample_rows``
+# over its last-token logits. The variants below fold the sampling epilogue
+# into the prefill program itself (the logits never leave the device
+# unsampled), so every admission (and every final prefill chunk feeding the
+# PR 3 lookahead chain its seed token) costs one device dispatch fewer.
+# Token-identical to prefill + ``sample_rows`` by construction: same
+# ``_next_token_batched`` math, same key, same traced temps/top_ks.
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "k_max"))
+def prefill_into_slots_sampled(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, temps, top_ks, key, k_max: int):
+  """``prefill_into_slots`` with the sampling epilogue fused in-program.
+
+  Returns (first_tokens [K] int32, cache) — one dispatch where the unfused
+  path took two."""
+  last, cache = prefill_into_slots(params, cfg, shard, tokens, cache, rows, prompt_lens)
+  tok, _ = _next_token_batched(last, key, temps, top_ks, k_max)
+  return tok, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "page_size", "k_max"))
+def prefill_into_pages_many_sampled(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, key, k_max: int):
+  """``prefill_into_pages_many`` with the sampling epilogue fused in-program
+  (the paged-admission analogue of ``prefill_into_slots_sampled``)."""
+  last, pool = prefill_into_pages_many(params, cfg, shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size)
+  tok, _ = _next_token_batched(last, key, temps, top_ks, k_max)
+  return tok, pool
 
 
 def _next_token_batched(rows, key, temps, top_ks, k_max: int):
@@ -1073,11 +1142,13 @@ def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: Mode
     pool_l = {"k": k_pool, "v": v_pool}
   else:
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
-    if "k_scale" in pool_l:  # int8 KV pages (models/quantize.py quantize_kv)
-      from .quantize import quantize_kv
+    if "k_scale" in pool_l:  # int8/int4 KV pages (models/quantize.py)
+      from .quantize import quantize_kv, quantize_kv_int4
 
-      kq, ks = quantize_kv(k[:, 0])
-      vq, vs = quantize_kv(v[:, 0])
+      packed = pool_l["k"].shape[-1] * 2 == k.shape[-1]  # int4: halved code axis
+      quant_fn = quantize_kv_int4 if packed else quantize_kv
+      kq, ks = quant_fn(k[:, 0])
+      vq, vs = quant_fn(v[:, 0])
       pool_l = {
         "k": write_token_kv(pool_l["k"], kq, block_tables, pos, page_size),
         "k_scale": write_token_kv(pool_l["k_scale"], ks, block_tables, pos, page_size),
@@ -1085,10 +1156,11 @@ def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: Mode
         "v_scale": write_token_kv(pool_l["v_scale"], vs, block_tables, pos, page_size),
       }
       if use_kernel and cfg.plain_attention:
-        # int8-KV pages straight through the kernel: codes + scales stream
-        # per page tile with in-register dequant — the pool read stays
-        # 1 byte/element (the gather fallback below moves int8 bytes too,
-        # but materializes the gathered window).
+        # int8/int4-KV pages straight through the kernel: codes + scales
+        # stream per page tile with in-register dequant — the pool read
+        # stays 1 byte/element (0.5 for packed int4; the gather fallback
+        # below moves the same quantized bytes but materializes the
+        # gathered window).
         attn = paged_decode_attention(
           q[:, 0], pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
           k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"],
@@ -1184,9 +1256,8 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   if key is None:
     key = jax.random.PRNGKey(0)
   if use_kernel is None:
-    kv_quant = "int8" if "k_scale" in pool else ""
     context = int(jnp.shape(block_tables)[1]) * int(page_size)
-    use_kernel = paged_kernel_supported(cfg) and select_decode_path(token.shape[0], context, kv_quant) != "gather"
+    use_kernel = paged_kernel_supported(cfg) and select_decode_path(token.shape[0], context, pool_kv_quant(pool, cfg)) != "gather"
   B = token.shape[0]
   top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
   return _fused_paged_batch_decode_impl(
@@ -1219,28 +1290,56 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
 # one-split-per-step exactly.
 
 
-def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int):
+def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool = False, interpret: bool = False):
   """One decoder layer for a multi-token VERIFY window against the page pool.
 
   positions [B, W] are each row's own absolute window positions (rows are at
   different depths). Writes all W tokens' KV through the block tables, then
-  attends via the gather reference path — the Pallas paged kernel is
-  one-query-per-row; a multi-query verify kernel is future work (the verify
-  reads each row's whole context once per round either way, exactly like a
-  decode step). MLA is unsupported here (the scheduler keeps MLA models on
-  the plain chunk program in paged mode)."""
+  attends per window position through the tuned Pallas kernel when the
+  dispatch table said kernel (``use_kernel`` — W is small and static, so the
+  window unrolls into W one-query kernel launches; each query's ``lengths``
+  is its own position+1, the same mask the reference's causal window
+  applies, and the batched pool read per launch is exactly a decode step's),
+  or via the gather reference otherwise. Before ISSUE 11 the verify ALWAYS
+  took the gather reference — batched speculation forfeited the kernel win
+  its plain chunks had. MLA is unsupported here (the scheduler keeps MLA
+  models on the plain chunk program in paged mode)."""
   B, W, D = h.shape
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
-  from ..ops.paged import paged_gqa_attention_ref, write_token_kv
+  from ..ops.paged import paged_decode_attention, paged_gqa_attention_ref, write_token_kv
 
   q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
   lengths = positions[:, -1] + 1  # valid KV slots incl. the window's writes
-  if "k_scale" in pool_l:  # int8 KV pages — per-token scales, same values a
-    # one-token-at-a-time write would produce (quantize_kv is per-(token, head))
-    from .quantize import quantize_kv
 
-    kq, ks = quantize_kv(k)
-    vq, vs = quantize_kv(v)
+  def window_attn(k_pool, v_pool, ks_pool=None, vs_pool=None):
+    """Kernel route: one tuned-kernel launch per window position, each
+    masked by its own query's length; gather route: one multi-query
+    reference call. Token-exact either way (A/B-pinned)."""
+    if use_kernel and cfg.plain_attention:
+      outs = []
+      for j in range(W):
+        outs.append(paged_decode_attention(
+          q[:, j], k_pool, v_pool, block_tables, positions[:, j] + 1, page_size,
+          k_scale_pool_l=ks_pool, v_scale_pool_l=vs_pool, interpret=interpret,
+        ))
+      return jnp.stack(outs, axis=1)  # [B, W, Hq, hd]
+    scales = {} if ks_pool is None else {"k_scale_pool_l": ks_pool, "v_scale_pool_l": vs_pool}
+    kk = k_pool if ks_pool is not None else k_pool.astype(h.dtype)
+    vv = v_pool if ks_pool is not None else v_pool.astype(h.dtype)
+    return paged_gqa_attention_ref(
+      q, kk, vv, block_tables, lengths, page_size,
+      q_positions=positions, **scales, **_attn_opts(cfg, p.get("is_sliding")),
+    )
+
+  if "k_scale" in pool_l:  # int8/int4 KV pages — per-token scales, same values
+    # a one-token-at-a-time write would produce (quantize_kv[_int4] is
+    # per-(token, head))
+    from .quantize import quantize_kv, quantize_kv_int4
+
+    packed = pool_l["k"].shape[-1] * 2 == k.shape[-1]
+    quant_fn = quantize_kv_int4 if packed else quantize_kv
+    kq, ks = quant_fn(k)
+    vq, vs = quant_fn(v)
     pool_l = dict(pool_l)
     for j in range(W):  # W is small (gamma_max+1) and static
       pos_j = positions[:, j]
@@ -1248,21 +1347,14 @@ def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cf
       pool_l["k_scale"] = write_token_kv(pool_l["k_scale"], ks[:, j], block_tables, pos_j, page_size)
       pool_l["v"] = write_token_kv(pool_l["v"], vq[:, j], block_tables, pos_j, page_size)
       pool_l["v_scale"] = write_token_kv(pool_l["v_scale"], vs[:, j], block_tables, pos_j, page_size)
-    attn = paged_gqa_attention_ref(
-      q, pool_l["k"], pool_l["v"], block_tables, lengths, page_size,
-      k_scale_pool_l=pool_l["k_scale"], v_scale_pool_l=pool_l["v_scale"],
-      q_positions=positions, **_attn_opts(cfg, p.get("is_sliding")),
-    )
+    attn = window_attn(pool_l["k"], pool_l["v"], pool_l["k_scale"], pool_l["v_scale"])
   else:
     k_pool, v_pool = pool_l["k"], pool_l["v"]
     for j in range(W):
       pos_j = positions[:, j]
       k_pool = write_token_kv(k_pool, k[:, j], block_tables, pos_j, page_size)
       v_pool = write_token_kv(v_pool, v[:, j], block_tables, pos_j, page_size)
-    attn = paged_gqa_attention_ref(
-      q, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, page_size,
-      q_positions=positions, **_attn_opts(cfg, p.get("is_sliding")),
-    )
+    attn = window_attn(k_pool, v_pool)
     pool_l = {"k": k_pool, "v": v_pool}
   attn_out = _mm(attn.reshape(B, W, -1), p, "wo", cfg.quant_compute)
   if "post_attn_norm" in p:  # gemma2
@@ -1272,10 +1364,12 @@ def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cf
   return h, pool_l
 
 
-def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int):
+def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool = False, interpret: bool = False):
   """W-token forward for every row against the page pool — the batched
   speculative VERIFY pass. tokens/positions [B, W] → (logits [B, W, V],
-  updated pool). Full shard only."""
+  updated pool). Full shard only. ``use_kernel`` routes each window
+  position through the tuned Pallas kernel instead of the gather reference
+  (``_paged_window_layer_step``; A/B-pinned token-exact)."""
   if cfg.is_mla:
     raise ValueError("paged_window_forward does not support MLA models")
   h = embed_tokens(params, cfg, tokens)
@@ -1289,7 +1383,7 @@ def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
     def body(carry, per_layer):
       h = carry
       lp, pool_l = per_layer
-      h, pool_l = _paged_window_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size)
+      h, pool_l = _paged_window_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel, interpret)
       return h, pool_l
 
     h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
@@ -1375,14 +1469,14 @@ def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, posit
   return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size"), donate_argnums=(2, 3))
-def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int):
+@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size", "use_kernel", "interpret"), donate_argnums=(2, 3))
+def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
   # Inactive rows' window writes must not land on pages another row may now
   # own: pin their tables to the trash page once (tables are chunk-constant).
   bt = jnp.where(active[:, None], block_tables, 0)
 
   def verify(window, wpos, pool):
-    return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size)
+    return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size, use_kernel, interpret)
 
   return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, pool, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
 
@@ -1421,24 +1515,33 @@ def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cf
   )
 
 
-def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, key=None):
+def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, interpret: bool = False, key=None):
   """``fused_spec_batch_decode`` against the page pool.
 
   Same contract plus ``block_tables`` [B, mp]: the host must have allocated
   pages covering every row's WORST-CASE advance
   ``n_rounds·(gamma_max+1)`` before dispatch
   (inference/paging.py ``spec_worst_advance`` — the gamma-deep analogue of
-  the lookahead pipeline's one-extra-chunk headroom). The verify pass runs
-  the gather reference attention (multi-query); the draft keeps its dense
-  slot cache.
+  the lookahead pipeline's one-extra-chunk headroom). ``use_kernel=None``
+  resolves through the SAME dispatch table as ``fused_paged_batch_decode``
+  — when the table says kernel, the verify window runs per-position through
+  the tuned Pallas kernel instead of the gather reference (ISSUE 11: spec
+  chunks no longer forfeit the kernel win; A/B-pinned token-exact); the
+  draft keeps its dense slot cache either way.
   """
+  from ..inference.paging import select_decode_path
+  from ..ops.paged import paged_kernel_supported
+
   if cfg.is_mla:
     raise ValueError("fused_spec_paged_batch_decode does not support MLA models (use the dense layout)")
+  if use_kernel is None:
+    context = int(jnp.shape(block_tables)[1]) * int(page_size)
+    use_kernel = paged_kernel_supported(cfg) and select_decode_path(jnp.shape(token)[0], context, pool_kv_quant(pool, cfg)) != "gather"
   token, active, gammas, temps, top_ks, key = _spec_batch_args(shard, token, active, gammas, temps, top_k, k_max, key)
   return _fused_spec_paged_batch_decode_impl(
     params, params_d, pool, cache_d, token, jnp.asarray(block_tables, jnp.int32), positions, active,
     jnp.minimum(gammas, gamma_max), temps, top_ks, key,
-    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size),
+    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size), bool(use_kernel), bool(interpret),
   )
 
 

@@ -160,6 +160,47 @@ def dequantize_kv(codes: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
   return (codes.astype(jnp.float32) * scale).astype(dtype)
 
 
+# ------------------------------------------------------------ int4 KV cache
+#
+# The int4 page mode (ISSUE 11): codes pack two 4-bit values per int8 byte
+# along the HEAD-DIM axis (channel 2i in the low nibble, 2i+1 in the high —
+# the same nibble convention as quantize_weight_int4, but on the LAST axis
+# because KV scales are per-(token, head) over the whole hd vector). The
+# packed leaf keeps the codes' rank with a halved trailing dim, so every
+# dict-generic cache path (slot gather/scatter, page row gather, tier
+# spill/restore, the KvPageBatch wire) moves the packed bytes untouched —
+# detection everywhere is the halved axis against the expected head dim,
+# exactly the qdot idiom. One scale per (token, head) rides unchanged, so
+# the int8 scale machinery (gqa_attention k_scale/v_scale, the kernel's
+# per-column score scaling) consumes int4 codes the moment they are
+# unpacked back to int8 nibble values in [-8, 7].
+
+
+def quantize_kv_int4(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Symmetric per-(token, head) int4, packed two nibbles per byte along hd.
+
+  x [..., hd] → (packed int8 [..., hd/2], scale f32 [..., 1])."""
+  if x.shape[-1] % 2:
+    raise ValueError(f"int4 KV packing needs an even head dim; got {x.shape}")
+  xf = x.astype(jnp.float32)
+  absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+  scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+  q = jnp.clip(jnp.round(xf / scale), -8, 7).astype(jnp.int8)
+  lo = q[..., 0::2] & 0x0F
+  hi = (q[..., 1::2] & 0x0F) << 4
+  return (lo | hi).astype(jnp.int8), scale
+
+
+def unpack_int4_kv(packed: jnp.ndarray) -> jnp.ndarray:
+  """packed int8 [..., hd/2] → int8 nibble values [..., hd] (sign-extended,
+  channel order restored). The unpacked array IS an int8-codes array for the
+  shared scale machinery: value = code × scale."""
+  lo = (packed << 4) >> 4  # arithmetic shifts on int8 sign-extend the nibble
+  hi = packed >> 4
+  pair = jnp.stack([lo, hi], axis=-1)  # [..., hd/2, 2]
+  return pair.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
 def qdot(x: jnp.ndarray, w: jnp.ndarray, scale: jnp.ndarray, compute: str = "w8a16") -> jnp.ndarray:
   """x [..., in] @ quantized w → [..., out] in x.dtype.
 

@@ -388,19 +388,21 @@ class GRPCPeerHandle(PeerHandle):
       request.result.extend(int(r) for r in result)
     await self._traced_call("SendResult", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
 
-  async def send_kv_pages(self, request_id: str, chain_keys: list, leaves: dict, *, page_size: int, seq: int, last: bool) -> int:
-    """Stream one batch of int8-KV pages to this peer (disaggregated
+  async def send_kv_pages(self, request_id: str, chain_keys: list, leaves: dict, *, page_size: int, seq: int, last: bool, quant: str | None = None) -> int:
+    """Stream one batch of quantized KV pages to this peer (disaggregated
     prefill/decode, ISSUE 10). ``leaves`` maps pool-leaf name → host array
     ``[L, n, ...]`` in ``chain_keys`` order; the batch rides the raw-bytes
-    fast path (1 byte/element for int8 codes), carries the traceparent +
-    QoS metadata like every data-plane RPC, and records a client-side
-    ``SendKvPages`` hop span. Returns the number of pages the peer adopted
-    (0 on refusal — the stream is best-effort by contract)."""
+    fast path (1 byte/element for int8 codes, 0.5 for packed int4), carries
+    the traceparent + QoS metadata like every data-plane RPC, a
+    ``quant`` mode tag for the receiver's adopt guard (ISSUE 11), and
+    records a client-side ``SendKvPages`` hop span. Returns the number of
+    pages the peer adopted (0 on refusal — the stream is best-effort by
+    contract)."""
     await self._ensure_connected()
     t_start = node_now_ns(self.origin_id)
     t_ser = time.perf_counter()
     request = kv_pages_to_proto(
-      request_id, chain_keys, leaves, page_size=page_size, seq=seq, last=last, origin=self.origin_id or "",
+      request_id, chain_keys, leaves, page_size=page_size, seq=seq, last=last, origin=self.origin_id or "", quant=quant,
     )
     response = await self._traced_call("SendKvPages", request, request_id, time.perf_counter() - t_ser, t_start_ns=t_start)
     return int(response.adopted) if response.ok else 0

@@ -37,6 +37,7 @@ from .serialization import (
   proto_payload_bytes,
   proto_to_kv_pages,
   proto_to_shard,
+  quant_from_wire,
   proto_to_state,
   proto_to_tensor,
   shard_to_proto,
@@ -312,7 +313,9 @@ class GRPCServer:
     adopted = 0
     err = ""
     try:
-      adopted = int(self.node.handle_kv_pages(request.request_id, keys, leaves, page_size=int(request.page_size)))
+      adopted = int(self.node.handle_kv_pages(
+        request.request_id, keys, leaves, page_size=int(request.page_size), quant=quant_from_wire(request.quant),
+      ))
     except Exception as e:  # noqa: BLE001
       err = repr(e)
     finally:

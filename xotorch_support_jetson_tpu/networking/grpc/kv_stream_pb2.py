@@ -22,6 +22,7 @@ Schema (proto3, package ``xot_tpu``):
       bool   last       = 5;   // final batch before the decode handoff
       repeated KvPageLeaf leaves = 6;
       string origin     = 7;   // sending node id
+      string quant      = 8;   // KV quant-mode tag: "bf16"|"int8"|"int4" ("" = untagged)
     }
     message KvPageAck {
       bool   ok      = 1;
@@ -73,6 +74,9 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     ("last", descriptor_pb2.FieldDescriptorProto.TYPE_BOOL, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
     ("leaves", descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED, ".xot_tpu.KvPageLeaf"),
     ("origin", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
+    # KV quant-mode tag (ISSUE 11): "bf16" | "int8" | "int4". "" = untagged
+    # (a pre-tag sender) — the receiver then trusts byte geometry alone.
+    ("quant", descriptor_pb2.FieldDescriptorProto.TYPE_STRING, descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL, ""),
   ]
   for num, (fname, ftype, label, tname) in enumerate(specs, start=1):
     f = batch.field.add()

@@ -81,11 +81,26 @@ def proto_to_tensor(t: pb.Tensor) -> np.ndarray | None:
 # ----------------------------------------------------- KV-page stream (ISSUE 10)
 
 
-def kv_pages_to_proto(request_id: str, chain_keys: list[bytes], leaves: dict, *, page_size: int, seq: int, last: bool, origin: str = "") -> "pbkv.KvPageBatch":
+def wire_quant_tag(kv_quant: str | None) -> str:
+  """Map a pool's KV quant mode to the explicit wire tag: the pool encodes
+  bf16 as "" but the wire must distinguish "untagged old sender" from
+  "explicitly unquantized", so "" becomes "bf16" on the wire."""
+  return {None: "", "": "bf16"}.get(kv_quant, kv_quant)
+
+
+def quant_from_wire(tag: str) -> str | None:
+  """Inverse of ``wire_quant_tag``: "" (untagged) → None, "bf16" → ""."""
+  return {"": None, "bf16": ""}.get(tag, tag)
+
+
+def kv_pages_to_proto(request_id: str, chain_keys: list[bytes], leaves: dict, *, page_size: int, seq: int, last: bool, origin: str = "", quant: str | None = None) -> "pbkv.KvPageBatch":
   """Build one KV-page stream batch: ``leaves`` maps pool-leaf name →
   host array ``[L, n_pages, ...]`` stacked in ``chain_keys`` order (the
   exact layout ``kv_tier.restore_into`` scatters). Leaf bytes ride the
-  raw-bytes fast path — int8 codes are 1 byte/element on the wire."""
+  raw-bytes fast path — int8 codes are 1 byte/element on the wire, packed
+  int4 codes (ISSUE 11) 0.5 byte/element (the halved trailing shape axis
+  carries the packing; ``quant`` tags the mode so the receiver's adopt
+  guard can refuse a mismatched pool up front)."""
   msg = pbkv.KvPageBatch(
     request_id=request_id,
     chain_keys=[k.hex() for k in chain_keys],
@@ -93,6 +108,7 @@ def kv_pages_to_proto(request_id: str, chain_keys: list[bytes], leaves: dict, *,
     seq=int(seq),
     last=bool(last),
     origin=origin,
+    quant=wire_quant_tag(quant),
   )
   for name, arr in leaves.items():
     a = arr if isinstance(arr, np.ndarray) else np.asarray(arr)

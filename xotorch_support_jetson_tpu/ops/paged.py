@@ -45,14 +45,25 @@ def init_paged_pool(cfg, n_shard_layers: int, n_pages: int, page_size: int, dtyp
   models; for MLA "k" holds the kv latent and "v" the rope channel.
   ``quant="int8"`` (default from ``XOT_TPU_KV_QUANT``; dense only) adds
   per-(slot, head) scale leaves [..., 1] — halving pool bytes DOUBLES the
-  contexts resident at a fixed HBM budget.
+  contexts resident at a fixed HBM budget. ``quant="int4"`` (ISSUE 11)
+  packs two code nibbles per byte along the head dim — the code leaves
+  carry a HALVED trailing axis (the detection idiom everywhere: packed
+  iff ``shape[-1] * 2 == cfg.cache_k_dim``) and the same per-(slot, head)
+  scales, halving page bytes AGAIN vs int8 (~2x pages, ~2x effective pool
+  read bandwidth, half the host-tier and wire bytes per page).
   """
   from ..models.decoder import kv_quant_mode
 
   dtype = dtype or cfg.dtype
-  k_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_k_dim)
-  v_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, cfg.cache_v_dim)
-  if kv_quant_mode(cfg, quant):
+  mode = kv_quant_mode(cfg, quant)
+  kd, vd = cfg.cache_k_dim, cfg.cache_v_dim
+  if mode == "int4":
+    if kd % 2 or vd % 2:
+      raise ValueError(f"int4 KV pages need even cache dims; got k={kd} v={vd}")
+    kd, vd = kd // 2, vd // 2
+  k_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, kd)
+  v_shape = (n_shard_layers, n_pages, cfg.cache_kv_heads, page_size, vd)
+  if mode:
     scale_shape = k_shape[:-1] + (1,)
     return {
       "k": jnp.zeros(k_shape, dtype=jnp.int8),
@@ -124,8 +135,11 @@ def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_s
   """Reference paged decode attention via gather (q [B, Sq, Hq, hd]; Sq is 1
   on the decode path). ``attn_opts`` forward gemma2's
   scale/softcap/sliding-window (models/decoder.py _attn_opts). With scale
-  pools (int8 KV), the gathered codes stay the einsum operand and the scales
-  gather alongside — the page gather itself moves int8 bytes.
+  pools (int8/int4 KV), the gathered codes stay the einsum operand and the
+  scales gather alongside — the page gather itself moves the quantized
+  bytes; packed int4 pools (trailing code axis == hd/2) unpack to int8
+  nibble values AFTER the gather, so the HBM-side move is 0.5 byte/element
+  and the unpack is a register-level fixup XLA fuses into the consumer.
   ``q_positions`` [B, Sq] overrides the single-query default — the batched
   speculative VERIFY window (models/decoder.py paged_window_forward) passes
   each row's own window positions."""
@@ -135,6 +149,11 @@ def paged_gqa_attention_ref(q, k_pool_l, v_pool_l, block_tables, lengths, page_s
   if q_positions is None:
     q_positions = (lengths - 1)[:, None]  # current token's position
   if k_scale_pool_l is not None:
+    if k.shape[-1] * 2 == q.shape[-1]:  # packed int4 codes (ISSUE 11)
+      from ..models.quantize import unpack_int4_kv
+
+      k = unpack_int4_kv(k)
+      v = unpack_int4_kv(v)
     attn_opts = dict(attn_opts, k_scale=gather_pages(k_scale_pool_l, block_tables), v_scale=gather_pages(v_scale_pool_l, block_tables))
   return gqa_attention(q, k, v, q_positions, kv_positions, **attn_opts)
 
@@ -173,28 +192,52 @@ def paged_mla_attention_ref(q_nope, q_pe, k_pool_l, v_pool_l, block_tables, leng
 # (The previous design dequantized OUTSIDE the kernel path via the gather
 # reference — doubling cache-read bytes exactly where the paged path was
 # losing to dense slots.)
+#
+# int4-KV pools (ISSUE 11) go one step further: the code tiles are PACKED
+# two nibbles per byte along hd ([ps, hd/2] int8 blocks — 0.5 byte/element
+# HBM reads), and the dequant stays in-register via the two-dot
+# formulation models/quantize.py qdot proved out for int4 weights: with q
+# DEINTERLEAVED outside the kernel (even channels first, odd second), the
+# score dot is q_even·signext(packed)ᵀ + q_odd·(packed>>4)ᵀ — each operand
+# a pure shift of the packed tile, nothing materialized — and the output
+# accumulator is kept deinterleaved the same way (even/odd halves), with
+# one channel re-interleave applied to the tiny [B, Hq, hd] result OUTSIDE
+# the kernel. Scales are per (token, head) over the whole hd vector, so
+# one [ps, 1] scale column serves both halves.
 
 _PAGE_TILE_DEFAULT = 4
 
 
-def _page_tile(mp: int) -> int:
+def _page_tile(mp: int, batch: int | None = None, context: int | None = None, kv_quant: str = "") -> int:
   """Pages fetched per grid step: the largest power of two ≤ mp, capped at
-  ``XOT_TPU_PAGED_TILE`` (default 4 — retuned at the measured serving shapes;
-  beyond 4 the extra operand streams stop paying on v5e). mp need not divide
-  the tile: trailing slots clamp to the last valid page and mask."""
+  the shape-aware dispatch verdict (inference/paging.py ``select_page_tile``
+  — the flat G=4 default was tuned at B=16 and left sequential-step
+  overhead on the table at B=48/96). ``XOT_TPU_PAGED_TILE`` force-caps
+  every shape (the in-process sweep knob). mp need not divide the tile:
+  trailing slots clamp to the last valid page and mask."""
   import os
 
-  cap = int(os.getenv("XOT_TPU_PAGED_TILE", str(_PAGE_TILE_DEFAULT)))
+  forced = os.getenv("XOT_TPU_PAGED_TILE")
+  if forced is not None:
+    cap = int(forced)
+  elif batch is not None:
+    from ..inference.paging import select_page_tile
+
+    cap = select_page_tile(batch, context if context is not None else mp * DEFAULT_PAGE_SIZE, kv_quant)
+  else:
+    cap = _PAGE_TILE_DEFAULT
   g = 1
   while g * 2 <= min(mp, max(cap, 1)):
     g *= 2
   return g
 
 
-def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: float, pages_per_step: int, quantized: bool):
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: float, pages_per_step: int, kv_quant: str):
   import jax.experimental.pallas as pl
 
   G = pages_per_step
+  quantized = bool(kv_quant)
+  packed = kv_quant == "int4"
   k_refs, v_refs = refs[0:G], refs[G : 2 * G]
   if quantized:
     ks_refs, vs_refs = refs[2 * G : 3 * G], refs[3 * G : 4 * G]
@@ -210,7 +253,11 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: f
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
   length = len_ref[b]
+  # int4: q arrives DEINTERLEAVED (even channels in the first half, odd in
+  # the second — paged_decode_attention reorders outside the kernel), and
+  # acc/o stay in that layout until the caller re-interleaves.
   q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+  half = q.shape[-1] // 2
   # Static unroll over the tile: each page's block chains the online-softmax
   # state exactly like a dedicated grid step would (same math, G× fewer
   # sequential steps). Pages clamped by the index map land with start >=
@@ -220,12 +267,23 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: f
 
     @pl.when(start < length)
     def _block(j=j, start=start):
-      k = k_refs[j][0, 0].astype(jnp.float32)  # [ps, hd]
-      v = v_refs[j][0, 0].astype(jnp.float32)
-      s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [group, ps]
+      if packed:
+        # Two-dot in-register dequant (see the int4 note above): lo/hi are
+        # pure shifts of the SAME packed [ps, hd/2] tile — read from HBM
+        # once at 0.5 byte/element, never materialized unpacked.
+        kp = k_refs[j][0, 0]
+        k_lo = ((kp << 4) >> 4).astype(jnp.float32)  # even channels, sign-extended
+        k_hi = (kp >> 4).astype(jnp.float32)  # odd channels
+        s = jax.lax.dot_general(q[:, :half], k_lo, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = s + jax.lax.dot_general(q[:, half:], k_hi, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = s * scale
+      else:
+        k = k_refs[j][0, 0].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale  # [group, ps]
       if quantized:
         # codes·scale = true k: the per-token scale multiplies each score
-        # COLUMN ([ps, 1] transposed to a [1, ps] row broadcast).
+        # COLUMN ([ps, 1] transposed to a [1, ps] row broadcast). One scale
+        # covers the whole hd vector, so it applies after both int4 halves.
         s = s * jnp.transpose(ks_refs[j][0, 0], (1, 0))
       kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
       s = jnp.where(kv_pos < length, s, NEG_INF)
@@ -239,7 +297,21 @@ def _paged_decode_kernel(bt_ref, len_ref, q_ref, *refs, page_size: int, scale: f
       l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
       if quantized:
         p = p * jnp.transpose(vs_refs[j][0, 0], (1, 0))  # v's scale folds into probs (after the l update)
-      acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+      if packed:
+        vp_ = v_refs[j][0, 0]
+        v_lo = ((vp_ << 4) >> 4).astype(jnp.float32)
+        v_hi = (vp_ >> 4).astype(jnp.float32)
+        upd = jnp.concatenate(
+          [
+            jax.lax.dot_general(p, v_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32),
+            jax.lax.dot_general(p, v_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32),
+          ],
+          axis=-1,
+        )  # deinterleaved [group, hd]: even half, then odd half
+        acc_ref[...] = acc_ref[...] * alpha + upd
+      else:
+        v = v_refs[j][0, 0].astype(jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
   @pl.when(i == pl.num_programs(2) - 1)
   def _finish():
@@ -260,38 +332,52 @@ def paged_decode_attention(
   lengths [B] int32 = number of valid KV slots INCLUDING the token just
   written. With ``k_scale_pool_l``/``v_scale_pool_l`` [P, Hkv, ps, 1]
   (int8-KV pools — init_paged_pool quant="int8"), k/v hold int8 codes
-  dequantized in-register per page tile. ``pages_per_step`` (static)
-  overrides the tuned page-tile width. Returns [B, Hq, hd].
+  dequantized in-register per page tile; a pool whose code axis is HALVED
+  ([P, Hkv, ps, hd/2] — init_paged_pool quant="int4") holds packed int4
+  nibbles dequantized via the two-dot split (module note above).
+  ``pages_per_step`` (static) overrides the shape-aware page-tile verdict
+  (inference/paging.py ``select_page_tile``). Returns [B, Hq, hd].
   """
   if (k_scale_pool_l is None) != (v_scale_pool_l is None):
     raise ValueError("paged_decode_attention: k_scale_pool_l and v_scale_pool_l must be passed together")
+  kv_quant = ""
+  if k_scale_pool_l is not None:
+    kv_quant = "int4" if jnp.shape(k_pool_l)[-1] * 2 == jnp.shape(q)[-1] else "int8"
   # Resolve the env-tunable tile width OUTSIDE the jitted body: baked-in-at-
   # first-trace env reads silently ignore later changes for identical shapes
   # (an in-process XOT_TPU_PAGED_TILE sweep would re-time one width forever).
-  G = pages_per_step or _page_tile(jnp.shape(block_tables)[1])
+  mp = jnp.shape(block_tables)[1]
+  G = pages_per_step or _page_tile(mp, batch=jnp.shape(q)[0], context=mp * page_size, kv_quant=kv_quant)
   return _paged_decode_attention_impl(
     q, k_pool_l, v_pool_l, block_tables, lengths, k_scale_pool_l, v_scale_pool_l,
-    page_size=page_size, pages_per_step=G, interpret=interpret,
+    page_size=page_size, pages_per_step=G, kv_quant=kv_quant, interpret=interpret,
   )
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "pages_per_step", "interpret"))
+@functools.partial(jax.jit, static_argnames=("page_size", "pages_per_step", "kv_quant", "interpret"))
 def _paged_decode_attention_impl(
   q, k_pool_l, v_pool_l, block_tables, lengths, k_scale_pool_l, v_scale_pool_l,
-  page_size: int, pages_per_step: int, interpret: bool,
+  page_size: int, pages_per_step: int, kv_quant: str, interpret: bool,
 ):
   import jax.experimental.pallas as pl
   from jax.experimental.pallas import tpu as pltpu
 
-  quantized = k_scale_pool_l is not None
+  quantized = bool(kv_quant)
+  packed = kv_quant == "int4"
   B, Hq, hd = q.shape
   Hkv = k_pool_l.shape[1]
   group = Hq // Hkv
   mp = block_tables.shape[1]
+  kd = k_pool_l.shape[-1]  # hd, or hd/2 for packed int4 codes
   G = pages_per_step
   n_steps = (mp + G - 1) // G
   scale = float(1.0 / (hd**0.5))
   qg = q.reshape(B, Hkv, group, hd)
+  if packed:
+    # Deinterleave q once outside the kernel (even channels first, odd
+    # second) so the in-kernel two-dot uses contiguous halves; the output
+    # comes back in the same layout and is re-interleaved below.
+    qg = jnp.concatenate([qg[..., 0::2], qg[..., 1::2]], axis=-1)
 
   def page_index(j):
     def index(b, h, i, bt_ref, len_ref):
@@ -304,8 +390,8 @@ def _paged_decode_attention_impl(
     return index
 
   in_specs = [pl.BlockSpec((1, 1, group, hd), lambda b, h, i, bt, ln: (b, h, 0, 0))]
-  in_specs += [pl.BlockSpec((1, 1, page_size, hd), page_index(j)) for j in range(G)]
-  in_specs += [pl.BlockSpec((1, 1, page_size, hd), page_index(j)) for j in range(G)]
+  in_specs += [pl.BlockSpec((1, 1, page_size, kd), page_index(j)) for j in range(G)]
+  in_specs += [pl.BlockSpec((1, 1, page_size, kd), page_index(j)) for j in range(G)]
   operands = [qg] + [k_pool_l] * G + [v_pool_l] * G
   if quantized:
     in_specs += [pl.BlockSpec((1, 1, page_size, 1), page_index(j)) for j in range(G)]
@@ -324,11 +410,16 @@ def _paged_decode_attention_impl(
     ],
   )
   out = pl.pallas_call(
-    functools.partial(_paged_decode_kernel, page_size=page_size, scale=scale, pages_per_step=G, quantized=quantized),
+    functools.partial(_paged_decode_kernel, page_size=page_size, scale=scale, pages_per_step=G, kv_quant=kv_quant),
     out_shape=jax.ShapeDtypeStruct((B, Hkv, group, hd), q.dtype),
     grid_spec=grid_spec,
     interpret=interpret,
   )(block_tables, lengths, *operands)
+  if packed:
+    # Undo the deinterleave on the [B, Hkv, group, hd] result: channel 2i
+    # from the even half, 2i+1 from the odd half.
+    half = hd // 2
+    out = jnp.stack([out[..., :half], out[..., half:]], axis=-1).reshape(B, Hkv, group, hd)
   return out.reshape(B, Hq, hd)
 
 

@@ -479,6 +479,7 @@ class Node:
         self._kv_stream_seq[request_id] = seq + 1
         adopted += await peer.send_kv_pages(
           request_id, sub_keys, sub, page_size=page_size, seq=seq, last=last and i + cap >= len(keys),
+          quant=getattr(server, "kv_quant", None),
         )
     except Exception:  # noqa: BLE001 — transfer is an optimization, never a failure
       if DEBUG >= 1:
@@ -562,10 +563,11 @@ class Node:
       print(f"[node {self.id}] disagg handoff: {request_id} decodes on {target_id} ({req.kv_streamed} pages streamed)")
     return True
 
-  def handle_kv_pages(self, request_id: str, keys: list, leaves: dict, *, page_size: int) -> int:
+  def handle_kv_pages(self, request_id: str, keys: list, leaves: dict, *, page_size: int, quant: str | None = None) -> int:
     """gRPC receive side: adopt streamed KV pages into the batched
     scheduler's host tier (the restore-adopt path then serves them to the
-    handoff's admission as an extended prefix hit)."""
+    handoff's admission as an extended prefix hit). ``quant`` is the
+    sender's KV quant-mode tag (ISSUE 11) — forwarded to the adopt guard."""
     engine = self.inference_engine
     if not hasattr(engine, "get_batched_server"):
       return 0
@@ -577,7 +579,7 @@ class Node:
     server = engine.get_batched_server()
     if page_size and getattr(server, "page_size", None) not in (None, page_size):
       return 0  # mismatched page geometry: refuse, the sender falls back
-    return int(server.adopt_kv_wire(keys, leaves))
+    return int(server.adopt_kv_wire(keys, leaves, quant=quant))
 
   async def _serve_disagg_decode(self, base_shard: Shard, shard: Shard, tensor: np.ndarray, request_id: str, state: InferenceState) -> None:
     """Decode-node side of a disagg handoff (ISSUE 10): submit the carried

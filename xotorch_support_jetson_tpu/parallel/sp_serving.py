@@ -177,19 +177,23 @@ def _sp_layer_step(h, p, kv, positions, rank_offset, inv_freq, cfg: ModelConfig,
     from ..models.decoder import _attn_opts
 
     q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
-    if "k_scale" in kv:  # int8 KV: quantize at write, codes stay the read operand
-      from ..models.quantize import quantize_kv
+    if "k_scale" in kv:  # int8/int4 KV: quantize at write, codes stay the read operand
+      from ..models.quantize import quantize_kv, quantize_kv_int4, unpack_int4_kv
 
-      kq, ks = quantize_kv(k)
-      vq, vs = quantize_kv(v)
+      packed = kv["k"].shape[-1] * 2 == k.shape[-1]  # int4: halved code axis (ISSUE 11)
+      quant_fn = quantize_kv_int4 if packed else quantize_kv
+      kq, ks = quant_fn(k)
+      vq, vs = quant_fn(v)
       kv = {
         "k": write_one(kv["k"], kq, start),
         "k_scale": write_one(kv["k_scale"], ks, start),
         "v": write_one(kv["v"], vq, start),
         "v_scale": write_one(kv["v_scale"], vs, start),
       }
+      k_codes = unpack_int4_kv(read_one(kv["k"])) if packed else read_one(kv["k"])
+      v_codes = unpack_int4_kv(read_one(kv["v"])) if packed else read_one(kv["v"])
       attn = _sp_gqa_attention(
-        q, read_one(kv["k"]), read_one(kv["v"]), positions, kv_positions_local,
+        q, k_codes, v_codes, positions, kv_positions_local,
         k_scale=read_one(kv["k_scale"]), v_scale=read_one(kv["v_scale"]), **_attn_opts(cfg, p.get("is_sliding"))
       )
     else:
