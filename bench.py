@@ -91,6 +91,19 @@ def gate_spec_batch(ratio: float | None) -> float | None:
   return float(ratio) if 1.0 / 3.0 <= ratio <= 8.0 else None
 
 
+def gate_spec_ngram(ratio: float | None) -> float | None:
+  """Drift gate for the draft-free n-gram spec/plain A/B ratio (ISSUE 12 —
+  same artifact-filter shape as ``gate_spec_batch``). N-gram proposals cost
+  no device work and the on-stream rounds advance up to gamma+1 tokens per
+  verify at the benched depth 8, so honest ratios on the repetition-heavy
+  workload live in roughly [0.5, 9]; the acceptance-EWMA floor bounds the
+  downside near parity. Outside [1/3, 12] one side of the back-to-back A/B
+  hit a timing artifact — drop it rather than record a fake speedup."""
+  if ratio is None:
+    return None
+  return float(ratio) if 1.0 / 3.0 <= ratio <= 12.0 else None
+
+
 def gate_paged_b48(ratio: float | None) -> float | None:
   """Drift gate for ``paged_vs_dense_ratio_b48`` (ISSUE 11: the tentpole
   gauge — target >= 0.95 with the retuned shape-aware kernel; the r5 gap
@@ -1433,6 +1446,23 @@ def main() -> None:
   spec_batch8_vs_plain8 = None
   spec_acceptance_rate = None
   spec_gamma_p50 = None
+  # Draft-free n-gram speculation round (ISSUE 12, behind gate_spec_ngram):
+  # measured on EVERY round — the proposer is host-side and the workload
+  # synthetic, so the CPU smoke run records a real A/B too (tiny model; the
+  # accel round measures the 1B-geometry echo model).
+  spec_ngram_batch8_aggregate_tok_s = None
+  spec_ngram_plain_batch8_aggregate_tok_s = None
+  spec_ngram_batch8_vs_plain8 = None
+  spec_ngram_acceptance_rate = None
+  spec_proposer_mix = None
+  # Proposer-policy dispatch verdicts (pure host policy, non-null on CPU —
+  # the paged_tile_* pattern): a policy-table regression is diagnosable
+  # from the JSON alone even when the throughput fields are null.
+  from xotorch_support_jetson_tpu.inference.paging import spec_reprobe_proposer, spec_select_proposer
+
+  spec_policy_model_collapse_switches_to = spec_select_proposer("model", {"model": 0.1}, ("model", "ngram"))[0]
+  spec_policy_exhausted_falls_back_to = spec_select_proposer("model", {"model": 0.1, "ngram": 0.05}, ("model", "ngram"))[0]
+  spec_policy_reprobe_prefers = spec_reprobe_proposer({}, ("ngram", "model"))
   if on_accel:
     try:
       from xotorch_support_jetson_tpu.inference.shard import Shard
@@ -1595,11 +1625,13 @@ def main() -> None:
             srv.shutdown()
             return round(tok_s, 2)
 
-          prop0 = _gm.counter_value("spec_proposed_tokens_total")
-          acc0 = _gm.counter_value("spec_accepted_tokens_total")
+          # The spec token counters are {proposer}-labeled since ISSUE 12;
+          # this round's drafting rides the model proposer.
+          prop0 = _gm.counter_value("spec_proposed_tokens_total", labels={"proposer": "model"})
+          acc0 = _gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "model"})
           spec_batch8_aggregate_tok_s = _bench_spec_batch("s", True)
-          prop1 = _gm.counter_value("spec_proposed_tokens_total")
-          acc1 = _gm.counter_value("spec_accepted_tokens_total")
+          prop1 = _gm.counter_value("spec_proposed_tokens_total", labels={"proposer": "model"})
+          acc1 = _gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "model"})
           plain_batch8_aggregate_tok_s = _bench_spec_batch("p", False)
           if prop1 > prop0:
             spec_acceptance_rate = round((acc1 - acc0) / (prop1 - prop0), 4)
@@ -1622,6 +1654,98 @@ def main() -> None:
       del qp8
     except Exception:  # noqa: BLE001 — smaller-HBM devices: skip, don't abort the bench
       int8_8b_tok_s = None
+
+  # --- DRAFT-FREE n-gram speculation A/B (ISSUE 12, behind gate_spec_ngram):
+  # a repetition-heavy synthetic workload (per-row periodic prompts — the
+  # RAG/code-edit/multi-turn shape where prompt-lookup pays) through the REAL
+  # batched scheduler at B=8 on the serving-default layout (paged + int8-KV),
+  # n-gram speculation (no draft model loaded, zero draft-KV pages) vs plain
+  # back-to-back. The echo model continues each row's periodic stream, so
+  # suffix matches fire AND accept — the acceptance criterion is
+  # spec_ngram_batch8_vs_plain8 > 1.0 with kv_draft_* gauges at 0. Runs on
+  # EVERY round: the proposer is host-side, so the CPU smoke measures a real
+  # (tiny-model) A/B instead of emitting null.
+  ngb_env = {k: os.environ.get(k) for k in ("XOT_TPU_PAGED", "XOT_TPU_KV_QUANT", "XOT_TPU_SPEC_NGRAM")}
+  try:
+    import asyncio as _asyncio
+
+    from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer as _BS
+    from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine as _Eng
+    from xotorch_support_jetson_tpu.utils.metrics import metrics as _gm
+    from xotorch_support_jetson_tpu.utils.synthetic import peaked_echo_params as _echo_p
+
+    os.environ["XOT_TPU_PAGED"] = "1"
+    os.environ["XOT_TPU_KV_QUANT"] = "int8"
+    os.environ["XOT_TPU_SPEC_NGRAM"] = "1"
+    ng_eng = _Eng(use_local_mesh=False)
+    # damp=0.01 on accel for the same reason as the 8B echo pair above (the
+    # 16-layer bf16 model only truly echoes at low damp); the tiny CPU
+    # config echoes cleanly at the default.
+    ng_eng.load_test_model(shard, cfg, _echo_p(params, damp=0.01) if on_accel else _echo_p(params))
+    assert ng_eng._draft_params is None  # the round must be draft-free
+    ng_rng = np.random.default_rng(17)
+    ng_prompts = {}
+    for i in range(8):
+      pat = ng_rng.integers(1, cfg.vocab_size, (8,)).astype(np.int32)
+      ng_prompts[f"ng{i}"] = np.tile(pat, 8)  # 64 tokens, period 8
+    ng_tokens = 65 if on_accel else 33
+
+    def _bench_spec_ngram(tag: str, spec_on: bool):
+      srv = _BS(ng_eng, n_slots=8, chunk=8, spec_batch=spec_on)
+
+      async def rnd():
+        total = 0
+
+        def emit(rid, toks, finished):
+          nonlocal total
+          total += len(toks)
+
+        async def one():
+          await _asyncio.gather(*(
+            srv.submit(f"{tag}{rid}", p, max_tokens=ng_tokens, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+            for rid, p in ng_prompts.items()
+          ))
+
+        await one()  # warm the admission + chunk programs
+        total = 0
+        t0 = time.perf_counter()
+        await one()
+        return total / (time.perf_counter() - t0)
+
+      tok_s = _asyncio.run(rnd())
+      if spec_on:
+        assert srv.spec and srv.draft_cache is None
+      srv.shutdown()
+      return round(tok_s, 2)
+
+    def _spec_family_by_proposer(name: str) -> dict:
+      return {p: _gm.counter_value(name, labels={"proposer": p}) for p in ("model", "ngram")}
+
+    ng_prop0 = _spec_family_by_proposer("spec_proposed_tokens_total")
+    ng_acc0 = _spec_family_by_proposer("spec_accepted_tokens_total")
+    spec_ngram_batch8_aggregate_tok_s = _bench_spec_ngram("s", True)
+    ng_prop1 = _spec_family_by_proposer("spec_proposed_tokens_total")
+    ng_acc1 = _spec_family_by_proposer("spec_accepted_tokens_total")
+    spec_ngram_plain_batch8_aggregate_tok_s = _bench_spec_ngram("p", False)
+    d_prop = {p: ng_prop1[p] - ng_prop0[p] for p in ng_prop0}
+    total_prop = sum(d_prop.values())
+    if d_prop.get("ngram", 0) > 0:
+      spec_ngram_acceptance_rate = round((ng_acc1["ngram"] - ng_acc0["ngram"]) / d_prop["ngram"], 4)
+    if total_prop > 0:
+      spec_proposer_mix = {p: round(v / total_prop, 4) for p, v in d_prop.items() if v > 0}
+    if spec_ngram_batch8_aggregate_tok_s and spec_ngram_plain_batch8_aggregate_tok_s:
+      spec_ngram_batch8_vs_plain8 = gate_spec_ngram(
+        round(spec_ngram_batch8_aggregate_tok_s / spec_ngram_plain_batch8_aggregate_tok_s, 4)
+      )
+    ng_eng = None
+  except Exception:  # noqa: BLE001 — optional section
+    pass
+  finally:
+    for k, v in ngb_env.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
 
   # --- stable-diffusion UNet denoise step (round 4: the image path is real —
   # models/diffusion.py). One classifier-free-guidance step at the SD2-base
@@ -1742,6 +1866,14 @@ def main() -> None:
         "spec_batch8_vs_plain8": spec_batch8_vs_plain8,
         "spec_acceptance_rate": spec_acceptance_rate,
         "spec_gamma_p50": spec_gamma_p50,
+        "spec_ngram_batch8_aggregate_tok_s": spec_ngram_batch8_aggregate_tok_s,
+        "spec_ngram_plain_batch8_aggregate_tok_s": spec_ngram_plain_batch8_aggregate_tok_s,
+        "spec_ngram_batch8_vs_plain8": spec_ngram_batch8_vs_plain8,
+        "spec_ngram_acceptance_rate": spec_ngram_acceptance_rate,
+        "spec_proposer_mix": spec_proposer_mix,
+        "spec_policy_model_collapse_switches_to": spec_policy_model_collapse_switches_to,
+        "spec_policy_exhausted_falls_back_to": spec_policy_exhausted_falls_back_to,
+        "spec_policy_reprobe_prefers": spec_policy_reprobe_prefers,
         "sd_unet_step_ms": sd_unet_step_ms,
         "int8_vs_prev": int8_vs_prev,
         "pp_decode_tok_s": pp_decode_tok_s,

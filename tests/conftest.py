@@ -21,6 +21,13 @@ os.environ.setdefault("HF_HUB_OFFLINE", "1")  # no egress in CI; fail fast
 # Incident auto-captures (ISSUE 9: stall watchdog / anomaly watchers inside
 # cluster tests) must never write into the real $XOT_HOME from CI.
 os.environ.setdefault("XOT_TPU_BUNDLE_DIR", "/tmp/xot-test-bundles")
+# The n-gram proposer (ISSUE 12) makes XOT_TPU_SPEC_BATCH=auto speculate
+# DRAFT-FREE — the production default. In the suite that would flip every
+# batched greedy test onto the spec programs (one extra compiled program
+# per module for streams that are already identity-pinned), so the suite
+# pins the family OFF here; tests/test_spec_ngram.py turns it on explicitly
+# and pins the draft-free behavior end to end.
+os.environ.setdefault("XOT_TPU_SPEC_NGRAM", "0")
 
 # The axon TPU plugin in this image overrides JAX_PLATFORMS at import time;
 # the config API still wins, so force the CPU backend explicitly.

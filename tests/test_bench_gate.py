@@ -166,6 +166,38 @@ def test_spec_batch_gate_drops_artifacts():
   assert gate_spec_batch(None) is None
 
 
+def test_spec_ngram_gate_keeps_plausible_ratios():
+  """ISSUE 12: the draft-free n-gram/plain A/B ratio lives in ~[0.5, 9] —
+  parity-ish at the adaptive floor, up to ~gamma+1 (benched depth 8) when
+  on-stream rounds keep full acceptance on the repetition-heavy workload."""
+  from bench import gate_spec_ngram
+
+  assert gate_spec_ngram(1.0) == 1.0
+  assert gate_spec_ngram(0.6) == 0.6
+  assert gate_spec_ngram(4.2) == 4.2
+  assert gate_spec_ngram(11.5) == 11.5
+
+
+def test_spec_ngram_gate_drops_artifacts():
+  from bench import gate_spec_ngram
+
+  assert gate_spec_ngram(60.0) is None
+  assert gate_spec_ngram(0.05) is None
+  assert gate_spec_ngram(None) is None
+
+
+def test_spec_policy_verdicts_pinned():
+  """The proposer-policy dispatch verdicts bench emits on EVERY round
+  (non-null on CPU, the paged_tile_* pattern): a collapsed model proposer
+  switches to the untried n-gram, two measured-dead proposers fall back to
+  plain, and re-probes prefer the free proposer."""
+  from xotorch_support_jetson_tpu.inference.paging import spec_reprobe_proposer, spec_select_proposer
+
+  assert spec_select_proposer("model", {"model": 0.1}, ("model", "ngram"))[0] == "ngram"
+  assert spec_select_proposer("model", {"model": 0.1, "ngram": 0.05}, ("model", "ngram"))[0] == "plain"
+  assert spec_reprobe_proposer({}, ("ngram", "model")) == "ngram"
+
+
 def test_committed_r02_artifact_is_filtered():
   """The artifact actually on disk must be neutralized by the filter."""
   path = pathlib.Path(__file__).resolve().parent.parent / "BENCH_r02.json"

@@ -290,3 +290,13 @@ async def test_streaming_spec_decode_through_api(tiny_model_dir, monkeypatch):
   spec_text, spec_usage = await run_once("int8")
   assert spec_text == plain_text
   assert spec_usage["completion_tokens"] == plain_usage["completion_tokens"] == 24
+
+  # Draft-free n-gram speculation (ISSUE 12): XOT_TPU_SPEC_DECODE=ngram
+  # loads NO draft pair — the streaming path speculates from session
+  # history with a strictly synchronous chain (the engine answers the
+  # node's dispatch-ahead with None and the loop's under-delivery fallback
+  # re-dispatches after each read). Same stream, same truthful usage.
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  ngram_text, ngram_usage = await run_once("ngram")
+  assert ngram_text == plain_text
+  assert ngram_usage["completion_tokens"] == 24

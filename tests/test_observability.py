@@ -680,9 +680,12 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_qos_rejected_total",
   "xot_tpu_qos_rate_limited_total",
   "xot_tpu_qos_preemptions_total",
-  # Batched speculation (ISSUE 7; spec_gamma labeled {row})
+  # Batched speculation (ISSUE 7; spec_gamma labeled {row}; since ISSUE 12
+  # the token counters are labeled {proposer} and spec_proposer{row} reports
+  # each row's active proposer: 0 plain / 1 n-gram / 2 model draft)
   "xot_tpu_spec_proposed_tokens_total",
   "xot_tpu_spec_accepted_tokens_total",
+  "xot_tpu_spec_proposer",
   # KV memory hierarchy (ISSUE 6; registry hits labeled {scope})
   "xot_tpu_kv_tier_spilled_pages_total",
   "xot_tpu_kv_tier_spilled_bytes_total",
@@ -800,9 +803,10 @@ def test_metric_name_snapshot_after_serving():
   ):
     gm.inc(name, 0)
   gm.inc("kv_prefix_registry_hits_total", 0, labels={"scope": "local"})
-  gm.inc("spec_proposed_tokens_total", 0)
-  gm.inc("spec_accepted_tokens_total", 0)
+  gm.inc("spec_proposed_tokens_total", 0, labels={"proposer": "ngram"})
+  gm.inc("spec_accepted_tokens_total", 0, labels={"proposer": "ngram"})
   gm.set_gauge("spec_gamma", 0, labels={"row": "0"})
+  gm.set_gauge("spec_proposer", 0, labels={"row": "0"})
   gm.set_gauge("kv_draft_bytes", 0)
   gm.set_gauge("kv_draft_slots", 0)
   gm.set_gauge("kv_draft_pages_equivalent", 0)

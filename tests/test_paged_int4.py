@@ -346,7 +346,7 @@ def test_fused_spec_paged_kernel_ab_identity(monkeypatch):
   temps = jnp.zeros((B,), jnp.float32)
   outs = {}
   for use_kernel in (False, True):
-    buf, counts, nxt, npos, _, _ = fused_spec_paged_batch_decode(
+    buf, counts, _n_prop, nxt, npos, _, _ = fused_spec_paged_batch_decode(
       params, CFG, shard, params_d, CFG, shard_d, token, {k: jnp.array(v) for k, v in pool.items()},
       {k: jnp.array(v) for k, v in cache_d.items()}, jnp.asarray(bts), positions, active, gammas, temps,
       n_rounds=2, gamma_max=2, page_size=PS, key=jax.random.PRNGKey(7), use_kernel=use_kernel, interpret=use_kernel,

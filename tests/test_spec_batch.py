@@ -80,10 +80,10 @@ def test_spec_batch_ab_paged_int8kv(monkeypatch):
   monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
   monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
   engine, params, shard = _echo_engine()
-  before = gm.counter_value("spec_accepted_tokens_total")
+  before = gm.counter_sum("spec_accepted_tokens_total")  # {proposer}-labeled since ISSUE 12
   _spec_ab(engine, params, shard, PROMPTS, 8)
   # The echo draft really accepted: multi-token advances happened.
-  assert gm.counter_value("spec_accepted_tokens_total") > before
+  assert gm.counter_sum("spec_accepted_tokens_total") > before
 
 
 def test_spec_batch_ab_paged_adversarial_draft(monkeypatch):

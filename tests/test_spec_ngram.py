@@ -1,0 +1,495 @@
+"""Draft-free speculation: prompt-lookup / n-gram proposers in the batched
+spec path, selected per row by the acceptance-EWMA policy (ISSUE 12,
+inference/ngram.py + the proposer hooks in batch_scheduler.py / decoder.py /
+jax_engine.py).
+
+The correctness contract is PR 7's, extended: greedy batched output with the
+n-gram proposer is TOKEN-IDENTICAL to plain batched decode (itself pinned
+against solo greedy) on every layout (paged-int8KV, paged-int4KV, dense),
+lookahead on or off, for ANY proposal content — adversarial streams reject
+cleanly with no position drift. Draft-free speculation holds no device
+state: the kv_draft_* gauges read 0 and the page budget is untouched. The
+per-row policy converges: a row whose text never pays falls back to plain
+(the spec dispatches STOP), a repetitive row stays on n-gram at full depth,
+and with a dead draft model loaded the policy switches rows model → n-gram.
+
+(The suite-wide conftest pins XOT_TPU_SPEC_NGRAM=0 so the rest of tier-1
+keeps its plain-program compile budget; every test here opts in.)
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_batched import _single_row_reference
+from tests.test_lookahead import _serve
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.ngram import NgramIndex
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.models.quantize import quantize_params
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+from xotorch_support_jetson_tpu.utils.synthetic import peaked_echo_params
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=256, tied_embedding=True)
+KEY = jax.random.PRNGKey(0)
+# Repetition-heavy prompts (the RAG/code-edit/multi-turn shape): the echo
+# model continues the periodic stream, so suffix matches both FIRE and ACCEPT.
+PROMPTS = [[3, 25, 9, 7] * 3, [7, 1, 88, 42, 5, 7, 1, 88, 42, 5], [9, 9, 9, 1, 9, 9, 9, 1], [100, 4, 100, 4, 100]]
+
+
+def _engine(cfg=CFG, key=KEY, echo=True, spec_decode=None):
+  """Draft-free engine (no XOT_TPU_SPEC_DECODE draft pair): the only
+  speculation available is the n-gram proposer."""
+  params, shard = full_model_params(key, cfg, "m")
+  if echo:
+    params = peaked_echo_params(params)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode=spec_decode)
+  engine.load_test_model(shard, cfg, params)
+  assert engine._draft_params is None
+  return engine, params, shard
+
+
+def _ngram_ab(engine, params, shard, prompts, n_gen, *, chunk=4, n_slots=4, cfg=CFG):
+  """Spec×lookahead A/B (the test_spec_batch harness shape): all four modes
+  token-identical to solo greedy, with the spec servers resolving DRAFT-FREE
+  n-gram speculation."""
+  expected = [_single_row_reference(params, shard, p, n_gen - 1, cfg=cfg) for p in prompts]
+  for spec in (True, False):
+    for la in (True, False):
+      server = BatchedServer(engine, n_slots=n_slots, chunk=chunk, lookahead=la, spec_batch=spec)
+      outs, streams = _serve(server, prompts, n_gen)
+      for o, s in zip(outs, streams):
+        assert s == o
+      if spec:
+        assert server.spec and server.spec_proposers == ("ngram",)
+        assert server.draft_cache is None
+      assert outs == expected, f"(spec={spec}, la={la}) diverged: {outs} != {expected}"
+      server.shutdown()
+  return expected
+
+
+# ------------------------------------------------------------- unit layer
+
+
+def test_ngram_index_longest_match_wins_and_previous_occurrence():
+  idx = NgramIndex(n=3)
+  idx.extend([1, 2, 3, 9, 1, 2, 3])
+  # Suffix [1,2,3] matched at its PREVIOUS occurrence (ending pos 2): the
+  # continuation there was 9, 1, 2...
+  assert idx.propose(3).tolist() == [9, 1, 2]
+  # Longest match wins over shorter suffixes: after appending 9 the suffix
+  # [2,3,9] occurred before (ending pos 3) — continuation 1,2,3.
+  idx.extend([9])
+  assert idx.propose(4).tolist() == [1, 2, 3, 9]
+  # No earlier occurrence at any length: miss.
+  fresh = NgramIndex(n=3)
+  fresh.extend([5, 6, 7])
+  assert fresh.propose(4).size == 0
+  # 1-gram fallback: only the last token repeats.
+  uni = NgramIndex(n=3)
+  uni.extend([4, 8, 4])
+  assert uni.propose(2).tolist() == [8, 4]
+  # Empty history / zero budget.
+  assert NgramIndex(n=2).propose(4).size == 0
+  assert idx.propose(0).size == 0
+
+
+def test_ngram_knobs(monkeypatch):
+  from xotorch_support_jetson_tpu.inference.ngram import ngram_enabled, ngram_knobs
+
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM_N", "2")
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM_MAX", "5")
+  assert ngram_enabled() and ngram_knobs() == (2, 5)
+  idx = NgramIndex()  # knob-driven suffix length
+  assert idx.n == 2
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "0")
+  assert not ngram_enabled()
+
+
+def test_proposer_selection_policy():
+  """spec_select_proposer / spec_reprobe_proposer (inference/paging.py):
+  untried alternatives probe at depth 1, measured-dead ones don't bounce,
+  plain is the floor, and re-probes rank unmeasured > best-EWMA."""
+  from xotorch_support_jetson_tpu.inference.paging import spec_reprobe_proposer, spec_select_proposer
+
+  both = ("model", "ngram")
+  # Model collapsed, n-gram untried: probe it.
+  assert spec_select_proposer("model", {"model": 0.1}, both) == ("ngram", 1)
+  # Both measured dead: plain (no proposer ping-pong).
+  assert spec_select_proposer("model", {"model": 0.1, "ngram": 0.05}, both) == ("plain", 0)
+  # The alternative still clears the demote bar: worth re-probing.
+  assert spec_select_proposer("ngram", {"ngram": 0.1, "model": 0.5}, both) == ("model", 1)
+  # Interactive demote bar is lower (0.15): a 0.2 EWMA alternative re-probes.
+  assert spec_select_proposer("ngram", {"ngram": 0.0, "model": 0.2}, both, priority="interactive") == ("model", 1)
+  # Only n-gram available (draft-free server): floor is plain.
+  assert spec_select_proposer("ngram", {"ngram": 0.01}, ("ngram",)) == ("plain", 0)
+  # Re-probe ranking: unmeasured first (ngram preferred), else best EWMA.
+  assert spec_reprobe_proposer({}, both) == "ngram"
+  assert spec_reprobe_proposer({"ngram": 0.2}, both) == "model"  # model unmeasured
+  assert spec_reprobe_proposer({"ngram": 0.2, "model": 0.6}, both) == "model"
+  assert spec_reprobe_proposer({"ngram": 0.7, "model": 0.6}, both) == "ngram"
+  assert spec_reprobe_proposer({}, ()) is None
+
+
+# ------------------------------------------------- batched identity layer
+
+
+def test_spec_ngram_ab_paged_int8kv(monkeypatch):
+  """A/B at the serving default (paged, int8-KV pages): n-gram spec ×
+  lookahead all token-identical to solo greedy, draft-free, with real
+  accepted runs (echo model on repetition-heavy prompts)."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _engine()
+  before = gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"})
+  _ngram_ab(engine, params, shard, PROMPTS, 10)
+  assert gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"}) > before
+
+
+def test_spec_ngram_ab_paged_int4kv(monkeypatch):
+  """Same A/B over int4-KV packed pages (ISSUE 11's layout): the verify
+  window runs the packed-page write/read path."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int4")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _engine()
+  _ngram_ab(engine, params, shard, PROMPTS[:2], 8, n_slots=2)
+
+
+def test_spec_ngram_ab_dense(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  engine, params, shard = _engine()
+  _ngram_ab(engine, params, shard, PROMPTS, 8)
+
+
+def test_spec_ngram_adversarial_proposal_rejects_cleanly(monkeypatch):
+  """A proposer that always proposes a WRONG continuation (suffix matches,
+  continuation doesn't): every proposal rejects, output is token-identical
+  to plain, positions never drift, and the pool fully recovers."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_SPEC_REPROBE", "1000")
+  engine, params, shard = _engine()
+  expected = [_single_row_reference(params, shard, p, 11, cfg=CFG) for p in PROMPTS[:2]]
+  monkeypatch.setattr(
+    NgramIndex, "propose",
+    lambda self, k: np.asarray([(t + 1) % CFG.vocab_size for t in self.history[-min(k, 8):]], np.int32),
+  )
+  server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True, spec_batch=True)
+  outs, streams = _serve(server, PROMPTS[:2], 12)
+  assert outs == expected
+  for o, s in zip(outs, streams):
+    assert s == o
+  for i, s in enumerate(server.slots):
+    assert s is None and server._h_positions[i] == 0  # no drift into freed rows
+  assert server.allocator.n_available == server.allocator.n_pages - 1
+  server.shutdown()
+
+
+def test_spec_ngram_sampled_rows_key_schedule_unchanged(monkeypatch):
+  """Gamma-0 key-schedule identity (ISSUE 12 satellite): a seeded SAMPLED
+  row's stream is identical with draft-free speculation on or off, even
+  while a greedy row in the same batch rides n-gram proposals — spec chunks
+  split once per round, the plain program's exact split-per-step schedule."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _engine()
+  outs = {}
+  for spec in (True, False):
+    engine._key = jax.random.PRNGKey(123)
+    server = BatchedServer(engine, n_slots=2, chunk=4, lookahead=True, spec_batch=spec)
+
+    async def run(server=server):
+      def emit(rid, toks, finished):
+        pass
+
+      return await asyncio.gather(
+        server.submit("greedy", np.asarray(PROMPTS[0], np.int32), max_tokens=8, temp=0.0, top_k=35, eos_ids=(), emit=emit),
+        server.submit("sampled", np.asarray([7, 1, 88], np.int32), max_tokens=8, temp=0.8, top_k=35, eos_ids=(), emit=emit),
+      )
+
+    outs[spec] = asyncio.run(run())
+    server.shutdown()
+  assert outs[True] == outs[False], f"sampled/greedy mix diverged: {outs[True]} != {outs[False]}"
+  assert len(outs[True][1]) == 8
+
+
+# ------------------------------------------------- policy convergence layer
+
+
+def _spy_spec_dispatches(server):
+  seen = []
+  orig = server.ops.spec_paged_batch_decode
+
+  def spy(token, pool, cache_d, bt, pos, active, gammas, *a, **k):
+    pc = k.get("prop_counts")
+    seen.append((np.asarray(gammas).copy(), np.asarray(pc).copy() if pc is not None else None, cache_d is not None))
+    return orig(token, pool, cache_d, bt, pos, active, gammas, *a, **k)
+
+  server.ops.spec_paged_batch_decode = spy
+  return seen
+
+
+def test_spec_ngram_policy_converges_nonrepetitive_to_plain(monkeypatch):
+  """Monotone-spy acceptance criterion, half 1: a RANDOM model's stream
+  never continues the matched suffixes, so every n-gram proposal rejects
+  (or misses), the EWMA walks the depth to the floor, the row parks on
+  plain, and the spec dispatches STOP — the batch no longer pays the
+  verify-window or the pipeline drain."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_SPEC_REPROBE", "1000")  # no re-probe inside the test
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512, tied_embedding=True)
+  engine, params, shard = _engine(cfg=cfg, key=jax.random.PRNGKey(7), echo=False)
+  server = BatchedServer(engine, n_slots=1, chunk=4, lookahead=True, spec_batch=True)
+  seen = _spy_spec_dispatches(server)
+  prompt = [3, 25, 9, 3, 25, 9, 3, 25]  # repetitive PROMPT, non-repetitive continuation
+  expected = _single_row_reference(params, shard, prompt, 79, cfg=cfg)
+  outs, _ = _serve(server, [prompt], 80)
+  assert outs[0] == expected
+  assert seen, "n-gram speculation never dispatched (the prompt repeats; matches must fire)"
+  peaks = [int(g.max()) for g, _, _ in seen]
+  assert all(a >= b for a, b in zip(peaks, peaks[1:])), f"depth not monotone under rejection: {peaks}"
+  assert peaks[-1] <= peaks[0]
+  # The stream is 80 tokens ≈ 20 chunks; the policy stopped paying long
+  # before the end (misses + rejections both charge the EWMA).
+  assert len(seen) <= 10, f"batch kept paying for dead proposals: {len(seen)} spec chunks"
+  assert all(not used_draft for _, _, used_draft in seen)  # draft-free program throughout
+  server.shutdown()
+
+
+def test_spec_ngram_policy_repetitive_row_stays_on_ngram(monkeypatch):
+  """Monotone-spy acceptance criterion, half 2: the echo model's stream IS
+  the repeated prompt, so proposals keep accepting and the row HOLDS
+  n-gram depth — spec dispatches continue to the end of the stream with
+  positive accepted counts and the proposer gauge pinned at n-gram."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512, tied_embedding=True)
+  engine, params, shard = _engine(cfg=cfg)
+  server = BatchedServer(engine, n_slots=1, chunk=4, lookahead=True, spec_batch=True)
+  seen = _spy_spec_dispatches(server)
+  prompt = [3, 25, 9, 7] * 3
+  expected = _single_row_reference(params, shard, prompt, 63, cfg=cfg)
+  before = gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"})
+  outs, _ = _serve(server, [prompt], 64)
+  assert outs[0] == expected
+  # The accepting stream rides n-gram to the END: on-stream rounds advance
+  # chunk·(gamma+1) tokens per dispatch, so the whole 64-token response is
+  # a handful of spec chunks — depth held at the cap, a full reference
+  # stream on every dispatch, and tens of accepted tokens.
+  assert seen, "repetitive row never speculated"
+  assert int(seen[-1][0].max()) == server.spec_ngram_max, "depth collapsed on an accepting stream"
+  assert all(pc is not None and pc.max() > 0 for _, pc, _ in seen)  # real host proposals rode every dispatch
+  accepted = gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"}) - before
+  assert accepted >= 32, f"accepted runs should dominate the stream: {accepted}"
+  server.shutdown()
+
+
+def test_spec_ngram_dead_draft_switches_proposer(monkeypatch):
+  """Both proposers loaded: an adversarial (≈0-acceptance) DRAFT MODEL
+  collapses the model proposer; the selection policy then probes n-gram,
+  which the echo stream accepts — the row converges model → n-gram instead
+  of model → plain (ISSUE 12: each row converges to whichever pays)."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_SPEC_REPROBE", "1000")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512, tied_embedding=True)
+  params, shard = full_model_params(KEY, cfg, "m")
+  params = peaked_echo_params(params)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="int8")
+  engine.load_test_model(shard, cfg, params)
+  # Unrelated draft weights: the model proposer's acceptance is ~0.
+  engine._draft_params = quantize_params(full_model_params(jax.random.PRNGKey(777), cfg, "m")[0])
+  server = BatchedServer(engine, n_slots=1, chunk=4, lookahead=True, spec_batch=True)
+  server._ensure_cache()
+  assert server.spec_proposers == ("model", "ngram")
+  seen = _spy_spec_dispatches(server)
+  prompt = [3, 25, 9, 7] * 3
+  expected = _single_row_reference(params, shard, prompt, 79, cfg=cfg)
+  outs, _ = _serve(server, [prompt], 80)
+  assert outs[0] == expected
+  drafted = [i for i, (_, _, used_draft) in enumerate(seen) if used_draft]
+  proposed = [i for i, (_, pc, _) in enumerate(seen) if pc is not None and pc.max() > 0]
+  assert drafted, "model proposer never dispatched"
+  assert proposed, "the policy never switched the row to the n-gram proposer"
+  assert min(proposed) > max(drafted), f"switch order wrong: model rounds {drafted}, ngram rounds {proposed}"
+  # Post-switch the n-gram proposer KEEPS paying: more n-gram dispatches
+  # than the single probe, still running near the end of the stream.
+  assert len(proposed) >= 3
+  server.shutdown()
+
+
+# ------------------------------------------------- accounting + auto layer
+
+
+def test_spec_ngram_draft_free_accounting(monkeypatch):
+  """ISSUE 12 satellite: draft-free speculation holds no draft KV — the
+  kv_draft_* gauges read 0 and the default page pool is NOT shrunk (the
+  PR 7 deduction applies only when a draft cache actually exists)."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  engine, params, shard = _engine()
+
+  server_off = BatchedServer(engine, n_slots=2, chunk=4, spec_batch=False)
+  server_off._ensure_cache()
+  pages_off = server_off.allocator.n_pages
+  server_off.shutdown()
+
+  server_on = BatchedServer(engine, n_slots=2, chunk=4, spec_batch=True)
+  server_on._ensure_cache()
+  assert server_on.spec and server_on.draft_cache is None
+  assert server_on.allocator.n_pages == pages_off, "draft-free speculation must not shrink the page budget"
+  assert gm.gauges.get("kv_draft_bytes") == 0
+  assert gm.gauges.get("kv_draft_slots") == 0
+  assert gm.gauges.get("kv_draft_pages_equivalent") == 0
+  server_on.shutdown()
+
+
+def test_spec_batch_auto_enables_draft_free(monkeypatch):
+  """XOT_TPU_SPEC_BATCH=auto (unset) + no draft checkpoint now resolves
+  speculation ON via the n-gram proposer (ISSUE 12: speculation is free to
+  enable fleet-wide); XOT_TPU_SPEC_NGRAM=0 restores the PR 7 resolution
+  (auto-without-draft = off, pinned in test_spec_batch)."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  monkeypatch.delenv("XOT_TPU_SPEC_BATCH", raising=False)
+  engine, params, shard = _engine()
+  server = BatchedServer(engine, n_slots=2, chunk=4)
+  server._ensure_cache()
+  assert server.spec and server.spec_proposers == ("ngram",) and server.draft_cache is None
+  server.shutdown()
+
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "0")
+  server2 = BatchedServer(engine, n_slots=2, chunk=4)
+  server2._ensure_cache()
+  assert not server2.spec and server2.spec_proposers == ()
+  server2.shutdown()
+
+
+# ------------------------------------------------------------- solo layer
+
+
+async def _drive_stream(engine, shard, prompt, rid, chunk, max_tokens):
+  """The node's chunk loop shape, including its under-delivery fallback —
+  exactly what an n-gram engine's None-for-pipelining answer relies on."""
+  logits, _ = await engine.infer_tensor(rid, shard, prompt)
+  first = int(np.argmax(logits, -1)[0])
+  out = [first]
+  pending = await engine.dispatch_chunk(rid, shard, chunk, 0.0, 35, first_token=first)
+  while pending is not None and len(out) < max_tokens:
+    nxt = await engine.dispatch_chunk(rid, shard, chunk, 0.0, 35)
+    out.extend(await engine.read_chunk(pending))
+    pending = nxt
+    if pending is None and len(out) < max_tokens:
+      pending = await engine.dispatch_chunk(rid, shard, chunk, 0.0, 35)
+  return out[:max_tokens]
+
+
+@pytest.mark.asyncio
+async def test_solo_spec_decode_ngram_only(monkeypatch):
+  """ISSUE 12 satellite: XOT_TPU_SPEC_DECODE works with NO draft checkpoint
+  configured (=ngram) — the streaming chunk path speculates from the
+  session's own history, token-identical to the plain engine, with real
+  accepted runs on the echo stream."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=256, tied_embedding=True)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  params = peaked_echo_params(params)
+  prompt = np.array([[5, 9, 2, 71, 33, 5, 9, 2, 71, 33, 5, 9, 2]], dtype=np.int32)
+
+  plain = JaxShardedInferenceEngine(use_local_mesh=False)
+  plain.load_test_model(shard, cfg, params)
+  ref = await _drive_stream(plain, shard, prompt, "a", 8, 60)
+
+  spec = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="ngram")
+  spec.load_test_model(shard, cfg, params)
+  assert spec._draft_params is None
+  before = gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"})
+  got = await _drive_stream(spec, shard, prompt, "b", 8, 60)
+  assert got == ref
+  assert gm.counter_value("spec_accepted_tokens_total", labels={"proposer": "ngram"}) > before
+  assert spec.sessions["b"].ngram_gamma > 0  # accepting stream holds its depth
+
+
+@pytest.mark.asyncio
+async def test_solo_ngram_nonrepetitive_identity_and_demotion(monkeypatch):
+  """Random model: proposals reject, the engine EWMA demotes to the floor,
+  the session hands off to the (pipelined) plain path — and the stream is
+  still token-identical throughout the transition."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=256, tied_embedding=True)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  prompt = np.array([[5, 9, 2, 71, 33, 5, 9, 2, 71, 33, 5, 9, 2]], dtype=np.int32)
+
+  plain = JaxShardedInferenceEngine(use_local_mesh=False)
+  plain.load_test_model(shard, cfg, params)
+  ref = await _drive_stream(plain, shard, prompt, "a", 8, 60)
+
+  spec = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="ngram")
+  spec.load_test_model(shard, cfg, params)
+  got = await _drive_stream(spec, shard, prompt, "b", 8, 60)
+  assert got == ref
+  sess = spec.sessions["b"]
+  assert sess.ngram_gamma == 0 and sess.ngram_index is None, (
+    f"rejecting stream must demote this session to plain (ewma {sess.ngram_ewma})"
+  )
+
+
+@pytest.mark.asyncio
+async def test_solo_ngram_state_is_per_session(monkeypatch):
+  """Found live (ISSUE 12 review): n-gram acceptance is a property of the
+  TEXT, not the model — a non-repetitive session (e.g. the daemon's warm
+  request) collapsing an ENGINE-level depth would disable speculation for
+  every later session until a long re-probe streak. The state lives per
+  session: after a collapsing session, the next session still opens at full
+  depth and actually proposes."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "1")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=256, tied_embedding=True)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  spec = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="ngram")
+  spec.load_test_model(shard, cfg, params)
+
+  # Session 1: no suffix ever repeats — misses demote it to the floor.
+  flat = np.array([[7, 12, 29, 41, 3, 88, 101, 55]], dtype=np.int32)
+  await _drive_stream(spec, shard, flat, "s1", 8, 40)
+  assert spec.sessions["s1"].ngram_gamma == 0 and spec.sessions["s1"].ngram_index is None
+
+  # Session 2: repetitive prompt — proposals must still FIRE (fresh depth),
+  # whatever the random model then does with them.
+  before = gm.counter_value("spec_proposed_tokens_total", labels={"proposer": "ngram"})
+  rep = np.array([[5, 9, 2, 5, 9, 2, 5, 9, 2, 5, 9]], dtype=np.int32)
+  await _drive_stream(spec, shard, rep, "s2", 8, 24)
+  assert gm.counter_value("spec_proposed_tokens_total", labels={"proposer": "ngram"}) > before, (
+    "session 2 never proposed: n-gram state leaked across sessions"
+  )
+
+
+@pytest.mark.asyncio
+async def test_solo_ngram_disabled_family_stays_plain(monkeypatch):
+  """XOT_TPU_SPEC_NGRAM=0 with XOT_TPU_SPEC_DECODE=ngram: no draft, no
+  n-gram — every dispatch takes the plain path (no ngram handles)."""
+  monkeypatch.setenv("XOT_TPU_SPEC_NGRAM", "0")
+  cfg = tiny_test_config(n_layers=2, max_seq_len=128, tied_embedding=True)
+  params, shard = full_model_params(jax.random.PRNGKey(11), cfg, "m")
+  engine = JaxShardedInferenceEngine(use_local_mesh=False, spec_decode="ngram")
+  engine.load_test_model(shard, cfg, params)
+  prompt = np.array([[5, 9, 2, 5, 9, 2]], dtype=np.int32)
+  logits, _ = await engine.infer_tensor("p", shard, prompt)
+  h = engine._dispatch_chunk_sync("p", shard, 8, 0.0, 35, int(np.argmax(logits, -1)[0]))
+  assert not (isinstance(h, tuple) and h[0] == "ngram")
+  assert engine.sessions["p"].ngram_index is None

@@ -77,6 +77,13 @@ class DecoderBatchOps(_PageCopyMixin):
   def spec_supported(self) -> bool:
     return getattr(self.engine, "_draft_params", None) is not None
 
+  def spec_ngram_supported(self) -> bool:
+    """Whether the DRAFT-FREE spec programs can run here (ISSUE 12): the
+    fused spec programs need a full-model single-device backend, which is
+    exactly what this class is — no draft model required. The pp/sp mesh
+    backends have no spec integration at all (the mixin default)."""
+    return True
+
   def draft_geometry(self):
     """(cfg_d, shard_d) of the draft — the target's own for a self-draft."""
     eng = self.engine
@@ -104,26 +111,32 @@ class DecoderBatchOps(_PageCopyMixin):
     )
     return cache_d
 
-  def spec_batch_decode(self, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key):
+  def spec_batch_decode(self, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key, props=None, prop_counts=None):
     from ..models.decoder import fused_spec_batch_decode
 
     eng = self.engine
     cfg_d, shard_d = self.draft_geometry()
+    # cache_d=None dispatches the DRAFT-FREE program (ISSUE 12): the
+    # scheduler passes it when no model-drafted row is in the chunk, so
+    # n-gram-only dispatches never pay the draft rounds (and draft-free
+    # engines have no draft params to pass at all).
+    params_d = getattr(eng, "_draft_params", None) if cache_d is not None else None
     return fused_spec_batch_decode(
-      eng.params, eng.cfg, eng._effective_shard, eng._draft_params, cfg_d, shard_d,
+      eng.params, eng.cfg, eng._effective_shard, params_d, cfg_d, shard_d,
       token, cache, cache_d, positions, active, gammas, temps, n_rounds, gamma_max,
-      top_k=top_ks, k_max=k_max, key=key,
+      top_k=top_ks, k_max=k_max, key=key, props=props, prop_counts=prop_counts,
     )
 
-  def spec_paged_batch_decode(self, token, pool, cache_d, block_tables, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, page_size: int, key):
+  def spec_paged_batch_decode(self, token, pool, cache_d, block_tables, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, page_size: int, key, props=None, prop_counts=None):
     from ..models.decoder import fused_spec_paged_batch_decode
 
     eng = self.engine
     cfg_d, shard_d = self.draft_geometry()
+    params_d = getattr(eng, "_draft_params", None) if cache_d is not None else None
     return fused_spec_paged_batch_decode(
-      eng.params, eng.cfg, eng._effective_shard, eng._draft_params, cfg_d, shard_d,
+      eng.params, eng.cfg, eng._effective_shard, params_d, cfg_d, shard_d,
       token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds, gamma_max,
-      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key, props=props, prop_counts=prop_counts,
     )
 
   def init_cache(self, n_slots: int, max_seq: int):

@@ -52,28 +52,40 @@ shapes:
   comparisons of sampled traffic are per-request, not cross-request.
 
 Speculative decoding is a FIRST-CLASS SCHEDULER MODE (``XOT_TPU_SPEC_BATCH``,
-default auto — ISSUE 7): when the engine carries a draft model
-(``XOT_TPU_SPEC_DECODE=int8`` / ``XOT_TPU_SPEC_DRAFT``) and the backend
-supports it, each decode tick dispatches a draft-then-verify chunk
-(``models/decoder.py fused_spec_[paged_]batch_decode``): ``chunk`` rounds in
-which a batched draft proposes up to gamma tokens per row, ONE batched target
-forward verifies every row's window, and per-row accept/reject becomes a
-variable advance on the paged pool — rejected tails are garbage the next
-round's writes cover before any read (the same drop-on-read argument as the
-lookahead pipeline). Depth is adaptive PER ROW: an acceptance EWMA walks each
-row's gamma through the policy table (inference/paging.py
-``spec_adapt_gamma``; floor 0 = plain decode, so rows where the draft isn't
-paying stop proposing without dragging the batch), interactive-class rows
+default auto — ISSUE 7): each decode tick dispatches a draft-then-verify
+chunk (``models/decoder.py fused_spec_[paged_]batch_decode``): ``chunk``
+rounds in which a proposer drafts up to gamma tokens per row, ONE batched
+target forward verifies every row's window, and per-row accept/reject
+becomes a variable advance on the paged pool — rejected tails are garbage
+the next round's writes cover before any read (the same drop-on-read
+argument as the lookahead pipeline). Since ISSUE 12 the PROPOSER is itself a
+per-row adaptive choice: a loaded draft model ("model" —
+``XOT_TPU_SPEC_DECODE=int8`` / ``XOT_TPU_SPEC_DRAFT``), the row's own
+prompt-lookup suffix index ("ngram" — inference/ngram.py, zero device work,
+zero KV pages, ``XOT_TPU_SPEC_NGRAM[_N/_MAX]`` knobs), or plain (gamma 0
+inside the same program) — so ``auto`` speculates DRAFT-FREE when no draft
+is configured. N-gram rows draft from a host-proposed reference stream that
+keeps proposing round after round while the target stays on it (the LLMA
+multi-round continuation); proposals key on SETTLED history, so chunks with
+n-gram rows dispatch synchronously (the pipeline drains first). Depth is
+adaptive PER ROW per proposer: an acceptance EWMA walks each row's gamma
+through the policy table (inference/paging.py ``spec_adapt_gamma``; floor 0
+→ ``spec_select_proposer`` probes the next proposer or parks the row on
+plain; n-gram lookup misses charge the same zero observation so
+non-repetitive rows stop paying the pipeline drain), interactive-class rows
 demote later (accepted runs directly cut their ITL), and when every row sits
-at gamma 0 the scheduler dispatches the PLAIN chunk program (re-probing at
-gamma 1 every ``XOT_TPU_SPEC_REPROBE`` plain chunks). Page growth and the
-context-window gate run against the chunk's WORST-CASE advance
-(``spec_worst_advance`` — gamma-deep speculative headroom); within
-``spec_worst_advance`` tokens of the context window the batch falls back to
-plain chunks so the window-end cutoff keeps plain-mode chunk granularity.
-The draft's own dense slot cache rides next to the target pool (prefilled at
-admission), and its HBM bytes enter the pool-sizing block math so enabling
-speculation cannot oversubscribe admission (``kv_draft_*`` gauges). Greedy
+at gamma 0 the scheduler dispatches the PLAIN chunk program (re-probing
+every ``XOT_TPU_SPEC_REPROBE`` plain chunks, each row on its best-ranked
+proposer). Page growth and the context-window gate run against the chunk's
+WORST-CASE advance (``spec_worst_advance`` — gamma-deep speculative
+headroom); within ``spec_worst_advance`` tokens of the context window the
+batch falls back to plain chunks so the window-end cutoff keeps plain-mode
+chunk granularity. A loaded draft's dense slot cache rides next to the
+target pool (prefilled at admission), and its HBM bytes enter the
+pool-sizing block math so enabling speculation cannot oversubscribe
+admission (``kv_draft_*`` gauges); DRAFT-FREE speculation holds no device
+state — the gauges read 0, the page budget stays whole, and n-gram-only
+chunks compile the draft-free program even when a draft is loaded. Greedy
 streams are token-identical to the plain program by construction; sampled
 rows always run gamma 0 and draw one sample per round (same key-split
 schedule as plain chunks). ``XOT_TPU_SPEC_BATCH=0`` restores the plain
@@ -151,6 +163,11 @@ __all__ = ["BatchedServer", "_Request"]
 
 PREFILL_BUCKET = 128
 
+# spec_proposer{row} gauge encoding (ISSUE 12) — same 0/1/2 style as the
+# node_role gauge: 0 = plain decode, 1 = n-gram prompt-lookup, 2 = model
+# draft. Documented in the README metric table.
+PROPOSER_CODE = {"plain": 0, "ngram": 1, "model": 2}
+
 
 def _round_up(n: int, multiple: int) -> int:
   return ((n + multiple - 1) // multiple) * multiple
@@ -187,10 +204,17 @@ class _Slot:
   shared_pages: list = field(default_factory=list)
   pages: list = field(default_factory=list)
   chain_keys: list = field(default_factory=list)
-  # Batched speculation (ISSUE 7): this row's current draft depth and the
-  # acceptance EWMA that drives it (inference/paging.py spec_adapt_gamma).
+  # Batched speculation (ISSUE 7/12): this row's current draft depth, its
+  # active PROPOSER ("model" draft / "ngram" prompt-lookup / "plain"), the
+  # per-proposer acceptance EWMAs that drive both choices
+  # (inference/paging.py spec_adapt_gamma + spec_select_proposer), and the
+  # row's own n-gram suffix index over prompt+generated history
+  # (inference/ngram.py — None when the n-gram family is off or the row is
+  # sampled).
   spec_gamma: int = 0
-  spec_ewma: float | None = None
+  spec_proposer: str = "plain"
+  spec_ewmas: dict = field(default_factory=dict)
+  ngram: object = None
   # perf_counter at the first emitted token (ISSUE 9): with the finish time
   # it yields the request's realized mean inter-token latency for goodput's
   # within-SLO check.
@@ -239,6 +263,12 @@ class _Chunk:
   counts: object = None  # device [B] int32 — valid tokens per row
   pos_dev: object = None  # device [B] int32 — post-chunk positions
   gammas: np.ndarray | None = None  # [B] dispatched depths (metrics/EWMA)
+  # ISSUE 12: per-row proposer attribution for the settle's accounting —
+  # which proposer drafted each row this chunk, and the device handle of the
+  # per-row drafted-token totals (the acceptance-EWMA denominator; model
+  # rows draft rounds·gamma, n-gram rows their consumed stream length).
+  proposers: list | None = None  # [n_slots] "model"|"ngram"|"plain"
+  n_prop: object = None  # device [B] int32 — tokens drafted per row
 
 
 class BatchedServer:
@@ -319,6 +349,20 @@ class BatchedServer:
     # plain decode (0 disables re-probing).
     self.spec_reprobe = int(os.getenv("XOT_TPU_SPEC_REPROBE", "32"))
     self._spec_plain_chunks = 0
+    # Draft-free proposers (ISSUE 12): which proposer families this server
+    # can offer ("model" = loaded draft, "ngram" = the prompt-lookup index).
+    # Resolved with the spec verdict at cache-build time; the n-gram knobs
+    # are read here so one server's dispatches are self-consistent.
+    from .ngram import ngram_knobs
+
+    self.spec_proposers: tuple = ()
+    self.spec_ngram_n, self.spec_ngram_max = ngram_knobs()
+    # Host proposals staged by _spec_intent for the NEXT dispatch (row ->
+    # int32 reference stream). Only ever populated with the pipeline
+    # drained: n-gram proposals key on settled history, so a chunk with
+    # n-gram rows always dispatches synchronously.
+    self._spec_props: dict | None = None
+    self._spec_needs_host = False
     self.max_seq = 0
     self.slots: list[_Slot | None] = [None] * self.n_slots
     self._loop_task: asyncio.Task | None = None
@@ -697,13 +741,21 @@ class BatchedServer:
     # sizing so the draft cache's bytes can enter the page budget.
     mode = os.getenv("XOT_TPU_SPEC_BATCH", "auto")
     want = self._spec_batch_arg if self._spec_batch_arg is not None else mode not in ("0", "false")
-    self.spec = (
-      bool(want)
-      and getattr(self.ops, "spec_supported", lambda: False)()
-      and not (self.paged and eng.cfg.is_mla)
-    )
+    # Proposer families (ISSUE 12): a loaded draft model offers "model";
+    # the n-gram index offers "ngram" on any backend with the fused spec
+    # programs — so XOT_TPU_SPEC_BATCH=auto speculates DRAFT-FREE when no
+    # draft checkpoint is configured.
+    from .ngram import ngram_enabled
+
+    proposers = []
+    if getattr(self.ops, "spec_supported", lambda: False)():
+      proposers.append("model")
+    if ngram_enabled() and getattr(self.ops, "spec_ngram_supported", lambda: False)():
+      proposers.append("ngram")
+    self.spec = bool(want) and bool(proposers) and not (self.paged and eng.cfg.is_mla)
+    self.spec_proposers = tuple(proposers) if self.spec else ()
     draft_pages_equiv = 0
-    if self.spec:
+    if self.spec and "model" in self.spec_proposers:
       from .paging import kv_cache_bytes
 
       cfg_d, shard_d = self.ops.draft_geometry()
@@ -713,6 +765,14 @@ class BatchedServer:
       metrics.set_gauge("kv_draft_bytes", draft_bytes)
       metrics.set_gauge("kv_draft_slots", self.n_slots)
       metrics.set_gauge("kv_draft_pages_equivalent", draft_pages_equiv)
+    elif self.spec:
+      # Draft-free speculation (ISSUE 12 satellite): the n-gram proposer
+      # holds no device state — the draft gauges must READ ZERO and the
+      # page budget below stays whole (nothing to deduct back from
+      # admission).
+      metrics.set_gauge("kv_draft_bytes", 0)
+      metrics.set_gauge("kv_draft_slots", 0)
+      metrics.set_gauge("kv_draft_pages_equivalent", 0)
     if self.paged:
       from .paging import PageAllocator, kv_cache_bytes, pages_to_cover
 
@@ -764,7 +824,7 @@ class BatchedServer:
         self.tier.kv_quant = kv_quant
     else:
       self.cache = self.ops.init_cache(self.n_slots, self.max_seq)
-    if self.spec:
+    if self.spec and "model" in self.spec_proposers:
       self.draft_cache = self.ops.init_draft_cache(self.n_slots, self.max_seq)
     # Decode-path attribution label for this pool's compiled chunk program:
     # fixed per (layout, slots, window, quant) — the same resolution
@@ -1320,8 +1380,24 @@ class BatchedServer:
       # their ITL — while batch-class rows start shallow and must EARN depth
       # through the acceptance EWMA (they only care about throughput, where
       # a mispredicting deep draft costs most). Sampled rows stay at 0.
+      # Starting PROPOSER (ISSUE 12): the loaded draft keeps PR 7's behavior
+      # when present; draft-free servers open on the n-gram proposer at its
+      # own depth cap (proposals are free — a row only pays when a suffix
+      # match actually fires). Per-row convergence from here is the policy's
+      # job (spec_adapt_gamma + spec_select_proposer at every settle).
       cls = req.qos.priority if req.qos is not None else "standard"
-      slot.spec_gamma = max(self.spec_gamma_max // 2, 1) if cls == "batch" else self.spec_gamma_max
+      if "model" in self.spec_proposers:
+        slot.spec_proposer = "model"
+        slot.spec_gamma = max(self.spec_gamma_max // 2, 1) if cls == "batch" else self.spec_gamma_max
+      else:
+        slot.spec_proposer = "ngram"
+        slot.spec_gamma = max(self.spec_ngram_max // 2, 1) if cls == "batch" else self.spec_ngram_max
+      if "ngram" in self.spec_proposers:
+        from .ngram import NgramIndex
+
+        slot.ngram = NgramIndex(self.spec_ngram_n)
+        slot.ngram.extend(req.tokens)
+        slot.ngram.extend([first])
     self.slots[r.row] = slot
     self._h_occupied[r.row] = True
     self._h_tokens[r.row, 0] = first
@@ -1531,6 +1607,7 @@ class BatchedServer:
       self.block_tables[row, :] = 0
     if self.spec:
       metrics.set_gauge("spec_gamma", 0, labels={"row": str(row)})
+      metrics.set_gauge("spec_proposer", 0, labels={"row": str(row)})
     self._h_occupied[row] = False
     self._h_tokens[row, 0] = 0
     self._h_positions[row] = 0
@@ -1639,6 +1716,24 @@ class BatchedServer:
     deadlocked = inflight is None and bool(starved) and not active.any() and finishing == 0
     return _Plan(rows=rows, active=active, starved=starved, positions=positions, deadlocked=deadlocked, gmax=gmax)
 
+  def _note_ngram_miss(self, row: int, slot: _Slot) -> None:
+    """Charge a proposal MISS (no suffix match in the row's history) to the
+    n-gram EWMA as a zero-acceptance observation. A miss costs no device
+    work, but a row holding n-gram depth forces synchronous dispatch (host
+    proposals need settled history), so rows whose text never matches must
+    converge back to plain and let the pipeline chain — while a row with an
+    established high EWMA rides the hysteresis band through brief
+    non-repetitive gaps."""
+    from .paging import ewma_update, spec_adapt_gamma, spec_select_proposer
+
+    ewma = ewma_update(slot.spec_ewmas.get("ngram"), 0.0)
+    slot.spec_ewmas["ngram"] = ewma
+    prio = slot.req.qos.priority if slot.req.qos is not None else "standard"
+    slot.spec_gamma = spec_adapt_gamma(ewma, slot.spec_gamma, self.spec_ngram_max, prio)
+    if slot.spec_gamma == 0:
+      slot.spec_proposer, slot.spec_gamma = spec_select_proposer("ngram", slot.spec_ewmas, self.spec_proposers, prio)
+    metrics.set_gauge("spec_proposer", PROPOSER_CODE[slot.spec_proposer], labels={"row": str(row)})
+
   def _spec_intent(self, inflight: _Chunk | None) -> int:
     """gamma_max for the NEXT decode chunk; 0 ⇒ dispatch the plain program.
 
@@ -1646,31 +1741,70 @@ class BatchedServer:
     depth collapsed to 0 — the acceptance-EWMA floor), or any live row sits
     within the chunk's worst-case advance of the context window (the plain
     program's window-end cutoff keeps chunk granularity there — identity
-    over the band). When every depth is 0, one probe chunk at gamma 1 runs
-    every ``spec_reprobe`` plain chunks so a draft that STARTS paying again
-    (e.g. the stream left a pathological region) can re-earn its depth."""
-    if not self.spec or self.draft_cache is None:
+    over the band). When every depth is 0, one probe chunk runs every
+    ``spec_reprobe`` plain chunks so a proposer that STARTS paying again
+    (e.g. the stream left a pathological region) can re-earn its depth —
+    each row probes whichever proposer the policy ranks best for it
+    (inference/paging.py ``spec_reprobe_proposer``).
+
+    ISSUE 12: rows on the N-GRAM proposer draft from settled host history,
+    so when any such row holds depth while a chunk is in flight this
+    returns with ``_spec_needs_host`` set and the loop settles first; with
+    the pipeline drained the proposals are computed here (one suffix lookup
+    per row) and staged in ``_spec_props`` for the dispatch. A lookup MISS
+    contributes no depth this chunk and charges the miss policy
+    (``_note_ngram_miss``)."""
+    self._spec_props = None
+    self._spec_needs_host = False
+    if not self.spec:
       return 0
-    from .paging import spec_worst_advance
+    from .paging import spec_reprobe_proposer, spec_worst_advance
 
     live = [(i, s) for i, s in enumerate(self.slots) if s is not None and not s.finished and not s.cancelled]
     greedy = [(i, s) for i, s in live if s.req.temp <= 0.0]
     if not greedy:
       return 0
-    gmax = max(s.spec_gamma for _, s in greedy)
-    if gmax == 0:
+    model_ok = self.draft_cache is not None
+    if all(s.spec_gamma <= 0 or (s.spec_proposer == "model" and not model_ok) for _, s in greedy):
       if self.spec_reprobe <= 0 or self._spec_plain_chunks < self.spec_reprobe:
         return 0
-      for _, s in greedy:  # probe round: shallowest depth, every greedy row
-        s.spec_gamma = 1
+      for i, s in greedy:  # probe round: shallowest depth, best proposer per row
+        prop = spec_reprobe_proposer(s.spec_ewmas, self.spec_proposers if model_ok else tuple(p for p in self.spec_proposers if p != "model"))
+        if prop is None:
+          continue
+        s.spec_proposer, s.spec_gamma = prop, 1
+        metrics.set_gauge("spec_proposer", PROPOSER_CODE[prop], labels={"row": str(i)})
       self._spec_plain_chunks = 0
-      gmax = 1
+    if inflight is not None and any(s.spec_proposer == "ngram" and s.spec_gamma > 0 and s.ngram is not None for _, s in greedy):
+      # Host proposals need settled history: ask the loop to drain first.
+      self._spec_needs_host = True
+      return max(s.spec_gamma for _, s in greedy)
+    gmax = 0
+    props: dict[int, np.ndarray] = {}
+    stream_cap = spec_worst_advance(self.chunk, self.spec_ngram_max)
+    for i, s in greedy:
+      if s.spec_gamma <= 0:
+        continue
+      if s.spec_proposer == "ngram":
+        if s.ngram is None:
+          continue
+        cand = s.ngram.propose(stream_cap)
+        if len(cand) == 0:
+          self._note_ngram_miss(i, s)
+          continue
+        props[i] = cand
+        gmax = max(gmax, min(s.spec_gamma, len(cand)))
+      elif model_ok:
+        gmax = max(gmax, s.spec_gamma)
+    if gmax == 0:
+      return 0
     worst = spec_worst_advance(self.chunk, gmax)
     adv = inflight.worst if inflight is not None else 0
     for i, s in live:
       pos = int(self._h_positions[i]) + (adv if (inflight is not None and inflight.active[i]) else 0)
       if pos + worst >= self.max_seq:
         return 0  # near-window band: plain chunks carry the row to its end
+    self._spec_props = props or None
     return gmax
 
   def _preempt_starved(self, plan: _Plan) -> None:
@@ -1715,11 +1849,31 @@ class BatchedServer:
       positions = inflight.pos_dev  # true device positions; plan's copy is worst-case
     temps, top_ks = self._h_temps, self._h_top_ks
     gammas = None
+    proposers = None
+    props_arr = prop_counts = None
+    use_draft = False
     if spec:
+      props_map, self._spec_props = self._spec_props, None
       gammas = np.zeros((self.n_slots,), dtype=np.int32)
+      proposers = ["plain"] * self.n_slots
+      if props_map:
+        stream_w = spec_worst_advance(self.chunk, gmax) + gmax
+        props_arr = np.zeros((self.n_slots, stream_w), dtype=np.int32)
+        prop_counts = np.zeros((self.n_slots,), dtype=np.int32)
       for i, s in plan.rows:
-        if plan.active[i] and s.req.temp <= 0.0:
+        if not (plan.active[i] and s.req.temp <= 0.0):
+          continue
+        if s.spec_proposer == "ngram":
+          if props_map and i in props_map:
+            stream = props_map[i][:stream_w]
+            props_arr[i, : len(stream)] = stream
+            prop_counts[i] = len(stream)
+            gammas[i] = min(s.spec_gamma, gmax)
+            proposers[i] = "ngram"
+        elif s.spec_proposer == "model" and self.draft_cache is not None and s.spec_gamma > 0:
           gammas[i] = min(s.spec_gamma, gmax)
+          proposers[i] = "model"
+          use_draft = True
       self._spec_plain_chunks = 0
     elif self.spec:
       self._spec_plain_chunks += 1
@@ -1733,17 +1887,26 @@ class BatchedServer:
       metrics.observe_hist("sched_host_gap_seconds", 0.0 if inflight is not None else now - self._t_last_ready)
 
     def run():
-      counts = pos_dev = None
+      counts = pos_dev = n_prop = None
+      # The draft cache rides the dispatch only when a MODEL-drafted row is
+      # in it (ISSUE 12): n-gram/plain-only chunks compile the draft-free
+      # program — no draft rounds, no donated draft cache (it stays valid
+      # for a later model re-probe; staleness only lowers that probe's
+      # acceptance, never correctness).
+      cd = self.draft_cache if (spec and use_draft) else None
+      pr = jnp.asarray(props_arr) if (spec and props_arr is not None) else None
+      pc = jnp.asarray(prop_counts) if (spec and prop_counts is not None) else None
       if spec and self.paged:
-        toks, counts, next_tok, pos_dev, self.cache, self.draft_cache = self.ops.spec_paged_batch_decode(
-          jnp.asarray(tokens), self.cache, self.draft_cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
+        toks, counts, n_prop, next_tok, pos_dev, self.cache, cd = self.ops.spec_paged_batch_decode(
+          jnp.asarray(tokens), self.cache, cd, jnp.asarray(self.block_tables), jnp.asarray(positions),
           jnp.asarray(active), jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax,
-          k_max=self.k_max, page_size=self.page_size, key=sub,
+          k_max=self.k_max, page_size=self.page_size, key=sub, props=pr, prop_counts=pc,
         )
       elif spec:
-        toks, counts, next_tok, pos_dev, self.cache, self.draft_cache = self.ops.spec_batch_decode(
-          jnp.asarray(tokens), self.cache, self.draft_cache, jnp.asarray(positions), jnp.asarray(active),
+        toks, counts, n_prop, next_tok, pos_dev, self.cache, cd = self.ops.spec_batch_decode(
+          jnp.asarray(tokens), self.cache, cd, jnp.asarray(positions), jnp.asarray(active),
           jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax, k_max=self.k_max, key=sub,
+          props=pr, prop_counts=pc,
         )
       elif self.paged:
         toks, next_tok, _pos, self.cache = self.ops.paged_batch_decode(
@@ -1756,44 +1919,61 @@ class BatchedServer:
           jnp.asarray(tokens), self.cache, jnp.asarray(positions), jnp.asarray(active),
           jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub,
         )
+      if spec and use_draft:
+        self.draft_cache = cd
       try:
         toks.copy_to_host_async()  # the readback overlaps the next chunk's compute
         if counts is not None:
           counts.copy_to_host_async()
+        if n_prop is not None:
+          n_prop.copy_to_host_async()
       except AttributeError:  # backend without async copies
         pass
-      return toks, next_tok, counts, pos_dev
+      return toks, next_tok, counts, pos_dev, n_prop
 
     if plan.starved:
       metrics.inc("scheduler_page_starved_total", len(plan.starved))
     t_dispatch = time.perf_counter()
-    toks, next_tok, counts, pos_dev = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    toks, next_tok, counts, pos_dev, n_prop = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
     return _Chunk(
       toks=toks, next_tok=next_tok, rows=plan.rows, active=plan.active,
       starved=frozenset(plan.starved), t_dispatch=t_dispatch, chained=inflight is not None,
       spec=spec, worst=worst, rounds=self.chunk if spec else 0, counts=counts, pos_dev=pos_dev, gammas=gammas,
+      proposers=proposers, n_prop=n_prop,
     )
 
-  def _note_spec_settle(self, row: int, slot: _Slot, record: _Chunk, avail: int, emitted: int) -> None:
-    """Per-row spec-chunk bookkeeping at the settle: acceptance counters,
-    the EWMA → depth policy step, the per-row depth gauge, and the timeline
-    decode stage carrying the chunk's accepted-run total (ISSUE 7)."""
-    from .paging import ewma_update, spec_adapt_gamma
+  def _note_spec_settle(self, row: int, slot: _Slot, record: _Chunk, avail: int, emitted: int, proposed: int) -> None:
+    """Per-row spec-chunk bookkeeping at the settle: per-proposer acceptance
+    counters, the EWMA → depth policy step, proposer switching at the depth
+    floor (ISSUE 12: ``spec_select_proposer`` — each row converges to
+    model-draft / n-gram / plain, whichever pays), the per-row depth and
+    proposer gauges, and the timeline decode stage carrying the chunk's
+    accepted-run total."""
+    from .paging import ewma_update, spec_adapt_gamma, spec_select_proposer
 
     g = int(record.gammas[row]) if record.gammas is not None else 0
+    prop = record.proposers[row] if record.proposers is not None else ("model" if g > 0 else "plain")
     accepted = max(avail - record.rounds, 0)
-    metrics.inc("spec_accepted_tokens_total", accepted)
-    if g > 0:
-      metrics.inc("spec_proposed_tokens_total", record.rounds * g)
-      acc = accepted / float(record.rounds * g)
-      slot.spec_ewma = ewma_update(slot.spec_ewma, acc)
+    metrics.inc("spec_accepted_tokens_total", accepted, labels={"proposer": prop})
+    ewma = None
+    if g > 0 and proposed > 0:
+      metrics.inc("spec_proposed_tokens_total", proposed, labels={"proposer": prop})
+      acc = accepted / float(proposed)
+      ewma = ewma_update(slot.spec_ewmas.get(prop), acc)
+      slot.spec_ewmas[prop] = ewma
       prio = slot.req.qos.priority if slot.req.qos is not None else "standard"
-      slot.spec_gamma = spec_adapt_gamma(slot.spec_ewma, g, self.spec_gamma_max, prio)
-      metrics.observe_hist("spec_acceptance_ewma", slot.spec_ewma, buckets=FRACTION_BUCKETS)
+      cap = self.spec_ngram_max if prop == "ngram" else self.spec_gamma_max
+      slot.spec_gamma = spec_adapt_gamma(ewma, g, cap, prio)
+      if slot.spec_gamma == 0:
+        # Depth floor on the current proposer: the selection policy probes
+        # the next candidate (or parks the row on plain until a re-probe).
+        slot.spec_proposer, slot.spec_gamma = spec_select_proposer(prop, slot.spec_ewmas, self.spec_proposers, prio)
+      metrics.observe_hist("spec_acceptance_ewma", ewma, buckets=FRACTION_BUCKETS)
     metrics.set_gauge("spec_gamma", slot.spec_gamma, labels={"row": str(row)})
+    metrics.set_gauge("spec_proposer", PROPOSER_CODE[slot.spec_proposer], labels={"row": str(row)})
     tracer.stage(slot.req.request_id, "decode_chunk", {
-      "tokens": emitted, "accepted": accepted, "gamma": g, "rounds": record.rounds,
-      "ewma": round(slot.spec_ewma, 4) if slot.spec_ewma is not None else None,
+      "tokens": emitted, "accepted": accepted, "gamma": g, "rounds": record.rounds, "proposer": prop,
+      "ewma": round(ewma, 4) if ewma is not None else None,
     })
 
   async def _settle(self, record: _Chunk) -> None:
@@ -1816,9 +1996,13 @@ class BatchedServer:
     eng = self.engine
 
     def fetch():
-      return np.asarray(record.toks), (np.asarray(record.counts) if record.counts is not None else None)
+      return (
+        np.asarray(record.toks),
+        np.asarray(record.counts) if record.counts is not None else None,
+        np.asarray(record.n_prop) if record.n_prop is not None else None,
+      )
 
-    rows_host, counts_host = await asyncio.get_event_loop().run_in_executor(eng.executor, fetch)
+    rows_host, counts_host, n_prop_host = await asyncio.get_event_loop().run_in_executor(eng.executor, fetch)
     t_ready = time.perf_counter()
     # Device-time attribution: while the pipeline is full the device runs
     # chunks back-to-back, so per-chunk device time is READY-TO-READY (==
@@ -1862,7 +2046,11 @@ class BatchedServer:
           done = True
           break
       if record.spec:
-        self._note_spec_settle(i, slot, record, avail, len(emit))
+        self._note_spec_settle(i, slot, record, avail, len(emit), int(n_prop_host[i]) if n_prop_host is not None else 0)
+      if slot.ngram is not None and emit:
+        # O(1)-per-token index update: the row's suffix history now covers
+        # everything the next chunk's proposal may key on.
+        slot.ngram.extend(emit)
       slot.out_tokens.extend(emit)
       slot.pos += len(emit)
       slot.last_token = emit[-1] if emit else slot.last_token
@@ -1964,10 +2152,14 @@ class BatchedServer:
             continue
 
         gmax = self._spec_intent(inflight)
-        if inflight is not None and inflight.spec != (gmax > 0):
+        if inflight is not None and (inflight.spec != (gmax > 0) or self._spec_needs_host):
           # Program-type switch (spec↔plain): a chained dispatch would need
           # the other program's chain contract (device positions vs host
           # plan) — settle the in-flight chunk and dispatch synchronously.
+          # N-gram rows holding depth settle the same way (ISSUE 12): their
+          # proposals key on the suffix of SETTLED history, so a chunk with
+          # host proposals never chains — the intent recomputes them against
+          # the drained state on the next pass.
           await self._settle(inflight)
           inflight = None
           continue

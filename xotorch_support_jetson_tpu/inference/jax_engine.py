@@ -103,6 +103,7 @@ class _Session:
   __slots__ = (
     "kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch", "prompt_np", "draft_cache",
     "spec_seed_dev", "spec_pos_dev", "spec_known_pos", "spec_inflight_slots",
+    "ngram_index", "ngram_unread", "ngram_ewma", "ngram_gamma",
   )
 
   def __init__(self, kv_cache, max_seq: int, epoch: int = 0) -> None:
@@ -125,6 +126,22 @@ class _Session:
     self.spec_pos_dev = None
     self.spec_known_pos = 0
     self.spec_inflight_slots = 0
+    # Draft-free n-gram chain (ISSUE 12): the suffix index over this
+    # session's prompt+generated history (inference/ngram.py), and whether
+    # an n-gram chunk is dispatched-but-unread. Unlike the draft spec chain,
+    # n-gram chunks can NEVER pipeline: the next proposal keys on the tokens
+    # this one emits, so the engine answers the node's speculative
+    # dispatch-ahead with None and the chunk loop degrades to synchronous.
+    # The acceptance EWMA and live depth are PER SESSION (unlike the model
+    # draft's engine-level pair): n-gram acceptance is a property of the
+    # TEXT being generated, not of the model — one non-repetitive response
+    # must not collapse speculation for the repetitive session that follows
+    # (the batched path's per-slot state makes the same choice). -1 depth =
+    # not initialized yet (set from the engine cap at chain start).
+    self.ngram_index = None
+    self.ngram_unread = False
+    self.ngram_ewma = None
+    self.ngram_gamma = -1
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -170,6 +187,17 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._spec_gamma_live = self.spec_gamma
     self._spec_plain_streak = 0
     self._spec_reprobe = int(os.getenv("XOT_TPU_SPEC_REPROBE", "64"))
+    # Draft-free n-gram proposer (ISSUE 12): with XOT_TPU_SPEC_DECODE set
+    # but NO draft pair loaded (XOT_TPU_SPEC_DECODE=ngram, or a draft whose
+    # checkpoint/vocab check failed), streaming chunks speculate from the
+    # session's own prompt+generated history (inference/ngram.py) — same
+    # accept rule, zero draft weights, zero draft KV. The EWMA/depth state
+    # lives on the SESSION (n-gram acceptance is a property of the text,
+    # not the model); only the knobs are engine-level.
+    from .ngram import ngram_enabled, ngram_knobs
+
+    self._spec_ngram_on = ngram_enabled()
+    self.spec_ngram_n, self.spec_ngram_max = ngram_knobs()
     self._draft_params = None
     # Cross-model draft (XOT_TPU_SPEC_DRAFT=<registry-id-or-dir>): a second,
     # SMALLER model drafts for the target. None ⇒ int8 self-draft (same cfg).
@@ -299,6 +327,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._draft_cfg = None
     self._draft_shard = None
     # A new draft is a new acceptance distribution: reset the adaptive state.
+    # (The n-gram state needs no reset here — it lives per session, and a
+    # model swap drops every session with the cache it invalidates.)
     self._spec_ewma = None
     self._spec_gamma_live = self.spec_gamma
     self._spec_plain_streak = 0
@@ -981,6 +1011,128 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._spec_plain_streak = 0
     metrics.observe_hist("spec_acceptance_ewma", self._spec_ewma, buckets=FRACTION_BUCKETS)
 
+  def _ngram_chunk_eligible(self, session, temp, first_token) -> bool:
+    """Draft-free n-gram chain (ISSUE 12): greedy single-stream requests
+    with XOT_TPU_SPEC_DECODE set but NO draft pair loaded — the solo spec
+    path no longer requires a draft checkpoint. Entered right after prefill
+    like the draft chain; continues while the session's index is alive."""
+    if self._draft_params is not None or not self.spec_decode or not self._spec_ngram_on:
+      return False
+    if temp is not None and float(temp) > 0.0:
+      return False
+    if session.ngram_index is not None or session.ngram_unread:
+      return True  # chain active
+    return (
+      first_token is not None
+      and session.prompt_np is not None
+      and session.prompt_np.shape[0] == 1
+      and session.curr_pos == session.prompt_len  # fresh after prefill
+    )
+
+  def _ngram_gamma_for_dispatch(self, session) -> int:
+    """The SESSION's adaptive n-gram depth for the next chunk. Every fresh
+    session opens at the full cap — proposals cost nothing to attempt, and
+    the previous response's text says nothing about this one's — and the
+    session's own measured acceptance walks it down from there (the batched
+    path's per-slot fresh start, same reasoning)."""
+    if session.ngram_gamma < 0:
+      session.ngram_gamma = self.spec_ngram_max
+    return session.ngram_gamma
+
+  def _note_ngram_acceptance(self, session, accepted: int, proposed: int) -> None:
+    """Fold one n-gram chunk's measured acceptance into the SESSION's EWMA
+    and re-run the depth policy (same shape as ``_note_spec_acceptance``,
+    per-session state — ISSUE 12)."""
+    from .paging import ewma_update, spec_adapt_gamma
+    from ..utils.metrics import FRACTION_BUCKETS
+
+    if proposed <= 0:
+      return
+    # Counters record the device work unconditionally (the batched settle
+    # does too); only the EWMA needs a live session — a request cancelled
+    # between dispatch and read still drafted/verified those tokens.
+    metrics.inc("spec_proposed_tokens_total", proposed, labels={"proposer": "ngram"})
+    metrics.inc("spec_accepted_tokens_total", accepted, labels={"proposer": "ngram"})
+    if session is None:
+      return
+    session.ngram_ewma = ewma_update(session.ngram_ewma, accepted / proposed)
+    session.ngram_gamma = spec_adapt_gamma(session.ngram_ewma, max(session.ngram_gamma, 1), self.spec_ngram_max)
+    metrics.observe_hist("spec_acceptance_ewma", session.ngram_ewma, buckets=FRACTION_BUCKETS)
+
+  def _note_ngram_miss(self, session) -> None:
+    """A suffix lookup found nothing: zero-acceptance EWMA observation, so
+    a session over non-repetitive text converges back to the (pipelined)
+    plain path instead of holding the chunk loop synchronous forever."""
+    from .paging import ewma_update, spec_adapt_gamma
+
+    session.ngram_ewma = ewma_update(session.ngram_ewma, 0.0)
+    session.ngram_gamma = spec_adapt_gamma(session.ngram_ewma, session.ngram_gamma, self.spec_ngram_max)
+
+  def _dispatch_ngram_chunk_sync(self, request_id, shard, first_token, steps: int, gamma: int):
+    """One draft-free speculative chunk (models/decoder.py
+    ``fused_spec_batch_decode`` with ``params_d=None``, B=1): the host
+    proposes the continuation that followed the current suffix earlier in
+    prompt+generated history, the target verifies the whole window, and the
+    session's dense cache absorbs the variable advance. Returns the packed
+    handle, or None to hand THIS dispatch to the plain path (no proposal
+    and depth at the floor, or the near-window band).
+
+    The chain is strictly sequential: host history must cover a chunk's
+    emitted tokens before the next proposal — ``read_chunk`` confirms the
+    position, extends the index, and clears ``ngram_unread``."""
+    from ..models.decoder import fused_spec_batch_decode
+
+    session = self.sessions[request_id]
+    if session.ngram_index is None:
+      from .ngram import NgramIndex
+
+      idx = NgramIndex(self.spec_ngram_n)
+      idx.extend(session.prompt_np[0])
+      idx.extend([int(first_token)])
+      session.ngram_index = idx
+      token = jnp.full((1, 1), int(first_token), dtype=jnp.int32)
+    else:
+      token = session.next_token_dev
+      if token is None:
+        session.ngram_index = None  # chain broken (plain re-seeds exactly)
+        return None
+    G = self.spec_ngram_max
+    rounds = max(steps // (G + 1), 1)
+    stream = session.ngram_index.propose(rounds * (G + 1) + G)
+    if len(stream) == 0:
+      self._note_ngram_miss(session)
+      if session.ngram_gamma <= 0:
+        session.ngram_index = None  # depth floor: plain serves the rest
+        return None
+      # Tracking-only chunk (gamma_max=0 compiles to a plain-equivalent
+      # program that still reports counts): history stays live so the next
+      # repetitive region can propose again.
+      rounds, G, g_eff = steps, 0, 0
+      props = prop_counts = None
+    else:
+      g_eff = min(gamma, len(stream))
+      props = jnp.asarray(np.asarray(stream)[None, :], jnp.int32)
+      prop_counts = jnp.asarray([len(stream)], jnp.int32)
+    worst = rounds * (G + 1)
+    if session.curr_pos + worst + 1 > session.max_seq:
+      session.ngram_index = None  # near the cache end: plain trims exactly
+      return None
+    pos = jnp.full((1,), session.curr_pos, dtype=jnp.int32)
+    buf, counts, n_prop, seed, _new_pos, session.kv_cache, _cd = fused_spec_batch_decode(
+      self.params, self.cfg, shard, None, self.cfg, shard,
+      token, session.kv_cache, None, pos, jnp.ones((1,), jnp.bool_), jnp.asarray([g_eff], jnp.int32),
+      jnp.zeros((1,), jnp.float32), rounds, G, top_k=1, k_max=1, key=None,
+      props=props, prop_counts=prop_counts,
+    )
+    packed = jnp.concatenate([counts, n_prop, buf[0]])
+    session.next_token_dev = seed
+    session.ngram_unread = True
+    try:
+      packed.copy_to_host_async()
+    except AttributeError:
+      pass
+    return ("ngram", request_id, rounds, packed)
+
   def _dispatch_spec_chunk_sync(self, request_id, shard, n_steps, first_token, steps: int, gamma: int):
     """One streaming speculative chunk (models/decoder.py
     fused_speculative_chunk). The seed token and position ride the DEVICE
@@ -1046,6 +1198,21 @@ class JaxShardedInferenceEngine(InferenceEngine):
         session.spec_seed_dev = None
         session.spec_pos_dev = None
         session.spec_inflight_slots = 0
+    elif self._pp is None and self._ngram_chunk_eligible(session, temp, first_token):
+      # Draft-free n-gram chain (ISSUE 12). An unread n-gram chunk answers
+      # the node's dispatch-ahead with None — the chunk loop's
+      # under-delivery fallback then re-dispatches after reading, which is
+      # exactly the synchronous cadence host proposals require.
+      if session.ngram_unread:
+        return None
+      G = self._ngram_gamma_for_dispatch(session)
+      if G > 0:
+        steps = min(1 << (max(n_steps, 1) - 1).bit_length(), 256)
+        handle = self._dispatch_ngram_chunk_sync(request_id, shard, first_token, steps, G)
+        if handle is not None:
+          return handle
+      else:
+        session.ngram_index = None  # session at the depth floor: plain takes over
     return self._dispatch_plain_chunk_sync(request_id, shard, n_steps, temp, top_k, first_token)
 
   def _dispatch_plain_chunk_sync(self, request_id, shard, n_steps, temp, top_k, first_token):
@@ -1211,6 +1378,23 @@ class JaxShardedInferenceEngine(InferenceEngine):
       return []
 
     def read():
+      if isinstance(handle, tuple) and handle[0] == "ngram":
+        # Packed draft-free n-gram chunk: [m, n_prop, tokens...] in one
+        # fetch (ISSUE 12). Confirms the chain position, extends the
+        # suffix index with the emitted tokens (the next proposal keys on
+        # them), and feeds the measured acceptance into the n-gram EWMA.
+        _, request_id, rounds, packed = handle
+        row = np.asarray(packed)
+        m, n_prop = int(row[0]), int(row[1])
+        session = self.sessions.get(request_id)
+        self._note_ngram_acceptance(session, max(m - rounds, 0), n_prop)
+        toks = [int(t) for t in row[2 : 2 + m]]
+        if session is not None:
+          session.ngram_unread = False
+          session.curr_pos += m
+          if session.ngram_index is not None:
+            session.ngram_index.extend(toks)
+        return toks
       if isinstance(handle, tuple) and handle[0] == "spec":
         # Packed speculative chunk: [m, rounds, tokens...] in one fetch.
         # Confirm the chain position host-side (the room bound tightens back

@@ -1,0 +1,133 @@
+"""Prompt-lookup / n-gram draft proposer for speculative decoding (ISSUE 12).
+
+The insight (Saxena's prompt-lookup decoding; Yang et al. "Inference with
+Reference"/LLMA): on exactly the workloads the prefix cache already targets —
+RAG answers quoting retrieved context, code edits echoing the original file,
+multi-turn chats restating earlier turns — the continuation being generated
+has very often ALREADY APPEARED in prompt+generated history. Matching the
+current suffix against that history yields a draft that costs zero device
+work, zero extra HBM, and zero KV pages, with acceptance high enough to beat
+a trained draft model on these workloads. The accept/verify machinery is
+draft-agnostic (PR 7), so the only new pieces are this host-side index and
+the per-row proposer-selection policy (inference/paging.py
+``spec_select_proposer``).
+
+``NgramIndex`` is ONE ROW's incremental suffix index over its own
+prompt+generated token history:
+
+- ``extend(tokens)`` appends emitted tokens and updates the index in O(N)
+  dict writes per token (N = ``XOT_TPU_SPEC_NGRAM_N``, the max suffix length
+  matched — a constant, so O(1) per token; the scheduler calls it once per
+  settle with that chunk's emitted tokens, the admission path once with the
+  full prompt).
+- ``propose(max_tokens)`` keys on the LAST-N-token suffix, longest match
+  wins (N down to 1), and returns the run of up to ``max_tokens`` tokens
+  that FOLLOWED the most recent earlier occurrence of that suffix — the
+  "reference" continuation the target then verifies in one batched window.
+  Empty when no earlier occurrence exists (a miss: the policy charges it so
+  rows in non-repetitive text converge back to plain decode).
+
+For each gram length k the index keeps the END position of the latest and
+previous occurrences (two dicts) — the latest occurrence of the CURRENT
+suffix is always the suffix itself, so the previous one is the match.
+Memory is O(history · N) dict entries per row, bounded by the context
+window; the whole index dies with its slot/session.
+
+Knobs (all read at construction; the scheduler re-reads per server):
+
+- ``XOT_TPU_SPEC_NGRAM`` (default 1): enable the n-gram proposer family.
+  With it on, ``XOT_TPU_SPEC_BATCH=auto`` speculates DRAFT-FREE — no draft
+  checkpoint, no draft KV, nothing deducted from the page budget.
+- ``XOT_TPU_SPEC_NGRAM_N`` (default 3): longest suffix length to match.
+- ``XOT_TPU_SPEC_NGRAM_MAX`` (default 8): the n-gram proposer's per-round
+  depth cap (its ``gamma_max`` — deeper than the model draft's default
+  because proposals are free; the acceptance EWMA still walks each row's
+  live depth below it).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["NgramIndex", "ngram_enabled", "ngram_knobs"]
+
+
+def ngram_enabled() -> bool:
+  """Whether the n-gram proposer family is enabled (``XOT_TPU_SPEC_NGRAM``,
+  default on). The speculation master switches still gate it:
+  ``XOT_TPU_SPEC_BATCH=0`` / an unset ``XOT_TPU_SPEC_DECODE`` never
+  speculate regardless."""
+  return os.getenv("XOT_TPU_SPEC_NGRAM", "1") not in ("0", "false")
+
+
+def ngram_knobs() -> tuple[int, int]:
+  """(suffix length N, depth cap) from the env, floored at sane minimums."""
+  n = max(int(os.getenv("XOT_TPU_SPEC_NGRAM_N", "3")), 1)
+  gmax = max(int(os.getenv("XOT_TPU_SPEC_NGRAM_MAX", "8")), 1)
+  return n, gmax
+
+
+class NgramIndex:
+  """Incremental suffix-match index over one row's token history."""
+
+  def __init__(self, n: int | None = None):
+    self.n = max(int(n), 1) if n is not None else ngram_knobs()[0]
+    self.history: list[int] = []
+    # Per gram length k (1..n): k-gram tuple -> end position of its LATEST
+    # occurrence, and -> end position of the occurrence BEFORE that. The
+    # current suffix's latest occurrence is itself; the previous one is the
+    # match a proposal continues from.
+    self._last: list[dict[tuple, int]] = [dict() for _ in range(self.n)]
+    self._prev: list[dict[tuple, int]] = [dict() for _ in range(self.n)]
+
+  def __len__(self) -> int:
+    return len(self.history)
+
+  def extend(self, tokens) -> None:
+    """Append emitted tokens, updating every gram length's maps — O(n) dict
+    writes per token."""
+    h = self.history
+    for t in tokens:
+      h.append(int(t))
+      p = len(h) - 1
+      for k in range(1, self.n + 1):
+        if p + 1 < k:
+          break
+        gram = tuple(h[p + 1 - k : p + 1])
+        old = self._last[k - 1].get(gram)
+        if old is not None:
+          self._prev[k - 1][gram] = old
+        self._last[k - 1][gram] = p
+
+  def propose(self, max_tokens: int) -> np.ndarray:
+    """Exactly ``max_tokens`` predicted continuation tokens after the most
+    recent EARLIER occurrence of the longest matching suffix; empty int32
+    array on a miss. Longest match wins: a 3-gram hit is a stronger signal
+    than the 1-gram fallback, so k walks n→1 and the first hit proposes.
+
+    A match ``period = P - e`` positions back predicts position P+1+j as
+    the value at P+1+j-period — recursively past the history end, so the
+    proposal continues CYCLICALLY instead of truncating. This is what makes
+    tight repetition (the period smaller than the requested depth: repeated
+    tokens, short templated runs) proposable at FULL depth: the naive
+    "copy until history runs out" caps every proposal at one period."""
+    h = self.history
+    P = len(h) - 1
+    if P < 0 or max_tokens <= 0:
+      return np.empty((0,), np.int32)
+    for k in range(min(self.n, P + 1), 0, -1):
+      gram = tuple(h[P + 1 - k : P + 1])
+      e = self._last[k - 1].get(gram)
+      if e == P:  # the suffix itself — the real match is the one before it
+        e = self._prev[k - 1].get(gram)
+      if e is None or e >= P:
+        continue
+      period = P - e
+      out: list[int] = []
+      for j in range(max_tokens):
+        src = P + 1 + j - period
+        out.append(h[src] if src <= P else out[src - P - 1])
+      return np.asarray(out, np.int32)
+    return np.empty((0,), np.int32)

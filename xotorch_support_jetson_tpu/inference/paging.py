@@ -241,6 +241,49 @@ def spec_adapt_gamma(ewma: float | None, gamma: int, gamma_max: int, priority: s
   return gamma // 2
 
 
+# Proposer preference order for probes/switches (ISSUE 12): the n-gram
+# proposer costs nothing to try (host dict lookups; a miss never dispatches),
+# so it is probed before the model draft, whose rounds cost real device work.
+SPEC_PROPOSERS = ("ngram", "model")
+
+
+def spec_select_proposer(current: str, ewmas: dict, available: tuple, priority: str = "standard") -> tuple[str, int]:
+  """Next proposer for a row whose depth policy just landed at gamma 0 on
+  ``current`` (ISSUE 12: the proposer itself is the per-row adaptive choice).
+
+  ``ewmas`` maps proposer name -> that proposer's acceptance EWMA for THIS
+  row (None/absent = never measured). Returns ``(proposer, gamma)``: an
+  untried alternative is probed at depth 1 (the same shallow probe the
+  re-probe path uses), a measured alternative re-probes only if its EWMA
+  still clears the row's demote bar (no point bouncing between two proposers
+  that both measured dead), and ``("plain", 0)`` otherwise — the row decodes
+  plain until the scheduler's re-probe cadence resurrects one."""
+  demote_bar = _SPEC_DEMOTE_FLOOR.get(priority, _SPEC_GAMMA_TABLE[1][0])
+  for cand in SPEC_PROPOSERS:
+    if cand == current or cand not in available:
+      continue
+    e = ewmas.get(cand)
+    if e is None or e >= demote_bar:
+      return cand, 1
+  return "plain", 0
+
+
+def spec_reprobe_proposer(ewmas: dict, available: tuple) -> str | None:
+  """Which proposer a re-probe round should try for one row: unmeasured
+  proposers win (cheap discovery, n-gram first per SPEC_PROPOSERS), then the
+  best measured EWMA. None when nothing is available."""
+  best, best_e = None, -1.0
+  for cand in SPEC_PROPOSERS:
+    if cand not in available:
+      continue
+    e = ewmas.get(cand)
+    if e is None:
+      return cand
+    if e > best_e:
+      best, best_e = cand, e
+  return best
+
+
 def spec_worst_advance(n_rounds: int, gamma_max: int) -> int:
   """Worst-case tokens one spec chunk advances a row: every round fully
   accepted. The scheduler's page growth and context-window band gate both

@@ -1393,34 +1393,75 @@ def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
   return head_logits(params, cfg, h), new_pool
 
 
-def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, token, carry_t, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key):
+def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, token, carry_t, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key, props=None, prop_counts=None):
   """The shared draft→verify→accept round loop of both batched spec programs.
 
   ``verify(window [B,W], wpos [B,W], carry_t)`` runs the target over each
   row's window and returns (logits [B,W,V], carry_t) — the dense impl closes
   over the slot cache, the paged impl over (pool, block tables). Returns
-  (buf [B, n_rounds·W], counts [B], next_tok [B,1], next_pos [B], carry_t,
-  cache_d): row i's first counts[i] buffer slots are its emitted tokens, in
-  order; slots past counts[i] are overwritten leftovers the host drops."""
+  (buf [B, n_rounds·W], counts [B], n_prop [B], next_tok [B,1],
+  next_pos [B], carry_t, cache_d): row i's first counts[i] buffer slots are
+  its emitted tokens, in order; slots past counts[i] are overwritten
+  leftovers the host drops; n_prop[i] is the number of draft tokens actually
+  proposed for row i across the chunk (the host's acceptance-EWMA
+  denominator — rounds·gamma for model-drafted rows, the consumed stream
+  length for host-proposed rows).
+
+  HOST-PROPOSED rows (ISSUE 12): ``props`` [B, L] carries each row's n-gram
+  reference STREAM (the continuation that followed the matched suffix
+  earlier in prompt+generated history), ``prop_counts`` [B] its valid
+  length (0 = no proposal: the row runs plain). A proposed row drafts the
+  next G stream tokens each round for as long as it stays ON-STREAM — every
+  verified token so far (accepted draft AND the target's own correction)
+  continued the reference exactly — so a row tracking a long quote keeps
+  full depth across all ``n_rounds`` rounds of the chunk, not just the
+  first (the LLMA multi-round continuation); the first divergence drops it
+  to plain for the rest of the chunk. Greedy identity holds for ANY stream
+  content: the stream only ever supplies draft tokens, and the accept rule
+  compares them to the target's own greedy choices.
+
+  ``params_d is None`` compiles the DRAFT-FREE variant (n-gram/plain rows
+  only): the draft proposal scan and the draft catch-up forward are absent
+  from the program entirely, and ``cache_d`` passes through untouched."""
   B = token.shape[0]
   G = gamma_max
   W = G + 1
   widx = jnp.arange(W, dtype=jnp.int32)
   buf0 = jnp.zeros((B, n_rounds * W), dtype=jnp.int32)
+  if props is not None and G > 0:
+    # Pad so the per-round dynamic_slice window [counts, counts+G) is always
+    # in range (counts can reach (n_rounds-1)·W before the last round).
+    props_pad = jnp.concatenate([props.astype(jnp.int32), jnp.zeros((B, n_rounds * W + G - props.shape[1]), jnp.int32)], axis=1)
 
   def body(carry, _):
-    tok, pos, carry_t, cache_d, buf, counts, key = carry
+    tok, pos, carry_t, cache_d, buf, counts, n_prop, on_stream, key = carry
 
-    # 1) Draft proposes G tokens per row, greedily (batched sequential steps
-    #    — the same single-token program shape as plain decode, small model).
-    def dstep(c, _):
-      t, p, cd = c
-      logits, cd = shard_forward(params_d, cfg_d, shard_d, t, p[:, None], cd)
-      nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-      return (nxt[:, None], p + 1, cd), nxt
+    if params_d is not None:
+      # 1) Draft proposes G tokens per row, greedily (batched sequential
+      #    steps — the same single-token program shape as plain decode,
+      #    small model).
+      def dstep(c, _):
+        t, p, cd = c
+        logits, cd = shard_forward(params_d, cfg_d, shard_d, t, p[:, None], cd)
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return (nxt[:, None], p + 1, cd), nxt
 
-    (_, _, cache_d), d = jax.lax.scan(dstep, (tok, pos, cache_d), None, length=G)
-    d = jnp.moveaxis(d, 0, 1)  # [B, G]
+      (_, _, cache_d), d = jax.lax.scan(dstep, (tok, pos, cache_d), None, length=G)
+      d = jnp.moveaxis(d, 0, 1)  # [B, G]
+    else:
+      d = jnp.zeros((B, G), dtype=jnp.int32)
+
+    # 1b) Host-proposed rows draft the next G tokens of their reference
+    #     stream instead; once off-stream they propose nothing (geff 0) and
+    #     decode plain for the rest of the chunk.
+    geff = gammas
+    if props is not None and G > 0:
+      d_stream = jax.vmap(lambda s, o: jax.lax.dynamic_slice(s, (o,), (G,)))(props_pad, counts)
+      is_prop = prop_counts > 0
+      use_prop = is_prop & on_stream
+      d = jnp.where(use_prop[:, None], d_stream, d)
+      remaining = jnp.maximum(prop_counts - counts, 0)
+      geff = jnp.where(is_prop, jnp.where(use_prop, jnp.minimum(remaining, gammas), 0), gammas)
 
     # 2) Target verifies every row's window [tok, d_1..d_G] in ONE forward.
     window = jnp.concatenate([tok, d], axis=1)  # [B, W]
@@ -1432,9 +1473,9 @@ def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, tok
     # identical subkeys under either program.
     nxt0, key = _next_token_batched(logits_t[:, 0, :], key, temps, top_ks, k_max)
 
-    # 3) Per-row greedy acceptance, capped at the row's own traced gamma;
+    # 3) Per-row greedy acceptance, capped at the row's own traced depth;
     #    sampled rows accept nothing (their draft run is scaffolding only).
-    matches = (d == t_greedy[:, :G]).astype(jnp.int32) * (widx[None, :G] < gammas[:, None]).astype(jnp.int32)
+    matches = (d == t_greedy[:, :G]).astype(jnp.int32) * (widx[None, :G] < geff[:, None]).astype(jnp.int32)
     n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [B]
     n_acc = jnp.where(temps > 0, 0, n_acc)
     corr = jnp.take_along_axis(t_greedy, n_acc[:, None], axis=1)[:, 0]  # target's own next token
@@ -1445,32 +1486,43 @@ def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, tok
     # correction token and are overwritten by the next round's append.
     buf = jax.vmap(lambda b, e, o: jax.lax.dynamic_update_slice(b, e, (o,)))(buf, emitted, counts)
 
-    # 4) Draft catch-up: the window through the draft so its cache covers
-    #    every accepted position (the sequential proposal never writes the
-    #    last proposed token's KV — see _fused_spec_generate_impl).
-    _, cache_d = shard_forward(params_d, cfg_d, shard_d, window, wpos, cache_d)
+    if params_d is not None:
+      # 4) Draft catch-up: the window through the draft so its cache covers
+      #    every accepted position (the sequential proposal never writes the
+      #    last proposed token's KV — see _fused_spec_generate_impl). Also
+      #    keeps the draft warm for host-proposed rows that may switch back.
+      _, cache_d = shard_forward(params_d, cfg_d, shard_d, window, wpos, cache_d)
+
+    if props is not None and G > 0:
+      # On-stream iff the whole window continued the reference: full
+      # acceptance AND the correction token is the stream's next token.
+      nxt_idx = jnp.clip(counts + n_acc, 0, props_pad.shape[1] - 1)
+      cont = jnp.take_along_axis(props_pad, nxt_idx[:, None], axis=1)[:, 0]
+      on_stream = use_prop & (n_acc == geff) & (counts + n_acc < prop_counts) & (corr == cont)
 
     k_adv = jnp.where(active, n_acc + 1, 0)  # inactive rows hold token & position
+    n_prop = n_prop + jnp.where(active, geff, 0)
     new_tok = jnp.where(active, corr, tok[:, 0])[:, None]
-    return (new_tok, pos + k_adv, carry_t, cache_d, buf, counts + k_adv, key), None
+    return (new_tok, pos + k_adv, carry_t, cache_d, buf, counts + k_adv, n_prop, on_stream, key), None
 
   counts0 = jnp.zeros((B,), dtype=jnp.int32)
-  (next_tok, next_pos, carry_t, cache_d, buf, counts, _), _ = jax.lax.scan(
-    body, (token, positions, carry_t, cache_d, buf0, counts0, key), None, length=n_rounds
+  on0 = (prop_counts > 0) if props is not None else jnp.zeros((B,), jnp.bool_)
+  (next_tok, next_pos, carry_t, cache_d, buf, counts, n_prop, _, _), _ = jax.lax.scan(
+    body, (token, positions, carry_t, cache_d, buf0, counts0, counts0, on0, key), None, length=n_rounds
   )
-  return buf, counts, next_tok, next_pos, carry_t, cache_d
+  return buf, counts, n_prop, next_tok, next_pos, carry_t, cache_d
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max"), donate_argnums=(2, 3))
-def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
+def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, props, prop_counts, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
   def verify(window, wpos, cache):
     return shard_forward(params, cfg, shard, window, wpos, cache)
 
-  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
+  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key, props, prop_counts)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size", "use_kernel", "interpret"), donate_argnums=(2, 3))
-def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
+def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, props, prop_counts, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
   # Inactive rows' window writes must not land on pages another row may now
   # own: pin their tables to the trash page once (tables are chunk-constant).
   bt = jnp.where(active[:, None], block_tables, 0)
@@ -1478,7 +1530,7 @@ def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, 
   def verify(window, wpos, pool):
     return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size, use_kernel, interpret)
 
-  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, pool, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key)
+  return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, pool, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key, props, prop_counts)
 
 
 def _spec_batch_args(shard: Shard, token, active, gammas, temps, top_k, k_max: int, key):
@@ -1494,7 +1546,22 @@ def _spec_batch_args(shard: Shard, token, active, gammas, temps, top_k, k_max: i
   )
 
 
-def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, cache, cache_d, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, key=None):
+def _spec_props_args(props, prop_counts, B: int, n_rounds: int, gamma_max: int):
+  """Normalize the host-proposal pair (ISSUE 12): both None (no n-gram rows
+  this dispatch — compiles the props-free program) or a [B, ≤worst+G]
+  int32 stream buffer + [B] valid counts, clipped to what the chunk can
+  consume."""
+  if props is None:
+    return None, None
+  cap = n_rounds * (gamma_max + 1) + gamma_max
+  props = jnp.asarray(props, jnp.int32)[:, :cap]
+  counts = jnp.minimum(jnp.asarray(prop_counts, jnp.int32), props.shape[1])
+  if props.shape[0] != B:
+    raise ValueError(f"props batch {props.shape[0]} != token batch {B}")
+  return props, counts
+
+
+def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, cache, cache_d, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, key=None, props=None, prop_counts=None):
   """``fused_batch_decode`` with draft-then-verify rounds (dense slot cache).
 
   token [B,1] / positions [B] / active [B] / temps [B] as in
@@ -1502,20 +1569,29 @@ def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cf
   speculation depth (0 ⇒ plain decode for that row), clamped to the static
   ``gamma_max``; ``cache_d`` is the draft's OWN dense slot cache (same slot
   indexing, prefilled by the scheduler at admission). Returns
-  (tokens [B, n_rounds·(gamma_max+1)], counts [B], next_token [B,1],
-  next_positions [B], cache, cache_d) — counts[i] of row i's buffer slots
-  are valid; next_token/next_positions are DEVICE handles so the scheduler's
-  lookahead pipeline chains chunk N+1 without knowing chunk N's variable
-  advance host-side.
+  (tokens [B, n_rounds·(gamma_max+1)], counts [B], n_prop [B],
+  next_token [B,1], next_positions [B], cache, cache_d) — counts[i] of row
+  i's buffer slots are valid; n_prop[i] is the tokens actually drafted for
+  row i (the acceptance denominator); next_token/next_positions are DEVICE
+  handles so the scheduler's lookahead pipeline chains chunk N+1 without
+  knowing chunk N's variable advance host-side.
+
+  ISSUE 12: ``props``/``prop_counts`` carry per-row HOST-PROPOSED reference
+  streams (inference/ngram.py) — those rows skip the draft model entirely
+  and draft from their stream while it keeps verifying (see
+  ``_spec_batch_rounds``). ``params_d=None`` compiles the DRAFT-FREE
+  program (no draft scan, no catch-up, ``cache_d`` passes through): the
+  spec path no longer requires a loaded draft pair.
   """
   token, active, gammas, temps, top_ks, key = _spec_batch_args(shard, token, active, gammas, temps, top_k, k_max, key)
+  props, prop_counts = _spec_props_args(props, prop_counts, token.shape[0], int(n_rounds), int(gamma_max))
   return _fused_spec_batch_decode_impl(
     params, params_d, cache, cache_d, token, positions, active, jnp.minimum(gammas, gamma_max), temps, top_ks, key,
-    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max),
+    props, prop_counts, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max),
   )
 
 
-def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, interpret: bool = False, key=None):
+def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, interpret: bool = False, key=None, props=None, prop_counts=None):
   """``fused_spec_batch_decode`` against the page pool.
 
   Same contract plus ``block_tables`` [B, mp]: the host must have allocated
@@ -1527,7 +1603,8 @@ def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params
   — when the table says kernel, the verify window runs per-position through
   the tuned Pallas kernel instead of the gather reference (ISSUE 11: spec
   chunks no longer forfeit the kernel win; A/B-pinned token-exact); the
-  draft keeps its dense slot cache either way.
+  draft keeps its dense slot cache either way. ``props``/``prop_counts``/
+  ``params_d=None`` as in ``fused_spec_batch_decode`` (ISSUE 12).
   """
   from ..inference.paging import select_decode_path
   from ..ops.paged import paged_kernel_supported
@@ -1538,10 +1615,11 @@ def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params
     context = int(jnp.shape(block_tables)[1]) * int(page_size)
     use_kernel = paged_kernel_supported(cfg) and select_decode_path(jnp.shape(token)[0], context, pool_kv_quant(pool, cfg)) != "gather"
   token, active, gammas, temps, top_ks, key = _spec_batch_args(shard, token, active, gammas, temps, top_k, k_max, key)
+  props, prop_counts = _spec_props_args(props, prop_counts, token.shape[0], int(n_rounds), int(gamma_max))
   return _fused_spec_paged_batch_decode_impl(
     params, params_d, pool, cache_d, token, jnp.asarray(block_tables, jnp.int32), positions, active,
     jnp.minimum(gammas, gamma_max), temps, top_ks, key,
-    cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size), bool(use_kernel), bool(interpret),
+    props, prop_counts, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size), bool(use_kernel), bool(interpret),
   )
 
 
