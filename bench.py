@@ -142,6 +142,16 @@ def gate_disagg(value: float | None, lo: float = 0.001, hi: float = 10000.0) -> 
   return gate_kv_tier(value, lo=lo, hi=hi)
 
 
+def gate_router(value: float | None, lo: float = 0.001, hi: float = 1000.0) -> float | None:
+  """Drift gate for the router round's numbers (ISSUE 13): the
+  affine-vs-random TTFT ratio, the prefix hit rate, and the failover
+  splice window each ride this band check with their own bounds (the
+  ``gate_kv_tier`` pattern — values outside a generous plausibility band
+  are timing artifacts, not results; honest regressions INSIDE the band
+  stay recorded so drift is visible)."""
+  return gate_kv_tier(value, lo=lo, hi=hi)
+
+
 def gate_failover(recovery_ms: float | None, lo: float = 1.0, hi: float = 120000.0) -> float | None:
   """Sanity-gate the failover round's recovery latency (same drift-gate
   pattern). Recovery = kill-to-next-client-visible-token on the localhost
@@ -531,6 +541,277 @@ def bench_disagg(n_burst: int = 4, n_resident_tokens: int = 96, n_burst_tokens: 
     gate_disagg(round(dis_ttft, 2) if dis_ttft is not None else None, lo=0.01, hi=600000.0),
     gate_disagg(ratio, lo=0.001, hi=1000.0),
     gate_disagg(round(gbps, 4) if gbps is not None else None, lo=1e-6, hi=10000.0),
+  )
+
+
+def bench_router_round(n_sessions: int = 5, sys_tokens: int = 256, n_gen: int = 6) -> tuple:
+  """Cluster front door round (ISSUE 13) on a two-replica localhost fixture
+  with a tiny-but-real jax checkpoint — CPU-measurable (the
+  ``gate_spec_ngram`` pattern: the router is host-side HTTP + policy, so
+  every round records a real A/B instead of null).
+
+  Workload: ``n_sessions`` two-turn chats, each with its own
+  ``sys_tokens``-token system prompt (the repeated-system-prompt shape).
+  AFFINE arm: both turns via the router (``XOT_TPU_ROUTER=1``) — turn 2
+  sticks to the replica whose KV holds turn 1. RANDOM arm: the motivating
+  baseline, a client round-robining the replicas by hand — turn 2 lands on
+  the OTHER replica and re-prefills. FAILOVER drill: a streamed request's
+  serving replica is killed at the wire (transport abort) mid-stream; the
+  measured window is kill → next client-visible token through the router's
+  transparent re-submit.
+
+  Returns (router_affine_vs_random_ttft_p50, router_prefix_hit_rate,
+  router_failover_ms_p50, affine_ttft_ms_p50, random_ttft_ms_p50)."""
+  import asyncio
+
+  import aiohttp
+  from aiohttp import web as aioweb
+
+  from xotorch_support_jetson_tpu import registry as _registry
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+  from xotorch_support_jetson_tpu.networking.discovery import Discovery
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as _gm
+
+  class _NoDisc(Discovery):
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+    async def discover_peers(self, wait_for_peers: int = 0):
+      return []
+
+  class _Srv:
+    async def start(self):
+      pass
+
+    async def stop(self):
+      pass
+
+  class _Tok:
+    eos_token_id = None
+
+    def encode(self, text):
+      return [int(w) for w in str(text).split()]
+
+    def decode(self, toks):
+      return " ".join(str(int(t)) for t in toks)
+
+    def apply_chat_template(self, conversation=None, tokenize=False, add_generation_prompt=True, **kw):
+      return " ".join(m["content"] for m in conversation)
+
+  model_id = "bench-router-tiny"
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512)
+  params, shard = full_model_params(jax.random.PRNGKey(3), cfg, model_id)
+  overrides = {
+    "XOT_TPU_BATCHED": "1", "XOT_TPU_PAGE_SIZE": "4", "XOT_TPU_BATCH_CHUNK": "2",
+    "XOT_TPU_ROUTER_STATS_TTL_S": "60", "XOT_TPU_ROUTER_AFFINITY": "1",
+    "XOT_TPU_ROUTER_RETRIES": "2",
+  }
+  saved = {k: os.environ.get(k) for k in list(overrides) + ["XOT_TPU_ROUTER", "XOT_TPU_ROUTER_REPLICAS"]}
+  os.environ.update(overrides)
+  os.environ.pop("XOT_TPU_ROUTER", None)  # replicas must construct router-off
+  had_card = model_id in _registry.model_cards
+  _registry.model_cards[model_id] = _registry.ModelCard(model_id, cfg.n_layers, "Bench Router Tiny", "llama", {"JaxShardedInferenceEngine": "local-bench"})
+
+  def messages(*contents):
+    roles = ["system"] + ["user", "assistant"] * len(contents)
+    return [{"role": r, "content": c} for r, c in zip(roles, contents)]
+
+  def sys_prompt(tag: int) -> str:
+    return " ".join(str(2 + ((tag * 37 + i) % 200)) for i in range(sys_tokens))
+
+  async def round_():
+    tok = _Tok()
+    ids = ["bench-rt0", "bench-rt1"]
+    nodes, runners, sites, ports, urls = [], [], [], [], []
+    for i in range(2):
+      engine = JaxShardedInferenceEngine(use_local_mesh=False)
+      engine.load_test_model(shard, cfg, params, tokenizer=_Tok())
+      node = Node(ids[i], _Srv(), engine, _NoDisc(), None, RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=200, default_sample_temp=0.0)
+      await node.start()
+      api = ChatGPTAPI(node, "JaxShardedInferenceEngine", response_timeout=60, default_model=model_id)
+      runner = aioweb.AppRunner(api.app)
+      await runner.setup()
+      port = find_available_port("127.0.0.1")
+      site = aioweb.TCPSite(runner, "127.0.0.1", port)
+      await site.start()
+      nodes.append(node)
+      runners.append(runner)
+      sites.append(site)
+      ports.append(port)
+      urls.append(f"http://127.0.0.1:{port}")
+    os.environ["XOT_TPU_ROUTER"] = "1"
+    os.environ["XOT_TPU_ROUTER_REPLICAS"] = ",".join(f"{i}={u}" for i, u in zip(ids, urls))
+    rnode = Node("bench-router", _Srv(), DummyInferenceEngine(), _NoDisc(), None, RingMemoryWeightedPartitioningStrategy())
+    await rnode.start()
+    rapi = ChatGPTAPI(rnode, "JaxShardedInferenceEngine", response_timeout=60, default_model=model_id)
+
+    async def _tokenizer(shard_):
+      return tok
+
+    rapi._tokenizer_for = _tokenizer
+    rrunner = aioweb.AppRunner(rapi.app)
+    await rrunner.setup()
+    rport = find_available_port("127.0.0.1")
+    await aioweb.TCPSite(rrunner, "127.0.0.1", rport).start()
+    router_url = f"http://127.0.0.1:{rport}"
+
+    async def stream_ttft(sess, url, body):
+      """POST a streaming chat and return (ttft_ms, full_text)."""
+      t0 = time.perf_counter()
+      ttft = None
+      acc = ""
+      async with sess.post(url + "/v1/chat/completions", json={**body, "stream": True}, timeout=aiohttp.ClientTimeout(total=60)) as resp:
+        assert resp.status == 200, await resp.text()
+        async for line in resp.content:
+          line = line.decode().strip()
+          if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+          obj = json.loads(line[6:])
+          delta = (obj.get("choices") or [{}])[0].get("delta", {}).get("content")
+          if delta:
+            if ttft is None:
+              ttft = (time.perf_counter() - t0) * 1e3
+            acc += delta
+      return ttft, acc
+
+    try:
+      async with aiohttp.ClientSession() as sess:
+        # Warm BOTH replicas through BOTH turn shapes (and the cached-prefix
+        # prefill variant) so neither arm pays first-compile skew — the
+        # affine arm runs first and would otherwise absorb every XLA
+        # compile while the random arm reused them.
+        for wi, u in enumerate(urls):
+          w1 = {"model": model_id, "messages": messages(sys_prompt(90 + wi), "5 3"), "max_tokens": n_gen}
+          _, wreply = await stream_ttft(sess, u, w1)
+          w2 = {"model": model_id, "messages": messages(sys_prompt(90 + wi), "5 3", wreply, "7 7"), "max_tokens": n_gen}
+          await stream_ttft(sess, u, w2)
+
+        # AFFINE arm: two turns per session through the router.
+        req0 = _gm.counter_sum("router_requests_total")
+        hit0 = _gm.counter_sum("router_prefix_hits_total")
+        affine: list[float] = []
+        for s in range(n_sessions):
+          b1 = {"model": model_id, "messages": messages(sys_prompt(s), "5 3"), "max_tokens": n_gen}
+          _, reply = await stream_ttft(sess, router_url, b1)
+          b2 = {"model": model_id, "messages": messages(sys_prompt(s), "5 3", reply, "7 7"), "max_tokens": n_gen}
+          ttft, _ = await stream_ttft(sess, router_url, b2)
+          if ttft is not None:
+            affine.append(ttft)
+        routed = _gm.counter_sum("router_requests_total") - req0
+        hits = _gm.counter_sum("router_prefix_hits_total") - hit0
+        hit_rate = round(hits / routed, 4) if routed else None
+
+        # RANDOM arm: same router hop, affinity OFF — the load fallback's
+        # round-robin sends turn 2 to the OTHER replica, which re-prefills
+        # the session (fresh system prompts so nothing is pre-cached). The
+        # A/B isolates the PLACEMENT policy, not the HTTP hop.
+        os.environ["XOT_TPU_ROUTER_AFFINITY"] = "0"
+        random_: list[float] = []
+        for s in range(n_sessions):
+          b1 = {"model": model_id, "messages": messages(sys_prompt(100 + s), "5 3"), "max_tokens": n_gen}
+          _, reply = await stream_ttft(sess, router_url, b1)
+          b2 = {"model": model_id, "messages": messages(sys_prompt(100 + s), "5 3", reply, "7 7"), "max_tokens": n_gen}
+          ttft, _ = await stream_ttft(sess, router_url, b2)
+          if ttft is not None:
+            random_.append(ttft)
+        os.environ["XOT_TPU_ROUTER_AFFINITY"] = "1"
+
+        # FAILOVER drill: kill the serving replica mid-stream, measure the
+        # client-visible splice window through the router.
+        windows: list[float] = []
+        for d in range(3):
+          t_kill: list[float] = []
+          per_target0 = {i: _gm.counter_value("router_requests_total", labels={"target": i}) for i in ids}
+
+          async def kill_serving():
+            await asyncio.sleep(0)  # let the dispatch counter settle
+            per = {i: _gm.counter_value("router_requests_total", labels={"target": i}) for i in ids}
+            victim = max(ids, key=lambda i: per[i] - per_target0[i])
+            v = ids.index(victim)
+            web_server = runners[v].server
+            for proto in list(getattr(web_server, "connections", []) or []):
+              tr = getattr(proto, "transport", None)
+              if tr is not None:
+                tr.abort()
+            await sites[v].stop()
+            t_kill.append(time.perf_counter())
+            # Re-arm the replica for the next drill.
+            sites[v] = aioweb.TCPSite(runners[v], "127.0.0.1", ports[v])
+            await sites[v].start()
+            view = rapi._router.policy.replicas.get(victim)
+            if view is not None:
+              view.t_unreachable = 0.0
+
+          t_rec: list[float] = []
+          body = {"model": model_id, "messages": messages(sys_prompt(200 + d), "9 9"), "max_tokens": 32}
+          t0 = time.perf_counter()
+          seen_first = False
+          async with sess.post(router_url + "/v1/chat/completions", json={**body, "stream": True}, timeout=aiohttp.ClientTimeout(total=60)) as resp:
+            async for line in resp.content:
+              line = line.decode().strip()
+              if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+              obj = json.loads(line[6:])
+              delta = (obj.get("choices") or [{}])[0].get("delta", {}).get("content")
+              if not delta:
+                continue
+              if not seen_first:
+                seen_first = True
+                await kill_serving()
+              elif t_kill and not t_rec:
+                t_rec.append(time.perf_counter())
+          if t_kill and t_rec:
+            windows.append((t_rec[0] - t_kill[0]) * 1e3)
+
+      aff_p50 = float(np.percentile(np.asarray(affine), 50)) if affine else None
+      rnd_p50 = float(np.percentile(np.asarray(random_), 50)) if random_ else None
+      fo_p50 = float(np.percentile(np.asarray(windows), 50)) if windows else None
+      return aff_p50, rnd_p50, hit_rate, fo_p50
+    finally:
+      if rapi._router is not None:
+        await rapi._router.close()
+      await rrunner.cleanup()
+      for r in runners:
+        try:
+          await asyncio.wait_for(r.cleanup(), timeout=5)
+        except asyncio.TimeoutError:
+          pass
+      for n in nodes:
+        srv = getattr(n.inference_engine, "_batched_server", None)
+        if srv is not None:
+          srv.shutdown()
+        await n.stop()
+      await rnode.stop()
+
+  try:
+    aff_p50, rnd_p50, hit_rate, fo_p50 = asyncio.run(round_())
+  finally:
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+    if not had_card:
+      _registry.model_cards.pop(model_id, None)
+  ratio = round(aff_p50 / rnd_p50, 4) if (aff_p50 and rnd_p50) else None
+  return (
+    gate_router(ratio, lo=0.001, hi=100.0),
+    # lo=0.0: a measured 0.0 hit rate is an honest (bad) result that must
+    # stay in the drift record — unlike the ratio, where 0 = broken input.
+    gate_router(hit_rate, lo=0.0, hi=1.0),
+    gate_router(round(fo_p50, 1) if fo_p50 is not None else None, lo=1.0, hi=120000.0),
+    round(aff_p50, 2) if aff_p50 is not None else None,
+    round(rnd_p50, 2) if rnd_p50 is not None else None,
   )
 
 
@@ -1430,6 +1711,25 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
       pass
 
+  # Cluster front door round (ISSUE 13, behind gate_router): two-replica
+  # localhost fixture with a tiny checkpoint and a repeated-system-prompt
+  # two-turn workload — affine (router) vs random (hand round-robin) TTFT,
+  # the routed prefix hit rate, and the kill-mid-stream failover splice
+  # window. Runs on EVERY round (the router is host-side HTTP + policy —
+  # CPU-measurable like gate_spec_ngram).
+  router_affine_vs_random_ttft_p50 = None
+  router_prefix_hit_rate = None
+  router_failover_ms_p50 = None
+  router_affine_ttft_ms_p50 = None
+  router_random_ttft_ms_p50 = None
+  try:
+    (
+      router_affine_vs_random_ttft_p50, router_prefix_hit_rate, router_failover_ms_p50,
+      router_affine_ttft_ms_p50, router_random_ttft_ms_p50,
+    ) = bench_router_round()
+  except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+    pass
+
   # 8B-geometry int8 decode: the measurable v5e-1 stand-in for BASELINE
   # configs 2/3 (8B-class serving). bf16 8B (~16 GB) exceeds one v5e chip's
   # HBM, so weights are generated AND quantized leaf-by-leaf (the full bf16
@@ -1885,6 +2185,11 @@ def main() -> None:
         "disagg_ttft_ms_p50": disagg_ttft_ms_p50,
         "disagg_vs_colocated_itl_p50": disagg_vs_colocated_itl_p50,
         "kv_stream_gbps": kv_stream_gbps,
+        "router_affine_vs_random_ttft_p50": router_affine_vs_random_ttft_p50,
+        "router_prefix_hit_rate": router_prefix_hit_rate,
+        "router_failover_ms_p50": router_failover_ms_p50,
+        "router_affine_ttft_ms_p50": router_affine_ttft_ms_p50,
+        "router_random_ttft_ms_p50": router_random_ttft_ms_p50,
         "ttft_ms_prefill128": round(ttft_ms, 2),
         "ttft_ms_spread": round(ttft_spread_ms, 2),
         "ttft_vs_prev": ttft_vs_prev,

@@ -40,6 +40,21 @@ RULES: list[tuple[str, str, str]] = [
     f"{PACKAGE}.networking",
     "placement policy is transport-agnostic: the node layer owns the wire",
   ),
+  # Cluster router (ISSUE 13): the routing policy ranks replicas through the
+  # admission/placement layer's scoring — it may import sched_admission,
+  # but never the device-execution scheduler (a router owns no model and
+  # must stay expressible against replicas it only knows by advert) and
+  # never the transport (api/router.py owns the HTTP mechanics).
+  (
+    f"{PACKAGE}/inference/router_policy.py",
+    f"{PACKAGE}.inference.batch_scheduler",
+    "router policy scores adverts via admission/placement, never the device-execution scheduler (ISSUE 13)",
+  ),
+  (
+    f"{PACKAGE}/inference/router_policy.py",
+    f"{PACKAGE}.networking",
+    "routing policy is transport-agnostic: api/router.py owns the HTTP client",
+  ),
 ]
 
 

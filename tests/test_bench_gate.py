@@ -228,6 +228,35 @@ def test_disagg_gate_drops_artifacts():
   assert gate_disagg(2000.0, lo=0.001, hi=1000.0) is None
 
 
+def test_router_gate_keeps_plausible_values():
+  """ISSUE 13: the router round's three fields ride one named gate with
+  per-field bounds — the affine/random TTFT ratio (honest values include
+  regressions above 1.0, recorded so drift is visible against the < 1.0
+  target), the prefix hit rate fraction, and the failover splice window
+  (same band as gate_failover: a sub-ms splice means a token raced the
+  kill)."""
+  from bench import gate_router
+
+  assert gate_router(0.43, lo=0.001, hi=100.0) == 0.43
+  assert gate_router(1.3, lo=0.001, hi=100.0) == 1.3  # a regression is a result, not an artifact
+  assert gate_router(0.5, lo=0.0, hi=1.0) == 0.5
+  assert gate_router(1.0, lo=0.0, hi=1.0) == 1.0  # every routed request affine is legitimate
+  assert gate_router(0.0, lo=0.0, hi=1.0) == 0.0  # a dead-affinity round is a result, not an artifact
+  assert gate_router(32.6, lo=1.0, hi=120000.0) == 32.6
+  assert gate_router(4000.0, lo=1.0, hi=120000.0) == 4000.0
+
+
+def test_router_gate_drops_artifacts():
+  from bench import gate_router
+
+  assert gate_router(None) is None
+  assert gate_router(0.0, lo=0.001, hi=100.0) is None  # broken denominator
+  assert gate_router(500.0, lo=0.001, hi=100.0) is None
+  assert gate_router(1.2, lo=0.0, hi=1.0) is None  # a >1 hit "rate" is a counter bug
+  assert gate_router(0.2, lo=1.0, hi=120000.0) is None  # token raced the kill
+  assert gate_router(500000.0, lo=1.0, hi=120000.0) is None  # wedged into an outer timeout
+
+
 def test_paged_b48_gate_keeps_plausible_ratios():
   """ISSUE 11: the paged-vs-dense B=48 ratio rides its own named gate
   (target >= 0.95 with the shape-aware kernel retune). Honest values —

@@ -49,6 +49,39 @@ def test_checker_catches_a_planted_reverse_import(tmp_path):
     check_layering.REPO = old_repo
 
 
+def test_checker_catches_planted_reverse_import_in_router_policy(tmp_path):
+  """ISSUE 13 satellite: the router-policy rule bites too — a copy of
+  ``router_policy.py`` smuggling a function-local import of the
+  device-execution scheduler fails the gate (its allowed imports of
+  sched_admission/qos/kv_tier stay clean)."""
+  check_layering = _checker()
+  src = (REPO / "xotorch_support_jetson_tpu" / "inference" / "router_policy.py").read_text()
+  planted = src + (
+    "\n\ndef _smuggle():\n"
+    "  from .batch_scheduler import BatchedServer as _B\n"
+    "  return _B\n"
+  )
+  pkg = tmp_path / "xotorch_support_jetson_tpu" / "inference"
+  pkg.mkdir(parents=True)
+  (pkg / "sched_admission.py").write_text((REPO / "xotorch_support_jetson_tpu" / "inference" / "sched_admission.py").read_text())
+  (pkg / "router_policy.py").write_text(planted)
+  old_repo = check_layering.REPO
+  try:
+    check_layering.REPO = tmp_path
+    problems = [p for p in check_layering.check() if "router_policy" in p and "batch_scheduler" in p]
+    assert problems, "planted reverse import in router_policy was not detected"
+  finally:
+    check_layering.REPO = old_repo
+
+
+def test_router_policy_rule_is_active():
+  """The live module passes, and the rule set actually names it (deleting
+  the rule would silently disable the gate)."""
+  check_layering = _checker()
+  assert any("router_policy" in rel for rel, _f, _w in check_layering.RULES)
+  assert not [p for p in check_layering.check() if "router_policy" in p]
+
+
 def test_checker_cli_exit_status():
   proc = subprocess.run(
     [sys.executable, str(REPO / "scripts" / "check_layering.py")],

@@ -707,6 +707,12 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_kv_stream_bytes_total",
   "xot_tpu_kv_stream_adopted_pages_total",
   "xot_tpu_disagg_handoffs_total",
+  # Cluster front door (ISSUE 13; requests labeled {target}, hits {source},
+  # throttles {tenant})
+  "xot_tpu_router_requests_total",
+  "xot_tpu_router_prefix_hits_total",
+  "xot_tpu_router_failovers_total",
+  "xot_tpu_router_tenant_throttled_total",
   # SLO engine + flight recorder (ISSUE 9)
   "xot_tpu_slo_requests_good_total",  # {class}
   "xot_tpu_slo_requests_bad_total",  # {class,reason}
@@ -865,6 +871,11 @@ def test_metric_name_snapshot_after_serving():
   gm.inc("kv_stream_bytes_total", 0)
   gm.inc("kv_stream_adopted_pages_total", 0)
   gm.inc("disagg_handoffs_total", 0)
+  # Cluster front door (ISSUE 13): emitted only by a router-mode API.
+  gm.inc("router_requests_total", 0, labels={"target": "replica-0"})
+  gm.inc("router_prefix_hits_total", 0, labels={"source": "advert"})
+  gm.inc("router_failovers_total", 0)
+  gm.inc("router_tenant_throttled_total", 0, labels={"tenant": "default"})
   gm.observe_hist("kv_stream_seconds", 0.0, labels={"peer": "peer-0"})
   gm.set_gauge("node_role", 0)
   gm.set_gauge("slo_burn_rate", 0.0, labels={"class": "standard", "window": "300s"})

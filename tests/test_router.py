@@ -1,0 +1,711 @@
+"""Cluster front door suite (ISSUE 13).
+
+Covers the acceptance points end to end: the routing-policy ladder
+(session → advert → weighted-least-loaded) as units, the prefix-advert TTL
+staleness guard, and the REAL two-replica gRPC fixture — two full-model jax
+nodes on a localhost ring (the ISSUE 10 replica-set shape, roles ``both``),
+each serving its own ChatGPT API on a real TCP port, fronted by a router
+(``XOT_TPU_ROUTER=1``) that owns no model:
+
+- prefix affinity lands the request on the ADVERTISING replica (counter
+  deltas + routed-target labels), token-identical to the solo baseline;
+- session stickiness keeps a multi-turn chat on its replica with no advert
+  round-trip;
+- a replica killed MID-STREAM (transport abort — the wire-level SIGKILL)
+  fails over invisibly: the client stream completes token-identical to the
+  solo baseline with zero client-visible errors;
+- the cluster-scoped tenant bucket refuses at 1× (not N×) aggregate quota
+  while direct node access still shows the N× trust gap;
+- ``XOT_TPU_ROUTER=0`` is byte-identical serving (poison pin);
+- ``resume_tokens`` + ``token_stream`` (the failover building blocks) are
+  pinned token-exact against the solo reference on a single replica.
+"""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests_support_stubs import NoDiscovery, StubServer
+from xotorch_support_jetson_tpu import registry
+from xotorch_support_jetson_tpu.inference import router_policy, sched_admission
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+MODEL_ID = "tiny-rt"
+
+# 16-token system prompt = 4 full pages at XOT_TPU_PAGE_SIZE=4: the shared
+# prefix the affinity hash matches across requests.
+SYSTEM = " ".join(str(7 + i) for i in range(16))
+
+
+class _Tok:
+  """Whitespace-int tokenizer: prefix-stable under multi-turn extension
+  (decode∘encode is the identity on these streams), so chain keys computed
+  by the router match the replicas' exactly."""
+
+  eos_token_id = None
+
+  def encode(self, text):
+    return [int(w) for w in str(text).split()]
+
+  def decode(self, toks):
+    return " ".join(str(int(t)) for t in toks)
+
+  def apply_chat_template(self, conversation=None, tokenize=False, add_generation_prompt=True, **kw):
+    return " ".join(m["content"] for m in conversation)
+
+
+_TOK = _Tok()
+
+
+def _register_card(monkeypatch):
+  card = registry.ModelCard(MODEL_ID, CFG.n_layers, "Tiny Router Test", "llama", {"JaxShardedInferenceEngine": "local-test"})
+  monkeypatch.setitem(registry.model_cards, MODEL_ID, card)
+
+
+def _messages(*contents):
+  roles = ["system"] + ["user", "assistant"] * len(contents)
+  return [{"role": r, "content": c} for r, c in zip(roles, contents)]
+
+
+# ------------------------------------------------------------- policy units
+
+
+def test_parse_replicas_forms():
+  assert router_policy.parse_replicas("a=http://h:1, b=http://h:2/") == {"a": "http://h:1", "b": "http://h:2"}
+  assert router_policy.parse_replicas("http://h:9") == {"h:9": "http://h:9"}
+  assert router_policy.parse_replicas("") == {}
+  assert router_policy.parse_replicas(None) == {} or isinstance(router_policy.parse_replicas(None), dict)
+
+
+def test_policy_ladder_session_then_advert_then_load(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_ROUTER_AFFINITY", "1")
+  monkeypatch.setenv("XOT_TPU_PREFIX_ADVERT_TTL_S", "120")
+  t = [1000.0]  # nonzero: t_stats == 0 means "never pulled"
+  pol = router_policy.RouterPolicy({"a": "http://a", "b": "http://b"}, clock=lambda: t[0])
+  keys = [bytes([i]) * 16 for i in range(3)]
+  # No stats at all: least-loaded fallback still answers.
+  nid, source, hit = pol.choose(keys)
+  assert nid in ("a", "b") and source == "load" and hit == 0
+  # b advertises the first two keys → advert affinity.
+  pol.update_stats("a", {"slots_total": 4, "slots_busy": 0, "prefix_keys": []})
+  pol.update_stats("b", {"slots_total": 4, "slots_busy": 4, "prefix_keys": [k.hex() for k in keys[:2]]})
+  nid, source, hit = pol.choose(keys)
+  assert (nid, source, hit) == ("b", "advert", 2)
+  # Session memory outranks adverts (and survives advert staleness).
+  pol.note_session(keys, "a")
+  nid, source, hit = pol.choose(keys)
+  assert (nid, source) == ("a", "session") and hit == 3
+  # Affinity off → pure least-loaded (a is idle, b is full).
+  monkeypatch.setenv("XOT_TPU_ROUTER_AFFINITY", "0")
+  nid, source, _ = pol.choose(keys)
+  assert (nid, source) == ("a", "load")
+  monkeypatch.setenv("XOT_TPU_ROUTER_AFFINITY", "1")
+  # Advert TTL: past the TTL the advert stops steering (the staleness
+  # guard), and the session entry for an excluded replica is skipped too.
+  pol2 = router_policy.RouterPolicy({"a": "http://a", "b": "http://b"}, clock=lambda: t[0])
+  pol2.update_stats("b", {"prefix_keys": [k.hex() for k in keys]})
+  t[0] += 121.0
+  nid, source, _ = pol2.choose(keys)
+  assert source == "load"
+  # Draining replicas are ineligible; exclusion falls through to survivors.
+  pol.update_stats("a", {"draining": True})
+  nid, _, _ = pol.choose(keys)
+  assert nid == "b"
+  assert pol.choose(keys, exclude={"a", "b"})[0] is None
+
+
+def test_cluster_retry_horizon_is_min_over_replicas():
+  pol = router_policy.RouterPolicy({"a": "http://a", "b": "http://b", "c": "http://c"})
+  assert pol.cluster_retry_after_ms() == 1000.0  # cold: nothing advertised
+  pol.update_stats("a", {"est_drain_ms": 5000.0})
+  pol.update_stats("b", {"est_drain_ms": 800.0})
+  assert pol.cluster_retry_after_ms() == 800.0  # soonest ANY replica drains
+  pol.update_stats("c", {"ttft_p50_ms": 100.0, "queue_depth_total": 2, "slots_total": 4})
+  assert pol.cluster_retry_after_ms() == 150.0  # ttft-scaled pseudo-estimate
+
+
+def test_load_score_orders_pressure():
+  idle = {"slots_total": 4, "slots_busy": 0, "queue_depth_total": 0, "total_pages": 100, "free_pages": 90}
+  busy = {"slots_total": 4, "slots_busy": 4, "queue_depth_total": 8, "total_pages": 100, "free_pages": 5}
+  assert sched_admission.load_score(idle) < sched_admission.load_score(busy)
+  # Burn contributes: same capacity, one replica burning error budget.
+  hot = dict(idle, slo_burn_fast={"interactive": 10.0})
+  assert sched_admission.load_score(idle) < sched_admission.load_score(hot)
+  # rank_* heads stay the historical choose_* answers (pinned in
+  # test_disagg); the ranked pools expose the N×M tail.
+  stats = {
+    "d1": {"role": "decode", "free_pages": 10, "queue_depth": 3},
+    "d2": {"role": "decode", "free_pages": 40, "queue_depth": 5},
+    "b1": {"role": "both", "free_pages": 500, "queue_depth": 0},
+  }
+  ranked = sched_admission.rank_decode_nodes(stats, self_id="me", self_role="prefill")
+  assert ranked == ["d2", "d1", "b1"]
+  assert sched_admission.choose_decode_node(stats, self_id="me", self_role="prefill") == "d2"
+
+
+def test_prefix_registry_advert_ttl(monkeypatch):
+  from xotorch_support_jetson_tpu.inference.kv_tier import PrefixRegistry
+
+  monkeypatch.setenv("XOT_TPU_PREFIX_ADVERT_TTL_S", "10")
+  t = [0.0]
+  reg = PrefixRegistry(clock=lambda: t[0])
+  key = b"\x01" * 16
+  reg.update_remote("peer-a", [key.hex()])
+  assert reg.locate(key) == ["peer-a"]
+  assert reg.stale_remote_ids() == []
+  t[0] = 10.5  # past the TTL: the advert stops steering and asks for a re-pull
+  assert reg.locate(key) == []
+  assert reg.stale_remote_ids() == ["peer-a"]
+  snap = reg.snapshot()
+  assert snap["stale"] == ["peer-a"] and snap["remote_age_s"]["peer-a"] == 10.5
+  reg.update_remote("peer-a", [key.hex()])  # the re-pull restores steering
+  assert reg.locate(key) == ["peer-a"] and reg.stale_remote_ids() == []
+  monkeypatch.setenv("XOT_TPU_PREFIX_ADVERT_TTL_S", "0")  # 0 disables expiry
+  t[0] = 1e6
+  assert reg.locate(key) == ["peer-a"]
+
+
+# --------------------------------------------------- two-replica gRPC fixture
+
+
+def _fixture_env(monkeypatch):
+  _register_card(monkeypatch)
+  monkeypatch.setenv("XOT_TPU_BATCHED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "4")
+  monkeypatch.setenv("XOT_TPU_BATCH_CHUNK", "2")
+  # The ISSUE 10 replica-set shape: a ring where every node holds the FULL
+  # model (roles default to ``both`` → each serves colocated; two ``both``
+  # peers never hand off to each other).
+  monkeypatch.setenv("XOT_TPU_DISAGG", "1")
+  monkeypatch.setenv("XOT_TPU_RETRY_DELAY_S", "0.05")
+  # One stats pull per test: session-vs-advert attribution stays
+  # deterministic (the sticky test must hit the SESSION path, not a
+  # freshly refreshed advert).
+  monkeypatch.setenv("XOT_TPU_ROUTER_STATS_TTL_S", "60")
+
+
+async def _make_replica_ring(monkeypatch, ids, ports):
+  """Two full-model jax nodes on a localhost gRPC ring, each with its own
+  ChatGPT API bound to a real TCP port."""
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_peer_handle import GRPCPeerHandle
+  from xotorch_support_jetson_tpu.networking.grpc.grpc_server import GRPCServer
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from tests.test_networking import CAPS, StaticDiscovery
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  params, shard = full_model_params(KEY, CFG, MODEL_ID)
+  nodes, apis, runners, urls = [], [], [], []
+  for i in range(2):
+    engine = JaxShardedInferenceEngine(use_local_mesh=False)
+    engine.load_test_model(shard, CFG, params, tokenizer=_Tok())
+    peers = [GRPCPeerHandle(ids[j], f"127.0.0.1:{ports[j]}", "test", CAPS) for j in range(2) if j != i]
+    node = Node(
+      ids[i], None, engine, StaticDiscovery(peers), None,
+      RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=200, default_sample_temp=0.0,
+    )
+    node.server = GRPCServer(node, "127.0.0.1", ports[i])
+    nodes.append(node)
+  await asyncio.gather(*(n.start() for n in nodes))
+  for _ in range(100):
+    if all(len(n.topology.nodes) == 2 for n in nodes):
+      break
+    await asyncio.gather(*(n.collect_topology(set()) for n in nodes))
+    await asyncio.sleep(0.05)
+  for node in nodes:
+    api = ChatGPTAPI(node, "JaxShardedInferenceEngine", response_timeout=60, default_model=MODEL_ID)
+    server = TestServer(api.app)
+    await server.start_server()
+    apis.append(api)
+    runners.append(server)
+    urls.append(str(server.make_url("")).rstrip("/"))
+  return params, shard, nodes, apis, runners, urls
+
+
+async def _make_router(monkeypatch, ids, urls):
+  """An API-only router node: owns no model (only the tokenizer), fronting
+  the replica URLs."""
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  monkeypatch.setenv("XOT_TPU_ROUTER", "1")
+  monkeypatch.setenv("XOT_TPU_ROUTER_REPLICAS", ",".join(f"{i}={u}" for i, u in zip(ids, urls)))
+  node = Node("rt-router", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  await node.start()
+  api = ChatGPTAPI(node, "JaxShardedInferenceEngine", response_timeout=60, default_model=MODEL_ID)
+  assert api._router is not None
+
+  async def _tok(shard):
+    return _TOK
+
+  api._tokenizer_for = _tok  # the router resolves tokenizer artifacts, never weights
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client
+
+
+async def _teardown(nodes, runners, router=None):
+  if router is not None:
+    node, api, client = router
+    if api._router is not None:
+      await api._router.close()
+    await client.close()
+    await node.stop()
+  for r in runners:
+    try:
+      await asyncio.wait_for(r.close(), timeout=5)
+    except asyncio.TimeoutError:
+      pass
+  for n in nodes:
+    server = getattr(n.inference_engine, "_batched_server", None)
+    if server is not None:
+      server.shutdown()
+    await n.stop()
+
+
+def _reference(params, shard, prompt_ids, n_tokens):
+  from tests.test_batched import _single_row_reference
+
+  return _single_row_reference(params, shard, list(prompt_ids), n_tokens - 1)
+
+
+async def _sse_text(resp):
+  """Accumulate an OpenAI chat SSE stream → (text, saw_error)."""
+  acc, err = "", False
+  async for line in resp.content:
+    line = line.decode().strip()
+    if not line.startswith("data: ") or line == "data: [DONE]":
+      continue
+    obj = json.loads(line[6:])
+    if "error" in obj:
+      err = True
+      continue
+    delta = (obj.get("choices") or [{}])[0].get("delta", {}).get("content")
+    if delta:
+      acc += delta
+  return acc, err
+
+
+def _target_counts(ids):
+  return {i: gm.counter_value("router_requests_total", labels={"target": i}) for i in ids}
+
+
+@pytest.mark.asyncio
+async def test_router_affinity_session_and_state(monkeypatch):
+  """Acceptance: (1) a request whose system-prompt KV sits on replica A is
+  routed to A by the ADVERT hash and token-matches the solo baseline;
+  (2) the follow-up turn sticks to its replica via SESSION affinity with no
+  advert refresh; (3) /v1/router and /v1/router/stats surface the state."""
+  _fixture_env(monkeypatch)
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  ids = ["rtaff0", "rtaff1"]
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  params, shard, nodes, apis, runners, urls = await _make_replica_ring(monkeypatch, ids, ports)
+  router = await _make_router(monkeypatch, ids, urls)
+  _node_r, api_r, client = router
+  try:
+    import aiohttp
+
+    # Warm replica A DIRECTLY (not through the router): its finished
+    # request donates the system-prompt pages to A's prefix cache.
+    async with aiohttp.ClientSession() as s:
+      body = {"model": MODEL_ID, "messages": _messages(SYSTEM, "1 2 3"), "max_tokens": 4}
+      async with s.post(urls[0] + "/v1/chat/completions", json=body) as resp:
+        assert resp.status == 200, await resp.text()
+      # The replica advertises its prefix keys at the stats endpoint.
+      async with s.get(urls[0] + "/v1/router/stats") as resp:
+        st = await resp.json()
+        assert st["node_id"] == ids[0] and st["page_size"] == 4
+        assert len(st["prefix_keys"]) >= 4  # 16-token system prompt = 4 pages (+ donated tail)
+
+    # A DIFFERENT conversation sharing the system prompt, via the router:
+    # the advert hash must land it on A (where the KV sits).
+    before = _target_counts(ids)
+    hits_before = gm.counter_value("router_prefix_hits_total", labels={"source": "advert"})
+    prompt_ids = _TOK.encode(" ".join([SYSTEM, "9 8 7 6"]))
+    expected = _reference(params, shard, prompt_ids, 6)
+    resp = await client.post("/v1/chat/completions", json={"model": MODEL_ID, "messages": _messages(SYSTEM, "9 8 7 6"), "max_tokens": 6})
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    assert data["choices"][0]["message"]["content"] == _TOK.decode(expected)
+    after = _target_counts(ids)
+    assert after[ids[0]] == before[ids[0]] + 1 and after[ids[1]] == before[ids[1]]
+    assert gm.counter_value("router_prefix_hits_total", labels={"source": "advert"}) == hits_before + 1
+
+    # Follow-up turn: extends the conversation → SESSION stickiness (the
+    # stats TTL guarantees no advert refresh happened in between).
+    sess_before = gm.counter_value("router_prefix_hits_total", labels={"source": "session"})
+    turn2 = _messages(SYSTEM, "9 8 7 6", data["choices"][0]["message"]["content"], "5 5")
+    prompt2_ids = _TOK.encode(" ".join(m["content"] for m in turn2))
+    expected2 = _reference(params, shard, prompt2_ids, 5)
+    resp = await client.post("/v1/chat/completions", json={"model": MODEL_ID, "messages": turn2, "max_tokens": 5, "stream": True})
+    assert resp.status == 200
+    text2, saw_err = await _sse_text(resp)
+    assert not saw_err and text2 == _TOK.decode(expected2)
+    after2 = _target_counts(ids)
+    assert after2[ids[0]] == after[ids[0]] + 1  # stuck to A
+    assert gm.counter_value("router_prefix_hits_total", labels={"source": "session"}) == sess_before + 1
+
+    # Router introspection.
+    resp = await client.get("/v1/router")
+    state = await resp.json()
+    assert state["enabled"] and set(state["replicas"]) == set(ids)
+    assert state["replicas"][ids[0]]["prefix_keys"] >= 4
+    # The replica's own view of router mode is off.
+    async with aiohttp.ClientSession() as s:
+      async with s.get(urls[0] + "/v1/router") as resp:
+        assert (await resp.json())["enabled"] is False
+  finally:
+    await _teardown(nodes, runners, router)
+
+
+@pytest.mark.asyncio
+async def test_router_failover_mid_stream_token_identical(monkeypatch):
+  """Acceptance: kill the serving replica MID-STREAM (transport abort — the
+  wire-level SIGKILL). The router re-submits the remainder to the survivor
+  with ``resume_tokens`` and splices the continuation: the client sees ONE
+  unbroken stream, token-identical to the solo baseline, zero errors."""
+  _fixture_env(monkeypatch)
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  ids = ["rtko0", "rtko1"]
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  params, shard, nodes, apis, runners, urls = await _make_replica_ring(monkeypatch, ids, ports)
+  router = await _make_router(monkeypatch, ids, urls)
+  _node_r, api_r, client = router
+  try:
+    import aiohttp
+
+    # Pin the victim: warm A so affinity routes the doomed request there.
+    async with aiohttp.ClientSession() as s:
+      async with s.post(urls[0] + "/v1/chat/completions", json={"model": MODEL_ID, "messages": _messages(SYSTEM, "2 4"), "max_tokens": 3}) as resp:
+        assert resp.status == 200
+
+    n_tokens = 24  # XOT_TPU_BATCH_CHUNK=2 → many chunks → a real mid-stream kill window
+    prompt_ids = _TOK.encode(" ".join([SYSTEM, "11 12 13"]))
+    expected = _reference(params, shard, prompt_ids, n_tokens)
+    failovers_before = gm.counter_value("router_failovers_total")
+    before = _target_counts(ids)
+
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": MODEL_ID, "messages": _messages(SYSTEM, "11 12 13"), "max_tokens": n_tokens, "stream": True},
+    )
+    assert resp.status == 200
+    acc, saw_err, killed = "", False, False
+    async for line in resp.content:
+      line = line.decode().strip()
+      if not line.startswith("data: ") or line == "data: [DONE]":
+        continue
+      obj = json.loads(line[6:])
+      if "error" in obj:
+        saw_err = True
+        continue
+      delta = (obj.get("choices") or [{}])[0].get("delta", {}).get("content")
+      if delta:
+        acc += delta
+      if not killed and len(_TOK.encode(acc)) >= 4:
+        killed = True
+        # SIGKILL at the wire: abort every live connection into replica A
+        # and stop its listener — the router's read fails mid-stream.
+        web_server = runners[0].runner.server
+        for proto in list(getattr(web_server, "connections", []) or []):
+          tr = getattr(proto, "transport", None)
+          if tr is not None:
+            tr.abort()
+        for site in list(runners[0].runner.sites):
+          await site.stop()
+    assert killed, "stream finished before the kill window — raise n_tokens"
+    assert not saw_err, "failover leaked a client-visible error"
+    assert acc == _TOK.decode(expected), f"spliced stream diverged: {acc!r}"
+    assert gm.counter_value("router_failovers_total") == failovers_before + 1
+    after = _target_counts(ids)
+    assert after[ids[0]] == before[ids[0]] + 1  # the doomed dispatch
+    assert after[ids[1]] == before[ids[1]] + 1  # the survivor's resume
+    # The survivor's scheduler finished clean.
+    srv_b = nodes[1].inference_engine.get_batched_server()
+    assert all(s is None for s in srv_b.slots)
+  finally:
+    await _teardown(nodes, runners, router)
+
+
+@pytest.mark.asyncio
+async def test_cluster_tenant_bucket_refuses_at_aggregate_quota(monkeypatch):
+  """Acceptance: the router enforces ONE logical tenant bucket for the
+  fleet — the tenant is refused at 1× the aggregate quota, while direct
+  node access still grants the N× the PR 5 trust note warned about."""
+  _fixture_env(monkeypatch)
+  monkeypatch.setenv("XOT_TPU_QOS_RPS", "2")
+  monkeypatch.setenv("XOT_TPU_QOS_BURST_S", "1")
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  ids = ["rtten0", "rtten1"]
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  params, shard, nodes, apis, runners, urls = await _make_replica_ring(monkeypatch, ids, ports)
+  router = await _make_router(monkeypatch, ids, urls)
+  _node_r, api_r, client = router
+  try:
+    throttled_before = gm.counter_value("router_tenant_throttled_total", labels={"tenant": "acme"})
+    body = {"model": MODEL_ID, "messages": _messages(SYSTEM, "3 1"), "max_tokens": 2}
+    headers = {"x-tenant-id": "acme"}
+    for _ in range(2):  # the aggregate quota: 2 requests
+      resp = await client.post("/v1/chat/completions", json=body, headers=headers)
+      assert resp.status == 200, await resp.text()
+    resp = await client.post("/v1/chat/completions", json=body, headers=headers)
+    assert resp.status == 429
+    refusal = await resp.json()
+    assert refusal["error"]["type"] == "rate_limited"
+    assert "Retry-After" in resp.headers
+    assert gm.counter_value("router_tenant_throttled_total", labels={"tenant": "acme"}) == throttled_before + 1
+    # The SAME tenant hitting a node DIRECTLY still gets fresh per-node
+    # quota — the N× trust gap the router closes.
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+      async with s.post(urls[1] + "/v1/chat/completions", json=body, headers=headers) as direct:
+        assert direct.status == 200
+  finally:
+    await _teardown(nodes, runners, router)
+
+
+@pytest.mark.asyncio
+async def test_resume_tokens_and_token_stream_pins(monkeypatch):
+  """The failover building blocks, pinned on one replica: ``token_stream``
+  streams raw token-id batches, and ``resume_tokens`` continues the stream
+  token-exactly where the carried span ends (the scheduler's carry-resume
+  surfaced at the API)."""
+  _fixture_env(monkeypatch)
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  ids = ["rtres0", "rtres1"]
+  ports = [find_available_port("127.0.0.1") for _ in range(2)]
+  params, shard, nodes, apis, runners, urls = await _make_replica_ring(monkeypatch, ids, ports)
+  try:
+    import aiohttp
+
+    n_tokens = 12
+    prompt_ids = _TOK.encode(" ".join([SYSTEM, "42 17"]))
+    expected = _reference(params, shard, prompt_ids, n_tokens)
+
+    async def token_stream(body) -> list[int]:
+      got: list[int] = []
+      async with aiohttp.ClientSession() as s:
+        async with s.post(urls[0] + "/v1/chat/completions", json=body) as resp:
+          assert resp.status == 200, await resp.text()
+          async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+              continue
+            obj = json.loads(line[6:])
+            assert "error" not in obj, obj
+            got.extend(obj["tokens"])
+      return got
+
+    base = {"model": MODEL_ID, "messages": _messages(SYSTEM, "42 17"), "stream": True, "token_stream": True}
+    assert await token_stream({**base, "max_tokens": n_tokens}) == expected
+    # Resume after k carried tokens: the continuation is exactly the tail.
+    k = 5
+    resumed = await token_stream({**base, "max_tokens": n_tokens - k, "resume_tokens": expected[:k]})
+    assert resumed == expected[k:]
+    # Malformed resume payload is a clean 400.
+    async with aiohttp.ClientSession() as s:
+      async with s.post(urls[0] + "/v1/chat/completions", json={**base, "resume_tokens": ["x"]}) as resp:
+        assert resp.status == 400
+  finally:
+    await _teardown(nodes, runners)
+
+
+# ----------------------------------------------- stub-replica pump behavior
+
+
+def _stub_replica_app(node_id: str, *, refuse_429: bool = False, tokens=(5, 6), est_drain_ms=None):
+  """A fake replica speaking just enough of the protocol: /v1/router/stats
+  and a token-stream completions endpoint (or a structured 429)."""
+  served = {"n": 0, "bodies": []}
+
+  async def stats(request):
+    st = {"node_id": node_id, "slots_total": 2, "slots_busy": 0, "page_size": 4, "prefix_keys": []}
+    if est_drain_ms is not None:
+      st["est_drain_ms"] = est_drain_ms
+    return web.json_response(st)
+
+  async def completions(request):
+    served["n"] += 1
+    served["bodies"].append(await request.json())
+    if refuse_429:
+      return web.json_response(
+        {"error": {"type": "overloaded", "message": "queue full", "retry_after_ms": 60000.0}},
+        status=429, headers={"Retry-After": "60"},
+      )
+    resp = web.StreamResponse(headers={"Content-Type": "text/event-stream"})
+    await resp.prepare(request)
+    await resp.write(f"data: {json.dumps({'tokens': list(tokens), 'finished': True})}\n\n".encode())
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+  app = web.Application()
+  app.router.add_get("/v1/router/stats", stats)
+  app.router.add_post("/v1/chat/completions", completions)
+  return app, served
+
+
+async def _stub_router(monkeypatch, stubs):
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+  from xotorch_support_jetson_tpu.utils.helpers import find_available_port
+
+  _register_card(monkeypatch)
+  runners, entries = [], []
+  for node_id, app in stubs:
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = find_available_port("127.0.0.1")
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    runners.append(runner)
+    entries.append(f"{node_id}=http://127.0.0.1:{port}")
+  monkeypatch.setenv("XOT_TPU_ROUTER", "1")
+  monkeypatch.setenv("XOT_TPU_ROUTER_REPLICAS", ",".join(entries))
+  node = Node("rt-stub-router", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  await node.start()
+  api = ChatGPTAPI(node, "JaxShardedInferenceEngine", response_timeout=30, default_model=MODEL_ID)
+
+  async def _tok(shard):
+    return _TOK
+
+  api._tokenizer_for = _tok
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  return node, api, client, runners
+
+
+@pytest.mark.asyncio
+async def test_router_tries_next_replica_on_429(monkeypatch):
+  """One replica's full queue is NOT cluster overload: the router moves on
+  to a survivor and the client never sees the refusal."""
+  app_full, served_full = _stub_replica_app("stub-full", refuse_429=True)
+  app_ok, served_ok = _stub_replica_app("stub-ok", tokens=(5, 6, 7))
+  node, api, client, runners = await _stub_router(monkeypatch, [("stub-full", app_full), ("stub-ok", app_ok)])
+  try:
+    resp = await client.post("/v1/chat/completions", json={"model": MODEL_ID, "messages": _messages("1 2 3 4", "5"), "max_tokens": 3})
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    assert data["choices"][0]["message"]["content"] == "5 6 7"
+    assert served_ok["n"] == 1
+  finally:
+    if api._router is not None:
+      await api._router.close()
+    await client.close()
+    await node.stop()
+    for r in runners:
+      await r.cleanup()
+
+
+@pytest.mark.asyncio
+async def test_router_relays_client_resume_and_refuses_images(monkeypatch):
+  """A client re-submitting the router's own terminal 503 contract gets its
+  ``resume_tokens`` RELAYED (carried downstream, never re-delivered, and
+  max_tokens NOT double-decremented — the client already sent the remaining
+  budget); image content gets an explicit 400, not model-less local
+  serving."""
+  app_ok, served = _stub_replica_app("stub-res", tokens=(7,))
+  node, api, client, runners = await _stub_router(monkeypatch, [("stub-res", app_ok)])
+  try:
+    body = {
+      "model": MODEL_ID, "messages": _messages("1 2 3 4", "5"),
+      "max_tokens": 3, "resume_tokens": [5, 6],
+    }
+    resp = await client.post("/v1/chat/completions", json=body)
+    assert resp.status == 200, await resp.text()
+    data = await resp.json()
+    assert data["choices"][0]["message"]["content"] == "7"  # carried span not re-delivered
+    fwd = served["bodies"][0]
+    assert fwd["resume_tokens"] == [5, 6] and fwd["max_tokens"] == 3 and fwd["token_stream"] is True
+    # Image content: explicit refusal (a model-less front door must not
+    # fall through to local serving).
+    img_msg = [{"role": "user", "content": [{"type": "image_url", "image_url": {"url": "data:image/png;base64,aGk="}}]}]
+    resp = await client.post("/v1/chat/completions", json={"model": "llava-1.5-7b-hf", "messages": img_msg})
+    assert resp.status == 400
+    assert "router" in (await resp.json())["error"]
+  finally:
+    if api._router is not None:
+      await api._router.close()
+    await client.close()
+    await node.stop()
+    for r in runners:
+      await r.cleanup()
+
+
+@pytest.mark.asyncio
+async def test_router_429_carries_cluster_retry_horizon(monkeypatch):
+  """Satellite: when the WHOLE fleet refuses, the relayed 429 carries the
+  CLUSTER retry horizon (the soonest any replica drains — 800 ms here),
+  not the refusing node's own 60 s estimate."""
+  app_a, _ = _stub_replica_app("stub-a", refuse_429=True, est_drain_ms=5000.0)
+  app_b, _ = _stub_replica_app("stub-b", refuse_429=True, est_drain_ms=800.0)
+  node, api, client, runners = await _stub_router(monkeypatch, [("stub-a", app_a), ("stub-b", app_b)])
+  try:
+    resp = await client.post("/v1/chat/completions", json={"model": MODEL_ID, "messages": _messages("1 2 3 4", "5"), "max_tokens": 3})
+    assert resp.status == 429
+    body = await resp.json()
+    assert body["error"]["type"] == "overloaded"
+    assert body["error"]["retry_after_ms"] == 800.0  # cluster horizon, not 60000
+    assert resp.headers["Retry-After"] == "1"
+  finally:
+    if api._router is not None:
+      await api._router.close()
+    await client.close()
+    await node.stop()
+    for r in runners:
+      await r.cleanup()
+
+
+@pytest.mark.asyncio
+async def test_router_off_is_byte_identical_serving(monkeypatch):
+  """XOT_TPU_ROUTER unset/0: no router is constructed and NO router code
+  runs on the request path (poisoned policy + transport never called)."""
+  from xotorch_support_jetson_tpu.api import router as api_router
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  monkeypatch.delenv("XOT_TPU_ROUTER", raising=False)
+  monkeypatch.delenv("XOT_TPU_ROUTER_REPLICAS", raising=False)
+
+  def poisoned(*a, **k):  # noqa: ANN001
+    raise AssertionError("router code ran with XOT_TPU_ROUTER off")
+
+  monkeypatch.setattr(api_router.ClusterRouter, "serve_chat", poisoned)
+  monkeypatch.setattr(router_policy.RouterPolicy, "choose", poisoned)
+
+  node = Node("rt-off-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None, RingMemoryWeightedPartitioningStrategy())
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", default_model="dummy")
+  assert api._router is None
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.post("/v1/chat/completions", json={"model": "dummy", "messages": [{"role": "user", "content": "hi"}], "max_tokens": 4})
+    assert resp.status == 200
+    resp = await client.get("/v1/router")
+    assert (await resp.json())["enabled"] is False
+  finally:
+    await client.close()
+    await node.stop()

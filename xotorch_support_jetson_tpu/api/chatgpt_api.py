@@ -356,6 +356,14 @@ class ChatGPTAPI:
     timeout_middleware = self._make_timeout_middleware()
     self.app.middlewares.extend([cors_middleware, timeout_middleware])
 
+    # Cluster front door (ISSUE 13): XOT_TPU_ROUTER=1 + XOT_TPU_ROUTER_REPLICAS
+    # turn this API into a prefix-affine multi-replica router that owns no
+    # model. None (the default) keeps the request path byte-identical: one
+    # ``is None`` check per chat request (test-pinned).
+    from .router import build_router
+
+    self._router = build_router(self)
+
     r = self.app.router
     r.add_post("/v1/chat/completions", self.handle_post_chat_completions)
     r.add_post("/chat/completions", self.handle_post_chat_completions)
@@ -374,6 +382,8 @@ class ChatGPTAPI:
     r.add_get("/v1/kv/tier", self.handle_kv_tier)
     r.add_get("/v1/disagg", self.handle_disagg)
     r.add_get("/v1/slo", self.handle_slo)
+    r.add_get("/v1/router", self.handle_router_state)
+    r.add_get("/v1/router/stats", self.handle_router_stats)
     r.add_get("/v1/events", self.handle_events)
     r.add_post("/v1/debug/bundle", self.handle_debug_bundle)
     r.add_post("/v1/profile", self.handle_profile)
@@ -603,6 +613,69 @@ class ChatGPTAPI:
       return slo_engine.report(node_id=getattr(self.node, "id", None))
 
     return web.json_response(await loop.run_in_executor(None, local_report))
+
+  async def handle_router_stats(self, request):
+    """GET /v1/router/stats — the replica-side advert a cluster router
+    polls (ISSUE 13): this node's live capacity/pressure aggregates (the
+    same numbers ``/metrics`` exports, read from the live scheduler so
+    multiple servers in one process stay distinct), the PR 5 deadline
+    estimator's queue-drain number, the latency medians, the fast-window
+    SLO burn per class, and the node's prefix advertisement (the chain-key
+    hexes whose KV this node can serve as a prefix hit). Served by every
+    node — cheap, no cluster fan-out."""
+    from ..inference import sched_admission
+    from ..inference.kv_tier import prefix_registry
+
+    node = self.node
+    st: dict = {
+      "node_id": getattr(node, "id", None),
+      "role": getattr(node, "disagg_role", sched_admission.node_role()),
+      "draining": bool(getattr(node, "draining", False)),
+    }
+    engine = getattr(node, "inference_engine", None)
+    shard = getattr(engine, "shard", None)
+    if shard is not None:
+      st["model"] = shard.model_id
+    server = getattr(engine, "_batched_server", None)
+    if server is not None:
+      st.update(server.stats_snapshot())
+      st["prefix_keys"] = server.prefix_hexes()
+    else:
+      # No live scheduler (cold node / non-batched engine): advertise what
+      # the process-global registry knows so the endpoint stays truthful.
+      st["prefix_keys"] = prefix_registry.local_hexes(limit=512)
+    for name, q in (("ttft_p50_ms", "ttft_seconds"), ("itl_p50_ms", "itl_seconds")):
+      v = metrics.quantile(q, 0.5)
+      if v is not None:
+        st[name] = round(v * 1e3, 3)
+    burn = {}
+    from ..inference.qos import PRIORITY_CLASSES
+    from ..orchestration.slo import slo_enabled, slo_windows_s
+
+    if slo_enabled():
+      fast = f"{int(slo_windows_s()[0])}s"
+      for cls in PRIORITY_CLASSES:
+        v = metrics.gauge_value("slo_burn_rate", labels={"class": cls, "window": fast})
+        if v is not None:
+          burn[cls] = v
+    st["slo_burn_fast"] = burn
+    return web.json_response(st)
+
+  async def handle_router_state(self, request):
+    """GET /v1/router — router-mode introspection: replica views (stats
+    age, advert freshness, load score), session-affinity occupancy, and
+    the routing counters. ``{"enabled": false}`` on a non-router node."""
+    if self._router is None:
+      return web.json_response({"enabled": False, "detail": "router mode off (XOT_TPU_ROUTER=0 or no replicas)"})
+    body = {
+      "enabled": True,
+      **self._router.policy.snapshot(),
+      "requests_total": metrics.counter_sum("router_requests_total"),
+      "prefix_hits_total": metrics.counter_sum("router_prefix_hits_total"),
+      "failovers_total": metrics.counter_value("router_failovers_total"),
+      "tenant_throttled_total": metrics.counter_sum("router_tenant_throttled_total"),
+    }
+    return web.json_response(body)
 
   async def handle_events(self, request):
     """GET /v1/events — query the flight recorder's wide-event ring
@@ -1512,11 +1585,29 @@ class ChatGPTAPI:
         tenant=qos_tenant,
         deadline_ms=qos_deadline_ms,
       )
+    # Resume semantics (ISSUE 13): ``resume_tokens`` marks a re-submitted
+    # continuation — the batched scheduler absorbs the carried tokens into
+    # the prompt (the PR 8 carry-resume mechanics) and emits only NEW
+    # tokens, so a router can splice an invisible failover. Requires the
+    # batched scheduler (the only path with carry semantics).
+    resume_tokens = data.get("resume_tokens")
+    if resume_tokens is not None:
+      if not isinstance(resume_tokens, list) or not all(isinstance(t, int) and not isinstance(t, bool) for t in resume_tokens):
+        return web.json_response({"error": "'resume_tokens' must be a list of integers"}, status=400)
+      # Router mode relays the carry to a replica (which enforces its own
+      # scheduler support); only LOCAL serving needs the batched scheduler.
+      if self._router is None and (os.getenv("XOT_TPU_BATCHED", "0") != "1" or not hasattr(self.node.inference_engine, "get_batched_server")):
+        return web.json_response({"error": "'resume_tokens' requires the batched scheduler (XOT_TPU_BATCHED=1)"}, status=400)
     initial_state = None
-    if images:
+    if images or resume_tokens:
       from ..inference.state import InferenceState
 
-      initial_state = InferenceState(extras={"images": images})
+      extras = {}
+      if images:
+        extras["images"] = images
+      if resume_tokens:
+        extras["resume_tokens"] = [int(t) for t in resume_tokens]
+      initial_state = InferenceState(extras=extras)
     # Truthful usage accounting (the reference reports none at all). Encoding
     # the prompt again costs one BPE pass — only pay it when usage will
     # actually be reported (blocking always; streaming only on request).
@@ -1525,11 +1616,29 @@ class ChatGPTAPI:
       return web.json_response({"error": "'stream_options' must be an object"}, status=400)
     include_usage = bool((stream_options or {}).get("include_usage"))
     need_usage = not chat_request.stream or include_usage
-    prompt_tokens = len(tokenizer.encode(prompt)) if need_usage and hasattr(tokenizer, "encode") else 0
+    # Router mode always encodes (the affinity hash needs the ids) and
+    # derives usage from that one pass — don't pay a second BPE here.
+    prompt_tokens = len(tokenizer.encode(prompt)) if need_usage and self._router is None and hasattr(tokenizer, "encode") else 0
     from ..inference.engine import PromptTooLongError, ServerOverloadedError
     from ..parallel.hbm_planner import RingBudgetError
+    from .router import RouterUpstreamHTTPError
 
     try:
+      if self._router is not None:
+        # Router mode (ISSUE 13): this node owns no model — the request is
+        # dispatched to a full-model replica chosen by the prefix-affinity
+        # ladder, with cluster-scoped tenant limits and invisible failover.
+        # The typed refusals surface through the same ladder below.
+        if chat_request.logprobs:
+          return web.json_response({"error": "'logprobs' is not supported through the router"}, status=400)
+        if images:
+          # Falling through would serve locally on a model-less node; an
+          # explicit refusal beats a confusing 500 (same shape as logprobs).
+          return web.json_response({"error": "image content is not supported through the router"}, status=400)
+        return await self._router.serve_chat(
+          request, data, chat_request, request_id, tokenizer, prompt, created,
+          (qos_priority, qos_tenant, qos_deadline_ms), include_usage,
+        )
       if chat_request.stream:
         # Generation runs CONCURRENTLY with the SSE stream: tokens flow to
         # the client as they arrive (TTFT = prefill, not full generation),
@@ -1537,6 +1646,10 @@ class ChatGPTAPI:
         # its batch slot / decode loop) instead of running to max_tokens.
         gen_task = asyncio.create_task(self.node.process_prompt(shard, prompt, request_id, inference_state=initial_state))
         try:
+          if data.get("token_stream"):
+            # Internal router protocol: raw token-id batches, no
+            # detokenization — the ROUTER decodes the merged stream once.
+            return await self._stream_token_response(request, request_id, gen_task)
           return await self._stream_response(request, chat_request, request_id, tokenizer, created, gen_task, prompt_tokens, include_usage)
         finally:
           if not gen_task.done():
@@ -1571,8 +1684,13 @@ class ChatGPTAPI:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
     except ServerOverloadedError as e:
       # Overload / rate-limit / deadline-shed: structured 429 + Retry-After
-      # (the QoS subclasses carry retry_after_ms from the drain estimate).
+      # (the QoS subclasses carry retry_after_ms from the drain estimate —
+      # or, through the router, the CLUSTER retry horizon).
       return overloaded_response(e)
+    except RouterUpstreamHTTPError as e:
+      # A replica refused with a non-retryable status: relay it verbatim —
+      # the router adds no failure modes of its own to client errors.
+      return web.json_response(e.body, status=e.status)
     except RingBudgetError as e:
       # Ahead-of-time refusal (node.py): the current ring cannot hold the
       # model — nothing was downloaded or loaded.
@@ -1710,6 +1828,46 @@ class ChatGPTAPI:
           "type": getattr(e, "error_type", "upstream_stalled"),
           "retryable": True,
           "tokens": [int(t) for t in all_tokens + (getattr(e, "tokens", None) or [])],
+        })
+      if DEBUG >= 1 and not isinstance(e, (asyncio.TimeoutError, RequestStalledError)):
+        import traceback
+
+        traceback.print_exc()
+      try:
+        await response.write(f"data: {json.dumps({'error': err_obj})}\n\n".encode())
+      except ConnectionResetError:
+        return response  # client already gone
+    await response.write(b"data: [DONE]\n\n")
+    await response.write_eof()
+    return response
+
+  async def _stream_token_response(self, request, request_id, gen_task):
+    """Internal token-stream SSE (ISSUE 13): raw token-id batches for a
+    cluster router — ``data: {"tokens": [...], "finished": bool}`` events,
+    ``data: [DONE]`` terminator. No detokenization, no stop strings (the
+    router owns both over the merged stream). Errors knowable before the
+    first batch propagate as proper HTTP statuses; a mid-stream stall
+    reports IN-BAND with the retryable contract, ``tokens`` carrying only
+    the UNDELIVERED batches (the router tracks what it already received)."""
+    tokens, is_finished = await self._next_tokens(request_id, gen_task)
+    response = web.StreamResponse(
+      status=200, reason="OK",
+      headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
+    )
+    await response.prepare(request)
+    try:
+      while True:
+        await response.write(f"data: {json.dumps({'tokens': [int(t) for t in tokens], 'finished': bool(is_finished)})}\n\n".encode())
+        if is_finished:
+          break
+        tokens, is_finished = await self._next_tokens(request_id, gen_task)
+    except Exception as e:  # noqa: BLE001 — response committed: report in-band
+      err_obj: dict = {"message": "Response generation timed out" if isinstance(e, asyncio.TimeoutError) else f"Error processing prompt: {e}"}
+      if isinstance(e, RequestStalledError):
+        err_obj.update({
+          "type": getattr(e, "error_type", "upstream_stalled"),
+          "retryable": True,
+          "tokens": [int(t) for t in (getattr(e, "tokens", None) or [])],
         })
       if DEBUG >= 1 and not isinstance(e, (asyncio.TimeoutError, RequestStalledError)):
         import traceback

@@ -1,0 +1,400 @@
+"""Cluster front door — transport half of router mode (ISSUE 13 tentpole).
+
+``inference/router_policy.py`` decides WHERE a request goes; this module
+moves the bytes: it polls each replica's ``GET /v1/router/stats`` (the
+cluster aggregates PR 2/9/10 already ship, plus its prefix advert), then
+serves every routed chat completion through a TOKEN PUMP:
+
+- The router always streams upstream in the internal token protocol
+  (``token_stream: true`` body flag → SSE events of raw token-id batches),
+  even for blocking client requests, so a replica death MID-GENERATION is
+  recoverable at any point.
+- The pump feeds the received batches into the SAME per-request token queue
+  the local serving path uses (``ChatGPTAPI.handle_tokens``), so the
+  existing SSE/blocking machinery — incremental detokenization, stop-string
+  hold-back, finish_reason, usage — serves the client unchanged. The
+  router, not the replica, detokenizes: the client stream is decoded ONCE
+  over the merged token sequence, so a failover splice is token-identical
+  by construction.
+- INVISIBLE FAILOVER: when the upstream dies (connection drop, wedged
+  read) or answers the stall watchdog's structured retryable 503/in-band
+  error (which carries the undelivered tokens — the PR 8 ``carry_tokens``
+  contract), the pump delivers the carried tokens to the client, picks a
+  survivor, and re-submits the REMAINDER with ``resume_tokens`` (the
+  replica absorbs them into the prompt via the scheduler's carry-resume
+  path and emits only the continuation). The client sees one unbroken
+  stream. Only when the failover budget (``XOT_TPU_ROUTER_RETRIES``) or
+  the replica set is exhausted does the router degrade to the structured
+  retryable 503 the watchdog contract already defines.
+
+TRUST: the router is the layer that makes per-tenant limits meaningful —
+it pins ``x-tenant-id`` downstream (the PR 5 trust note). Replicas behind
+a router should have their own per-node buckets disabled (or accept that
+both layers charge)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+from ..inference.engine import RequestStalledError, ServerOverloadedError
+from ..inference.router_policy import RouterPolicy, max_failovers, stats_ttl_s
+from ..utils.helpers import DEBUG
+from ..utils.metrics import metrics
+
+
+class RouterUpstreamHTTPError(Exception):
+  """A replica refused the forwarded request with a non-retryable HTTP
+  status: relayed to the client as-is (status + body)."""
+
+  def __init__(self, status: int, body: dict) -> None:
+    super().__init__(f"upstream status {status}")
+    self.status = int(status)
+    self.body = body if isinstance(body, dict) else {"error": str(body)}
+
+
+class _UpstreamLost(Exception):
+  """The upstream stream ended without a finish event (connection drop,
+  server kill, or a retryable stall error). ``tokens`` carries whatever the
+  failing replica generated but never delivered (the 503/in-band resume
+  payload)."""
+
+  def __init__(self, tokens: list | None = None) -> None:
+    super().__init__("upstream lost mid-stream")
+    self.tokens = list(tokens or [])
+
+
+# Router-only body fields that must not be forwarded verbatim (the router
+# re-derives or owns them): stream/stop are applied router-side, logprobs is
+# unsupported through the router (needs replica-side scoring of the final
+# text the router assembles).
+_STRIP_FIELDS = ("stream", "stream_options", "token_stream", "resume_tokens", "stop", "logprobs", "top_logprobs")
+
+
+class ClusterRouter:
+  """One per router-mode ``ChatGPTAPI``: owns the aiohttp client session,
+  the TTL-guarded stats refresh, and the failover pump."""
+
+  def __init__(self, api, policy: RouterPolicy | None = None) -> None:
+    self.api = api
+    self.policy = policy or RouterPolicy()
+    self._session = None
+    self._refresh_lock = asyncio.Lock()
+    self._t_refresh = 0.0
+    self._bg_refresh: asyncio.Task | None = None
+
+  async def maybe_refresh(self) -> None:
+    """TTL-gated stats refresh that never stalls dispatch once a view
+    exists: only the COLD first pull is awaited (affinity needs adverts to
+    exist at all); afterwards an expired TTL schedules the re-poll as a
+    background task and routing proceeds from the stale view — one dead
+    replica's pull timeout must not become every request's TTFT."""
+    now = time.monotonic()
+    if self._t_refresh and now - self._t_refresh <= stats_ttl_s():
+      return
+    if not self._t_refresh:
+      await self.refresh_stats()
+      return
+    if self._bg_refresh is None or self._bg_refresh.done():
+      self._bg_refresh = asyncio.create_task(self.refresh_stats())
+
+  async def close(self) -> None:
+    if self._bg_refresh is not None and not self._bg_refresh.done():
+      self._bg_refresh.cancel()
+      await asyncio.gather(self._bg_refresh, return_exceptions=True)
+    if self._session is not None:
+      await self._session.close()
+      self._session = None
+
+  async def _client(self):
+    import aiohttp
+
+    if self._session is None or self._session.closed:
+      self._session = aiohttp.ClientSession()
+    return self._session
+
+  # ------------------------------------------------------------ stats refresh
+
+  async def refresh_stats(self, force: bool = False) -> None:
+    """Pull ``/v1/router/stats`` from every replica (TTL-guarded; one
+    in-flight refresh at a time). A replica that doesn't answer keeps its
+    last view and is marked unreachable — the policy deprioritizes it
+    briefly instead of blocking routing."""
+    now = time.monotonic()
+    if not force and self._t_refresh and now - self._t_refresh <= stats_ttl_s():
+      return
+    async with self._refresh_lock:
+      now = time.monotonic()
+      if not force and self._t_refresh and now - self._t_refresh <= stats_ttl_s():
+        return
+      sess = await self._client()
+
+      async def pull(view) -> None:
+        import aiohttp
+
+        try:
+          async with sess.get(
+            view.url + "/v1/router/stats",
+            timeout=aiohttp.ClientTimeout(total=max(stats_ttl_s(), 1.0)),
+          ) as resp:
+            if resp.status != 200:
+              raise RuntimeError(f"stats status {resp.status}")
+            self.policy.update_stats(view.node_id, await resp.json())
+        except (Exception, asyncio.TimeoutError):  # noqa: BLE001 — a dead replica keeps its stale view
+          self.policy.mark_unreachable(view.node_id)
+          if DEBUG >= 2:
+            print(f"[router] stats pull from {view.node_id} failed")
+
+      await asyncio.gather(*(pull(v) for v in self.policy.replicas.values()))
+      self._t_refresh = time.monotonic()
+
+  # ------------------------------------------------------------- serving path
+
+  async def serve_chat(self, request, data, chat_request, request_id, tokenizer, prompt, created, qos, include_usage):
+    """Serve one chat completion through the cluster. Called from
+    ``handle_post_chat_completions`` inside its try/except/finally, so the
+    typed refusals raised here (RateLimitedError/ServerOverloadedError/
+    RequestStalledError/RouterUpstreamHTTPError) map to the same structured
+    responses as local serving."""
+    api = self.api
+    priority, tenant, deadline_ms = qos
+    # ONE encode serves the affinity hash, the tenant charge, AND usage
+    # accounting (the handler skips its own usage pass in router mode).
+    prompt_ids = [int(t) for t in tokenizer.encode(prompt)] if hasattr(tokenizer, "encode") else []
+    prompt_tokens = len(prompt_ids)
+    # Cluster-scoped tenant buckets: ONE logical charge for the whole fleet.
+    self.policy.check_tenant(tenant, len(prompt_ids))
+    served_any = False
+    try:
+      await self.maybe_refresh()
+      chain = self.policy.chain_keys_for(prompt_ids)
+
+      def on_first_tokens() -> None:
+        nonlocal served_any
+        served_any = True
+
+      pump = asyncio.create_task(
+        self._pump(request_id, data, chat_request, chain, qos, on_first_tokens)
+      )
+      if chat_request.stream:
+        try:
+          return await api._stream_response(request, chat_request, request_id, tokenizer, created, pump, prompt_tokens, include_usage)
+        finally:
+          if not pump.done():
+            pump.cancel()
+          await asyncio.gather(pump, return_exceptions=True)
+      try:
+        await api._await_generation(request_id, pump)
+      except (asyncio.TimeoutError, RequestStalledError):
+        pump.cancel()
+        await asyncio.gather(pump, return_exceptions=True)
+        raise
+      return await api._blocking_response(chat_request, request_id, tokenizer, created, prompt_tokens)
+    except Exception:
+      if not served_any:
+        # The cluster never served this request — whatever the refusal
+        # shape (overload relay, stall with zero tokens, timeout, transport
+        # loss): one refusal, one charge. A client's compliant retries
+        # during an outage must not drain its quota for zero service.
+        self.policy.refund_tenant(tenant, len(prompt_ids))
+      raise
+
+  async def _pump(self, request_id, data, chat_request, chain, qos, on_first_tokens) -> list:
+    """Drive the upstream token stream into the request's queue, failing
+    over transparently. Returns the full token list (the pump's task result
+    doubles as the generation task the API machinery awaits)."""
+    priority, tenant, deadline_ms = qos
+    api = self.api
+    policy = self.policy
+    t0 = asyncio.get_event_loop().time()
+    # A client re-submitting a terminal retryable 503 through the router
+    # (the contract the router itself hands out) seeds the carry: the span
+    # is relayed downstream but never re-delivered to the client, and the
+    # client's max_tokens is already the REMAINING budget (the node-level
+    # resume contract), so only tokens received DURING this routed request
+    # decrement it further.
+    pre_carried: list[int] = [int(t) for t in data.get("resume_tokens") or []]
+    received: list[int] = list(pre_carried)
+    tried: set[str] = set()
+    failovers = 0
+    refusal: RouterUpstreamHTTPError | None = None
+    while True:
+      target, source, hit_pages = policy.choose(chain, exclude=tried)
+      if target is None:
+        if len(received) > len(pre_carried):
+          # A committed, partially-delivered stream must keep the carry
+          # contract even when some replicas also refused along the way:
+          # the retryable 503 with the undelivered span outranks relaying
+          # an overload refusal the client cannot resume from.
+          raise RequestStalledError(
+            f"lost all serving replicas after {len(received)} tokens",
+            tokens=self._drain_queue(request_id),
+          )
+        if refusal is not None:
+          # Every eligible replica refused: relay the last refusal, but
+          # with the CLUSTER retry horizon (ISSUE 13 satellite) — the
+          # soonest ANY replica drains, not the refusing node's own rate.
+          err_body = (refusal.body or {}).get("error") or {}
+          err = ServerOverloadedError(str(err_body.get("message") or "all replicas refused"))
+          err.error_type = str(err_body.get("type") or "overloaded")
+          err.retry_after_ms = policy.cluster_retry_after_ms()
+          raise err
+        err = ServerOverloadedError("no serving replica available")
+        err.retry_after_ms = policy.cluster_retry_after_ms()
+        raise err
+      metrics.inc("router_requests_total", labels={"target": target})
+      if received == pre_carried and source in ("session", "advert"):
+        metrics.inc("router_prefix_hits_total", labels={"source": source})
+      policy.note_session(chain, target)
+      body = {k: v for k, v in data.items() if k not in _STRIP_FIELDS}
+      body["stream"] = True
+      body["token_stream"] = True
+      if received:
+        body["resume_tokens"] = [int(t) for t in received]
+        if chat_request.max_tokens is not None:
+          body["max_tokens"] = max(int(chat_request.max_tokens) - (len(received) - len(pre_carried)), 1)
+      headers = self._forward_headers(request_id, priority, tenant, deadline_ms, t0)
+      try:
+        async for tokens, finished in self._token_events(target, body, headers):
+          if tokens:
+            received.extend(tokens)
+            on_first_tokens()
+          await api.handle_tokens(request_id, tokens, finished)
+          if finished:
+            return received
+        raise _UpstreamLost()  # stream ended without a finish event
+      except RouterUpstreamHTTPError as e:
+        tried.add(target)
+        if e.status == 429:
+          # A full queue on ONE replica is not cluster overload: try the
+          # others first; only a fleet-wide refusal reaches the client.
+          refusal = e
+          continue
+        raise
+      except _UpstreamLost as e:
+        pending = [int(t) for t in e.tokens]
+      except (asyncio.CancelledError, RequestStalledError):
+        raise
+      except Exception as e:  # noqa: BLE001 — transport-level loss (conn refused/reset/timeout)
+        if DEBUG >= 1:
+          print(f"[router] upstream {target} lost for {request_id}: {type(e).__name__}: {e}")
+        pending = []
+      # Upstream lost mid-flight: deliver whatever it generated but never
+      # delivered (the resume payload), then re-submit the remainder to a
+      # survivor — the client stream just keeps going.
+      if pending:
+        received.extend(pending)
+        on_first_tokens()
+        await api.handle_tokens(request_id, pending, False)
+      tried.add(target)
+      policy.mark_unreachable(target)
+      if chat_request.max_tokens is not None and len(received) - len(pre_carried) >= int(chat_request.max_tokens):
+        # The lost replica had already delivered the client's full token
+        # budget — only the finished event went missing. Synthesize it
+        # instead of re-submitting: a survivor forced to emit ≥1 token
+        # (the resume floor) would overshoot max_tokens.
+        await api.handle_tokens(request_id, [], True)
+        return received
+      failovers += 1
+      if failovers > max_failovers():
+        raise RequestStalledError(
+          f"failover budget exhausted after {len(received)} tokens",
+          tokens=self._drain_queue(request_id),
+        )
+      metrics.inc("router_failovers_total")
+      if DEBUG >= 1:
+        print(f"[router] failing over {request_id} away from {target} ({len(received)} tokens carried)")
+
+  def _drain_queue(self, request_id: str) -> list[int]:
+    """Undelivered batches still sitting in the request's token queue — a
+    terminal retryable 503 must carry EVERYTHING the client never got (the
+    stall watchdog's contract), whether the loss happened upstream or in
+    the pump."""
+    pending: list[int] = []
+    queue = self.api.token_queues.get(request_id)
+    if queue is not None:
+      while not queue.empty():
+        toks, _fin = queue.get_nowait()
+        pending.extend(toks)
+    return pending
+
+  def _forward_headers(self, request_id, priority, tenant, deadline_ms, t0) -> dict:
+    from ..orchestration.tracing import tracer
+
+    headers = {"x-router-request-id": str(request_id)}
+    if tenant:
+      headers["x-tenant-id"] = str(tenant)
+    if priority:
+      headers["x-priority"] = str(priority)
+    if deadline_ms is not None:
+      # Ship the REMAINING end-to-end budget (the qos_wire decay rule): a
+      # failover re-submit must not grant the survivor a fresh full SLO.
+      elapsed_ms = (asyncio.get_event_loop().time() - t0) * 1e3
+      headers["x-deadline-ms"] = str(max(round(float(deadline_ms) - elapsed_ms, 3), 1.0))
+    try:
+      headers["traceparent"] = tracer.request_context(request_id).traceparent()
+    except Exception:  # noqa: BLE001 — tracing decoration is best-effort
+      pass
+    return headers
+
+  async def _token_events(self, target: str, body: dict, headers: dict):
+    """POST the internal token-stream request to ``target`` and yield
+    ``(tokens, finished)`` batches. Raises ``_UpstreamLost`` (with the
+    resume payload) on the retryable stall contract, and
+    ``RouterUpstreamHTTPError`` on non-retryable upstream statuses."""
+    import aiohttp
+
+    url = self.policy.url_of(target)
+    if url is None:
+      raise _UpstreamLost()
+    sess = await self._client()
+    stall = self.api._stall_after_s()
+    read_timeout = max(stall * 1.5, 10.0) if stall > 0 else None
+    timeout = aiohttp.ClientTimeout(total=None, sock_connect=5.0, sock_read=read_timeout)
+    async with sess.post(url + "/v1/chat/completions", json=body, headers=headers, timeout=timeout) as resp:
+      if resp.status != 200:
+        try:
+          payload = await resp.json()
+        except Exception:  # noqa: BLE001 — non-JSON error body
+          payload = {"error": {"message": await resp.text()}}
+        err = (payload or {}).get("error") or {}
+        if resp.status == 503 and err.get("retryable"):
+          # The stall watchdog's structured retryable 503: the resume
+          # payload is the failover's carry.
+          raise _UpstreamLost(tokens=err.get("tokens") or [])
+        raise RouterUpstreamHTTPError(resp.status, payload)
+      async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+          continue
+        payload = line[6:]
+        if payload == "[DONE]":
+          return
+        try:
+          obj = json.loads(payload)
+        except ValueError:
+          continue
+        err = obj.get("error")
+        if err is not None:
+          if err.get("retryable"):
+            raise _UpstreamLost(tokens=err.get("tokens") or [])
+          raise RouterUpstreamHTTPError(500, {"error": err})
+        yield [int(t) for t in obj.get("tokens") or []], bool(obj.get("finished"))
+
+
+def build_router(api) -> ClusterRouter | None:
+  """Construct the router for an API instance when router mode is on AND
+  replicas are configured; None otherwise (the request path then contains
+  exactly one ``is None`` check — the XOT_TPU_ROUTER=0 byte-identity pin)."""
+  from ..inference.router_policy import parse_replicas, router_enabled
+
+  if not router_enabled():
+    return None
+  replicas = parse_replicas()
+  if not replicas:
+    if os.getenv("XOT_TPU_ROUTER", ""):
+      print("[router] XOT_TPU_ROUTER=1 but XOT_TPU_ROUTER_REPLICAS is empty; serving locally")
+    return None
+  return ClusterRouter(api, RouterPolicy(replicas))

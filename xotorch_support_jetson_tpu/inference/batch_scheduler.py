@@ -863,6 +863,54 @@ class BatchedServer:
       for cls, depth in self.queue.class_depths().items():
         metrics.set_gauge("qos_queue_depth", depth, labels={"class": cls})
 
+  def stats_snapshot(self) -> dict:
+    """Live capacity/pressure aggregates for this scheduler — the payload a
+    replica advertises at ``GET /v1/router/stats`` (ISSUE 13). Read from
+    the live objects, not the process-global gauges, so multiple servers in
+    one process (tests, benches) each report their OWN state."""
+    busy = sum(1 for s in self.slots if s is not None)
+    depths = self.queue.class_depths() if self.qos is not None else {}
+    waiting = self.admission.waiting()
+    st = {
+      "slots_total": self.n_slots,
+      "slots_busy": busy,
+      "slots_free": self.n_slots - busy,
+      "queue_depth": dict(depths),
+      "queue_depth_total": waiting,
+      "prefilling": len(self._prefilling),
+      "parked": len(self._parked),
+      "page_size": self.page_size,
+      "draining": bool(self.draining),
+    }
+    if self.allocator is not None:
+      st["total_pages"] = max(self.allocator.n_pages - 1, 0)  # page 0 is the trash page
+      st["free_pages"] = self.allocator.n_available
+    if self.qos is not None:
+      est = self.qos.estimate_completion_ms(queue_depth=waiting, n_slots=self.n_slots, max_tokens=1)
+      if est is not None:
+        st["est_drain_ms"] = round(float(est), 1)
+    return st
+
+  def prefix_hexes(self, limit: int = 512) -> list[str]:
+    """Chain-key hexes THIS server can actually serve as a prefix hit —
+    device prefix cache first (newest donations first), then host-tier
+    entries. Per-server state (unlike the process-global
+    ``kv_tier.prefix_registry``), so a prefix-affinity router polling
+    several replicas in one process sees who truly holds what."""
+    keys: list[bytes] = []
+    seen: set[bytes] = set()
+    if self.allocator is not None:
+      for k in self.allocator.cached_keys():
+        if k not in seen:
+          seen.add(k)
+          keys.append(k)
+    if self.tier is not None:
+      for k in self.tier.host_keys():
+        if k not in seen:
+          seen.add(k)
+          keys.append(k)
+    return [k.hex() for k in keys[:limit]]
+
   def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
     # Mid-chunked-prefill rows are protected by ``taken``: _admit_pending
     # swaps _prefilling out and seeds taken with those rows before any

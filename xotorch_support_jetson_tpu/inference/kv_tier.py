@@ -69,6 +69,19 @@ def kv_tier_enabled() -> bool:
   return os.getenv("XOT_TPU_KV_TIER", "1") not in ("0", "false")
 
 
+def advert_ttl_s() -> float:
+  """``XOT_TPU_PREFIX_ADVERT_TTL_S`` (default 120 s; <= 0 disables expiry):
+  how long a peer's prefix advertisement stays trusted without a refresh.
+  Adverts already drop on peer disconnect, but a LONG-LIVED entry from a
+  peer that swapped weights or wrapped its pool can steer a prefix-affinity
+  router (ISSUE 13) toward KV that no longer exists — bounding advert age
+  turns that into one extra refresh pull instead of a misroute."""
+  try:
+    return float(os.getenv("XOT_TPU_PREFIX_ADVERT_TTL_S", "120") or 120)
+  except ValueError:
+    return 120.0
+
+
 def _bucket(n: int) -> int:
   b = 1
   while b < n:
@@ -411,6 +424,12 @@ class KvTierManager:
     with self._lock:
       return key in self._entries
 
+  def host_keys(self) -> list[bytes]:
+    """Chain keys host-resident right now, newest-first — the host half of
+    this node's prefix advertisement (``BatchedServer.prefix_hexes``)."""
+    with self._lock:
+      return list(reversed(self._entries))
+
   @property
   def host_pages(self) -> int:
     with self._lock:
@@ -465,13 +484,29 @@ class PrefixRegistry:
   or malicious advertisement can at worst misroute one request to a node
   that recomputes the prefill it hoped to skip. Entries also go stale
   benignly (eviction races the advert); the bounded LRU and
-  advert-replacement keep the registry from growing without limit."""
+  advert-replacement keep the registry from growing without limit.
 
-  def __init__(self, max_keys: int = MAX_REGISTRY_KEYS) -> None:
+  STALENESS (ISSUE 13 satellite): every remote advert carries its update
+  timestamp; once older than ``advert_ttl_s()`` it stops answering
+  ``locate`` (a wrapped-pool or weight-swapped peer must not keep steering
+  the router to dead KV) and shows up in ``stale_remote_ids()`` so the
+  owner can re-pull (``Node.collect_cluster_prefixes``) instead of serving
+  from the expired view."""
+
+  def __init__(self, max_keys: int = MAX_REGISTRY_KEYS, *, clock=time.monotonic) -> None:
     self.max_keys = max_keys
+    self._clock = clock
     self._local: "OrderedDict[bytes, None]" = OrderedDict()
     self._remote: dict[str, "OrderedDict[bytes, None]"] = {}
+    self._remote_ts: dict[str, float] = {}
     self._lock = threading.Lock()
+
+  def _fresh_locked(self, node_id: str) -> bool:
+    ttl = advert_ttl_s()
+    if ttl <= 0:
+      return True
+    ts = self._remote_ts.get(node_id)
+    return ts is not None and self._clock() - ts <= ttl
 
   def note(self, keys) -> None:
     """Record chain keys now resident locally (either tier)."""
@@ -500,21 +535,39 @@ class PrefixRegistry:
         continue  # a malformed advert key is dropped, not fatal
     with self._lock:
       self._remote[str(node_id)] = entries
+      self._remote_ts[str(node_id)] = self._clock()
 
   def forget_remote(self, node_id: str) -> None:
     with self._lock:
       self._remote.pop(str(node_id), None)
+      self._remote_ts.pop(str(node_id), None)
 
   def locate(self, key: bytes) -> list[str]:
-    """Peers advertising ``key`` (hints — see the class trust note)."""
+    """Peers advertising ``key`` (hints — see the class trust note). Peers
+    whose advert has outlived ``advert_ttl_s()`` never answer: an expired
+    advert is re-pulled, not trusted."""
     with self._lock:
-      return [nid for nid, entries in self._remote.items() if key in entries]
+      return [
+        nid for nid, entries in self._remote.items()
+        if key in entries and self._fresh_locked(nid)
+      ]
+
+  def stale_remote_ids(self) -> list[str]:
+    """Peers whose advert is past the TTL — the re-pull worklist (the node's
+    periodic loop and ``?scope=cluster`` refreshes consume this)."""
+    with self._lock:
+      return [nid for nid in self._remote if not self._fresh_locked(nid)]
 
   def snapshot(self) -> dict:
     with self._lock:
+      now = self._clock()
       return {
         "local_keys": len(self._local),
         "remote": {nid: len(entries) for nid, entries in self._remote.items()},
+        "remote_age_s": {
+          nid: round(now - ts, 3) for nid, ts in self._remote_ts.items()
+        },
+        "stale": [nid for nid in self._remote if not self._fresh_locked(nid)],
       }
 
   def clear_local(self) -> None:
@@ -528,6 +581,7 @@ class PrefixRegistry:
     with self._lock:
       self._local.clear()
       self._remote.clear()
+      self._remote_ts.clear()
 
 
 prefix_registry = PrefixRegistry()

@@ -357,6 +357,13 @@ class PageAllocator:
   def n_available(self) -> int:
     return len(self._free) + len(self._lru)
 
+  def cached_keys(self) -> list[bytes]:
+    """Chain keys currently device-cached (shared prefix pages), newest
+    first — the device half of this node's prefix advertisement (the host
+    half lives in the KV tier). Insertion order approximates recency:
+    donations append as requests finish."""
+    return list(reversed(self._by_key))
+
   def alloc(self, n: int) -> list[int] | None:
     """n fresh private pages, evicting idle cached pages if needed; None if
     even eviction can't cover it (caller backpressures). Evictions run as

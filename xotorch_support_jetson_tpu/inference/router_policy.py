@@ -1,0 +1,350 @@
+"""Routing policy for the cluster front door (ISSUE 13 tentpole).
+
+``XOT_TPU_ROUTER=1`` turns a ``chatgpt_api.py`` instance into an API-only
+node that owns no model: it spreads chat sessions across FULL-MODEL replicas
+instead of serving locally. This module is the policy half — pure decisions
+over advertised replica aggregates, no HTTP, no device code (the transport
+mechanics live in ``api/router.py``; the layering gate
+``scripts/check_layering.py`` keeps this module off the device-execution
+scheduler and the networking transport, the same split discipline as
+``sched_admission.py``).
+
+Decision ladder per request (first hit wins):
+
+1. SESSION AFFINITY — a bounded LRU of chain-key → replica recording where
+   each routed prompt landed. A follow-up turn's prompt EXTENDS the
+   previous turn's prompt, so its page-aligned chain keys contain the
+   previous prompt's keys as a prefix: the lookup walks the new prompt's
+   keys longest-first and sticks to the replica that served the session,
+   with no advert round-trip on the hot path.
+
+2. PREFIX AFFINITY — the prompt's page-aligned prefix chain
+   (``PageAllocator.chain_keys``, the same content-addressed hashes the KV
+   tier advertises) matched against each replica's advertised prefix keys
+   (``/v1/router/stats`` → ``BatchedServer.prefix_hexes``): the request
+   lands where its system-prompt / multi-turn KV already sits and prefill
+   skips those pages instead of recomputing them somewhere random. Adverts
+   are HINTS with a TTL (``kv_tier.advert_ttl_s``): a stale advert stops
+   steering and costs at worst one recomputed prefill, never correctness.
+
+3. WEIGHTED-LEAST-LOADED fallback — ``sched_admission.load_score`` over
+   the advertised aggregates (slot occupancy, queue pressure, page-pool
+   pressure, fast-window SLO burn): the same scoring the N×M disagg role
+   pools rank with.
+
+CLUSTER-SCOPED TENANT LIMITS: each replica's own token buckets are
+per-node, so a tenant hitting N nodes directly gets N× its quota (the PR 5
+trust-gap note). The router holds ONE logical bucket set
+(``qos.QosPolicy`` with the same ``XOT_TPU_QOS_RPS``/``_TPS``/``_TENANTS``
+knobs, now meaning CLUSTER aggregate quota) and stamps ``x-tenant-id``
+downstream, so the per-replica buckets can be disabled behind it. Refusals
+carry the CLUSTER retry horizon — the soonest ANY replica drains — not one
+node's view.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from ..utils.metrics import metrics
+from . import sched_admission
+from .kv_tier import advert_ttl_s
+from .paging import PageAllocator
+from .qos import QosPolicy, RateLimitedError
+
+
+def router_enabled() -> bool:
+  """``XOT_TPU_ROUTER=1`` opts into router mode. Unset or ``0`` is
+  byte-identical serving (test-pinned: no router object is constructed and
+  no router code runs on the request path)."""
+  return os.getenv("XOT_TPU_ROUTER", "0") not in ("0", "false", "")
+
+
+def affinity_enabled() -> bool:
+  """``XOT_TPU_ROUTER_AFFINITY=0`` disables the session/prefix affinity
+  steps (pure weighted-least-loaded) — the bench A/B's "random" arm and an
+  operator escape hatch."""
+  return os.getenv("XOT_TPU_ROUTER_AFFINITY", "1") not in ("0", "false")
+
+
+def parse_replicas(raw: str | None = None) -> dict[str, str]:
+  """``XOT_TPU_ROUTER_REPLICAS`` → {replica_id: base_url}. Entries are
+  comma-separated ``id=http://host:port`` pairs; a bare URL derives its id
+  from ``host:port``. Trailing slashes are stripped so path joins are
+  uniform."""
+  raw = os.getenv("XOT_TPU_ROUTER_REPLICAS", "") if raw is None else raw
+  out: dict[str, str] = {}
+  for entry in (raw or "").split(","):
+    entry = entry.strip()
+    if not entry:
+      continue
+    if "=" in entry and not entry.split("=", 1)[0].startswith(("http:", "https:")):
+      rid, url = entry.split("=", 1)
+    else:
+      url = entry
+      rid = url.split("://", 1)[-1].strip("/")
+    url = url.strip().rstrip("/")
+    rid = rid.strip()
+    if rid and url:
+      out[rid] = url
+  return out
+
+
+def _env_f(name: str, default: float) -> float:
+  try:
+    return float(os.getenv(name, "") or default)
+  except ValueError:
+    return default
+
+
+def stats_ttl_s() -> float:
+  """How long a replica stats pull stays fresh before the router re-polls
+  (``XOT_TPU_ROUTER_STATS_TTL_S``, default 2 s)."""
+  return max(_env_f("XOT_TPU_ROUTER_STATS_TTL_S", 2.0), 0.0)
+
+
+def max_failovers() -> int:
+  """Transparent re-submits per request before the router degrades to the
+  structured retryable 503 (``XOT_TPU_ROUTER_RETRIES``, default 2)."""
+  try:
+    return max(int(os.getenv("XOT_TPU_ROUTER_RETRIES", "2") or 2), 0)
+  except ValueError:
+    return 2
+
+
+MAX_SESSIONS = 4096  # chain-key → replica LRU bound (client-driven keyspace)
+UNREACHABLE_COOLDOWN_S = 5.0  # deprioritize a just-failed replica briefly
+
+
+class ReplicaView:
+  """Latest advertised state of one replica (stats + prefix advert)."""
+
+  __slots__ = ("node_id", "url", "stats", "prefix", "t_stats", "t_unreachable")
+
+  def __init__(self, node_id: str, url: str) -> None:
+    self.node_id = node_id
+    self.url = url
+    self.stats: dict = {}
+    self.prefix: set[bytes] = set()
+    self.t_stats = 0.0  # 0 = never pulled
+    self.t_unreachable = 0.0
+
+  def advert_fresh(self, now: float) -> bool:
+    ttl = advert_ttl_s()
+    if self.t_stats <= 0.0:
+      return False
+    return ttl <= 0 or now - self.t_stats <= ttl
+
+
+class RouterPolicy:
+  """The front door's routing brain: replica views, the affinity ladder,
+  the shared load scoring, and the cluster-scoped tenant buckets.
+
+  Thread-safe for the (rare) concurrent readers; all mutation happens on
+  the API event loop. ``clock`` is injectable for deterministic tests."""
+
+  def __init__(self, replicas: dict[str, str] | None = None, *, clock=time.monotonic) -> None:
+    self.clock = clock
+    self.replicas: dict[str, ReplicaView] = {
+      rid: ReplicaView(rid, url) for rid, url in (replicas if replicas is not None else parse_replicas()).items()
+    }
+    # ONE logical bucket set for the whole cluster (the same knobs the
+    # per-node QoS layer reads, reinterpreted as aggregate quota).
+    self.limits = QosPolicy.from_env()
+    self._sessions: "OrderedDict[bytes, str]" = OrderedDict()
+    self._rr = 0  # round-robin cursor for load-score ties
+    self._lock = threading.Lock()
+
+  # ------------------------------------------------------------ replica state
+
+  def url_of(self, node_id: str) -> str | None:
+    view = self.replicas.get(node_id)
+    return view.url if view else None
+
+  def update_stats(self, node_id: str, stats: dict) -> None:
+    view = self.replicas.get(node_id)
+    if view is None:
+      return
+    view.stats = dict(stats or {})
+    keys: set[bytes] = set()
+    for h in (stats or {}).get("prefix_keys") or []:
+      try:
+        keys.add(bytes.fromhex(h))
+      except (ValueError, TypeError):
+        continue  # a malformed advert key is dropped, not fatal
+    view.prefix = keys
+    view.t_stats = self.clock()
+    view.t_unreachable = 0.0
+
+  def mark_unreachable(self, node_id: str) -> None:
+    view = self.replicas.get(node_id)
+    if view is not None:
+      view.t_unreachable = self.clock()
+
+  def eligible(self, exclude: set[str] | frozenset = frozenset()) -> list[ReplicaView]:
+    """Replicas a request may be dispatched to: not excluded (already tried
+    this request), not draining per their last advert, and not inside the
+    unreachable cooldown — unless that empties the set, in which case
+    cooled-down replicas come back (trying beats refusing)."""
+    now = self.clock()
+    views = [v for v in self.replicas.values() if v.node_id not in exclude and not v.stats.get("draining")]
+    warm = [v for v in views if not v.t_unreachable or now - v.t_unreachable > UNREACHABLE_COOLDOWN_S]
+    return warm or views
+
+  # ---------------------------------------------------------------- affinity
+
+  def page_size(self) -> int:
+    for view in self.replicas.values():
+      ps = view.stats.get("page_size")
+      if ps:
+        return int(ps)
+    try:
+      return int(os.getenv("XOT_TPU_PAGE_SIZE", "64") or 64)
+    except ValueError:
+      return 64
+
+  def chain_keys_for(self, prompt_ids) -> list[bytes]:
+    """The prompt's page-aligned prefix chain — the SAME content-addressed
+    hashes the replicas' page allocators compute, so advert matches mean
+    resident KV (page size must be uniform across the fleet; replicas
+    advertise theirs)."""
+    if not prompt_ids:
+      return []
+    return PageAllocator.chain_keys(list(prompt_ids), self.page_size())
+
+  def note_session(self, chain_keys: list[bytes], node_id: str) -> None:
+    """Record where this prompt landed: every full-page chain key maps to
+    the serving replica, so the follow-up turn (whose prompt extends this
+    one) sticks without waiting for an advert refresh."""
+    if not chain_keys:
+      return
+    with self._lock:
+      for key in chain_keys:
+        self._sessions.pop(key, None)
+        self._sessions[key] = node_id
+      while len(self._sessions) > MAX_SESSIONS:
+        self._sessions.popitem(last=False)
+
+  def _session_hit(self, chain_keys: list[bytes], views: list[ReplicaView]) -> tuple[str, int] | None:
+    by_id = {v.node_id: v for v in views}
+    with self._lock:
+      for i in range(len(chain_keys) - 1, -1, -1):
+        nid = self._sessions.get(chain_keys[i])
+        if nid is not None and nid in by_id:
+          return nid, i + 1
+    return None
+
+  def _advert_hit(self, chain_keys: list[bytes], views: list[ReplicaView]) -> tuple[str, int] | None:
+    """Replica with the LONGEST advertised leading run of the prompt's
+    chain; load score breaks ties. Only TTL-fresh adverts steer."""
+    now = self.clock()
+    best: tuple[int, float, str] | None = None  # (-match, load, nid)
+    for view in views:
+      if not view.advert_fresh(now) or not view.prefix:
+        continue
+      match = 0
+      for key in chain_keys:
+        if key not in view.prefix:
+          break
+        match += 1
+      if match <= 0:
+        continue
+      cand = (-match, sched_admission.load_score(view.stats), view.node_id)
+      if best is None or cand < best:
+        best = cand
+    if best is None:
+      return None
+    return best[2], -best[0]
+
+  def choose(self, chain_keys: list[bytes], exclude: set[str] | frozenset = frozenset()) -> tuple[str | None, str, int]:
+    """→ (replica_id | None, source, matched_pages). ``source`` ∈
+    {"session", "advert", "load"}; None means no eligible replica."""
+    views = self.eligible(exclude)
+    if not views:
+      return None, "none", 0
+    if affinity_enabled() and chain_keys:
+      hit = self._session_hit(chain_keys, views)
+      if hit is not None:
+        return hit[0], "session", hit[1]
+      hit = self._advert_hit(chain_keys, views)
+      if hit is not None:
+        return hit[0], "advert", hit[1]
+    # Weighted-least-loaded fallback. Ties rotate round-robin: an idle
+    # fleet must SPREAD fresh sessions across replicas, not dogpile the
+    # lexicographically-first one (which would also accidentally re-create
+    # affinity when measuring the affinity-off baseline).
+    scored = sorted(views, key=lambda v: (sched_admission.load_score(v.stats), v.node_id))
+    ties = [v for v in scored if sched_admission.load_score(v.stats) - sched_admission.load_score(scored[0].stats) <= 1e-9]
+    pick = ties[self._rr % len(ties)]
+    self._rr += 1
+    return pick.node_id, "load", 0
+
+  # ------------------------------------------------- cluster tenant limits
+
+  def check_tenant(self, tenant: str | None, prompt_tokens: int) -> None:
+    """Charge the CLUSTER-scoped buckets; raises ``RateLimitedError`` when
+    over the aggregate quota. The per-request horizon is the bucket refill
+    math (exact for rate limits); overload refusals use
+    ``cluster_retry_after_ms`` instead."""
+    try:
+      self.limits.check_rate(tenant or "default", prompt_tokens)
+    except RateLimitedError:
+      metrics.inc("router_tenant_throttled_total", labels={"tenant": tenant or "default"})
+      raise
+
+  def refund_tenant(self, tenant: str | None, prompt_tokens: int) -> None:
+    """One refusal, one charge (the PR 5 contract): a request the cluster
+    never served gives its bucket charge back."""
+    self.limits.refund(tenant or "default", prompt_tokens)
+
+  def cluster_retry_after_ms(self) -> float:
+    """The CLUSTER retry horizon (ISSUE 13 satellite): the soonest ANY
+    replica is expected to free capacity — min over replicas of its
+    advertised drain estimate (or TTFT-scaled queue depth) — rather than
+    the refusing node's own drain rate. 1 s floor when no replica has
+    advertised anything yet (cold overload: something is still wrong)."""
+    views = [v for v in self.replicas.values() if v.stats and not v.stats.get("draining")]
+    # All-draining is still a horizon source — better a drain-tinged hint
+    # than the cold 1 s floor.
+    views = views or [v for v in self.replicas.values() if v.stats]
+    horizons: list[float] = []
+    for view in views:
+      st = view.stats
+      est = st.get("est_drain_ms")
+      if est is not None:
+        horizons.append(float(est))
+        continue
+      ttft = st.get("ttft_p50_ms")
+      if ttft is not None:
+        waiting = st.get("queue_depth_total", 0) or 0
+        slots = st.get("slots_total") or 1
+        horizons.append(float(ttft) * (1.0 + float(waiting) / max(slots, 1)))
+    if not horizons:
+      return 1000.0
+    return max(min(horizons), 50.0)
+
+  # ------------------------------------------------------------------ admin
+
+  def snapshot(self) -> dict:
+    now = self.clock()
+    with self._lock:
+      sessions = len(self._sessions)
+    return {
+      "affinity": affinity_enabled(),
+      "sessions": sessions,
+      "replicas": {
+        v.node_id: {
+          "url": v.url,
+          "stats_age_s": round(now - v.t_stats, 3) if v.t_stats else None,
+          "advert_fresh": v.advert_fresh(now),
+          "prefix_keys": len(v.prefix),
+          "draining": bool(v.stats.get("draining")),
+          "load_score": round(sched_admission.load_score(v.stats), 4) if v.stats else None,
+          "unreachable": bool(v.t_unreachable and now - v.t_unreachable <= UNREACHABLE_COOLDOWN_S),
+        }
+        for v in self.replicas.values()
+      },
+    }

@@ -289,17 +289,58 @@ def disagg_enabled() -> bool:
   return os.getenv("XOT_TPU_DISAGG", "0") not in ("0", "false", "")
 
 
-def choose_decode_node(stats: dict[str, dict], *, self_id: str, self_role: str | None = None) -> str | None:
-  """Pick the decode node for a freshly prefilled request (ISSUE 10): most
-  free pages first, class queue depth as the tie-break — the node whose pool
-  can adopt the streamed KV and whose decode batch is least contended.
+def replica_load_key(st: dict) -> tuple:
+  """Per-replica load ordering key shared by every pool ranking (smaller =
+  less loaded): most free pages first, queue depth as the tie-break.
+
+  Unknown capacity (no advertised ``free_pages`` — no batched server yet,
+  or a non-paged pool) ranks LAST: a peer advertising real free pages must
+  never lose to one whose pool may not even exist — it still wins when it
+  is the only candidate (a fresh decode node before its first row)."""
+  free = st.get("free_pages")
+  depth = st.get("queue_depth", 0) or 0
+  free_rank = -free if free is not None else 1
+  return (free_rank, depth, load_score(st))
+
+
+def load_score(st: dict) -> float:
+  """Weighted-least-loaded scalar over a replica's advertised aggregates —
+  the ONE scoring both the role-pool placement below and the cluster
+  router (``inference/router_policy.py``, ISSUE 13) rank candidates with.
+  Blends slot occupancy, queue pressure per slot, page-pool pressure, and
+  the fast-window SLO burn (each term normalized to ~[0, 1]; missing
+  aggregates contribute a pessimistic middle so a silent peer never looks
+  idle). Lower is less loaded."""
+  slots = st.get("slots_total") or 0
+  busy = st.get("slots_busy", 0) or 0
+  occ = (busy / slots) if slots else 0.5
+  waiting = st.get("queue_depth_total")
+  if waiting is None:
+    qd = st.get("queue_depth", 0) or 0
+    waiting = sum(qd.values()) if isinstance(qd, dict) else qd
+  queue_pressure = min(float(waiting) / max(slots, 1), 4.0) / 4.0
+  total = st.get("total_pages") or 0
+  free = st.get("free_pages")
+  page_pressure = (1.0 - free / total) if (total and free is not None) else 0.5
+  burn = st.get("slo_burn_fast") or 0.0
+  if isinstance(burn, dict):
+    burn = max((float(v) for v in burn.values()), default=0.0)
+  burn = min(float(burn), 10.0) / 10.0
+  return 1.0 * occ + 0.75 * queue_pressure + 0.5 * page_pressure + 0.25 * burn
+
+
+def rank_decode_nodes(stats: dict[str, dict], *, self_id: str, self_role: str | None = None) -> list[str]:
+  """Rank the DECODE role pool for a freshly prefilled request (ISSUE 10,
+  generalized to N-node pools in ISSUE 13): dedicated ``decode`` nodes
+  always outrank ``both`` nodes, ``replica_load_key`` orders inside each
+  tier (most free pages, then class queue depth, then the shared load
+  score). A ``both`` node only hands off to DEDICATED decode peers (two
+  ``both`` nodes would otherwise ping-pong every request).
 
   ``stats`` maps node_id → the peer's advertised ``{role, free_pages,
   queue_depth, slots_free}`` (see ``orchestration/node.py`` disagg_stats).
-  Dedicated ``decode`` nodes always outrank ``both`` nodes; a ``both`` node
-  only hands off to DEDICATED decode peers (two ``both`` nodes would
-  otherwise ping-pong every request). Returns None — serve colocated — when
-  no eligible peer exists."""
+  Callers take the head as the placement and may walk the tail as
+  fallbacks."""
   self_role = self_role or node_role()
   cands = []
   for nid, st in stats.items():
@@ -310,24 +351,23 @@ def choose_decode_node(stats: dict[str, dict], *, self_id: str, self_role: str |
       continue
     if role == "both" and self_role == "both":
       continue  # symmetric colocated peers: no handoff churn
-    free = st.get("free_pages")
-    depth = st.get("queue_depth", 0) or 0
-    # Unknown capacity (no batched server yet, or a non-paged pool) ranks
-    # LAST within its role tier: a peer advertising real free pages must
-    # never lose to one whose pool may not even exist — it still wins when
-    # it is the only candidate (a fresh decode node before its first row).
-    free_rank = -free if free is not None else 1
-    cands.append((0 if role == "decode" else 1, free_rank, depth, nid))
-  if not cands:
-    return None
-  return min(cands)[3]
+    cands.append((0 if role == "decode" else 1, *replica_load_key(st), nid))
+  return [c[-1] for c in sorted(cands)]
 
 
-def choose_prefill_node(stats: dict[str, dict], *, self_id: str) -> str | None:
-  """Pick the prefill node a decode-role node forwards a fresh prompt to:
-  smallest estimated queue drain (the PR 5 deadline estimator's number,
-  advertised as ``est_drain_ms``), queue depth as the fallback ordering when
-  no estimate exists yet (cold histograms)."""
+def choose_decode_node(stats: dict[str, dict], *, self_id: str, self_role: str | None = None) -> str | None:
+  """Head of ``rank_decode_nodes`` — None (serve colocated) when no
+  eligible peer exists."""
+  ranked = rank_decode_nodes(stats, self_id=self_id, self_role=self_role)
+  return ranked[0] if ranked else None
+
+
+def rank_prefill_nodes(stats: dict[str, dict], *, self_id: str) -> list[str]:
+  """Rank the PREFILL role pool a decode-role node forwards fresh prompts
+  to: smallest estimated queue drain first (the PR 5 deadline estimator's
+  number, advertised as ``est_drain_ms``), queue depth scaled as a
+  pseudo-estimate when no estimate exists yet (cold histograms), the shared
+  load score breaking exact ties."""
   cands = []
   for nid, st in stats.items():
     if nid == self_id:
@@ -337,7 +377,11 @@ def choose_prefill_node(stats: dict[str, dict], *, self_id: str) -> str | None:
       continue
     est = st.get("est_drain_ms")
     depth = st.get("queue_depth", 0) or 0
-    cands.append((0 if role == "prefill" else 1, est if est is not None else float(depth) * 1e3, depth, nid))
-  if not cands:
-    return None
-  return min(cands)[3]
+    cands.append((0 if role == "prefill" else 1, est if est is not None else float(depth) * 1e3, depth, load_score(st), nid))
+  return [c[-1] for c in sorted(cands)]
+
+
+def choose_prefill_node(stats: dict[str, dict], *, self_id: str) -> str | None:
+  """Head of ``rank_prefill_nodes`` — None when no eligible peer exists."""
+  ranked = rank_prefill_nodes(stats, self_id=self_id)
+  return ranked[0] if ranked else None

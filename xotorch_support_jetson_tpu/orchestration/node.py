@@ -51,6 +51,15 @@ from .tracing import merge_cluster_timeline, tracer
 # so zombie broadcasts arriving within any live client's window stay deduped.
 RESPONSE_TIMEOUT_HORIZON_S = 900.0
 
+
+def _resume_tokens_of(state: InferenceState | None) -> list | None:
+  """API-level resume payload (ISSUE 13): tokens a router carried over from
+  a failed replica, to be absorbed into the prompt (carry semantics)."""
+  if state is None:
+    return None
+  toks = state.extras.get("resume_tokens")
+  return list(toks) if toks else None
+
 # A held ahead-of-mark chunk waits this long for the gap to fill before the
 # stream force-flushes in position order: one LOST broadcast RPC then costs a
 # visible gap after a short stall instead of hanging the client forever.
@@ -809,13 +818,16 @@ class Node:
       full = Shard(base_shard.model_id, 0, base_shard.n_layers - 1, base_shard.n_layers)
       if not wire_concrete and self.disagg_role == "decode" and self.peers:
         stats = await self._disagg_stats_fresh()
-        target_id = sched_admission.choose_prefill_node(stats, self_id=self.id)
-        peer = next((p for p in self.peers if p.id() == target_id), None) if target_id else None
-        if peer is not None and not self._peer_draining(target_id):
-          await peer.send_prompt(full, prompt, request_id, self._stash_options(request_id, inference_state))
-          return None
+        # N-node prefill pool (ISSUE 13): walk the ranked candidates so a
+        # draining/desynced head doesn't force a colocated degrade while a
+        # healthy second-choice prefill node exists.
+        for target_id in sched_admission.rank_prefill_nodes(stats, self_id=self.id):
+          peer = next((p for p in self.peers if p.id() == target_id), None)
+          if peer is not None and not self._peer_draining(target_id):
+            await peer.send_prompt(full, prompt, request_id, self._stash_options(request_id, inference_state))
+            return None
         # No prefill peer reachable: degrade to serving colocated here.
-      return await self._batched_serve(full, full, prompt, request_id)
+      return await self._batched_serve(full, full, prompt, request_id, resume_tokens=_resume_tokens_of(inference_state))
     if not shard.is_first_layer:
       # Not the ring head: route the prompt to whichever node owns layer 0,
       # retrying once over a refreshed topology if the head just left.
@@ -852,7 +864,7 @@ class Node:
       # Continuous batching (inference/batch_scheduler.py): this node owns the
       # whole model, so concurrent requests share fused decode chunks — decode
       # is weight-bandwidth-bound, so B in-flight requests cost ≈ 1.
-      return await self._batched_serve(base_shard, shard, prompt, request_id)
+      return await self._batched_serve(base_shard, shard, prompt, request_id, resume_tokens=_resume_tokens_of(inference_state))
     self.outstanding_requests[request_id] = "processing"
     tracer.stage(request_id, "admitted", {"node_id": self.id}, node=self.id)
     tracer.stage(request_id, "prefill_chunk", {"node_id": self.id}, node=self.id)
@@ -860,7 +872,7 @@ class Node:
     await self.process_inference_result(base_shard, output, request_id, state, shard=shard)
     return output
 
-  async def _batched_serve(self, base_shard: Shard, shard: Shard, prompt: str, request_id: str) -> None:
+  async def _batched_serve(self, base_shard: Shard, shard: Shard, prompt: str, request_id: str, resume_tokens: list | None = None) -> None:
     engine = self.inference_engine
     self.outstanding_requests[request_id] = "processing"
     tokens = await engine.encode(shard, prompt)
@@ -871,10 +883,23 @@ class Node:
     # path with no node); pre-claim the choke-point observation so the same
     # request isn't counted twice.
     self._ttft_observed.add(request_id)
+    # API-level resume (ISSUE 13): a router re-submitting a failed-over
+    # request ships the tokens the client already has — the prompt absorbs
+    # them (the scheduler's carry contract), emit skips them, and absolute
+    # stream positions offset past them so any broadcast dedup splices.
+    carried = [int(t) for t in (resume_tokens or [])]
+    if carried:
+      tokens = np.concatenate([np.asarray(tokens, np.int32).reshape(-1), np.asarray(carried, np.int32)])
+      # The carried span was already DELIVERED to the client by whoever is
+      # re-submitting (the router's failover contract) — seed the absolute-
+      # position high-water there, or the dedup would hold the continuation
+      # as an out-of-order chunk until the GAP_FLUSH_S timer fired.
+      self._emitted_counts[request_id] = max(self._emitted_counts.get(request_id, 0), len(carried))
+    offset = len(carried)
 
     def emit(rid: str, new_tokens: list, finished: bool) -> None:
       buffered, _ = self.buffered_token_output.get(rid, ([], False))
-      start = len(buffered)
+      start = offset + len(buffered)
       buffered.extend(new_tokens)
       self.buffered_token_output[rid] = (buffered, finished)
       for _ in new_tokens:
@@ -897,6 +922,7 @@ class Node:
         request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
         priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
         deadline_ms=opts.get("deadline_ms"), disagg_target=disagg_target,
+        carry=carried or None,
       )
     except RequestMigratedError:
       # A draining scheduler shipped the row to a surviving peer (graceful
@@ -2065,6 +2091,12 @@ class Node:
           # completing early, and its 1 s timeout must not stall the shared
           # periodic loop (clock sync + SLO tick run right after this).
           asyncio.create_task(self.collect_disagg_stats(timeout=1.0))
+        if self.peers and prefix_registry.stale_remote_ids():
+          # Prefix-advert staleness guard (ISSUE 13 satellite): an advert
+          # past XOT_TPU_PREFIX_ADVERT_TTL_S stops steering placement
+          # (``locate`` skips it) — re-pull so a live peer's advert comes
+          # back fresh instead of aging out into routing blindness.
+          asyncio.create_task(self.collect_cluster_prefixes(timeout=1.0))
         if slo_enabled():
           # SLO windows stay fresh without a dedicated timer (the engine
           # self-gates to its tick interval); the anomaly watchers run on

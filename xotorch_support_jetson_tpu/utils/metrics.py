@@ -199,6 +199,17 @@ class Metrics:
         return self._labeled_counters.get(name, {}).get(_label_key(labels), 0.0)
       return self.counters.get(name, 0.0)
 
+  def gauge_value(self, name: str, labels: dict | None = None) -> float | None:
+    """Current value of a gauge series (None when never set) — the labeled
+    counterpart of reading ``gauges[name]`` directly."""
+    with self._lock:
+      if labels:
+        series = self._labeled_gauges.get(name)
+        if series is None:
+          return None
+        return series.get(_label_key(labels))
+      return self.gauges.get(name)
+
   def counter_sum(self, name: str) -> float:
     """Total across a counter family: the unlabeled value plus every labeled
     series (e.g. ``qos_shed_total`` regardless of reason)."""
