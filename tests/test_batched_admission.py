@@ -325,7 +325,13 @@ def test_chunked_prefill_interleaves_decode(monkeypatch):
   """A long prompt prefills in XOT_TPU_PREFILL_CHUNK-sized chunks with
   decode ticks for resident rows BETWEEN the chunks — one long arrival no
   longer stalls every stream for its whole prefill — and every output stays
-  token-identical to solo greedy."""
+  token-identical to solo greedy.
+
+  Pinned to the ALTERNATING scheduler (`XOT_TPU_MIXED_TICK=0`): this test
+  counts separate prefill/decode dispatches, which is exactly the schedule
+  mixed ticks replace (ISSUE 14 — tests/test_mixed_tick.py pins the fused
+  schedule's stronger bound: decode advances INSIDE every prefill tick)."""
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "0")
   monkeypatch.setenv("XOT_TPU_PAGED", "1")
   monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
   monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "128")

@@ -257,6 +257,29 @@ def test_router_gate_drops_artifacts():
   assert gate_router(500000.0, lo=1.0, hi=120000.0) is None  # wedged into an outer timeout
 
 
+def test_mixed_gate_keeps_plausible_values():
+  """ISSUE 14: the mixed-tick round's fields ride one named gate with
+  per-field bounds — the mid-burst resident ITL means (and amortized
+  p50s), their mixed/alternating ratio (honest values include regressions
+  above 1.0, recorded so drift is visible against the ≤ 0.5 acceptance
+  bar), and the burst TTFT p50s."""
+  from bench import gate_mixed
+
+  assert gate_mixed(4.253, lo=0.001, hi=600000.0) == 4.253  # the measured CPU-fixture mean
+  assert gate_mixed(0.3956, lo=0.001, hi=1000.0) == 0.3956
+  assert gate_mixed(1.2, lo=0.001, hi=1000.0) == 1.2  # a regression is a result, not an artifact
+  assert gate_mixed(151.97, lo=0.01, hi=600000.0) == 151.97
+
+
+def test_mixed_gate_drops_artifacts():
+  from bench import gate_mixed
+
+  assert gate_mixed(None) is None
+  assert gate_mixed(0.0, lo=0.001, hi=1000.0) is None  # a zero ITL/ratio is a broken fixture
+  assert gate_mixed(-1.0, lo=0.001, hi=1000.0) is None
+  assert gate_mixed(5e6, lo=0.01, hi=600000.0) is None  # wedged into an outer timeout
+
+
 def test_paged_b48_gate_keeps_plausible_ratios():
   """ISSUE 11: the paged-vs-dense B=48 ratio rides its own named gate
   (target >= 0.95 with the shape-aware kernel retune). Honest values —

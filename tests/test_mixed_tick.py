@@ -1,0 +1,362 @@
+"""Mixed prefill+decode ticks (ISSUE 14, inference/batch_scheduler.py).
+
+The contract: with ``XOT_TPU_MIXED_TICK`` on (the default) a chunked prefill
+advances by SLO-budgeted slices fused INTO the batched decode dispatches
+(``models/decoder.py fused_mixed_paged_batch_decode``) instead of stalling
+every resident stream for whole alternating prefill chunks — and greedy
+output is TOKEN-IDENTICAL to the alternating baseline across paged
+int8-KV/int4-KV × lookahead on/off × QoS preempt-resume mid-mixed-tick.
+``XOT_TPU_MIXED_TICK=0`` is byte-identical off: the mixed program is never
+dispatched (poison-pinned). The tick planner never exceeds the per-tick
+budget, and neither side starves: a staged prefill advances every tick while
+decode rows keep emitting.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_batched import _single_row_reference
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.paging import select_mixed_budget
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params
+
+# The suite-shared tiny geometry (test_batched/test_lookahead use the same
+# cfg), so compiled programs dedup across modules in one pytest process —
+# this file must stay cheap inside the tier-1 timing budget.
+CFG = tiny_test_config(n_layers=2, max_seq_len=128)
+KEY = jax.random.PRNGKey(0)
+PARAMS, SHARD = full_model_params(KEY, CFG)
+LONG = [(i % 90) + 3 for i in range(80)]  # 5 chunks at XOT_TPU_PREFILL_CHUNK=16
+PROMPTS = [[3, 25, 9], LONG, [7, 1, 88, 42, 5]]
+
+
+def _engine():
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(SHARD, CFG, PARAMS)
+  return engine
+
+
+def _spy_mixed(server, calls, poison=False):
+  """Record (start, end) of every mixed dispatch's prefill slice — or
+  poison the op so an off-mode dispatch fails loudly."""
+  orig = server.ops.mixed_paged_batch_decode
+
+  def wrapped(*a, **kw):
+    if poison:
+      raise AssertionError("mixed program dispatched with XOT_TPU_MIXED_TICK=0")
+    calls.append((int(kw["pf_prefix"][0]), int(kw["pf_end"][0])))
+    return orig(*a, **kw)
+
+  server.ops.mixed_paged_batch_decode = wrapped
+
+
+def _serve(server, prompts, n_gen, priorities=None):
+  streams: dict[str, list] = {}
+
+  async def run():
+    def emit(rid, toks, finished):
+      streams.setdefault(rid, []).extend(toks)
+
+    return await asyncio.gather(
+      *(
+        server.submit(
+          f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(),
+          emit=emit, priority=(priorities[i] if priorities else "standard"),
+        )
+        for i, p in enumerate(prompts)
+      )
+    )
+
+  outs = asyncio.run(run())
+  return outs, streams
+
+
+def test_mixed_env_knob(monkeypatch):
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "16")
+  engine = _engine()
+  assert BatchedServer(engine).mixed  # default ON
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "0")
+  assert not BatchedServer(engine).mixed
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1")
+  assert BatchedServer(engine).mixed
+
+
+def test_select_mixed_budget_policy(monkeypatch):
+  """Budget-policy properties: always within [floor-or-cap, cap], monotone
+  non-increasing in burn, full cap when idle, env force-pin clamps."""
+  monkeypatch.delenv("XOT_TPU_MIXED_BUDGET", raising=False)
+  for cap in (16, 64, 2048):
+    assert select_mixed_budget(cap, None, residents=0) == cap  # idle: full chunk
+    assert select_mixed_budget(cap, 50.0, residents=0) == cap  # idle wins regardless of burn
+    prev = cap
+    for burn in (None, 0.0, 0.3, 1.0, 2.0, 5.0, 50.0):
+      b = select_mixed_budget(cap, burn, residents=3)
+      assert min(16, cap) <= b <= cap
+      assert b <= prev  # shrinks (weakly) as burn rises
+      prev = b
+    assert select_mixed_budget(cap, None, residents=3) == max(cap // 2, min(16, cap))
+    # Backlog growth: with K admissions mid-prefill and ITL not burning the
+    # slice grows toward the cap (small slices never shrink the TOTAL stall
+    # a backlog imposes — they only multiply the ticks TTFT waits through);
+    # measured burn >= 1 keeps the table's shrink UNSCALED (smoothing is
+    # what a burning objective pays TTFT for).
+    assert select_mixed_budget(cap, None, residents=3, backlog=4) == cap
+    assert select_mixed_budget(cap, 0.5, residents=3, backlog=2) <= cap
+    assert select_mixed_budget(cap, 2.0, residents=3, backlog=8) == select_mixed_budget(cap, 2.0, residents=3)
+  monkeypatch.setenv("XOT_TPU_MIXED_BUDGET", "24")
+  assert select_mixed_budget(2048, 50.0, residents=8) == 24  # force-pin wins
+  assert select_mixed_budget(16, None, residents=1) == 16  # ...clamped to cap
+
+
+@pytest.mark.parametrize("kv_quant", ["int8", "int4"])
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_mixed_ab_identity(monkeypatch, kv_quant, lookahead):
+  """The A/B matrix: mixed vs alternating greedy streams are token-identical
+  (and equal to the solo reference) over paged int8-KV and int4-KV pools,
+  lookahead on and off — with the mixed program VERIFIABLY dispatching in
+  the on arm and poisoned-never-called in the off arm."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", kv_quant)
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "16")
+  n_gen = 8
+  outs = {}
+  for mixed in (False, True):
+    monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1" if mixed else "0")
+    server = BatchedServer(_engine(), n_slots=4, chunk=4, lookahead=lookahead)
+    calls: list = []
+    _spy_mixed(server, calls, poison=not mixed)
+    outs[mixed], streams = _serve(server, PROMPTS, n_gen)
+    for i, o in enumerate(outs[mixed]):
+      assert streams[f"r{i}"] == o
+    server.shutdown()
+    if mixed:
+      # The long prompt's later chunks rode mixed ticks (the short rows
+      # admitted alongside are still decoding), each slice within budget.
+      assert calls, "mixed program never dispatched — the A/B is vacuous"
+      assert all(0 < e - s <= 16 for s, e in calls)
+  assert outs[True] == outs[False]
+  expected = [_single_row_reference(PARAMS, SHARD, p, n_gen - 1) for p in PROMPTS]
+  assert outs[True] == expected
+
+
+def test_mixed_slice_pad_stays_pow2_near_window(monkeypatch):
+  """Near the context window the planner SHRINKS the slice so its padded
+  dispatch shape stays a power of two inside the scatter-clamp bound
+  (prefix + pad <= max_seq) — clamping the pad to an arbitrary width would
+  trace a fresh XLA compile per near-window slice, the exact recompile the
+  traced budget exists to avoid."""
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import _Ready
+  from xotorch_support_jetson_tpu.inference.sched_admission import _Request
+
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "512")
+  monkeypatch.setenv("XOT_TPU_MIXED_BUDGET", "512")
+  server = BatchedServer(_engine(), n_slots=2, chunk=4)
+  server.max_seq = 1024
+  server.slots[0] = "resident"  # placeholder: the planner only checks identity-vs-None
+  req = _Request(request_id="w", tokens=np.zeros(1000, np.int32), max_tokens=4, temp=0.0, top_k=1, eos_ids=(), emit=lambda *a: None)
+  server._prefilling.append(_Ready(req=req, row=1, pad_to=0, prefix_len=596))
+  r, start, end = server._mixed_intent(None)
+  # Budget 512 would slice 276 (remaining 404 - final cap 128), whose pow2
+  # pad 512 exceeds the 428-token window room: the slice shrinks to 256.
+  assert (start, end - start) == (596, 256)
+  pad = 1
+  while pad < end - start:
+    pad *= 2
+  assert start + pad <= server.max_seq
+
+
+def test_mixed_preempt_resume_mid_mixed_tick(monkeypatch):
+  """QoS preempt-resume lands mid-mixed-tick: an interactive long-prompt
+  arrival preempts the batch-class resident, then its chunked prefill rides
+  mixed ticks next to the surviving interactive resident; the preempted row
+  resumes token-identically. Pinned A/B vs the alternating scheduler."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "16")
+  n_gen = 8
+  outs = {}
+  for mixed in (False, True):
+    monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1" if mixed else "0")
+    server = BatchedServer(_engine(), n_slots=2, chunk=4, lookahead=True)
+    calls: list = []
+    _spy_mixed(server, calls, poison=not mixed)
+    streams: dict[str, list] = {}
+
+    async def run(server=server):
+      def emit(rid, toks, finished):
+        streams.setdefault(rid, []).extend(toks)
+
+      first = asyncio.Event()
+
+      def emit_first(rid, toks, finished):
+        emit(rid, toks, finished)
+        if toks:
+          first.set()
+
+      async def submit(rid, prompt, prio, em, max_tokens):
+        return await server.submit(rid, np.asarray(prompt, np.int32), max_tokens=max_tokens, temp=0.0, top_k=35, eos_ids=(), emit=em, priority=prio)
+
+      # Two residents fill the pool: one interactive survivor, one
+      # batch-class victim; the interactive long prompt then has no free
+      # slot and preempts the victim at the admission boundary.
+      t_a = asyncio.ensure_future(submit("ra", [3, 25, 9], "interactive", emit_first, 24))
+      t_b = asyncio.ensure_future(submit("rb", [7, 1, 88], "batch", emit, 24))
+      await first.wait()
+      out_c = await submit("rc", LONG, "interactive", emit, n_gen)
+      return [await t_a, await t_b, out_c]
+
+    outs[mixed] = asyncio.run(run())
+    for rid, o in zip(("ra", "rb", "rc"), outs[mixed]):
+      assert streams[rid] == o
+    server.shutdown()
+    if mixed:
+      assert calls, "the preempting request's prefill never rode a mixed tick"
+  assert outs[True] == outs[False]
+  # Every stream equals its solo reference — including the preempted-and-
+  # resumed batch row (resume identity holds through the mixed schedule).
+  assert outs[True][0] == _single_row_reference(PARAMS, SHARD, [3, 25, 9], 23)
+  assert outs[True][1] == _single_row_reference(PARAMS, SHARD, [7, 1, 88], 23)
+  assert outs[True][2] == _single_row_reference(PARAMS, SHARD, LONG, n_gen - 1)
+
+
+def test_mixed_budget_respected_and_no_starvation(monkeypatch):
+  """Tick-planner property pin: under decode saturation a staged prefill
+  advances monotonically (never starves), every slice stays within the
+  policy budget, and the resident decode rows keep emitting between the
+  prefill's start and its first token (prefill never starves decode)."""
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "32")
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1")
+  server = BatchedServer(_engine(), n_slots=3, chunk=4, lookahead=True)
+  calls: list = []
+  _spy_mixed(server, calls)
+  resident_during_prefill = {"n": 0}
+  long_first: dict = {}
+
+  async def run():
+    def emit_resident(rid, toks, finished):
+      if toks and not long_first:
+        resident_during_prefill["n"] += len(toks)
+
+    def emit_long(rid, toks, finished):
+      if toks and not long_first:
+        long_first["t"] = True
+
+    first = asyncio.Event()
+
+    def emit_r0(rid, toks, finished):
+      emit_resident(rid, toks, finished)
+      if toks:
+        first.set()
+
+    # Two residents saturate decode with a long budget; the third slot is
+    # the staged prefill's row.
+    t0 = asyncio.ensure_future(server.submit("d0", np.asarray([3, 25, 9], np.int32), max_tokens=48, temp=0.0, top_k=35, eos_ids=(), emit=emit_r0))
+    t1 = asyncio.ensure_future(server.submit("d1", np.asarray([9, 9, 1], np.int32), max_tokens=48, temp=0.0, top_k=35, eos_ids=(), emit=emit_resident))
+    await first.wait()
+    tl = asyncio.ensure_future(server.submit("long", np.asarray(LONG, np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=emit_long))
+    return await asyncio.gather(t0, t1, tl)
+
+  outs = asyncio.run(run())
+  server.shutdown()
+  assert [len(o) for o in outs] == [48, 48, 4]
+  # Budget: burn is unmeasured and residents > 0 ⇒ cap/2 = 16 every tick.
+  assert calls, "saturated decode starved the staged prefill out of mixed ticks"
+  assert all(0 < e - s <= 16 for s, e in calls)
+  # Progress in BOTH directions: the prefill's slices advance monotonically
+  # tick over tick, and the residents kept emitting during the prefill span.
+  assert all(b[0] >= a[1] for a, b in zip(calls, calls[1:])), calls
+  assert resident_during_prefill["n"] > 0
+
+
+def test_deadline_estimator_uses_measured_drain(monkeypatch):
+  """ISSUE 14 satellite: the deadline estimator stops modeling queue drain
+  as serial TTFT-per-waiter once a measured admission cadence exists (under
+  mixed ticks prefill overlaps decode, so the serial model over-sheds); the
+  serial model stays the cold-start fallback and the floor never rises."""
+  from xotorch_support_jetson_tpu.inference.qos import QosConfig, QosPolicy
+
+  class _Reg:
+    def quantile(self, name, q, labels=None):
+      return {"ttft_seconds": 2.0, "itl_seconds": 0.01}.get(name)
+
+  now = {"t": 100.0}
+  pol = QosPolicy(QosConfig(), clock=lambda: now["t"], registry=_Reg())
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1")
+  # Cold: serial model — 4 waiters / 2 slots at 2 s TTFT ⇒ 4 s drain.
+  serial = pol.estimate_completion_ms(queue_depth=4, n_slots=2, max_tokens=10)
+  assert serial == pytest.approx(4000.0 + 2000.0 + 100.0)
+  # Measured cadence: admissions every 100 ms while work was waiting.
+  for _ in range(6):
+    now["t"] += 0.1
+    pol.note_admission(waiting=3)
+  assert pol.measured_drain_ms(4) == pytest.approx(400.0, rel=0.05)
+  est = pol.estimate_completion_ms(queue_depth=4, n_slots=2, max_tokens=10)
+  assert est == pytest.approx(400.0 + 2000.0 + 100.0, rel=0.05)
+  assert est < serial  # the over-eager shed margin is gone
+  # BATCHED admissions (K rows in one boundary pass, microseconds apart)
+  # are one boundary of evidence, not K: the inter-boundary gap splits over
+  # the pass size — K near-zero intra-pass gaps must not drag the EWMA
+  # toward 0 (that would flip the estimator to under-shedding).
+  for _ in range(12):  # boundaries every 400 ms admitting 4 each
+    now["t"] += 0.4
+    pol.note_admission(waiting=5)
+    for _ in range(3):
+      now["t"] += 1e-5
+      pol.note_admission(waiting=5)
+  assert pol.measured_drain_ms(1) == pytest.approx(100.0, rel=0.1)  # 400 ms / 4 rows
+  # A SLOW boundary pass (each admission doing milliseconds of host work —
+  # page restores, validation) still groups by the caller's pass id: the
+  # wall-clock heuristic alone would misread the intra-pass gaps as
+  # separate boundaries and drag the EWMA toward the per-admission host
+  # cost (under-shedding).
+  for p in range(8):
+    now["t"] += 0.4
+    pol.note_admission(waiting=5, pass_id=p)
+    for _ in range(3):
+      now["t"] += 0.005  # 5 ms of host work per admission, same pass
+      pol.note_admission(waiting=5, pass_id=p)
+  assert pol.measured_drain_ms(1) == pytest.approx(104.0, rel=0.1)  # ≈415 ms / 4 rows
+  # An admission off an idle queue drops the anchor: the idle gap that
+  # follows must not count as drain evidence.
+  now["t"] += 30.0
+  pol.note_admission(waiting=0)
+  now["t"] += 0.1
+  pol.note_admission(waiting=2)  # fresh anchor — no 30 s gap recorded
+  assert pol.measured_drain_ms(4) < 1000.0
+  # Mixed ticks off ⇒ the serial model stands (alternating really is serial).
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "0")
+  assert pol.estimate_completion_ms(queue_depth=4, n_slots=2, max_tokens=10) == pytest.approx(serial)
+
+
+def test_mixed_metrics_families(monkeypatch):
+  """The mixed dispatch's attribution split: ``mixed_tick_seconds`` gets the
+  fused dispatch (decode_chunk_seconds must NOT — one dispatch, one home)
+  and ``sched_tick_prefill_tokens_total`` counts exactly the slice tokens."""
+  from xotorch_support_jetson_tpu.utils.metrics import metrics, snapshot_delta
+
+  monkeypatch.setenv("XOT_TPU_PAGED", "1")
+  monkeypatch.setenv("XOT_TPU_PAGE_SIZE", "16")
+  monkeypatch.setenv("XOT_TPU_PREFILL_CHUNK", "16")
+  monkeypatch.setenv("XOT_TPU_MIXED_TICK", "1")
+  server = BatchedServer(_engine(), n_slots=4, chunk=4)
+  calls: list = []
+  _spy_mixed(server, calls)
+  before = metrics.snapshot()
+  _serve(server, PROMPTS, 8)
+  server.shutdown()
+  delta = snapshot_delta(before, metrics.snapshot())
+  assert calls
+  sliced = sum(e - s for s, e in calls)
+  assert delta["counters"].get("sched_tick_prefill_tokens_total") == sliced
+  mixed_hist = delta["histograms"].get("mixed_tick_seconds")
+  assert mixed_hist and sum(mixed_hist["counts"]) == len(calls)
+  assert metrics.gauge_value("mixed_budget_tokens") == 16

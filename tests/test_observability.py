@@ -702,6 +702,8 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_drain_migrations_total",
   "xot_tpu_requests_recovered_total",
   "xot_tpu_requests_stalled_total",
+  # Mixed prefill+decode ticks (ISSUE 14)
+  "xot_tpu_sched_tick_prefill_tokens_total",
   # Disaggregated prefill/decode (ISSUE 10)
   "xot_tpu_kv_stream_pages_total",
   "xot_tpu_kv_stream_bytes_total",
@@ -750,6 +752,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_node_role",  # 0=both 1=prefill 2=decode (ISSUE 10)
   "xot_tpu_paged_kernel_tile",  # shape-aware page-tile verdict for this pool (ISSUE 11)
   "xot_tpu_kv_quant_bits",  # 16=bf16 8=int8 4=int4 (ISSUE 11)
+  "xot_tpu_mixed_budget_tokens",  # the tick planner's current prefill-slice budget (ISSUE 14)
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -758,6 +761,7 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_queue_wait_seconds",
   "xot_tpu_prefill_chunk_seconds",
   "xot_tpu_decode_chunk_seconds",
+  "xot_tpu_mixed_tick_seconds",  # one fused mixed prefill+decode dispatch (ISSUE 14)
   "xot_tpu_sched_host_gap_seconds",
   "xot_tpu_spec_acceptance_ewma",
   "xot_tpu_kv_tier_spill_seconds",
@@ -816,6 +820,12 @@ def test_metric_name_snapshot_after_serving():
   gm.set_gauge("kv_draft_bytes", 0)
   gm.set_gauge("kv_draft_slots", 0)
   gm.set_gauge("kv_draft_pages_equivalent", 0)
+  # Mixed ticks (ISSUE 14): a short solo drive never stages a chunked
+  # prefill next to resident decode rows, so the mixed families stay
+  # event-driven — materialize them at zero for the exposition pin.
+  gm.inc("sched_tick_prefill_tokens_total", 0)
+  gm.observe_hist("mixed_tick_seconds", 0.0)
+  gm.set_gauge("mixed_budget_tokens", 0)
   from xotorch_support_jetson_tpu.utils.metrics import FRACTION_BUCKETS
 
   gm.observe_hist("spec_acceptance_ewma", 0.0, buckets=FRACTION_BUCKETS)

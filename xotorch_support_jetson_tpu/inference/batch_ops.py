@@ -52,6 +52,13 @@ class _PageCopyMixin:
     epilogue yet) — the scheduler falls back to ``sample_rows``."""
     return False
 
+  def mixed_tick_supported(self) -> bool:
+    """Whether this backend has the mixed prefill+decode tick program
+    (ISSUE 14). Default False: the pp/sp mesh backends keep the alternating
+    prefill-dispatch / decode-dispatch schedule — the scheduler falls back
+    automatically."""
+    return False
+
 
 class DecoderBatchOps(_PageCopyMixin):
   """Single-device batched serving ops (the default).
@@ -210,6 +217,24 @@ class DecoderBatchOps(_PageCopyMixin):
     eng = self.engine
     return fused_paged_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, token, pool, block_tables, positions, active, temps, n_steps,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+    )
+
+  # ------------------------------------------------- mixed tick (ISSUE 14)
+
+  def mixed_tick_supported(self) -> bool:
+    """The mixed prefill+decode program needs the full-model single-device
+    fused path (same reach as the spec programs); MLA models stay on the
+    alternating schedule (no paged multi-token prefill composition)."""
+    return not self.engine.cfg.is_mla
+
+  def mixed_paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key, pf_tokens, pf_bt, pf_prefix, pf_end):
+    from ..models.decoder import fused_mixed_paged_batch_decode
+
+    eng = self.engine
+    return fused_mixed_paged_batch_decode(
+      eng.params, eng.cfg, eng._effective_shard, token, pool, block_tables, positions, active, temps,
+      pf_tokens, pf_bt, pf_prefix, pf_end, n_steps,
       top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
     )
 

@@ -233,6 +233,9 @@ class _Plan:
   positions: np.ndarray  # [B] int32 dispatch positions
   deadlocked: bool = False  # every resident row starved, nothing finishing
   gmax: int = 0  # >0: dispatch the SPEC program at this depth cap (ISSUE 7)
+  # Mixed tick (ISSUE 14): (ready, start, end) — fuse this admission's
+  # prefill slice [start, end) into the decode dispatch. None = plain tick.
+  mixed: tuple | None = None
 
 
 @dataclass
@@ -269,6 +272,13 @@ class _Chunk:
   # rows draft rounds·gamma, n-gram rows their consumed stream length).
   proposers: list | None = None  # [n_slots] "model"|"ngram"|"plain"
   n_prop: object = None  # device [B] int32 — tokens drafted per row
+  # Mixed tick (ISSUE 14): the admission whose prefill slice rode this
+  # dispatch (its ``prefix_len`` advances to ``mixed_end`` at the settle —
+  # never before, so a cancel/teardown while the chunk flies releases the
+  # pages against the CONFIRMED prefix).
+  mixed_ready: object = None  # _Ready | None
+  mixed_start: int = 0
+  mixed_end: int = 0
 
 
 class BatchedServer:
@@ -315,6 +325,26 @@ class BatchedServer:
     # prefix offset). 0 disables; dense mode always prefills whole (its
     # program has no resume offset — and it is the opt-in layout).
     self.prefill_chunk = int(os.getenv("XOT_TPU_PREFILL_CHUNK", "2048"))
+    # Mixed prefill+decode ticks (ISSUE 14): while decode rows are resident,
+    # a chunked prefill advances by a token-BUDGETED slice fused INTO the
+    # batched decode dispatch (models/decoder.py
+    # fused_mixed_paged_batch_decode) instead of stalling every resident
+    # stream for a whole alternating prefill chunk. The budget is
+    # SLO-driven (inference/paging.py select_mixed_budget: shrinks as the
+    # interactive ITL burn rises, grows to XOT_TPU_PREFILL_CHUNK when
+    # idle; XOT_TPU_MIXED_BUDGET force-pins). The FINAL slice — the one
+    # that samples the first token — always dispatches through the
+    # ordinary admission path, so first-token key-split semantics are
+    # untouched. XOT_TPU_MIXED_TICK=0 restores the strictly alternating
+    # schedule byte-for-byte (test-pinned).
+    from .paging import mixed_tick_enabled
+
+    self.mixed = mixed_tick_enabled()
+    # Boundary-pass counter: identifies which _admit_pending pass an
+    # admission belongs to (the deadline estimator's measured-drain EWMA
+    # groups intra-pass admissions — wall-clock can't, since one pass's
+    # _prepare calls may each do milliseconds of host-tier restore work).
+    self._admit_pass = 0
     self._prefilling: list[_Ready] = []  # admissions mid-chunked-prefill (rows reserved)
     self.allocator = None
     self.block_tables = None
@@ -911,6 +941,21 @@ class BatchedServer:
           keys.append(k)
     return [k.hex() for k in keys[:limit]]
 
+  def _page_window(self, end_pos: int) -> int:
+    """Block-table width for a prefill dispatch covering ``[0, end_pos)``:
+    pages needed, rounded UP to a power of two (bounds the compiled-shape
+    count at log2(pages_per_row)) and clamped to the row maximum. The ONE
+    bucketing both the alternating group dispatch and the mixed-tick slice
+    staging use — the two paths' compiled-program shapes must stay in
+    lockstep."""
+    from .paging import pages_to_cover
+
+    need = pages_to_cover(end_pos, self.page_size)
+    mp_used = 1
+    while mp_used < need:
+      mp_used *= 2
+    return min(mp_used, self.pages_per_row)
+
   def _free_slot(self, taken: frozenset | set = frozenset()) -> int | None:
     # Mid-chunked-prefill rows are protected by ``taken``: _admit_pending
     # swaps _prefilling out and seeds taken with those rows before any
@@ -1065,6 +1110,11 @@ class BatchedServer:
 
   def _note_admitted(self, req: _Request, row: int, shared: int = 0, fresh: int = 0) -> None:
     metrics.inc("scheduler_admissions_total")
+    if self.qos is not None:
+      # Measured admission cadence for the deadline estimator (ISSUE 14
+      # satellite): only gaps taken while work was still waiting count, and
+      # the pass id groups this boundary's batch into ONE observation.
+      self.qos.note_admission(waiting=self.admission.waiting(), pass_id=self._admit_pass)
     if req.t_submit:
       metrics.observe_hist("queue_wait_seconds", time.perf_counter() - req.t_submit)
     if req.t_parked:
@@ -1088,6 +1138,7 @@ class BatchedServer:
     into ``reserve``: younger requests may only admit out of the surplus
     beyond it, so freed pages accumulate toward the parked requests instead
     of being consumed by later small prompts."""
+    self._admit_pass += 1  # one boundary pass = one drain-cadence observation
     ready: list[_Ready] = []
     taken: set[int] = set()
     reserve = 0
@@ -1147,6 +1198,24 @@ class BatchedServer:
       # Baseline for the lookahead drain gate: parked retries wait for the
       # NEXT availability change instead of replaying this pass's verdict.
       self._parked_avail_seen = self.allocator.n_available
+    if ready and self._mixed_active() and any(s is not None for s in self.slots):
+      # Mixed ticks (ISSUE 14): admissions whose remaining prompt exceeds
+      # the per-tick budget don't dispatch an alternating prefill chunk —
+      # they stage into ``_prefilling`` (rows/pages already committed) and
+      # the tick planner fuses budgeted slices into the decode dispatches.
+      # Final-slice-ready entries (and everything when no decode row is
+      # resident) dispatch below as before.
+      # Backlog counts every candidate this pass could stage: the budget
+      # must see the pass's FULL depth, or the first deferral would be
+      # sized for a backlog of one.
+      budget = self._mixed_budget(backlog=max(len(ready), 1))
+      still: list[_Ready] = []
+      for r in ready:
+        if self._mixed_defer(r, budget):
+          self._prefilling.append(r)
+        else:
+          still.append(r)
+      ready = still
     if ready:
       await self._dispatch(ready)
 
@@ -1255,13 +1324,7 @@ class BatchedServer:
       # The window must cover each row's PADDED write reach (the program
       # writes S_pad slots from prefix_len; pad garbage scatters to trash),
       # which the scatter-clamp grouping already bounds to max_seq.
-      from .paging import pages_to_cover
-
-      need_pages = pages_to_cover(max(int(r.prefix_len) for r in group) + S_pad, ps)
-      mp_used = 1
-      while mp_used < need_pages:
-        mp_used *= 2
-      mp_used = min(mp_used, self.pages_per_row)
+      mp_used = self._page_window(max(int(r.prefix_len) for r in group) + S_pad)
       bts = np.zeros((n_rows, mp_used), dtype=np.int32)
       prefix_lens = np.zeros((n_rows,), dtype=np.int32)
       for i, r in enumerate(group):
@@ -1716,6 +1779,133 @@ class BatchedServer:
     self._parked_avail_seen = avail  # shrunk: re-baseline, keep chaining
     return False
 
+  # ------------------------------------------------- mixed ticks (ISSUE 14)
+
+  def _mixed_active(self) -> bool:
+    """Mixed prefill+decode ticks apply: knob on, paged layout (the prefill
+    program's per-row prefix-offset resume is what a slice IS), chunking on,
+    and a backend with the fused mixed program (pp/sp fall back to the
+    alternating schedule)."""
+    return (
+      self.mixed
+      and self.paged
+      and self.prefill_chunk > 0
+      and getattr(self.ops, "mixed_tick_supported", lambda: False)()
+    )
+
+  def _itl_burn(self) -> float | None:
+    """Interactive-class fast-window ITL burn — the budget policy's input.
+    The SLO tick's gauge when it has run; before the first tick, a proxy
+    judged directly from the live ``qos_itl_seconds{class=interactive}``
+    histogram against the class objective (p50 at the p99 objective reads
+    as burn 1.0 — conservative toward shrinking the slice). None = no ITL
+    signal at all."""
+    if not slo.slo_enabled():
+      return None
+    fast = int(min(slo.slo_windows_s()))
+    b = metrics.gauge_value("slo_burn_rate", labels={"class": "interactive", "window": f"{fast}s"})
+    if b is not None:
+      return float(b)
+    itl = metrics.quantile("qos_itl_seconds", 0.5, labels={"class": "interactive"})
+    if itl is None:
+      return None
+    obj_ms = slo.objectives("interactive")["itl_p99_ms"]
+    return (itl * 1e3) / max(obj_ms, 1e-9)
+
+  def _mixed_budget(self, backlog: int | None = None) -> int:
+    from .paging import select_mixed_budget
+
+    residents = sum(1 for s in self.slots if s is not None)
+    budget = select_mixed_budget(
+      self.prefill_chunk, self._itl_burn(), residents,
+      backlog=backlog if backlog is not None else max(len(self._prefilling), 1),
+    )
+    metrics.set_gauge("mixed_budget_tokens", budget)
+    return budget
+
+  @staticmethod
+  def _mixed_final_cap(budget: int) -> int:
+    """Largest remaining suffix the FINAL (sampling) dispatch may cover.
+    The final runs ALONE at a boundary — a pure prefill stall — so its size
+    is bounded by one pad bucket, not the (possibly much larger) slice
+    budget: mixed ticks keep slicing until the remainder fits a single
+    PREFILL_BUCKET-wide dispatch. When the budget is already below the
+    bucket the budget bounds it (small-chunk configs are unchanged)."""
+    return min(budget, PREFILL_BUCKET)
+
+  def _mixed_defer(self, r: _Ready, budget: int) -> bool:
+    """Should this admission's next prefill advance ride mixed ticks
+    instead of an alternating prefill dispatch? Yes while decode rows are
+    resident (there is someone to stall) and the remaining suffix exceeds
+    the final cap (the final, sampling slice always dispatches through the
+    ordinary admission path)."""
+    if not self._mixed_active() or r.req.request_id in self._cancelled_ids:
+      return False
+    if not any(s is not None for s in self.slots):
+      return False  # nothing to mix with: the alternating dispatch stalls no one
+    return int(r.req.tokens.shape[0]) - r.prefix_len > self._mixed_final_cap(budget)
+
+  def _mixed_intent(self, inflight: _Chunk | None, budget: int | None = None) -> tuple | None:
+    """(ready, start, end) of the prefill slice the NEXT decode dispatch
+    should fuse in, or None for a plain tick. One admission per tick (the
+    head of ``_prefilling`` — arrival order); a chained dispatch continues
+    from the IN-FLIGHT slice's end (the advance is host-deterministic, so
+    mixed chunks chain exactly like plain lookahead chunks). ``budget`` is
+    the loop iteration's single policy verdict — recomputing here could
+    disagree with the boundary gate's read within one tick."""
+    if not self._mixed_active() or not self._prefilling:
+      return None
+    if not any(s is not None for s in self.slots):
+      return None
+    r = self._prefilling[0]
+    if r.req.request_id in self._cancelled_ids:
+      return None  # force a boundary: the admission sweep settles the cancel
+    start = r.prefix_len
+    if inflight is not None and inflight.mixed_ready is r:
+      start = inflight.mixed_end  # the in-flight slice hasn't settled yet
+    if budget is None:
+      budget = self._mixed_budget()
+    final_cap = self._mixed_final_cap(budget)
+    remaining = int(r.req.tokens.shape[0]) - start
+    if remaining <= final_cap:
+      return None  # final slice: the boundary dispatch prefills + samples it
+    # Never leave a final larger than the cap: the last slice shrinks so
+    # the sampling dispatch stays one pad bucket wide.
+    slice_len = min(budget, remaining - final_cap)
+    # Keep the padded dispatch shape a POWER OF TWO inside the scatter-clamp
+    # bound (prefix + pad <= max_seq): near the window end the slice shrinks
+    # rather than the pad clamping to an arbitrary width — a non-pow2
+    # [1, pad] shape would trace a fresh XLA compile per near-window slice,
+    # exactly the recompile the traced budget exists to avoid.
+    pad = 1
+    while pad < slice_len:
+      pad *= 2
+    while pad > self.max_seq - start and pad > 1:
+      pad //= 2
+    slice_len = max(min(slice_len, pad), 1)
+    return (r, start, start + slice_len)
+
+  def _prefill_boundary_needed(self, budget: int | None = None) -> bool:
+    """Does a mid-flight chunked prefill need a SYNCHRONOUS boundary
+    (settle + ``_admit_pending`` dispatch)? Always under the alternating
+    scheduler (the historical behavior); under mixed ticks only when an
+    entry is final-slice-ready (its sampling dispatch runs through the
+    admission path), cancelled, or no decode row is resident to mix with.
+    ``budget`` shares the loop iteration's verdict with ``_mixed_intent``."""
+    if not self._prefilling:
+      return False
+    if not self._mixed_active() or not any(s is not None for s in self.slots):
+      return True
+    if budget is None:
+      budget = self._mixed_budget()
+    final_cap = self._mixed_final_cap(budget)
+    for r in self._prefilling:
+      if r.req.request_id in self._cancelled_ids:
+        return True
+      if int(r.req.tokens.shape[0]) - r.prefix_len <= final_cap:
+        return True
+    return False
+
   def _plan_chunk(self, inflight: _Chunk | None, gmax: int = 0) -> _Plan:
     """Snapshot the next chunk's dispatch state: CONFIRMED slot state plus
     the (single) in-flight chunk's speculative advance.
@@ -1926,6 +2116,32 @@ class BatchedServer:
     elif self.spec:
       self._spec_plain_chunks += 1
     worst = spec_worst_advance(self.chunk, gmax) if spec else self.chunk
+    # Mixed tick (ISSUE 14): stage the prefill slice's host operands. The
+    # slice pads to a power of two (one compiled program per pad bucket —
+    # the traced prefix/end mean slice-length changes within a bucket never
+    # recompile) and its page window pow2-buckets like _dispatch_group's.
+    pf_tokens = pf_bt = pf_prefix = pf_end = None
+    mixed_r = None
+    m_start = m_end = 0
+    if plan.mixed is not None and not spec:
+      mixed_r, m_start, m_end = plan.mixed
+      s_slice = m_end - m_start
+      # The planner already shrank the slice so this pow2 pad fits the
+      # scatter-clamp bound (prefix + pad <= max_seq) — see _mixed_intent.
+      pad = 1
+      while pad < s_slice:
+        pad *= 2
+      pf_tokens = np.zeros((1, pad), dtype=np.int32)
+      pf_tokens[0, :s_slice] = mixed_r.req.tokens[m_start:m_end]
+      mp_used = self._page_window(m_start + pad)
+      pf_bt = np.zeros((1, mp_used), dtype=np.int32)
+      row_pages = (mixed_r.shared_pages + mixed_r.new_pages)[:mp_used]
+      pf_bt[0, : len(row_pages)] = row_pages
+      pf_prefix = np.asarray([m_start], dtype=np.int32)
+      pf_end = np.asarray([m_end], dtype=np.int32)
+      tracer.stage(mixed_r.req.request_id, "prefill_chunk", {
+        "tokens": s_slice, "mixed": True, "batched_with": int(plan.active.sum()),
+      })
     sub = eng.split_key()
     now = time.perf_counter()
     if self._t_last_ready is not None:
@@ -1955,6 +2171,15 @@ class BatchedServer:
           jnp.asarray(tokens), self.cache, cd, jnp.asarray(positions), jnp.asarray(active),
           jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax, k_max=self.k_max, key=sub,
           props=pr, prop_counts=pc,
+        )
+      elif pf_tokens is not None:
+        # Mixed tick: one dispatch advances every decode row by its chunk
+        # AND the staged admission's prefill by its budgeted slice.
+        toks, next_tok, _pos, self.cache = self.ops.mixed_paged_batch_decode(
+          jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
+          jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
+          k_max=self.k_max, page_size=self.page_size, key=sub,
+          pf_tokens=pf_tokens, pf_bt=pf_bt, pf_prefix=pf_prefix, pf_end=pf_end,
         )
       elif self.paged:
         toks, next_tok, _pos, self.cache = self.ops.paged_batch_decode(
@@ -1988,6 +2213,7 @@ class BatchedServer:
       starved=frozenset(plan.starved), t_dispatch=t_dispatch, chained=inflight is not None,
       spec=spec, worst=worst, rounds=self.chunk if spec else 0, counts=counts, pos_dev=pos_dev, gammas=gammas,
       proposers=proposers, n_prop=n_prop,
+      mixed_ready=mixed_r, mixed_start=m_start, mixed_end=m_end,
     )
 
   def _note_spec_settle(self, row: int, slot: _Slot, record: _Chunk, avail: int, emitted: int, proposed: int) -> None:
@@ -2060,10 +2286,26 @@ class BatchedServer:
     base = self._t_last_ready if (record.chained and self._t_last_ready is not None) else record.t_dispatch
     chunk_dt = max(t_ready - base, 1e-9)
     self._t_last_ready = t_ready
+    if record.mixed_ready is not None:
+      # Mixed-tick settle (ISSUE 14): the fused dispatch's prefill slice is
+      # confirmed — advance the admission's prefix (max-guarded: a settle
+      # never rewinds past a later chained slice) and attribute the
+      # dispatch to its OWN latency family: one fused program is neither a
+      # pure prefill chunk nor a pure decode chunk, so it must not skew
+      # either existing histogram (the attribution-split satellite).
+      r = record.mixed_ready
+      r.prefix_len = max(r.prefix_len, record.mixed_end)
+      metrics.observe_hist("mixed_tick_seconds", chunk_dt)
+      metrics.inc("sched_tick_prefill_tokens_total", record.mixed_end - record.mixed_start)
+      if r.req.disagg_target and self.kv_stream is not None and self.paged:
+        # Disagg overlap rides mixed ticks too: ship the slice's completed
+        # full pages while the remaining prefill advances.
+        self._disagg_stream_chunk(r)
     if record.active.any():
       # Per-chunk decode-path attribution: the dispatch table's real-world
       # mix, observable at /metrics instead of only in offline bench JSON.
-      metrics.observe_hist("decode_chunk_seconds", chunk_dt)
+      if record.mixed_ready is None:
+        metrics.observe_hist("decode_chunk_seconds", chunk_dt)
       metrics.inc("decode_chunks_total", labels={"path": "spec" if record.spec else self.decode_path})
 
     for i, slot in record.rows:
@@ -2135,6 +2377,10 @@ class BatchedServer:
     inflight: _Chunk | None = None
     try:
       while True:
+        # One mixed-budget verdict per loop iteration: the boundary gate,
+        # the tick planner, and the admission sweep must agree within a
+        # tick (and the policy read — gauge/histogram walk — runs once).
+        mixed_budget = self._mixed_budget() if (self._prefilling and self._mixed_active()) else None
         if inflight is not None:
           # Membership changes happen only at dispatch boundaries: DRAIN the
           # pipeline whenever a waiting request could actually ADMIT —
@@ -2162,7 +2408,12 @@ class BatchedServer:
             # boundary's admission pass can preempt-and-admit — interactive
             # work must not chain behind a saturated batch pipeline.
             admissible = True
-          if not self.lookahead or self._prefilling or admissible or self._drain_pending():
+          # Mid-chunked-prefill continuations force a boundary only when the
+          # ALTERNATING schedule needs one (ISSUE 14): under mixed ticks an
+          # intermediate slice rides the decode dispatch and chains, so only
+          # final-slice-ready entries (their dispatch samples), cancels, and
+          # no-decode-resident states drain the pipeline.
+          if not self.lookahead or self._prefill_boundary_needed(mixed_budget) or admissible or self._drain_pending():
             await self._settle(inflight)
             inflight = None
             continue
@@ -2199,7 +2450,21 @@ class BatchedServer:
             await self._admit_pending(woken=req)
             continue
 
-        gmax = self._spec_intent(inflight)
+        if mixed_budget is None and self._prefilling and self._mixed_active():
+          # The admission pass above just staged a prefill: pick up the
+          # verdict for this iteration's planner.
+          mixed_budget = self._mixed_budget()
+        mixed = self._mixed_intent(inflight, mixed_budget)
+        if mixed is not None:
+          # Spec rows fall back to plain chunks during a mixed tick (the
+          # mixed program composes with the PLAIN decode scan only); the
+          # settle semantics are exactly the existing spec↔plain switch —
+          # an in-flight spec chunk settles below before the mixed dispatch.
+          self._spec_props = None
+          self._spec_needs_host = False
+          gmax = 0
+        else:
+          gmax = self._spec_intent(inflight)
         if inflight is not None and (inflight.spec != (gmax > 0) or self._spec_needs_host):
           # Program-type switch (spec↔plain): a chained dispatch would need
           # the other program's chain contract (device positions vs host
@@ -2212,6 +2477,7 @@ class BatchedServer:
           inflight = None
           continue
         plan = self._plan_chunk(inflight, gmax)
+        plan.mixed = mixed
         if inflight is not None and (not plan.rows or not plan.active.any()):
           # Nothing would step — a membership change is imminent (every row
           # finishing, starved, or already resolved by the in-flight

@@ -284,6 +284,75 @@ def spec_reprobe_proposer(ewmas: dict, available: tuple) -> str | None:
   return best
 
 
+# ------------------------------------------------- mixed-tick budget policy
+#
+# ISSUE 14: one scheduler tick can fuse a token-budgeted PREFILL SLICE into
+# the batched decode dispatch (models/decoder.py
+# ``fused_mixed_paged_batch_decode``), so resident decode rows never stall
+# for a full prefill chunk. How many prefill tokens one tick should carry is
+# the same kind of measured trade as the decode-path table above: every
+# slice token adds latency to EVERY resident row's next token, while smaller
+# slices stretch the prefilling request's TTFT across more ticks. The policy
+# is SLO-driven — the interactive fast-window burn rate (orchestration/slo.py,
+# computed from the live ``qos_itl_seconds{class}`` histograms) says whether
+# resident ITL is actually suffering:
+#
+# Rows are (min_burn, fraction-of-cap); first row whose bound covers the
+# burn wins. ``burn=None`` means no ITL signal at all; with resident decode
+# rows that is "healthy until proven otherwise" (the half-cap hedge), and
+# with NO residents there is nothing to protect — the slice grows to the
+# full ``XOT_TPU_PREFILL_CHUNK`` cap (TTFT-optimal, exactly the alternating
+# chunk).
+
+_MIXED_BUDGET_TABLE = (
+  (4.0, 1 / 16),  # ITL budget burning >=4x: minimum forward progress only
+  (2.0, 1 / 8),
+  (1.0, 1 / 4),  # burning at exactly budget: quarter-chunk slices
+  (0.0, 1 / 2),  # healthy (or unmeasured) with residents: half-chunk hedge
+)
+
+
+def mixed_tick_enabled() -> bool:
+  """``XOT_TPU_MIXED_TICK`` (default on): fuse chunked prefill into the
+  batched decode dispatch. ``0`` restores the strictly alternating
+  prefill-tick / decode-tick scheduler byte-for-byte (test-pinned)."""
+  return os.getenv("XOT_TPU_MIXED_TICK", "1") not in ("0", "false")
+
+
+def select_mixed_budget(cap: int, burn: float | None, residents: int = 1, backlog: int = 1, floor: int = 16) -> int:
+  """Prefill-token budget for one mixed tick at a (cap, burn, residents,
+  backlog) point. ``cap`` is ``XOT_TPU_PREFILL_CHUNK`` (the alternating
+  chunk — the budget's ceiling and the idle verdict); ``burn`` the
+  interactive class's fast-window ITL burn rate (None = no signal);
+  ``residents`` how many decode rows the slice would delay; ``backlog`` how
+  many admissions are mid-prefill. A deeper backlog GROWS the slice toward
+  the cap while ITL is not actually burning (burn < 1): slicing smaller
+  never reduces the TOTAL stall the backlog imposes on residents — the same
+  prefill tokens cross the device either way, small slices only smooth it —
+  while TTFT for the queued prompts degrades linearly with the tick count.
+  Under measured burn the table's shrink wins unscaled: smoothing is
+  exactly what a burning ITL objective buys with the TTFT trade.
+  ``XOT_TPU_MIXED_BUDGET`` (tokens) force-pins the verdict, clamped to
+  [1, cap] — the operator's escape hatch, same spirit as
+  ``XOT_TPU_PAGED_TILE``."""
+  cap = max(int(cap), 1)
+  forced = int(os.getenv("XOT_TPU_MIXED_BUDGET", "0") or 0)
+  if forced > 0:
+    return max(min(forced, cap), 1)
+  if residents <= 0:
+    return cap  # idle: nothing to protect, prefill at full chunk
+  frac = _MIXED_BUDGET_TABLE[-1][1]
+  if burn is not None:
+    for bound, f in _MIXED_BUDGET_TABLE:
+      if burn >= bound:
+        frac = f
+        break
+  budget = int(cap * frac)
+  if (burn is None or burn < 1.0) and backlog > 1:
+    budget = min(budget * int(backlog), cap)
+  return max(min(budget, cap), min(floor, cap))
+
+
 def spec_worst_advance(n_rounds: int, gamma_max: int) -> int:
   """Worst-case tokens one spec chunk advances a row: every round fully
   accepted. The scheduler's page growth and context-window band gate both

@@ -255,6 +255,16 @@ class QosPolicy:
     self.registry = registry
     self._tenants: "OrderedDict[str, _TenantState]" = OrderedDict()
     self._lock = threading.Lock()
+    # Measured admission drain (ISSUE 14 satellite): EWMA of the gap between
+    # consecutive admissions taken WHILE work was still waiting — direct
+    # evidence of how fast the queue actually drains. Under mixed ticks a
+    # waiting request's prefill overlaps resident decode, so the historical
+    # serial model (one median TTFT per waiting request per slot) overstates
+    # drain time and sheds deadlines that would comfortably be met.
+    self._t_last_admit: float | None = None
+    self._admit_batch_n: int = 0  # admissions recorded at the current anchor
+    self._admit_pass_seen: object = None  # boundary-pass id of the anchor
+    self._admit_gap_ewma_s: float | None = None
 
   @classmethod
   def from_env(cls) -> "QosPolicy":
@@ -310,13 +320,71 @@ class QosPolicy:
 
   # ------------------------------------------------------ deadline admission
 
+  def note_admission(self, waiting: int, pass_id: object = None) -> None:
+    """Record one slot admission for the measured-drain estimate. Only gaps
+    taken while ``waiting > 0`` count — an idle stretch between requests is
+    not drain evidence, and folding it in would swing the estimate the
+    over-eager-shed way the serial model already errs. Admission is BATCHED
+    (one boundary pass admits K requests), so the cadence evidence is per
+    BOUNDARY: the K intra-pass gaps must not enter the EWMA (they measure
+    per-admission host work — page restores, validation — not drain, and
+    would flip the estimator to under-shedding); instead the inter-boundary
+    gap is split over the previous pass's K admissions. ``pass_id`` is the
+    caller's boundary-pass identity (the scheduler passes its admission
+    pass counter); callers without one fall back to a 1 ms same-instant
+    heuristic."""
+    now = self.clock()
+    if waiting <= 0:
+      # This admission came off an idle (or freshly drained) queue: the gap
+      # behind it measures arrival spacing, not drain rate. Drop the anchor
+      # so the NEXT backlogged admission starts a fresh gap.
+      self._t_last_admit = None
+      self._admit_batch_n = 0
+      self._admit_pass_seen = None
+      return
+    if self._t_last_admit is None:
+      self._t_last_admit = now
+      self._admit_batch_n = 1
+      self._admit_pass_seen = pass_id
+      return
+    gap = max(now - self._t_last_admit, 0.0)
+    same_pass = (pass_id == self._admit_pass_seen) if pass_id is not None else gap < 1e-3
+    if same_pass:
+      # Same boundary pass: another row of the batch, not cadence evidence.
+      self._admit_batch_n += 1
+      return
+    # A new boundary: the previous pass's admissions drained in ``gap`` —
+    # per-request spacing is gap / batch size. Inline EWMA
+    # (paging.ewma_update clamps to [0,1] — it is an acceptance fraction);
+    # the 60 s cap bounds one stall's poisoning.
+    per = min(gap, 60.0) / max(self._admit_batch_n, 1)
+    self._admit_gap_ewma_s = per if self._admit_gap_ewma_s is None else 0.7 * self._admit_gap_ewma_s + 0.3 * per
+    self._t_last_admit = now
+    self._admit_batch_n = 1
+    self._admit_pass_seen = pass_id
+
+  def measured_drain_ms(self, queue_depth: int) -> float | None:
+    """Queue-drain estimate from the MEASURED admission cadence (None until
+    two backlogged admissions have been observed)."""
+    if self._admit_gap_ewma_s is None:
+      return None
+    return float(queue_depth) * self._admit_gap_ewma_s * 1e3
+
   def estimate_completion_ms(self, *, queue_depth: int, n_slots: int, max_tokens: int) -> float | None:
     """Expected time-to-last-token for a request admitted NOW, from the live
-    latency histograms: queue drain (one median TTFT per waiting request per
-    slot — admission is batched, so a slot turns over about once per TTFT
-    under load), plus this request's own prefill (median TTFT) and decode
-    (``max_tokens`` median inter-token gaps). ``None`` when the histograms
-    are empty (cold start: admit, never guess)."""
+    latency histograms: queue drain, plus this request's own prefill (median
+    TTFT) and decode (``max_tokens`` median inter-token gaps). ``None`` when
+    the histograms are empty (cold start: admit, never guess).
+
+    The drain term historically modeled one median TTFT per waiting request
+    per slot — a SERIAL model that is honest for the alternating scheduler
+    but over-sheds under mixed ticks (ISSUE 14), where a queued request's
+    prefill overlaps resident decode and admissions keep flowing during
+    generation. When the measured admission cadence is available
+    (``note_admission``) and mixed ticks are enabled, the drain term is the
+    smaller of the two: measured evidence caps the model, and the serial
+    model remains the cold-start fallback. The request's OWN prefill and
+    decode stay serial — they are serial for the request itself."""
     ttft = self.registry.quantile("ttft_seconds", 0.5)
     itl = self.registry.quantile("itl_seconds", 0.5)
     if ttft is None and itl is None:
@@ -324,6 +392,11 @@ class QosPolicy:
     ttft_ms = (ttft or 0.0) * 1e3
     itl_ms = (itl or 0.0) * 1e3
     drain_ms = ttft_ms * (queue_depth / max(n_slots, 1))
+    from .paging import mixed_tick_enabled
+
+    measured = self.measured_drain_ms(queue_depth) if mixed_tick_enabled() else None
+    if measured is not None:
+      drain_ms = min(drain_ms, measured)
     return drain_ms + ttft_ms + max(int(max_tokens), 0) * itl_ms
 
   def should_shed(self, deadline_ms: float, estimate_ms: float) -> bool:

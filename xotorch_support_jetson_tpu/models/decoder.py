@@ -1212,8 +1212,12 @@ def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
   return head_logits(params, cfg, h), new_pool
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
-def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+  """The chunked paged decode loop shared by ``fused_paged_batch_decode``
+  and the mixed-tick program below — ONE definition of the per-step math, so
+  the mixed tick's decode half is the plain program's decode half by
+  construction (the token-identity contract of ISSUE 14)."""
+
   def body(carry, _):
     tok, pos, pool, key = carry
     # Inactive rows would write into whatever page their table names; pin
@@ -1228,6 +1232,11 @@ def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token
 
   (next_tok, pos, pool, _), toks = jax.lax.scan(body, (token, positions, pool, key), None, length=n_steps)
   return jnp.moveaxis(toks, 0, 1), next_tok, pos, pool
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
+def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key)
 
 
 def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
@@ -1263,6 +1272,80 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   return _fused_paged_batch_decode_impl(
     params, cfg, shard, token, pool, jnp.asarray(block_tables, jnp.int32), positions, active.astype(jnp.bool_),
     jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
+  )
+
+
+# --------------------------------------------------- mixed prefill+decode tick
+# (inference/batch_scheduler.py, XOT_TPU_MIXED_TICK — ISSUE 14): the
+# alternating scheduler dispatched chunked prefill and batched decode as
+# strictly SEPARATE device programs, so every resident decode row idled for
+# the full wall-clock of every prefill chunk (the head-of-line ITL hit the
+# disagg bench quantified: mid-burst resident ITL 108 ms colocated vs 2.9 ms
+# with a second node). The mixed tick removes the stall WITHOUT extra
+# hardware (Sarathi-Serve / Orca style): ONE fused program per tick advances
+# all resident rows by their decode chunk AND pushes one admission's prefill
+# forward by a token-budgeted slice. Correct by page disjointness: the
+# prefilling row's private pages are never in any decode row's block table
+# (pages are private until donated at release), and shared prefix pages are
+# read-only for both halves — so the decode half reads exactly the pool
+# values the plain program would, and greedy decode streams are
+# token-identical to the alternating baseline by construction (test-pinned).
+
+
+@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
+def _fused_mixed_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+  from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
+
+  # Prefill half: the SAME gather → shard_forward → scatter math as
+  # prefill_into_pages_many, minus the sampling epilogue — an intermediate
+  # slice produces no token (the final slice, which samples, dispatches
+  # through the ordinary admission path so first-token key-split semantics
+  # are untouched). pf_prefix/pf_end are traced [1] scalars: slice length
+  # changes within a pad bucket never recompile (the traced-budget contract).
+  S = pf_tokens.shape[1]
+  temp_c = {k: gather_row_pages(v, pf_bt) for k, v in pool.items()}
+  ppos = pf_prefix[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+  _, temp_c = shard_forward(params, cfg, shard, pf_tokens, ppos, temp_c, head_pos=pf_end - pf_prefix - 1)
+  target = touched_page_targets(pf_bt, pf_prefix, pf_end, page_size)
+  pool = {k: scatter_row_pages(pool[k], temp_c[k], target) for k in pool}
+
+  # Decode half: the plain program's scan, verbatim (_paged_decode_scan).
+  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key)
+
+
+def fused_mixed_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
+  """``fused_paged_batch_decode`` with one admission's prefill slice fused in.
+
+  Decode operands as in ``fused_paged_batch_decode``; the prefill slice is
+  ``pf_tokens`` [1, S_pad] (the prompt's tokens from ``pf_prefix`` on,
+  zero-padded), ``pf_bt`` [1, mp] (the admission's block-table row — the
+  caller must have allocated pages covering ``pf_end``), and traced [1]
+  scalars ``pf_prefix``/``pf_end`` bounding the slice's absolute positions
+  (``pf_prefix + S_pad <= max_seq``, the scatter-clamp constraint of
+  ``prefill_into_pages_many``). Returns the plain contract
+  (tokens [B, n_steps], next_token [B, 1], positions [B], pool) — the slice
+  emits nothing; its pages simply advance. ``use_kernel=None`` resolves
+  through the same dispatch table as the plain program.
+  """
+  from ..inference.paging import select_decode_path
+  from ..ops.paged import paged_kernel_supported
+
+  if not (shard.is_first_layer and shard.is_last_layer):
+    raise ValueError("fused_mixed_paged_batch_decode requires a full-model shard")
+  if cfg.is_mla:
+    raise ValueError("fused_mixed_paged_batch_decode does not support MLA models")
+  if key is None:
+    key = jax.random.PRNGKey(0)
+  if use_kernel is None:
+    context = int(jnp.shape(block_tables)[1]) * int(page_size)
+    use_kernel = paged_kernel_supported(cfg) and select_decode_path(token.shape[0], context, pool_kv_quant(pool, cfg)) != "gather"
+  B = token.shape[0]
+  top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+  return _fused_mixed_paged_batch_decode_impl(
+    params, cfg, shard, token, pool, jnp.asarray(block_tables, jnp.int32), positions, active.astype(jnp.bool_),
+    jnp.asarray(temps, jnp.float32), top_ks, jnp.asarray(pf_tokens, jnp.int32), jnp.asarray(pf_bt, jnp.int32),
+    jnp.asarray(pf_prefix, jnp.int32), jnp.asarray(pf_end, jnp.int32),
+    int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
   )
 
 
