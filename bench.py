@@ -162,6 +162,17 @@ def gate_mixed(value: float | None, lo: float = 0.001, hi: float = 1000.0) -> fl
   return gate_kv_tier(value, lo=lo, hi=hi)
 
 
+def gate_lora(value: float | None, lo: float = 0.001, hi: float = 1000.0) -> float | None:
+  """Drift gate for the multi-LoRA round's numbers (ISSUE 15): the
+  mixed-adapter vs base B=8 throughput ratio (acceptance bar ≥ 0.5 —
+  adapter overhead must not halve batched throughput) and the adapter
+  swap-in latency p50 each ride this band check with their own bounds
+  (the ``gate_kv_tier`` pattern — values outside a generous plausibility
+  band are timing artifacts, not results; honest regressions INSIDE the
+  band stay recorded so drift is visible)."""
+  return gate_kv_tier(value, lo=lo, hi=hi)
+
+
 def gate_failover(recovery_ms: float | None, lo: float = 1.0, hi: float = 120000.0) -> float | None:
   """Sanity-gate the failover round's recovery latency (same drift-gate
   pattern). Recovery = kill-to-next-client-visible-token on the localhost
@@ -684,6 +695,121 @@ def bench_mixed(n_burst: int = 4, n_resident_tokens: int = 120, n_burst_tokens: 
     gate_mixed(round(mix_p50, 3) if mix_p50 is not None else None, lo=0.001, hi=600000.0),
     gate_mixed(round(alt_p50, 3) if alt_p50 is not None else None, lo=0.001, hi=600000.0),
   )
+
+
+def bench_lora(n_rows: int = 8, n_gen: int = 33) -> tuple:
+  """Batched multi-LoRA round (ISSUE 15), measured on EVERY round — the
+  adapter hook is a per-row gather inside the same fused programs, so the
+  CPU smoke measures a real A/B (tiny model) instead of emitting null.
+
+  A tiny checkpoint + 2 synthetic adapters serve a MIXED B=8 batch through
+  the REAL scheduler (rows alternate adapter-1 / adapter-2 / base — the
+  Punica serving shape: one resident base model, every row its own
+  variant) vs the SAME engine serving all-base with the hook compiled in
+  never enabled (fresh engine, no registry). Also measures the adapter
+  swap path: cycling more adapters than device slots forces evict+install
+  rounds whose latency lands in ``lora_swap_seconds``.
+
+  Returns (lora_mixed_batch8_vs_base8, lora_swap_ms_p50,
+  lora_mixed_batch8_aggregate_tok_s, lora_base_batch8_aggregate_tok_s)."""
+  import asyncio
+
+  from xotorch_support_jetson_tpu.inference.adapters import extract_adapter
+  from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+  from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+  from xotorch_support_jetson_tpu.models.config import tiny_test_config
+  from xotorch_support_jetson_tpu.models.decoder import full_model_params
+  from xotorch_support_jetson_tpu.train.lora import add_lora
+  from xotorch_support_jetson_tpu.utils.metrics import metrics as _gm
+
+  cfg = tiny_test_config(n_layers=2, max_seq_len=512)
+  params, shard = full_model_params(jax.random.PRNGKey(7), cfg, "m")
+  rank = 4
+
+  def synth_adapter(seed: int) -> dict:
+    wl = add_lora(params, rank, jax.random.PRNGKey(seed))
+    layers = dict(wl["layers"])
+    for t in ("wq", "wv"):  # nonzero B so the variant actually differs from base
+      b = layers[f"{t}_lora_b"]
+      layers[f"{t}_lora_b"] = (jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(seed), 99), b.shape, jnp.float32) * 0.05).astype(b.dtype)
+    return extract_adapter({**wl, "layers": layers})
+
+  saved = {k: os.environ.get(k) for k in ("XOT_TPU_PAGED", "XOT_TPU_KV_QUANT")}
+  os.environ["XOT_TPU_PAGED"] = "1"
+  os.environ["XOT_TPU_KV_QUANT"] = "int8"
+  try:
+    rng = np.random.default_rng(23)
+    prompts = {f"lr{i}": rng.integers(1, cfg.vocab_size, (24,)).astype(np.int32) for i in range(n_rows)}
+
+    def measure(engine, adapters_by_row) -> float:
+      srv = BatchedServer(engine, n_slots=n_rows, chunk=8)
+
+      async def rnd():
+        total = 0
+
+        def emit(rid, toks, finished):
+          nonlocal total
+          total += len(toks)
+
+        async def one(tag):
+          await asyncio.gather(*(
+            srv.submit(f"{tag}{rid}", p, max_tokens=n_gen, temp=0.0, top_k=35, eos_ids=(), emit=emit,
+                       adapter=adapters_by_row[i])
+            for i, (rid, p) in enumerate(prompts.items())
+          ))
+
+        await one("w")  # compile warm-up (admission + chunk programs)
+        total = 0
+        t0 = time.perf_counter()
+        await one("m")
+        return total / (time.perf_counter() - t0)
+
+      tok_s = asyncio.run(rnd())
+      srv.shutdown()
+      return round(tok_s, 2)
+
+    # Base arm: NO registry — the dispatch signature (and compiled program)
+    # is exactly pre-multi-LoRA serving.
+    base_eng = JaxShardedInferenceEngine(use_local_mesh=False)
+    base_eng.load_test_model(shard, cfg, params)
+    base_tok_s = measure(base_eng, [None] * n_rows)
+    base_eng = None
+
+    # Mixed arm: registry + 2 adapters, rows alternating a1/a2/base.
+    mix_eng = JaxShardedInferenceEngine(use_local_mesh=False)
+    mix_eng.load_test_model(shard, cfg, params)
+    reg = mix_eng.enable_multi_lora(capacity=4, rank=rank)
+    if reg is None:
+      return None, None, None, base_tok_s
+    reg.register("bl-a1", synth_adapter(1))
+    reg.register("bl-a2", synth_adapter(2))
+    mixed_names = [("bl-a1", "bl-a2", None)[i % 3] for i in range(n_rows)]
+    mixed_tok_s = measure(mix_eng, mixed_names)
+
+    # Swap latency: more adapters than free slots → every acquire past
+    # capacity is an LRU evict + install (the lora_swap_seconds histogram).
+    for i in range(3, 9):
+      reg.register(f"bl-x{i}", synth_adapter(i))
+    for cycle in range(2):
+      for i in range(3, 9):
+        reg.acquire(f"bl-x{i}")
+    swap_p50 = _gm.quantile("lora_swap_seconds", 0.5)
+    swap_ms_p50 = round(swap_p50 * 1e3, 3) if swap_p50 is not None else None
+    mix_eng = None
+
+    ratio = round(mixed_tok_s / base_tok_s, 4) if (mixed_tok_s and base_tok_s) else None
+    return (
+      gate_lora(ratio, lo=0.001, hi=100.0),
+      gate_lora(swap_ms_p50, lo=0.0001, hi=600000.0),
+      gate_lora(mixed_tok_s, lo=0.001, hi=10_000_000.0),
+      gate_lora(base_tok_s, lo=0.001, hi=10_000_000.0),
+    )
+  finally:
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
 
 
 def bench_router_round(n_sessions: int = 5, sys_tokens: int = 256, n_gen: int = 6) -> tuple:
@@ -1875,6 +2001,22 @@ def main() -> None:
   except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
     pass
 
+  # Batched multi-LoRA round (ISSUE 15, behind gate_lora): mixed-adapter
+  # B=8 batch through the real scheduler vs the base batch, plus the
+  # adapter swap-in latency — CPU-measurable on every round (the hook is a
+  # per-row gather inside the same fused programs).
+  lora_mixed_batch8_vs_base8 = None
+  lora_swap_ms_p50 = None
+  lora_mixed_batch8_aggregate_tok_s = None
+  lora_base_batch8_aggregate_tok_s = None
+  try:
+    (
+      lora_mixed_batch8_vs_base8, lora_swap_ms_p50,
+      lora_mixed_batch8_aggregate_tok_s, lora_base_batch8_aggregate_tok_s,
+    ) = bench_lora()
+  except Exception:  # noqa: BLE001 — optional section: skip, don't abort the bench
+    pass
+
   # Cluster front door round (ISSUE 13, behind gate_router): two-replica
   # localhost fixture with a tiny checkpoint and a repeated-system-prompt
   # two-turn workload — affine (router) vs random (hand round-robin) TTFT,
@@ -2356,6 +2498,10 @@ def main() -> None:
         "mixed_vs_alternating_itl": mixed_vs_alternating_itl,
         "mixed_ttft_ms_p50": mixed_ttft_ms_p50,
         "alternating_ttft_ms_p50": alternating_ttft_ms_p50,
+        "lora_mixed_batch8_vs_base8": lora_mixed_batch8_vs_base8,
+        "lora_swap_ms_p50": lora_swap_ms_p50,
+        "lora_mixed_batch8_aggregate_tok_s": lora_mixed_batch8_aggregate_tok_s,
+        "lora_base_batch8_aggregate_tok_s": lora_base_batch8_aggregate_tok_s,
         "router_affine_vs_random_ttft_p50": router_affine_vs_random_ttft_p50,
         "router_prefix_hit_rate": router_prefix_hit_rate,
         "router_failover_ms_p50": router_failover_ms_p50,

@@ -55,6 +55,21 @@ RULES: list[tuple[str, str, str]] = [
     f"{PACKAGE}.networking",
     "routing policy is transport-agnostic: api/router.py owns the HTTP client",
   ),
+  # Multi-LoRA registry (ISSUE 15): adapters.py may import paging/kv_tier
+  # (block math, tiering idioms) but never the device-execution scheduler —
+  # the registry must stay expressible against any executor (the
+  # sched_admission discipline) — and never the transport (the node layer
+  # propagates x-adapter metadata).
+  (
+    f"{PACKAGE}/inference/adapters.py",
+    f"{PACKAGE}.inference.batch_scheduler",
+    "the adapter registry is pool policy, never device-execution (ISSUE 15)",
+  ),
+  (
+    f"{PACKAGE}/inference/adapters.py",
+    f"{PACKAGE}.networking",
+    "the adapter registry is transport-agnostic: the node layer owns the x-adapter wire",
+  ),
 ]
 
 

@@ -15,7 +15,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from bench import gate_disagg, gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_overload, gate_slo, gate_spec_batch, plausible_value
+from bench import gate_disagg, gate_failover, gate_headline, gate_kv_tier, gate_lookahead, gate_lora, gate_overload, gate_slo, gate_spec_batch, plausible_value
 
 # The actual poisoned round-2 record (BENCH_r02.json "parsed" payload).
 R02 = {
@@ -300,3 +300,20 @@ def test_paged_b48_gate_drops_artifacts():
   assert gate_paged_b48(0.0) is None  # broken denominator
   assert gate_paged_b48(-1.0) is None
   assert gate_paged_b48(5.0) is None  # early-return artifact, not a 5x paging win
+
+
+def test_lora_gate_keeps_plausible_values():
+  """ISSUE 15: the multi-LoRA round's drift gate — the mixed-vs-base B=8
+  throughput ratio and the swap-in latency ride generous plausibility
+  bands; honest regressions (e.g. a ratio below the 0.5 acceptance bar)
+  stay RECORDED so the drift is visible in the bench record."""
+  assert gate_lora(1.18, lo=0.001, hi=100.0) == 1.18
+  assert gate_lora(0.5, lo=0.001, hi=100.0) == 0.5
+  assert gate_lora(0.31, lo=0.001, hi=100.0) == 0.31  # below the bar, still recorded
+  assert gate_lora(2.05, lo=0.0001, hi=600000.0) == 2.05  # swap ms p50
+
+
+def test_lora_gate_drops_artifacts():
+  assert gate_lora(0.0, lo=0.001, hi=100.0) is None
+  assert gate_lora(1e6, lo=0.001, hi=100.0) is None
+  assert gate_lora(None) is None

@@ -74,6 +74,40 @@ def test_checker_catches_planted_reverse_import_in_router_policy(tmp_path):
     check_layering.REPO = old_repo
 
 
+def test_checker_catches_planted_reverse_import_in_adapters(tmp_path):
+  """ISSUE 15 satellite: the adapter-registry rule bites — a copy of
+  ``adapters.py`` smuggling a function-local import of the device-execution
+  scheduler (or the networking transport) fails the gate, while its allowed
+  paging/kv_tier imports stay clean."""
+  check_layering = _checker()
+  src = (REPO / "xotorch_support_jetson_tpu" / "inference" / "adapters.py").read_text()
+  planted = src + (
+    "\n\ndef _smuggle():\n"
+    "  from .batch_scheduler import BatchedServer as _B\n"
+    "  from ..networking import server as _S\n"
+    "  return _B, _S\n"
+  )
+  pkg = tmp_path / "xotorch_support_jetson_tpu" / "inference"
+  pkg.mkdir(parents=True)
+  for name in ("sched_admission.py", "router_policy.py"):
+    (pkg / name).write_text((REPO / "xotorch_support_jetson_tpu" / "inference" / name).read_text())
+  (pkg / "adapters.py").write_text(planted)
+  old_repo = check_layering.REPO
+  try:
+    check_layering.REPO = tmp_path
+    problems = [p for p in check_layering.check() if "adapters" in p]
+    assert any("batch_scheduler" in p for p in problems), "planted scheduler import was not detected"
+    assert any("networking" in p for p in problems), "planted networking import was not detected"
+  finally:
+    check_layering.REPO = old_repo
+
+
+def test_adapters_rule_is_active():
+  check_layering = _checker()
+  assert any("adapters" in rel for rel, _f, _w in check_layering.RULES)
+  assert not [p for p in check_layering.check() if "adapters" in p]
+
+
 def test_router_policy_rule_is_active():
   """The live module passes, and the rule set actually names it (deleting
   the rule would silently disable the gate)."""

@@ -1,0 +1,501 @@
+"""Batched multi-LoRA serving (ISSUE 15): the per-row adapter-indexed hook
+in the fused programs (models/decoder.py ``_alora_delta``), the adapter
+registry with its host tier and pins (inference/adapters.py), and the
+scheduler/engine plumbing.
+
+The correctness contract: each row of a MIXED-adapter batch is
+token-identical to its own ``merge_lora`` solo reference (the adapter
+folded into the base weights), adapter-less rows == the base model, on the
+paged int8-KV serving default AND the dense layout, lookahead on and off;
+preempt-resume keeps its adapter across the carry; ``XOT_TPU_LORA=0`` is
+byte-identical base serving with the hook poison-pinned never-called."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_lookahead import _serve
+from xotorch_support_jetson_tpu.inference.adapters import (
+  AdapterRegistry,
+  AdapterSlotsPinnedError,
+  UnknownAdapterError,
+  adapter_nbytes,
+  extract_adapter,
+  load_adapter,
+  lora_tenant_map,
+  save_adapter,
+)
+from xotorch_support_jetson_tpu.inference.batch_scheduler import BatchedServer
+from xotorch_support_jetson_tpu.inference.jax_engine import JaxShardedInferenceEngine
+from xotorch_support_jetson_tpu.inference.qos import QosConfig, QosPolicy, qos_metadata, qos_wire
+from xotorch_support_jetson_tpu.models.config import tiny_test_config
+from xotorch_support_jetson_tpu.models.decoder import full_model_params, fused_decode, init_kv_cache, shard_forward
+from xotorch_support_jetson_tpu.train.lora import add_lora, merge_lora
+from xotorch_support_jetson_tpu.utils.metrics import metrics as gm
+
+CFG = tiny_test_config(n_layers=2, max_seq_len=256, tied_embedding=True)
+KEY = jax.random.PRNGKey(0)
+RANK = 4
+PARAMS, SHARD = full_model_params(KEY, CFG, "m")
+
+
+def _synth_adapter_params(seed: int, rank: int = RANK) -> dict:
+  """A params tree carrying one synthetic adapter in train/lora.py leaf
+  format — B is made nonzero so the variant actually differs from base."""
+  p = add_lora(PARAMS, rank, jax.random.PRNGKey(seed))
+  layers = dict(p["layers"])
+  for t in ("wq", "wv"):
+    b = layers[f"{t}_lora_b"]
+    layers[f"{t}_lora_b"] = (
+      jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(seed), 99), b.shape, jnp.float32) * 0.05
+    ).astype(b.dtype)
+  return {**p, "layers": layers}
+
+
+_AD1 = _synth_adapter_params(1)
+_AD2 = _synth_adapter_params(2)
+ADAPTER_1 = extract_adapter(_AD1)
+ADAPTER_2 = extract_adapter(_AD2)
+MERGED_1 = merge_lora(_AD1, RANK)
+MERGED_2 = merge_lora(_AD2, RANK)
+
+
+def _solo_ref(params, prompt, n_steps):
+  """Greedy solo decode against ``params`` (base or MERGED adapter) — the
+  no-batching, no-adapter-hook ground truth."""
+  S = len(prompt)
+  tokens = jnp.asarray([prompt], dtype=jnp.int32)
+  positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (1, S))
+  cache = init_kv_cache(CFG, SHARD.n_shard_layers, 1, max(64, S + n_steps + 2))
+  logits, cache = shard_forward(params, CFG, SHARD, tokens, positions, cache)
+  first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+  toks, _ = fused_decode(params, CFG, SHARD, first, cache, jnp.full((1,), S, jnp.int32), n_steps, temp=0.0)
+  return [int(first[0, 0])] + [int(t) for t in np.asarray(toks)[0]]
+
+
+def _engine_with_adapters(capacity: int = 4):
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(SHARD, CFG, PARAMS)
+  reg = engine.enable_multi_lora(capacity=capacity, rank=RANK)
+  assert reg is not None
+  reg.register("a1", ADAPTER_1)
+  reg.register("a2", ADAPTER_2)
+  return engine, reg
+
+
+PROMPTS = [[3, 25, 9, 7], [7, 1, 88, 42, 5], [100, 4, 17], [9, 9, 2, 1, 5, 6]]
+NAMES = ["a1", "a2", None, "a1"]  # mixed batch: two adapters + a base row
+
+
+def _serve_mixed(server, n_gen):
+  streams: dict[str, list] = {}
+
+  async def run():
+    def emit(rid, toks, fin):
+      streams.setdefault(rid, []).extend(toks)
+
+    return await asyncio.gather(*(
+      server.submit(
+        f"r{i}", np.asarray(p, np.int32), max_tokens=n_gen, temp=0.0, top_k=35,
+        eos_ids=(), emit=emit, adapter=nm,
+      )
+      for i, (p, nm) in enumerate(zip(PROMPTS, NAMES))
+    ))
+
+  outs = asyncio.run(run())
+  return outs, [streams[f"r{i}"] for i in range(len(PROMPTS))]
+
+
+def _mixed_refs(n_gen):
+  by_name = {None: PARAMS, "a1": MERGED_1, "a2": MERGED_2}
+  return [_solo_ref(by_name[nm], p, n_gen - 1) for p, nm in zip(PROMPTS, NAMES)]
+
+
+# ------------------------------------------------- token-identity contract
+
+
+@pytest.mark.parametrize("layout", ["paged_int8", "dense"])
+def test_mixed_batch_rows_match_merged_solo(monkeypatch, layout):
+  """Each row of a mixed-adapter batch == its own merge_lora solo
+  reference; the adapter-less row == the base model — paged int8-KV (the
+  serving default) and dense, lookahead on AND off."""
+  if layout == "paged_int8":
+    monkeypatch.setenv("XOT_TPU_PAGED", "1")
+    monkeypatch.setenv("XOT_TPU_KV_QUANT", "int8")
+  else:
+    monkeypatch.setenv("XOT_TPU_PAGED", "0")
+  n_gen = 6
+  refs = _mixed_refs(n_gen)
+  engine, reg = _engine_with_adapters()
+  for lookahead in (True, False):
+    server = BatchedServer(engine, n_slots=4, chunk=2, lookahead=lookahead)
+    outs, streams = _serve_mixed(server, n_gen)
+    assert server._lora_active()
+    server.shutdown()
+    for i, (o, s, r) in enumerate(zip(outs, streams, refs)):
+      assert s == o
+      assert o == r, f"(layout={layout}, lookahead={lookahead}) row {i}: {o} != {r}"
+  assert not reg.pinned_holders()  # every finish path unpinned
+
+
+def test_adapter_requests_count_and_resident_gauge():
+  engine, reg = _engine_with_adapters()
+  before = gm.counter_value("lora_requests_total", labels={"adapter": "a1"})
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  _serve_mixed(server, 4)
+  server.shutdown()
+  assert gm.counter_value("lora_requests_total", labels={"adapter": "a1"}) == before + 2
+  assert gm.gauge_value("lora_adapters_resident") == 2
+
+
+def test_lora_off_is_base_and_hook_never_called(monkeypatch):
+  """XOT_TPU_LORA=0: enable_multi_lora returns None, serving is the base
+  model byte-for-byte, and the decoder hook is POISONED never-called."""
+  from xotorch_support_jetson_tpu.models import decoder as dec
+
+  monkeypatch.setenv("XOT_TPU_LORA", "0")
+
+  def boom(*a, **k):  # noqa: ANN002, ANN003
+    raise AssertionError("_alora_delta must never run with XOT_TPU_LORA=0")
+
+  monkeypatch.setattr(dec, "_alora_delta", boom)
+  engine = JaxShardedInferenceEngine(use_local_mesh=False)
+  engine.load_test_model(SHARD, CFG, PARAMS)
+  assert engine.enable_multi_lora(capacity=4, rank=RANK) is None
+  n_gen = 4
+  base_refs = [_solo_ref(PARAMS, p, n_gen - 1) for p in PROMPTS]
+  server = BatchedServer(engine, n_slots=4, chunk=2)
+  outs, _ = _serve(server, PROMPTS, n_gen)
+  server.shutdown()
+  assert outs == base_refs
+
+
+def test_unknown_adapter_fails_the_request_only():
+  """An unknown name fails ITS request with the client-error type; the
+  rest of the batch serves normally and the pool stays clean."""
+  engine, _ = _engine_with_adapters()
+  server = BatchedServer(engine, n_slots=2, chunk=2)
+  ref = _solo_ref(PARAMS, PROMPTS[0], 3)
+
+  async def run():
+    def emit(rid, toks, fin):
+      pass
+
+    bad = server.submit("bad", np.asarray(PROMPTS[1], np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=emit, adapter="nope")
+    good = server.submit("good", np.asarray(PROMPTS[0], np.int32), max_tokens=4, temp=0.0, top_k=35, eos_ids=(), emit=emit)
+    results = await asyncio.gather(bad, good, return_exceptions=True)
+    return results
+
+  bad_res, good_res = asyncio.run(run())
+  assert isinstance(bad_res, UnknownAdapterError)
+  assert good_res == ref
+  assert all(s is None for s in server.slots)
+  server.shutdown()
+
+
+def test_preempt_resume_keeps_adapter():
+  """A preempted adapter row resumes ON ITS ADAPTER across the carry: the
+  resumed stream is token-identical to the adapter's merged solo
+  reference (the name rides _Request.adapter; the resumed admission
+  re-resolves and re-pins a slot)."""
+  engine, reg = _engine_with_adapters()
+  server = BatchedServer(engine, n_slots=1, chunk=2, qos=QosPolicy(QosConfig(aging_s=10_000.0)))
+  p_batch, p_int = [3, 25, 9], [7, 1, 88, 42, 5]
+  n_batch, n_int = 24, 4
+  solo_batch = _solo_ref(MERGED_1, p_batch, n_batch - 1)
+  solo_int = _solo_ref(PARAMS, p_int, n_int - 1)
+  before = gm.counter_value("qos_preemptions_total")
+  streams: dict[str, list] = {}
+
+  async def run():
+    started = asyncio.Event()
+
+    def emit(rid, toks, fin):
+      streams.setdefault(rid, []).extend(toks)
+      if rid == "bg" and len(streams["bg"]) >= 4:
+        started.set()
+
+    bg = asyncio.create_task(server.submit(
+      "bg", np.asarray(p_batch, np.int32), max_tokens=n_batch, temp=0.0, top_k=35,
+      eos_ids=(), emit=emit, priority="batch", tenant="bulk", adapter="a1",
+    ))
+    await asyncio.wait_for(started.wait(), timeout=30)
+    out_int = await asyncio.wait_for(server.submit(
+      "vip", np.asarray(p_int, np.int32), max_tokens=n_int, temp=0.0, top_k=35,
+      eos_ids=(), emit=emit, priority="interactive", tenant="vip",
+    ), timeout=60)
+    out_bg = await asyncio.wait_for(bg, timeout=60)
+    return out_int, out_bg
+
+  out_int, out_bg = asyncio.run(run())
+  assert gm.counter_value("qos_preemptions_total") > before  # it really preempted
+  assert out_int == solo_int
+  assert out_bg == solo_batch  # carry + resumed tokens == the merged stream
+  assert streams["bg"] == solo_batch
+  assert not reg.pinned_holders()
+  server.shutdown()
+
+
+# --------------------------------------------------------- solo parity
+
+
+def test_solo_session_applies_adapter():
+  """Solo/streaming parity: a non-batched session selecting a named
+  adapter decodes the merged reference (indexed application through
+  _prefill + fused_decode), and the base session stays base."""
+  engine, reg = _engine_with_adapters()
+  n = 5
+  for name, mp in (("a1", MERGED_1), (None, PARAMS)):
+    rid = f"solo-{name}"
+    if name:
+      engine.set_request_adapter(rid, name)
+    prompt = np.asarray([PROMPTS[0]], np.int32)
+    out, state = asyncio.run(engine.infer_tensor(rid, SHARD, prompt))
+    first = int(np.argmax(out[0]))
+    toks = asyncio.run(engine.generate_chunk(rid, SHARD, first, n, temp=0.0))
+    got = [first] + [int(t) for t in toks]
+    assert got == _solo_ref(mp, PROMPTS[0], n), f"solo adapter={name}"
+  with pytest.raises(UnknownAdapterError):
+    engine.set_request_adapter("solo-x", "nope")
+  # Solo pins sweep once their sessions are gone.
+  asyncio.run(engine.clear_session())
+  engine.set_request_adapter("solo-y", "a2")
+  asyncio.run(engine.infer_tensor("solo-y", SHARD, np.asarray([PROMPTS[1]], np.int32)))
+  assert not [h for h in reg.pinned_holders() if isinstance(h, tuple) and h[1] == f"solo-a1"]
+
+
+# ------------------------------------------------------- registry units
+
+
+def _null_install():
+  calls = []
+
+  def install(slot, arrays):
+    calls.append((slot, None if arrays is None else sorted(arrays)))
+
+  return install, calls
+
+
+def _geometry():
+  L, D = SHARD.n_shard_layers, CFG.dim
+  return {"layers": {"wq": (L, D, CFG.q_dim), "wv": (L, D, CFG.kv_dim)}}
+
+
+def test_registry_lru_swap_and_pins():
+  install, calls = _null_install()
+  reg = AdapterRegistry(geometry=_geometry(), rank=RANK, capacity=4, install=install, host_budget_bytes=1 << 30)
+  for i in range(5):
+    reg.register(f"x{i}", extract_adapter(_synth_adapter_params(10 + i)))
+  s0, s1, s2 = reg.acquire("x0"), reg.acquire("x1"), reg.acquire("x2")
+  assert len({s0, s1, s2}) == 3 and 0 not in (s0, s1, s2)  # slot 0 reserved
+  before = gm.counter_value("lora_swaps_total", labels={"direction": "out"})
+  s3 = reg.acquire("x3")  # capacity 4 → 3 usable: x0 (LRU) evicts
+  assert s3 == s0 and reg.slot_of("x0") is None
+  assert gm.counter_value("lora_swaps_total", labels={"direction": "out"}) == before + 1
+  # A pinned slot is never reassigned; with every slot pinned acquire raises.
+  reg.acquire("x1", holder="h1")
+  reg.acquire("x2", holder="h2")
+  reg.acquire("x3", holder="h3")
+  with pytest.raises(AdapterSlotsPinnedError):
+    reg.acquire("x4")
+  reg.unpin("h2")
+  assert reg.acquire("x4") == s2  # the unpinned slot was the only candidate
+  with pytest.raises(UnknownAdapterError):
+    reg.acquire("never-registered")
+  # Refreshing a DEVICE-RESIDENT adapter reinstalls its slot in place (the
+  # operator wants the new weights, never a stale slot served forever).
+  slot_before = reg.slot_of("x1")
+  n_installs = len(calls)
+  reg.register("x1", extract_adapter(_synth_adapter_params(77)))
+  assert reg.slot_of("x1") == slot_before
+  assert len(calls) == n_installs + 1 and calls[-1][0] == slot_before
+
+
+def test_registry_host_budget_evicts_and_reloads(tmp_path):
+  """The byte-budgeted host LRU: cold entries with a checkpoint path drop
+  their arrays under pressure and reload on demand (direction-labeled
+  swaps); an in-memory-only entry is never made unrecoverable."""
+  install, _ = _null_install()
+  one = adapter_nbytes(ADAPTER_1)
+  path = save_adapter(tmp_path / "d1", ADAPTER_1)
+  reg = AdapterRegistry(geometry=_geometry(), rank=RANK, capacity=4, install=install, host_budget_bytes=int(one * 1.5))
+  reg.register("mem-only", ADAPTER_2)  # no path: must survive the budget squeeze
+  reg.register("disk", path=str(path))
+  reg.register("mem2", ADAPTER_1)  # over budget now: "disk" is the evictable LRU entry
+  snap = reg.snapshot()["adapters"]
+  assert snap["mem-only"]["host_resident"]
+  assert not snap["disk"]["host_resident"]  # arrays dropped, path kept
+  before = gm.counter_value("lora_swaps_total", labels={"direction": "load"})
+  assert reg.acquire("disk") > 0  # reloads from the npz
+  assert gm.counter_value("lora_swaps_total", labels={"direction": "load"}) == before + 1
+
+
+def test_registry_rank_pad_and_refuse():
+  install, _ = _null_install()
+  reg = AdapterRegistry(geometry=_geometry(), rank=RANK, capacity=2, install=install)
+  small = extract_adapter(_synth_adapter_params(30, rank=2))  # pads 2 → 4
+  reg.register("small", small)
+  assert reg.acquire("small") == 1
+  with pytest.raises(ValueError, match="rank"):
+    reg.register("big", extract_adapter(_synth_adapter_params(31, rank=8)))
+  with pytest.raises(ValueError, match="geometry"):
+    bad = {"layers": {"wq": (np.zeros((1, 2, RANK), np.float32), np.zeros((1, RANK, 3), np.float32))}}
+    reg.register("bad", bad)
+
+
+def test_adapter_checkpoint_roundtrip(tmp_path):
+  """save_adapter/load_adapter round-trips, and load_adapter also reads a
+  full train/checkpoint.py npz (flat keystr keys) — the train/lora.py
+  checkpoint format the registry documents."""
+  p = save_adapter(tmp_path / "rt", ADAPTER_1)
+  back = load_adapter(p)
+  for t in ("wq", "wv"):
+    np.testing.assert_array_equal(back["layers"][t][0], ADAPTER_1["layers"][t][0])
+  # train/checkpoint.py npz-fallback format: keystr flat keys.
+  flat = {}
+  for stack, per in ADAPTER_1.items():
+    for t, (a, b) in per.items():
+      flat[f"['{stack}']['{t}_lora_a']"] = a
+      flat[f"['{stack}']['{t}_lora_b']"] = b
+  flat["['layers']['wq']"] = np.zeros((2, 2), np.float32)  # non-adapter leaves ignored
+  np.savez(str(tmp_path / "full.npz"), **flat)
+  back2 = load_adapter(tmp_path / "full.npz")
+  np.testing.assert_array_equal(back2["layers"]["wv"][1], ADAPTER_1["layers"]["wv"][1])
+  with pytest.raises(FileNotFoundError):
+    load_adapter(tmp_path / "missing.npz")
+
+
+def test_lora_block_math_and_pool_deduction():
+  """The adapter-stack HBM enters the page budget (the draft-KV pattern):
+  a multi-LoRA server's pool is strictly smaller than the base server's,
+  by the block-math page equivalent."""
+  from xotorch_support_jetson_tpu.inference.paging import lora_device_bytes, lora_pages_equivalent
+
+  assert lora_device_bytes(2, 8, 16, 4, 8, itemsize=4) == 2 * 8 * 4 * (8 + 16) * 4
+  assert lora_pages_equivalent(100, 64) == 2
+  assert lora_pages_equivalent(0, 64) == 0
+
+  base_eng = JaxShardedInferenceEngine(use_local_mesh=False)
+  base_eng.load_test_model(SHARD, CFG, PARAMS)
+  base_srv = BatchedServer(base_eng, n_slots=2, chunk=2)
+  base_srv._ensure_cache()
+  base_pages = base_srv.allocator.n_pages
+  base_srv.shutdown()
+
+  lora_eng, reg = _engine_with_adapters()
+  srv = BatchedServer(lora_eng, n_slots=2, chunk=2)
+  srv._ensure_cache()
+  from xotorch_support_jetson_tpu.inference.paging import kv_cache_bytes
+
+  page_bytes = max(kv_cache_bytes(CFG, SHARD.n_shard_layers, srv.page_size, srv.kv_quant), 1)
+  expect_deduct = lora_pages_equivalent(reg.device_bytes(), page_bytes)
+  assert expect_deduct > 0
+  assert srv.allocator.n_pages <= base_pages - min(expect_deduct, base_pages - srv.pages_per_row - 2)
+  srv.shutdown()
+
+
+# ------------------------------------------------- wire / router / advert
+
+
+def test_adapter_rides_the_qos_wire():
+  qos_wire.register("wreq", priority="standard", adapter="a1", node_id="n0")
+  try:
+    meta = dict(qos_metadata("wreq"))
+    assert meta["x-adapter"] == "a1"
+  finally:
+    qos_wire.pop("wreq")
+
+
+def test_stats_snapshot_advertises_resident_adapters():
+  engine, reg = _engine_with_adapters()
+  reg.acquire("a2")
+  server = BatchedServer(engine, n_slots=2, chunk=2)
+  server._ensure_cache()
+  st = server.stats_snapshot()
+  assert "a2" in st["lora_adapters"]
+  # The full REGISTERED list rides along for the front door's model-field
+  # alias check — a registered-but-cold adapter must still resolve.
+  assert set(st["lora_adapters_known"]) == {"a1", "a2"}
+  server.shutdown()
+
+
+def test_router_policy_adapter_affinity_rung():
+  """The ladder's ADAPTER rung: a named adapter restricts placement to
+  replicas advertising it device-resident (source="adapter"); with no
+  advertiser the restriction drops (any replica can load it)."""
+  from xotorch_support_jetson_tpu.inference.router_policy import RouterPolicy
+
+  t = [0.0]
+  policy = RouterPolicy({"r0": "http://a", "r1": "http://b"}, clock=lambda: t[0])
+  policy.update_stats("r0", {"slots_total": 4, "slots_busy": 0, "lora_adapters": []})
+  policy.update_stats("r1", {"slots_total": 4, "slots_busy": 3, "lora_adapters": ["a1"]})
+  # r1 is more loaded, but it holds the adapter: the rung restricts to it.
+  target, source, _ = policy.choose([], adapter="a1")
+  assert (target, source) == ("r1", "adapter")
+  # Nobody advertises a2: restriction drops, least-loaded wins as "load".
+  target, source, _ = policy.choose([], adapter="a2")
+  assert target == "r0" and source == "load"
+  # No adapter: unchanged ladder.
+  target, source, _ = policy.choose([])
+  assert source == "load"
+
+
+@pytest.mark.asyncio
+async def test_api_unknown_adapter_400_and_introspection():
+  """HTTP surface: an `x-adapter` naming an unknown adapter 400s with the
+  typed code BEFORE any device work, and `GET /v1/adapters` reports
+  multi-LoRA off on an adapter-less node."""
+  from aiohttp.test_utils import TestClient, TestServer
+
+  from tests_support_stubs import NoDiscovery, StubServer
+  from xotorch_support_jetson_tpu.api.chatgpt_api import ChatGPTAPI
+  from xotorch_support_jetson_tpu.inference.dummy_engine import DummyInferenceEngine
+  from xotorch_support_jetson_tpu.orchestration.node import Node
+  from xotorch_support_jetson_tpu.topology.partitioning import RingMemoryWeightedPartitioningStrategy
+
+  node = Node(
+    "lora-api-node", StubServer(), DummyInferenceEngine(), NoDiscovery(), None,
+    RingMemoryWeightedPartitioningStrategy(), max_generate_tokens=8,
+  )
+  await node.start()
+  api = ChatGPTAPI(node, "DummyInferenceEngine", response_timeout=30, default_model="dummy")
+  client = TestClient(TestServer(api.app))
+  await client.start_server()
+  try:
+    resp = await client.get("/v1/adapters")
+    assert resp.status == 200 and (await resp.json())["enabled"] is False
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "hi"}]},
+      headers={"x-adapter": "nope"},
+    )
+    assert resp.status == 400
+    body = await resp.json()
+    assert body["error"]["code"] == "unknown_adapter"
+    # No adapter selection: the ordinary request path is untouched.
+    resp = await client.post(
+      "/v1/chat/completions",
+      json={"model": "dummy", "messages": [{"role": "user", "content": "hi"}]},
+    )
+    assert resp.status == 200
+  finally:
+    await client.close()
+    await node.stop()
+
+
+def test_tenant_map_and_parse_adapter_field(monkeypatch):
+  from xotorch_support_jetson_tpu.api.chatgpt_api import parse_adapter_field
+
+  monkeypatch.setenv("XOT_TPU_LORA_TENANTS", '{"acme": "a1"}')
+  assert lora_tenant_map() == {"acme": "a1"}
+  known = lambda n: n in ("a1", "a2")  # noqa: E731
+  assert parse_adapter_field({}, {"x-adapter": "a2"}, None, known) == "a2"
+  assert parse_adapter_field({"model": "a1"}, {}, None, known) == "a1"
+  assert parse_adapter_field({"model": "llama-3.2-1b"}, {}, None, known) is None
+  assert parse_adapter_field({}, {}, "acme", known) == "a1"
+  assert parse_adapter_field({}, {}, "other", known) is None
+  monkeypatch.setenv("XOT_TPU_LORA_TENANTS", "not json")
+  assert lora_tenant_map() == {}

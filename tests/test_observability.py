@@ -753,6 +753,14 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_paged_kernel_tile",  # shape-aware page-tile verdict for this pool (ISSUE 11)
   "xot_tpu_kv_quant_bits",  # 16=bf16 8=int8 4=int4 (ISSUE 11)
   "xot_tpu_mixed_budget_tokens",  # the tick planner's current prefill-slice budget (ISSUE 14)
+  # Multi-LoRA serving (ISSUE 15; swaps labeled {direction}, requests
+  # labeled {adapter} — adapter names are client-asserted, same trust note
+  # as tenant keys)
+  "xot_tpu_lora_adapters_resident",
+  "xot_tpu_lora_host_bytes",
+  "xot_tpu_lora_swaps_total",
+  "xot_tpu_lora_requests_total",
+  "xot_tpu_lora_swap_seconds",
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -826,6 +834,13 @@ def test_metric_name_snapshot_after_serving():
   gm.inc("sched_tick_prefill_tokens_total", 0)
   gm.observe_hist("mixed_tick_seconds", 0.0)
   gm.set_gauge("mixed_budget_tokens", 0)
+  # Multi-LoRA (ISSUE 15): registry families are event-driven (a solo
+  # drive loads no adapter) — materialize them at zero for the pin.
+  gm.set_gauge("lora_adapters_resident", 0)
+  gm.set_gauge("lora_host_bytes", 0)
+  gm.inc("lora_swaps_total", 0, labels={"direction": "in"})
+  gm.inc("lora_requests_total", 0, labels={"adapter": "base"})
+  gm.observe_hist("lora_swap_seconds", 0.0)
   from xotorch_support_jetson_tpu.utils.metrics import FRACTION_BUCKETS
 
   gm.observe_hist("spec_acceptance_ewma", 0.0, buckets=FRACTION_BUCKETS)

@@ -267,6 +267,27 @@ def parse_qos_fields(data: dict, headers) -> tuple[str | None, str | None, float
   return priority, tenant, deadline
 
 
+def parse_adapter_field(data: dict, headers, tenant: str | None, known=None) -> str | None:
+  """Multi-LoRA adapter selection (ISSUE 15), first hit wins: the
+  ``x-adapter`` header; an OpenAI-compatible ``model`` field that names a
+  REGISTERED adapter (``known(name)`` — only a known name can alias the
+  model field, so ordinary model ids keep their meaning); the tenant's
+  default from ``XOT_TPU_LORA_TENANTS``. None = base model. TRUST: adapter
+  names are client-asserted, exactly like tenant keys — pin the header at a
+  gateway for real per-tenant adapter policy."""
+  name = headers.get("x-adapter")
+  if name:
+    return str(name)[:128]
+  model = data.get("model")
+  if model and known is not None and known(str(model)):
+    return str(model)
+  if tenant:
+    from ..inference.adapters import lora_tenant_map
+
+    return lora_tenant_map().get(tenant)
+  return None
+
+
 def overloaded_response(e: Exception) -> web.Response:
   """ServerOverloadedError (and its QoS subclasses) → structured 429: a JSON
   body clients can back off on (``{"error": {"type", "message",
@@ -380,6 +401,7 @@ class ChatGPTAPI:
     r.add_get("/v1/traces", self.handle_traces)
     r.add_get("/v1/requests/{request_id}/timeline", self.handle_request_timeline)
     r.add_get("/v1/kv/tier", self.handle_kv_tier)
+    r.add_get("/v1/adapters", self.handle_adapters)
     r.add_get("/v1/disagg", self.handle_disagg)
     r.add_get("/v1/slo", self.handle_slo)
     r.add_get("/v1/router", self.handle_router_state)
@@ -545,6 +567,45 @@ class ChatGPTAPI:
       "prefix_registry": prefix_registry.snapshot(),
     }
     return web.json_response(body)
+
+  async def handle_adapters(self, request):
+    """GET /v1/adapters — multi-LoRA registry introspection (ISSUE 15):
+    every registered adapter with its device slot / host residency / pin
+    count, plus the capacity and byte budgets. ``{"enabled": false}`` when
+    multi-LoRA serving is off."""
+    reg = getattr(getattr(self.node, "inference_engine", None), "adapter_registry", None)
+    if reg is None:
+      return web.json_response({"enabled": False, "detail": "multi-LoRA serving off (XOT_TPU_LORA=0 or no adapters loaded)"})
+    return web.json_response({"enabled": True, **reg.snapshot()})
+
+  def _adapter_known(self, name: str) -> bool:
+    """Is ``name`` a registered adapter — locally, or (router mode) on any
+    replica's latest advert? Used for the model-field alias, so an ordinary
+    model id can never be misread as an adapter. Replicas advertise BOTH
+    lists: ``lora_adapters_known`` (every registered name — what the alias
+    must match, or a registered-but-cold adapter would silently serve base)
+    and ``lora_adapters`` (device-resident — the affinity rung's subset)."""
+    reg = getattr(getattr(self.node, "inference_engine", None), "adapter_registry", None)
+    if reg is not None and reg.known(name):
+      return True
+    if self._router is not None:
+      for v in self._router.policy.replicas.values():
+        st = v.stats
+        if name in (st.get("lora_adapters_known") or ()) or name in (st.get("lora_adapters") or ()):
+          return True
+    return False
+
+  def _resolve_adapter(self, data: dict, headers, tenant: str | None) -> str | None:
+    """Per-request adapter name (or None), validated locally when this node
+    serves the model itself. In router mode the name forwards unvalidated —
+    the serving replica enforces its own registry and the 400 relays."""
+    from ..inference.adapters import check_known
+
+    name = parse_adapter_field(data, headers, tenant, known=self._adapter_known)
+    if name is None or self._router is not None:
+      return name
+    check_known(getattr(getattr(self.node, "inference_engine", None), "adapter_registry", None), name)
+    return name
 
   async def handle_disagg(self, request):
     """GET /v1/disagg — disaggregated-serving state (ISSUE 10): this node's
@@ -951,7 +1012,9 @@ class ChatGPTAPI:
       # Reuse the chat validation for the shared fields.
       base = parse_chat_request({**data, "messages": [{"role": "user", "content": prompt}], "logprobs": False, "top_logprobs": 0}, self.default_model)
       qos_priority, qos_tenant, qos_deadline_ms = parse_qos_fields(data, request.headers)
+      adapter = self._resolve_adapter(data, request.headers, qos_tenant)
     except ValueError as e:
+      # UnknownAdapterError subclasses ValueError: both are client errors.
       return web.json_response({"error": str(e)}, status=400)
     shard = registry.build_base_shard(base.model, self.inference_engine_classname)
     if shard is None:
@@ -966,11 +1029,12 @@ class ChatGPTAPI:
     if hasattr(self.node, "set_request_options"):
       self.node.set_request_options(
         request_id, stream=bool(base.stream), max_tokens=base.max_tokens, temperature=base.temperature,
-        priority=qos_priority, tenant=qos_tenant, deadline_ms=qos_deadline_ms,
+        priority=qos_priority, tenant=qos_tenant, deadline_ms=qos_deadline_ms, adapter=adapter,
       )
     prompt_ids = list(tokenizer.encode(prompt)) if hasattr(tokenizer, "encode") else []
     eos = getattr(tokenizer, "eos_token_id", None)
     eos_set = {eos} if isinstance(eos, int) else set(eos or [])
+    from ..inference.adapters import UnknownAdapterError
     from ..inference.engine import PromptTooLongError, ServerOverloadedError
     from ..parallel.hbm_planner import RingBudgetError
 
@@ -1047,6 +1111,8 @@ class ChatGPTAPI:
       return stalled_response(e)
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
+    except UnknownAdapterError as e:
+      return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "unknown_adapter"}}, status=400)
     except ServerOverloadedError as e:
       return overloaded_response(e)
     except RingBudgetError as e:
@@ -1534,9 +1600,14 @@ class ChatGPTAPI:
       return web.json_response({"error": "invalid JSON body"}, status=400)
     if DEBUG >= 2:
       print(f"[api] chat completions request: {data}")
+    from ..inference.adapters import UnknownAdapterError
+
     try:
       chat_request = parse_chat_request(data, self.default_model)
       qos_priority, qos_tenant, qos_deadline_ms = parse_qos_fields(data, request.headers)
+      adapter = self._resolve_adapter(data, request.headers, qos_tenant)
+    except UnknownAdapterError as e:
+      return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "unknown_adapter"}}, status=400)
     except ValueError as e:
       return web.json_response({"error": str(e)}, status=400)
 
@@ -1584,6 +1655,7 @@ class ChatGPTAPI:
         priority=qos_priority,
         tenant=qos_tenant,
         deadline_ms=qos_deadline_ms,
+        adapter=adapter,
       )
     # Resume semantics (ISSUE 13): ``resume_tokens`` marks a re-submitted
     # continuation — the batched scheduler absorbs the carried tokens into
@@ -1637,7 +1709,7 @@ class ChatGPTAPI:
           return web.json_response({"error": "image content is not supported through the router"}, status=400)
         return await self._router.serve_chat(
           request, data, chat_request, request_id, tokenizer, prompt, created,
-          (qos_priority, qos_tenant, qos_deadline_ms), include_usage,
+          (qos_priority, qos_tenant, qos_deadline_ms), include_usage, adapter=adapter,
         )
       if chat_request.stream:
         # Generation runs CONCURRENTLY with the SSE stream: tokens flow to
@@ -1682,6 +1754,8 @@ class ChatGPTAPI:
       return stalled_response(e)
     except PromptTooLongError as e:
       return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "context_length_exceeded"}}, status=400)
+    except UnknownAdapterError as e:
+      return web.json_response({"error": {"message": str(e), "type": "invalid_request_error", "code": "unknown_adapter"}}, status=400)
     except ServerOverloadedError as e:
       # Overload / rate-limit / deadline-shed: structured 429 + Retry-After
       # (the QoS subclasses carry retry_after_ms from the drain estimate —

@@ -152,7 +152,7 @@ class ClusterRouter:
 
   # ------------------------------------------------------------- serving path
 
-  async def serve_chat(self, request, data, chat_request, request_id, tokenizer, prompt, created, qos, include_usage):
+  async def serve_chat(self, request, data, chat_request, request_id, tokenizer, prompt, created, qos, include_usage, adapter: str | None = None):
     """Serve one chat completion through the cluster. Called from
     ``handle_post_chat_completions`` inside its try/except/finally, so the
     typed refusals raised here (RateLimitedError/ServerOverloadedError/
@@ -176,7 +176,7 @@ class ClusterRouter:
         served_any = True
 
       pump = asyncio.create_task(
-        self._pump(request_id, data, chat_request, chain, qos, on_first_tokens)
+        self._pump(request_id, data, chat_request, chain, qos, on_first_tokens, adapter=adapter)
       )
       if chat_request.stream:
         try:
@@ -201,7 +201,7 @@ class ClusterRouter:
         self.policy.refund_tenant(tenant, len(prompt_ids))
       raise
 
-  async def _pump(self, request_id, data, chat_request, chain, qos, on_first_tokens) -> list:
+  async def _pump(self, request_id, data, chat_request, chain, qos, on_first_tokens, adapter: str | None = None) -> list:
     """Drive the upstream token stream into the request's queue, failing
     over transparently. Returns the full token list (the pump's task result
     doubles as the generation task the API machinery awaits)."""
@@ -220,8 +220,9 @@ class ClusterRouter:
     tried: set[str] = set()
     failovers = 0
     refusal: RouterUpstreamHTTPError | None = None
+    unknown_adapter: RouterUpstreamHTTPError | None = None
     while True:
-      target, source, hit_pages = policy.choose(chain, exclude=tried)
+      target, source, hit_pages = policy.choose(chain, exclude=tried, adapter=adapter)
       if target is None:
         if len(received) > len(pre_carried):
           # A committed, partially-delivered stream must keep the carry
@@ -232,6 +233,10 @@ class ClusterRouter:
             f"lost all serving replicas after {len(received)} tokens",
             tokens=self._drain_queue(request_id),
           )
+        if unknown_adapter is not None:
+          # Every replica tried lacks the adapter: relay the typed 400
+          # verbatim (the client named something the fleet doesn't have).
+          raise unknown_adapter
         if refusal is not None:
           # Every eligible replica refused: relay the last refusal, but
           # with the CLUSTER retry horizon (ISSUE 13 satellite) — the
@@ -245,7 +250,10 @@ class ClusterRouter:
         err.retry_after_ms = policy.cluster_retry_after_ms()
         raise err
       metrics.inc("router_requests_total", labels={"target": target})
-      if received == pre_carried and source in ("session", "advert"):
+      if received == pre_carried and source in ("session", "advert", "adapter"):
+        # The adapter rung reuses the affinity-hit family with its own
+        # source label — one counter answers "how often did placement land
+        # on already-resident state" across all three affinity kinds.
         metrics.inc("router_prefix_hits_total", labels={"source": source})
       policy.note_session(chain, target)
       body = {k: v for k, v in data.items() if k not in _STRIP_FIELDS}
@@ -255,7 +263,7 @@ class ClusterRouter:
         body["resume_tokens"] = [int(t) for t in received]
         if chat_request.max_tokens is not None:
           body["max_tokens"] = max(int(chat_request.max_tokens) - (len(received) - len(pre_carried)), 1)
-      headers = self._forward_headers(request_id, priority, tenant, deadline_ms, t0)
+      headers = self._forward_headers(request_id, priority, tenant, deadline_ms, t0, adapter=adapter)
       try:
         async for tokens, finished in self._token_events(target, body, headers):
           if tokens:
@@ -271,6 +279,13 @@ class ClusterRouter:
           # A full queue on ONE replica is not cluster overload: try the
           # others first; only a fleet-wide refusal reaches the client.
           refusal = e
+          continue
+        if e.status == 400 and ((e.body or {}).get("error") or {}).get("code") == "unknown_adapter":
+          # ONE replica missing the adapter is not cluster-unknown: the
+          # affinity restriction drops when nobody ADVERTISES it (a
+          # registered-but-cold adapter may still live elsewhere), so walk
+          # the other replicas before relaying the 400 (ISSUE 15).
+          unknown_adapter = e
           continue
         raise
       except _UpstreamLost as e:
@@ -320,12 +335,16 @@ class ClusterRouter:
         pending.extend(toks)
     return pending
 
-  def _forward_headers(self, request_id, priority, tenant, deadline_ms, t0) -> dict:
+  def _forward_headers(self, request_id, priority, tenant, deadline_ms, t0, adapter=None) -> dict:
     from ..orchestration.tracing import tracer
 
     headers = {"x-router-request-id": str(request_id)}
     if tenant:
       headers["x-tenant-id"] = str(tenant)
+    if adapter:
+      # The replica re-resolves the name against ITS registry (ISSUE 15);
+      # an unknown name 400s there and relays through the upstream ladder.
+      headers["x-adapter"] = str(adapter)
     if priority:
       headers["x-priority"] = str(priority)
     if deadline_ms is not None:

@@ -59,6 +59,13 @@ class _PageCopyMixin:
     automatically."""
     return False
 
+  def lora_supported(self) -> bool:
+    """Whether this backend's programs take the per-row ``adapter_ids``
+    operand (ISSUE 15). Default False: the pp/sp mesh backends have no
+    adapter integration — ``enable_multi_lora`` refuses mesh serving
+    anyway, and the scheduler only threads ids when this is True."""
+    return False
+
 
 class DecoderBatchOps(_PageCopyMixin):
   """Single-device batched serving ops (the default).
@@ -118,7 +125,7 @@ class DecoderBatchOps(_PageCopyMixin):
     )
     return cache_d
 
-  def spec_batch_decode(self, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key, props=None, prop_counts=None):
+  def spec_batch_decode(self, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, key, props=None, prop_counts=None, adapter_ids=None):
     from ..models.decoder import fused_spec_batch_decode
 
     eng = self.engine
@@ -131,10 +138,10 @@ class DecoderBatchOps(_PageCopyMixin):
     return fused_spec_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, params_d, cfg_d, shard_d,
       token, cache, cache_d, positions, active, gammas, temps, n_rounds, gamma_max,
-      top_k=top_ks, k_max=k_max, key=key, props=props, prop_counts=prop_counts,
+      top_k=top_ks, k_max=k_max, key=key, props=props, prop_counts=prop_counts, adapter_ids=adapter_ids,
     )
 
-  def spec_paged_batch_decode(self, token, pool, cache_d, block_tables, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, page_size: int, key, props=None, prop_counts=None):
+  def spec_paged_batch_decode(self, token, pool, cache_d, block_tables, positions, active, gammas, temps, top_ks, n_rounds: int, gamma_max: int, k_max: int, page_size: int, key, props=None, prop_counts=None, adapter_ids=None):
     from ..models.decoder import fused_spec_paged_batch_decode
 
     eng = self.engine
@@ -143,8 +150,14 @@ class DecoderBatchOps(_PageCopyMixin):
     return fused_spec_paged_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, params_d, cfg_d, shard_d,
       token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds, gamma_max,
-      top_k=top_ks, k_max=k_max, page_size=page_size, key=key, props=props, prop_counts=prop_counts,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key, props=props, prop_counts=prop_counts, adapter_ids=adapter_ids,
     )
+
+  def lora_supported(self) -> bool:
+    """Multi-LoRA (ISSUE 15): this single-device backend threads the traced
+    per-row adapter index through every fused program once the engine has
+    built its registry (jax_engine.enable_multi_lora)."""
+    return getattr(self.engine, "adapter_registry", None) is not None
 
   def init_cache(self, n_slots: int, max_seq: int):
     from ..models.decoder import init_kv_cache
@@ -158,21 +171,21 @@ class DecoderBatchOps(_PageCopyMixin):
     eng = self.engine
     return init_paged_pool(eng.cfg, eng._effective_shard.n_shard_layers, n_pages, page_size)
 
-  def prefill_into_slots(self, tokens, cache, rows, prompt_lens):
+  def prefill_into_slots(self, tokens, cache, rows, prompt_lens, adapter_ids=None):
     from ..models.decoder import prefill_into_slots
 
     eng = self.engine
     return prefill_into_slots(
-      eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32)
+      eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.asarray(rows, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), adapter_ids
     )
 
-  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+  def prefill_into_pages_many(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, adapter_ids=None):
     from ..models.decoder import prefill_into_pages_many
 
     eng = self.engine
     return prefill_into_pages_many(
       eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_rows, jnp.int32),
-      jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
+      jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size), adapter_ids,
     )
 
   # ------------------------------------------- fused sampling epilogue
@@ -183,41 +196,41 @@ class DecoderBatchOps(_PageCopyMixin):
   def fused_sampling_supported(self) -> bool:
     return True
 
-  def prefill_into_slots_sampled(self, tokens, cache, rows, prompt_lens, temps, top_ks, k_max: int, key):
+  def prefill_into_slots_sampled(self, tokens, cache, rows, prompt_lens, temps, top_ks, k_max: int, key, adapter_ids=None):
     from ..models.decoder import prefill_into_slots_sampled
 
     eng = self.engine
     return prefill_into_slots_sampled(
       eng.params, eng.cfg, eng._effective_shard, tokens, cache, jnp.asarray(rows, jnp.int32),
-      jnp.asarray(prompt_lens, jnp.int32), jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max),
+      jnp.asarray(prompt_lens, jnp.int32), jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max), adapter_ids,
     )
 
-  def prefill_into_pages_many_sampled(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, k_max: int, key):
+  def prefill_into_pages_many_sampled(self, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, k_max: int, key, adapter_ids=None):
     from ..models.decoder import prefill_into_pages_many_sampled
 
     eng = self.engine
     return prefill_into_pages_many_sampled(
       eng.params, eng.cfg, eng._effective_shard, tokens, pool, jnp.asarray(bt_rows, jnp.int32),
       jnp.asarray(prefix_lens, jnp.int32), jnp.asarray(prompt_lens, jnp.int32), int(page_size),
-      jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max),
+      jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32), key, int(k_max), adapter_ids,
     )
 
-  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
+  def batch_decode(self, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key, adapter_ids=None):
     from ..models.decoder import fused_batch_decode
 
     eng = self.engine
     return fused_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, token, cache, positions, active, temps, n_steps,
-      top_k=top_ks, k_max=k_max, key=key,
+      top_k=top_ks, k_max=k_max, key=key, adapter_ids=adapter_ids,
     )
 
-  def paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key):
+  def paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key, adapter_ids=None):
     from ..models.decoder import fused_paged_batch_decode
 
     eng = self.engine
     return fused_paged_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, token, pool, block_tables, positions, active, temps, n_steps,
-      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key, adapter_ids=adapter_ids,
     )
 
   # ------------------------------------------------- mixed tick (ISSUE 14)
@@ -228,14 +241,14 @@ class DecoderBatchOps(_PageCopyMixin):
     alternating schedule (no paged multi-token prefill composition)."""
     return not self.engine.cfg.is_mla
 
-  def mixed_paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key, pf_tokens, pf_bt, pf_prefix, pf_end):
+  def mixed_paged_batch_decode(self, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, key, pf_tokens, pf_bt, pf_prefix, pf_end, adapter_ids=None, pf_adapter=None):
     from ..models.decoder import fused_mixed_paged_batch_decode
 
     eng = self.engine
     return fused_mixed_paged_batch_decode(
       eng.params, eng.cfg, eng._effective_shard, token, pool, block_tables, positions, active, temps,
       pf_tokens, pf_bt, pf_prefix, pf_end, n_steps,
-      top_k=top_ks, k_max=k_max, page_size=page_size, key=key,
+      top_k=top_ks, k_max=k_max, page_size=page_size, key=key, adapter_ids=adapter_ids, pf_adapter=pf_adapter,
     )
 
 

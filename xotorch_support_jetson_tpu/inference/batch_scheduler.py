@@ -421,6 +421,9 @@ class BatchedServer:
     self._h_generated = np.zeros((self.n_slots,), dtype=np.int64)
     self._h_max_tokens = np.zeros((self.n_slots,), dtype=np.int64)
     self._h_occupied = np.zeros((self.n_slots,), dtype=bool)
+    # Multi-LoRA (ISSUE 15): each row's device adapter slot (0 = base) —
+    # the traced [B] index the fused programs gather per-row factors with.
+    self._h_adapters = np.zeros((self.n_slots,), dtype=np.int32)
     # Page availability as of the last admission pass: the lookahead drain
     # gate retries parked requests only when this moves (_parked_admissible).
     self._parked_avail_seen: int = -1
@@ -483,7 +486,7 @@ class BatchedServer:
 
   # ------------------------------------------------------------- public API
 
-  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit, priority: str = "standard", tenant: str = "default", deadline_ms: float | None = None, carry: list | None = None, disagg_target: str | None = None) -> list:
+  async def submit(self, request_id: str, tokens: np.ndarray, *, max_tokens: int, temp: float, top_k: int, eos_ids, emit, priority: str = "standard", tenant: str = "default", deadline_ms: float | None = None, carry: list | None = None, disagg_target: str | None = None, adapter: str | None = None) -> list:
     """Enqueue a request; resolves when it finishes. Tokens stream out via
     ``emit(request_id, new_tokens, finished)`` as chunks complete.
 
@@ -512,6 +515,7 @@ class BatchedServer:
       t_submit=0.0 if carry else time.perf_counter(),
       qos=ticket,
       disagg_target=disagg_target,
+      adapter=adapter or None,
     )
     if carry:
       req.carry_tokens = list(carry)
@@ -748,6 +752,44 @@ class BatchedServer:
     if last is not None:
       tracer.stage(request_id, "spilled", last)
 
+  # ------------------------------------------------- multi-LoRA (ISSUE 15)
+
+  def _lora_active(self) -> bool:
+    """Adapter-aware serving applies: the engine built its registry
+    (jax_engine.enable_multi_lora) AND this backend's fused programs take
+    the per-row index (DecoderBatchOps only — pp/sp keep base serving)."""
+    return (
+      getattr(self.ops, "lora_supported", lambda: False)()
+      and getattr(self.engine, "adapter_registry", None) is not None
+    )
+
+  def _lora_acquire(self, req: _Request) -> None:
+    """Resolve (and pin) the request's named adapter to a device slot at
+    admission — a cold adapter is a host-restore or checkpoint load (a
+    SWAP, measured in lora_swap_seconds), never a recompile. Unknown names
+    raise the client-error type; a fully pinned slot set raises the
+    retryable overload type. Both surface through _prepare's failure path
+    (pages released, future failed) without touching the pool."""
+    if not req.adapter:
+      req.adapter_slot = 0
+      return
+    from .adapters import check_known
+
+    reg = getattr(self.engine, "adapter_registry", None) if self._lora_active() else None
+    check_known(reg, req.adapter)
+    req.adapter_slot = reg.acquire(req.adapter, holder=req.request_id)
+
+  def _lora_unpin(self, req: _Request | None) -> None:
+    """Drop the request's slot pin (idempotent) — called from every path a
+    row leaves the pool through (finish, cancel, extract, teardown), so the
+    registry's LRU can never reassign a slot a resident row still indexes,
+    and a departed row can never pin one forever."""
+    if req is None or not getattr(req, "adapter", None):
+      return
+    reg = getattr(self.engine, "adapter_registry", None)
+    if reg is not None:
+      reg.unpin(req.request_id)
+
   # ---------------------------------------------------------------- loop
 
   def _ensure_cache(self):
@@ -836,6 +878,17 @@ class BatchedServer:
         # one row's window so a tiny test budget still serves; an explicit
         # XOT_TPU_BATCH_PAGES is the operator's own bookkeeping.
         per_dense = max(per_dense - draft_pages_equiv, self.pages_per_row + 1)
+      if self._lora_active():
+        # Adapter-stack accounting (ISSUE 15): the registry's pre-allocated
+        # slot capacity rides in the same HBM budget — the adapter analogue
+        # of the draft deduction (inference/paging.py lora_pages_equivalent),
+        # with the same one-row floor.
+        from .paging import lora_pages_equivalent
+
+        page_bytes = max(kv_cache_bytes(eng.cfg, eng._effective_shard.n_shard_layers, ps, kv_quant), 1)
+        lora_pages = lora_pages_equivalent(self.engine.adapter_registry.device_bytes(), page_bytes)
+        if lora_pages:
+          per_dense = max(per_dense - lora_pages, self.pages_per_row + 1)
       n_pages = int(os.getenv("XOT_TPU_BATCH_PAGES", "0")) or per_dense + 1
       self.allocator = PageAllocator(n_pages, ps)
       self.block_tables = np.zeros((self.n_slots, self.pages_per_row), dtype=np.int32)
@@ -915,6 +968,15 @@ class BatchedServer:
     if self.allocator is not None:
       st["total_pages"] = max(self.allocator.n_pages - 1, 0)  # page 0 is the trash page
       st["free_pages"] = self.allocator.n_available
+    if self._lora_active():
+      # Router ADAPTER-affinity rung (ISSUE 15): which adapters are
+      # DEVICE-resident here right now — a hit means zero swap, a miss a
+      # host-restore/load, never a recompile. The full REGISTERED list
+      # rides along for the front door's model-field alias check: a
+      # registered-but-cold adapter must still resolve (and 400 only when
+      # truly unknown), not silently serve base.
+      st["lora_adapters"] = self.engine.adapter_registry.resident_names()
+      st["lora_adapters_known"] = self.engine.adapter_registry.names()
     if self.qos is not None:
       est = self.qos.estimate_completion_ms(queue_depth=waiting, n_slots=self.n_slots, max_tokens=1)
       if est is not None:
@@ -1016,6 +1078,7 @@ class BatchedServer:
       if not self.paged:
         # pad_to is computed per dispatch by _chunk_ready (the single source
         # of truth — chunking advances it as prefix_len grows).
+        self._lora_acquire(req)
         self._note_admitted(req, row)
         return "ready", _Ready(req=req, row=row, pad_to=0)
 
@@ -1085,6 +1148,7 @@ class BatchedServer:
           metrics.inc("kv_prefix_registry_hits_total", labels={"scope": "remote"})
       if shared_pages:
         metrics.inc("prefix_cache_hit_pages_total", len(shared_pages))
+      self._lora_acquire(req)  # pin the adapter slot; failures release pages below
       self._note_admitted(req, row, shared=len(shared_pages), fresh=len(new_pages))
       return "ready", _Ready(
         req=req, row=row, pad_to=0, prefix_len=prefix_len, shared_pages=shared_pages,
@@ -1237,6 +1301,7 @@ class BatchedServer:
 
   def _release_ready_pages(self, r: _Ready) -> None:
     """Free a not-yet-finished admission's pages (cancel or failure)."""
+    self._lora_unpin(r.req)
     for p in r.shared_pages:
       self.allocator.release(p)
     if r.new_pages:
@@ -1272,12 +1337,27 @@ class BatchedServer:
         await self._dispatch_group(group, all_rows={r.row for r in ready})
     except BaseException as e:  # loop teardown mid-dispatch (CancelledError):
       # device errors are handled per group — only make sure no admitted
-      # request's future leaks unresolved before the task dies.
+      # request's future leaks unresolved before the task dies. Their
+      # adapter pins release too: these entries are in neither slots nor
+      # _prefilling, so _fail_all's sweep would miss them and the pin would
+      # outlive the server (the registry is engine-lifetime).
       for r in ready:
         self._admitting.discard(r.req.request_id)
+        self._lora_unpin(r.req)
         if not r.req.future.done():
           r.req.future.set_exception(RuntimeError(f"batched server shut down mid-admission: {e!r}"))
       raise
+
+  def _group_lora_kw(self, group: list[_Ready], n_rows: int) -> dict:
+    """Per-row adapter slots for one prefill group (padding rows = base 0);
+    empty when multi-LoRA is off so the dispatch signature — and therefore
+    the compiled program — is byte-identical to pre-ISSUE-15 serving."""
+    if not self._lora_active():
+      return {}
+    ad = np.zeros((n_rows,), dtype=np.int32)
+    for i, r in enumerate(group):
+      ad[i] = getattr(r.req, "adapter_slot", 0)
+    return {"adapter_ids": jnp.asarray(ad)}
 
   def _row_bucket(self, K: int) -> int:
     """Round the admission batch up to a power of two (capped at n_slots) so
@@ -1341,6 +1421,7 @@ class BatchedServer:
       # pipeline) can't interleave splits (engine.split_key is locked too).
       sub = eng.split_key()
       draft_job = self._draft_prefill_job(group)
+      lora_kw = self._group_lora_kw(group, n_rows)
 
       def run():
         # Fused sampling epilogue (ISSUE 11): prefill + first-token
@@ -1350,7 +1431,7 @@ class BatchedServer:
         if self.fused_sampling:
           firsts, self.cache = self.ops.prefill_into_pages_many_sampled(
             jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size,
-            temps, top_ks, self.k_max, sub,
+            temps, top_ks, self.k_max, sub, **lora_kw,
           )
           if draft_job is not None:
             draft_job()
@@ -1358,7 +1439,7 @@ class BatchedServer:
         from ..models.decoder import sample_rows
 
         last, self.cache = self.ops.prefill_into_pages_many(
-          jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size
+          jnp.asarray(tok), self.cache, bts, prefix_lens, prompt_lens, self.page_size, **lora_kw
         )
         if draft_job is not None:
           draft_job()
@@ -1368,20 +1449,21 @@ class BatchedServer:
       rows = np.asarray([r.row for r in group] + spare[: n_rows - K], dtype=np.int32)
       sub = eng.split_key()  # loop-thread split; the executor only runs device work
       draft_job = self._draft_prefill_job(group)
+      lora_kw = self._group_lora_kw(group, n_rows)
 
       def run():
         # Prefill AND first-token sampling stay on the engine executor — the
         # single thread that serializes all device work.
         if self.fused_sampling:
           firsts, self.cache = self.ops.prefill_into_slots_sampled(
-            jnp.asarray(tok), self.cache, rows, prompt_lens, temps, top_ks, self.k_max, sub,
+            jnp.asarray(tok), self.cache, rows, prompt_lens, temps, top_ks, self.k_max, sub, **lora_kw,
           )
           if draft_job is not None:
             draft_job()
           return np.asarray(firsts)
         from ..models.decoder import sample_rows
 
-        last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens)
+        last, self.cache = self.ops.prefill_into_slots(jnp.asarray(tok), self.cache, rows, prompt_lens, **lora_kw)
         if draft_job is not None:
           draft_job()
         return np.asarray(sample_rows(last, sub, jnp.asarray(temps), jnp.asarray(top_ks), self.k_max))
@@ -1517,6 +1599,7 @@ class BatchedServer:
     self._h_top_ks[r.row] = min(req.top_k, self.k_max)
     self._h_generated[r.row] = slot.generated
     self._h_max_tokens[r.row] = req.max_tokens
+    self._h_adapters[r.row] = getattr(req, "adapter_slot", 0)
     if self.paged:
       self.block_tables[r.row, :] = 0
       n = len(slot.shared_pages) + len(slot.pages)
@@ -1677,6 +1760,7 @@ class BatchedServer:
     carries forward), so a preempted row's resume and a multi-turn session's
     next turn find the whole history as a reusable prefix, device-side now
     and host-side after LRU pressure spills it."""
+    self._lora_unpin(slot.req)  # the row is leaving the pool in every caller
     if not self.paged:
       return
     for p in slot.shared_pages:
@@ -1726,6 +1810,7 @@ class BatchedServer:
     self._h_top_ks[row] = 1
     self._h_generated[row] = 0
     self._h_max_tokens[row] = 0
+    self._h_adapters[row] = 0
 
   def _grow_pages(self, row: int, slot: _Slot, pos: int, headroom: int | None = None) -> bool:
     """Ensure ``slot`` has pages covering the chunk dispatched at ``pos``.
@@ -2143,6 +2228,7 @@ class BatchedServer:
         "tokens": s_slice, "mixed": True, "batched_with": int(plan.active.sum()),
       })
     sub = eng.split_key()
+    lora_kw = {"adapter_ids": jnp.asarray(self._h_adapters)} if self._lora_active() else {}
     now = time.perf_counter()
     if self._t_last_ready is not None:
       # Device-idle window this dispatch had to wait for host work — 0 by
@@ -2164,33 +2250,36 @@ class BatchedServer:
         toks, counts, n_prop, next_tok, pos_dev, self.cache, cd = self.ops.spec_paged_batch_decode(
           jnp.asarray(tokens), self.cache, cd, jnp.asarray(self.block_tables), jnp.asarray(positions),
           jnp.asarray(active), jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax,
-          k_max=self.k_max, page_size=self.page_size, key=sub, props=pr, prop_counts=pc,
+          k_max=self.k_max, page_size=self.page_size, key=sub, props=pr, prop_counts=pc, **lora_kw,
         )
       elif spec:
         toks, counts, n_prop, next_tok, pos_dev, self.cache, cd = self.ops.spec_batch_decode(
           jnp.asarray(tokens), self.cache, cd, jnp.asarray(positions), jnp.asarray(active),
           jnp.asarray(gammas), jnp.asarray(temps), self._h_top_ks, self.chunk, gmax, k_max=self.k_max, key=sub,
-          props=pr, prop_counts=pc,
+          props=pr, prop_counts=pc, **lora_kw,
         )
       elif pf_tokens is not None:
         # Mixed tick: one dispatch advances every decode row by its chunk
-        # AND the staged admission's prefill by its budgeted slice.
+        # AND the staged admission's prefill by its budgeted slice (the
+        # slice carries ITS OWN adapter index — pf_adapter — so a mixed
+        # tick's prefill half applies the admission's adapter per-row too).
         toks, next_tok, _pos, self.cache = self.ops.mixed_paged_batch_decode(
           jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
           jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
           k_max=self.k_max, page_size=self.page_size, key=sub,
           pf_tokens=pf_tokens, pf_bt=pf_bt, pf_prefix=pf_prefix, pf_end=pf_end,
+          **({**lora_kw, "pf_adapter": np.asarray([getattr(mixed_r.req, "adapter_slot", 0)], np.int32)} if lora_kw else {}),
         )
       elif self.paged:
         toks, next_tok, _pos, self.cache = self.ops.paged_batch_decode(
           jnp.asarray(tokens), self.cache, jnp.asarray(self.block_tables), jnp.asarray(positions),
           jnp.asarray(active), jnp.asarray(temps), jnp.asarray(top_ks), self.chunk,
-          k_max=self.k_max, page_size=self.page_size, key=sub,
+          k_max=self.k_max, page_size=self.page_size, key=sub, **lora_kw,
         )
       else:
         toks, next_tok, _pos, self.cache = self.ops.batch_decode(
           jnp.asarray(tokens), self.cache, jnp.asarray(positions), jnp.asarray(active),
-          jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub,
+          jnp.asarray(temps), jnp.asarray(top_ks), self.chunk, k_max=self.k_max, key=sub, **lora_kw,
         )
       if spec and use_draft:
         self.draft_cache = cd
@@ -2511,13 +2600,16 @@ class BatchedServer:
 
   def _fail_all(self, exc: Exception) -> None:
     for i, slot in enumerate(self.slots):
-      if slot is not None and not slot.req.future.done():
-        slot.req.future.set_exception(exc)
+      if slot is not None:
+        self._lora_unpin(slot.req)
+        if not slot.req.future.done():
+          slot.req.future.set_exception(exc)
       self.slots[i] = None
       self._clear_row(i)  # the single release hook resets every dispatch array
     self._t_last_ready = None
     while self._prefilling:
       r = self._prefilling.pop()
+      self._lora_unpin(r.req)
       if not r.req.future.done():
         r.req.future.set_exception(exc)
     self.admission.fail_queued(exc)

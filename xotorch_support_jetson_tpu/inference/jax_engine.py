@@ -78,11 +78,11 @@ def _tokenizers_differ(fp_a: dict[str, str] | None, fp_b: dict[str, str] | None)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard"), donate_argnums=(4,))
-def _prefill(params, cfg, shard, x, kv_cache, prompt_len):
+def _prefill(params, cfg, shard, x, kv_cache, prompt_len, adapter_ids=None):
   B = x.shape[0]
   S = x.shape[1]
   positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-  out, kv_cache = shard_forward(params, cfg, shard, x, positions, kv_cache)
+  out, kv_cache = shard_forward(params, cfg, shard, x, positions, kv_cache, adapter_ids=adapter_ids)
   if shard.is_last_layer:
     idx = (prompt_len - 1).reshape(B, 1, 1)
     out = jnp.take_along_axis(out, jnp.broadcast_to(idx, (B, 1, out.shape[-1])), axis=1)[:, 0, :]
@@ -90,10 +90,10 @@ def _prefill(params, cfg, shard, x, kv_cache, prompt_len):
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard"), donate_argnums=(4,))
-def _decode_step(params, cfg, shard, x, kv_cache, pos):
+def _decode_step(params, cfg, shard, x, kv_cache, pos, adapter_ids=None):
   B = x.shape[0]
   positions = pos.reshape(B, 1)
-  out, kv_cache = shard_forward(params, cfg, shard, x, positions, kv_cache)
+  out, kv_cache = shard_forward(params, cfg, shard, x, positions, kv_cache, adapter_ids=adapter_ids)
   if shard.is_last_layer:
     out = out[:, 0, :]
   return out, kv_cache
@@ -103,7 +103,7 @@ class _Session:
   __slots__ = (
     "kv_cache", "curr_pos", "prompt_len", "max_seq", "next_token_dev", "epoch", "prompt_np", "draft_cache",
     "spec_seed_dev", "spec_pos_dev", "spec_known_pos", "spec_inflight_slots",
-    "ngram_index", "ngram_unread", "ngram_ewma", "ngram_gamma",
+    "ngram_index", "ngram_unread", "ngram_ewma", "ngram_gamma", "adapter_slot",
   )
 
   def __init__(self, kv_cache, max_seq: int, epoch: int = 0) -> None:
@@ -142,6 +142,12 @@ class _Session:
     self.ngram_unread = False
     self.ngram_ewma = None
     self.ngram_gamma = -1
+    # Multi-LoRA (ISSUE 15): this session's pinned adapter slot (0 = base).
+    # Solo sessions apply the SAME indexed hook as the batched rows
+    # (adapter_ids=[slot] through _prefill/fused_decode/fused_generate);
+    # spec/n-gram chunk modes step aside for adapter sessions — their
+    # programs verify against the base target.
+    self.adapter_slot = 0
 
 
 class JaxShardedInferenceEngine(InferenceEngine):
@@ -199,6 +205,10 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._spec_ngram_on = ngram_enabled()
     self.spec_ngram_n, self.spec_ngram_max = ngram_knobs()
     self._draft_params = None
+    # Multi-LoRA serving (ISSUE 15): the adapter registry built by
+    # enable_multi_lora (None = base-only serving). Model swaps reset it —
+    # its geometry/install hook target one params tree's stacked leaves.
+    self.adapter_registry = None
     # Cross-model draft (XOT_TPU_SPEC_DRAFT=<registry-id-or-dir>): a second,
     # SMALLER model drafts for the target. None ⇒ int8 self-draft (same cfg).
     self._draft_cfg = None
@@ -256,6 +266,11 @@ class JaxShardedInferenceEngine(InferenceEngine):
     from ..models.config import load_model_config
     from ..models.loader import load_shard_weights
 
+    # A model swap invalidates the adapter registry: its geometry/install
+    # hook target the OLD params' stacked leaves (XOT_TPU_LORA_DIR
+    # re-enables against the new model below).
+    self.adapter_registry = None
+
     # Diffusers-format checkpoints carry model_index.json at the root; they
     # take the image-generation path (the reference's SD special case,
     # reference node.py:116, is dead code — this one runs).
@@ -303,8 +318,33 @@ class JaxShardedInferenceEngine(InferenceEngine):
     self._drop_batched_server()  # pooled cache is model-specific
     self._key = jax.random.PRNGKey(self._seed)
     self._model_dir = Path(model_dir)
+    self._maybe_load_adapter_dir()
     if DEBUG >= 1:
       print(f"[jax_engine] loaded {shard} from {model_dir}" + (f" over mesh {self.mesh.shape}" if self.mesh else ""))
+
+  def _maybe_load_adapter_dir(self) -> None:
+    """``XOT_TPU_LORA_DIR``: enable multi-LoRA at model load and register
+    every ``*.npz`` adapter checkpoint in the directory (name = file stem,
+    train/lora.py leaf format — see inference/adapters.py). Best-effort: a
+    bad adapter file is skipped with a warning, never a failed model load;
+    mesh/MLA configurations (which refuse enable_multi_lora) just log."""
+    lora_dir = os.getenv("XOT_TPU_LORA_DIR")
+    if not lora_dir or getattr(self, "adapter_registry", None) is not None:
+      return
+    if not (self._effective_shard.is_first_layer and self._effective_shard.is_last_layer):
+      return  # partial ring shards serve hidden states; no adapter hook
+    try:
+      reg = self.enable_multi_lora()
+    except (RuntimeError, ValueError) as e:
+      print(f"[jax_engine] XOT_TPU_LORA_DIR set but multi-LoRA unavailable: {e}")
+      return
+    if reg is None:
+      return  # XOT_TPU_LORA=0
+    for path in sorted(Path(lora_dir).glob("*.npz")):
+      try:
+        reg.register(path.stem, path=str(path))
+      except Exception as e:  # noqa: BLE001 — one bad adapter must not sink the load
+        print(f"[jax_engine] skipping adapter {path.name}: {e}")
 
   def _maybe_build_draft(self, calibrate: bool = True) -> None:
     """Speculative draft. Two modes (VERDICT r4 #3):
@@ -644,6 +684,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
   def load_test_model(self, shard: Shard, cfg, params, tokenizer=None) -> None:
     """Directly inject a model (unit tests / local pipeline composition)."""
+    self.adapter_registry = None  # stale geometry: re-enable against the new params
     self.shard = shard
     self._effective_shard = shard
     self.cfg = cfg
@@ -900,6 +941,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       max_seq = min(self.max_seq_len, self.cfg.max_seq_len)
       cache = self._place_cache(init_kv_cache(self.cfg, shard.n_shard_layers, B, max_seq))
       session = self.sessions[request_id] = _Session(cache, max_seq, epoch)
+      session.adapter_slot = self._acquire_session_slot(request_id)
 
     prefilling = session.curr_pos == 0
     if prefilling:
@@ -926,7 +968,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       if self._pp is not None:
         out, session.kv_cache = self._pp.prefill(jnp.asarray(x_in), session.kv_cache, lens)
       else:
-        out, session.kv_cache = _prefill(self.params, self.cfg, shard, jnp.asarray(x_in), session.kv_cache, lens)
+        out, session.kv_cache = _prefill(self.params, self.cfg, shard, jnp.asarray(x_in), session.kv_cache, lens, self._session_adapter_ids(session, B))
       session.curr_pos = session.prompt_len = prompt_len
     else:
       if session.curr_pos >= session.max_seq:
@@ -941,7 +983,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       if self._pp is not None:
         out, session.kv_cache = self._pp.decode_step(jnp.asarray(x_step), session.kv_cache, pos)
       else:
-        out, session.kv_cache = _decode_step(self.params, self.cfg, shard, jnp.asarray(x_step), session.kv_cache, pos)
+        out, session.kv_cache = _decode_step(self.params, self.cfg, shard, jnp.asarray(x_step), session.kv_cache, pos, self._session_adapter_ids(session, B))
       session.curr_pos += 1
 
     state.curr_pos = session.curr_pos
@@ -976,6 +1018,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     int8 self-draft, entered right after prefill and continued on-device."""
     if self._draft_params is None or (temp is not None and float(temp) > 0.0):
       return False
+    if getattr(session, "adapter_slot", 0):
+      return False  # spec verifies the BASE target; adapter sessions decode plain
     if session.spec_seed_dev is not None:
       return True  # chain already active
     return (
@@ -1018,6 +1062,8 @@ class JaxShardedInferenceEngine(InferenceEngine):
     like the draft chain; continues while the session's index is alive."""
     if self._draft_params is not None or not self.spec_decode or not self._spec_ngram_on:
       return False
+    if getattr(session, "adapter_slot", 0):
+      return False  # n-gram chunks verify the BASE target; adapter sessions decode plain
     if temp is not None and float(temp) > 0.0:
       return False
     if session.ngram_index is not None or session.ngram_unread:
@@ -1237,6 +1283,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       toks, session.kv_cache = fused_decode(
         self.params, self.cfg, shard, token, session.kv_cache, start_pos, n_steps,
         temp=float(temp), top_k=int(top_k), key=sub,
+        adapter_ids=self._session_adapter_ids(session, B),
       )
     session.next_token_dev = toks[:, -1:]
     session.curr_pos += n_steps
@@ -1278,6 +1325,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     spec_gamma = self._spec_gamma_for_dispatch() if self._draft_params is not None else 0
     if (
       self._draft_params is not None
+      and not getattr(session, "adapter_slot", 0)  # spec verifies the BASE target; adapter sessions stay plain
       and (temp is None or float(temp) <= 0.0)
       and session.prompt_np is not None
       and session.curr_pos == session.prompt_len  # fresh after prefill (no chunk history to replay into the draft)
@@ -1310,6 +1358,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
       buf, _n, session.kv_cache = fused_generate(
         self.params, self.cfg, shard, token, session.kv_cache, start_pos, steps,
         eos_ids=eos, temp=float(temp), top_k=int(top_k), key=sub, n_limit=limit,
+        adapter_ids=self._session_adapter_ids(session, B),
       )
     # ONE host readback: the step count is recovered from the first EOS hit
     # (the while_loop stops right after writing it), not fetched separately —
@@ -1507,6 +1556,7 @@ class JaxShardedInferenceEngine(InferenceEngine):
     HBM is budgeted ahead of time by the static cache allocation.
     """
     self.params = None
+    self.adapter_registry = None
     self.shard = None
     self._effective_shard = None
     self.cfg = None
@@ -1589,12 +1639,130 @@ class JaxShardedInferenceEngine(InferenceEngine):
 
   def attach_lora(self, rank: int, key=None) -> None:
     """Attach LoRA adapters to the loaded model in ANY serving mode (the
-    train CLI's --lora-rank path; train/lora.py add_lora)."""
+    train CLI's --lora-rank path; train/lora.py add_lora).
+
+    This is the TRAINING attach (one adapter, unmerged leaves). For
+    SERVING many adapters at once, use ``enable_multi_lora`` + the adapter
+    registry (ISSUE 15) instead of merging one checkpoint per process."""
     from ..train.lora import add_lora
 
     key = jax.random.PRNGKey(0) if key is None else key
     self._adopt_flat_params(add_lora(self._flat_params_view(), rank, key))
     self._train_state = None  # param structure changed: new opt state + jits
+
+  # ------------------------------------------------- multi-LoRA (ISSUE 15)
+
+  def enable_multi_lora(self, capacity: int | None = None, rank: int | None = None, host_budget_bytes: int | None = None):
+    """Turn on batched multi-LoRA serving: install all-zero STACKED adapter
+    leaves ``{wq,wv}_alora_{a,b} [L, n_slots, ...]`` on the LORA_TARGETS
+    projections (slot 0 stays zero = base model) and build the
+    ``inference/adapters.py`` registry over them. Returns the registry, or
+    None when ``XOT_TPU_LORA=0`` (byte-identical base serving — the hook is
+    never traced). Capacity rounds UP to a power of two: slot count and
+    rank are compiled shapes, so adapter loads/evictions afterwards are
+    pure content swaps — never a recompile.
+
+    Single-device fused path only (the same reach as the fused batched
+    programs); MLA models are refused (their LoRA targets map onto the
+    latent up-projections the per-row hook does not cover)."""
+    from .adapters import ADAPTER_TARGETS, AdapterRegistry, lora_capacity, lora_enabled, lora_rank, round_pow2
+
+    if not lora_enabled():
+      return None
+    existing = getattr(self, "adapter_registry", None)
+    if existing is not None:
+      return existing
+    if self.cfg is None or self.params is None:
+      raise RuntimeError("load a model before enabling multi-LoRA")
+    if self.cfg.is_mla:
+      raise ValueError("multi-LoRA serving does not support MLA models (wq/wv targets map onto latent projections)")
+    if self._pp is not None or self.mesh is not None:
+      raise ValueError("multi-LoRA serving requires the single-device fused path (no pp/sp/tp serving mesh)")
+    cap = round_pow2(capacity) if capacity else lora_capacity()
+    rank = int(rank or lora_rank())
+    params = dict(self.params)
+    geometry: dict = {}
+    for stack in ("layers", "moe_layers"):
+      if stack not in params:
+        continue
+      layers = dict(params[stack])
+      geo: dict = {}
+      for t in ADAPTER_TARGETS:
+        w = layers.get(t)
+        if w is None:
+          continue
+        L, d_in, d_out = int(w.shape[0]), int(w.shape[1]), int(w.shape[2])
+        geo[t] = (L, d_in, d_out)
+        layers[f"{t}_alora_a"] = jnp.zeros((L, cap, d_in, rank), self.cfg.dtype)
+        layers[f"{t}_alora_b"] = jnp.zeros((L, cap, rank, d_out), self.cfg.dtype)
+      if geo:
+        params[stack] = layers
+        geometry[stack] = geo
+    if not geometry:
+      raise ValueError("the loaded model has no LoRA target projections (wq/wv)")
+    self.params = params
+    self.adapter_registry = AdapterRegistry(
+      geometry=geometry, rank=rank, capacity=cap, install=self._install_adapter_slot,
+      host_budget_bytes=host_budget_bytes,
+    )
+    self._session_adapters: dict[str, str] = {}
+    # Param structure changed: the pooled caches and every compiled serving
+    # program re-trace against the new pytree.
+    self.sessions.clear()
+    self._drop_batched_server()
+    return self.adapter_registry
+
+  def _install_adapter_slot(self, slot: int, arrays: dict) -> None:
+    """Registry install hook: functionally write one adapter's (rank-padded)
+    factors into device slot ``slot`` of the stacked leaves. Content-only —
+    shapes never change, so no compiled program invalidates; in-flight
+    dispatches captured the previous leaf buffers (the leaves are never
+    donated) and the next dispatch reads the fresh ones."""
+    params = dict(self.params)
+    for stack, per in arrays.items():
+      layers = dict(params[stack])
+      for t, (a, b) in per.items():
+        la, lb = layers[f"{t}_alora_a"], layers[f"{t}_alora_b"]
+        layers[f"{t}_alora_a"] = la.at[:, slot].set(jnp.asarray(a, la.dtype))
+        layers[f"{t}_alora_b"] = lb.at[:, slot].set(jnp.asarray(b, lb.dtype))
+      params[stack] = layers
+    self.params = params
+
+  def set_request_adapter(self, request_id: str, name: str | None) -> None:
+    """Select a named adapter for a request served on the SOLO path (the
+    batched scheduler takes the name through ``submit(adapter=...)``
+    instead). Validated against the registry up front — an unknown name
+    must 400 at the API, not fail mid-prefill."""
+    if not name:
+      return
+    from .adapters import check_known
+
+    check_known(getattr(self, "adapter_registry", None), name)
+    adapters = getattr(self, "_session_adapters", None)
+    if adapters is None:
+      adapters = self._session_adapters = {}
+    adapters[request_id] = name
+    while len(adapters) > 1024:  # client-driven keyspace: stay bounded
+      adapters.pop(next(iter(adapters)))
+
+  def _acquire_session_slot(self, request_id: str) -> int:
+    """Resolve (and pin) the solo session's adapter slot at session-creation
+    time; 0 = base. Dead solo pins (sessions dropped without an unpin —
+    replay-epoch invalidation, clear_session) are swept here, so a leaked
+    pin can never permanently shrink the evictable slot set."""
+    reg = getattr(self, "adapter_registry", None)
+    name = getattr(self, "_session_adapters", {}).get(request_id)
+    if reg is None or name is None:
+      return 0
+    for holder in reg.pinned_holders():
+      if isinstance(holder, tuple) and holder[0] == "solo" and holder[1] != request_id and holder[1] not in self.sessions:
+        reg.unpin(holder)
+    return reg.acquire(name, holder=("solo", request_id))
+
+  def _session_adapter_ids(self, session, B: int):
+    if not getattr(session, "adapter_slot", 0):
+      return None
+    return jnp.full((B,), int(session.adapter_slot), dtype=jnp.int32)
 
   async def score_tokens(self, shard: Shard, tokens, n_scored: int, top_n: int):
     """Post-hoc logprobs for the last ``n_scored`` tokens (OpenAI logprobs).

@@ -387,6 +387,22 @@ def kv_cache_bytes(cfg, n_layers: int, n_tokens: int, quant: str = "") -> int:
   return int(n_layers) * int(n_tokens) * int(per_token)
 
 
+def lora_device_bytes(n_layers: int, d_in: int, d_out: int, rank: int, n_slots: int, itemsize: int = 4) -> int:
+  """HBM bytes of ONE target projection's stacked LoRA slot factors
+  (ISSUE 15): ``A [L, n_slots, d_in, r]`` + ``B [L, n_slots, r, d_out]``.
+  The adapter analogue of the draft-cache block math — the registry's
+  capacity is pre-allocated, so enabling multi-LoRA deducts this from the
+  default page budget up front and can never oversubscribe admission."""
+  return int(n_layers) * int(n_slots) * int(rank) * (int(d_in) + int(d_out)) * int(itemsize)
+
+
+def lora_pages_equivalent(device_bytes: int, page_bytes: int) -> int:
+  """Adapter-stack bytes expressed in pages of the serving pool (ceil) —
+  what the scheduler subtracts from the default pool size, mirroring the
+  draft-KV deduction (ISSUE 7)."""
+  return -(-int(device_bytes) // max(int(page_bytes), 1))
+
+
 def pages_to_cover(end_pos: int, page_size: int) -> int:
   """Pages a row needs so every position in ``[0, end_pos)`` maps to an
   allocated block-table entry.

@@ -62,6 +62,10 @@ DEFAULT_PRIORITY = "standard"
 QOS_META_PRIORITY = "x-qos-priority"
 QOS_META_TENANT = "x-qos-tenant"
 QOS_META_DEADLINE = "x-qos-deadline-ms"
+# Multi-LoRA (ISSUE 15): the request's named adapter rides the same
+# metadata path, so a downstream node (disagg decode target, drain
+# survivor) serves the SAME adapter the origin's API selected.
+QOS_META_ADAPTER = "x-adapter"
 
 MAX_WIRE_ENTRIES = 2048
 # Per-tenant bucket/fairness state is LRU-bounded the same way: the tenant
@@ -610,7 +614,7 @@ class QosWire:
     self._entries: "OrderedDict[str, dict]" = OrderedDict()
     self._lock = threading.Lock()
 
-  def register(self, request_id: str, *, priority=None, tenant=None, deadline_ms=None, node_id: str | None = None) -> None:
+  def register(self, request_id: str, *, priority=None, tenant=None, deadline_ms=None, adapter=None, node_id: str | None = None) -> None:
     if not request_id:
       return
     with self._lock:
@@ -620,7 +624,7 @@ class QosWire:
         # ships the REMAINING budget, so every hop inherits a decayed
         # deadline instead of restarting the full SLO (time already spent
         # queueing on the origin is never forgiven downstream).
-        entry = self._entries[request_id] = {"priority": None, "tenant": None, "deadline_ms": None, "seen_by": set(), "t_register": time.monotonic()}
+        entry = self._entries[request_id] = {"priority": None, "tenant": None, "deadline_ms": None, "adapter": None, "seen_by": set(), "t_register": time.monotonic()}
         while len(self._entries) > MAX_WIRE_ENTRIES:
           self._entries.popitem(last=False)
       if priority is not None:
@@ -629,6 +633,8 @@ class QosWire:
         entry["tenant"] = str(tenant)
       if deadline_ms is not None:
         entry["deadline_ms"] = float(deadline_ms)
+      if adapter is not None:
+        entry["adapter"] = str(adapter)[:128]
       if node_id:
         entry["seen_by"].add(node_id)
       self._entries.move_to_end(request_id)
@@ -642,8 +648,8 @@ class QosWire:
       # a gRPC thread's concurrent mark_seen on the live entry.
       return {**entry, "seen_by": set(entry["seen_by"])}
 
-  def mark_seen(self, request_id: str, node_id: str, *, priority=None, tenant=None, deadline_ms=None) -> None:
-    self.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, node_id=node_id)
+  def mark_seen(self, request_id: str, node_id: str, *, priority=None, tenant=None, deadline_ms=None, adapter=None) -> None:
+    self.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, adapter=adapter, node_id=node_id)
 
   def remaining_deadline_ms(self, request_id: str) -> float | None:
     """The request's REMAINING end-to-end budget in ms (None when it
@@ -682,6 +688,8 @@ def qos_metadata(request_id: str) -> list[tuple[str, str]]:
     out.append((QOS_META_PRIORITY, str(entry["priority"])))
   if entry.get("tenant"):
     out.append((QOS_META_TENANT, str(entry["tenant"])))
+  if entry.get("adapter"):
+    out.append((QOS_META_ADAPTER, str(entry["adapter"])))
   remaining = qos_wire.remaining_deadline_ms(request_id)
   if remaining is not None:
     out.append((QOS_META_DEADLINE, str(round(remaining, 3))))

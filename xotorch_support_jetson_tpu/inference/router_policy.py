@@ -18,7 +18,15 @@ Decision ladder per request (first hit wins):
    keys longest-first and sticks to the replica that served the session,
    with no advert round-trip on the hot path.
 
-2. PREFIX AFFINITY — the prompt's page-aligned prefix chain
+2. ADAPTER AFFINITY (ISSUE 15) — when the request names a multi-LoRA
+   adapter, restrict the remaining ladder to replicas advertising it
+   DEVICE-RESIDENT (``/v1/router/stats`` → ``lora_adapters``): the request
+   lands where its adapter needs zero swap; a miss costs one host-restore
+   or checkpoint load on the chosen replica, never a recompile. When no
+   replica advertises it, the restriction is dropped (any replica can load
+   it) — affinity is a hint, not a gate.
+
+3. PREFIX AFFINITY — the prompt's page-aligned prefix chain
    (``PageAllocator.chain_keys``, the same content-addressed hashes the KV
    tier advertises) matched against each replica's advertised prefix keys
    (``/v1/router/stats`` → ``BatchedServer.prefix_hexes``): the request
@@ -27,7 +35,7 @@ Decision ladder per request (first hit wins):
    are HINTS with a TTL (``kv_tier.advert_ttl_s``): a stale advert stops
    steering and costs at worst one recomputed prefill, never correctness.
 
-3. WEIGHTED-LEAST-LOADED fallback — ``sched_admission.load_score`` over
+4. WEIGHTED-LEAST-LOADED fallback — ``sched_admission.load_score`` over
    the advertised aggregates (slot occupancy, queue pressure, page-pool
    pressure, fast-window SLO burn): the same scoring the N×M disagg role
    pools rank with.
@@ -259,9 +267,13 @@ class RouterPolicy:
       return None
     return best[2], -best[0]
 
-  def choose(self, chain_keys: list[bytes], exclude: set[str] | frozenset = frozenset()) -> tuple[str | None, str, int]:
+  def choose(self, chain_keys: list[bytes], exclude: set[str] | frozenset = frozenset(), adapter: str | None = None) -> tuple[str | None, str, int]:
     """→ (replica_id | None, source, matched_pages). ``source`` ∈
-    {"session", "advert", "load"}; None means no eligible replica."""
+    {"session", "adapter", "advert", "load"}; None means no eligible
+    replica. ``adapter`` engages the ADAPTER-affinity rung: session
+    stickiness still wins (the session replica already holds the adapter
+    from turn 1), then the remaining ladder restricts to replicas
+    advertising the adapter device-resident when any does."""
     views = self.eligible(exclude)
     if not views:
       return None, "none", 0
@@ -269,6 +281,12 @@ class RouterPolicy:
       hit = self._session_hit(chain_keys, views)
       if hit is not None:
         return hit[0], "session", hit[1]
+    restricted = False
+    if adapter and affinity_enabled():
+      sub = [v for v in views if adapter in (v.stats.get("lora_adapters") or ())]
+      if sub:
+        views, restricted = sub, True
+    if affinity_enabled() and chain_keys:
       hit = self._advert_hit(chain_keys, views)
       if hit is not None:
         return hit[0], "advert", hit[1]
@@ -280,7 +298,7 @@ class RouterPolicy:
     ties = [v for v in scored if sched_admission.load_score(v.stats) - sched_admission.load_score(scored[0].stats) <= 1e-9]
     pick = ties[self._rr % len(ties)]
     self._rr += 1
-    return pick.node_id, "load", 0
+    return pick.node_id, "adapter" if restricted else "load", 0
 
   # ------------------------------------------------- cluster tenant limits
 

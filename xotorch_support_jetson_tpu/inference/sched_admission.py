@@ -77,6 +77,13 @@ class _Request:
   # remaining prefill chunks).
   disagg_target: str | None = None
   kv_streamed: int = 0
+  # Multi-LoRA serving (ISSUE 15): the named adapter this request selected
+  # (None = base model) and the device slot admission resolved it to. The
+  # NAME survives preempt-resume / drain-migration carries — the resumed
+  # incarnation re-resolves a (possibly different) slot at its own
+  # admission, so a preempted row keeps its adapter across the carry.
+  adapter: str | None = None
+  adapter_slot: int = 0
 
 
 class AdmissionControl:

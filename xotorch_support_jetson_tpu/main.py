@@ -63,7 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
   parser.add_argument("--batch-size", type=int, default=1)
   parser.add_argument("--seq-len", type=int, default=512)
   parser.add_argument("--lr", type=float, default=1e-5)
-  parser.add_argument("--lora-rank", type=int, default=0, help=">0 enables LoRA with this rank")
+  # TRAINING-side LoRA attach (one adapter). For SERVING fine-tuned
+  # variants, do NOT merge one checkpoint per process: point
+  # XOT_TPU_LORA_DIR at a directory of adapter .npz files and the engine
+  # serves EVERY variant from one resident base model (the multi-LoRA
+  # registry, inference/adapters.py — select per request via the `model`
+  # field / x-adapter header; see README "Multi-LoRA serving").
+  parser.add_argument("--lora-rank", type=int, default=0, help=">0 enables LoRA with this rank (training; serving uses XOT_TPU_LORA_DIR + the adapter registry)")
   parser.add_argument("--save-every", type=int, default=0)
   parser.add_argument("--save-checkpoint-dir", type=str, default="checkpoints")
   parser.add_argument("--resume-checkpoint", type=str, default=None)

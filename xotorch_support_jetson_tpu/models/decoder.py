@@ -45,6 +45,21 @@ Params = dict
 QUANT_COMPUTE = os.getenv("XOT_TPU_QUANT_COMPUTE", "w8a16")
 
 
+def _alora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+  """Per-row adapter-indexed low-rank delta (the Punica BGMV idea, ISSUE 15).
+
+  x [B,S,D]; a [n_slots, D, r] / b [n_slots, r, O] are one layer's STACKED
+  LoRA factors (inference/adapters.py keeps slot 0 all-zero = base model);
+  ids [B] int32 is the TRACED per-row adapter slot — adapter mix changes
+  never recompile, exactly the per-row-gamma philosophy. The gather
+  materializes [B, D, r] + [B, r, O] per layer (rank r is small), and the
+  scale is train/lora.py's fixed alpha = 2·rank ⇒ 2."""
+  a_sel = jnp.take(a, ids, axis=0)  # [B, D, r]
+  b_sel = jnp.take(b, ids, axis=0)  # [B, r, O]
+  h = jnp.einsum("bsd,bdr->bsr", x, a_sel)
+  return (jnp.einsum("bsr,bro->bso", h, b_sel) * 2.0).astype(x.dtype)
+
+
 def _mm(x: jnp.ndarray, p: Params, name: str, compute: str = "") -> jnp.ndarray:
   """x @ p[name], transparently dequantizing int8 leaves (``<name>_scale``).
 
@@ -315,11 +330,16 @@ def _mla_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
   return q, k, v
 
 
-def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
+def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq, adapter_ids=None):
   """Dense-attention q/k/v projections (+LoRA, qkv bias, rope applied).
 
   x [B,S,D] → q [B,S,Hq,hd], k/v [B,S,Hkv,hd]. Shared by the contiguous-cache
   layer step below and the paged decode step (``_paged_layer_step``).
+
+  ``adapter_ids`` [B] int32 (ISSUE 15): per-row MULTI-LoRA application from
+  the stacked ``*_alora_a``/``*_alora_b`` leaves (inference/adapters.py
+  installs them on the LORA_TARGETS projections; slot 0 is all-zero = base).
+  None skips the hook entirely — base serving never pays the gather.
   """
   B, S, _ = x.shape
   q = _mm(x, p, "wq", cfg.quant_compute)
@@ -330,6 +350,10 @@ def _dense_qkv(x, p, cfg: ModelConfig, positions, inv_freq):
     q = q + ((x @ p["wq_lora_a"]) @ p["wq_lora_b"]) * 2.0
   if "wv_lora_a" in p:
     v = v + ((x @ p["wv_lora_a"]) @ p["wv_lora_b"]) * 2.0
+  if adapter_ids is not None and "wq_alora_a" in p:
+    q = q + _alora_delta(x, p["wq_alora_a"], p["wq_alora_b"], adapter_ids)
+  if adapter_ids is not None and "wv_alora_a" in p:
+    v = v + _alora_delta(x, p["wv_alora_a"], p["wv_alora_b"], adapter_ids)
   if "bq" in p:
     q = q + p["bq"]
     k = k + p["bk"]
@@ -419,7 +443,7 @@ def _mlp_block(h, p, cfg: ModelConfig):
   return h, aux
 
 
-def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None):
+def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: ModelConfig, use_cache: bool, attn_fn=None, adapter_ids=None):
   """One decoder layer. h [B,S,D] → (h, new_kv, aux).
 
   ``kv`` is this layer's cache dict ({"k", "v"} [+ "k_scale"/"v_scale" when
@@ -460,7 +484,7 @@ def _layer_step(h, layer_params, kv, positions, kv_positions, inv_freq, cfg: Mod
     if "wkv_a" in p:  # MLA, cache-less (training): naive per-head K/V
       q, k, v = _mla_qkv(x, p, cfg, positions, inv_freq)
     else:
-      q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+      q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq, adapter_ids)
 
     if use_cache:
       start = positions[:, 0]
@@ -561,6 +585,7 @@ def shard_forward(
   positions: jnp.ndarray,  # [B,S] absolute positions
   kv_cache: Params | None = None,
   head_pos: jnp.ndarray | None = None,  # [B] per-row S-axis index for the head
+  adapter_ids: jnp.ndarray | None = None,  # [B] per-row LoRA slot (ISSUE 15)
 ) -> tuple[jnp.ndarray, Params | None]:
   """Run the shard's layer range. Returns (hidden|logits, updated cache).
 
@@ -596,7 +621,7 @@ def shard_forward(
       def body(carry, per_layer):
         h = carry
         lp, kv = per_layer
-        h, kv, _ = _layer_step(h, lp, kv, positions, kv_positions, inv_freq, cfg, True)
+        h, kv, _ = _layer_step(h, lp, kv, positions, kv_positions, inv_freq, cfg, True, adapter_ids=adapter_ids)
         return h, kv
 
       h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in kv_cache.items()}))
@@ -607,7 +632,7 @@ def shard_forward(
 
     def body(carry, lp):
       h = carry
-      h, _, _ = _layer_step(h, lp, None, positions, kv_positions, inv_freq, cfg, False)
+      h, _, _ = _layer_step(h, lp, None, positions, kv_positions, inv_freq, cfg, False, adapter_ids=adapter_ids)
       return h, None
 
     for stack in stacks:
@@ -675,10 +700,10 @@ def _next_token(row, key, greedy: bool, temp, top_k: int):
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "top_k", "greedy"), donate_argnums=(4,))
-def _fused_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp, top_k: int, greedy: bool, key):
+def _fused_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp, top_k: int, greedy: bool, key, adapter_ids):
   def body(carry, _):
     tok, pos, cache, key = carry
-    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache, adapter_ids=adapter_ids)
     nxt, key = _next_token(logits[:, 0, :], key, greedy, temp, top_k)
     return (nxt[:, None], pos + 1, cache, key), nxt
 
@@ -686,12 +711,13 @@ def _fused_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, sta
   return jnp.moveaxis(toks, 0, 1), cache
 
 
-def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp: float = 0.0, top_k: int = 35, key=None):
+def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp: float = 0.0, top_k: int = 35, key=None, adapter_ids=None):
   """Generate ``n_steps`` tokens in ONE compiled program (lax.scan over steps).
 
   The single-node serving fast path: no host round-trip per token, cache
   donated and updated in place. token [B,1] int32; start_pos [B] int32.
   Returns (tokens [B, n_steps], cache). Requires a full-model shard.
+  ``adapter_ids`` [B] selects each row's LoRA slot (ISSUE 15; None = base).
   """
   if not (shard.is_first_layer and shard.is_last_layer):
     raise ValueError("fused_decode requires a full-model shard")
@@ -699,11 +725,11 @@ def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos
     key = jax.random.PRNGKey(0)
   greedy = temp is None or float(temp) <= 0.0
   temp_arr = jnp.float32(1.0 if greedy else float(temp))
-  return _fused_decode_impl(params, cfg, shard, token, cache, start_pos, int(n_steps), temp_arr, int(top_k), greedy, key)
+  return _fused_decode_impl(params, cfg, shard, token, cache, start_pos, int(n_steps), temp_arr, int(top_k), greedy, key, adapter_ids)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "max_steps", "top_k", "eos_ids", "greedy"), donate_argnums=(4,))
-def _fused_generate_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, max_steps: int, eos_ids: tuple, temp, top_k: int, greedy: bool, key, n_limit):
+def _fused_generate_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, max_steps: int, eos_ids: tuple, temp, top_k: int, greedy: bool, key, n_limit, adapter_ids):
   B = token.shape[0]
   eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
   limit = jnp.minimum(n_limit.astype(jnp.int32), max_steps)
@@ -716,7 +742,7 @@ def _fused_generate_impl(params, cfg: ModelConfig, shard: Shard, token, cache, s
 
   def body(carry):
     tok, pos, cache, key, buf, i, done = carry
-    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    logits, cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache, adapter_ids=adapter_ids)
     nxt, key = _next_token(logits[:, 0, :], key, greedy, temp, top_k)
     buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
     if eos is not None:
@@ -740,6 +766,7 @@ def fused_generate(
   top_k: int = 35,
   key=None,
   n_limit=None,
+  adapter_ids=None,
 ):
   """Generate until EOS (or a step limit) in ONE compiled program.
 
@@ -768,7 +795,7 @@ def fused_generate(
   temp_arr = jnp.float32(1.0 if greedy else float(temp))
   limit = jnp.int32(max_steps if n_limit is None else n_limit)
   return _fused_generate_impl(
-    params, cfg, shard, token, cache, start_pos, int(max_steps), tuple(eos_ids), temp_arr, int(top_k), greedy, key, limit
+    params, cfg, shard, token, cache, start_pos, int(max_steps), tuple(eos_ids), temp_arr, int(top_k), greedy, key, limit, adapter_ids
   )
 
 
@@ -964,7 +991,7 @@ def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard"))
-def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens):
+def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, adapter_ids=None):
   """Prefill K requests into K pool rows in ONE dispatch.
 
   tokens [K, S_pad] int32 (each row its own prompt, zero-padded to the
@@ -983,13 +1010,13 @@ def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, ro
   K, S = tokens.shape
   positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
   sub = {k: jnp.take(v, rows, axis=1) for k, v in cache.items()}
-  logits, sub = shard_forward(params, cfg, shard, tokens, positions, sub, head_pos=prompt_lens - 1)
+  logits, sub = shard_forward(params, cfg, shard, tokens, positions, sub, head_pos=prompt_lens - 1, adapter_ids=adapter_ids)
   cache = {k: cache[k].at[:, rows].set(sub[k]) for k in cache}
   return logits[:, 0, :], cache
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "page_size"))
-def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
+def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, adapter_ids=None):
   """``prefill_into_pages`` for K requests in ONE dispatch.
 
   tokens [K, S_pad] int32 — each row's prompt SUFFIX from its own
@@ -1005,7 +1032,7 @@ def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool
   K, S = tokens.shape
   temp = {key: gather_row_pages(val, bt_rows) for key, val in pool.items()}
   positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
-  logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp, head_pos=prompt_lens - prefix_lens - 1)
+  logits, temp = shard_forward(params, cfg, shard, tokens, positions, temp, head_pos=prompt_lens - prefix_lens - 1, adapter_ids=adapter_ids)
   target = touched_page_targets(bt_rows, prefix_lens, prompt_lens, page_size)
   pool = {key: scatter_row_pages(pool[key], temp[key], target) for key in pool}
   return logits[:, 0, :], pool
@@ -1040,21 +1067,21 @@ def sample_rows(logits, key, temps, top_ks, k_max: int):
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "k_max"))
-def prefill_into_slots_sampled(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, temps, top_ks, key, k_max: int):
+def prefill_into_slots_sampled(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, temps, top_ks, key, k_max: int, adapter_ids=None):
   """``prefill_into_slots`` with the sampling epilogue fused in-program.
 
   Returns (first_tokens [K] int32, cache) — one dispatch where the unfused
   path took two."""
-  last, cache = prefill_into_slots(params, cfg, shard, tokens, cache, rows, prompt_lens)
+  last, cache = prefill_into_slots(params, cfg, shard, tokens, cache, rows, prompt_lens, adapter_ids)
   tok, _ = _next_token_batched(last, key, temps, top_ks, k_max)
   return tok, cache
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "page_size", "k_max"))
-def prefill_into_pages_many_sampled(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, key, k_max: int):
+def prefill_into_pages_many_sampled(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, key, k_max: int, adapter_ids=None):
   """``prefill_into_pages_many`` with the sampling epilogue fused in-program
   (the paged-admission analogue of ``prefill_into_slots_sampled``)."""
-  last, pool = prefill_into_pages_many(params, cfg, shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size)
+  last, pool = prefill_into_pages_many(params, cfg, shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size, adapter_ids)
   tok, _ = _next_token_batched(last, key, temps, top_ks, k_max)
   return tok, pool
 
@@ -1072,10 +1099,10 @@ def _next_token_batched(rows, key, temps, top_ks, k_max: int):
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max"), donate_argnums=(4,))
-def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key):
+def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key, adapter_ids):
   def body(carry, _):
     tok, pos, cache, key = carry
-    logits, new_cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache)
+    logits, new_cache = shard_forward(params, cfg, shard, tok, pos[:, None], cache, adapter_ids=adapter_ids)
     nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_ks, k_max)
     nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold their token
     pos = jnp.where(active, pos + 1, pos)  # ...and their position
@@ -1085,7 +1112,7 @@ def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cach
   return jnp.moveaxis(toks, 0, 1), next_tok, pos, cache
 
 
-def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, key=None):
+def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, key=None, adapter_ids=None):
   """One compiled decode chunk over the whole slot pool.
 
   token [B,1] int32 (each row's last token; inactive rows ignored),
@@ -1106,7 +1133,7 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
   B = token.shape[0]
   top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
   return _fused_batch_decode_impl(
-    params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), key
+    params, cfg, shard, token, cache, positions, active.astype(jnp.bool_), jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), key, adapter_ids
   )
 
 
@@ -1120,7 +1147,7 @@ def fused_batch_decode(params, cfg: ModelConfig, shard: Shard, token, cache, pos
 # writes land in the reserved trash page 0).
 
 
-def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool):
+def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool, adapter_ids=None):
   """One decoder layer against the page pool — decode only (S == 1).
 
   ``pool_l`` is this layer's page dict: {"k", "v"} [P, Hkv, ps, hd]
@@ -1141,7 +1168,7 @@ def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: Mode
     attn = paged_mla_attention_ref(q_nope, q_pe, k_pool.astype(h.dtype), v_pool.astype(h.dtype), block_tables, lengths, _mla_w_kv_b(p, h.dtype), cfg.v_head_dim, page_size)
     pool_l = {"k": k_pool, "v": v_pool}
   else:
-    q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+    q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq, adapter_ids)
     if "k_scale" in pool_l:  # int8/int4 KV pages (models/quantize.py)
       from .quantize import quantize_kv, quantize_kv_int4
 
@@ -1186,7 +1213,7 @@ def _paged_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: Mode
   return h, pool_l
 
 
-def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool):
+def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool, adapter_ids=None):
   """One decode step for all rows against the page pool.
 
   tokens [B, 1] int32 → (logits [B, 1, V], updated pool). Full shard only
@@ -1202,7 +1229,7 @@ def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
     def body(carry, per_layer):
       h = carry
       lp, pool_l = per_layer
-      h, pool_l = _paged_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel)
+      h, pool_l = _paged_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel, adapter_ids)
       return h, pool_l
 
     h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
@@ -1212,7 +1239,7 @@ def paged_decode_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
   return head_logits(params, cfg, h), new_pool
 
 
-def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key, adapter_ids=None):
   """The chunked paged decode loop shared by ``fused_paged_batch_decode``
   and the mixed-tick program below — ONE definition of the per-step math, so
   the mixed tick's decode half is the plain program's decode half by
@@ -1224,7 +1251,7 @@ def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, bloc
     # their table to the trash page so held-token rewrites can't land on a
     # page another row now owns.
     bt = jnp.where(active[:, None], block_tables, 0)
-    logits, pool = paged_decode_forward(params, cfg, shard, tok, pos[:, None], pool, bt, page_size, use_kernel)
+    logits, pool = paged_decode_forward(params, cfg, shard, tok, pos[:, None], pool, bt, page_size, use_kernel, adapter_ids)
     nxt, key = _next_token_batched(logits[:, 0, :], key, temps, top_ks, k_max)
     nxt = jnp.where(active, nxt, tok[:, 0])  # inactive rows hold their token
     pos = jnp.where(active, pos + 1, pos)  # ...and their position
@@ -1235,11 +1262,11 @@ def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, bloc
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
-def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
-  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key)
+def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key, adapter_ids):
+  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key, adapter_ids)
 
 
-def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
+def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None, adapter_ids=None):
   """``fused_batch_decode`` against the page pool.
 
   Same contract plus ``block_tables`` [B, mp] int32 — the host must have
@@ -1271,7 +1298,7 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
   top_ks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
   return _fused_paged_batch_decode_impl(
     params, cfg, shard, token, pool, jnp.asarray(block_tables, jnp.int32), positions, active.astype(jnp.bool_),
-    jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
+    jnp.asarray(temps, jnp.float32), top_ks, int(n_steps), int(k_max), int(page_size), bool(use_kernel), key, adapter_ids,
   )
 
 
@@ -1293,7 +1320,7 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
-def _fused_mixed_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key):
+def _fused_mixed_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key, adapter_ids, pf_adapter):
   from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
 
   # Prefill half: the SAME gather → shard_forward → scatter math as
@@ -1305,15 +1332,15 @@ def _fused_mixed_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard,
   S = pf_tokens.shape[1]
   temp_c = {k: gather_row_pages(v, pf_bt) for k, v in pool.items()}
   ppos = pf_prefix[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
-  _, temp_c = shard_forward(params, cfg, shard, pf_tokens, ppos, temp_c, head_pos=pf_end - pf_prefix - 1)
+  _, temp_c = shard_forward(params, cfg, shard, pf_tokens, ppos, temp_c, head_pos=pf_end - pf_prefix - 1, adapter_ids=pf_adapter)
   target = touched_page_targets(pf_bt, pf_prefix, pf_end, page_size)
   pool = {k: scatter_row_pages(pool[k], temp_c[k], target) for k in pool}
 
   # Decode half: the plain program's scan, verbatim (_paged_decode_scan).
-  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key)
+  return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key, adapter_ids)
 
 
-def fused_mixed_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None):
+def fused_mixed_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, key=None, adapter_ids=None, pf_adapter=None):
   """``fused_paged_batch_decode`` with one admission's prefill slice fused in.
 
   Decode operands as in ``fused_paged_batch_decode``; the prefill slice is
@@ -1346,6 +1373,7 @@ def fused_mixed_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token
     jnp.asarray(temps, jnp.float32), top_ks, jnp.asarray(pf_tokens, jnp.int32), jnp.asarray(pf_bt, jnp.int32),
     jnp.asarray(pf_prefix, jnp.int32), jnp.asarray(pf_end, jnp.int32),
     int(n_steps), int(k_max), int(page_size), bool(use_kernel), key,
+    adapter_ids, None if pf_adapter is None else jnp.asarray(pf_adapter, jnp.int32),
   )
 
 
@@ -1373,7 +1401,7 @@ def fused_mixed_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token
 # one-split-per-step exactly.
 
 
-def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool = False, interpret: bool = False):
+def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cfg: ModelConfig, page_size: int, use_kernel: bool = False, interpret: bool = False, adapter_ids=None):
   """One decoder layer for a multi-token VERIFY window against the page pool.
 
   positions [B, W] are each row's own absolute window positions (rows are at
@@ -1391,7 +1419,7 @@ def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cf
   x = rms_norm(h, p["attn_norm"], cfg.norm_eps)
   from ..ops.paged import paged_decode_attention, paged_gqa_attention_ref, write_token_kv
 
-  q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq)
+  q, k, v = _dense_qkv(x, p, cfg, positions, inv_freq, adapter_ids)
   lengths = positions[:, -1] + 1  # valid KV slots incl. the window's writes
 
   def window_attn(k_pool, v_pool, ks_pool=None, vs_pool=None):
@@ -1447,7 +1475,7 @@ def _paged_window_layer_step(h, p, pool_l, block_tables, positions, inv_freq, cf
   return h, pool_l
 
 
-def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool = False, interpret: bool = False):
+def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positions, pool, block_tables, page_size: int, use_kernel: bool = False, interpret: bool = False, adapter_ids=None):
   """W-token forward for every row against the page pool — the batched
   speculative VERIFY pass. tokens/positions [B, W] → (logits [B, W, V],
   updated pool). Full shard only. ``use_kernel`` routes each window
@@ -1466,7 +1494,7 @@ def paged_window_forward(params, cfg: ModelConfig, shard: Shard, tokens, positio
     def body(carry, per_layer):
       h = carry
       lp, pool_l = per_layer
-      h, pool_l = _paged_window_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel, interpret)
+      h, pool_l = _paged_window_layer_step(h, lp, pool_l, block_tables, positions, inv_freq, cfg, page_size, use_kernel, interpret, adapter_ids)
       return h, pool_l
 
     h, new_sub = jax.lax.scan(body, h, (stack, {key: val[off : off + L] for key, val in pool.items()}))
@@ -1597,21 +1625,25 @@ def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, tok
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max"), donate_argnums=(2, 3))
-def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, props, prop_counts, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
+def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, props, prop_counts, adapter_ids, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
   def verify(window, wpos, cache):
-    return shard_forward(params, cfg, shard, window, wpos, cache)
+    # The TARGET applies each row's adapter (ISSUE 15) — greedy identity vs
+    # the merged solo reference holds for ANY draft because the accept rule
+    # compares against the adapter-applied target's own greedy choices; the
+    # draft stays base (a worse draft only lowers acceptance, never output).
+    return shard_forward(params, cfg, shard, window, wpos, cache, adapter_ids=adapter_ids)
 
   return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key, props, prop_counts)
 
 
 @partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size", "use_kernel", "interpret"), donate_argnums=(2, 3))
-def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, props, prop_counts, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
+def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, props, prop_counts, adapter_ids, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
   # Inactive rows' window writes must not land on pages another row may now
   # own: pin their tables to the trash page once (tables are chunk-constant).
   bt = jnp.where(active[:, None], block_tables, 0)
 
   def verify(window, wpos, pool):
-    return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size, use_kernel, interpret)
+    return paged_window_forward(params, cfg, shard, window, wpos, pool, bt, page_size, use_kernel, interpret, adapter_ids)
 
   return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, pool, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key, props, prop_counts)
 
@@ -1644,7 +1676,7 @@ def _spec_props_args(props, prop_counts, B: int, n_rounds: int, gamma_max: int):
   return props, counts
 
 
-def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, cache, cache_d, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, key=None, props=None, prop_counts=None):
+def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, cache, cache_d, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, key=None, props=None, prop_counts=None, adapter_ids=None):
   """``fused_batch_decode`` with draft-then-verify rounds (dense slot cache).
 
   token [B,1] / positions [B] / active [B] / temps [B] as in
@@ -1670,11 +1702,11 @@ def fused_spec_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cf
   props, prop_counts = _spec_props_args(props, prop_counts, token.shape[0], int(n_rounds), int(gamma_max))
   return _fused_spec_batch_decode_impl(
     params, params_d, cache, cache_d, token, positions, active, jnp.minimum(gammas, gamma_max), temps, top_ks, key,
-    props, prop_counts, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max),
+    props, prop_counts, adapter_ids, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max),
   )
 
 
-def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, interpret: bool = False, key=None, props=None, prop_counts=None):
+def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params_d, cfg_d: ModelConfig, shard_d: Shard, token, pool, cache_d, block_tables, positions, active, gammas, temps, n_rounds: int, gamma_max: int, top_k=35, k_max: int = 64, page_size: int = 64, use_kernel: bool | None = None, interpret: bool = False, key=None, props=None, prop_counts=None, adapter_ids=None):
   """``fused_spec_batch_decode`` against the page pool.
 
   Same contract plus ``block_tables`` [B, mp]: the host must have allocated
@@ -1702,7 +1734,7 @@ def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params
   return _fused_spec_paged_batch_decode_impl(
     params, params_d, pool, cache_d, token, jnp.asarray(block_tables, jnp.int32), positions, active,
     jnp.minimum(gammas, gamma_max), temps, top_ks, key,
-    props, prop_counts, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size), bool(use_kernel), bool(interpret),
+    props, prop_counts, adapter_ids, cfg, shard, cfg_d, shard_d, int(n_rounds), int(gamma_max), int(k_max), int(page_size), bool(use_kernel), bool(interpret),
   )
 
 

@@ -27,7 +27,7 @@ from concurrent import futures
 
 import grpc
 
-from ...inference.qos import QOS_META_DEADLINE, QOS_META_PRIORITY, QOS_META_TENANT, qos_wire
+from ...inference.qos import QOS_META_ADAPTER, QOS_META_DEADLINE, QOS_META_PRIORITY, QOS_META_TENANT, qos_wire
 from ...orchestration.tracing import node_now_ns, parse_traceparent, tracer
 from ...utils.helpers import DEBUG
 from ..faults import ChaosInjectedError, chaos
@@ -161,7 +161,7 @@ class GRPCServer:
     if not request_id:
       return
     opts = getattr(self.node, "request_options", {}).get(request_id)
-    if opts and ("priority" in opts or "tenant" in opts or "deadline_ms" in opts):
+    if opts and ("priority" in opts or "tenant" in opts or "deadline_ms" in opts or "adapter" in opts):
       # Already adopted: SendTensor fires once per token per hop on a ring
       # decode, and the identity cannot change mid-request — one adoption
       # per request, not three locked registry writes per token.
@@ -169,7 +169,8 @@ class GRPCServer:
     priority = _meta_get(context, QOS_META_PRIORITY)
     tenant = _meta_get(context, QOS_META_TENANT)
     deadline_raw = _meta_get(context, QOS_META_DEADLINE)
-    if priority is None and tenant is None and deadline_raw is None:
+    adapter = _meta_get(context, QOS_META_ADAPTER)
+    if priority is None and tenant is None and deadline_raw is None and adapter is None:
       return
     deadline_ms = None
     if deadline_raw is not None:
@@ -178,10 +179,10 @@ class GRPCServer:
       except (TypeError, ValueError):
         deadline_ms = None  # a corrupt deadline must not break the RPC
     try:
-      self.node.set_request_options(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms)
+      self.node.set_request_options(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, adapter=adapter)
     except Exception:  # noqa: BLE001 — QoS adoption must never fail a data RPC
       pass
-    qos_wire.mark_seen(request_id, self.node.id, priority=priority, tenant=tenant, deadline_ms=deadline_ms)
+    qos_wire.mark_seen(request_id, self.node.id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, adapter=adapter)
 
   def _record_server_hop(self, request_id: str, method: str, context, *, t_start_ns: int, hop_id: str | None, deserialize_s: float, handler_s: float, payload_bytes: int) -> None:
     from ...utils.metrics import metrics

@@ -630,14 +630,14 @@ class Node:
       await engine.get_batched_server().submit(
         request_id, tokens, max_tokens=remaining, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
         priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
-        deadline_ms=opts.get("deadline_ms"), carry=carried,
+        deadline_ms=opts.get("deadline_ms"), carry=carried, adapter=opts.get("adapter"),
       )
     finally:
       self._finish_request(request_id)
 
   # --------------------------------------------------------------- serving
 
-  def set_request_options(self, request_id: str, *, stream: bool | None = None, max_tokens: int | None = None, temperature: float | None = None, top_k: int | None = None, priority: str | None = None, tenant: str | None = None, deadline_ms: float | None = None) -> None:
+  def set_request_options(self, request_id: str, *, stream: bool | None = None, max_tokens: int | None = None, temperature: float | None = None, top_k: int | None = None, priority: str | None = None, tenant: str | None = None, deadline_ms: float | None = None, adapter: str | None = None) -> None:
     """Per-request serving hints set by the API before ``process_prompt``.
 
     ``stream=False`` lets the fast decode path generate the entire response
@@ -647,16 +647,18 @@ class Node:
     feed the batched scheduler's QoS layer and are registered in the QoS
     wire registry so data-plane RPCs carry them as ``x-qos-*`` metadata
     (inference/qos.py) — a non-head node that runs the scheduler enforces
-    the same policy.
+    the same policy. ``adapter`` (ISSUE 15) selects a named multi-LoRA
+    adapter and rides the same wire registry as ``x-adapter`` metadata, so
+    a disagg decode node or drain survivor serves the same variant.
     """
     opts = self.request_options.setdefault(request_id, {})
-    for k, v in (("stream", stream), ("max_tokens", max_tokens), ("temperature", temperature), ("top_k", top_k), ("priority", priority), ("tenant", tenant), ("deadline_ms", deadline_ms)):
+    for k, v in (("stream", stream), ("max_tokens", max_tokens), ("temperature", temperature), ("top_k", top_k), ("priority", priority), ("tenant", tenant), ("deadline_ms", deadline_ms), ("adapter", adapter)):
       if v is not None:
         opts[k] = v
-    if priority is not None or tenant is not None or deadline_ms is not None:
+    if priority is not None or tenant is not None or deadline_ms is not None or adapter is not None:
       from ..inference.qos import qos_wire
 
-      qos_wire.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, node_id=self.id)
+      qos_wire.register(request_id, priority=priority, tenant=tenant, deadline_ms=deadline_ms, adapter=adapter, node_id=self.id)
 
   def _request_limits(self, request_id: str) -> tuple[int, float, int]:
     opts = self.request_options.get(request_id, {})
@@ -866,6 +868,12 @@ class Node:
       # is weight-bandwidth-bound, so B in-flight requests cost ≈ 1.
       return await self._batched_serve(base_shard, shard, prompt, request_id, resume_tokens=_resume_tokens_of(inference_state))
     self.outstanding_requests[request_id] = "processing"
+    adapter = self.request_options.get(request_id, {}).get("adapter")
+    if adapter and hasattr(self.inference_engine, "set_request_adapter"):
+      # Solo/streaming parity (ISSUE 15): the engine applies the same
+      # indexed adapter hook per session; raises the client-error type for
+      # unknown names before any device work.
+      self.inference_engine.set_request_adapter(request_id, adapter)
     tracer.stage(request_id, "admitted", {"node_id": self.id}, node=self.id)
     tracer.stage(request_id, "prefill_chunk", {"node_id": self.id}, node=self.id)
     output, state = await self.inference_engine.infer_prompt(request_id, shard, prompt, inference_state)
@@ -922,7 +930,7 @@ class Node:
         request_id, tokens, max_tokens=max_tokens, temp=temp, top_k=top_k, eos_ids=eos_ids, emit=emit,
         priority=opts.get("priority", "standard"), tenant=opts.get("tenant", "default"),
         deadline_ms=opts.get("deadline_ms"), disagg_target=disagg_target,
-        carry=carried or None,
+        carry=carried or None, adapter=opts.get("adapter"),
       )
     except RequestMigratedError:
       # A draining scheduler shipped the row to a surviving peer (graceful
