@@ -185,6 +185,19 @@ def gate_failover(recovery_ms: float | None, lo: float = 1.0, hi: float = 120000
   return float(recovery_ms) if lo <= recovery_ms <= hi else None
 
 
+def gate_compile(value: float | None, lo: float = 0.0, hi: float = 0.0) -> float | None:
+  """Drift gate for the program-ledger round (ISSUE 19). The defaults ARE
+  the steady band: ``steady_state_compiles`` must be exactly 0 — the repo's
+  no-recompile invariant (traced hooks, pow2 pad buckets, static switches)
+  measured, not asserted — so any nonzero count is a broken round and drops
+  to null, which the drift check surfaces as a missing metric.
+  ``warmup_compile_s_total`` rides the same check with a generous
+  plausibility band (``lo=0.0, hi=3600.0``)."""
+  if value is None:
+    return None
+  return float(value) if lo <= value <= hi else None
+
+
 def labeled_hist_delta_quantile(before: dict, after: dict, name: str, q: float, where: dict | None = None) -> float | None:
   """Quantile of a LABELED histogram family's growth between two registry
   snapshots, aggregated across every label series (the per-peer-link RPC
@@ -1180,6 +1193,27 @@ def main() -> None:
     start_pos2 = start_pos2 + n_decode
   tok_per_s = float(np.median(headline_samples))
   headline_spread = round(float(max(headline_samples) - min(headline_samples)), 2)
+
+  # Program-ledger round (ISSUE 19): the warmup sections above compiled the
+  # tracked decode programs — the ledger holds their compile seconds. Mark
+  # steady, run a few more dispatches at already-compiled shapes (positions
+  # are TRACED, so a stale start_pos is the point: mix changes must not
+  # compile), and pin steady-state serving at zero recompiles. Steady is
+  # then unmarked: later rounds compile NEW programs legitimately.
+  from xotorch_support_jetson_tpu.utils.programs import ledger as program_ledger
+
+  warmup_compile_s_total = round(
+    sum(st["compile_s"] for st in program_ledger.snapshot()["families"].values()), 6
+  )
+  steady_compiles_before = program_ledger.steady_compile_count()
+  program_ledger.mark_steady()
+  try:
+    for _ in range(3):
+      toks, cache = fused_decode(params, cfg, shard, first_tok, cache, start_pos, n_decode)
+      _ = np.asarray(toks)
+    steady_state_compiles = program_ledger.steady_compile_count() - steady_compiles_before
+  finally:
+    program_ledger.unmark_steady()
 
   # Serving cadence: the Node's non-streaming fast path — fused_generate
   # (while_loop w/ on-device EOS) generates the whole response in ONE
@@ -2534,6 +2568,8 @@ def main() -> None:
         "preempt_resume_ms_recompute": preempt_resume_ms_recompute,
         "preempt_resume_ms_restore": preempt_resume_ms_restore,
         "preempt_resume_ms_recompute_vs_restore": preempt_resume_ms_recompute_vs_restore,
+        "steady_state_compiles": gate_compile(steady_state_compiles),
+        "warmup_compile_s_total": gate_compile(warmup_compile_s_total, lo=0.0, hi=3600.0),
         "platform": platform,
         "device": str(jax.devices()[0]),
         "n_decode": n_decode,

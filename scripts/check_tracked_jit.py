@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Tracked-jit drift gate (ISSUE 19 satellite).
+
+The device-program ledger (``utils/programs.py``) only measures what flows
+through ``tracked_jit`` — a single raw ``jax.jit`` added to a serving-path
+module is an invisible program: it compiles, stalls requests, and never
+shows up in ``/v1/programs``, the recompile sentinel, or the bench compile
+gate. This script makes that drift a tier-1 failure (tests/test_programs.py
+runs it), the ``check_layering.py`` pattern: AST-based, so aliased and
+function-local usage is caught while a string mention in a comment or
+docstring is not.
+
+A violation is any reference to the ``jit`` attribute of a name bound to the
+``jax`` module (``jax.jit``, ``import jax as j; j.jit``) or ``from jax
+import jit`` in a constrained module. ``utils/programs.py`` itself is the
+one place allowed to touch ``jax.jit`` — it IS the wrapper.
+
+Exit status: 0 clean, 1 with a report of every violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = "xotorch_support_jetson_tpu"
+
+# Serving-path modules that must create jits only through tracked_jit.
+CONSTRAINED: list[str] = [
+  f"{PACKAGE}/models/decoder.py",
+  f"{PACKAGE}/ops/paged.py",
+  f"{PACKAGE}/ops/pallas_attention.py",
+  f"{PACKAGE}/ops/pallas_int4.py",
+  f"{PACKAGE}/ops/sampling.py",
+  f"{PACKAGE}/parallel/pp_batch.py",
+  f"{PACKAGE}/parallel/sp_batch.py",
+  f"{PACKAGE}/inference/kv_tier.py",
+  f"{PACKAGE}/inference/batch_scheduler.py",
+  f"{PACKAGE}/inference/batch_ops.py",
+]
+
+
+def _jax_aliases(tree: ast.AST) -> set[str]:
+  """Names the module binds to the ``jax`` package (``import jax``,
+  ``import jax as j``)."""
+  aliases: set[str] = set()
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Import):
+      for alias in node.names:
+        if alias.name == "jax":
+          aliases.add(alias.asname or "jax")
+  return aliases
+
+
+def violations_in(path: Path) -> list[str]:
+  tree = ast.parse(path.read_text(), filename=str(path))
+  aliases = _jax_aliases(tree)
+  problems: list[str] = []
+  for node in ast.walk(tree):
+    # jax.jit / j.jit attribute access — covers direct decorators, calls,
+    # and functools.partial(jax.jit, ...) alike, since all reference the
+    # attribute.
+    if (
+      isinstance(node, ast.Attribute)
+      and node.attr == "jit"
+      and isinstance(node.value, ast.Name)
+      and node.value.id in aliases
+    ):
+      problems.append(f"line {node.lineno}: {node.value.id}.jit")
+    # from jax import jit [as alias]
+    if isinstance(node, ast.ImportFrom) and (node.module or "") == "jax":
+      for alias in node.names:
+        if alias.name == "jit":
+          problems.append(f"line {node.lineno}: from jax import jit")
+  return problems
+
+
+def check() -> list[str]:
+  """Returns a list of human-readable violations (empty = clean)."""
+  problems: list[str] = []
+  for rel in CONSTRAINED:
+    path = REPO / rel
+    if not path.exists():
+      problems.append(f"{rel}: constrained module missing (ledger adoption reverted?)")
+      continue
+    for v in violations_in(path):
+      problems.append(f"{rel} {v} — serving-path jits must go through utils/programs.py tracked_jit (ISSUE 19)")
+  return problems
+
+
+def main() -> int:
+  problems = check()
+  if problems:
+    print("check_tracked_jit: FAIL")
+    for p in problems:
+      print(f"  - {p}")
+    return 1
+  print(f"check_tracked_jit: OK ({len(CONSTRAINED)} serving-path modules ledger-tracked)")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

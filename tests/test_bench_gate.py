@@ -317,3 +317,31 @@ def test_lora_gate_drops_artifacts():
   assert gate_lora(0.0, lo=0.001, hi=100.0) is None
   assert gate_lora(1e6, lo=0.001, hi=100.0) is None
   assert gate_lora(None) is None
+
+
+def test_compile_gate_steady_band_is_exactly_zero():
+  """ISSUE 19: the program-ledger round's drift gate. The DEFAULT band is
+  the steady band [0, 0] — ``steady_state_compiles`` must be exactly zero
+  (the no-recompile invariant measured over live dispatches), so any
+  nonzero count drops to null and surfaces as a missing metric."""
+  from bench import gate_compile
+
+  assert gate_compile(0) == 0.0
+  assert gate_compile(0.0) == 0.0
+  assert gate_compile(1) is None  # a steady-state recompile happened: broken round
+  assert gate_compile(3) is None
+  assert gate_compile(-1) is None
+  assert gate_compile(None) is None
+
+
+def test_compile_gate_warmup_band_keeps_plausible_seconds():
+  """``warmup_compile_s_total`` rides the same gate with a generous
+  plausibility band; 0.0 is legal (XOT_TPU_PROGRAMS=0 disables the ledger
+  without nulling the bench key)."""
+  from bench import gate_compile
+
+  assert gate_compile(0.0, lo=0.0, hi=3600.0) == 0.0
+  assert gate_compile(0.8421, lo=0.0, hi=3600.0) == 0.8421
+  assert gate_compile(120.0, lo=0.0, hi=3600.0) == 120.0
+  assert gate_compile(7200.0, lo=0.0, hi=3600.0) is None  # wedged into an outer timeout
+  assert gate_compile(None, lo=0.0, hi=3600.0) is None

@@ -185,14 +185,14 @@ def test_client_temperature_does_not_recompile():
   start = jnp.zeros((1,), dtype=jnp.int32)
   cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
   fused_decode(params, cfg, shard, tok, cache, start, 2, temp=0.6)  # compile the sampled variant
-  base = _fused_decode_impl._cache_size()
+  base = _fused_decode_impl.xot_jitted._cache_size()
   for temp in (0.61, 0.9, 1.3):
     cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
     fused_decode(params, cfg, shard, tok, cache, start, 2, temp=temp)
-  assert _fused_decode_impl._cache_size() == base  # no recompile per temperature
+  assert _fused_decode_impl.xot_jitted._cache_size() == base  # no recompile per temperature
   cache = init_kv_cache(cfg, shard.n_shard_layers, 1, 16)
   fused_decode(params, cfg, shard, tok, cache, start, 2, temp=0.0)
-  assert _fused_decode_impl._cache_size() == base + 1  # greedy is its own variant
+  assert _fused_decode_impl.xot_jitted._cache_size() == base + 1  # greedy is its own variant
 
 
 def test_score_last_tokens_matches_full_logits():

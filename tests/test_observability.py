@@ -723,6 +723,10 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_flightrec_events_total",  # {type}
   "xot_tpu_anomalies_total",  # {rule}
   "xot_tpu_incident_bundles_total",  # {trigger}
+  # Device-program ledger (ISSUE 19; all labeled {family})
+  "xot_tpu_program_compiles_total",
+  "xot_tpu_program_steady_compiles_total",
+  "xot_tpu_program_dispatch_total",
   # gauges
   "xot_tpu_scheduler_batch_occupancy",
   "xot_tpu_scheduler_queue_depth",
@@ -761,6 +765,9 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_lora_swaps_total",
   "xot_tpu_lora_requests_total",
   "xot_tpu_lora_swap_seconds",
+  # Device-program ledger (ISSUE 19)
+  "xot_tpu_programs_steady",  # 0 warming / 1 steady (post-warmup sentinel armed)
+  "xot_tpu_warmup_programs",  # manifest size of the last warmup
   # histograms
   "xot_tpu_ttft_seconds",
   "xot_tpu_itl_seconds",
@@ -778,6 +785,10 @@ EXPECTED_METRIC_NAMES = {
   "xot_tpu_kv_stream_seconds",  # {peer} (ISSUE 10 — disagg KV-page transfer)
   "xot_tpu_prefill_seconds",
   "xot_tpu_decode_step_seconds",
+  # Device-program ledger (ISSUE 19; compile/device labeled {family})
+  "xot_tpu_program_compile_seconds",
+  "xot_tpu_program_device_seconds",
+  "xot_tpu_warmup_compile_seconds",
   # per-peer-link RPC attribution (ISSUE 4; labeled {peer,method} / {method})
   "xot_tpu_peer_rpc_seconds",
   "xot_tpu_peer_rpc_serialize_seconds",
@@ -903,6 +914,14 @@ def test_metric_name_snapshot_after_serving():
   gm.inc("router_tenant_throttled_total", 0, labels={"tenant": "default"})
   gm.observe_hist("kv_stream_seconds", 0.0, labels={"peer": "peer-0"})
   gm.set_gauge("node_role", 0)
+  # Device-program ledger (ISSUE 19): the drive itself compiles and
+  # dispatches tracked programs (program_compiles_total / dispatch /
+  # compile+device seconds land naturally); the STEADY families are
+  # event-driven — no warmup ran, nothing recompiled post-steady.
+  gm.inc("program_steady_compiles_total", 0, labels={"family": "decode.batch"})
+  gm.set_gauge("programs_steady", 0)
+  gm.set_gauge("warmup_programs", 0)
+  gm.observe_hist("warmup_compile_seconds", 0.0)
   gm.set_gauge("slo_burn_rate", 0.0, labels={"class": "standard", "window": "300s"})
   gm.set_gauge("slo_attainment", 1.0, labels={"class": "standard"})
   gm.set_gauge("goodput_tok_s", 0.0, labels={"class": "standard"})

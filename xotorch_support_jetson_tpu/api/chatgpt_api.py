@@ -404,6 +404,8 @@ class ChatGPTAPI:
     r.add_get("/v1/adapters", self.handle_adapters)
     r.add_get("/v1/disagg", self.handle_disagg)
     r.add_get("/v1/slo", self.handle_slo)
+    r.add_get("/v1/programs", self.handle_programs)
+    r.add_post("/v1/warmup", self.handle_warmup)
     r.add_get("/v1/router", self.handle_router_state)
     r.add_get("/v1/router/stats", self.handle_router_stats)
     r.add_get("/v1/events", self.handle_events)
@@ -675,6 +677,70 @@ class ChatGPTAPI:
 
     return web.json_response(await loop.run_in_executor(None, local_report))
 
+  async def handle_programs(self, request):
+    """GET /v1/programs — the device-program ledger (ISSUE 19): per-family
+    compile/dispatch counts, compile seconds (wall + the backend's own where
+    jax.monitoring reports it), the triggering abstract shape signatures,
+    the warmup manifest, and the steady flag. ``?scope=cluster`` pulls each
+    peer's snapshot over the gRPC opaque-status channel (``programs_pull``,
+    the ``slo_pull`` pattern) and merges by summing per-family counts —
+    silent peers are annotated unreachable, never waited out."""
+    from ..utils.programs import ProgramLedger, ledger
+
+    local = ledger.snapshot()
+    local["node_id"] = getattr(self.node, "id", None)
+    if request.query.get("scope") != "cluster":
+      return web.json_response(local)
+    peer_snaps: list[dict] = []
+    collect = getattr(self.node, "collect_cluster_programs", None)
+    if collect is not None:
+      try:
+        peer_snaps = await collect()
+      except Exception:  # noqa: BLE001 — cluster pull degrades to local
+        if DEBUG >= 1:
+          import traceback
+
+          traceback.print_exc()
+    merged = ProgramLedger.merge_snapshots([local] + peer_snaps)
+    answered = {s.get("node_id") for s in peer_snaps}
+    merged["unreachable"] = [
+      pid for p in getattr(self.node, "peers", []) if (pid := p.id()) not in answered
+    ]
+    return web.json_response(merged)
+
+  async def handle_warmup(self, request):
+    """POST /v1/warmup — pre-compile the expected program set OFF the
+    serving path (ISSUE 19): the batched scheduler enumerates its warmup
+    manifest for the active config (backend, paged/dense, kv-quant,
+    spec/mixed/LoRA), drives representative synthetic requests through the
+    real submit path, then marks the ledger STEADY — from that point every
+    compile is a recompile-sentinel event. A COLD batched-capable engine
+    (fresh daemon, nothing served yet) first loads the default model's
+    shard — the whole point of calling warmup before traffic is that the
+    load+compile burst happens here, not inside the first request. Degrades
+    gracefully when no batched scheduler exists (dummy engine / non-batched
+    backend): the ledger is marked steady over an empty manifest so the
+    sentinel still arms."""
+    from ..utils.programs import ledger
+
+    engine = getattr(self.node, "inference_engine", None)
+    server = None
+    if engine is not None and getattr(engine, "supports_batched", None):
+      try:
+        if getattr(engine, "shard", None) is None and self.default_model:
+          shard = registry.build_base_shard(self.default_model, self.inference_engine_classname)
+          if shard is not None:
+            await engine.ensure_shard(shard)
+        if getattr(engine, "shard", None) is not None and engine.supports_batched():
+          server = engine.get_batched_server()
+      except Exception:  # noqa: BLE001 — a cold engine warms up empty
+        server = None
+    if server is None:
+      ledger.mark_steady(manifest=[])
+      return web.json_response({"manifest": [], "warmup_s": 0.0, "steady": True, "detail": "no batched scheduler; ledger marked steady over an empty manifest"})
+    out = await server.warmup()
+    return web.json_response(out)
+
   async def handle_router_stats(self, request):
     """GET /v1/router/stats — the replica-side advert a cluster router
     polls (ISSUE 13): this node's live capacity/pressure aggregates (the
@@ -848,6 +914,12 @@ class ChatGPTAPI:
     from ..orchestration.flightrec import flightrec
 
     flightrec.record("profile_capture", attributes={"dir": out_dir, "duration_ms": duration_ms, "steps": steps})
+    from ..utils.programs import ledger as program_ledger
+
+    # Dispatch-count baseline: the response names the program families that
+    # actually ran inside the captured window, so the trace joins against
+    # the ledger (ISSUE 19).
+    programs_base = program_ledger.dispatch_counts()
     self._profiling = True
     t0 = time.perf_counter()
     steps_seen = 0
@@ -879,6 +951,7 @@ class ChatGPTAPI:
       "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
       "steps_requested": steps,
       "steps_captured": steps_seen,
+      "programs": program_ledger.active_families(programs_base),
     })
 
   async def handle_traces(self, request):

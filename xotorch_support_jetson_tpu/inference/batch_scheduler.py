@@ -155,6 +155,7 @@ from ..orchestration import slo
 from ..orchestration.tracing import TERMINAL_STAGES, tracer
 from ..utils.helpers import DEBUG
 from ..utils.metrics import FRACTION_BUCKETS, metrics
+from ..utils.programs import dispatch_context, ledger
 from .engine import PromptTooLongError, RequestMigratedError, ServerOverloadedError
 from .qos import DeadlineUnmeetableError
 from .sched_admission import AdmissionControl, _Request
@@ -946,6 +947,112 @@ class BatchedServer:
       for cls, depth in self.queue.class_depths().items():
         metrics.set_gauge("qos_queue_depth", depth, labels={"class": cls})
 
+  @staticmethod
+  def _attributed(run, request_ids):
+    """Wrap an executor ``run`` closure in the program-ledger dispatch
+    context (ISSUE 19): a compile happens synchronously inside the jitted
+    call on the executor thread, so a thread-local set here is visible to
+    ``tracked_jit`` — a post-steady recompile can then name the request(s)
+    whose dispatch it stalled (flight ``compile`` event + timeline stage)."""
+
+    def wrapped():
+      with dispatch_context(request_ids):
+        return run()
+
+    return wrapped
+
+  # ------------------------------------------------ warmup manifest (ISSUE 19)
+
+  def warmup_manifest(self) -> list[dict]:
+    """The device-program families this config is expected to compile —
+    keyed off the ACTIVE facets: batched backend (single-device vs pp/sp),
+    paged vs dense KV, fused-sampling epilogue, spec / mixed / LoRA on or
+    off. Pad buckets multiply *shapes within* a family, not families, so
+    the manifest enumerates families and the warmup drives representative
+    shapes through them."""
+    ops_name = type(self.ops).__name__
+    fams: list[dict] = []
+
+    def add(family: str, why: str) -> None:
+      fams.append({"family": family, "why": why})
+
+    if ops_name == "PPBatchOps":
+      add("pp.prefill_pages" if self.paged else "pp.prefill_slots", "pipeline-parallel batched prefill")
+      add("pp.paged_decode" if self.paged else "pp.decode", "pipeline-parallel chunked decode")
+    elif ops_name == "SPBatchOps":
+      add("sp.prefill_pages" if self.paged else "sp.prefill_slots", "sequence-parallel batched prefill")
+      add("sp.paged_decode" if self.paged else "sp.decode", "sequence-parallel chunked decode")
+    else:
+      if self.paged:
+        add("prefill.pages_many_sampled" if self.fused_sampling else "prefill.pages_many", "paged batched prefill")
+      else:
+        add("prefill.slots_sampled" if self.fused_sampling else "prefill.slots", "dense batched prefill")
+      if not self.fused_sampling:
+        add("sample.rows", "unfused first-token sampling epilogue")
+      if self.spec:
+        add("spec.paged_batch" if self.paged else "spec.batch", "batched speculative decode (greedy rows)")
+      if self.paged:
+        add("decode.paged_batch", "paged batched decode")
+        if self.mixed:
+          add("decode.mixed_paged_batch", "mixed prefill+decode tick")
+      else:
+        add("decode.batch", "dense batched decode")
+    return fams
+
+  async def warmup(self) -> dict:
+    """Pre-compile the manifest off the serving path (POST /v1/warmup):
+    drive tiny synthetic requests through the REAL submit path — the same
+    programs, shapes bucketed the same way — then mark the ledger steady so
+    any later compile is a sentinel event. Best-effort: families the
+    synthetic traffic cannot reach (e.g. the mixed tick needs a prefill
+    arriving mid-decode) are reported ``warmed: false``."""
+    manifest = self.warmup_manifest()
+    before = ledger.dispatch_counts()
+    before_s = {f["family"]: ledger.compile_count(f["family"]) for f in manifest}
+    t0 = time.perf_counter()
+    errors: list[str] = []
+
+    def sink(_rid, _toks, _fin) -> None:
+      return None
+
+    async def one(tag: str, temp: float) -> None:
+      try:
+        await self.submit(
+          f"_warmup-{tag}-{id(self):x}", np.ones((4,), dtype=np.int32),
+          max_tokens=max(int(self.chunk), 1) + 1, temp=temp, top_k=5 if temp > 0 else 0,
+          eos_ids=(), emit=sink,
+        )
+      except Exception as e:  # noqa: BLE001 — warmup must never take the API down
+        errors.append(f"{tag}: {e!r}")
+
+    await one("sampled", 0.7)
+    if self.spec:
+      # Spec programs only dispatch for greedy rows.
+      await one("greedy", 0.0)
+    total_s = time.perf_counter() - t0
+    after = ledger.dispatch_counts()
+    per_family_s: dict[str, float] = {}
+    for entry in manifest:
+      fam = entry["family"]
+      entry["warmed"] = after.get(fam, 0) > before.get(fam, 0) or ledger.compile_count(fam) > before_s.get(fam, 0)
+      snap_fam = ledger.snapshot()["families"].get(fam)
+      if snap_fam:
+        per_family_s[fam] = snap_fam["compile_s"]
+    ledger.note_warmup(manifest, per_family_s, total_s)
+    ledger.mark_steady(manifest)
+    try:
+      from ..orchestration.flightrec import flightrec
+
+      flightrec.record("warmup", cause="v1_warmup", attributes={
+        "families": [e["family"] for e in manifest],
+        "warmed": [e["family"] for e in manifest if e.get("warmed")],
+        "total_s": round(total_s, 6),
+        "errors": errors,
+      })
+    except Exception:  # noqa: BLE001
+      pass
+    return {"manifest": manifest, "warmup_s": round(total_s, 6), "steady": True, "errors": errors}
+
   def stats_snapshot(self) -> dict:
     """Live capacity/pressure aggregates for this scheduler — the payload a
     replica advertises at ``GET /v1/router/stats`` (ISSUE 13). Read from
@@ -1475,7 +1582,9 @@ class BatchedServer:
       tracer.stage(r.req.request_id, "prefill_chunk", {"tokens": end - r.prefix_len, "batched_with": K - 1})
     t_dispatch = time.perf_counter()
     try:
-      firsts = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+      firsts = await asyncio.get_event_loop().run_in_executor(
+        eng.executor, self._attributed(run, [r.req.request_id for r in group])
+      )
     except Exception as e:  # noqa: BLE001
       for r in group:
         self._release_ready_pages(r)
@@ -2296,7 +2405,12 @@ class BatchedServer:
     if plan.starved:
       metrics.inc("scheduler_page_starved_total", len(plan.starved))
     t_dispatch = time.perf_counter()
-    toks, next_tok, counts, pos_dev, n_prop = await asyncio.get_event_loop().run_in_executor(eng.executor, run)
+    rids = [s.req.request_id for i, s in plan.rows if plan.active[i]]
+    if mixed_r is not None:
+      rids.append(mixed_r.req.request_id)
+    toks, next_tok, counts, pos_dev, n_prop = await asyncio.get_event_loop().run_in_executor(
+      eng.executor, self._attributed(run, rids)
+    )
     return _Chunk(
       toks=toks, next_tok=next_tok, rows=plan.rows, active=plan.active,
       starved=frozenset(plan.starved), t_dispatch=t_dispatch, chained=inflight is not None,

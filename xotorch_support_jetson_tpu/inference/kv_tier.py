@@ -61,6 +61,7 @@ from collections import OrderedDict
 import numpy as np
 
 from ..utils.metrics import SIZE_BUCKETS, metrics
+from ..utils.programs import tracked_jit
 
 MAX_REGISTRY_KEYS = 4096  # per scope (local, and per remote node)
 
@@ -103,7 +104,7 @@ def _bucket(n: int) -> int:
 def _gather_fn():
   import jax
 
-  @jax.jit
+  @tracked_jit("kv_tier.gather")
   def gather(leaf, idx):
     return leaf[:, idx]
 
@@ -114,7 +115,7 @@ def _gather_fn():
 def _scatter_fn():
   import jax
 
-  @functools.partial(jax.jit, donate_argnums=(0,))
+  @functools.partial(tracked_jit, "kv_tier.scatter", donate_argnums=(0,))
   def scatter(leaf, idx, data):
     return leaf.at[:, idx].set(data)
 

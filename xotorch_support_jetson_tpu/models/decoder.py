@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..inference.shard import Shard
+from ..utils.programs import tracked_jit
 from ..ops.attention import gqa_attention
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope, apply_rope_interleaved, rope_attention_factor, rope_inv_freq
@@ -649,8 +650,10 @@ def shard_forward(
 
 
 # Jitted entry: cfg/shard are static (hashable frozen dataclasses).
-jit_shard_forward = partial(jax.jit, static_argnames=("cfg", "shard"))(
-  lambda params, cfg, shard, x, positions, kv_cache: shard_forward(params, cfg, shard, x, positions, kv_cache)
+jit_shard_forward = tracked_jit(
+  "decode.shard_forward",
+  lambda params, cfg, shard, x, positions, kv_cache: shard_forward(params, cfg, shard, x, positions, kv_cache),
+  static_argnames=("cfg", "shard"),
 )
 
 
@@ -699,7 +702,7 @@ def _next_token(row, key, greedy: bool, temp, top_k: int):
   return sample_logits(row, sub, temp=temp, top_k=top_k), key
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "top_k", "greedy"), donate_argnums=(4,))
+@partial(tracked_jit, "decode.fused", static_argnames=("cfg", "shard", "n_steps", "top_k", "greedy"), donate_argnums=(4,))
 def _fused_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, n_steps: int, temp, top_k: int, greedy: bool, key, adapter_ids):
   def body(carry, _):
     tok, pos, cache, key = carry
@@ -728,7 +731,7 @@ def fused_decode(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos
   return _fused_decode_impl(params, cfg, shard, token, cache, start_pos, int(n_steps), temp_arr, int(top_k), greedy, key, adapter_ids)
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "max_steps", "top_k", "eos_ids", "greedy"), donate_argnums=(4,))
+@partial(tracked_jit, "decode.fused_generate", static_argnames=("cfg", "shard", "max_steps", "top_k", "eos_ids", "greedy"), donate_argnums=(4,))
 def _fused_generate_impl(params, cfg: ModelConfig, shard: Shard, token, cache, start_pos, max_steps: int, eos_ids: tuple, temp, top_k: int, greedy: bool, key, n_limit, adapter_ids):
   B = token.shape[0]
   eos = jnp.asarray(eos_ids, dtype=jnp.int32) if eos_ids else None
@@ -802,7 +805,7 @@ def fused_generate(
 # ------------------------------------------------ speculative decoding
 
 
-@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "shard_t", "shard_d", "max_steps", "gamma", "eos_ids"), donate_argnums=(6, 7))
+@partial(tracked_jit, "spec.generate", static_argnames=("cfg_t", "cfg_d", "shard_t", "shard_d", "max_steps", "gamma", "eos_ids"), donate_argnums=(6, 7))
 def _fused_spec_generate_impl(
   params_t, params_d, cfg_t: ModelConfig, cfg_d: ModelConfig, shard_t: Shard, shard_d: Shard,
   cache_t, cache_d, token, start_pos, max_steps: int, gamma: int, eos_ids: tuple, n_limit,
@@ -921,7 +924,7 @@ def fused_speculative_generate(
   )
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
+@partial(tracked_jit, "spec.chunk", static_argnames=("cfg", "shard", "cfg_d", "shard_d", "steps", "gamma", "eos_ids"), donate_argnums=(3, 4))
 def _fused_spec_chunk_impl(params_t, params_d, token, cache_t, cache_d, pos, n_limit, steps: int, gamma: int, eos_ids: tuple, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard):
   buf, n, rounds, cache_t, cache_d = _fused_spec_generate_impl(
     params_t, params_d, cfg, cfg_d, shard, shard_d, cache_t, cache_d, token, pos, steps, gamma, eos_ids, n_limit
@@ -967,7 +970,7 @@ def fused_speculative_chunk(params_t, cfg: ModelConfig, shard: Shard, params_d, 
 # bound, so B rows cost ≈ 1 row) with per-row positions/temperature.
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard"))
+@partial(tracked_jit, "prefill.slot", static_argnames=("cfg", "shard"))
 def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row, prompt_len):
   """Prefill one request into batch row ``row`` of the pooled cache.
 
@@ -990,7 +993,7 @@ def prefill_into_slot(params, cfg: ModelConfig, shard: Shard, tokens, cache, row
   return last, cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard"))
+@partial(tracked_jit, "prefill.slots", static_argnames=("cfg", "shard"))
 def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, adapter_ids=None):
   """Prefill K requests into K pool rows in ONE dispatch.
 
@@ -1015,7 +1018,7 @@ def prefill_into_slots(params, cfg: ModelConfig, shard: Shard, tokens, cache, ro
   return logits[:, 0, :], cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "page_size"))
+@partial(tracked_jit, "prefill.pages_many", static_argnames=("cfg", "shard", "page_size"))
 def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, adapter_ids=None):
   """``prefill_into_pages`` for K requests in ONE dispatch.
 
@@ -1038,7 +1041,7 @@ def prefill_into_pages_many(params, cfg: ModelConfig, shard: Shard, tokens, pool
   return logits[:, 0, :], pool
 
 
-@partial(jax.jit, static_argnames=("k_max",))
+@partial(tracked_jit, "sample.rows", static_argnames=("k_max",))
 def sample_rows(logits, key, temps, top_ks, k_max: int):
   """First-token sampling for a batched admission: per-row temp/top_k over
   [K, V] logits in one device call (K host-side _sample_sync round-trips
@@ -1066,7 +1069,7 @@ def sample_rows(logits, key, temps, top_ks, k_max: int):
 # ``_next_token_batched`` math, same key, same traced temps/top_ks.
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "k_max"))
+@partial(tracked_jit, "prefill.slots_sampled", static_argnames=("cfg", "shard", "k_max"))
 def prefill_into_slots_sampled(params, cfg: ModelConfig, shard: Shard, tokens, cache, rows, prompt_lens, temps, top_ks, key, k_max: int, adapter_ids=None):
   """``prefill_into_slots`` with the sampling epilogue fused in-program.
 
@@ -1077,7 +1080,7 @@ def prefill_into_slots_sampled(params, cfg: ModelConfig, shard: Shard, tokens, c
   return tok, cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "page_size", "k_max"))
+@partial(tracked_jit, "prefill.pages_many_sampled", static_argnames=("cfg", "shard", "page_size", "k_max"))
 def prefill_into_pages_many_sampled(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int, temps, top_ks, key, k_max: int, adapter_ids=None):
   """``prefill_into_pages_many`` with the sampling epilogue fused in-program
   (the paged-admission analogue of ``prefill_into_slots_sampled``)."""
@@ -1098,7 +1101,7 @@ def _next_token_batched(rows, key, temps, top_ks, k_max: int):
   return jnp.where(temps > 0, sampled, greedy_rows), key
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max"), donate_argnums=(4,))
+@partial(tracked_jit, "decode.batch", static_argnames=("cfg", "shard", "n_steps", "k_max"), donate_argnums=(4,))
 def _fused_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, cache, positions, active, temps, top_ks, n_steps: int, k_max: int, key, adapter_ids):
   def body(carry, _):
     tok, pos, cache, key = carry
@@ -1261,7 +1264,7 @@ def _paged_decode_scan(params, cfg: ModelConfig, shard: Shard, token, pool, bloc
   return jnp.moveaxis(toks, 0, 1), next_tok, pos, pool
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
+@partial(tracked_jit, "decode.paged_batch", static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
 def _fused_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key, adapter_ids):
   return _paged_decode_scan(params, cfg, shard, token, pool, block_tables, positions, active, temps, top_ks, n_steps, k_max, page_size, use_kernel, key, adapter_ids)
 
@@ -1319,7 +1322,7 @@ def fused_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, token, pool
 # token-identical to the alternating baseline by construction (test-pinned).
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
+@partial(tracked_jit, "decode.mixed_paged_batch", static_argnames=("cfg", "shard", "n_steps", "k_max", "page_size", "use_kernel"), donate_argnums=(4,))
 def _fused_mixed_paged_batch_decode_impl(params, cfg: ModelConfig, shard: Shard, token, pool, block_tables, positions, active, temps, top_ks, pf_tokens, pf_bt, pf_prefix, pf_end, n_steps: int, k_max: int, page_size: int, use_kernel: bool, key, adapter_ids, pf_adapter):
   from ..ops.paged import gather_row_pages, scatter_row_pages, touched_page_targets
 
@@ -1624,7 +1627,7 @@ def _spec_batch_rounds(params_d, cfg_d: ModelConfig, shard_d: Shard, verify, tok
   return buf, counts, n_prop, next_tok, next_pos, carry_t, cache_d
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max"), donate_argnums=(2, 3))
+@partial(tracked_jit, "spec.batch", static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max"), donate_argnums=(2, 3))
 def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, positions, active, gammas, temps, top_ks, key, props, prop_counts, adapter_ids, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int):
   def verify(window, wpos, cache):
     # The TARGET applies each row's adapter (ISSUE 15) — greedy identity vs
@@ -1636,7 +1639,7 @@ def _fused_spec_batch_decode_impl(params, params_d, cache, cache_d, token, posit
   return _spec_batch_rounds(params_d, cfg_d, shard_d, verify, token, cache, cache_d, positions, active, gammas, temps, top_ks, n_rounds, gamma_max, k_max, key, props, prop_counts)
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size", "use_kernel", "interpret"), donate_argnums=(2, 3))
+@partial(tracked_jit, "spec.paged_batch", static_argnames=("cfg", "shard", "cfg_d", "shard_d", "n_rounds", "gamma_max", "k_max", "page_size", "use_kernel", "interpret"), donate_argnums=(2, 3))
 def _fused_spec_paged_batch_decode_impl(params, params_d, pool, cache_d, token, block_tables, positions, active, gammas, temps, top_ks, key, props, prop_counts, adapter_ids, cfg: ModelConfig, shard: Shard, cfg_d: ModelConfig, shard_d: Shard, n_rounds: int, gamma_max: int, k_max: int, page_size: int, use_kernel: bool, interpret: bool):
   # Inactive rows' window writes must not land on pages another row may now
   # own: pin their tables to the trash page once (tables are chunk-constant).
@@ -1738,7 +1741,7 @@ def fused_spec_paged_batch_decode(params, cfg: ModelConfig, shard: Shard, params
   )
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "page_size"))
+@partial(tracked_jit, "prefill.pages", static_argnames=("cfg", "shard", "page_size"))
 def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_row, prefix_len, prompt_len, page_size: int):
   """Prefill one request's prompt SUFFIX into its pages.
 
@@ -1790,7 +1793,7 @@ def prefill_into_pages(params, cfg: ModelConfig, shard: Shard, tokens, pool, bt_
 # sequence logits would be [S, V] fp32 — ~2 GB at a 4K/128K-vocab request).
 
 
-@partial(jax.jit, static_argnames=("cfg", "shard", "n_scored", "top_n"))
+@partial(tracked_jit, "prefill.score_last", static_argnames=("cfg", "shard", "n_scored", "top_n"))
 def score_last_tokens(params, cfg: ModelConfig, shard: Shard, tokens, seq_len, n_scored: int, top_n: int):
   """Logprobs of the last ``n_scored`` tokens of a [1, S_pad] sequence.
 

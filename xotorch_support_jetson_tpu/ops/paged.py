@@ -33,6 +33,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.programs import tracked_jit
 from .attention import NEG_INF, gqa_attention, mla_absorbed_attention
 
 DEFAULT_PAGE_SIZE = 64
@@ -354,7 +355,7 @@ def paged_decode_attention(
   )
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "pages_per_step", "kv_quant", "interpret"))
+@functools.partial(tracked_jit, "ops.paged_attention", static_argnames=("page_size", "pages_per_step", "kv_quant", "interpret"))
 def _paged_decode_attention_impl(
   q, k_pool_l, v_pool_l, block_tables, lengths, k_scale_pool_l, v_scale_pool_l,
   page_size: int, pages_per_step: int, kv_quant: str, interpret: bool,

@@ -27,6 +27,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..utils.programs import tracked_jit
+
 BLOCK_IN = 512  # packed rows per step = BLOCK_IN//2
 BLOCK_OUT = 512
 
@@ -65,7 +67,7 @@ def _block_out(d_out: int) -> int:
   return 0
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(tracked_jit, "ops.int4_matmul", static_argnames=("interpret",))
 def int4_matmul(x: jnp.ndarray, w_packed: jnp.ndarray, scale: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
   """x [T, in] (bf16/f32) @ packed int4 w [in/2, out] → [T, out] in x.dtype.
 

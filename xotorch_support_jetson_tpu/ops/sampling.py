@@ -13,12 +13,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..utils.programs import tracked_jit
+
 DEFAULT_TEMP = 0.6
 DEFAULT_TOP_K = 35
 NEG_INF = -1e30
 
 
-@partial(jax.jit, static_argnames=("top_k",))
+@partial(tracked_jit, "sample.logits", static_argnames=("top_k",))
 def sample_logits(
   logits: jnp.ndarray,  # [B, V]
   key: jax.Array,
@@ -54,7 +56,7 @@ def _apply_top_p_full(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
   return jnp.take_along_axis(masked, inv, axis=-1)
 
 
-@partial(jax.jit, static_argnames=("k_max",))
+@partial(tracked_jit, "sample.logits_per_row", static_argnames=("k_max",))
 def sample_logits_per_row(
   logits: jnp.ndarray,  # [B, V]
   key: jax.Array,
@@ -77,6 +79,6 @@ def sample_logits_per_row(
   return jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
-@jax.jit
+@tracked_jit("sample.greedy")
 def greedy(logits: jnp.ndarray) -> jnp.ndarray:
   return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -69,6 +69,9 @@ EVENT_TYPES = (
   "topology_join", "topology_leave", "drain_announced", "replay",
   # observability plane
   "profile_capture", "anomaly", "bundle_captured",
+  # device-program ledger (ISSUE 19): a post-steady XLA compile (the
+  # recompile sentinel, utils/programs.py) and a completed warmup pass
+  "compile", "warmup",
 )
 
 
@@ -197,7 +200,7 @@ class AnomalyWatchers:
   condition from flooding the ring — the bundle manager's own rate limit
   additionally bounds disk captures."""
 
-  RULES = ("breaker_flap", "spec_acceptance_collapse", "page_pool_thrash", "burn_rate", "clock_jump")
+  RULES = ("breaker_flap", "spec_acceptance_collapse", "page_pool_thrash", "burn_rate", "clock_jump", "recompile_storm")
 
   def __init__(self) -> None:
     self._last_fired: dict[str, float] = {}
@@ -315,6 +318,28 @@ class AnomalyWatchers:
         if ev:
           fired.append(ev)
 
+    # Recompile storm (ISSUE 19): the program ledger was marked steady by
+    # warmup, yet compiles keep landing — a shape leak (an unpadded bucket,
+    # a traced-vs-static regression) is stalling live requests multi-second
+    # at a time. Each ``compile`` flight event is one compiling dispatch
+    # (nested program builds collapse into their top-level dispatch), so
+    # the threshold counts serving stalls, not call-graph fan-out.
+    if self._cooled("recompile_storm", now):
+      window_s = env_float("XOT_TPU_ANOMALY_RECOMPILE_WINDOW_S", 60.0)
+      storm_n = int(env_float("XOT_TPU_ANOMALY_RECOMPILES", 3))
+      compiles = flightrec.query(types={"compile"}, since_s=window_s, limit=flightrec.capacity)
+      if len(compiles) >= storm_n:
+        families: dict[str, int] = {}
+        for ev in compiles:
+          fam = (ev.get("attributes") or {}).get("family") or "?"
+          families[fam] = families.get(fam, 0) + 1
+        ev = self._fire(
+          "recompile_storm", now, node=node, loop=loop,
+          compiles=len(compiles), window_s=window_s, families=families,
+        )
+        if ev:
+          fired.append(ev)
+
     return fired
 
 
@@ -343,6 +368,12 @@ def config_fingerprint() -> dict:
 
   digest = hashlib.sha256(json.dumps(env, sort_keys=True).encode()).hexdigest()[:16]
   return {"env": env, "versions": versions, "env_sha": digest}
+
+
+def _programs_section() -> dict:
+  from ..utils.programs import ledger
+
+  return ledger.snapshot()
 
 
 def assemble_local_bundle(node=None, reason: str = "manual", events_limit: int = 512) -> dict:
@@ -377,6 +408,7 @@ def assemble_local_bundle(node=None, reason: str = "manual", events_limit: int =
   section("clock_offsets", lambda: {pid: est.to_dict() for pid, est in clock_sync.offsets().items()})
   section("chaos", chaos.snapshot)
   section("slo", lambda: slo_engine.report() if slo_enabled() else {"enabled": False})
+  section("programs", _programs_section)
   section("inflight_timelines", lambda: tracer.inflight_timelines(16))
   if node is not None:
     section("peers", lambda: [p.id() for p in getattr(node, "peers", [])])

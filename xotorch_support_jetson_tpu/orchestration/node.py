@@ -144,6 +144,8 @@ class Node:
     self._slo_waiters: dict[str, list] = {}
     # Cluster incident-bundle pulls in flight: nonce -> [event, parts, expected].
     self._bundle_waiters: dict[str, list] = {}
+    # Cluster program-ledger pulls in flight: nonce -> [event, snapshots, expected].
+    self._programs_waiters: dict[str, list] = {}
 
     # Fault-tolerance state (ISSUE 8). ``draining`` marks THIS node as
     # shutting down (no new work; resident batched rows migrate);
@@ -1857,6 +1859,62 @@ class Node:
     slo_engine.maybe_tick(node=self, loop=loop)
     return merge_slo_reports([slo_engine.report(node_id=self.id)] + peer_reports)
 
+  # ----------------------------------------- cluster program ledger (ISSUE 19)
+
+  async def collect_cluster_programs(self, timeout: float = 2.0) -> list[dict]:
+    """Pull every peer's program-ledger snapshot over the opaque-status
+    channel (the ``slo_pull`` pattern) for ``/v1/programs?scope=cluster``.
+    Dead peers are annotated by absence — the endpoint merges whatever
+    arrived within ``timeout`` and lists the silent peers as unreachable."""
+    if not self.peers:
+      return []
+    nonce = uuid.uuid4().hex
+    event = asyncio.Event()
+    waiter = [event, [], len(self.peers)]
+    self._programs_waiters[nonce] = waiter
+    bcast = asyncio.create_task(self.broadcast_opaque_status(
+      "", json.dumps({"type": "programs_pull", "node_id": self.id, "nonce": nonce})
+    ))
+    try:
+      try:
+        await asyncio.wait_for(event.wait(), timeout=timeout)
+      except asyncio.TimeoutError:
+        pass  # merge whatever arrived; silent peers annotated by the caller
+      return list(waiter[1])
+    finally:
+      self._programs_waiters.pop(nonce, None)
+      bcast.cancel()
+
+  def _handle_programs_status(self, status_data: dict) -> None:
+    from ..utils.programs import ledger
+
+    kind = status_data.get("type")
+    if kind == "programs_pull":
+      requester = status_data.get("node_id")
+      if requester == self.id:
+        return  # our own broadcast echoing back through the local trigger
+      peer = next((p for p in self.peers if p.id() == requester), None)
+      if peer is not None:
+        nonce = status_data.get("nonce", "")
+
+        async def send():
+          try:
+            snap = ledger.snapshot()
+            snap["node_id"] = self.id
+            await peer.send_opaque_status("", json.dumps({
+              "type": "programs_report", "node_id": self.id, "nonce": nonce, "snapshot": snap,
+            }))
+          except Exception:  # noqa: BLE001 — ledger replies are best-effort
+            if DEBUG >= 1:
+              print(f"[node {self.id}] programs report reply to {requester} failed")
+        asyncio.create_task(send())
+    elif kind == "programs_report":
+      waiter = self._programs_waiters.get(status_data.get("nonce", ""))
+      if waiter is not None and status_data.get("node_id") != self.id:
+        waiter[1].append(status_data.get("snapshot") or {})
+        if len(waiter[1]) >= waiter[2]:
+          waiter[0].set()
+
   # ---------------------------------------------- incident bundles (ISSUE 9)
 
   async def collect_cluster_bundle(self, reason: str = "manual", timeout: float = 3.0) -> dict:
@@ -2203,6 +2261,9 @@ class Node:
       elif status_type in ("bundle_pull", "bundle_part"):
         # Incident-bundle assembly (ISSUE 9).
         self._handle_bundle_status(status_data)
+      elif status_type in ("programs_pull", "programs_report"):
+        # Device-program ledger snapshots (ISSUE 19).
+        self._handle_programs_status(status_data)
       if self.topology_viz:
         self.topology_viz.update_visualization(self.topology, self.partitioning_strategy.partition(self.topology), self.id)
     except Exception:  # noqa: BLE001

@@ -230,6 +230,18 @@ def parked_wait_ms(events: list[dict], end_ns: int) -> float:
   return round(total / 1e6, 3)
 
 
+def _active_program_families(window_ms: float) -> list[str]:
+  """Program-ledger families dispatched within the last ``window_ms`` — the
+  slow-request window, converted from the timeline's monotonic span to a
+  wall-clock cutoff (best effort; an empty ledger yields [])."""
+  try:
+    from ..utils.programs import ledger
+
+    return ledger.families_active_since(time.time() - window_ms / 1e3)
+  except Exception:  # noqa: BLE001 — the slow line must never fail to print
+    return []
+
+
 class Tracer:
   def __init__(self, max_spans: int = 4096) -> None:
     self.spans: deque[Span] = deque(maxlen=max_spans)
@@ -314,6 +326,11 @@ class Tracer:
             # detail): which peer link ate the time is answerable from the
             # log line alone.
             "hops": dict(tl.get("hop_agg") or {}),
+            # Device-program families dispatched inside this request's
+            # window (ISSUE 19) — the slow line joins against the ledger:
+            # a recompile stall shows up here as its program family plus a
+            # ``compile`` stage in ``stages``.
+            "programs": _active_program_families(total_ms),
           })
     self._flush_export()
     if completed:

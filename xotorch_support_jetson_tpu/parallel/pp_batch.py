@@ -59,6 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.decoder import _next_token_batched, embed_tokens, head_logits
 from ..ops.rope import rope_inv_freq
+from ..utils.programs import tracked_jit
 from .pp_serving import _merge_written, _pp_tick_loop, _stage_forward, place_pp_params, pp_cache_spec, split_pp_params
 from .mesh import shard_map_compat
 
@@ -183,7 +184,7 @@ class PPBatchedServing:
       cache = {**cache, **{k: cache[k].at[:, rows].set(sub[k]) for k in kv_keys}}
       return h, cache
 
-    @jax.jit  # NOT donated: a failed prefill must leave the pool intact
+    @tracked_jit("pp.prefill_slots")  # NOT donated: a failed prefill must leave the pool intact
     def _prefill_slots(stage_params, head, tokens, cache, rows, prompt_lens):
       K, S = tokens.shape
       positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
@@ -210,7 +211,7 @@ class PPBatchedServing:
       out.update({k: row_scatter(pool[k], temp[k]) for k in kv_keys})
       return h, out
 
-    @partial(jax.jit, static_argnames=("page_size",))  # NOT donated (failed prefill)
+    @partial(tracked_jit, "pp.prefill_pages", static_argnames=("page_size",))  # NOT donated (failed prefill)
     def _prefill_pages(stage_params, head, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
       S = tokens.shape[1]
       positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
@@ -346,7 +347,7 @@ class PPBatchedServing:
 
       return fn
 
-    @partial(jax.jit, static_argnames=("n_steps", "k_max", "G"), donate_argnums=(3,))
+    @partial(tracked_jit, "pp.decode", static_argnames=("n_steps", "k_max", "G"), donate_argnums=(3,))
     def _batch_decode(stage_params, head, token, cache, positions, active, temps, top_ks, key, n_steps: int, k_max: int, G: int):
       fn = sm(
         lambda sp, hd, tk, c, pos, act, tmp, tpk, ky: decode_sm(n_steps, k_max, G, False, 0)(sp, hd, tk, c, None, pos, act, tmp, tpk, ky),
@@ -360,7 +361,7 @@ class PPBatchedServing:
       # column IS the next chunk's input for every row.
       return toks, toks[:, -1:], pos, cache
 
-    @partial(jax.jit, static_argnames=("n_steps", "k_max", "G", "page_size"), donate_argnums=(3,))
+    @partial(tracked_jit, "pp.paged_decode", static_argnames=("n_steps", "k_max", "G", "page_size"), donate_argnums=(3,))
     def _paged_batch_decode(stage_params, head, token, pool, block_tables, positions, active, temps, top_ks, key, n_steps: int, k_max: int, G: int, page_size: int):
       fn = sm(
         decode_sm(n_steps, k_max, G, True, page_size),

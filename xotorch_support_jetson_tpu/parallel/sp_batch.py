@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.decoder import _next_token_batched, embed_tokens, head_logits
 from ..ops.rope import rope_inv_freq
+from ..utils.programs import tracked_jit
 from .sp_serving import AXIS, SPServing, _sp_forward, _sp_layer_step
 from .mesh import shard_map_compat
 
@@ -156,7 +157,7 @@ class SPBatchedServing:
       cache = {k: cache[k].at[:, rows].set(sub[k]) for k in cache}
       return h, cache
 
-    @jax.jit  # NOT donated: a failed prefill must leave the pool intact
+    @tracked_jit("sp.prefill_slots")  # NOT donated: a failed prefill must leave the pool intact
     def _prefill_slots(params, tokens, cache, rows, prompt_lens):
       K, S = tokens.shape
       positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (K, S))
@@ -185,7 +186,7 @@ class SPBatchedServing:
 
       return fn
 
-    @partial(jax.jit, static_argnames=("n_steps", "k_max"), donate_argnums=(2,))
+    @partial(tracked_jit, "sp.decode", static_argnames=("n_steps", "k_max"), donate_argnums=(2,))
     def _batch_decode(params, token, cache, positions, active, temps, top_ks, key, n_steps: int, k_max: int):
       fn = sm(
         decode_sm(n_steps, k_max),
@@ -238,7 +239,7 @@ class SPBatchedServing:
 
       return fn
 
-    @partial(jax.jit, static_argnames=("page_size",))  # NOT donated: a failed prefill must leave the pool intact
+    @partial(tracked_jit, "sp.prefill_pages", static_argnames=("page_size",))  # NOT donated: a failed prefill must leave the pool intact
     def _prefill_pages(params, tokens, pool, bt_rows, prefix_lens, prompt_lens, page_size: int):
       K, S = tokens.shape
       positions = prefix_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
@@ -291,7 +292,7 @@ class SPBatchedServing:
 
       return fn
 
-    @partial(jax.jit, static_argnames=("n_steps", "k_max", "page_size"), donate_argnums=(2,))
+    @partial(tracked_jit, "sp.paged_decode", static_argnames=("n_steps", "k_max", "page_size"), donate_argnums=(2,))
     def _paged_batch_decode(params, token, pool, block_tables, positions, active, temps, top_ks, key, n_steps: int, k_max: int, page_size: int):
       fn = sm(
         paged_decode_sm(n_steps, k_max, page_size),
